@@ -1,0 +1,33 @@
+"""geomesa_tpu: a TPU-native spatio-temporal indexing and query framework.
+
+Re-implements the capabilities of GeoMesa (reference: jorgeramirez/geomesa, a
+fork of locationtech/geomesa) with a JAX/XLA/Pallas execution model:
+
+- space-filling-curve index math (Z2/Z3/XZ2/XZ3) as vectorized bit kernels
+  (``geomesa_tpu.curves``)
+- columnar SimpleFeature batches (struct-of-arrays, Arrow-fed)
+  (``geomesa_tpu.features``)
+- CQL-style filters compiled to fused device mask scans
+  (``geomesa_tpu.filter``, ``geomesa_tpu.ops``)
+- index build = z-key sort + partition manifests (``geomesa_tpu.index``)
+- a query planner with strategy costing and partition pruning
+  (``geomesa_tpu.query``)
+- DataStore-style APIs over in-memory and Parquet filesystem backends
+  (``geomesa_tpu.store``)
+- pushdown analytics: density, stats sketches, BIN export, kNN
+  (``geomesa_tpu.process``, ``geomesa_tpu.stats``)
+- multi-chip scaling via jax.sharding meshes + XLA collectives
+  (``geomesa_tpu.parallel``)
+
+Subpackages are added as layers land (see the build plan in SURVEY.md
+section 7); importing ``geomesa_tpu`` itself is side-effect free -- jax is
+loaded lazily and 64-bit mode is enabled only by the code paths that need it
+(``geomesa_tpu.jaxconf.require_x64``).
+
+Design notes live in SURVEY.md (structural analysis of the reference) at the
+repo root. Citations in docstrings use upstream-canonical GeoMesa paths; the
+reference mount was empty at survey time so they are unverified (SURVEY.md
+provenance note).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,41 @@
+"""Space-filling-curve layer (maps reference L3).
+
+Mirrors the semantics of GeoMesa's curve module + the sfcurve z-order library:
+
+- ``zorder``:      Morton interleave/deinterleave bit kernels
+                   (ref: org.locationtech.sfcurve.zorder.{Z2,Z3})
+- ``normalize``:   fixed-precision dimension quantization
+                   (ref: geomesa-z3 .../curve/NormalizedDimension.scala)
+- ``binnedtime``:  epoch time binning (day/week/month/year)
+                   (ref: geomesa-z3 .../curve/BinnedTime.scala)
+- ``z2``/``z3``:   point curves (ref: Z2SFC.scala / Z3SFC.scala)
+- ``zranges``:     query box -> contiguous z-value ranges (litmax/bigmin
+                   decomposition; ref: sfcurve ZN.zranges)
+- ``xz2``/``xz3``: extent curves for non-point geometries
+                   (ref: XZ2SFC.scala / XZ3SFC.scala) -- planned, not yet
+                   implemented
+"""
+
+from geomesa_tpu.curves.binnedtime import BinnedTime, TimePeriod
+from geomesa_tpu.curves.normalize import (
+    NormalizedDimension,
+    NormalizedLat,
+    NormalizedLon,
+    NormalizedTime,
+)
+from geomesa_tpu.curves.z2 import Z2SFC
+from geomesa_tpu.curves.z3 import Z3SFC
+from geomesa_tpu.curves.zranges import IndexRange, zranges
+
+__all__ = [
+    "BinnedTime",
+    "TimePeriod",
+    "NormalizedDimension",
+    "NormalizedLat",
+    "NormalizedLon",
+    "NormalizedTime",
+    "Z2SFC",
+    "Z3SFC",
+    "IndexRange",
+    "zranges",
+]

@@ -1,0 +1,91 @@
+"""Fixed-precision dimension quantization.
+
+Semantics follow GeoMesa's NormalizedDimension
+(ref: geomesa-z3 .../curve/NormalizedDimension.scala, class
+BitNormalizedDimension [UNVERIFIED - empty reference mount]):
+
+- ``normalize(v) = maxIndex          if v >= max``
+- ``normalize(v) = floor((v - min) * bins / (max - min))  otherwise``
+- ``denormalize(i)`` returns the *center* of bin ``min(i, maxIndex)``.
+
+These exact floor/clamp rules are what make z-keys comparable bit-for-bit
+with an Accumulo Z3 scan, so they are kept verbatim rather than redesigned.
+Vectorized over NumPy arrays; `normalize_jax` mirrors them on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class NormalizedDimension:
+    """Maps a double in [min, max] onto {0 .. 2**precision - 1}."""
+
+    min: float
+    max: float
+    precision: int  # bits; <= 31
+
+    @property
+    def bins(self) -> int:
+        return 1 << self.precision
+
+    @property
+    def max_index(self) -> int:
+        return self.bins - 1
+
+    def normalize(self, value):
+        """Vectorized quantization; returns int64 ndarray (or scalar)."""
+        v = np.asarray(value, dtype=np.float64)
+        scale = self.bins / (self.max - self.min)
+        idx = np.floor((v - self.min) * scale).astype(np.int64)
+        idx = np.where(v >= self.max, self.max_index, idx)
+        # match reference: values below min floor to negative -- callers are
+        # expected to pre-clamp; we clamp to 0 to stay in key space.
+        return np.clip(idx, 0, self.max_index)
+
+    def denormalize(self, index):
+        """Bin center of index (clamped to max_index)."""
+        i = np.minimum(np.asarray(index, dtype=np.float64), self.max_index)
+        width = (self.max - self.min) / self.bins
+        return self.min + (i + 0.5) * width
+
+    def normalize_jax(self, value):
+        """Same quantization on device; returns int32 (max_index fits int32
+        for precision <= 31).
+
+        The float floor result is clamped *in float* before the integer cast
+        so values at/above ``max`` cannot overflow int32 (e.g. precision=31,
+        v just below 180.0 -> floor == 2**31). float32 cannot represent bin
+        edges exactly beyond ~23 bits, so inputs are promoted to float64 when
+        precision > 23 (requires x64; geomesa_tpu.jaxconf.require_x64). The
+        TPU hot path (Z3, precision 21) stays fully in 32-bit lanes.
+        """
+        import jax.numpy as jnp
+
+        v = value
+        if self.precision > 23 and v.dtype != jnp.float64:
+            from geomesa_tpu.jaxconf import require_x64
+
+            require_x64()
+            v = v.astype(jnp.float64)
+        scale = self.bins / (self.max - self.min)
+        f = jnp.floor((v - self.min) * scale)
+        f = jnp.clip(f, 0.0, float(self.max_index))
+        idx = f.astype(jnp.int32)
+        idx = jnp.where(v >= self.max, self.max_index, idx)
+        return jnp.clip(idx, 0, self.max_index)
+
+
+def NormalizedLon(precision: int) -> NormalizedDimension:
+    return NormalizedDimension(-180.0, 180.0, precision)
+
+
+def NormalizedLat(precision: int) -> NormalizedDimension:
+    return NormalizedDimension(-90.0, 90.0, precision)
+
+
+def NormalizedTime(precision: int, max_offset: float) -> NormalizedDimension:
+    return NormalizedDimension(0.0, max_offset, precision)

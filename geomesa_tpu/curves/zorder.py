@@ -1,0 +1,249 @@
+"""Morton (z-order) bit interleave kernels.
+
+Semantics follow the sfcurve z-order library used by GeoMesa
+(ref: org.locationtech.sfcurve.zorder.Z2 / Z3 [UNVERIFIED - empty reference
+mount, see SURVEY.md]):
+
+- 2D: 31 bits per dimension -> 62-bit z. Bit ``2k`` of z is bit ``k`` of x,
+  bit ``2k+1`` is bit ``k`` of y.
+- 3D: 21 bits per dimension -> 63-bit z. Bit ``3k`` is bit ``k`` of x,
+  ``3k+1`` y, ``3k+2`` t.
+
+Three implementations are provided:
+
+- ``*_py``:  pure-Python bit-by-bit oracle (tests only)
+- ``*_np``:  vectorized NumPy on uint64 lanes (host planning path)
+- ``*_jax``: device variants. The 64-bit-lane forms (``encode_3d_jax``)
+  enable x64 lazily; the TPU-safe forms (``encode_2d_jax``,
+  ``encode_3d_hi_lo_jax``) produce (hi, lo) uint32 z pairs and never touch a
+  64-bit lane.
+
+All functions are dtype-strict: inputs are expected as unsigned/nonnegative
+integers already clamped to the dimension precision.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+U = np.uint64
+
+# ---------------------------------------------------------------------------
+# 2D (Z2): 31 bits/dim, magic-mask gather/scatter
+# ---------------------------------------------------------------------------
+
+MAX_MASK_2D = 0x7FFFFFFF  # 31 bits
+BITS_2D = 62
+
+_M2 = [U(m) for m in (
+    0x00000000FFFFFFFF,
+    0x0000FFFF0000FFFF,
+    0x00FF00FF00FF00FF,
+    0x0F0F0F0F0F0F0F0F,
+    0x3333333333333333,
+    0x5555555555555555,
+)]
+
+
+def split_2d_np(x: np.ndarray) -> np.ndarray:
+    """Spread the low 31 bits of each lane to even bit positions."""
+    x = np.asarray(x).astype(np.uint64) & U(MAX_MASK_2D)
+    x = (x ^ (x << U(32))) & _M2[0]
+    x = (x ^ (x << U(16))) & _M2[1]
+    x = (x ^ (x << U(8))) & _M2[2]
+    x = (x ^ (x << U(4))) & _M2[3]
+    x = (x ^ (x << U(2))) & _M2[4]
+    x = (x ^ (x << U(1))) & _M2[5]
+    return x
+
+
+def combine_2d_np(z: np.ndarray) -> np.ndarray:
+    """Gather even bit positions back into a 31-bit lane."""
+    x = np.asarray(z).astype(np.uint64) & _M2[5]
+    x = (x ^ (x >> U(1))) & _M2[4]
+    x = (x ^ (x >> U(2))) & _M2[3]
+    x = (x ^ (x >> U(4))) & _M2[2]
+    x = (x ^ (x >> U(8))) & _M2[1]
+    x = (x ^ (x >> U(16))) & _M2[0]
+    x = (x ^ (x >> U(32))) & U(MAX_MASK_2D)
+    return x
+
+
+def encode_2d_np(x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """(x, y) 31-bit lanes -> 62-bit z (uint64)."""
+    return split_2d_np(x) | (split_2d_np(y) << U(1))
+
+
+def decode_2d_np(z: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    z = np.asarray(z).astype(np.uint64)
+    return combine_2d_np(z), combine_2d_np(z >> U(1))
+
+
+# ---------------------------------------------------------------------------
+# 3D (Z3): 21 bits/dim
+# ---------------------------------------------------------------------------
+
+MAX_MASK_3D = 0x1FFFFF  # 21 bits
+BITS_3D = 63
+
+_M3 = [U(m) for m in (
+    0x00001F00000000FFFF,
+    0x00001F0000FF0000FF,
+    0x100F00F00F00F00F,
+    0x10C30C30C30C30C3,
+    0x1249249249249249,
+)]
+
+
+def split_3d_np(x: np.ndarray) -> np.ndarray:
+    """Spread the low 21 bits of each lane to every-3rd bit positions."""
+    x = np.asarray(x).astype(np.uint64) & U(MAX_MASK_3D)
+    x = (x | (x << U(32))) & _M3[0]
+    x = (x | (x << U(16))) & _M3[1]
+    x = (x | (x << U(8))) & _M3[2]
+    x = (x | (x << U(4))) & _M3[3]
+    x = (x | (x << U(2))) & _M3[4]
+    return x
+
+
+def combine_3d_np(z: np.ndarray) -> np.ndarray:
+    x = np.asarray(z).astype(np.uint64) & _M3[4]
+    x = (x ^ (x >> U(2))) & _M3[3]
+    x = (x ^ (x >> U(4))) & _M3[2]
+    x = (x ^ (x >> U(8))) & _M3[1]
+    x = (x ^ (x >> U(16))) & _M3[0]
+    x = (x ^ (x >> U(32))) & U(MAX_MASK_3D)
+    return x
+
+
+def encode_3d_np(x: np.ndarray, y: np.ndarray, t: np.ndarray) -> np.ndarray:
+    """(x, y, t) 21-bit lanes -> 63-bit z (uint64)."""
+    return split_3d_np(x) | (split_3d_np(y) << U(1)) | (split_3d_np(t) << U(2))
+
+
+def decode_3d_np(z: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    z = np.asarray(z).astype(np.uint64)
+    return combine_3d_np(z), combine_3d_np(z >> U(1)), combine_3d_np(z >> U(2))
+
+
+# ---------------------------------------------------------------------------
+# JAX variants (uint32 hi/lo lanes -- TPU has no native 64-bit integer lanes,
+# so the device kernels carry z as a (hi, lo) uint32 pair).
+# ---------------------------------------------------------------------------
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def encode_2d_jax(x, y):
+    """JAX 2D Morton encode from int32 lanes to (hi, lo) uint32 z pair.
+
+    Interleaves the low 16 bits of each dim into ``lo`` and the high 15 bits
+    into ``hi`` -- exact same bit layout as ``encode_2d_np`` viewed as
+    ``(z >> 32, z & 0xffffffff)``.
+    """
+    jnp = _jnp()
+    x = x.astype(jnp.uint32) & jnp.uint32(MAX_MASK_2D)
+    y = y.astype(jnp.uint32) & jnp.uint32(MAX_MASK_2D)
+    lo = _spread16_jax(x & 0xFFFF) | (_spread16_jax(y & 0xFFFF) << 1)
+    hi = _spread16_jax(x >> 16) | (_spread16_jax(y >> 16) << 1)
+    return hi, lo
+
+
+def _spread16_jax(v):
+    """Spread 16 bits of a uint32 lane to even positions (32-bit result)."""
+    jnp = _jnp()
+    v = v.astype(jnp.uint32)
+    v = (v ^ (v << 8)) & jnp.uint32(0x00FF00FF)
+    v = (v ^ (v << 4)) & jnp.uint32(0x0F0F0F0F)
+    v = (v ^ (v << 2)) & jnp.uint32(0x33333333)
+    v = (v ^ (v << 1)) & jnp.uint32(0x55555555)
+    return v
+
+
+def encode_3d_jax(x, y, t):
+    """JAX 3D Morton encode to a single uint64 lane (CPU/x64 paths)."""
+    from geomesa_tpu.jaxconf import require_x64
+
+    require_x64()
+    jnp = _jnp()
+
+    def split(v):
+        v = v.astype(jnp.uint64) & jnp.uint64(MAX_MASK_3D)
+        v = (v | (v << 32)) & _M3[0]
+        v = (v | (v << 16)) & _M3[1]
+        v = (v | (v << 8)) & _M3[2]
+        v = (v | (v << 4)) & _M3[3]
+        v = (v | (v << 2)) & _M3[4]
+        return v
+
+    return split(x) | (split(y) << 1) | (split(t) << 2)
+
+
+def encode_3d_hi_lo_jax(x, y, t):
+    """JAX 3D Morton encode from int32 lanes to (hi, lo) uint32 z pair.
+
+    TPU-friendly: never materializes a 64-bit lane. Layout matches
+    ``encode_3d_np`` viewed as ``(z >> 32, z & 0xffffffff)``.
+
+    Bits of z: bit 3k+d is bit k of dim d (d: 0=x, 1=y, 2=t). ``lo`` holds z
+    bits 0..31, ``hi`` holds 32..62. For each dim we spread 11 low bits into
+    lo (bits 3k+d < 32 -> k <= 10 for x; k <= 10 for y when 3k+1<32; k <= 9
+    for t when 3k+2<32) and the rest into hi. Rather than hand-deriving the
+    per-dim split points we spread each dim's 21 bits over two 32-bit halves
+    with a straddle-correct shift.
+    """
+    jnp = _jnp()
+
+    def spread11(v):
+        # spread low 11 bits to every-3rd positions of a 32-bit lane
+        v = v.astype(jnp.uint32) & jnp.uint32(0x7FF)
+        v = (v | (v << 16)) & jnp.uint32(0x070000FF)
+        v = (v | (v << 8)) & jnp.uint32(0x0700F00F)
+        v = (v | (v << 4)) & jnp.uint32(0x430C30C3)  # keeps bit 30 (k=10)
+        v = (v | (v << 2)) & jnp.uint32(0x49249249)
+        return v
+
+    out_hi = jnp.zeros(x.shape, jnp.uint32)
+    out_lo = jnp.zeros(x.shape, jnp.uint32)
+    for d, v in enumerate((x, y, t)):
+        v = v.astype(jnp.uint32) & jnp.uint32(MAX_MASK_3D)
+        # dim d occupies z bits 3k+d; bits with 3k+d < 32 live in lo.
+        # number of low ks: ceil((32-d)/3)
+        n_lo = (32 - d + 2) // 3
+        lo_bits = spread11(v & ((1 << n_lo) - 1)) << d
+        # The spread of n_lo bits may exceed bit 31 only if 3*(n_lo-1)+d > 31,
+        # which by construction it does not.
+        hi_k0 = n_lo  # first k that lands in hi
+        hi_pos = 3 * hi_k0 + d - 32  # bit position within hi for k=hi_k0
+        hi_bits = spread11(v >> n_lo) << hi_pos
+        out_lo = out_lo | lo_bits
+        out_hi = out_hi | hi_bits
+    return out_hi, out_lo
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python oracle (tests)
+# ---------------------------------------------------------------------------
+
+
+def encode_py(coords: tuple[int, ...], bits: int) -> int:
+    """Bit-by-bit Morton interleave. coords[d] contributes bit d of each
+    ``dims``-bit group."""
+    dims = len(coords)
+    z = 0
+    for k in range(bits):
+        for d, c in enumerate(coords):
+            z |= ((c >> k) & 1) << (k * dims + d)
+    return z
+
+
+def decode_py(z: int, dims: int, bits: int) -> tuple[int, ...]:
+    out = [0] * dims
+    for k in range(bits):
+        for d in range(dims):
+            out[d] |= ((z >> (k * dims + d)) & 1) << k
+    return tuple(out)

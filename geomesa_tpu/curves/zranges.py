@@ -1,0 +1,169 @@
+"""Query box -> contiguous z-value ranges (litmax/bigmin decomposition).
+
+Equivalent in effect to sfcurve's ``ZN.zranges`` quad/oct-tree prune
+(ref: org.locationtech.sfcurve.zorder.ZN [UNVERIFIED - empty reference
+mount]): given inclusive per-dimension index bounds, emit sorted disjoint
+``[zlo, zhi]`` ranges whose union covers every z whose cell lies inside the
+box, over-covering (never under-covering) when the ``max_ranges`` budget or
+recursion cap is hit. Over-coverage is always corrected downstream by the
+exact per-feature predicate scan (the Z3Iterator analog), so correctness of
+result sets does not depend on tightness -- only scan efficiency does.
+
+Implementation: binary descent over z bits (MSB first). In Morton layout bit
+``p`` of z belongs to dimension ``p % dims``, so a binary tree over z bits is
+exactly the quad/oct tree. DFS child-0-first yields ranges already sorted by
+``zlo``.
+
+This is the client-side hot loop of the reference's query path (SURVEY.md
+section 3.1); a C++ implementation with identical semantics is planned for
+``native/`` with this as the fallback.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+DEFAULT_MAX_RANGES = 2000  # ref: geomesa.scan.ranges.target default
+
+
+class IndexRange(NamedTuple):
+    lower: int  # inclusive
+    upper: int  # inclusive
+    contained: bool  # cell fully inside the query box (no residual needed)
+
+
+def zranges(
+    qlo: Sequence[int],
+    qhi: Sequence[int],
+    bits_per_dim: int,
+    max_ranges: int = DEFAULT_MAX_RANGES,
+    max_recurse: int | None = None,
+) -> list[IndexRange]:
+    """Decompose the inclusive box [qlo, qhi] into z ranges.
+
+    qlo/qhi: per-dimension inclusive normalized index bounds (dim order =
+    Morton bit order: dim d owns z bits ``k*dims + d``).
+    """
+    dims = len(qlo)
+    assert len(qhi) == dims
+    total_bits = dims * bits_per_dim
+    qlo = [int(v) for v in qlo]
+    qhi = [int(v) for v in qhi]
+    for d in range(dims):
+        if qhi[d] < qlo[d]:
+            return []
+
+    max_bits = total_bits
+    if max_recurse is not None:
+        # common prefix length of the box corners' z codes bounds where
+        # splitting can start; recursion counts full dim-rounds below it.
+        from geomesa_tpu.curves.zorder import encode_py
+
+        zmin = encode_py(tuple(qlo), bits_per_dim)
+        zmax = encode_py(tuple(qhi), bits_per_dim)
+        diff = zmin ^ zmax
+        prefix_len = total_bits - diff.bit_length()
+        max_bits = min(total_bits, prefix_len + max_recurse * dims)
+
+    from collections import deque
+
+    results: list[IndexRange] = []
+    overflow: list[IndexRange] = []
+    # node: (zprefix, decided_bits, per-dim prefixes tuple). Level-order BFS
+    # so the max_ranges budget is spent evenly across the tree -- a DFS would
+    # refine one flank to full depth and emit coarse cells for the rest.
+    stack: deque[tuple[int, int, tuple[int, ...]]] = deque([(0, 0, (0,) * dims)])
+
+    while stack:
+        zprefix, decided, dprefix = stack.popleft()
+        rem = total_bits - decided
+        # per-dim cell bounds
+        contained = True
+        disjoint = False
+        for d in range(dims):
+            # dim d has had ceil/floor share of decided bits: bits of dim d
+            # decided so far = number of p < decided with p % dims == d,
+            # where p counts from MSB: p-th decided bit is z bit
+            # (total_bits - 1 - p), owning dim (total_bits - 1 - p) % dims.
+            dec_d = _decided_for_dim(decided, d, dims, total_bits)
+            r = bits_per_dim - dec_d
+            lo_d = dprefix[d] << r
+            hi_d = lo_d + (1 << r) - 1
+            if hi_d < qlo[d] or lo_d > qhi[d]:
+                disjoint = True
+                break
+            if not (lo_d >= qlo[d] and hi_d <= qhi[d]):
+                contained = False
+        if disjoint:
+            continue
+        zlo = zprefix << rem
+        zhi = zlo + (1 << rem) - 1
+        if contained:
+            results.append(IndexRange(zlo, zhi, True))
+            continue
+        budget_left = max_ranges - len(results) - len(overflow) - len(stack)
+        if rem == 0 or decided >= max_bits or budget_left <= 0:
+            overflow.append(IndexRange(zlo, zhi, False))
+            continue
+        # split on the next z bit (MSB-first): z bit index total_bits-1-decided
+        d = (total_bits - 1 - decided) % dims
+        new_dp1 = tuple(
+            (v << 1) | 1 if i == d else v for i, v in enumerate(dprefix)
+        )
+        new_dp0 = tuple((v << 1) if i == d else v for i, v in enumerate(dprefix))
+        stack.append((zprefix << 1, decided + 1, new_dp0))
+        stack.append(((zprefix << 1) | 1, decided + 1, new_dp1))
+    results.extend(overflow)
+    results.sort(key=lambda r: r.lower)
+    return _merge(results, max_ranges)
+
+
+def _decided_for_dim(decided: int, d: int, dims: int, total_bits: int) -> int:
+    """How many bits of dim d are fixed after `decided` MSB-first z bits."""
+    # z bits consumed: total_bits-1 down to total_bits-decided.
+    # bit index b owns dim b % dims; count b in [total_bits-decided, total_bits-1]
+    # with b % dims == d.
+    if decided == 0:
+        return 0
+    lo_b = total_bits - decided
+    hi_b = total_bits - 1
+    # count of integers in [lo_b, hi_b] congruent to d mod dims
+    return (hi_b - d) // dims - (lo_b - 1 - d) // dims if hi_b >= d else 0
+
+
+def _merge(ranges: list[IndexRange], max_ranges: int) -> list[IndexRange]:
+    """Coalesce adjacent/overlapping ranges; enforce the budget by merging
+    the smallest gaps (over-covering, marked not-contained)."""
+    if not ranges:
+        return ranges
+    merged: list[IndexRange] = []
+    cur = ranges[0]
+    for r in ranges[1:]:
+        if r.lower <= cur.upper + 1:
+            cur = IndexRange(
+                cur.lower, max(cur.upper, r.upper), cur.contained and r.contained
+            )
+        else:
+            merged.append(cur)
+            cur = r
+    merged.append(cur)
+    while len(merged) > max_ranges:
+        # merge the pair with the smallest gap
+        gaps = [
+            (merged[i + 1].lower - merged[i].upper, i)
+            for i in range(len(merged) - 1)
+        ]
+        _, i = min(gaps)
+        merged[i : i + 2] = [
+            IndexRange(merged[i].lower, merged[i + 1].upper, False)
+        ]
+    return merged
+
+
+def ranges_to_array(ranges: list[IndexRange]) -> np.ndarray:
+    """(n, 2) uint64 array of [lower, upper] (inclusive)."""
+    if not ranges:
+        return np.zeros((0, 2), dtype=np.uint64)
+    return np.array([(r.lower, r.upper) for r in ranges], dtype=np.uint64)

@@ -1,0 +1,27 @@
+"""Scoped JAX configuration.
+
+geomesa-tpu needs 64-bit lanes only in specific places (uint64 z-value
+device ops on CPU, float64 quantization above 23 bits of precision). Rather
+than flipping ``jax_enable_x64`` globally at package import -- which would
+silently change dtype promotion for any host application that merely imports
+us -- the modules that need it call :func:`require_x64` lazily.
+
+The TPU hot paths (Z3 encode, predicate scans) are designed to stay in
+32-bit lanes (hi/lo uint32 z pairs, int32 quantized dims) and never call
+this.
+"""
+
+from __future__ import annotations
+
+_enabled = False
+
+
+def require_x64() -> None:
+    """Enable 64-bit jax types (idempotent)."""
+    global _enabled
+    if _enabled:
+        return
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    _enabled = True

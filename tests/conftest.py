@@ -1,0 +1,35 @@
+"""Test configuration: force CPU jax with a virtual 8-device mesh.
+
+Must run before any jax import (SURVEY.md section 4 rebuild test plan:
+multi-chip tests via host-platform device-count simulation).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The axon sitecustomize hook sets jax.config.jax_platforms directly (which
+# outranks the env var), so force the config back to cpu before any backend
+# initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+# Tests run the host-parity path: float64 quantization + uint64 z lanes on
+# CPU jax. (The TPU 32-bit lane path is covered by the hi/lo encode tests.)
+from geomesa_tpu.jaxconf import require_x64
+
+require_x64()
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,173 @@
+"""Z2/Z3 SFC + normalization + time binning semantics tests."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curves import (
+    BinnedTime,
+    NormalizedLat,
+    NormalizedLon,
+    TimePeriod,
+    Z2SFC,
+    Z3SFC,
+)
+from geomesa_tpu.curves import binnedtime
+
+
+class TestNormalize:
+    def test_edges(self):
+        lon = NormalizedLon(31)
+        assert int(lon.normalize(-180.0)) == 0
+        assert int(lon.normalize(180.0)) == lon.max_index
+        assert int(lon.normalize(179.99999999)) == lon.max_index
+        assert int(lon.normalize(0.0)) == 1 << 30
+
+    def test_roundtrip_within_bin(self, rng):
+        lat = NormalizedLat(21)
+        v = rng.uniform(-90, 90, size=1000)
+        idx = lat.normalize(v)
+        back = lat.denormalize(idx)
+        width = 180.0 / (1 << 21)
+        assert np.all(np.abs(back - v) <= width)
+
+    def test_denormalize_is_bin_center(self):
+        lon = NormalizedLon(31)
+        width = 360.0 / (1 << 31)
+        assert lon.denormalize(0) == pytest.approx(-180.0 + width / 2)
+
+    def test_jax_matches_np(self, rng):
+        import jax.numpy as jnp
+
+        lon = NormalizedLon(21)
+        v = rng.uniform(-180, 180, size=4096)
+        np.testing.assert_array_equal(
+            np.asarray(lon.normalize_jax(jnp.asarray(v))), lon.normalize(v)
+        )
+
+    def test_jax_boundary_no_int32_overflow(self):
+        # floor((v-min)*scale) == 2**31 for v just below max at precision=31;
+        # must clamp in float before the int cast (code-review finding).
+        import jax.numpy as jnp
+
+        lon = NormalizedLon(31)
+        vals = np.array(
+            [np.nextafter(180.0, -np.inf), 180.0, -180.0, np.nextafter(-180.0, np.inf)]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(lon.normalize_jax(jnp.asarray(vals))), lon.normalize(vals)
+        )
+
+
+class TestBinnedTime:
+    def test_week_binning(self):
+        # 1970-01-08T00:00:00Z = exactly 1 week after epoch
+        ms = 7 * 86400000
+        b, off = binnedtime.to_binned_time(ms, TimePeriod.WEEK)
+        assert (int(b), int(off)) == (1, 0)
+        b, off = binnedtime.to_binned_time(ms - 1000, TimePeriod.WEEK)
+        assert (int(b), int(off)) == (0, 604799)
+
+    def test_day_binning(self):
+        b, off = binnedtime.to_binned_time(86400000 + 123, TimePeriod.DAY)
+        assert (int(b), int(off)) == (1, 123)
+
+    def test_month_binning(self):
+        # 2020-03-01T00:00:10Z
+        ms = np.datetime64("2020-03-01T00:00:10", "ms").astype(np.int64)
+        b, off = binnedtime.to_binned_time(ms, TimePeriod.MONTH)
+        assert int(b) == (2020 - 1970) * 12 + 2
+        assert int(off) == 10
+
+    def test_year_binning(self):
+        ms = np.datetime64("1999-01-01T00:02:00", "ms").astype(np.int64)
+        b, off = binnedtime.to_binned_time(ms, TimePeriod.YEAR)
+        assert (int(b), int(off)) == (29, 2)
+
+    def test_roundtrip(self, rng):
+        ms = rng.integers(0, 2**41, size=500)  # up to ~2039
+        for period in TimePeriod:
+            b, off = binnedtime.to_binned_time(ms, period)
+            back = binnedtime.binned_time_to_millis(b, off, period)
+            unit = {"day": 1, "week": 1000, "month": 1000, "year": 60000}[
+                period.value
+            ]
+            assert np.all(ms - back < unit)
+            assert np.all(back <= ms)
+
+    def test_bins_for_interval(self):
+        wk = 7 * 86400000
+        spans = binnedtime.bins_for_interval(wk - 5000, 2 * wk + 1000, "week")
+        assert spans == [
+            (0, 604795, 604800),
+            (1, 0, 604800),
+            (2, 0, 1),
+        ]
+
+    def test_max_offsets(self):
+        assert binnedtime.max_offset("day") == 86400000
+        assert binnedtime.max_offset("week") == 604800
+        assert binnedtime.max_offset("month") == 2678400
+        assert binnedtime.max_offset("year") == 527040
+
+
+class TestZ2:
+    def test_known_corners(self):
+        sfc = Z2SFC()
+        assert int(sfc.index(-180.0, -90.0)) == 0
+        assert int(sfc.index(180.0, 90.0)) == (1 << 62) - 1
+
+    def test_invert_roundtrip(self, rng):
+        sfc = Z2SFC()
+        x = rng.uniform(-180, 180, 1000)
+        y = rng.uniform(-90, 90, 1000)
+        ix, iy = sfc.invert(sfc.index(x, y))
+        assert np.all(np.abs(ix - x) <= 360.0 / (1 << 31))
+        assert np.all(np.abs(iy - y) <= 180.0 / (1 << 31))
+
+
+class TestZ3:
+    def test_z3_range_containment(self, rng):
+        sfc = Z3SFC()
+        box = (-10.0, 20.0, 5.0, 45.0)
+        t0, t1 = 10000.0, 200000.0
+        ranges = sfc.ranges(box[0], box[1], box[2], box[3], t0, t1)
+        arr = np.array([(r.lower, r.upper) for r in ranges], dtype=np.int64)
+        # every point inside the box must land in some range
+        x = rng.uniform(box[0], box[2], 2000)
+        y = rng.uniform(box[1], box[3], 2000)
+        t = rng.uniform(t0, t1, 2000)
+        z = sfc.index(x, y, t).astype(np.int64)
+        idx = np.searchsorted(arr[:, 0], z, side="right") - 1
+        ok = (idx >= 0) & (z <= arr[np.clip(idx, 0, len(arr) - 1), 1])
+        assert np.all(ok)
+
+    def test_z3_ranges_exclude_far_points(self, rng):
+        sfc = Z3SFC()
+        ranges = sfc.ranges(-10.0, 20.0, 5.0, 45.0, 10000.0, 200000.0)
+        arr = np.array([(r.lower, r.upper) for r in ranges], dtype=np.int64)
+        # points far outside should mostly not be covered
+        x = rng.uniform(100, 170, 2000)
+        y = rng.uniform(-80, -50, 2000)
+        t = rng.uniform(400000, 600000, 2000)
+        z = sfc.index(x, y, t).astype(np.int64)
+        idx = np.searchsorted(arr[:, 0], z, side="right") - 1
+        hit = (idx >= 0) & (z <= arr[np.clip(idx, 0, len(arr) - 1), 1])
+        assert np.mean(hit) < 0.05
+
+    def test_hi_lo_encode_matches(self, rng):
+        import jax.numpy as jnp
+
+        sfc = Z3SFC()
+        x = rng.uniform(-180, 180, 1024)
+        y = rng.uniform(-90, 90, 1024)
+        t = rng.uniform(0, 604800, 1024)
+        hi, lo = sfc.index_jax_hi_lo(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(t)
+        )
+        z = sfc.index(x, y, t)
+        np.testing.assert_array_equal(
+            np.asarray(hi, dtype=np.uint64), z >> np.uint64(32)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(lo, dtype=np.uint64), z & np.uint64(0xFFFFFFFF)
+        )

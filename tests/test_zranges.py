@@ -1,0 +1,78 @@
+"""zranges decomposition properties: exact cover, sortedness, budget."""
+
+import numpy as np
+
+from geomesa_tpu.curves.zorder import encode_py
+from geomesa_tpu.curves.zranges import zranges
+
+
+def brute_force_cover(qlo, qhi, bits):
+    """All z values whose cell is in the box (tiny spaces only)."""
+    dims = len(qlo)
+    zs = set()
+    import itertools
+
+    axes = [range(qlo[d], qhi[d] + 1) for d in range(dims)]
+    for coords in itertools.product(*axes):
+        zs.add(encode_py(coords, bits))
+    return zs
+
+
+def ranges_cover(ranges):
+    zs = set()
+    for r in ranges:
+        zs.update(range(r.lower, r.upper + 1))
+    return zs
+
+
+def test_exact_cover_small_2d():
+    for qlo, qhi in [((1, 2), (6, 5)), ((0, 0), (7, 7)), ((3, 3), (3, 3))]:
+        ranges = zranges(qlo, qhi, bits_per_dim=3, max_ranges=1000)
+        expected = brute_force_cover(qlo, qhi, 3)
+        assert ranges_cover(ranges) == expected  # tight when budget is ample
+
+
+def test_exact_cover_small_3d():
+    qlo, qhi = (1, 0, 2), (3, 3, 3)
+    ranges = zranges(qlo, qhi, bits_per_dim=2, max_ranges=1000)
+    assert ranges_cover(ranges) == brute_force_cover(qlo, qhi, 2)
+
+
+def test_overcover_with_budget():
+    qlo, qhi = (1, 2), (6, 5)
+    full = brute_force_cover(qlo, qhi, 3)
+    ranges = zranges(qlo, qhi, bits_per_dim=3, max_ranges=3)
+    assert len(ranges) <= 3
+    assert ranges_cover(ranges) >= full  # never under-covers
+
+
+def test_sorted_disjoint():
+    ranges = zranges((5, 9), (900, 700), bits_per_dim=10, max_ranges=64)
+    for a, b in zip(ranges, ranges[1:]):
+        assert a.upper < b.lower  # disjoint and sorted with gaps
+
+
+def test_budget_respected_large():
+    ranges = zranges(
+        (0, 0, 0), ((1 << 21) - 1, (1 << 21) - 1, 1000), 21, max_ranges=2000
+    )
+    assert len(ranges) <= 2000
+
+
+def test_full_space_is_single_range():
+    ranges = zranges((0, 0), (7, 7), bits_per_dim=3)
+    assert len(ranges) == 1
+    assert (ranges[0].lower, ranges[0].upper) == (0, 63)
+    assert ranges[0].contained
+
+
+def test_contained_flag():
+    ranges = zranges((0, 0), (3, 1), bits_per_dim=2, max_ranges=100)
+    # box x[0..3], y[0..1]: y bit 1 == 0 -> z bit 3 == 0 -> z 0..7 contiguous
+    assert [(r.lower, r.upper, r.contained) for r in ranges] == [(0, 7, True)]
+    # box x[0..1], y[0..3]: x bit 1 == 0 -> z bit 2 == 0 -> z 0..3 and 8..11
+    ranges = zranges((0, 0), (1, 3), bits_per_dim=2, max_ranges=100)
+    assert [(r.lower, r.upper, r.contained) for r in ranges] == [
+        (0, 3, True),
+        (8, 11, True),
+    ]

@@ -11,9 +11,8 @@ Mirrors the semantics of GeoMesa's curve module + the sfcurve z-order library:
 - ``z2``/``z3``:   point curves (ref: Z2SFC.scala / Z3SFC.scala)
 - ``zranges``:     query box -> contiguous z-value ranges (litmax/bigmin
                    decomposition; ref: sfcurve ZN.zranges)
-- ``xz2``/``xz3``: extent curves for non-point geometries
-                   (ref: XZ2SFC.scala / XZ3SFC.scala) -- planned, not yet
-                   implemented
+- ``xz``/``xz2``/``xz3``: extent curves for non-point geometries
+                   (ref: XZ2SFC.scala / XZ3SFC.scala)
 """
 
 from geomesa_tpu.curves.binnedtime import BinnedTime, TimePeriod
@@ -23,6 +22,8 @@ from geomesa_tpu.curves.normalize import (
     NormalizedLon,
     NormalizedTime,
 )
+from geomesa_tpu.curves.xz2 import XZ2SFC
+from geomesa_tpu.curves.xz3 import XZ3SFC
 from geomesa_tpu.curves.z2 import Z2SFC
 from geomesa_tpu.curves.z3 import Z3SFC
 from geomesa_tpu.curves.zranges import IndexRange, zranges
@@ -30,6 +31,8 @@ from geomesa_tpu.curves.zranges import IndexRange, zranges
 __all__ = [
     "BinnedTime",
     "TimePeriod",
+    "XZ2SFC",
+    "XZ3SFC",
     "NormalizedDimension",
     "NormalizedLat",
     "NormalizedLon",

@@ -1,0 +1,101 @@
+"""XZ2/XZ3 extent-curve properties.
+
+Key invariant (the XZ coverage property): for any set of boxes and any query
+window, every box that intersects the query must have its code inside the
+emitted ranges (no false negatives); boxes far from the query should mostly
+be excluded.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curves import XZ2SFC, XZ3SFC
+
+
+def _covered(codes, ranges):
+    arr = np.array([(r.lower, r.upper) for r in ranges], dtype=np.int64)
+    idx = np.searchsorted(arr[:, 0], codes, side="right") - 1
+    return (idx >= 0) & (codes <= arr[np.clip(idx, 0, len(arr) - 1), 1])
+
+
+def _rand_boxes(rng, n, x0, y0, x1, y1, max_size):
+    xmin = rng.uniform(x0, x1 - max_size, n)
+    ymin = rng.uniform(y0, y1 - max_size, n)
+    w = rng.uniform(0, max_size, n)
+    h = rng.uniform(0, max_size, n)
+    return xmin, ymin, xmin + w, ymin + h
+
+
+class TestXZ2:
+    def test_point_boxes_deterministic(self):
+        sfc = XZ2SFC()
+        c1 = sfc.index(np.array([2.0]), np.array([48.0]), np.array([2.0]), np.array([48.0]))
+        c2 = sfc.index(np.array([2.0]), np.array([48.0]), np.array([2.0]), np.array([48.0]))
+        assert c1[0] == c2[0] >= 0
+
+    def test_codes_within_keyspace(self, rng):
+        sfc = XZ2SFC()
+        xmin, ymin, xmax, ymax = _rand_boxes(rng, 5000, -180, -90, 180, 90, 5.0)
+        codes = sfc.index(xmin, ymin, xmax, ymax)
+        max_code = (4 ** (sfc.g + 1) - 1) // 3
+        assert np.all(codes >= 0)
+        assert np.all(codes <= max_code)
+
+    def test_no_false_negatives(self, rng):
+        sfc = XZ2SFC()
+        xmin, ymin, xmax, ymax = _rand_boxes(rng, 5000, -20, 20, 30, 60, 2.0)
+        codes = sfc.index(xmin, ymin, xmax, ymax)
+        q = (-5.0, 42.0, 8.0, 51.0)
+        ranges = sfc.ranges(*q)
+        hits = _covered(codes, ranges)
+        intersecting = (
+            (xmax >= q[0]) & (xmin <= q[2]) & (ymax >= q[1]) & (ymin <= q[3])
+        )
+        assert np.all(hits[intersecting]), "false negatives in XZ2 ranges"
+
+    def test_prunes_far_boxes(self, rng):
+        sfc = XZ2SFC()
+        xmin, ymin, xmax, ymax = _rand_boxes(rng, 5000, 100, -80, 170, -40, 2.0)
+        codes = sfc.index(xmin, ymin, xmax, ymax)
+        ranges = sfc.ranges(-5.0, 42.0, 8.0, 51.0)
+        assert np.mean(_covered(codes, ranges)) < 0.05
+
+    def test_large_geometries_low_level(self):
+        # a hemisphere-sized box is stored at level 1 (every box fits some
+        # level-1 enlarged cell, which spans the whole space), so its code is
+        # one of the four level-1 quadrant codes.
+        sfc = XZ2SFC()
+        code = sfc.index(
+            np.array([-170.0]), np.array([-80.0]), np.array([170.0]), np.array([80.0])
+        )
+        step = (4**sfc.g - 1) // 3
+        assert int(code[0]) in {1 + q * step for q in range(4)}
+
+
+class TestXZ3:
+    def test_no_false_negatives(self, rng):
+        sfc = XZ3SFC()
+        xmin, ymin, xmax, ymax = _rand_boxes(rng, 3000, -20, 20, 30, 60, 2.0)
+        tmin = rng.uniform(0, 500000, 3000)
+        tmax = tmin + rng.uniform(0, 3600, 3000)
+        codes = sfc.index(xmin, ymin, tmin, xmax, ymax, np.minimum(tmax, 604800))
+        q = (-5.0, 42.0, 86400.0, 8.0, 51.0, 259200.0)
+        ranges = sfc.ranges(*q)
+        hits = _covered(codes, ranges)
+        inter = (
+            (xmax >= q[0])
+            & (xmin <= q[3])
+            & (ymax >= q[1])
+            & (ymin <= q[4])
+            & (tmax >= q[2])
+            & (tmin <= q[5])
+        )
+        assert np.all(hits[inter]), "false negatives in XZ3 ranges"
+
+    def test_prunes_far_boxes(self, rng):
+        sfc = XZ3SFC()
+        xmin, ymin, xmax, ymax = _rand_boxes(rng, 3000, 100, -80, 170, -40, 2.0)
+        tmin = rng.uniform(400000, 500000, 3000)
+        codes = sfc.index(xmin, ymin, tmin, xmax, ymax, tmin + 100)
+        ranges = sfc.ranges(-5.0, 42.0, 1000.0, 8.0, 51.0, 2000.0)
+        assert np.mean(_covered(codes, ranges)) < 0.05

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Benchmark: bbox+time filter throughput through the real framework path.
+
+Shape of BASELINE config #1 (GDELT bbox+during): synthetic GDELT-like
+points resident on device, one ECQL filter compiled by
+``geomesa_tpu.filter.compile_filter``, its fused device mask + count jitted
+and timed. Metric: features/sec/chip scanned by the fused predicate kernel
+(the north-star counts features *evaluated* per second against the
+baseline's >= 62.5M features/sec/chip target).
+
+Prints exactly one JSON line to stdout; all logs go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=None, help="rows resident on device")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--check", action="store_true", help="verify count vs host oracle")
+    args = ap.parse_args()
+
+    from geomesa_tpu.jaxconf import require_x64
+
+    require_x64()  # Date columns are int64 epoch-ms (TPU emulates s64 lanes)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    platform = jax.devices()[0].platform
+    n = args.n or ((1 << 27) if platform != "cpu" else (1 << 20))
+    log(f"platform={platform} device={jax.devices()[0]} n={n:,}")
+
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.filter.compile import compile_filter
+    from geomesa_tpu.filter.ecql import parse_ecql, parse_instant
+
+    sft = SimpleFeatureType.create(
+        "gdelt", "count:Int,dtg:Date,*geom:Point:srid=4326"
+    )
+    # Europe bbox + 5-day window over a 60-day span (GDELT-style selectivity)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    ecql = (
+        "BBOX(geom, -10, 35, 30, 60) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-01-15T00:00:00Z"
+    )
+    compiled = compile_filter(parse_ecql(ecql), sft)
+    assert compiled.fully_on_device
+
+    # generate data on device (float32 coords, int64 epoch-ms)
+    log("generating device-resident columns...")
+    key = jax.random.PRNGKey(42)
+    kx, ky, kt = jax.random.split(key, 3)
+    cols = {
+        "geom__x": jax.random.uniform(kx, (n,), jnp.float32, -180.0, 180.0),
+        "geom__y": jax.random.uniform(ky, (n,), jnp.float32, -90.0, 90.0),
+        "dtg": jax.random.randint(kt, (n,), t0, t1, jnp.int64),
+    }
+    jax.block_until_ready(cols)
+
+    @jax.jit
+    def scan_count(c):
+        return compiled.device_fn(c).sum()
+
+    # compile + warmup
+    t_compile = time.perf_counter()
+    hits = int(scan_count(cols))
+    log(f"compiled in {time.perf_counter() - t_compile:.1f}s; hits={hits:,} "
+        f"(selectivity {hits / n:.4%})")
+
+    if args.check:
+        x = np.asarray(cols["geom__x"])
+        y = np.asarray(cols["geom__y"])
+        d = np.asarray(cols["dtg"])
+        expect = int(
+            (
+                (x >= -10) & (x <= 30) & (y >= 35) & (y <= 60)
+                & (d >= parse_instant("2020-01-10T00:00:00"))
+                & (d <= parse_instant("2020-01-15T00:00:00"))
+            ).sum()
+        )
+        assert hits == expect, f"device {hits} != host {expect}"
+        log("count verified against host oracle")
+
+    times = []
+    for _ in range(args.iters):
+        t = time.perf_counter()
+        scan_count(cols).block_until_ready()
+        times.append(time.perf_counter() - t)
+    best = min(times)
+    median = sorted(times)[len(times) // 2]
+    feats_per_sec = n / median
+    log(
+        f"best={best*1e3:.2f}ms median={median*1e3:.2f}ms "
+        f"-> {feats_per_sec/1e9:.2f}B features/sec/chip"
+    )
+
+    baseline_per_chip = 62.5e6  # BASELINE.json north star / 8 chips
+    print(
+        json.dumps(
+            {
+                "metric": "bbox+time filter throughput (fused device scan)",
+                "value": round(feats_per_sec, 1),
+                "unit": "features/sec/chip",
+                "vs_baseline": round(feats_per_sec / baseline_per_chip, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

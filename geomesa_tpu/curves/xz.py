@@ -23,7 +23,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from geomesa_tpu.curves.zranges import IndexRange
+from geomesa_tpu.curves.zranges import DEFAULT_MAX_RANGES, IndexRange
 
 DEFAULT_XZ_PRECISION = 12  # ref: geomesa.xz.precision default
 
@@ -45,9 +45,28 @@ class XZSFC:
     g: int  # max resolution (tree depth)
     dims: int
 
+    def __post_init__(self):
+        # total code count (fanout^(g+1)-1)/(fanout-1) must fit int64
+        limit = {2: 31, 3: 20}.get(self.dims)
+        if limit is None:
+            raise ValueError(f"unsupported dims {self.dims}")
+        if not 1 <= self.g <= limit:
+            raise ValueError(
+                f"g={self.g} out of range [1, {limit}] for dims={self.dims} "
+                "(code space must fit int64)"
+            )
+
     @property
     def fanout(self) -> int:
         return 1 << self.dims  # 4 for 2D, 8 for 3D
+
+    def _child_step(self, level: int) -> int:
+        """Pre-order code increment per quadrant unit at ``level`` (the code
+        span of one child subtree plus its root):
+        (fanout^(g-level) - 1)/(fanout-1). Shared by sequence_code and
+        ranges so encode and decompose cannot drift."""
+        f = self.fanout
+        return (f ** (self.g - level) - 1) // (f - 1)
 
     def subtree_size(self, level: int) -> int:
         """Number of codes in a full subtree rooted at depth ``level``
@@ -99,9 +118,7 @@ class XZSFC:
             quad = np.zeros(n, dtype=np.int64)
             for d in range(self.dims):
                 quad |= (point[d] >= center[d]).astype(np.int64) << d
-            # code step: 1 + quad * subtree_size(i+1)... the reference's
-            # increment is 1 + quad*(f^(g-i)-1)/(f-1)
-            step = 1 + quad * ((f ** (self.g - i) - 1) // (f - 1))
+            step = 1 + quad * self._child_step(i)
             cs = np.where(active, cs + step, cs)
             upper = (quad[None, :] >> np.arange(self.dims)[:, None]) & 1
             new_lo = np.where(upper == 1, center, lo)
@@ -111,9 +128,23 @@ class XZSFC:
         return cs
 
     def index(self, mins: np.ndarray, maxs: np.ndarray) -> np.ndarray:
-        """Normalized boxes -> XZ sequence codes (int64). (dims, n) arrays."""
-        mins = np.clip(np.asarray(mins, dtype=np.float64), 0.0, 1.0)
-        maxs = np.clip(np.asarray(maxs, dtype=np.float64), 0.0, 1.0)
+        """Normalized boxes -> XZ sequence codes (int64). (dims, n) arrays.
+
+        Inverted boxes (min > max, e.g. an un-split antimeridian-crossing
+        bbox) are rejected: silently encoding them would produce codes that
+        range queries never cover (the reference's XZ2SFC likewise requires
+        ordered bounds; antimeridian geometries must be split by the caller).
+        """
+        mins = np.asarray(mins, dtype=np.float64)
+        maxs = np.asarray(maxs, dtype=np.float64)
+        if np.any(maxs < mins):
+            bad = np.nonzero(np.any(maxs < mins, axis=0))[0][:3]
+            raise ValueError(
+                f"inverted box bounds at rows {bad.tolist()} (min > max); "
+                "split antimeridian-crossing geometries before indexing"
+            )
+        mins = np.clip(mins, 0.0, 1.0)
+        maxs = np.clip(maxs, 0.0, 1.0)
         length = self.length(mins, maxs)
         return self.sequence_code(mins, length)
 
@@ -123,7 +154,7 @@ class XZSFC:
         self,
         q_mins: np.ndarray,
         q_maxs: np.ndarray,
-        max_ranges: int = 2000,
+        max_ranges: int = DEFAULT_MAX_RANGES,
     ) -> list[IndexRange]:
         """Query windows -> sorted merged inclusive ranges of sequence codes.
 
@@ -196,9 +227,7 @@ class XZSFC:
                     lo[d] + (half if (quad >> d) & 1 else 0.0)
                     for d in range(self.dims)
                 )
-                # pre-order step for quadrant q at this depth (matches
-                # sequence_code): 1 + q * (f^(g-level) - 1)/(f-1)
-                child_code = code + 1 + quad * ((f ** (self.g - level) - 1) // (f - 1))
+                child_code = code + 1 + quad * self._child_step(level)
                 queue.append((child_code, level + 1, child_lo))
         results.sort(key=lambda r: r.lower)
         merged: list[IndexRange] = []

@@ -17,7 +17,7 @@ from geomesa_tpu.curves.xz import (
     XZSFC,
     stack_windows,
 )
-from geomesa_tpu.curves.zranges import IndexRange
+from geomesa_tpu.curves.zranges import DEFAULT_MAX_RANGES, IndexRange
 
 
 @dataclass(frozen=True)
@@ -47,7 +47,7 @@ class XZ2SFC:
         return self._xz.index(mins, maxs)
 
     def ranges(
-        self, xmin, ymin, xmax, ymax, max_ranges: int = 2000
+        self, xmin, ymin, xmax, ymax, max_ranges: int = DEFAULT_MAX_RANGES
     ) -> list[IndexRange]:
         """Query bbox(es) -> sorted inclusive code ranges.
 

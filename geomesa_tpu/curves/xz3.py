@@ -19,7 +19,7 @@ from geomesa_tpu.curves.xz import (
     XZSFC,
     stack_windows,
 )
-from geomesa_tpu.curves.zranges import IndexRange
+from geomesa_tpu.curves.zranges import DEFAULT_MAX_RANGES, IndexRange
 
 
 @dataclass(frozen=True)
@@ -50,7 +50,7 @@ class XZ3SFC:
         return self._xz.index(mins, maxs)
 
     def ranges(
-        self, xmin, ymin, tmin, xmax, ymax, tmax, max_ranges: int = 2000
+        self, xmin, ymin, tmin, xmax, ymax, tmax, max_ranges: int = DEFAULT_MAX_RANGES
     ) -> list[IndexRange]:
         mins, maxs = self._windows(xmin, ymin, tmin, xmax, ymax, tmax)
         return self._xz.ranges(mins, maxs, max_ranges)

@@ -1,0 +1,14 @@
+"""Feature model & columnar serialization (maps reference L2).
+
+- ``sft``:   SimpleFeatureType + spec-string parser
+             (ref: geomesa-utils .../geotools/SimpleFeatureTypes.scala)
+- ``batch``: struct-of-arrays FeatureBatch + Arrow interop
+             (ref role: geomesa-arrow ArrowSimpleFeatureVector + the value
+             side of KryoFeatureSerializer -- the rebuild stores columnar
+             batches instead of per-row Kryo bytes, SURVEY.md section 7)
+"""
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import AttributeDescriptor, SimpleFeatureType
+
+__all__ = ["AttributeDescriptor", "SimpleFeatureType", "FeatureBatch"]

@@ -1,0 +1,216 @@
+"""FeatureBatch: the columnar SimpleFeature collection.
+
+Struct-of-arrays: one numpy array per attribute (Arrow-compatible), plus a
+feature-id array. This replaces the reference's per-row Kryo-serialized
+values (ref: geomesa-features KryoFeatureSerializer) with a layout the TPU
+can scan directly -- the design stance of SURVEY.md section 7.
+
+Column conventions:
+- Point geometry  -> (n, 2) float64 array [x, y]
+- other geometry  -> object array of geomesa_tpu.geom Geometry + a cached
+                     (n, 4) float64 bbox array [xmin, ymin, xmax, ymax]
+                     (device prefilter operates on the bboxes)
+- Date            -> int64 epoch milliseconds
+- numeric/bool    -> matching numpy dtype
+- String/UUID/Bytes -> object array (host-only; dictionary-encoded on
+                     export)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.geom import Geometry, Point, parse_wkt, to_wkt
+
+
+@dataclass
+class FeatureBatch:
+    sft: SimpleFeatureType
+    fids: np.ndarray
+    columns: dict
+    _bboxes: dict = field(default_factory=dict, repr=False)
+
+    # -- construction ------------------------------------------------------
+
+    @staticmethod
+    def from_columns(sft: SimpleFeatureType, columns: dict, fids=None) -> "FeatureBatch":
+        """Build from {attribute: values}. Geometry columns may be given as
+        (n,2) point arrays, object arrays of Geometry, or WKT strings; dates
+        as int64 millis or numpy datetime64."""
+        n = None
+        out: dict = {}
+        for attr in sft.attributes:
+            if attr.name not in columns:
+                raise ValueError(f"missing column {attr.name!r}")
+            vals = columns[attr.name]
+            if attr.is_geometry:
+                col = _coerce_geometry(vals, attr.is_point)
+            elif attr.type_name == "Date":
+                col = _coerce_date(vals)
+            elif attr.column_dtype is not None:
+                col = np.asarray(vals).astype(attr.column_dtype)
+            else:
+                col = np.asarray(vals, dtype=object)
+            m = len(col)
+            if n is None:
+                n = m
+            elif m != n:
+                raise ValueError(
+                    f"column {attr.name!r} has {m} rows, expected {n}"
+                )
+            out[attr.name] = col
+        if n is None:
+            n = 0
+        if fids is None:
+            fids = np.arange(n)
+        fids = np.asarray(fids)
+        if len(fids) != n:
+            raise ValueError("fids length mismatch")
+        return FeatureBatch(sft, fids, out)
+
+    @staticmethod
+    def concat(batches: "list[FeatureBatch]") -> "FeatureBatch":
+        if not batches:
+            raise ValueError("no batches")
+        sft = batches[0].sft
+        cols = {
+            name: np.concatenate([b.columns[name] for b in batches])
+            for name in batches[0].columns
+        }
+        fids = np.concatenate([b.fids for b in batches])
+        return FeatureBatch(sft, fids, cols)
+
+    # -- basics ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.fids)
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def take(self, indices) -> "FeatureBatch":
+        idx = np.asarray(indices)
+        return FeatureBatch(
+            self.sft,
+            self.fids[idx],
+            {k: v[idx] for k, v in self.columns.items()},
+        )
+
+    def point_coords(self, name: str | None = None):
+        """(x, y) float64 arrays for a Point column (default geometry)."""
+        name = name or self.sft.geom_field
+        col = self.columns[name]
+        if col.dtype == object:
+            raise TypeError(f"{name!r} is not a Point column")
+        return np.ascontiguousarray(col[:, 0]), np.ascontiguousarray(col[:, 1])
+
+    def bboxes(self, name: str | None = None) -> np.ndarray:
+        """(n, 4) [xmin, ymin, xmax, ymax] for any geometry column."""
+        name = name or self.sft.geom_field
+        col = self.columns[name]
+        if col.dtype != object:
+            return np.stack(
+                [col[:, 0], col[:, 1], col[:, 0], col[:, 1]], axis=1
+            )
+        if name not in self._bboxes:
+            bb = np.empty((len(col), 4), dtype=np.float64)
+            for i, g in enumerate(col):
+                e = g.envelope
+                bb[i] = (e.xmin, e.ymin, e.xmax, e.ymax)
+            self._bboxes[name] = bb
+        return self._bboxes[name]
+
+    # -- Arrow interop -----------------------------------------------------
+
+    def to_arrow(self):
+        """pyarrow Table; points become x/y float64 struct-ish columns
+        ``<name>_x``/``<name>_y``; other geometries are WKT strings.
+
+        (ref role: geomesa-arrow ArrowSimpleFeatureVector; fixed-width point
+        child vectors match its PointVector layout.)
+        """
+        import pyarrow as pa
+
+        arrays = {"__fid__": pa.array(self.fids.tolist())}
+        for attr in self.sft.attributes:
+            col = self.columns[attr.name]
+            if attr.is_geometry:
+                if col.dtype != object:
+                    arrays[f"{attr.name}_x"] = pa.array(col[:, 0])
+                    arrays[f"{attr.name}_y"] = pa.array(col[:, 1])
+                else:
+                    arrays[attr.name] = pa.array([to_wkt(g) for g in col])
+            elif attr.type_name == "Date":
+                arrays[attr.name] = pa.array(col, type=pa.timestamp("ms"))
+            else:
+                arrays[attr.name] = pa.array(col.tolist())
+        return pa.table(arrays)
+
+    @staticmethod
+    def from_arrow(table, sft: SimpleFeatureType) -> "FeatureBatch":
+        cols: dict = {}
+        names = set(table.column_names)
+        for attr in sft.attributes:
+            if attr.is_geometry and f"{attr.name}_x" in names:
+                x = table.column(f"{attr.name}_x").to_numpy()
+                y = table.column(f"{attr.name}_y").to_numpy()
+                cols[attr.name] = np.stack([x, y], axis=1)
+            elif attr.is_geometry:
+                wkts = table.column(attr.name).to_pylist()
+                cols[attr.name] = np.array(
+                    [parse_wkt(w) for w in wkts], dtype=object
+                )
+            elif attr.type_name == "Date":
+                arr = table.column(attr.name).cast("timestamp[ms]").to_numpy()
+                cols[attr.name] = arr.astype("datetime64[ms]").astype(np.int64)
+            else:
+                arr = table.column(attr.name)
+                if attr.column_dtype is not None:
+                    cols[attr.name] = arr.to_numpy().astype(attr.column_dtype)
+                else:
+                    cols[attr.name] = np.array(arr.to_pylist(), dtype=object)
+        fids = (
+            table.column("__fid__").to_numpy(zero_copy_only=False)
+            if "__fid__" in names
+            else None
+        )
+        return FeatureBatch.from_columns(sft, cols, fids)
+
+
+def _coerce_geometry(vals, is_point: bool) -> np.ndarray:
+    if isinstance(vals, np.ndarray) and vals.dtype != object and vals.ndim == 2:
+        return np.asarray(vals, dtype=np.float64)
+    vals = list(vals)
+    if not vals:
+        return (
+            np.zeros((0, 2), dtype=np.float64)
+            if is_point
+            else np.array([], dtype=object)
+        )
+    first = vals[0]
+    if isinstance(first, str):
+        vals = [parse_wkt(v) for v in vals]
+        first = vals[0]
+    if is_point:
+        if isinstance(first, Point):
+            return np.array([(p.x, p.y) for p in vals], dtype=np.float64)
+        if isinstance(first, (tuple, list)):
+            return np.asarray(vals, dtype=np.float64)
+        raise TypeError(f"cannot coerce {type(first)} to Point column")
+    if isinstance(first, Geometry):
+        return np.array(vals, dtype=object)
+    raise TypeError(f"cannot coerce {type(first)} to geometry column")
+
+
+def _coerce_date(vals) -> np.ndarray:
+    a = np.asarray(vals)
+    if np.issubdtype(a.dtype, np.datetime64):
+        return a.astype("datetime64[ms]").astype(np.int64)
+    if a.dtype == object or a.dtype.kind in "US":
+        return (
+            np.array(a, dtype="datetime64[ms]").astype(np.int64)
+        )
+    return a.astype(np.int64)

@@ -1,0 +1,59 @@
+"""CQL filter layer (maps reference L5: geomesa-filter).
+
+- ``ast``:     filter expression tree
+- ``ecql``:    text parser for the (E)CQL subset
+               (ref: GeoTools ECQL + geomesa-filter FilterHelper usage)
+- ``extract``: spatial/temporal bound extraction
+               (ref: geomesa-filter .../FilterHelper.scala
+               extractGeometries / extractIntervals)
+- ``compile``: AST -> vectorized evaluators (host numpy exact; device jax
+               for the kernel-scannable subset -- the Z3Iterator /
+               FilterTransformIterator analog)
+"""
+
+from geomesa_tpu.filter.ast import (
+    And,
+    BBox,
+    Between,
+    Compare,
+    During,
+    Exclude,
+    Filter,
+    In,
+    Include,
+    Intersects,
+    IsNull,
+    Like,
+    Not,
+    Or,
+)
+from geomesa_tpu.filter.compile import CompiledFilter, compile_filter
+from geomesa_tpu.filter.ecql import parse_ecql
+from geomesa_tpu.filter.extract import (
+    FilterBounds,
+    extract_geometries,
+    extract_intervals,
+)
+
+__all__ = [
+    "Filter",
+    "Include",
+    "Exclude",
+    "And",
+    "Or",
+    "Not",
+    "BBox",
+    "Intersects",
+    "During",
+    "Between",
+    "Compare",
+    "In",
+    "Like",
+    "IsNull",
+    "parse_ecql",
+    "extract_geometries",
+    "extract_intervals",
+    "FilterBounds",
+    "compile_filter",
+    "CompiledFilter",
+]

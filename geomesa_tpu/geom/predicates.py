@@ -1,0 +1,245 @@
+"""Vectorized geometry predicates.
+
+The device-side predicate set (SURVEY.md section 7 hard part #3): bbox
+compare is trivial columnar math; point-in-polygon uses the crossing-number
+test over packed edge lists, identical semantics host (numpy) and device
+(jax). Boundary behavior: points exactly on a horizontal-crossing vertex
+follow the half-open rule (a vertex counts for the edge whose y-interval is
+[min, max)); points on edges may test either way at float precision -- same
+caveat as JTS's RayCrossingCounter fast path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def polygon_edges(rings) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Pack closed rings into edge arrays (x1, y1, x2, y2)."""
+    x1, y1, x2, y2 = [], [], [], []
+    for ring in rings:
+        r = np.asarray(ring, dtype=np.float64)
+        a = r[:-1]
+        b = r[1:]
+        x1.append(a[:, 0])
+        y1.append(a[:, 1])
+        x2.append(b[:, 0])
+        y2.append(b[:, 1])
+    return (
+        np.concatenate(x1),
+        np.concatenate(y1),
+        np.concatenate(x2),
+        np.concatenate(y2),
+    )
+
+
+def points_in_polygon(px, py, rings) -> np.ndarray:
+    """Crossing-number containment for (n,) point arrays against a polygon
+    given as closed rings (shell + holes: odd crossings = inside)."""
+    x1, y1, x2, y2 = polygon_edges(rings)
+    px = np.asarray(px, dtype=np.float64)[:, None]
+    py = np.asarray(py, dtype=np.float64)[:, None]
+    # edge straddles the horizontal ray (half-open to dodge vertex double count)
+    straddle = (y1[None, :] > py) != (y2[None, :] > py)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        xint = x1 + (py - y1) * (x2 - x1) / (y2 - y1)
+    crossing = straddle & (px < xint)
+    return crossing.sum(axis=1) % 2 == 1
+
+
+def points_in_polygon_jax(px, py, rings):
+    """Same crossing-number test on device. Edge list is packed host-side;
+    px/py are device arrays."""
+    import jax.numpy as jnp
+
+    x1, y1, x2, y2 = polygon_edges(rings)
+    x1 = jnp.asarray(x1, dtype=px.dtype)
+    y1 = jnp.asarray(y1, dtype=px.dtype)
+    x2 = jnp.asarray(x2, dtype=px.dtype)
+    y2 = jnp.asarray(y2, dtype=px.dtype)
+    pxc = px[:, None]
+    pyc = py[:, None]
+    straddle = (y1[None, :] > pyc) != (y2[None, :] > pyc)
+    denom = y2 - y1
+    denom = jnp.where(denom == 0, 1.0, denom)  # straddle==False masks these
+    xint = x1 + (pyc - y1) * (x2 - x1) / denom
+    crossings = jnp.sum(straddle & (pxc < xint), axis=1)
+    return crossings % 2 == 1
+
+
+def _segments_of(geom) -> "np.ndarray | None":
+    """(m, 4) [x1, y1, x2, y2] segment array for a line/polygon geometry."""
+    from geomesa_tpu.geom.base import (
+        LineString,
+        MultiLineString,
+        MultiPolygon,
+        Polygon,
+    )
+
+    if isinstance(geom, LineString):
+        c = geom.coords
+        return np.concatenate([c[:-1], c[1:]], axis=1)
+    if isinstance(geom, (Polygon, MultiPolygon)):
+        x1, y1, x2, y2 = polygon_edges(geom.rings())
+        return np.stack([x1, y1, x2, y2], axis=1)
+    if isinstance(geom, MultiLineString):
+        return np.concatenate([_segments_of(l) for l in geom.lines], axis=0)
+    return None
+
+
+def _any_segments_cross(sa: np.ndarray, sb: np.ndarray) -> bool:
+    """Do any segments of (m,4) array sa intersect any of (k,4) sb."""
+    m, k = len(sa), len(sb)
+    if m == 0 or k == 0:
+        return False
+    A = np.repeat(sa, k, axis=0)
+    B = np.tile(sb, (m, 1))
+    hits = segments_intersect(
+        A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 0], B[:, 1], B[:, 2], B[:, 3]
+    )
+    return bool(hits.any())
+
+
+def _poly_contains_point(geom, x: float, y: float) -> bool:
+    from geomesa_tpu.geom.base import MultiPolygon, Polygon
+
+    if isinstance(geom, Polygon):
+        return bool(points_in_polygon(np.array([x]), np.array([y]), geom.rings())[0])
+    if isinstance(geom, MultiPolygon):
+        return any(_poly_contains_point(p, x, y) for p in geom.polygons)
+    return False
+
+
+def geometry_intersects(a, b) -> bool:
+    """Exact intersects for the supported geometry subset (host-side
+    residual; the device path prefilters with bboxes).
+
+    Handles Point / LineString / Polygon / Multi* pairs via: bbox reject,
+    any-segments-cross, or either containing a vertex of the other.
+    Boundary behavior at float precision matches the crossing-number caveat
+    in the module docstring (JTS-robustness is out of scope).
+    """
+    from geomesa_tpu.geom.base import (
+        MultiLineString,
+        MultiPoint,
+        MultiPolygon,
+        Point,
+        Polygon,
+    )
+
+    if not a.envelope.intersects(b.envelope):
+        return False
+    if isinstance(a, MultiPoint):
+        return any(geometry_intersects(p, b) for p in a.points)
+    if isinstance(b, MultiPoint):
+        return any(geometry_intersects(a, p) for p in b.points)
+    if isinstance(a, Point) and isinstance(b, Point):
+        return a.x == b.x and a.y == b.y
+    if isinstance(a, Point) or isinstance(b, Point):
+        pt, other = (a, b) if isinstance(a, Point) else (b, a)
+        if isinstance(other, (Polygon, MultiPolygon)):
+            if _poly_contains_point(other, pt.x, pt.y):
+                return True
+        segs = _segments_of(other)
+        if segs is None:
+            return False
+        px = np.full(len(segs), pt.x)
+        py = np.full(len(segs), pt.y)
+        on = segments_intersect(
+            px, py, px, py, segs[:, 0], segs[:, 1], segs[:, 2], segs[:, 3]
+        )
+        return bool(on.any())
+    sa, sb = _segments_of(a), _segments_of(b)
+    if _any_segments_cross(sa, sb):
+        return True
+    # containment without boundary crossing: a component lies entirely
+    # inside the other geometry -- test one vertex of EVERY component (a
+    # multi-part geometry can have one far part and one contained part)
+    if isinstance(a, (Polygon, MultiPolygon)) and any(
+        _poly_contains_point(a, float(vx), float(vy))
+        for vx, vy in _component_vertices(b)
+    ):
+        return True
+    if isinstance(b, (Polygon, MultiPolygon)) and any(
+        _poly_contains_point(b, float(vx), float(vy))
+        for vx, vy in _component_vertices(a)
+    ):
+        return True
+    return False
+
+
+def _component_vertices(geom):
+    """One representative vertex per connected component."""
+    from geomesa_tpu.geom.base import (
+        LineString,
+        MultiLineString,
+        MultiPolygon,
+        Polygon,
+    )
+
+    if isinstance(geom, LineString):
+        yield geom.coords[0, 0], geom.coords[0, 1]
+    elif isinstance(geom, Polygon):
+        yield geom.shell[0, 0], geom.shell[0, 1]
+    elif isinstance(geom, MultiPolygon):
+        for p in geom.polygons:
+            yield p.shell[0, 0], p.shell[0, 1]
+    elif isinstance(geom, MultiLineString):
+        for l in geom.lines:
+            yield l.coords[0, 0], l.coords[0, 1]
+
+
+def geometry_within(inner, outer) -> bool:
+    """Is ``inner`` entirely within ``outer`` (interior-contained, boundary
+    tolerance per the crossing-number caveat)? Supported for polygon/line/
+    point inner vs polygon outer."""
+    from geomesa_tpu.geom.base import MultiPolygon, Point, Polygon
+
+    if not isinstance(outer, (Polygon, MultiPolygon)):
+        return False
+    if isinstance(inner, Point):
+        return _poly_contains_point(outer, inner.x, inner.y)
+    if not outer.envelope.contains_env(inner.envelope):
+        return False
+    si = _segments_of(inner)
+    so = _segments_of(outer)
+    if si is None:
+        return False
+    if _any_segments_cross(si, so):
+        return False
+    # no boundary crossings: containment decided per component vertex
+    return all(
+        _poly_contains_point(outer, float(vx), float(vy))
+        for vx, vy in _component_vertices(inner)
+    )
+
+
+def segments_intersect(ax, ay, bx, by, cx, cy, dx, dy) -> np.ndarray:
+    """Vectorized proper/improper segment intersection AB vs CD (orientation
+    sign tests, inclusive of touching endpoints)."""
+
+    def orient(ox, oy, px_, py_, qx, qy):
+        return np.sign((px_ - ox) * (qy - oy) - (py_ - oy) * (qx - ox))
+
+    d1 = orient(cx, cy, dx, dy, ax, ay)
+    d2 = orient(cx, cy, dx, dy, bx, by)
+    d3 = orient(ax, ay, bx, by, cx, cy)
+    d4 = orient(ax, ay, bx, by, dx, dy)
+    proper = (d1 * d2 < 0) & (d3 * d4 < 0)
+
+    def on_seg(ox, oy, px_, py_, qx, qy):
+        return (
+            (orient(ox, oy, px_, py_, qx, qy) == 0)
+            & (np.minimum(ox, px_) <= qx)
+            & (qx <= np.maximum(ox, px_))
+            & (np.minimum(oy, py_) <= qy)
+            & (qy <= np.maximum(oy, py_))
+        )
+
+    touch = (
+        on_seg(cx, cy, dx, dy, ax, ay)
+        | on_seg(cx, cy, dx, dy, bx, by)
+        | on_seg(ax, ay, bx, by, cx, cy)
+        | on_seg(ax, ay, bx, by, dx, dy)
+    )
+    return proper | touch

@@ -1,0 +1,41 @@
+"""Index core (maps reference L4: geomesa-index-api).
+
+Key spaces map feature batches to sort-key columns and query bounds to scan
+ranges (ref: geomesa-index-api .../index/index/{z3,z2,xz3,xz2,attribute,id}/
+*IndexKeySpace.scala [UNVERIFIED - empty reference mount]). The TPU-native
+index structure is: batch -> key columns -> global sort -> fixed-size
+partitions with a manifest (key bounds + stats per partition) -- the
+columnar analog of the reference's sorted KV tables with tablet splits.
+"""
+
+from geomesa_tpu.index.api import (
+    BuiltIndex,
+    IndexKeySpace,
+    KeyRange,
+    PartitionMeta,
+)
+from geomesa_tpu.index.keyspaces import (
+    AttributeKeySpace,
+    IdKeySpace,
+    XZ2KeySpace,
+    XZ3KeySpace,
+    Z2KeySpace,
+    Z3KeySpace,
+    keyspace_for,
+)
+from geomesa_tpu.index.build import build_index
+
+__all__ = [
+    "IndexKeySpace",
+    "KeyRange",
+    "PartitionMeta",
+    "BuiltIndex",
+    "Z3KeySpace",
+    "Z2KeySpace",
+    "XZ2KeySpace",
+    "XZ3KeySpace",
+    "AttributeKeySpace",
+    "IdKeySpace",
+    "keyspace_for",
+    "build_index",
+]

@@ -1,0 +1,99 @@
+"""Index API: key spaces, ranges, partitions.
+
+(ref: geomesa-index-api .../index/api/GeoMesaFeatureIndex.scala +
+IndexKeySpace.scala [UNVERIFIED - empty reference mount])
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Protocol, Sequence
+
+import numpy as np
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.filter.extract import FilterBounds
+
+
+@dataclass(frozen=True)
+class KeyRange:
+    """Inclusive lexicographic range over sort-key tuples."""
+
+    lo: tuple
+    hi: tuple
+    contained: bool = False  # True: every key in range satisfies the primary
+
+
+class IndexKeySpace(Protocol):
+    """Maps features -> sort keys and query bounds -> key ranges."""
+
+    name: str
+    key_columns: tuple  # ordered names of the sort-key columns
+
+    def index_keys(self, batch: FeatureBatch) -> dict:
+        """Compute {key_column: np.ndarray} for a batch."""
+        ...
+
+    def scan_ranges(
+        self,
+        geoms: FilterBounds,
+        intervals: FilterBounds,
+        max_ranges: int,
+        data_interval: "tuple[int, int] | None" = None,
+    ) -> "list[KeyRange] | None":
+        """Bounds -> ranges; None = cannot prune (full scan)."""
+        ...
+
+    def supports(self, geoms: FilterBounds, intervals: FilterBounds) -> bool:
+        """Can this index usefully serve these bounds?"""
+        ...
+
+    def cost(self, geoms: FilterBounds, intervals: FilterBounds) -> float:
+        """Heuristic cost for StrategyDecider (lower = better).
+        (ref: geomesa-index-api .../planning/StrategyDecider heuristics)"""
+        ...
+
+
+@dataclass
+class PartitionMeta:
+    """Manifest entry for one sorted partition (the tablet-split analog,
+    rolled together with geomesa-fs partition metadata + stats)."""
+
+    pid: int
+    start: int  # row offset in the sorted index
+    stop: int
+    key_lo: tuple
+    key_hi: tuple
+    count: int
+    bbox: "tuple[float, float, float, float] | None" = None
+    time_range: "tuple[int, int] | None" = None
+
+    def overlaps(self, r: KeyRange) -> bool:
+        return not (r.hi < self.key_lo or r.lo > self.key_hi)
+
+
+@dataclass
+class BuiltIndex:
+    """A fully built (sorted + partitioned) index over a feature set."""
+
+    keyspace: "IndexKeySpace"
+    batch: FeatureBatch  # sorted by key columns
+    keys: dict  # {key_column: sorted np.ndarray}
+    partitions: "list[PartitionMeta]"
+
+    @property
+    def n(self) -> int:
+        return len(self.batch)
+
+    def prune(self, ranges: "list[KeyRange] | None") -> "list[PartitionMeta]":
+        """Partitions whose key span overlaps any range (all if None)."""
+        if ranges is None:
+            return list(self.partitions)
+        out = []
+        for p in self.partitions:
+            # ranges sorted by lo; binary-search the first candidate
+            for r in ranges:
+                if p.overlaps(r):
+                    out.append(p)
+                    break
+        return out

@@ -1,0 +1,83 @@
+"""Index build: key compute -> global sort -> partition manifest.
+
+The rebuild's analog of bulk ingest + table splits (ref: geomesa-accumulo
+bulk ingest MapReduce sort + AccumuloIndexAdapter table splits, SURVEY.md
+section 2.6 "Z-order bulk sort"). Host path uses numpy lexsort; the device
+path (jax.lax.sort over z keys, ICI radix exchange across a mesh) lives in
+geomesa_tpu.parallel and is exercised by the bench/dryrun.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.index.api import BuiltIndex, PartitionMeta
+
+DEFAULT_PARTITION_SIZE = 1 << 20  # ~1M rows per partition
+
+
+def build_index(
+    keyspace,
+    batch: FeatureBatch,
+    partition_size: int = DEFAULT_PARTITION_SIZE,
+) -> BuiltIndex:
+    keys = keyspace.index_keys(batch)
+    cols = [keys[c] for c in keyspace.key_columns]
+    order = _sort_order(cols)
+    sorted_batch = batch.take(order)
+    sorted_keys = {k: v[order] for k, v in keys.items()}
+    partitions = make_partitions(keyspace, sorted_batch, sorted_keys, partition_size)
+    return BuiltIndex(keyspace, sorted_batch, sorted_keys, partitions)
+
+
+def _sort_order(cols: list) -> np.ndarray:
+    if len(cols) == 1:
+        return np.argsort(cols[0], kind="stable")
+    # np.lexsort: last key is primary -> reverse
+    return np.lexsort(tuple(reversed(cols)))
+
+
+def make_partitions(
+    keyspace,
+    sorted_batch: FeatureBatch,
+    sorted_keys: dict,
+    partition_size: int,
+) -> "list[PartitionMeta]":
+    n = len(sorted_batch)
+    sft = sorted_batch.sft
+    geom = sft.geom_field
+    dtg = sft.dtg_field
+    key_cols = [sorted_keys[c] for c in keyspace.key_columns]
+    partitions = []
+    for pid, start in enumerate(range(0, max(n, 1), partition_size)):
+        stop = min(start + partition_size, n)
+        if stop <= start:
+            break
+        key_lo = tuple(_item(c[start]) for c in key_cols)
+        key_hi = tuple(_item(c[stop - 1]) for c in key_cols)
+        bbox = None
+        if geom is not None:
+            bb = sorted_batch.bboxes(geom)[start:stop]
+            bbox = (
+                float(bb[:, 0].min()),
+                float(bb[:, 1].min()),
+                float(bb[:, 2].max()),
+                float(bb[:, 3].max()),
+            )
+        time_range = None
+        if dtg is not None:
+            d = sorted_batch.column(dtg)[start:stop]
+            time_range = (int(d.min()), int(d.max()))
+        partitions.append(
+            PartitionMeta(pid, start, stop, key_lo, key_hi, stop - start, bbox, time_range)
+        )
+    return partitions
+
+
+def _item(v):
+    """numpy scalar -> python scalar for tuple comparisons; uint64 z values
+    stay exact via int()."""
+    if isinstance(v, np.generic):
+        return v.item()
+    return v

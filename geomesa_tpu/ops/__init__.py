@@ -1,0 +1,7 @@
+"""Device-side scan/sort kernels (the server-side iterator analog).
+
+The reference runs per-KV Scala iterators next to the data (ref:
+geomesa-accumulo .../iterators/Z3Iterator.scala,
+FilterTransformIterator.scala); here the same role is fused jax/Pallas
+masks over resident columnar partitions (SURVEY.md sections 2.6, 7).
+"""

@@ -1,0 +1,46 @@
+"""Columnar device scan: stage columns, evaluate fused masks.
+
+The jitted mask function is the rebuild's Z3Iterator+FilterTransformIterator:
+one fused elementwise kernel over resident columns producing a boolean mask
+(XLA fuses the compare chain into a single HBM pass). Callers jit the
+compiled device_fn once per query and apply it per partition so XLA caches
+the executable across same-shaped partitions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.features.batch import FeatureBatch
+
+
+def stage_columns(
+    batch: FeatureBatch,
+    names: "list[str]",
+    start: int = 0,
+    stop: "int | None" = None,
+    dtype=None,
+):
+    """Slice + upload the named device columns ("attr" scalar columns,
+    "attr__x"/"attr__y" point coordinates) as jax arrays."""
+    import jax.numpy as jnp
+
+    stop = len(batch) if stop is None else stop
+    out = {}
+    for name in names:
+        if name.endswith("__x") or name.endswith("__y"):
+            attr = name[:-3]
+            col = batch.column(attr)
+            arr = col[start:stop, 0 if name.endswith("__x") else 1]
+        else:
+            arr = batch.column(name)[start:stop]
+        if dtype is not None and arr.dtype.kind == "f":
+            arr = arr.astype(dtype)
+        if arr.dtype in (np.int64, np.uint64):
+            # Date columns are epoch-ms int64; without x64 jax would silently
+            # downcast to int32 and ms literals would overflow.
+            from geomesa_tpu.jaxconf import require_x64
+
+            require_x64()
+        out[name] = jnp.asarray(np.ascontiguousarray(arr))
+    return out

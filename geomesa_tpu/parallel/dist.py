@@ -1,0 +1,165 @@
+"""Distributed index build + scan steps (shard_map + XLA collectives).
+
+The pod-scale Z-order sort (SURVEY.md section 2.6 row "Z-order bulk sort"
+and section 7 hard part #5): each chip buckets its local rows by the high
+bits of the z key, exchanges buckets over ICI with ``all_to_all`` (radix
+exchange), and locally sorts -- yielding a globally z-sorted, shard-
+partitioned index. Scans run shard-local fused masks merged with ``psum``.
+
+All functions are pure and jittable over a Mesh; fixed shapes throughout
+(bucket capacity is static -- over-capacity rows would be dropped, so
+callers size ``capacity_factor`` for their skew; the host pipeline re-salts
+hot shards like the reference's ShardStrategy does for hot tablets).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+
+def _log2(n: int) -> int:
+    b = int(n).bit_length() - 1
+    if (1 << b) != n:
+        raise ValueError(f"device count {n} must be a power of two")
+    return b
+
+
+def sharded_count_scan(mesh, device_fn, cols: dict, axis: str = "shard"):
+    """Data-parallel fused-mask count: each shard scans its resident slice,
+    psum merges (the BatchScanner fan-out + client merge)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    spec = P(axis)
+    sharded_cols = {
+        k: jax.device_put(v, NamedSharding(mesh, spec)) for k, v in cols.items()
+    }
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec,) * len(sharded_cols),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def step(*arrs):
+        local = dict(zip(sorted(sharded_cols), arrs))
+        mask = device_fn(local)
+        return jax.lax.psum(mask.sum(), axis)
+
+    ordered = tuple(sharded_cols[k] for k in sorted(sharded_cols))
+    return jax.jit(step)(*ordered)
+
+
+def distributed_z3_sort(mesh, hi, lo, axis: str = "shard", capacity_factor: float = 2.0):
+    """Radix-exchange sort of (hi, lo) uint32 z-key pairs across the mesh.
+
+    Returns (hi, lo, valid) shard-partitioned arrays where shard s holds the
+    s-th globally-sorted key range (top log2(n_shards) bits of ``hi``),
+    locally sorted; ``valid`` masks padding introduced by the fixed-capacity
+    exchange.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    n_shards = mesh.shape[axis]
+    bits = _log2(n_shards)
+    spec = P(axis)
+    hi = jax.device_put(hi, NamedSharding(mesh, spec))
+    lo = jax.device_put(lo, NamedSharding(mesh, spec))
+    local_n = hi.shape[0] // n_shards
+    cap = int(np.ceil(local_n / n_shards * capacity_factor))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=False,
+    )
+    def step(h, l):
+        # z bits 62..(63-bits): top `bits` bits of the 63-bit z live in hi
+        # bits (62-32)=30 .. (31-bits): shift (31 - bits) then mask.
+        dest = (h >> (31 - bits)) & (n_shards - 1) if bits else jnp.zeros_like(h)
+        dest = dest.astype(jnp.int32)
+        # stable-bucket locally: sort by dest so each bucket is contiguous
+        order = jnp.argsort(dest, stable=True)
+        h_s, l_s, d_s = h[order], l[order], dest[order]
+        # position of each row within its bucket
+        start = jnp.searchsorted(d_s, jnp.arange(n_shards), side="left")
+        within = jnp.arange(h.shape[0]) - start[d_s]
+        # scatter into (n_shards, cap) with sentinel padding; rows past cap
+        # are dropped (capacity_factor sized for skew)
+        keep = within < cap
+        flat_idx = d_s * cap + within
+        flat_idx = jnp.where(keep, flat_idx, n_shards * cap)  # spill slot
+        buf_h = jnp.full((n_shards * cap + 1,), jnp.uint32(0xFFFFFFFF))
+        buf_l = jnp.full((n_shards * cap + 1,), jnp.uint32(0xFFFFFFFF))
+        buf_v = jnp.zeros((n_shards * cap + 1,), dtype=bool)
+        buf_h = buf_h.at[flat_idx].set(h_s)
+        buf_l = buf_l.at[flat_idx].set(l_s)
+        buf_v = buf_v.at[flat_idx].set(keep)
+        buf_h = buf_h[:-1].reshape(n_shards, cap)
+        buf_l = buf_l[:-1].reshape(n_shards, cap)
+        buf_v = buf_v[:-1].reshape(n_shards, cap)
+        # ICI radix exchange: block s goes to shard s
+        buf_h = jax.lax.all_to_all(buf_h, axis, 0, 0, tiled=False)
+        buf_l = jax.lax.all_to_all(buf_l, axis, 0, 0, tiled=False)
+        buf_v = jax.lax.all_to_all(buf_v, axis, 0, 0, tiled=False)
+        rh = buf_h.reshape(-1)
+        rl = buf_l.reshape(-1)
+        rv = buf_v.reshape(-1)
+        # local sort by (hi, lo); sentinels (0xffffffff) sink to the end
+        rh, rl, rv = jax.lax.sort((rh, rl, rv), num_keys=2)
+        return rh, rl, rv
+
+    return jax.jit(step)(hi, lo)
+
+
+def sharded_build_and_query_step(mesh, sfc, x, y, t, query_bounds, axis: str = "shard"):
+    """One full distributed 'index build + query' step, end to end on the
+    mesh: z3 hi/lo key encode (data-parallel) -> radix all_to_all exchange +
+    local sort (index build) -> fused bbox+time mask + psum count (query).
+
+    Returns (sorted_hi, sorted_lo, valid, count). This is the step
+    ``__graft_entry__.dryrun_multichip`` compiles over N virtual devices.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    spec = P(axis)
+    put = lambda a: jax.device_put(a, NamedSharding(mesh, spec))
+    x, y, t = put(x), put(y), put(t)
+    xmin, ymin, xmax, ymax, tmin, tmax = query_bounds
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec, P()),
+        check_vma=False,
+    )
+    def encode_and_count(xl, yl, tl):
+        hi, lo = sfc.index_jax_hi_lo(xl, yl, tl)
+        mask = (
+            (xl >= xmin)
+            & (xl <= xmax)
+            & (yl >= ymin)
+            & (yl <= ymax)
+            & (tl >= tmin)
+            & (tl <= tmax)
+        )
+        count = jax.lax.psum(mask.sum(), axis)
+        return hi, lo, mask, count
+
+    hi, lo, mask, count = jax.jit(encode_and_count)(x, y, t)
+    sh, sl, sv = distributed_z3_sort(mesh, hi, lo, axis=axis)
+    return sh, sl, sv, count

@@ -1,0 +1,24 @@
+"""Device mesh construction helpers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_mesh(n_devices: "int | None" = None, axes: "tuple[str, ...]" = ("shard",)):
+    """Build a Mesh over the first n devices. With two axis names the
+    devices are factored (shard-major)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    n = len(devices) if n_devices is None else n_devices
+    devices = np.array(devices[:n])
+    if len(axes) == 1:
+        return Mesh(devices, axes)
+    if len(axes) == 2:
+        # factor n = shard * replica, preferring more shards
+        for r in (2, 1):
+            if n % r == 0 and n // r >= 1:
+                return Mesh(devices.reshape(n // r, r), axes)
+    raise ValueError(f"cannot build mesh with axes {axes} over {n} devices")

@@ -1,0 +1,14 @@
+"""Query planning & execution (maps reference L5 planning + scan execution).
+
+- ``plan``:   Query/QueryPlan model, StrategyDecider, range generation
+              (ref: geomesa-index-api .../index/planning/QueryPlanner.scala,
+              FilterSplitter.scala, StrategyDecider.scala)
+- ``runner``: partition-pruned device scan + residual + local post-processing
+              (ref: LocalQueryRunner + the server-side iterator stack, which
+              here runs as fused device masks)
+"""
+
+from geomesa_tpu.query.plan import Query, QueryPlan, plan_query
+from geomesa_tpu.query.runner import run_query
+
+__all__ = ["Query", "QueryPlan", "plan_query", "run_query"]

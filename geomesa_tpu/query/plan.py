@@ -1,0 +1,165 @@
+"""Query model + planner.
+
+(ref: geomesa-index-api .../index/planning/QueryPlanner.planQuery,
+FilterSplitter, StrategyDecider [UNVERIFIED - empty reference mount]).
+
+Planning steps: parse/normalize the filter; extract spatial + temporal +
+attribute bounds; score each available index (heuristic cost, ref
+StrategyDecider's stat-less fallback); generate key ranges for the winner;
+split device-vs-residual predicates (the FilterTransformIterator analog).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.compile import CompiledFilter, compile_filter
+from geomesa_tpu.filter.ecql import parse_ecql
+from geomesa_tpu.filter.extract import (
+    FilterBounds,
+    extract_geometries,
+    extract_intervals,
+)
+from geomesa_tpu.index.api import BuiltIndex, KeyRange
+from geomesa_tpu.index.keyspaces import AttributeKeySpace, IdKeySpace
+
+from geomesa_tpu.curves.zranges import DEFAULT_MAX_RANGES
+
+
+@dataclass
+class Query:
+    """A GeoTools-Query analog: filter + projection + limits + hints."""
+
+    filter: "ast.Filter | str" = ast.Include
+    properties: "list[str] | None" = None  # projection (transform)
+    max_features: "int | None" = None
+    sort_by: "str | None" = None
+    sort_desc: bool = False
+    hints: dict = field(default_factory=dict)  # density/stats/bin/sampling
+
+    def parsed(self) -> ast.Filter:
+        if isinstance(self.filter, str):
+            return parse_ecql(self.filter)
+        return self.filter
+
+
+@dataclass
+class QueryPlan:
+    """The chosen strategy + ranges + filter split (explain() payload)."""
+
+    sft: SimpleFeatureType
+    query: Query
+    filter: ast.Filter
+    index_name: str
+    ranges: "list[KeyRange] | None"
+    compiled: CompiledFilter
+    geom_bounds: FilterBounds
+    time_bounds: FilterBounds
+    candidates: "list[tuple[str, float]]" = field(default_factory=list)
+
+    def explain(self) -> str:
+        """Human-readable plan dump (ref: Explainer output surfaced by the
+        CLI 'explain' command)."""
+        lines = [
+            f"Planning query on '{self.sft.type_name}'",
+            f"  Filter: {self.filter!r}",
+            f"  Strategy candidates: "
+            + ", ".join(f"{n} (cost {c:g})" for n, c in self.candidates),
+            f"  Chosen index: {self.index_name}",
+        ]
+        if self.ranges is None:
+            lines.append("  Ranges: FULL SCAN (no extractable bounds)")
+        else:
+            lines.append(f"  Ranges: {len(self.ranges)}")
+            for r in self.ranges[:5]:
+                lines.append(f"    {r.lo} .. {r.hi}{' (contained)' if r.contained else ''}")
+            if len(self.ranges) > 5:
+                lines.append(f"    ... {len(self.ranges) - 5} more")
+        lines.append(f"  Device predicate: {self.compiled.device_part!r}")
+        lines.append(f"  Host residual:    {self.compiled.residual_part!r}")
+        return "\n".join(lines)
+
+
+def plan_query(
+    sft: SimpleFeatureType,
+    indices: dict,
+    query: Query,
+    max_ranges: int = DEFAULT_MAX_RANGES,
+    data_interval: "tuple[int, int] | None" = None,
+) -> QueryPlan:
+    """indices: {name: BuiltIndex | IndexKeySpace} -- planning only needs
+    the key spaces, so disk-backed stores can plan before loading data."""
+    f = query.parsed()
+    geom_field = sft.geom_field
+    dtg_field = sft.dtg_field
+    geoms = (
+        extract_geometries(f, geom_field) if geom_field else FilterBounds.all()
+    )
+    intervals = (
+        extract_intervals(f, dtg_field) if dtg_field else FilterBounds.all()
+    )
+
+    # score every index (ref StrategyDecider: stat-based when stats exist,
+    # heuristic otherwise; here: heuristic + per-attribute route)
+    candidates: list[tuple[str, float]] = []
+    for name, built in indices.items():
+        ks = getattr(built, "keyspace", built)
+        if isinstance(ks, AttributeKeySpace):
+            bounds = extract_intervals(f, ks.attr)
+            eq = _attr_equality(f, ks.attr)
+            cost = 0.5 if eq else (5.0 if not bounds.unbounded else float("inf"))
+            candidates.append((name, cost))
+        elif isinstance(ks, IdKeySpace):
+            candidates.append((name, float("inf")))
+        else:
+            candidates.append((name, ks.cost(geoms, intervals)))
+    # full scan fallback uses whichever index exists
+    candidates.sort(key=lambda t: t[1])
+    index_name = candidates[0][0] if candidates else None
+    if index_name is None:
+        raise ValueError("no indices available")
+    if candidates[0][1] == float("inf"):
+        # nothing prunes: full scan on the first index
+        ranges = None
+    else:
+        built = indices[index_name]
+        ks = getattr(built, "keyspace", built)
+        if isinstance(ks, AttributeKeySpace):
+            bounds = extract_intervals(f, ks.attr)
+            eq = _attr_equality(f, ks.attr)
+            if eq is not None:
+                ranges = [KeyRange((v,), (v,), False) for v in eq]
+            else:
+                ranges = ks.ranges_for_values(bounds)
+        else:
+            ranges = ks.scan_ranges(
+                geoms, intervals, max_ranges, data_interval=data_interval
+            )
+    compiled = compile_filter(f, sft)
+    return QueryPlan(
+        sft=sft,
+        query=query,
+        filter=f,
+        index_name=index_name,
+        ranges=ranges,
+        compiled=compiled,
+        geom_bounds=geoms,
+        time_bounds=intervals,
+        candidates=candidates,
+    )
+
+
+def _attr_equality(f: ast.Filter, attr: str):
+    """Equality/IN value set for an attribute if the filter pins it
+    (top-level or within an AND), else None."""
+    nodes = f.children if isinstance(f, ast.And) else (f,)
+    for n in nodes:
+        if isinstance(n, ast.Compare) and n.op == "=" and n.attr == attr:
+            return (n.value,)
+        if isinstance(n, ast.In) and n.attr == attr:
+            return tuple(sorted(n.values))
+    return None

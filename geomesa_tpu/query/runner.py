@@ -1,0 +1,94 @@
+"""Query execution: partition prune -> device mask scan -> residual ->
+local post-processing.
+
+(ref: the scan side of AccumuloQueryPlan.BatchScanPlan + LocalQueryRunner
+[UNVERIFIED - empty reference mount]. The reference fans ranges out to
+tablet servers; here partitions are scanned with one jitted fused mask --
+same shape = one XLA executable -- and non-device predicates run as an
+exact numpy residual over surviving candidates only.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.index.api import BuiltIndex
+from geomesa_tpu.ops.scan import stage_columns
+from geomesa_tpu.query.plan import QueryPlan
+
+
+@dataclass
+class QueryResult:
+    batch: FeatureBatch
+    plan: QueryPlan
+    scanned: int  # rows device-scanned after pruning
+    total: int  # rows in the index
+
+    def __len__(self) -> int:
+        return len(self.batch)
+
+
+def run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
+    import jax
+
+    parts = built.prune(plan.ranges)
+    compiled = plan.compiled
+    n_scanned = sum(p.count for p in parts)
+
+    hit_chunks: list[np.ndarray] = []
+    if parts:
+        use_device = bool(compiled.device_cols)
+        jitted = jax.jit(compiled.device_fn) if use_device else None
+        for p in parts:
+            if use_device:
+                cols = stage_columns(
+                    built.batch, compiled.device_cols, p.start, p.stop
+                )
+                mask = np.asarray(jitted(cols))
+            else:
+                mask = np.ones(p.stop - p.start, dtype=bool)
+            idx = np.nonzero(mask)[0]
+            if len(idx) and not compiled.fully_on_device:
+                cand = built.batch.take(idx + p.start)
+                idx = idx[compiled.residual_mask(cand)]
+            if len(idx):
+                hit_chunks.append(idx + p.start)
+
+    if hit_chunks:
+        rows = np.concatenate(hit_chunks)
+    else:
+        rows = np.array([], dtype=np.int64)
+
+    result = built.batch.take(rows)
+    result = _post_process(result, plan)
+    return QueryResult(result, plan, n_scanned, built.n)
+
+
+def _post_process(batch: FeatureBatch, plan: QueryPlan) -> FeatureBatch:
+    """sort / max-features / projection (ref LocalQueryRunner)."""
+    q = plan.query
+    if q.sort_by:
+        order = np.argsort(batch.column(q.sort_by), kind="stable")
+        if q.sort_desc:
+            order = order[::-1]
+        batch = batch.take(order)
+    if q.max_features is not None and len(batch) > q.max_features:
+        batch = batch.take(np.arange(q.max_features))
+    if q.properties:
+        from geomesa_tpu.features.sft import SimpleFeatureType
+
+        attrs = tuple(
+            batch.sft.descriptor(p) for p in q.properties
+        )
+        sub_sft = SimpleFeatureType(
+            batch.sft.type_name, attrs, batch.sft.user_data
+        )
+        batch = FeatureBatch(
+            sub_sft,
+            batch.fids,
+            {p: batch.columns[p] for p in q.properties},
+        )
+    return batch

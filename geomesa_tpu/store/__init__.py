@@ -1,0 +1,13 @@
+"""DataStore API surface (maps reference L6 + L1).
+
+- ``api``:    store protocol + feature writer
+              (ref: geomesa-index-api .../index/geotools/GeoMesaDataStore)
+- ``memory``: in-memory columnar store -- the TestGeoMesaDataStore analog
+              (ref: geomesa-index-api src/test TestGeoMesaDataStore; SURVEY
+              section 4 calls this the most important testing idea)
+- ``fs``:     Parquet filesystem store (ref: geomesa-fs)
+"""
+
+from geomesa_tpu.store.memory import MemoryDataStore
+
+__all__ = ["MemoryDataStore"]

@@ -1,0 +1,143 @@
+"""SFT spec parsing, FeatureBatch columns, Arrow round-trip, geometry."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, SimpleFeatureType
+from geomesa_tpu.geom import (
+    Envelope,
+    Point,
+    Polygon,
+    parse_wkt,
+    points_in_polygon,
+    to_wkt,
+)
+
+
+SPEC = "name:String,age:Int,dtg:Date,*geom:Point:srid=4326;geomesa.z3.interval=week"
+
+
+class TestSFT:
+    def test_parse(self):
+        sft = SimpleFeatureType.create("gdelt", SPEC)
+        assert sft.attribute_names == ["name", "age", "dtg", "geom"]
+        assert sft.geom_field == "geom"
+        assert sft.dtg_field == "dtg"
+        assert sft.descriptor("age").type_name == "Integer"
+        assert sft.descriptor("geom").options["srid"] == "4326"
+        assert sft.z3_interval == "week"
+
+    def test_spec_roundtrip(self):
+        sft = SimpleFeatureType.create("gdelt", SPEC)
+        again = SimpleFeatureType.create("gdelt", sft.spec)
+        assert again == sft
+
+    def test_defaults_and_errors(self):
+        sft = SimpleFeatureType.create("t", "a,b:Double")
+        assert sft.descriptor("a").type_name == "String"
+        assert sft.geom_field is None
+        with pytest.raises(ValueError):
+            SimpleFeatureType.create("t", "a:Nope")
+        with pytest.raises(ValueError):
+            SimpleFeatureType.create("t", "a:Int,a:Int")
+
+    def test_dtg_user_data_override(self):
+        sft = SimpleFeatureType.create(
+            "t", "d1:Date,d2:Date;geomesa.index.dtg=d2"
+        )
+        assert sft.dtg_field == "d2"
+
+
+class TestBatch:
+    def _batch(self, n=100):
+        sft = SimpleFeatureType.create("gdelt", SPEC)
+        rng = np.random.default_rng(1)
+        return FeatureBatch.from_columns(
+            sft,
+            {
+                "name": [f"ev{i}" for i in range(n)],
+                "age": rng.integers(0, 100, n),
+                "dtg": rng.integers(0, 10**12, n),
+                "geom": np.stack(
+                    [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+                ),
+            },
+        )
+
+    def test_build_and_take(self):
+        b = self._batch()
+        assert len(b) == 100
+        sub = b.take([3, 5, 7])
+        assert len(sub) == 3
+        assert sub.columns["name"][0] == "ev3"
+
+    def test_point_coords(self):
+        b = self._batch()
+        x, y = b.point_coords()
+        assert x.shape == (100,)
+        np.testing.assert_array_equal(b.bboxes()[:, 0], x)
+
+    def test_arrow_roundtrip(self):
+        b = self._batch()
+        t = b.to_arrow()
+        back = FeatureBatch.from_arrow(t, b.sft)
+        np.testing.assert_array_equal(back.columns["age"], b.columns["age"])
+        np.testing.assert_array_equal(back.columns["dtg"], b.columns["dtg"])
+        np.testing.assert_allclose(back.columns["geom"], b.columns["geom"])
+        np.testing.assert_array_equal(back.fids, b.fids)
+
+    def test_wkt_geometry_column(self):
+        sft = SimpleFeatureType.create("t", "*geom:Polygon")
+        b = FeatureBatch.from_columns(
+            sft, {"geom": ["POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))"]}
+        )
+        bb = b.bboxes()
+        np.testing.assert_array_equal(bb[0], [0, 0, 2, 2])
+
+    def test_date_string_coercion(self):
+        sft = SimpleFeatureType.create("t", "dtg:Date")
+        b = FeatureBatch.from_columns(sft, {"dtg": ["2020-01-01T00:00:01"]})
+        assert b.columns["dtg"][0] == np.datetime64("2020-01-01T00:00:01").astype(
+            "datetime64[ms]"
+        ).astype(np.int64)
+
+
+class TestGeom:
+    def test_wkt_roundtrip(self):
+        for w in [
+            "POINT (1.5 -2.5)",
+            "LINESTRING (0 0, 1 1, 2 0)",
+            "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))",
+            "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 0)), ((5 5, 6 5, 6 6, 5 5)))",
+        ]:
+            g = parse_wkt(w)
+            assert to_wkt(g) == w
+
+    def test_envelope_geotools_order(self):
+        e = parse_wkt("ENVELOPE (10, 20, -5, 5)")
+        assert isinstance(e, Envelope)
+        assert (e.xmin, e.xmax, e.ymin, e.ymax) == (10, 20, -5, 5)
+
+    def test_point_in_polygon(self):
+        # square with a hole
+        poly = parse_wkt(
+            "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), (4 4, 6 4, 6 6, 4 6, 4 4))"
+        )
+        px = np.array([5.0, 1.0, 11.0, 5.0])
+        py = np.array([5.0, 1.0, 5.0, 4.5])
+        res = points_in_polygon(px, py, poly.rings())
+        np.testing.assert_array_equal(res, [False, True, False, False])
+
+    def test_point_in_polygon_jax_matches(self, rng):
+        import jax.numpy as jnp
+
+        poly = parse_wkt("POLYGON ((0 0, 10 0, 12 6, 5 11, -2 6, 0 0))")
+        px = rng.uniform(-5, 15, 5000)
+        py = rng.uniform(-5, 15, 5000)
+        host = points_in_polygon(px, py, poly.rings())
+        from geomesa_tpu.geom import points_in_polygon_jax
+
+        dev = np.asarray(
+            points_in_polygon_jax(jnp.asarray(px), jnp.asarray(py), poly.rings())
+        )
+        np.testing.assert_array_equal(host, dev)

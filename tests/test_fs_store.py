@@ -1,0 +1,84 @@
+"""Parquet filesystem store: persistence, reopen, pruned queries."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter.compile import evaluate_host
+from geomesa_tpu.filter.ecql import parse_ecql, parse_instant
+from geomesa_tpu.query.plan import Query
+from geomesa_tpu.store.fs import FileSystemDataStore
+
+SPEC = "name:String,count:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def fill(store, n=20000, seed=11):
+    sft = store.create_schema("gdelt", SPEC)
+    rng = np.random.default_rng(seed)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    cols = {
+        "name": rng.choice(["alpha", "beta", "gamma"], n),
+        "count": rng.integers(0, 100, n),
+        "dtg": rng.integers(t0, t1, n),
+        "geom": np.stack([rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1),
+    }
+    store.write("gdelt", cols, fids=np.arange(n))
+    store.flush("gdelt")
+    return cols
+
+
+def test_fs_roundtrip_and_prune(tmp_path):
+    store = FileSystemDataStore(str(tmp_path), partition_size=4096)
+    cols = fill(store)
+    ecql = "BBOX(geom, -5, 42, 8, 51) AND dtg DURING 2020-01-05T00:00:00Z/2020-01-20T00:00:00Z"
+    res = store.query("gdelt", ecql)
+    # oracle
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.features.sft import SimpleFeatureType
+
+    sft = SimpleFeatureType.create("gdelt", SPEC)
+    all_data = FeatureBatch.from_columns(sft, cols, np.arange(20000))
+    expected = np.sort(all_data.fids[evaluate_host(parse_ecql(ecql), all_data)])
+    np.testing.assert_array_equal(np.sort(res.batch.fids), expected)
+    assert res.scanned < res.total, "manifest pruning should skip partitions"
+
+
+def test_fs_reopen(tmp_path):
+    store = FileSystemDataStore(str(tmp_path), partition_size=4096)
+    fill(store, n=5000)
+    # reopen from disk only
+    store2 = FileSystemDataStore(str(tmp_path))
+    assert store2.type_names == ["gdelt"]
+    assert store2.get_schema("gdelt").geom_field == "geom"
+    n = store2.count("gdelt", "BBOX(geom, -90, -45, 90, 45)")
+    assert n == store.count("gdelt", "BBOX(geom, -90, -45, 90, 45)")
+    assert n > 0
+
+
+def test_fs_incremental_write(tmp_path):
+    store = FileSystemDataStore(str(tmp_path), partition_size=1024)
+    fill(store, n=3000)
+    store.write(
+        "gdelt",
+        {
+            "name": ["omega"],
+            "count": [1],
+            "dtg": [parse_instant("2020-01-10T00:00:00")],
+            "geom": np.array([[2.0, 48.0]]),
+        },
+        fids=[777777],
+    )
+    store.flush("gdelt")
+    res = store.query("gdelt", "name = 'omega'")
+    assert list(res.batch.fids) == [777777]
+
+
+def test_fs_sort_on_dropped_column(tmp_path):
+    store = FileSystemDataStore(str(tmp_path), partition_size=1024)
+    fill(store, n=3000)
+    res = store.query(
+        "gdelt",
+        Query(filter="INCLUDE", properties=["count"], sort_by="count", max_features=5),
+    )
+    assert len(res) == 5
+    assert np.all(np.diff(res.batch.column("count")) >= 0)

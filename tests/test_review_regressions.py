@@ -1,0 +1,100 @@
+"""Regression tests for code-review findings (host spatial semantics,
+parser edge cases, store edge cases, planner budgets)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, SimpleFeatureType
+from geomesa_tpu.filter.compile import evaluate_host
+from geomesa_tpu.filter.ecql import parse_ecql, parse_instant
+from geomesa_tpu.geom import parse_wkt
+from geomesa_tpu.geom.predicates import geometry_intersects, geometry_within
+from geomesa_tpu.store import MemoryDataStore
+
+
+def test_multipolygon_contained_part_intersects():
+    a = parse_wkt("POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))")
+    b = parse_wkt(
+        "MULTIPOLYGON (((5 5, 6 5, 6 6, 5 6, 5 5)), "
+        "((0.4 0.4, 0.6 0.4, 0.6 0.6, 0.4 0.6, 0.4 0.4)))"
+    )
+    assert geometry_intersects(a, b)
+    assert geometry_intersects(b, a)
+
+
+def test_geometry_within_semantics():
+    outer = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+    inside = parse_wkt("POLYGON ((1 1, 2 1, 2 2, 1 2, 1 1))")
+    crossing = parse_wkt("POLYGON ((8 8, 12 8, 12 12, 8 12, 8 8))")
+    assert geometry_within(inside, outer)
+    assert not geometry_within(crossing, outer)
+    assert not geometry_within(outer, inside)
+
+
+def test_within_contains_on_line_column():
+    sft = SimpleFeatureType.create("t", "*geom:LineString")
+    batch = FeatureBatch.from_columns(
+        sft,
+        {
+            "geom": [
+                "LINESTRING (1 1, 2 2)",  # within P
+                "LINESTRING (8 8, 12 12)",  # crosses P boundary
+            ]
+        },
+    )
+    within = evaluate_host(
+        parse_ecql("WITHIN(geom, POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0)))"), batch
+    )
+    np.testing.assert_array_equal(within, [True, False])
+    intersects = evaluate_host(
+        parse_ecql("INTERSECTS(geom, POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0)))"),
+        batch,
+    )
+    np.testing.assert_array_equal(intersects, [True, True])
+
+
+def test_contains_on_point_column_is_false_for_polygons():
+    sft = SimpleFeatureType.create("t", "*geom:Point")
+    batch = FeatureBatch.from_columns(sft, {"geom": np.array([[5.0, 5.0]])})
+    m = evaluate_host(
+        parse_ecql("CONTAINS(geom, POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0)))"),
+        batch,
+    )
+    np.testing.assert_array_equal(m, [False])
+
+
+def test_quoted_during_instants():
+    f = parse_ecql("dtg DURING '2020-01-01T00:00:00Z'/'2020-01-02T00:00:00Z'")
+    assert f.t0 == parse_instant("2020-01-01T00:00:00")
+    f2 = parse_ecql("dtg AFTER '2020-01-01T00:00:00'")
+    assert f2.value == parse_instant("2020-01-01T00:00:00")
+
+
+def test_get_by_ids_after_delete_all():
+    store = MemoryDataStore()
+    store.create_schema("t", "v:Int,*geom:Point")
+    store.write("t", {"v": [1, 2], "geom": np.array([[0.0, 0.0], [1.0, 1.0]])}, fids=[10, 20])
+    store.delete("t", [10, 20])
+    assert len(store.get_by_ids("t", [10])) == 0
+
+
+def test_huge_interval_range_budget():
+    from geomesa_tpu.filter.extract import FilterBounds
+    from geomesa_tpu.index.keyspaces import Z3KeySpace
+
+    ks = Z3KeySpace("geom", "dtg")
+    t0 = parse_instant("2000-01-01T00:00:00")
+    t1 = parse_instant("2020-01-01T00:00:00")  # ~1043 weekly bins
+    from geomesa_tpu.geom import Envelope
+
+    geoms = FilterBounds(((Envelope(-5, 42, 8, 51), None),))
+    intervals = FilterBounds(((t0, t1),))
+    ranges = ks.scan_ranges(geoms, intervals, max_ranges=2000)
+    assert len(ranges) <= 2200, f"{len(ranges)} ranges exceed budget"
+    # and a 10000-bin day interval collapses to one coarse range
+    ks_day = Z3KeySpace("geom", "dtg", period="day")
+    from geomesa_tpu.curves.binnedtime import TimePeriod
+
+    ks_day = Z3KeySpace("geom", "dtg", TimePeriod.DAY)
+    ranges = ks_day.scan_ranges(geoms, intervals, max_ranges=2000)
+    assert len(ranges) == 1

@@ -1,0 +1,156 @@
+"""End-to-end store tests: schema -> write -> plan -> scan -> results.
+
+The oracle is brute-force host evaluation of the full filter over all data
+(result sets must be identical -- the "bit-identical to the Accumulo scan"
+bar at the semantic level)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter.compile import evaluate_host
+from geomesa_tpu.filter.ecql import parse_ecql, parse_instant
+from geomesa_tpu.query.plan import Query
+from geomesa_tpu.store import MemoryDataStore
+
+SPEC = "name:String,count:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def make_store(n=20000, seed=11, partition_size=4096):
+    store = MemoryDataStore(partition_size=partition_size)
+    sft = store.create_schema("gdelt", SPEC)
+    rng = np.random.default_rng(seed)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    store.write(
+        "gdelt",
+        {
+            "name": rng.choice(["alpha", "beta", "gamma"], n),
+            "count": rng.integers(0, 100, n),
+            "dtg": rng.integers(t0, t1, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    return store
+
+
+FILTERS = [
+    "BBOX(geom, -5, 42, 8, 51) AND dtg DURING 2020-01-05T00:00:00Z/2020-01-20T00:00:00Z",
+    "BBOX(geom, -5, 42, 8, 51)",
+    "dtg DURING 2020-01-05T00:00:00Z/2020-01-07T00:00:00Z",
+    "INTERSECTS(geom, POLYGON ((-10 30, 20 30, 20 60, -10 60, -10 30))) AND count > 50",
+    "BBOX(geom, -5, 42, 8, 51) AND name = 'alpha'",
+    "count BETWEEN 10 AND 20",
+    "name = 'beta'",
+    "INCLUDE",
+    "BBOX(geom, 100, -80, 170, -40) OR BBOX(geom, -5, 42, 8, 51)",
+    "dtg AFTER 2020-02-20T00:00:00Z AND count < 10 AND name LIKE 'ga%'",
+]
+
+
+@pytest.fixture(scope="module")
+def store():
+    return make_store()
+
+
+@pytest.mark.parametrize("ecql", FILTERS)
+def test_results_match_oracle(store, ecql):
+    st = store._state("gdelt")
+    store._flush(st)
+    expected = np.sort(st.data.fids[evaluate_host(parse_ecql(ecql), st.data)])
+    res = store.query("gdelt", ecql)
+    got = np.sort(res.batch.fids)
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_z3_chosen_for_bbox_time(store):
+    plan = store.plan("gdelt", FILTERS[0])
+    assert plan.index_name == "z3"
+    assert plan.ranges, "expected pruning ranges"
+
+
+def test_z2_chosen_for_bbox_only(store):
+    plan = store.plan("gdelt", "BBOX(geom, -5, 42, 8, 51)")
+    assert plan.index_name == "z2"
+
+
+def test_pruning_actually_prunes(store):
+    res = store.query("gdelt", FILTERS[2])  # narrow 2-day window
+    assert res.scanned < res.total, "time-window query should prune partitions"
+
+
+def test_explain_output(store):
+    text = store.explain("gdelt", FILTERS[0])
+    assert "Chosen index: z3" in text
+    assert "Ranges:" in text
+
+
+def test_max_features_and_sort(store):
+    res = store.query(
+        "gdelt",
+        Query(filter="count >= 0", sort_by="count", sort_desc=True, max_features=7),
+    )
+    assert len(res) == 7
+    c = res.batch.column("count")
+    assert np.all(np.diff(c) <= 0)
+
+
+def test_projection(store):
+    res = store.query("gdelt", Query(filter=FILTERS[1], properties=["count", "geom"]))
+    assert res.batch.sft.attribute_names == ["count", "geom"]
+
+
+def test_get_by_ids(store):
+    b = store.get_by_ids("gdelt", [5, 17, 19999])
+    assert len(b) == 3
+    np.testing.assert_array_equal(np.sort(b.fids), [5, 17, 19999])
+
+
+def test_incremental_write_and_delete():
+    store = make_store(n=1000)
+    store.write(
+        "gdelt",
+        {
+            "name": ["omega"],
+            "count": [1],
+            "dtg": [parse_instant("2020-01-10T00:00:00")],
+            "geom": np.array([[2.0, 48.0]]),
+        },
+        fids=[99999],
+    )
+    assert store.count("gdelt", "name = 'omega'") == 1
+    assert store.delete("gdelt", [99999]) == 1
+    assert store.count("gdelt", "name = 'omega'") == 0
+
+
+def test_empty_result(store):
+    res = store.query("gdelt", "BBOX(geom, 0, 0, 0.0001, 0.0001) AND name = 'nope'")
+    assert len(res) == 0
+
+
+def test_attribute_index():
+    store = MemoryDataStore(partition_size=512)
+    store.create_schema(
+        "t", "tag:String:index=true,count:Int,dtg:Date,*geom:Point"
+    )
+    rng = np.random.default_rng(2)
+    n = 5000
+    store.write(
+        "t",
+        {
+            "tag": rng.choice(["a", "b", "c", "d"], n),
+            "count": rng.integers(0, 10, n),
+            "dtg": rng.integers(0, 10**10, n),
+            "geom": np.stack([rng.uniform(-10, 10, n), rng.uniform(-10, 10, n)], 1),
+        },
+    )
+    plan = store.plan("t", "tag = 'b'")
+    assert plan.index_name == "attr:tag"
+    res = store.query("t", "tag = 'b'")
+    assert np.all(res.batch.column("tag") == "b")
+    st = store._state("t")
+    expected = int((st.data.column("tag") == "b").sum())
+    assert len(res) == expected
+    assert res.scanned < len(st.data)

@@ -72,6 +72,25 @@ class TestXZ2:
         assert int(code[0]) in {1 + q * step for q in range(4)}
 
 
+class TestValidation:
+    def test_inverted_box_rejected(self):
+        sfc = XZ2SFC()
+        with pytest.raises(ValueError, match="antimeridian"):
+            sfc.index(
+                np.array([170.0]), np.array([0.0]), np.array([-170.0]), np.array([1.0])
+            )
+
+    def test_g_capacity_limits(self):
+        from geomesa_tpu.curves.xz import XZSFC
+
+        with pytest.raises(ValueError, match="int64"):
+            XZSFC(32, dims=2)
+        with pytest.raises(ValueError, match="int64"):
+            XZSFC(21, dims=3)
+        XZSFC(31, dims=2)
+        XZSFC(20, dims=3)
+
+
 class TestXZ3:
     def test_no_false_negatives(self, rng):
         sfc = XZ3SFC()

@@ -1,0 +1,45 @@
+"""Declarative ingest converters (maps reference geomesa-convert).
+
+(ref: geomesa-convert SimpleFeatureConverter/AbstractConverter + the
+Transformers expression language [UNVERIFIED - empty reference mount]).
+A converter config (dict; the TypeSafe-Config analog) declares how raw
+records become features:
+
+    {
+      "type": "delimited-text",       # or "json"
+      "format": "csv",                 # csv | tsv
+      "id-field": "$1",                # expression for the feature id
+      "options": {"skip-lines": 1, "error-mode": "skip-bad-records"},
+      "fields": [
+        {"name": "name", "transform": "$1"},
+        {"name": "age",  "transform": "$2::int"},
+        {"name": "dtg",  "transform": "datetime($3)"},
+        {"name": "geom", "transform": "point($4::double, $5::double)"},
+      ],
+    }
+
+Transforms use the expression language in ``expression.py``; evaluation is
+vectorized over record batches (columns in, columns out).
+"""
+
+from geomesa_tpu.convert.expression import Expression, parse_expression
+from geomesa_tpu.convert.delimited import DelimitedTextConverter
+from geomesa_tpu.convert.json_conv import JsonConverter
+
+
+def converter_for(config: dict, sft):
+    kind = config.get("type")
+    if kind == "delimited-text":
+        return DelimitedTextConverter(config, sft)
+    if kind == "json":
+        return JsonConverter(config, sft)
+    raise ValueError(f"unknown converter type {kind!r}")
+
+
+__all__ = [
+    "Expression",
+    "parse_expression",
+    "DelimitedTextConverter",
+    "JsonConverter",
+    "converter_for",
+]

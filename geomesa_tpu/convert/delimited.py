@@ -1,0 +1,101 @@
+"""Delimited-text converter (ref: geomesa-convert-text
+DelimitedTextConverter)."""
+
+from __future__ import annotations
+
+import csv
+import io
+from dataclasses import dataclass
+
+import numpy as np
+
+from geomesa_tpu.convert.expression import parse_expression
+from geomesa_tpu.features.batch import FeatureBatch
+
+
+@dataclass
+class ConvertResult:
+    batch: FeatureBatch
+    success: int
+    failed: int
+
+
+class DelimitedTextConverter:
+    def __init__(self, config: dict, sft):
+        self.sft = sft
+        self.delimiter = {"csv": ",", "tsv": "\t"}.get(
+            config.get("format", "csv"), config.get("format", ",")
+        )
+        opts = config.get("options", {})
+        self.skip_lines = int(opts.get("skip-lines", 0))
+        self.error_mode = opts.get("error-mode", "skip-bad-records")
+        self.fields = [
+            (f["name"], parse_expression(f["transform"])) for f in config["fields"]
+        ]
+        self.id_expr = (
+            parse_expression(config["id-field"]) if config.get("id-field") else None
+        )
+        missing = {a.name for a in sft.attributes} - {n for n, _ in self.fields}
+        if missing:
+            raise ValueError(f"converter missing fields for {sorted(missing)}")
+
+    def process(self, text_or_lines) -> ConvertResult:
+        """Convert raw csv/tsv content to a FeatureBatch."""
+        if isinstance(text_or_lines, str):
+            rows = list(
+                csv.reader(io.StringIO(text_or_lines), delimiter=self.delimiter)
+            )
+        else:
+            rows = list(csv.reader(text_or_lines, delimiter=self.delimiter))
+        rows = [r for r in rows[self.skip_lines :] if r]
+        if not rows:
+            empty = FeatureBatch.from_columns(
+                self.sft, {a.name: [] for a in self.sft.attributes}
+            )
+            return ConvertResult(empty, 0, 0)
+        width = max(len(r) for r in rows)
+        # drop short rows (bad records) up front
+        good = [r for r in rows if len(r) == width]
+        failed = len(rows) - len(good)
+        if failed and self.error_mode == "raise-errors":
+            raise ValueError(f"{failed} malformed records")
+        cols = {
+            str(i + 1): np.array([r[i] for r in good], dtype=object)
+            for i in range(width)
+        }
+        cols["0"] = np.array([self.delimiter.join(r) for r in good], dtype=object)
+        out = {}
+        ok = np.ones(len(good), dtype=bool)
+        for name, expr in self.fields:
+            try:
+                out[name] = expr(cols)
+            except Exception:
+                if self.error_mode == "raise-errors":
+                    raise
+                # row-wise salvage: evaluate one row at a time
+                vals, ok = _rowwise(expr, cols, ok)
+                out[name] = vals
+        if not np.all(ok):
+            failed += int((~ok).sum())
+            keep = np.nonzero(ok)[0]
+            out = {
+                k: (v[keep] if len(v) == len(ok) else v) for k, v in out.items()
+            }
+            cols = {k: v[keep] for k, v in cols.items()}
+        fids = self.id_expr(cols) if self.id_expr else None
+        batch = FeatureBatch.from_columns(self.sft, out, fids)
+        return ConvertResult(batch, len(batch), failed)
+
+
+def _rowwise(expr, cols: dict, ok: np.ndarray):
+    n = len(next(iter(cols.values())))
+    vals = [None] * n
+    ok = ok.copy()
+    for i in range(n):
+        row = {k: v[i : i + 1] for k, v in cols.items()}
+        try:
+            vals[i] = expr(row)[0]
+        except Exception:
+            ok[i] = False
+    arr = np.array([v for v in vals], dtype=object)
+    return arr, ok

@@ -1,0 +1,257 @@
+"""Converter expression language.
+
+(ref: geomesa-convert .../Transformers.scala / Expression.scala parboiled
+parser [UNVERIFIED - empty reference mount]). Supported grammar:
+
+    expr     := term ('::' cast)?
+    term     := func '(' expr (',' expr)* ')' | ref | literal
+    ref      := $N (1-based column) | $0 (whole record) | $name (field ref)
+    literal  := 'string' | number
+    cast     := int | long | float | double | string | boolean
+    func     := point | datetime | millisToDate | secsToDate | concat |
+                trim | lowercase | uppercase | replace | substring |
+                stringToInt/Long/Float/Double | md5 | lit | try
+
+Evaluation is columnar: refs resolve in a dict {ref: np.ndarray}; functions
+are vectorized where numpy allows, else row-wise object ops.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+_TOKEN = re.compile(
+    r"""\s*(?:
+        (?P<ref>\$[A-Za-z0-9_]+)
+      | (?P<cast>::[a-z]+)
+      | (?P<lparen>\()
+      | (?P<rparen>\))
+      | (?P<comma>,)
+      | (?P<string>'(?:[^']|'')*')
+      | (?P<number>-?\d+\.?\d*(?:[eE][-+]?\d+)?)
+      | (?P<word>[A-Za-z_][A-Za-z0-9_]*)
+    )""",
+    re.VERBOSE,
+)
+
+
+@dataclass
+class Expression:
+    fn: Callable  # (cols: dict) -> np.ndarray
+    refs: set
+    text: str
+
+    def __call__(self, cols: dict) -> np.ndarray:
+        return self.fn(cols)
+
+
+def parse_expression(text: str) -> Expression:
+    toks = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m or m.end() == pos:
+            if text[pos:].strip():
+                raise ValueError(f"cannot tokenize {text[pos:pos+15]!r}")
+            break
+        pos = m.end()
+        for k, v in m.groupdict().items():
+            if v is not None:
+                toks.append((k, v))
+                break
+    state = {"i": 0}
+    refs: set = set()
+
+    def peek():
+        return toks[state["i"]] if state["i"] < len(toks) else (None, None)
+
+    def nxt():
+        t = peek()
+        if t[0] is None:
+            raise ValueError(f"unexpected end of expression {text!r}")
+        state["i"] += 1
+        return t
+
+    def parse_expr():
+        fn = parse_term()
+        kind, val = peek()
+        if kind == "cast":
+            nxt()
+            fn = _cast(fn, val[2:])
+        return fn
+
+    def parse_term():
+        kind, val = nxt()
+        if kind == "ref":
+            name = val[1:]
+            refs.add(name)
+            return lambda cols, name=name: cols[name]
+        if kind == "string":
+            s = val[1:-1].replace("''", "'")
+            return lambda cols, s=s: _broadcast(cols, np.array([s], dtype=object))
+        if kind == "number":
+            v = float(val) if ("." in val or "e" in val.lower()) else int(val)
+            return lambda cols, v=v: _broadcast(cols, np.array([v]))
+        if kind == "word":
+            fname = val.lower()
+            k2, _ = peek()
+            if k2 != "lparen":
+                raise ValueError(f"expected '(' after {val!r}")
+            nxt()
+            args = []
+            if peek()[0] != "rparen":
+                args.append(parse_expr())
+                while peek()[0] == "comma":
+                    nxt()
+                    args.append(parse_expr())
+            if peek()[0] != "rparen":
+                raise ValueError(f"missing ')' in {text!r}")
+            nxt()
+            return _function(fname, args)
+        raise ValueError(f"unexpected token {val!r} in {text!r}")
+
+    fn = parse_expr()
+    if peek()[0] is not None:
+        raise ValueError(f"trailing input in expression {text!r}")
+    return Expression(fn, refs, text)
+
+
+def _broadcast(cols: dict, v: np.ndarray) -> np.ndarray:
+    n = len(next(iter(cols.values()))) if cols else 1
+    return np.repeat(v, n)
+
+
+_CASTS = {
+    "int": np.int32,
+    "long": np.int64,
+    "float": np.float32,
+    "double": np.float64,
+    "boolean": None,
+    "string": None,
+}
+
+
+def _cast(fn, kind: str):
+    if kind not in _CASTS:
+        raise ValueError(f"unknown cast ::{kind}")
+    if kind == "string":
+        return lambda cols: np.array(
+            [str(v) for v in fn(cols)], dtype=object
+        )
+    if kind == "boolean":
+        return lambda cols: np.array(
+            [str(v).strip().lower() in ("true", "1", "t", "yes") for v in fn(cols)]
+        )
+    dtype = _CASTS[kind]
+    if kind in ("int", "long"):
+        # parse via float first so "3.0" and "3" both work
+        return lambda cols: np.asarray(
+            np.asarray(fn(cols), dtype=np.float64), dtype=dtype
+        )
+    return lambda cols: np.asarray(fn(cols), dtype=dtype)
+
+
+def _function(name: str, args: list):
+    if name == "point":
+        if len(args) != 2:
+            raise ValueError("point(x, y) takes 2 args")
+        fx, fy = args
+        return lambda cols: np.stack(
+            [
+                np.asarray(fx(cols), dtype=np.float64),
+                np.asarray(fy(cols), dtype=np.float64),
+            ],
+            axis=1,
+        )
+    if name in ("datetime", "isodate"):
+        (f,) = args
+        def dt(cols, f=f):
+            vals = f(cols)
+            out = np.empty(len(vals), dtype=np.int64)
+            for i, v in enumerate(vals):
+                s = str(v).strip()
+                if s.endswith("Z"):
+                    s = s[:-1]
+                out[i] = np.datetime64(s, "ms").astype(np.int64)
+            return out
+        return dt
+    if name == "millistodate":
+        (f,) = args
+        return lambda cols: np.asarray(
+            np.asarray(f(cols), dtype=np.float64), dtype=np.int64
+        )
+    if name == "secstodate":
+        (f,) = args
+        return lambda cols: np.asarray(
+            np.asarray(f(cols), dtype=np.float64) * 1000, dtype=np.int64
+        )
+    if name == "concat":
+        return lambda cols: np.array(
+            ["".join(str(f(cols)[i]) for f in args) for i in range(len(args[0](cols)))],
+            dtype=object,
+        )
+    if name == "trim":
+        (f,) = args
+        return lambda cols: np.array([str(v).strip() for v in f(cols)], dtype=object)
+    if name == "lowercase":
+        (f,) = args
+        return lambda cols: np.array([str(v).lower() for v in f(cols)], dtype=object)
+    if name == "uppercase":
+        (f,) = args
+        return lambda cols: np.array([str(v).upper() for v in f(cols)], dtype=object)
+    if name == "replace":
+        f, fa, fb = args
+        def rep(cols, f=f, fa=fa, fb=fb):
+            a = str(fa(cols)[0])
+            b = str(fb(cols)[0])
+            return np.array([str(v).replace(a, b) for v in f(cols)], dtype=object)
+        return rep
+    if name == "substring":
+        f, f0, f1 = args
+        def sub(cols, f=f, f0=f0, f1=f1):
+            i0 = int(f0(cols)[0])
+            i1 = int(f1(cols)[0])
+            return np.array([str(v)[i0:i1] for v in f(cols)], dtype=object)
+        return sub
+    if name in ("stringtoint", "stringtolong", "stringtofloat", "stringtodouble"):
+        f, default = args if len(args) == 2 else (args[0], None)
+        dtype = {
+            "stringtoint": np.int32,
+            "stringtolong": np.int64,
+            "stringtofloat": np.float32,
+            "stringtodouble": np.float64,
+        }[name]
+        def conv(cols, f=f, default=default, dtype=dtype):
+            vals = f(cols)
+            dflt = default(cols)[0] if default is not None else 0
+            out = []
+            for v in vals:
+                try:
+                    out.append(dtype(float(v)))
+                except (TypeError, ValueError):
+                    out.append(dtype(dflt))
+            return np.array(out, dtype=dtype)
+        return conv
+    if name == "md5":
+        (f,) = args
+        return lambda cols: np.array(
+            [hashlib.md5(str(v).encode()).hexdigest() for v in f(cols)],
+            dtype=object,
+        )
+    if name == "lit":
+        (f,) = args
+        return f
+    if name == "try":
+        f, fallback = args
+        def try_(cols, f=f, fallback=fallback):
+            try:
+                return f(cols)
+            except Exception:
+                return fallback(cols)
+        return try_
+    raise ValueError(f"unknown function {name!r}")

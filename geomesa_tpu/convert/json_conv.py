@@ -1,0 +1,103 @@
+"""JSON converter (ref: geomesa-convert-json JsonConverter; JsonPath
+subset)."""
+
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+
+from geomesa_tpu.convert.delimited import ConvertResult
+from geomesa_tpu.convert.expression import parse_expression
+from geomesa_tpu.features.batch import FeatureBatch
+
+_PATH = re.compile(r"\.([A-Za-z0-9_]+)|\[(\d+|\*)\]")
+
+
+def json_path(obj, path: str):
+    """Minimal JsonPath: $.a.b[0].c and $.items[*] (one wildcard)."""
+    if not path.startswith("$"):
+        raise ValueError(f"json path must start with $: {path!r}")
+    cur = [obj]
+    for m in _PATH.finditer(path, 1):
+        key, idx = m.group(1), m.group(2)
+        nxt = []
+        for c in cur:
+            if c is None:
+                nxt.append(None)
+            elif key is not None:
+                nxt.append(c.get(key) if isinstance(c, dict) else None)
+            elif idx == "*":
+                nxt.extend(c if isinstance(c, list) else [])
+            else:
+                i = int(idx)
+                nxt.append(c[i] if isinstance(c, list) and i < len(c) else None)
+        cur = nxt
+    return cur
+
+
+class JsonConverter:
+    """fields entries use "json-path" (per-record extraction) and/or
+    "transform" (expression over extracted refs; extracted values bind as
+    ``$name``)."""
+
+    def __init__(self, config: dict, sft):
+        self.sft = sft
+        self.feature_path = config.get("feature-path")  # e.g. $.features[*]
+        self.fields = []
+        for f in config["fields"]:
+            self.fields.append(
+                (
+                    f["name"],
+                    f.get("json-path"),
+                    parse_expression(f["transform"]) if f.get("transform") else None,
+                )
+            )
+        self.id_expr = (
+            parse_expression(config["id-field"]) if config.get("id-field") else None
+        )
+
+    def process(self, text: str) -> ConvertResult:
+        docs = []
+        text = text.strip()
+        if not text:
+            docs = []
+        elif text.startswith("["):
+            docs = json.loads(text)
+        else:
+            # newline-delimited json or a single object
+            try:
+                one = json.loads(text)
+                docs = [one]
+            except json.JSONDecodeError:
+                docs = [json.loads(line) for line in text.splitlines() if line.strip()]
+        if self.feature_path:
+            records = []
+            for d in docs:
+                records.extend(json_path(d, self.feature_path))
+        else:
+            records = docs
+        failed = 0
+        # extract raw values per field
+        raw: dict = {}
+        for name, path, _ in self.fields:
+            if path:
+                vals = []
+                for r in records:
+                    v = json_path(r, path)
+                    vals.append(v[0] if len(v) == 1 else v)
+                raw[name] = np.array(vals, dtype=object)
+        n = len(records)
+        cols = dict(raw)
+        out = {}
+        for name, path, transform in self.fields:
+            if transform is not None:
+                out[name] = transform(cols)
+            elif path is not None:
+                out[name] = raw[name]
+            else:
+                raise ValueError(f"field {name!r} needs json-path or transform")
+        fids = self.id_expr(cols) if self.id_expr else None
+        batch = FeatureBatch.from_columns(self.sft, out, fids)
+        return ConvertResult(batch, len(batch), failed)

@@ -1,0 +1,34 @@
+"""Analytics processes (maps reference geomesa-process WPS + the
+aggregating server-side iterators).
+
+- ``density``:  heatmap rasterization (ref DensityProcess/DensityIterator)
+- ``binexport``: compact 16/24-byte track records (ref BinAggregatingIterator
+                 + utils/bin/BinaryOutputEncoder)
+- ``knn``:      expanding-window k-nearest-neighbors (ref KNearestNeighbor
+                 SearchProcess/KNNQuery)
+- ``sampling``: per-query feature sampling (ref SamplingProcess)
+- ``tube``:     spatio-temporal corridor select (ref TubeSelectProcess)
+- ``statsproc``: Stat-DSL aggregation over query results (ref StatsProcess/
+                 StatsIterator)
+
+Aggregations run as device reductions (scatter-add, segment reductions)
+over the same staged columns the scan kernels use -- the rebuild's version
+of "compute next to the data" (SURVEY.md section 2.6 pushdown row).
+"""
+
+from geomesa_tpu.process.density import density
+from geomesa_tpu.process.binexport import encode_bin, decode_bin
+from geomesa_tpu.process.knn import knn
+from geomesa_tpu.process.sampling import sample
+from geomesa_tpu.process.statsproc import run_stats
+from geomesa_tpu.process.tube import tube_select
+
+__all__ = [
+    "density",
+    "encode_bin",
+    "decode_bin",
+    "knn",
+    "sample",
+    "run_stats",
+    "tube_select",
+]

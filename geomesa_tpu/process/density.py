@@ -1,0 +1,73 @@
+"""Density (heatmap) rasterization.
+
+(ref: geomesa-process .../density/DensityProcess + geomesa-accumulo
+iterators/DensityIterator [UNVERIFIED - empty reference mount]): features in
+the query window are accumulated onto a width x height grid, optionally
+weighted by an attribute. Device path: quantize coordinates to pixel ids and
+scatter-add -- one fused kernel over the resident columns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.geom import Envelope
+
+
+def density(
+    store,
+    type_name: str,
+    query,
+    envelope: Envelope,
+    width: int,
+    height: int,
+    weight_attr: "str | None" = None,
+    use_device: bool = True,
+) -> np.ndarray:
+    """(height, width) float32 grid of (weighted) feature counts."""
+    res = store.query(type_name, query)
+    batch = res.batch
+    if len(batch) == 0:
+        return np.zeros((height, width), dtype=np.float32)
+    x, y = batch.point_coords()
+    w = (
+        batch.column(weight_attr).astype(np.float64)
+        if weight_attr
+        else np.ones(len(batch))
+    )
+    if use_device:
+        return np.asarray(
+            _density_device(x, y, w, envelope, width, height)
+        )
+    return _density_host(x, y, w, envelope, width, height)
+
+
+def _pixel_ids(x, y, env: Envelope, width: int, height: int, xp):
+    sx = width / (env.xmax - env.xmin)
+    sy = height / (env.ymax - env.ymin)
+    px = xp.clip(xp.floor((x - env.xmin) * sx), 0, width - 1)
+    py = xp.clip(xp.floor((y - env.ymin) * sy), 0, height - 1)
+    inside = (x >= env.xmin) & (x <= env.xmax) & (y >= env.ymin) & (y <= env.ymax)
+    return px.astype(xp.int32), py.astype(xp.int32), inside
+
+
+def _density_host(x, y, w, env, width, height) -> np.ndarray:
+    px, py, inside = _pixel_ids(x, y, env, width, height, np)
+    grid = np.zeros(height * width, dtype=np.float64)
+    np.add.at(grid, (py * width + px)[inside], w[inside])
+    return grid.reshape(height, width).astype(np.float32)
+
+
+def _density_device(x, y, w, env, width, height):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(xd, yd, wd):
+        px, py, inside = _pixel_ids(xd, yd, env, width, height, jnp)
+        flat = py * width + px
+        contrib = jnp.where(inside, wd, 0.0).astype(jnp.float32)
+        grid = jnp.zeros(height * width, dtype=jnp.float32)
+        return grid.at[flat].add(contrib).reshape(height, width)
+
+    return kernel(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w))

@@ -1,0 +1,72 @@
+"""k-nearest-neighbors by iterative expanding-window search.
+
+(ref: geomesa-process .../knn/KNearestNeighborSearchProcess + KNNQuery's
+expanding-window algorithm [UNVERIFIED - empty reference mount]): query a
+small bbox around the target; if fewer than k hits, grow the window and
+retry; finish with a confidence pass at the k-th distance radius so no
+closer neighbor outside the last window is missed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+
+
+def _dist_deg(x, y, px, py):
+    """Equirectangular-approx distance in degrees (lat-corrected lon)."""
+    dx = (x - px) * np.cos(np.radians(py))
+    dy = y - py
+    return np.sqrt(dx * dx + dy * dy)
+
+
+def knn(
+    store,
+    type_name: str,
+    px: float,
+    py: float,
+    k: int,
+    base_filter: "ast.Filter | str | None" = None,
+    initial_radius_deg: float = 0.05,
+    max_radius_deg: float = 45.0,
+):
+    """Returns (batch_of_k_nearest, distances_deg), nearest first."""
+    from geomesa_tpu.filter.ecql import parse_ecql
+
+    base = (
+        parse_ecql(base_filter)
+        if isinstance(base_filter, str)
+        else (base_filter or ast.Include)
+    )
+    sft = store.get_schema(type_name)
+    geom = sft.geom_field
+    r = initial_radius_deg
+    batch = None
+    while r <= max_radius_deg:
+        f = ast.And((ast.BBox(geom, px - r, py - r, px + r, py + r), base))
+        res = store.query(type_name, f)
+        if len(res) >= k:
+            batch = res.batch
+            break
+        r *= 2
+    if batch is None:
+        res = store.query(type_name, base)
+        batch = res.batch
+    if len(batch) == 0:
+        return batch, np.array([])
+    x, y = batch.point_coords(geom)
+    d = _dist_deg(x, y, px, py)
+    order = np.argsort(d, kind="stable")[:k]
+    kth = float(d[order[-1]]) if len(order) else 0.0
+    # confidence pass: any point with corrected distance <= kth lies inside
+    # the raw-degree box of half-extents (kth/cos(lat), kth) around the
+    # target -- the k-th circle can poke outside the search window, and the
+    # window's lon extent under-covers because the metric shrinks lon.
+    rx = kth / max(np.cos(np.radians(py)), 0.01)
+    f = ast.And((ast.BBox(geom, px - rx, py - kth, px + rx, py + kth), base))
+    batch = store.query(type_name, f).batch
+    x, y = batch.point_coords(geom)
+    d = _dist_deg(x, y, px, py)
+    order = np.argsort(d, kind="stable")[:k]
+    return batch.take(order), d[order]

@@ -1,0 +1,46 @@
+"""Feature sampling (ref: geomesa-process SamplingProcess + the per-query
+sampling hint honored by the reference's iterators)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def sample(
+    store,
+    type_name: str,
+    query,
+    n: "int | None" = None,
+    fraction: "float | None" = None,
+    by_attr: "str | None" = None,
+    seed: int = 0,
+):
+    """Sample query results: every-nth deterministic thinning to ``n`` or
+    ``fraction``; with ``by_attr``, thinning applies per attribute value
+    (the reference's per-thread track sampling)."""
+    if (n is None) == (fraction is None):
+        raise ValueError("pass exactly one of n / fraction")
+    res = store.query(type_name, query)
+    batch = res.batch
+    m = len(batch)
+    if m == 0:
+        return batch
+    if by_attr is None:
+        keep = _thin(np.arange(m), n, fraction)
+        return batch.take(keep)
+    col = batch.column(by_attr)
+    keep_chunks = []
+    for v in np.unique(col):
+        idx = np.nonzero(col == v)[0]
+        keep_chunks.append(_thin(idx, n, fraction))
+    keep = np.sort(np.concatenate(keep_chunks))
+    return batch.take(keep)
+
+
+def _thin(idx: np.ndarray, n, fraction) -> np.ndarray:
+    m = len(idx)
+    want = n if n is not None else max(1, int(round(m * fraction)))
+    if want >= m:
+        return idx
+    step = m / want
+    return idx[(np.arange(want) * step).astype(np.int64)]

@@ -1,0 +1,14 @@
+"""Stat-DSL aggregation over query results (ref: geomesa-process
+StatsProcess + geomesa-accumulo iterators/StatsIterator)."""
+
+from __future__ import annotations
+
+from geomesa_tpu.stats import SeqStat, parse_stat
+
+
+def run_stats(store, type_name: str, query, stat_spec: str) -> SeqStat:
+    """Evaluate a Stat-DSL spec over the features matching the query."""
+    seq = parse_stat(stat_spec)
+    res = store.query(type_name, query)
+    seq.observe_batch(res.batch)
+    return seq

@@ -1,0 +1,99 @@
+"""Tube select: spatio-temporal corridor search around a track.
+
+(ref: geomesa-process .../tube/TubeSelectProcess [UNVERIFIED - empty
+reference mount]): given a track (ordered points with times), find features
+within ``buffer_deg`` of the track's path AND within ``max_dt_ms`` of the
+track's (interpolated) time at the closest approach -- "who traveled with
+this vessel".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+
+
+def tube_select(
+    store,
+    type_name: str,
+    track_xy: np.ndarray,  # (m, 2) ordered track points
+    track_t_ms: np.ndarray,  # (m,)
+    buffer_deg: float,
+    max_dt_ms: int,
+    base_filter: "ast.Filter | str | None" = None,
+):
+    """Returns the matching FeatureBatch."""
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.filter.ecql import parse_ecql
+
+    base = (
+        parse_ecql(base_filter)
+        if isinstance(base_filter, str)
+        else (base_filter or ast.Include)
+    )
+    sft = store.get_schema(type_name)
+    geom = sft.geom_field
+    dtg = sft.dtg_field
+    track_xy = np.asarray(track_xy, dtype=np.float64)
+    track_t = np.asarray(track_t_ms, dtype=np.int64)
+
+    # coarse pass: one bbox+time query per track segment (the reference's
+    # per-bin tube queries), unioned
+    chunks = []
+    seen = set()
+    for i in range(len(track_xy) - 1):
+        (x0, y0), (x1, y1) = track_xy[i], track_xy[i + 1]
+        f = ast.And(
+            (
+                ast.BBox(
+                    geom,
+                    min(x0, x1) - buffer_deg,
+                    min(y0, y1) - buffer_deg,
+                    max(x0, x1) + buffer_deg,
+                    max(y0, y1) + buffer_deg,
+                ),
+                ast.During(
+                    dtg,
+                    int(min(track_t[i], track_t[i + 1]) - max_dt_ms),
+                    int(max(track_t[i], track_t[i + 1]) + max_dt_ms),
+                ),
+                base,
+            )
+        )
+        b = store.query(type_name, f).batch
+        if len(b):
+            chunks.append(b)
+    if not chunks:
+        return store.query(type_name, ast.Exclude).batch
+    merged = chunks[0] if len(chunks) == 1 else FeatureBatch.concat(chunks)
+    # dedupe by fid
+    _, first = np.unique(merged.fids, return_index=True)
+    merged = merged.take(np.sort(first))
+
+    # fine pass: exact distance to the nearest segment + time consistency
+    x, y = merged.point_coords(geom)
+    t = merged.column(dtg)
+    ok = np.zeros(len(merged), dtype=bool)
+    best = np.full(len(merged), np.inf)
+    for i in range(len(track_xy) - 1):
+        d, frac = _point_segment_dist(
+            x, y, *track_xy[i], *track_xy[i + 1]
+        )
+        seg_t = track_t[i] + frac * (track_t[i + 1] - track_t[i])
+        cand = (d <= buffer_deg) & (np.abs(t - seg_t) <= max_dt_ms) & (d < best)
+        ok |= cand
+        best = np.where(cand, d, best)
+    return merged.take(np.nonzero(ok)[0])
+
+
+def _point_segment_dist(px, py, x0, y0, x1, y1):
+    """Distance from points to a segment + projection fraction [0, 1]."""
+    dx, dy = x1 - x0, y1 - y0
+    L2 = dx * dx + dy * dy
+    if L2 == 0:
+        d = np.sqrt((px - x0) ** 2 + (py - y0) ** 2)
+        return d, np.zeros_like(px)
+    frac = np.clip(((px - x0) * dx + (py - y0) * dy) / L2, 0.0, 1.0)
+    cx, cy = x0 + frac * dx, y0 + frac * dy
+    return np.sqrt((px - cx) ** 2 + (py - cy) ** 2), frac

@@ -1,0 +1,32 @@
+"""Streaming stats sketches + the Stat DSL (maps reference stats stack).
+
+(ref: geomesa-utils .../stats/Stat.scala MinMax/TopK/Frequency/Z3Histogram +
+geomesa-index-api .../stats/GeoMesaStats [UNVERIFIED - empty reference
+mount]). Sketches summarize written data; the planner uses them for
+selectivity-based strategy costing and the CLI surfaces them (stats-*
+commands). All sketches are mergeable (distributed ingest folds partial
+sketches) and serializable to JSON for store metadata.
+"""
+
+from geomesa_tpu.stats.sketches import (
+    Cardinality,
+    CountStat,
+    Frequency,
+    Histogram,
+    MinMax,
+    TopK,
+    Z3HistogramStat,
+)
+from geomesa_tpu.stats.dsl import parse_stat, SeqStat
+
+__all__ = [
+    "MinMax",
+    "CountStat",
+    "Cardinality",
+    "TopK",
+    "Frequency",
+    "Histogram",
+    "Z3HistogramStat",
+    "parse_stat",
+    "SeqStat",
+]

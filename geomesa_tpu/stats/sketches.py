@@ -1,0 +1,356 @@
+"""Mergeable streaming sketches.
+
+Vectorized ``observe(values)`` over numpy columns (the write-path
+StatUpdater analog); ``merge`` folds partials from distributed ingest;
+``to_json``/``from_json`` round-trip for store metadata persistence.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+def _hash64(values: np.ndarray) -> np.ndarray:
+    """Stable 64-bit hashes of arbitrary values (vectorized-ish)."""
+    if values.dtype.kind in "iuf":
+        # splitmix64 over the bit pattern
+        h = values.astype(np.int64).view(np.uint64).copy()
+        h ^= h >> np.uint64(30)
+        h *= np.uint64(0xBF58476D1CE4E5B9)
+        h ^= h >> np.uint64(27)
+        h *= np.uint64(0x94D049BB133111EB)
+        h ^= h >> np.uint64(31)
+        return h
+    out = np.empty(len(values), dtype=np.uint64)
+    for i, v in enumerate(values):
+        out[i] = np.uint64(
+            int.from_bytes(
+                hashlib.blake2b(str(v).encode(), digest_size=8).digest(), "little"
+            )
+        )
+    return out
+
+
+def _bit_length(x: np.ndarray) -> np.ndarray:
+    """Exact vectorized bit_length for uint64 lanes."""
+    x = x.astype(np.uint64).copy()
+    bl = np.zeros(x.shape, dtype=np.uint64)
+    for s in (32, 16, 8, 4, 2, 1):
+        y = x >> np.uint64(s)
+        m = y != 0
+        bl += np.where(m, np.uint64(s), np.uint64(0))
+        x = np.where(m, y, x)
+    return bl + (x != 0).astype(np.uint64)
+
+
+class Stat:
+    """Base: observe / merge / value / json."""
+
+    def observe(self, values: np.ndarray) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def merge(self, other: "Stat") -> "Stat":  # pragma: no cover
+        raise NotImplementedError
+
+    def to_json(self) -> dict:  # pragma: no cover
+        raise NotImplementedError
+
+
+@dataclass
+class CountStat(Stat):
+    count: int = 0
+
+    def observe(self, values):
+        self.count += len(values)
+
+    def merge(self, other):
+        self.count += other.count
+        return self
+
+    @property
+    def value(self):
+        return self.count
+
+    def to_json(self):
+        return {"type": "count", "count": self.count}
+
+
+@dataclass
+class MinMax(Stat):
+    attr: str
+    min: "float | None" = None
+    max: "float | None" = None
+    count: int = 0
+
+    def observe(self, values):
+        v = np.asarray(values)
+        if len(v) == 0:
+            return
+        self.count += len(v)
+        lo, hi = v.min(), v.max()
+        lo = lo.item() if hasattr(lo, "item") else lo
+        hi = hi.item() if hasattr(hi, "item") else hi
+        self.min = lo if self.min is None else min(self.min, lo)
+        self.max = hi if self.max is None else max(self.max, hi)
+
+    def merge(self, other):
+        if other.min is not None:
+            self.observe(np.array([other.min, other.max]))
+            self.count += other.count - 2
+        return self
+
+    @property
+    def bounds(self):
+        return (self.min, self.max)
+
+    def to_json(self):
+        return {
+            "type": "minmax",
+            "attr": self.attr,
+            "min": self.min,
+            "max": self.max,
+            "count": self.count,
+        }
+
+
+@dataclass
+class Cardinality(Stat):
+    """HyperLogLog distinct-count (ref Stat.Cardinality backed by HLL++)."""
+
+    attr: str
+    p: int = 12  # 2^12 registers -> ~1.6% error
+    registers: np.ndarray = None
+
+    def __post_init__(self):
+        if self.registers is None:
+            self.registers = np.zeros(1 << self.p, dtype=np.uint8)
+
+    def observe(self, values):
+        v = np.asarray(values)
+        if len(v) == 0:
+            return
+        h = _hash64(v)
+        idx = (h >> np.uint64(64 - self.p)).astype(np.int64)
+        rest = h << np.uint64(self.p)
+        # rank = leading zeros of the (64-p)-bit remainder + 1; exact
+        # branchless bit_length (float log2 rounds at power-of-two edges)
+        lz = np.uint64(64) - _bit_length(rest)
+        rank = np.minimum(lz + np.uint64(1), np.uint64(64 - self.p + 1))
+        np.maximum.at(self.registers, idx, rank.astype(np.uint8))
+
+    def merge(self, other):
+        np.maximum(self.registers, other.registers, out=self.registers)
+        return self
+
+    @property
+    def estimate(self) -> float:
+        m = float(len(self.registers))
+        alpha = 0.7213 / (1 + 1.079 / m)
+        inv = np.power(2.0, -self.registers.astype(np.float64))
+        e = alpha * m * m / inv.sum()
+        zeros = int((self.registers == 0).sum())
+        if e <= 2.5 * m and zeros:
+            e = m * np.log(m / zeros)  # linear counting for small n
+        return float(e)
+
+    def to_json(self):
+        import base64
+
+        return {
+            "type": "cardinality",
+            "attr": self.attr,
+            "p": self.p,
+            "registers": base64.b64encode(self.registers.tobytes()).decode(),
+        }
+
+
+@dataclass
+class TopK(Stat):
+    """Space-saving top-k heavy hitters (ref Stat.TopK)."""
+
+    attr: str
+    k: int = 10
+    counters: dict = field(default_factory=dict)
+
+    def observe(self, values):
+        vals, counts = np.unique(np.asarray(values), return_counts=True)
+        for v, c in zip(vals.tolist(), counts.tolist()):
+            if v in self.counters:
+                self.counters[v] += c
+            elif len(self.counters) < self.k * 4:
+                self.counters[v] = c
+            else:
+                victim = min(self.counters, key=self.counters.get)
+                base = self.counters.pop(victim)
+                self.counters[v] = base + c
+
+    def merge(self, other):
+        for v, c in other.counters.items():
+            self.counters[v] = self.counters.get(v, 0) + c
+        return self
+
+    @property
+    def topk(self):
+        return sorted(self.counters.items(), key=lambda kv: -kv[1])[: self.k]
+
+    def to_json(self):
+        return {
+            "type": "topk",
+            "attr": self.attr,
+            "k": self.k,
+            "counters": {str(k): v for k, v in self.topk},
+        }
+
+
+@dataclass
+class Frequency(Stat):
+    """Count-min sketch (ref Stat.Frequency)."""
+
+    attr: str
+    depth: int = 4
+    width: int = 1 << 12
+    table: np.ndarray = None
+
+    def __post_init__(self):
+        if self.table is None:
+            self.table = np.zeros((self.depth, self.width), dtype=np.int64)
+
+    def observe(self, values):
+        v = np.asarray(values)
+        if len(v) == 0:
+            return
+        h = _hash64(v)
+        for d in range(self.depth):
+            # derive row hash: xor-fold with row-salt splitmix step
+            hd = h ^ (np.uint64(0x9E3779B97F4A7C15) * np.uint64(d + 1))
+            idx = (hd % np.uint64(self.width)).astype(np.int64)
+            np.add.at(self.table[d], idx, 1)
+
+    def count(self, value) -> int:
+        h = _hash64(np.array([value]))
+        est = []
+        for d in range(self.depth):
+            hd = h ^ (np.uint64(0x9E3779B97F4A7C15) * np.uint64(d + 1))
+            est.append(int(self.table[d][int(hd[0] % np.uint64(self.width))]))
+        return min(est)
+
+    def merge(self, other):
+        self.table += other.table
+        return self
+
+    def to_json(self):
+        return {
+            "type": "frequency",
+            "attr": self.attr,
+            "depth": self.depth,
+            "width": self.width,
+            "total": int(self.table[0].sum()),
+        }
+
+
+@dataclass
+class Histogram(Stat):
+    """Fixed-bin histogram over [lo, hi] (ref Stat.Histogram); also the
+    device-reduction path (jnp scatter-add) used by density/stats queries."""
+
+    attr: str
+    bins: int
+    lo: float
+    hi: float
+    counts: np.ndarray = None
+
+    def __post_init__(self):
+        if self.counts is None:
+            self.counts = np.zeros(self.bins, dtype=np.int64)
+
+    def bin_of(self, values):
+        v = np.asarray(values, dtype=np.float64)
+        scale = self.bins / (self.hi - self.lo) if self.hi > self.lo else 0.0
+        idx = np.floor((v - self.lo) * scale).astype(np.int64)
+        return np.clip(idx, 0, self.bins - 1)
+
+    def observe(self, values):
+        v = np.asarray(values)
+        if len(v) == 0:
+            return
+        np.add.at(self.counts, self.bin_of(v), 1)
+
+    def merge(self, other):
+        self.counts += other.counts
+        return self
+
+    def selectivity(self, lo: float, hi: float) -> float:
+        """Estimated fraction of values in [lo, hi] (planner costing), with
+        linear interpolation inside the boundary bins."""
+        total = int(self.counts.sum())
+        if total == 0 or self.hi <= self.lo:
+            return 1.0
+        width = (self.hi - self.lo) / self.bins
+        b0, b1 = int(self.bin_of(lo)), int(self.bin_of(hi))
+        if b0 == b1:
+            frac = min(hi, self.hi) - max(lo, self.lo)
+            return float(self.counts[b0]) * max(frac, 0) / width / total
+        acc = float(self.counts[b0 + 1 : b1].sum())
+        lo_edge = self.lo + (b0 + 1) * width
+        acc += float(self.counts[b0]) * np.clip((lo_edge - lo) / width, 0, 1)
+        hi_edge = self.lo + b1 * width
+        acc += float(self.counts[b1]) * np.clip((hi - hi_edge) / width, 0, 1)
+        return acc / total
+
+    def to_json(self):
+        return {
+            "type": "histogram",
+            "attr": self.attr,
+            "bins": self.bins,
+            "lo": self.lo,
+            "hi": self.hi,
+            "counts": self.counts.tolist(),
+        }
+
+
+@dataclass
+class Z3HistogramStat(Stat):
+    """Coarse spatio-temporal occupancy histogram keyed by (bin, z-prefix)
+    (ref Stat.Z3Histogram): drives spatial selectivity estimates."""
+
+    geom_attr: str
+    dtg_attr: str
+    period: str = "week"
+    prefix_bits: int = 12
+    counts: dict = field(default_factory=dict)
+
+    def observe_xyt(self, x, y, t_ms):
+        from geomesa_tpu.curves import Z3SFC, TimePeriod
+        from geomesa_tpu.curves.binnedtime import to_binned_time
+
+        sfc = Z3SFC(TimePeriod.parse(self.period))
+        b, off = to_binned_time(np.asarray(t_ms), self.period)
+        z = sfc.index(x, y, off)
+        key = (np.asarray(b).astype(np.int64) << np.int64(self.prefix_bits)) | (
+            z >> np.uint64(63 - self.prefix_bits)
+        ).astype(np.int64)
+        vals, cnts = np.unique(key, return_counts=True)
+        for k, c in zip(vals.tolist(), cnts.tolist()):
+            self.counts[k] = self.counts.get(k, 0) + c
+
+    def observe(self, values):  # pragma: no cover - use observe_xyt
+        raise TypeError("Z3Histogram observes (x, y, t) triples")
+
+    def merge(self, other):
+        for k, c in other.counts.items():
+            self.counts[k] = self.counts.get(k, 0) + c
+        return self
+
+    def to_json(self):
+        return {
+            "type": "z3histogram",
+            "geom": self.geom_attr,
+            "dtg": self.dtg_attr,
+            "period": self.period,
+            "prefix_bits": self.prefix_bits,
+            "nonzero": len(self.counts),
+            "total": sum(self.counts.values()),
+        }

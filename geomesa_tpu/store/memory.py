@@ -30,6 +30,7 @@ class _TypeState:
     data: "FeatureBatch | None" = None
     indices: "dict[str, BuiltIndex]" = field(default_factory=dict)
     data_interval: "tuple[int, int] | None" = None
+    stats: object = None  # SeqStat maintained at flush (GeoMesaStats analog)
 
 
 class MemoryDataStore:
@@ -110,22 +111,68 @@ class MemoryDataStore:
             if dtg is not None and len(st.data):
                 d = st.data.column(dtg)
                 st.data_interval = (int(d.min()), int(d.max()))
+            st.stats = self._build_stats(st)
+
+    def _build_stats(self, st: _TypeState):
+        """Write-time stats (ref MetadataBackedStats/StatUpdater): count,
+        MinMax per numeric/date attribute, Z3Histogram for point+time
+        schemas. Used by the stats API/CLI and selectivity estimates."""
+        from geomesa_tpu.stats import SeqStat
+        from geomesa_tpu.stats.sketches import (
+            CountStat,
+            MinMax,
+            Z3HistogramStat,
+        )
+
+        stats: list = [CountStat()]
+        for a in st.sft.attributes:
+            if a.column_dtype is not None and a.column_dtype != np.bool_:
+                stats.append(MinMax(a.name))
+        geom, dtg = st.sft.geom_field, st.sft.dtg_field
+        if geom and dtg and st.sft.descriptor(geom).is_point:
+            stats.append(Z3HistogramStat(geom, dtg, st.sft.z3_interval))
+        seq = SeqStat(stats)
+        if st.data is not None and len(st.data):
+            seq.observe_batch(st.data)
+        return seq
+
+    def stats(self, type_name: str):
+        """The maintained SeqStat for a type (ref GeoMesaStats.getStats).
+        Always returns a SeqStat (zero-observation sketches before any
+        write)."""
+        st = self._state(type_name)
+        self._flush(st)
+        if st.stats is None:
+            st.stats = self._build_stats(st)
+        return st.stats
 
     # -- queries -----------------------------------------------------------
 
     def plan(self, type_name: str, query: "Query | str | ast.Filter") -> QueryPlan:
+        """Plan a query; on an empty type plans against the schema's default
+        key spaces so filter errors surface and explain() works uniformly."""
         st = self._state(type_name)
         self._flush(st)
         q = _as_query(query)
-        if st.data is None or not st.indices:
-            raise ValueError(f"no data written to {type_name!r}")
-        return plan_query(
-            st.sft, st.indices, q, data_interval=st.data_interval
-        )
+        indices = st.indices or {
+            name: keyspace_for(st.sft, name) for name in default_indices(st.sft)
+        }
+        return plan_query(st.sft, indices, q, data_interval=st.data_interval)
 
     def query(self, type_name: str, query: "Query | str | ast.Filter" = ast.Include) -> QueryResult:
-        plan = self.plan(type_name, query)
+        plan = self.plan(type_name, query)  # flushes
         st = self._state(type_name)
+        if st.data is None or len(st.data) == 0:
+            from geomesa_tpu.query.runner import _post_process
+
+            empty = (
+                st.data
+                if st.data is not None
+                else FeatureBatch.from_columns(
+                    st.sft, {a.name: [] for a in st.sft.attributes}
+                )
+            )
+            return QueryResult(_post_process(empty, plan), plan, 0, 0)
         return run_query(st.indices[plan.index_name], plan)
 
     def explain(self, type_name: str, query: "Query | str | ast.Filter") -> str:

@@ -1,0 +1,25 @@
+"""Streaming 'live layer' (maps reference geomesa-kafka + geomesa-lambda).
+
+- ``log``:    an ordered feature-change log with replay (the Kafka topic +
+              GeoMessageSerializer role, broker-less for embedding/tests;
+              a real broker can implement the same append/subscribe shape)
+- ``live``:   LiveFeatureStore -- current-state in-memory cache fed by a
+              log consumer: continuous-query listeners, feature expiry,
+              spatial queries against the live state
+              (ref: KafkaDataStore + KafkaFeatureCache + KafkaCacheLoader)
+- ``lambda_store``: transient (live) + persistent store merge with age-off
+              persistence (ref: geomesa-lambda LambdaDataStore)
+"""
+
+from geomesa_tpu.stream.log import FeatureLog, Put, Remove, Clear
+from geomesa_tpu.stream.live import LiveFeatureStore
+from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+__all__ = [
+    "FeatureLog",
+    "Put",
+    "Remove",
+    "Clear",
+    "LiveFeatureStore",
+    "LambdaDataStore",
+]

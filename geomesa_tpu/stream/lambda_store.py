@@ -1,0 +1,70 @@
+"""Lambda-architecture store: live transient layer + durable indexed layer.
+
+(ref: geomesa-lambda LambdaDataStore / TransientStore / PersistEvictor
+[UNVERIFIED - empty reference mount]): writes land in the live layer
+(immediately queryable); a persist pass moves features older than
+``persist_after_ms`` into the durable store (here: MemoryDataStore or
+FileSystemDataStore); queries merge both, transient state winning per fid.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable
+
+import numpy as np
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.filter import ast
+from geomesa_tpu.stream.live import LiveFeatureStore
+
+
+class LambdaDataStore:
+    def __init__(
+        self,
+        persistent,
+        type_name: str,
+        persist_after_ms: int = 60_000,
+        clock: Callable = lambda: int(_time.time() * 1000),
+    ):
+        self.persistent = persistent
+        self.type_name = type_name
+        self.sft: SimpleFeatureType = persistent.get_schema(type_name)
+        self.live = LiveFeatureStore(self.sft, clock=clock)
+        self.persist_after_ms = persist_after_ms
+        self.clock = clock
+
+    def write(self, columns: dict, fids) -> None:
+        self.live.put(columns, fids)
+
+    def persist(self) -> int:
+        """Move live features older than the threshold into the durable
+        store (the PersistEvictor run). Returns how many moved."""
+        cutoff = self.clock() - self.persist_after_ms
+        old = self.live._written_ms < cutoff
+        if not np.any(old):
+            return 0
+        batch = self.live._batch.take(np.nonzero(old)[0])
+        # durable upsert: replace any prior persisted version of these fids
+        self.persistent.delete(self.type_name, batch.fids)
+        self.persistent.write(self.type_name, batch)
+        self.live.remove(batch.fids)
+        return len(batch)
+
+    def query(self, filt: "ast.Filter | str" = ast.Include) -> FeatureBatch:
+        """Merged view: live wins per fid (it is strictly newer)."""
+        live = self.live.query(filt)
+        persisted = self.persistent.query(self.type_name, filt).batch
+        if len(persisted) == 0:
+            return live
+        if len(live) == 0:
+            return persisted
+        shadowed = np.isin(persisted.fids, live.fids)
+        merged = FeatureBatch.concat(
+            [live, persisted.take(np.nonzero(~shadowed)[0])]
+        )
+        return merged
+
+    def count(self, filt: "ast.Filter | str" = ast.Include) -> int:
+        return len(self.query(filt))

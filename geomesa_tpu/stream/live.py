@@ -1,0 +1,166 @@
+"""Live feature store: current-state cache over a feature log.
+
+(ref: geomesa-kafka KafkaDataStore consumer side -- KafkaFeatureCache
+(latest state per feature id, spatially queryable) + KafkaCacheLoader
+(applies the message stream) + FeatureListener continuous queries + feature
+expiry [UNVERIFIED - empty reference mount]).
+
+State is columnar: a FeatureBatch rebuilt incrementally with an fid->row
+map; queries evaluate the exact host filter over the live batch (live
+layers hold "recent hot" data -- small relative to the indexed store, so a
+full scan of the live set matches the reference's in-memory cache query
+model)."""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable
+
+import numpy as np
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.compile import evaluate_host
+from geomesa_tpu.filter.ecql import parse_ecql
+from geomesa_tpu.stream.log import Clear, FeatureLog, Put, Remove
+
+
+class LiveFeatureStore:
+    """Consume a FeatureLog into a queryable current-state cache."""
+
+    def __init__(
+        self,
+        sft: SimpleFeatureType,
+        log: "FeatureLog | None" = None,
+        expiry_ms: "int | None" = None,
+        clock: Callable = lambda: int(_time.time() * 1000),
+    ):
+        self.sft = sft
+        # explicit None check: an empty FeatureLog is falsy (__len__ == 0)
+        self.log = log if log is not None else FeatureLog()
+        self.expiry_ms = expiry_ms
+        self.clock = clock
+        self._batch = FeatureBatch.from_columns(
+            sft, {a.name: [] for a in sft.attributes}, fids=np.array([], dtype=object)
+        )
+        self._row_of: dict = {}
+        self._written_ms: np.ndarray = np.array([], dtype=np.int64)
+        self._listeners: list = []
+        self._offset = 0
+        self.replay()
+        self.log.subscribe(self._on_message)
+
+    # -- log application ---------------------------------------------------
+
+    def replay(self) -> None:
+        """Rebuild state from the log (crash recovery; ref cache rebuild
+        from topic replay)."""
+        for msg in self.log.read_from(self._offset):
+            self._apply(msg)
+            self._offset += 1
+
+    def _on_message(self, offset: int, msg) -> None:
+        if offset < self._offset:
+            return
+        self._apply(msg)
+        self._offset = offset + 1
+
+    def _apply(self, msg) -> None:
+        if isinstance(msg, Put):
+            batch = FeatureBatch.from_columns(self.sft, msg.columns, msg.fids)
+            self._upsert(batch)
+        elif isinstance(msg, Remove):
+            self._remove(np.asarray(msg.fids))
+        elif isinstance(msg, Clear):
+            self._rebuild(self._batch.take(np.array([], dtype=np.int64)))
+        for cb in self._listeners:
+            cb(msg)
+
+    def _upsert(self, batch: FeatureBatch) -> None:
+        now = self.clock()
+        incoming = np.asarray(batch.fids)
+        existing_rows = np.array(
+            [self._row_of.get(f, -1) for f in incoming.tolist()], dtype=np.int64
+        )
+        fresh = existing_rows < 0
+        # in-place update for known fids
+        if np.any(~fresh):
+            rows = existing_rows[~fresh]
+            src = np.nonzero(~fresh)[0]
+            for name in self._batch.columns:
+                self._batch.columns[name][rows] = batch.columns[name][src]
+            self._written_ms[rows] = now
+        if np.any(fresh):
+            src = np.nonzero(fresh)[0]
+            add = batch.take(src)
+            base = len(self._batch)
+            merged = (
+                add
+                if base == 0
+                else FeatureBatch.concat([self._batch, add])
+            )
+            self._written_ms = np.concatenate(
+                [self._written_ms, np.full(len(add), now, dtype=np.int64)]
+            )
+            self._batch = merged
+            for i, f in enumerate(add.fids.tolist()):
+                self._row_of[f] = base + i
+
+    def _remove(self, fids: np.ndarray) -> None:
+        rows = [self._row_of[f] for f in fids.tolist() if f in self._row_of]
+        if not rows:
+            return
+        keep = np.ones(len(self._batch), dtype=bool)
+        keep[rows] = False
+        self._written_ms = self._written_ms[keep]
+        self._rebuild(self._batch.take(np.nonzero(keep)[0]))
+
+    def _rebuild(self, batch: FeatureBatch) -> None:
+        self._batch = batch
+        self._row_of = {f: i for i, f in enumerate(batch.fids.tolist())}
+        if len(batch) != len(self._written_ms):
+            self._written_ms = np.full(len(batch), self.clock(), dtype=np.int64)
+
+    def _expire(self) -> None:
+        if self.expiry_ms is None or len(self._batch) == 0:
+            return
+        cutoff = self.clock() - self.expiry_ms
+        dead = self._written_ms < cutoff
+        if np.any(dead):
+            self._written_ms = self._written_ms[~dead]
+            self._rebuild(self._batch.take(np.nonzero(~dead)[0]))
+
+    # -- write-side convenience (producer role) ----------------------------
+
+    def put(self, columns: dict, fids) -> None:
+        self.log.append(Put(columns, np.asarray(fids)))
+
+    def remove(self, fids) -> None:
+        self.log.append(Remove(np.asarray(fids)))
+
+    def clear(self) -> None:
+        self.log.append(Clear())
+
+    # -- queries & CQ ------------------------------------------------------
+
+    def query(self, filt: "ast.Filter | str" = ast.Include) -> FeatureBatch:
+        self._expire()
+        f = parse_ecql(filt) if isinstance(filt, str) else filt
+        if len(self._batch) == 0:
+            return self._batch
+        mask = evaluate_host(f, self._batch)
+        return self._batch.take(np.nonzero(mask)[0])
+
+    def snapshot(self) -> FeatureBatch:
+        self._expire()
+        return self._batch
+
+    def __len__(self) -> int:
+        self._expire()
+        return len(self._batch)
+
+    def add_listener(self, callback: Callable) -> None:
+        """Continuous query: callback(message) after each applied change
+        (ref FeatureListener events)."""
+        self._listeners.append(callback)

@@ -1,0 +1,67 @@
+"""Feature-change log: ordered Put/Remove/Clear messages with replay.
+
+(ref: geomesa-kafka GeoMessageSerializer's message model [UNVERIFIED -
+empty reference mount]). The in-memory implementation is the embedded
+broker for tests and single-process pipelines; the consumer contract
+(append / read_from / subscribe) is what a Kafka-backed implementation
+would satisfy. Recovery = replay from offset 0 (the reference's cache
+rebuild from topic replay, SURVEY.md section 5 failure model).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Put:
+    """Upsert a batch of features (columns keyed by attribute)."""
+
+    columns: dict
+    fids: np.ndarray
+
+
+@dataclass(frozen=True)
+class Remove:
+    fids: np.ndarray
+
+
+@dataclass(frozen=True)
+class Clear:
+    pass
+
+
+@dataclass
+class FeatureLog:
+    """Append-only ordered log with offset-based reads."""
+
+    messages: list = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _subscribers: list = field(default_factory=list, repr=False)
+
+    def append(self, msg) -> int:
+        with self._lock:
+            self.messages.append(msg)
+            offset = len(self.messages) - 1
+            subs = list(self._subscribers)
+        for cb in subs:
+            cb(offset, msg)
+        return offset
+
+    def read_from(self, offset: int = 0) -> list:
+        with self._lock:
+            return self.messages[offset:]
+
+    def subscribe(self, callback: Callable) -> None:
+        """callback(offset, message) on every append (delivered inline --
+        the single-process analog of a consumer thread)."""
+        with self._lock:
+            self._subscribers.append(callback)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.messages)

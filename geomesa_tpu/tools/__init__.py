@@ -1,0 +1,10 @@
+"""CLI tools (maps reference geomesa-tools).
+
+``python -m geomesa_tpu.tools <command>`` or the ``geomesa-tpu`` script.
+(ref: geomesa-tools Runner + command classes: create-schema, ingest,
+export, explain, stats-*, get-sfts [UNVERIFIED - empty reference mount]).
+"""
+
+from geomesa_tpu.tools.cli import main
+
+__all__ = ["main"]

@@ -1,0 +1,4 @@
+from geomesa_tpu.tools.cli import main
+
+if __name__ == "__main__":
+    main()

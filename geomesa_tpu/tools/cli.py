@@ -1,0 +1,296 @@
+"""The geomesa-tpu command line.
+
+Commands mirror the reference CLI surface (ref: geomesa-tools
+Runner/IngestCommand/ExportCommand/ExplainCommand/StatsCommand):
+
+    geomesa-tpu create-schema  --root DIR -f NAME -s SPEC
+    geomesa-tpu get-sfts       --root DIR
+    geomesa-tpu describe-schema --root DIR -f NAME
+    geomesa-tpu remove-schema  --root DIR -f NAME
+    geomesa-tpu ingest         --root DIR -f NAME -C converter.json FILES...
+    geomesa-tpu export         --root DIR -f NAME [-q CQL] [-F fmt] [-o out]
+    geomesa-tpu explain        --root DIR -f NAME -q CQL
+    geomesa-tpu count          --root DIR -f NAME [-q CQL]
+    geomesa-tpu stats          --root DIR -f NAME -s STAT_SPEC [-q CQL]
+
+The store root is a FileSystemDataStore directory (Parquet partitions +
+manifests); --root defaults to $GEOMESA_TPU_ROOT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _store(args):
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    root = args.root or os.environ.get("GEOMESA_TPU_ROOT")
+    if not root:
+        sys.exit("error: --root (or $GEOMESA_TPU_ROOT) is required")
+    return FileSystemDataStore(root)
+
+
+def cmd_create_schema(args):
+    store = _store(args)
+    sft = store.create_schema(args.feature_name, args.spec)
+    print(f"created schema {sft.type_name!r} with {len(sft.attributes)} attributes")
+
+
+def cmd_get_sfts(args):
+    store = _store(args)
+    for name in store.type_names:
+        print(name)
+
+
+def cmd_describe_schema(args):
+    store = _store(args)
+    sft = store.get_schema(args.feature_name)
+    print(f"{sft.type_name}:")
+    for a in sft.attributes:
+        marks = []
+        if a.default_geom or (a.is_geometry and a.name == sft.geom_field):
+            marks.append("default geometry")
+        if a.name == sft.dtg_field:
+            marks.append("default dtg")
+        if a.indexed:
+            marks.append("indexed")
+        suffix = f"  ({', '.join(marks)})" if marks else ""
+        print(f"  {a.name}: {a.type_name}{suffix}")
+    if sft.user_data:
+        print("user data:")
+        for k, v in sft.user_data.items():
+            print(f"  {k}={v}")
+
+
+def cmd_remove_schema(args):
+    import shutil
+
+    store = _store(args)
+    if args.feature_name not in store.type_names:
+        sys.exit(f"error: no schema {args.feature_name!r}")
+    shutil.rmtree(os.path.join(store.root, args.feature_name))
+    print(f"removed {args.feature_name!r}")
+
+
+def cmd_ingest(args):
+    from geomesa_tpu.convert import converter_for
+
+    store = _store(args)
+    sft = store.get_schema(args.feature_name)
+    with open(args.converter) as fh:
+        config = json.load(fh)
+    conv = converter_for(config, sft)
+    total = failed = 0
+    for path in args.files:
+        with open(path) as fh:
+            res = conv.process(fh.read())
+        store.write(args.feature_name, res.batch)
+        total += res.success
+        failed += res.failed
+        print(f"  {path}: {res.success} ingested, {res.failed} failed")
+    store.flush(args.feature_name)
+    print(f"ingested {total} features ({failed} failed)")
+
+
+def cmd_export(args):
+    from geomesa_tpu.query.plan import Query
+
+    store = _store(args)
+    q = Query(
+        filter=args.cql or "INCLUDE",
+        max_features=args.max_features,
+        properties=args.attributes.split(",") if args.attributes else None,
+    )
+    res = store.query(args.feature_name, q)
+    batch = res.batch
+    out = args.output
+    fmt = args.format
+    if fmt == "csv":
+        _export_csv(batch, out)
+    elif fmt == "json":
+        _export_geojson(batch, out)
+    elif fmt == "arrow":
+        import pyarrow as pa
+
+        table = batch.to_arrow()
+        with pa.OSFile(out, "wb") as sink:
+            with pa.ipc.new_file(sink, table.schema) as w:
+                w.write_table(table)
+    elif fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(batch.to_arrow(), out)
+    elif fmt == "bin":
+        from geomesa_tpu.process import encode_bin
+
+        if not args.track_attr:
+            sys.exit("error: --track-attr required for bin export")
+        data = encode_bin(batch, args.track_attr, sort=True)
+        with open(out, "wb") as fh:
+            fh.write(data)
+    else:
+        sys.exit(f"error: unknown format {fmt!r}")
+    print(f"exported {len(batch)} features to {out} ({fmt})")
+
+
+def _export_csv(batch, out):
+    import contextlib
+    import csv
+
+    geom = batch.sft.geom_field
+    # nullcontext so '-' does not close sys.stdout on block exit
+    cm = (
+        open(out, "w", newline="")
+        if out != "-"
+        else contextlib.nullcontext(sys.stdout)
+    )
+    with cm as fh:
+        w = csv.writer(fh)
+        names = batch.sft.attribute_names
+        w.writerow(["fid", *names])
+        cols = []
+        for name in names:
+            c = batch.columns[name]
+            if name == geom and c.dtype != object:
+                from geomesa_tpu.geom import Point, to_wkt
+
+                cols.append([to_wkt(Point(float(x), float(y))) for x, y in c])
+            elif c.dtype != object and batch.sft.descriptor(name).type_name == "Date":
+                import numpy as np
+
+                cols.append(
+                    np.array(c, dtype="datetime64[ms]").astype(str).tolist()
+                )
+            elif c.dtype == object and batch.sft.descriptor(name).is_geometry:
+                from geomesa_tpu.geom import to_wkt
+
+                cols.append([to_wkt(g) for g in c])
+            else:
+                cols.append(c.tolist())
+        for i in range(len(batch)):
+            w.writerow([batch.fids[i], *(col[i] for col in cols)])
+
+
+def _export_geojson(batch, out):
+    import numpy as np
+
+    geom = batch.sft.geom_field
+    features = []
+    for i in range(len(batch)):
+        props = {}
+        geometry = None
+        for name in batch.sft.attribute_names:
+            c = batch.columns[name]
+            desc = batch.sft.descriptor(name)
+            if name == geom:
+                if c.dtype != object:
+                    geometry = {
+                        "type": "Point",
+                        "coordinates": [float(c[i, 0]), float(c[i, 1])],
+                    }
+                else:
+                    from geomesa_tpu.geom import to_wkt
+
+                    geometry = {"wkt": to_wkt(c[i])}
+            elif desc.type_name == "Date":
+                props[name] = str(np.datetime64(int(c[i]), "ms"))
+            else:
+                v = c[i]
+                props[name] = v.item() if hasattr(v, "item") else v
+        features.append(
+            {
+                "type": "Feature",
+                "id": str(batch.fids[i]),
+                "geometry": geometry,
+                "properties": props,
+            }
+        )
+    doc = {"type": "FeatureCollection", "features": features}
+    if out == "-":
+        json.dump(doc, sys.stdout)
+        print()
+    else:
+        with open(out, "w") as fh:
+            json.dump(doc, fh)
+
+
+def cmd_explain(args):
+    store = _store(args)
+    print(store.explain(args.feature_name, args.cql))
+
+
+def cmd_count(args):
+    store = _store(args)
+    print(store.count(args.feature_name, args.cql or "INCLUDE"))
+
+
+def cmd_stats(args):
+    from geomesa_tpu.process import run_stats
+
+    store = _store(args)
+    seq = run_stats(store, args.feature_name, args.cql or "INCLUDE", args.stat_spec)
+    for s in seq.stats:
+        print(json.dumps(s.to_json()))
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="geomesa-tpu")
+    p.add_argument("--root", help="store root directory (default $GEOMESA_TPU_ROOT)")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add(name, fn, **kw):
+        sp = sub.add_parser(name, **kw)
+        sp.set_defaults(fn=fn)
+        return sp
+
+    sp = add("create-schema", cmd_create_schema)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-s", "--spec", required=True)
+
+    add("get-sfts", cmd_get_sfts)
+
+    sp = add("describe-schema", cmd_describe_schema)
+    sp.add_argument("-f", "--feature-name", required=True)
+
+    sp = add("remove-schema", cmd_remove_schema)
+    sp.add_argument("-f", "--feature-name", required=True)
+
+    sp = add("ingest", cmd_ingest)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-C", "--converter", required=True, help="converter config json")
+    sp.add_argument("files", nargs="+")
+
+    sp = add("export", cmd_export)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-q", "--cql")
+    sp.add_argument("-F", "--format", default="csv",
+                    choices=["csv", "json", "arrow", "parquet", "bin"])
+    sp.add_argument("-o", "--output", default="-")
+    sp.add_argument("-m", "--max-features", type=int)
+    sp.add_argument("-a", "--attributes", help="comma-separated projection")
+    sp.add_argument("--track-attr", help="track id attribute for bin export")
+
+    sp = add("explain", cmd_explain)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-q", "--cql", required=True)
+
+    sp = add("count", cmd_count)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-q", "--cql")
+
+    sp = add("stats", cmd_stats)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-s", "--stat-spec", required=True)
+    sp.add_argument("-q", "--cql")
+
+    args = p.parse_args(argv)
+    try:
+        args.fn(args)
+    except KeyError as e:
+        sys.exit(f"error: unknown schema or attribute {e}")
+    except (ValueError, FileNotFoundError) as e:
+        sys.exit(f"error: {e}")

@@ -1,0 +1,170 @@
+"""Converter framework + CLI tools."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert import converter_for, parse_expression
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.tools.cli import main
+
+SPEC = "name:String,age:Int,dtg:Date,*geom:Point"
+SFT = SimpleFeatureType.create("people", SPEC)
+
+CSV_CONFIG = {
+    "type": "delimited-text",
+    "format": "csv",
+    "id-field": "$1",
+    "options": {"skip-lines": 1},
+    "fields": [
+        {"name": "name", "transform": "lowercase($1)"},
+        {"name": "age", "transform": "$2::int"},
+        {"name": "dtg", "transform": "datetime($3)"},
+        {"name": "geom", "transform": "point($4::double, $5::double)"},
+    ],
+}
+
+CSV_DATA = """name,age,date,lon,lat
+Alice,34,2020-01-05T12:00:00Z,2.35,48.85
+BOB,55,2020-02-01T00:30:00Z,-0.12,51.5
+Carol,21,2020-03-15T08:00:00Z,13.4,52.5
+"""
+
+
+class TestExpression:
+    def test_refs_and_casts(self):
+        e = parse_expression("$2::int")
+        out = e({"2": np.array(["41", "42"], dtype=object)})
+        np.testing.assert_array_equal(out, [41, 42])
+        assert out.dtype == np.int32
+
+    def test_functions(self):
+        cols = {"1": np.array(["a", "b"], dtype=object)}
+        assert parse_expression("concat($1, 'x')")(cols).tolist() == ["ax", "bx"]
+        assert parse_expression("uppercase($1)")(cols).tolist() == ["A", "B"]
+        pts = parse_expression("point($1::double, $1::double)")(
+            {"1": np.array(["1.5", "2.5"], dtype=object)}
+        )
+        assert pts.shape == (2, 2)
+
+    def test_string_to_int_with_default(self):
+        e = parse_expression("stringToInt($1, 7)")
+        out = e({"1": np.array(["3", "oops"], dtype=object)})
+        np.testing.assert_array_equal(out, [3, 7])
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            parse_expression("nosuchfn($1)")
+        with pytest.raises(ValueError):
+            parse_expression("$1::nope")
+
+
+class TestDelimited:
+    def test_csv(self):
+        conv = converter_for(CSV_CONFIG, SFT)
+        res = conv.process(CSV_DATA)
+        assert res.success == 3 and res.failed == 0
+        b = res.batch
+        assert b.columns["name"].tolist() == ["alice", "bob", "carol"]
+        assert b.columns["age"].tolist() == [34, 55, 21]
+        assert b.fids.tolist() == ["Alice", "BOB", "Carol"]
+        x, y = b.point_coords()
+        assert x[1] == pytest.approx(-0.12)
+
+    def test_bad_records_skipped(self):
+        conv = converter_for(CSV_CONFIG, SFT)
+        res = conv.process(CSV_DATA + "short,row\n")
+        assert res.success == 3
+        assert res.failed == 1
+
+
+class TestJson:
+    def test_feature_path(self):
+        config = {
+            "type": "json",
+            "feature-path": "$.features[*]",
+            "id-field": "$id",
+            "fields": [
+                {"name": "name", "json-path": "$.props.name"},
+                {"name": "age", "json-path": "$.props.age", "transform": "$age::int"},
+                {"name": "dtg", "json-path": "$.when", "transform": "datetime($dtg)"},
+                {"name": "geom", "json-path": "$.loc",
+                 "transform": "point($geom::double, $geom::double)"},
+                {"name": "id", "json-path": "$.id"},
+            ],
+        }
+        # geom transform above is nonsense for a list; use explicit x/y
+        config["fields"][3] = {
+            "name": "geom", "json-path": "$.loc[0]", "transform": "point($geom::double, $y::double)"
+        }
+        config["fields"].append({"name": "y", "json-path": "$.loc[1]"})
+        sft = SimpleFeatureType.create(
+            "j", "name:String,age:Int,dtg:Date,*geom:Point,id:String,y:Double"
+        )
+        doc = {
+            "features": [
+                {"id": "f1", "props": {"name": "n1", "age": 10},
+                 "when": "2021-01-01T00:00:00Z", "loc": [1.0, 2.0]},
+                {"id": "f2", "props": {"name": "n2", "age": 20},
+                 "when": "2021-06-01T00:00:00Z", "loc": [3.0, 4.0]},
+            ]
+        }
+        conv = converter_for(config, sft)
+        res = conv.process(json.dumps(doc))
+        assert res.success == 2
+        assert res.batch.fids.tolist() == ["f1", "f2"]
+        x, y = res.batch.point_coords()
+        np.testing.assert_allclose(x, [1.0, 3.0])
+        np.testing.assert_allclose(y, [2.0, 4.0])
+
+
+class TestCli:
+    def test_full_workflow(self, tmp_path, capsys):
+        root = str(tmp_path / "store")
+        conv_path = str(tmp_path / "conv.json")
+        csv_path = str(tmp_path / "data.csv")
+        with open(conv_path, "w") as fh:
+            json.dump(CSV_CONFIG, fh)
+        with open(csv_path, "w") as fh:
+            fh.write(CSV_DATA)
+
+        main(["--root", root, "create-schema", "-f", "people", "-s", SPEC])
+        main(["--root", root, "ingest", "-f", "people", "-C", conv_path, csv_path])
+        main(["--root", root, "get-sfts"])
+        main(["--root", root, "describe-schema", "-f", "people"])
+        main(["--root", root, "count", "-f", "people", "-q", "age > 30"])
+        out = capsys.readouterr().out
+        assert "ingested 3 features" in out
+        assert "people" in out
+        assert out.strip().endswith("2")
+
+        main(["--root", root, "explain", "-f", "people", "-q",
+              "BBOX(geom, 0, 45, 5, 50)"])
+        out = capsys.readouterr().out
+        assert "Chosen index" in out
+
+        csv_out = str(tmp_path / "out.csv")
+        main(["--root", root, "export", "-f", "people", "-q", "age > 30",
+              "-F", "csv", "-o", csv_out])
+        lines = open(csv_out).read().strip().splitlines()
+        assert len(lines) == 3  # header + 2
+
+        json_out = str(tmp_path / "out.json")
+        main(["--root", root, "export", "-f", "people", "-F", "json", "-o", json_out])
+        doc = json.load(open(json_out))
+        assert len(doc["features"]) == 3
+        assert doc["features"][0]["geometry"]["type"] == "Point"
+
+        pq_out = str(tmp_path / "out.parquet")
+        main(["--root", root, "export", "-f", "people", "-F", "parquet", "-o", pq_out])
+        import pyarrow.parquet as pq
+
+        assert pq.read_table(pq_out).num_rows == 3
+
+        main(["--root", root, "stats", "-f", "people", "-s",
+              'Count();MinMax("age")'])
+        out = capsys.readouterr().out
+        stats_lines = [json.loads(l) for l in out.strip().splitlines() if l.startswith("{")]
+        assert stats_lines[-1]["min"] == 21 and stats_lines[-1]["max"] == 55

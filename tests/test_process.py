@@ -1,0 +1,174 @@
+"""Process layer: density, BIN, kNN, sampling, stats DSL, tube select."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom import Envelope
+from geomesa_tpu.process import (
+    decode_bin,
+    density,
+    encode_bin,
+    knn,
+    run_stats,
+    sample,
+    tube_select,
+)
+from geomesa_tpu.stats import parse_stat
+from geomesa_tpu.store import MemoryDataStore
+
+SPEC = "track:String,val:Double,dtg:Date,*geom:Point"
+
+
+@pytest.fixture(scope="module")
+def store():
+    s = MemoryDataStore(partition_size=8192)
+    s.create_schema("ais", SPEC)
+    rng = np.random.default_rng(9)
+    n = 30000
+    t0 = np.datetime64("2021-01-01").astype("datetime64[ms]").astype(np.int64)
+    s.write(
+        "ais",
+        {
+            "track": rng.choice([f"v{i}" for i in range(50)], n),
+            "val": rng.uniform(0, 1, n),
+            "dtg": t0 + rng.integers(0, 10 * 86400000, n),
+            "geom": np.stack(
+                [rng.uniform(-10, 10, n), rng.uniform(40, 60, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    return s
+
+
+class TestDensity:
+    def test_counts_conserved(self, store):
+        env = Envelope(-10, 40, 10, 60)
+        grid = density(store, "ais", "INCLUDE", env, 64, 32)
+        assert grid.shape == (32, 64)
+        assert int(grid.sum()) == 30000
+
+    def test_device_matches_host(self, store):
+        env = Envelope(-10, 40, 10, 60)
+        g1 = density(store, "ais", "BBOX(geom, -5, 45, 5, 55)", env, 32, 32, use_device=True)
+        g2 = density(store, "ais", "BBOX(geom, -5, 45, 5, 55)", env, 32, 32, use_device=False)
+        np.testing.assert_allclose(g1, g2)
+
+    def test_weighted(self, store):
+        env = Envelope(-10, 40, 10, 60)
+        g = density(store, "ais", "INCLUDE", env, 8, 8, weight_attr="val")
+        st = store._state("ais")
+        assert g.sum() == pytest.approx(st.data.column("val").sum(), rel=1e-5)
+
+
+class TestBin:
+    def test_roundtrip(self, store):
+        res = store.query("ais", "BBOX(geom, -5, 45, 5, 55)")
+        data = encode_bin(res.batch, "track", sort=True)
+        assert len(data) == 16 * len(res.batch)
+        rec = decode_bin(data)
+        assert np.all(np.diff(rec["dtg"]) >= 0)
+        np.testing.assert_allclose(
+            np.sort(rec["lon"]),
+            np.sort(res.batch.point_coords()[0].astype(np.float32)),
+        )
+
+    def test_labels(self, store):
+        res = store.query("ais", "val > 0.9")
+        data = encode_bin(res.batch, "track", label_attr="track")
+        rec = decode_bin(data, labels=True)
+        assert len(rec) == len(res.batch)
+        raw = int(rec["label"][0]).to_bytes(8, "little").rstrip(b"\0").decode()
+        assert raw == str(res.batch.column("track")[0])[:8]
+
+
+class TestKnn:
+    def test_knn_exact(self, store):
+        st = store._state("ais")
+        x, y = st.data.point_coords()
+        px, py = 1.5, 50.5
+        from geomesa_tpu.process.knn import _dist_deg
+
+        d_all = _dist_deg(x, y, px, py)
+        expected = np.sort(d_all)[:10]
+        batch, dists = knn(store, "ais", px, py, 10)
+        assert len(batch) == 10
+        np.testing.assert_allclose(np.sort(dists), expected)
+
+
+class TestSampling:
+    def test_fraction(self, store):
+        b = sample(store, "ais", "INCLUDE", fraction=0.1)
+        assert abs(len(b) - 3000) < 10
+
+    def test_per_track(self, store):
+        b = sample(store, "ais", "INCLUDE", n=2, by_attr="track")
+        vals, counts = np.unique(b.column("track"), return_counts=True)
+        assert np.all(counts <= 2)
+        assert len(vals) == 50
+
+
+class TestStatsDSL:
+    def test_parse_and_run(self, store):
+        seq = run_stats(
+            store,
+            "ais",
+            "INCLUDE",
+            'Count();MinMax("val");Cardinality("track");TopK("track",5);Histogram("val",10,0,1)',
+        )
+        count, minmax, card, topk, hist = seq.stats
+        assert count.value == 30000
+        assert 0 <= minmax.min < 0.001 and 0.999 < minmax.max <= 1
+        assert abs(card.estimate - 50) < 5
+        assert len(topk.topk) == 5
+        assert hist.counts.sum() == 30000
+        assert 0.45 < hist.selectivity(0.2, 0.7) < 0.55
+
+    def test_merge(self, rng):
+        a, b = parse_stat('MinMax("v")'), parse_stat('MinMax("v")')
+        a.stats[0].observe(np.array([1.0, 5.0]))
+        b.stats[0].observe(np.array([-3.0, 2.0]))
+        a.merge(b)
+        assert a.stats[0].bounds == (-3.0, 5.0)
+
+    def test_frequency(self):
+        from geomesa_tpu.stats import Frequency
+
+        f = Frequency("x")
+        f.observe(np.array(["a"] * 100 + ["b"] * 7))
+        assert f.count("a") >= 100
+        assert f.count("b") >= 7
+        assert f.count("zzz") < 5
+
+    def test_z3histogram(self, store):
+        seq = run_stats(store, "ais", "INCLUDE", 'Z3Histogram("geom","dtg")')
+        z3h = seq.stats[0]
+        assert sum(z3h.counts.values()) == 30000
+        assert len(z3h.counts) > 10
+
+
+class TestTube:
+    def test_corridor(self, store):
+        st = store._state("ais")
+        t0 = int(st.data.column("dtg").min())
+        track = np.array([[-5.0, 45.0], [0.0, 50.0], [5.0, 55.0]])
+        times = np.array([t0, t0 + 3600_000, t0 + 7200_000])
+        batch = tube_select(store, "ais", track, times, buffer_deg=1.0, max_dt_ms=86400_000)
+        # every result is near the track and time-consistent
+        if len(batch):
+            from geomesa_tpu.process.tube import _point_segment_dist
+
+            x, y = batch.point_coords()
+            d0, _ = _point_segment_dist(x, y, *track[0], *track[1])
+            d1, _ = _point_segment_dist(x, y, *track[1], *track[2])
+            assert np.all(np.minimum(d0, d1) <= 1.0)
+        # a corridor in empty ocean matches nothing
+        far = tube_select(
+            store,
+            "ais",
+            np.array([[100.0, -50.0], [110.0, -40.0]]),
+            np.array([t0, t0 + 3600_000]),
+            buffer_deg=1.0,
+            max_dt_ms=86400_000,
+        )
+        assert len(far) == 0

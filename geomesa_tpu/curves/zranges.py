@@ -21,6 +21,7 @@ section 3.1); a C++ implementation with identical semantics is planned for
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Sequence
 
 import numpy as np
@@ -40,32 +41,43 @@ def zranges(
     bits_per_dim: int,
     max_ranges: int = DEFAULT_MAX_RANGES,
     max_recurse: int | None = None,
+    use_native: bool = True,
 ) -> list[IndexRange]:
     """Decompose the inclusive box [qlo, qhi] into z ranges.
 
     qlo/qhi: per-dimension inclusive normalized index bounds (dim order =
     Morton bit order: dim d owns z bits ``k*dims + d``).
+
+    Dispatches to the C++ implementation (native/zorder.cpp, bit-identical
+    by contract and by test) when built; set GEOMESA_TPU_NO_NATIVE=1 or
+    pass use_native=False to force this Python path.
     """
     dims = len(qlo)
-    assert len(qhi) == dims
+    if len(qhi) != dims:
+        raise ValueError(f"qlo has {dims} dims but qhi has {len(qhi)}")
     total_bits = dims * bits_per_dim
-    qlo = [int(v) for v in qlo]
-    qhi = [int(v) for v in qhi]
+    # coerce + clamp BEFORE native dispatch so both paths see identical
+    # inputs (a negative bound would wrap under the C side's uint64)
+    max_idx = (1 << bits_per_dim) - 1
+    qlo = [min(max(int(v), 0), max_idx) for v in qlo]
+    qhi = [min(max(int(v), 0), max_idx) for v in qhi]
     for d in range(dims):
         if qhi[d] < qlo[d]:
             return []
+    from geomesa_tpu import native
+
+    if dims <= 3 and native.enabled(use_native):
+        # the C struct carries at most 3 dims (Node.dp[3])
+        max_bits = -1
+        if max_recurse is not None:
+            max_bits = _max_bits_for(qlo, qhi, dims, bits_per_dim, max_recurse)
+        out = native.zranges_native(qlo, qhi, bits_per_dim, max_ranges, max_bits)
+        if out is not None:
+            return out
 
     max_bits = total_bits
     if max_recurse is not None:
-        # common prefix length of the box corners' z codes bounds where
-        # splitting can start; recursion counts full dim-rounds below it.
-        from geomesa_tpu.curves.zorder import encode_py
-
-        zmin = encode_py(tuple(qlo), bits_per_dim)
-        zmax = encode_py(tuple(qhi), bits_per_dim)
-        diff = zmin ^ zmax
-        prefix_len = total_bits - diff.bit_length()
-        max_bits = min(total_bits, prefix_len + max_recurse * dims)
+        max_bits = _max_bits_for(qlo, qhi, dims, bits_per_dim, max_recurse)
 
     from collections import deque
 
@@ -118,6 +130,18 @@ def zranges(
     results.extend(overflow)
     results.sort(key=lambda r: r.lower)
     return _merge(results, max_ranges)
+
+
+def _max_bits_for(qlo, qhi, dims: int, bits_per_dim: int, max_recurse: int) -> int:
+    """Depth cap: common z-prefix of the box corners + max_recurse rounds."""
+    from geomesa_tpu.curves.zorder import encode_py
+
+    total_bits = dims * bits_per_dim
+    zmin = encode_py(tuple(int(v) for v in qlo), bits_per_dim)
+    zmax = encode_py(tuple(int(v) for v in qhi), bits_per_dim)
+    diff = zmin ^ zmax
+    prefix_len = total_bits - diff.bit_length()
+    return min(total_bits, prefix_len + max_recurse * dims)
 
 
 def _decided_for_dim(decided: int, d: int, dims: int, total_bits: int) -> int:

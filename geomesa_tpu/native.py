@@ -1,0 +1,166 @@
+"""ctypes bindings for the native host library (native/zorder.cpp).
+
+Loads ``native/build/libgeomesa_tpu.so``, compiling it on first use when a
+C++ toolchain is available (``make -C native``). Every entry point has a
+pure-Python/NumPy fallback with identical semantics (the Python versions
+are the oracle; tests assert bit-identical outputs), so the package works
+without the toolchain -- just slower planning.
+
+Native entry points:
+- bulk Morton encode/decode (2D/3D)
+- fused quantize+encode z3 keys (the ingest hot loop)
+- ``zranges`` litmax/bigmin decomposition (the query-planning hot loop)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LIB_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_LIB_PATH = os.path.join(_LIB_DIR, "build", "libgeomesa_tpu.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _LIB_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def get_lib():
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and os.path.exists(
+            os.path.join(_LIB_DIR, "zorder.cpp")
+        ):
+            _build()
+        if not os.path.exists(_LIB_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.gm_encode_2d.argtypes = [ctypes.c_int64, _u64p, _u64p, _u64p]
+        lib.gm_decode_2d.argtypes = [ctypes.c_int64, _u64p, _u64p, _u64p]
+        lib.gm_encode_3d.argtypes = [ctypes.c_int64, _u64p, _u64p, _u64p, _u64p]
+        lib.gm_decode_3d.argtypes = [ctypes.c_int64, _u64p, _u64p, _u64p, _u64p]
+        lib.gm_z3_index.argtypes = [
+            ctypes.c_int64,
+            _f64p,
+            _f64p,
+            _f64p,
+            ctypes.c_double,
+            _u64p,
+        ]
+        lib.gm_zranges.argtypes = [
+            _u64p,
+            _u64p,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int64,
+            ctypes.c_int,
+            _u64p,
+            _u64p,
+            _u8p,
+            ctypes.c_int64,
+        ]
+        lib.gm_zranges.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def enabled(use_native: bool = True) -> bool:
+    """Shared gate: native lib built AND not disabled via
+    GEOMESA_TPU_NO_NATIVE AND the caller's use_native flag."""
+    return (
+        use_native
+        and not os.environ.get("GEOMESA_TPU_NO_NATIVE")
+        and available()
+    )
+
+
+def encode_3d(x: np.ndarray, y: np.ndarray, t: np.ndarray) -> "np.ndarray | None":
+    lib = get_lib()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.uint64)
+    y = np.ascontiguousarray(y, dtype=np.uint64)
+    t = np.ascontiguousarray(t, dtype=np.uint64)
+    out = np.empty(len(x), dtype=np.uint64)
+    lib.gm_encode_3d(len(x), x, y, t, out)
+    return out
+
+
+def z3_index(x: np.ndarray, y: np.ndarray, t: np.ndarray, t_max: float) -> "np.ndarray | None":
+    """Fused quantize+encode (lon, lat, offset) -> z3, precision 21."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    y = np.ascontiguousarray(y, dtype=np.float64)
+    t = np.ascontiguousarray(t, dtype=np.float64)
+    out = np.empty(len(x), dtype=np.uint64)
+    lib.gm_z3_index(len(x), x, y, t, float(t_max), out)
+    return out
+
+
+def zranges_native(qlo, qhi, bits_per_dim, max_ranges, max_bits=-1):
+    """Native range decomposition; returns list[IndexRange] or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    from geomesa_tpu.curves.zranges import IndexRange
+
+    dims = len(qlo)
+    qlo_a = np.ascontiguousarray(np.asarray(qlo, dtype=np.uint64))
+    qhi_a = np.ascontiguousarray(np.asarray(qhi, dtype=np.uint64))
+    cap = max(int(max_ranges) * 2 + 16, 64)
+    out_lo = np.empty(cap, dtype=np.uint64)
+    out_hi = np.empty(cap, dtype=np.uint64)
+    out_c = np.empty(cap, dtype=np.uint8)
+    n = lib.gm_zranges(
+        qlo_a, qhi_a, dims, bits_per_dim, max_ranges, max_bits,
+        out_lo, out_hi, out_c, cap,
+    )
+    if n < 0:  # capacity exceeded; retry bigger once
+        cap = cap * 8
+        out_lo = np.empty(cap, dtype=np.uint64)
+        out_hi = np.empty(cap, dtype=np.uint64)
+        out_c = np.empty(cap, dtype=np.uint8)
+        n = lib.gm_zranges(
+            qlo_a, qhi_a, dims, bits_per_dim, max_ranges, max_bits,
+            out_lo, out_hi, out_c, cap,
+        )
+        if n < 0:
+            return None
+    return [
+        IndexRange(int(out_lo[i]), int(out_hi[i]), bool(out_c[i]))
+        for i in range(n)
+    ]

@@ -1,0 +1,244 @@
+// Native host kernels for geomesa-tpu: bulk Morton encode/decode and the
+// litmax/bigmin z-range decomposition.
+//
+// Semantics are EXACTLY those of geomesa_tpu/curves/zorder.py and
+// zranges.py (the Python implementations are the oracle; tests assert
+// bit-identical output). The range decomposition is the client-side hot
+// loop of the reference's query path (SURVEY.md section 3.1): recursive
+// quad/oct-tree pruning that the JVM reference does per-query in Scala
+// (sfcurve ZN.zranges) and we do here in C++ at ~20-50x the Python speed.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+// Python binding: ctypes (geomesa_tpu/native.py) -- no pybind11 in image.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Morton encode/decode (magic-mask gather/scatter; matches zorder.py masks)
+// ---------------------------------------------------------------------------
+
+static inline uint64_t split2(uint64_t x) {
+  x &= 0x7fffffffULL;
+  x = (x ^ (x << 32)) & 0x00000000ffffffffULL;
+  x = (x ^ (x << 16)) & 0x0000ffff0000ffffULL;
+  x = (x ^ (x << 8)) & 0x00ff00ff00ff00ffULL;
+  x = (x ^ (x << 4)) & 0x0f0f0f0f0f0f0f0fULL;
+  x = (x ^ (x << 2)) & 0x3333333333333333ULL;
+  x = (x ^ (x << 1)) & 0x5555555555555555ULL;
+  return x;
+}
+
+static inline uint64_t combine2(uint64_t z) {
+  uint64_t x = z & 0x5555555555555555ULL;
+  x = (x ^ (x >> 1)) & 0x3333333333333333ULL;
+  x = (x ^ (x >> 2)) & 0x0f0f0f0f0f0f0f0fULL;
+  x = (x ^ (x >> 4)) & 0x00ff00ff00ff00ffULL;
+  x = (x ^ (x >> 8)) & 0x0000ffff0000ffffULL;
+  x = (x ^ (x >> 16)) & 0x00000000ffffffffULL;
+  x = (x ^ (x >> 32)) & 0x7fffffffULL;
+  return x;
+}
+
+static inline uint64_t split3(uint64_t x) {
+  x &= 0x1fffffULL;
+  x = (x | (x << 32)) & 0x1f00000000ffffULL;
+  x = (x | (x << 16)) & 0x1f0000ff0000ffULL;
+  x = (x | (x << 8)) & 0x100f00f00f00f00fULL;
+  x = (x | (x << 4)) & 0x10c30c30c30c30c3ULL;
+  x = (x | (x << 2)) & 0x1249249249249249ULL;
+  return x;
+}
+
+static inline uint64_t combine3(uint64_t z) {
+  uint64_t x = z & 0x1249249249249249ULL;
+  x = (x ^ (x >> 2)) & 0x10c30c30c30c30c3ULL;
+  x = (x ^ (x >> 4)) & 0x100f00f00f00f00fULL;
+  x = (x ^ (x >> 8)) & 0x1f0000ff0000ffULL;
+  x = (x ^ (x >> 16)) & 0x1f00000000ffffULL;
+  x = (x ^ (x >> 32)) & 0x1fffffULL;
+  return x;
+}
+
+void gm_encode_2d(int64_t n, const uint64_t* x, const uint64_t* y,
+                  uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = split2(x[i]) | (split2(y[i]) << 1);
+}
+
+void gm_decode_2d(int64_t n, const uint64_t* z, uint64_t* x, uint64_t* y) {
+  for (int64_t i = 0; i < n; ++i) {
+    x[i] = combine2(z[i]);
+    y[i] = combine2(z[i] >> 1);
+  }
+}
+
+void gm_encode_3d(int64_t n, const uint64_t* x, const uint64_t* y,
+                  const uint64_t* t, uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = split3(x[i]) | (split3(y[i]) << 1) | (split3(t[i]) << 2);
+}
+
+void gm_decode_3d(int64_t n, const uint64_t* z, uint64_t* x, uint64_t* y,
+                  uint64_t* t) {
+  for (int64_t i = 0; i < n; ++i) {
+    x[i] = combine3(z[i]);
+    y[i] = combine3(z[i] >> 1);
+    t[i] = combine3(z[i] >> 2);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Quantize + encode fused (the ingest-side per-feature key hot loop)
+// ---------------------------------------------------------------------------
+
+static inline uint64_t quantize(double v, double lo, double hi, int64_t bins) {
+  if (v >= hi) return (uint64_t)(bins - 1);
+  double scale = (double)bins / (hi - lo);
+  int64_t idx = (int64_t)std::floor((v - lo) * scale);
+  if (idx < 0) idx = 0;
+  if (idx > bins - 1) idx = bins - 1;
+  return (uint64_t)idx;
+}
+
+void gm_z3_index(int64_t n, const double* x, const double* y, const double* t,
+                 double t_max, uint64_t* out) {
+  const int64_t bins = 1LL << 21;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t nx = quantize(x[i], -180.0, 180.0, bins);
+    uint64_t ny = quantize(y[i], -90.0, 90.0, bins);
+    uint64_t nt = quantize(t[i], 0.0, t_max, bins);
+    out[i] = split3(nx) | (split3(ny) << 1) | (split3(nt) << 2);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// zranges: level-order BFS binary descent (mirrors zranges.py exactly)
+// ---------------------------------------------------------------------------
+
+struct Node {
+  uint64_t zprefix;
+  int decided;
+  uint64_t dp[3];
+};
+
+struct Range {
+  uint64_t lo, hi;
+  uint8_t contained;
+};
+
+static inline int decided_for_dim(int decided, int d, int dims,
+                                  int total_bits) {
+  // count of b in [total_bits-decided, total_bits-1] with b % dims == d
+  if (decided == 0) return 0;
+  int lo_b = total_bits - decided;
+  int hi_b = total_bits - 1;
+  // floor divisions with potentially negative numerators (match Python //)
+  auto fdiv = [](int a, int b) {
+    int q = a / b;
+    if ((a % b != 0) && ((a < 0) != (b < 0))) --q;
+    return q;
+  };
+  return fdiv(hi_b - d, dims) - fdiv(lo_b - 1 - d, dims);
+}
+
+// returns number of ranges written, or -1 if out_cap insufficient
+int64_t gm_zranges(const uint64_t* qlo, const uint64_t* qhi, int dims,
+                   int bits_per_dim, int64_t max_ranges, int max_bits,
+                   uint64_t* out_lo, uint64_t* out_hi, uint8_t* out_contained,
+                   int64_t out_cap) {
+  const int total_bits = dims * bits_per_dim;
+  for (int d = 0; d < dims; ++d)
+    if (qhi[d] < qlo[d]) return 0;
+  if (max_bits < 0 || max_bits > total_bits) max_bits = total_bits;
+
+  std::vector<Range> results;
+  std::vector<Range> overflow;
+  std::deque<Node> queue;
+  queue.push_back(Node{0, 0, {0, 0, 0}});
+
+  while (!queue.empty()) {
+    Node node = queue.front();
+    queue.pop_front();
+    int rem = total_bits - node.decided;
+    bool contained = true, disjoint = false;
+    for (int d = 0; d < dims; ++d) {
+      int dec_d = decided_for_dim(node.decided, d, dims, total_bits);
+      int r = bits_per_dim - dec_d;
+      uint64_t lo_d = node.dp[d] << r;
+      uint64_t hi_d = lo_d + ((r >= 64 ? 0 : (1ULL << r)) - 1);
+      if (hi_d < qlo[d] || lo_d > qhi[d]) {
+        disjoint = true;
+        break;
+      }
+      if (!(lo_d >= qlo[d] && hi_d <= qhi[d])) contained = false;
+    }
+    if (disjoint) continue;
+    uint64_t zlo = node.zprefix << rem;
+    uint64_t zhi = zlo + ((rem >= 64 ? 0 : (1ULL << rem)) - 1);
+    if (contained) {
+      results.push_back(Range{zlo, zhi, 1});
+      continue;
+    }
+    int64_t budget_left = max_ranges - (int64_t)results.size() -
+                          (int64_t)overflow.size() - (int64_t)queue.size();
+    if (rem == 0 || node.decided >= max_bits || budget_left <= 0) {
+      overflow.push_back(Range{zlo, zhi, 0});
+      continue;
+    }
+    int d = (total_bits - 1 - node.decided) % dims;
+    Node c0 = node, c1 = node;
+    c0.zprefix = node.zprefix << 1;
+    c1.zprefix = (node.zprefix << 1) | 1;
+    c0.decided = c1.decided = node.decided + 1;
+    c0.dp[d] = node.dp[d] << 1;
+    c1.dp[d] = (node.dp[d] << 1) | 1;
+    queue.push_back(c0);
+    queue.push_back(c1);
+  }
+
+  results.insert(results.end(), overflow.begin(), overflow.end());
+  std::sort(results.begin(), results.end(),
+            [](const Range& a, const Range& b) { return a.lo < b.lo; });
+
+  // coalesce adjacent/overlapping (z <= 2^63-1, so hi+1 cannot wrap)
+  std::vector<Range> merged;
+  for (const Range& r : results) {
+    if (!merged.empty() && r.lo <= merged.back().hi + 1) {
+      merged.back().hi = std::max(merged.back().hi, r.hi);
+      merged.back().contained = merged.back().contained && r.contained;
+    } else {
+      merged.push_back(r);
+    }
+  }
+  // enforce budget by merging smallest gaps
+  while ((int64_t)merged.size() > max_ranges) {
+    size_t best = 0;
+    uint64_t best_gap = UINT64_MAX;
+    for (size_t i = 0; i + 1 < merged.size(); ++i) {
+      uint64_t gap = merged[i + 1].lo - merged[i].hi;
+      if (gap < best_gap) {
+        best_gap = gap;
+        best = i;
+      }
+    }
+    merged[best].hi = merged[best + 1].hi;
+    merged[best].contained = 0;
+    merged.erase(merged.begin() + best + 1);
+  }
+
+  if ((int64_t)merged.size() > out_cap) return -1;
+  for (size_t i = 0; i < merged.size(); ++i) {
+    out_lo[i] = merged[i].lo;
+    out_hi[i] = merged[i].hi;
+    out_contained[i] = merged[i].contained;
+  }
+  return (int64_t)merged.size();
+}
+
+}  // extern "C"

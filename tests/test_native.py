@@ -1,0 +1,67 @@
+"""Native C++ library vs Python oracle: bit-identical outputs."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import native
+from geomesa_tpu.curves import zorder
+from geomesa_tpu.curves.z3 import Z3SFC
+from geomesa_tpu.curves.zranges import zranges
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native lib not built (no toolchain)"
+)
+
+
+@needs_native
+def test_encode_3d_matches_numpy(rng):
+    x = rng.integers(0, 1 << 21, 10000).astype(np.uint64)
+    y = rng.integers(0, 1 << 21, 10000).astype(np.uint64)
+    t = rng.integers(0, 1 << 21, 10000).astype(np.uint64)
+    np.testing.assert_array_equal(
+        native.encode_3d(x, y, t), zorder.encode_3d_np(x, y, t)
+    )
+
+
+@needs_native
+def test_z3_index_fused_matches(rng):
+    sfc = Z3SFC()
+    x = rng.uniform(-180, 180, 10000)
+    y = rng.uniform(-90, 90, 10000)
+    t = rng.uniform(0, 604800, 10000)
+    got = native.z3_index(x, y, t, 604800.0)
+    np.testing.assert_array_equal(got, sfc.index(x, y, t))
+
+
+@needs_native
+@pytest.mark.parametrize(
+    "qlo,qhi,bits,mr",
+    [
+        ((1, 2), (6, 5), 3, 1000),
+        ((0, 0), (7, 7), 3, 1000),
+        ((5, 9), (900, 700), 10, 64),
+        ((0, 0, 0), ((1 << 21) - 1, (1 << 21) - 1, 1000), 21, 2000),
+        ((123456, 654321, 1000), (1234567, 6543210, 2000), 21, 500),
+        ((100, 200), (2**30, 2**30 + 5000), 31, 2000),
+    ],
+)
+def test_zranges_bit_identical(qlo, qhi, bits, mr):
+    py = zranges(qlo, qhi, bits, max_ranges=mr, use_native=False)
+    cc = zranges(qlo, qhi, bits, max_ranges=mr, use_native=True)
+    assert cc == py
+
+
+@needs_native
+def test_zranges_speed(rng):
+    import time
+
+    qlo = (0, 0, 0)
+    qhi = ((1 << 21) - 1, (1 << 20), 10000)
+    t0 = time.perf_counter()
+    cc = zranges(qlo, qhi, 21, max_ranges=2000, use_native=True)
+    t_cc = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    py = zranges(qlo, qhi, 21, max_ranges=2000, use_native=False)
+    t_py = time.perf_counter() - t0
+    assert cc == py
+    assert t_cc < t_py, f"native {t_cc:.4f}s not faster than python {t_py:.4f}s"

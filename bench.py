@@ -28,11 +28,14 @@ def main() -> None:
     ap.add_argument("--n", type=int, default=None, help="rows resident on device")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--check", action="store_true", help="verify count vs host oracle")
+    ap.add_argument(
+        "--engine",
+        choices=("pallas", "xla"),
+        default="pallas",
+        help="fused scan kernel: hand-written Pallas tiles or XLA-fused jnp",
+    )
     args = ap.parse_args()
 
-    from geomesa_tpu.jaxconf import require_x64
-
-    require_x64()  # Date columns are int64 epoch-ms (TPU emulates s64 lanes)
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -58,20 +61,32 @@ def main() -> None:
     compiled = compile_filter(parse_ecql(ecql), sft)
     assert compiled.fully_on_device
 
-    # generate data on device (float32 coords, int64 epoch-ms)
+    # generate data on device: float32 coords; int64 epoch-ms materialized
+    # as the storage-format hi/lo word planes (ops/int64lanes.py)
     log("generating device-resident columns...")
+    from geomesa_tpu.jaxconf import require_x64
+
+    require_x64()  # only for generating the i64 oracle column
     key = jax.random.PRNGKey(42)
     kx, ky, kt = jax.random.split(key, 3)
+    dtg = jax.random.randint(kt, (n,), t0, t1, jnp.int64)
     cols = {
         "geom__x": jax.random.uniform(kx, (n,), jnp.float32, -180.0, 180.0),
         "geom__y": jax.random.uniform(ky, (n,), jnp.float32, -90.0, 90.0),
-        "dtg": jax.random.randint(kt, (n,), t0, t1, jnp.int64),
+        "dtg__hi": (dtg >> 32).astype(jnp.int32),
+        "dtg__lo": (dtg & 0xFFFFFFFF).astype(jnp.uint32),
     }
     jax.block_until_ready(cols)
+    assert sorted(compiled.device_cols) == sorted(cols)
 
-    @jax.jit
-    def scan_count(c):
-        return compiled.device_fn(c).sum()
+    if args.engine == "pallas":
+        scan = compiled.pallas_scan()
+        assert scan is not None, "filter not pallas-tileable"
+        scan_count = jax.jit(scan[0])
+    else:
+        @jax.jit
+        def scan_count(c):
+            return compiled.device_fn(c).sum()
 
     # compile + warmup
     t_compile = time.perf_counter()
@@ -82,7 +97,7 @@ def main() -> None:
     if args.check:
         x = np.asarray(cols["geom__x"])
         y = np.asarray(cols["geom__y"])
-        d = np.asarray(cols["dtg"])
+        d = np.asarray(dtg)
         expect = int(
             (
                 (x >= -10) & (x <= 30) & (y >= 35) & (y <= 60)

@@ -66,8 +66,9 @@ def zranges(
             return []
     from geomesa_tpu import native
 
-    if dims <= 3 and native.enabled(use_native):
-        # the C struct carries at most 3 dims (Node.dp[3])
+    if dims <= 3 and dims * bits_per_dim <= 64 and native.enabled(use_native):
+        # the C struct carries at most 3 dims (Node.dp[3]) and packs the
+        # interleaved prefix in a uint64 (wider keys would shift-count UB)
         max_bits = -1
         if max_recurse is not None:
             max_bits = _max_bits_for(qlo, qhi, dims, bits_per_dim, max_recurse)

@@ -220,14 +220,21 @@ def _device_supported(f: ast.Filter, sft: SimpleFeatureType) -> bool:
     return False
 
 
+def _is_i64(sft: SimpleFeatureType, attr: str) -> bool:
+    return sft.descriptor(attr).column_dtype == np.int64
+
+
 def device_columns_for(f: ast.Filter, sft: SimpleFeatureType) -> list[str]:
     """Device column names needed: ``attr`` for scalars, ``attr__x/__y`` for
-    point geometries."""
+    point geometries, ``attr__hi/__lo`` int32/uint32 planes for int64
+    scalars (Date/Long -- see ops/int64lanes.py)."""
     cols: list[str] = []
     for attr in sorted(ast.attributes_of(f)):
         desc = sft.descriptor(attr)
         if desc.is_point:
             cols += [f"{attr}__x", f"{attr}__y"]
+        elif desc.column_dtype == np.int64:
+            cols += [f"{attr}__hi", f"{attr}__lo"]
         elif desc.column_dtype is not None:
             cols.append(attr)
     return cols
@@ -293,12 +300,52 @@ def build_device_fn(f: ast.Filter, sft: SimpleFeatureType) -> Callable:
             lo = node.t0 if isinstance(node, ast.During) else node.lo
             hi = node.t1 if isinstance(node, ast.During) else node.hi
             attr = node.attr
+            if _is_i64(sft, attr):
+                import math
+
+                from geomesa_tpu.ops.int64lanes import cmp_jax
+
+                def f_rng64(
+                    cols, n, attr=attr, lo=math.ceil(lo), hi=math.floor(hi)
+                ):
+                    chi, clo = cols[f"{attr}__hi"], cols[f"{attr}__lo"]
+                    return cmp_jax(">=", chi, clo, lo) & cmp_jax(
+                        "<=", chi, clo, hi
+                    )
+                return f_rng64
             def f_rng(cols, n, attr=attr, lo=lo, hi=hi):
                 c = cols[attr]
                 return (c >= lo) & (c <= hi)
             return f_rng
         if isinstance(node, ast.Compare):
             attr, op, v = node.attr, node.op, node.value
+            if _is_i64(sft, attr):
+                import math
+
+                from geomesa_tpu.ops.int64lanes import cmp_jax
+
+                # Non-integer literals vs int64 lanes: round the bound so the
+                # integer compare is equivalent ('>5.5' == '>=6' == '>5').
+                if v != math.floor(v):
+                    if op in ("=", "<>"):
+                        const = op == "<>"
+                        def f_const(cols, n, const=const):
+                            import jax.numpy as jnp
+
+                            some = next(iter(cols.values()))
+                            return jnp.full(some.shape, const, dtype=bool)
+                        return f_const
+                    # c < 5.5 == c <= 5 ; c > 5.5 == c >= 6
+                    if op in ("<", "<="):
+                        op, v = "<=", math.floor(v)
+                    else:
+                        op, v = ">=", math.ceil(v)
+                else:
+                    v = int(v)
+
+                def f_cmp64(cols, n, attr=attr, op=op, v=v):
+                    return cmp_jax(op, cols[f"{attr}__hi"], cols[f"{attr}__lo"], v)
+                return f_cmp64
             ops = {
                 "=": lambda c: c == v,
                 "<>": lambda c: c != v,
@@ -311,6 +358,24 @@ def build_device_fn(f: ast.Filter, sft: SimpleFeatureType) -> Callable:
             return lambda cols, n, attr=attr, fn0=fn0: fn0(cols[attr])
         if isinstance(node, ast.In):
             attr, vals = node.attr, node.values
+            if _is_i64(sft, attr):
+                import math
+
+                from geomesa_tpu.ops.int64lanes import cmp_jax
+
+                ivals = [int(v) for v in vals if v == math.floor(v)]
+
+                def f_in64(cols, n, attr=attr, ivals=ivals):
+                    import jax.numpy as jnp
+
+                    chi, clo = cols[f"{attr}__hi"], cols[f"{attr}__lo"]
+                    if not ivals:
+                        return jnp.zeros(chi.shape, dtype=bool)
+                    m = cmp_jax("=", chi, clo, ivals[0])
+                    for v in ivals[1:]:
+                        m = m | cmp_jax("=", chi, clo, v)
+                    return m
+                return f_in64
             def f_in(cols, n, attr=attr, vals=vals):
                 c = cols[attr]
                 m = c == vals[0]
@@ -346,6 +411,28 @@ class CompiledFilter:
     @property
     def fully_on_device(self) -> bool:
         return self.residual_part is ast.Include
+
+    def pallas_scan(self, **kw):
+        """(count_fn, mask_fn) Pallas TPU kernels for the device part, or
+        None when the filter can't be tiled (callers use device_fn). Cached
+        per CompiledFilter and option set."""
+        if not hasattr(self, "_pallas"):
+            self._pallas = {}
+        key = tuple(sorted(kw.items()))
+        if key not in self._pallas:
+            from geomesa_tpu.ops.pallas_scan import (
+                PallasUnsupported,
+                build_pallas_scan,
+            )
+
+            try:
+                count_fn, mask_fn, _ = build_pallas_scan(
+                    self.device_part, self.sft, **kw
+                )
+                self._pallas[key] = (count_fn, mask_fn)
+            except PallasUnsupported:
+                self._pallas[key] = None
+        return self._pallas[key]
 
     def host_mask(self, batch: FeatureBatch) -> np.ndarray:
         """Exact full-filter mask (oracle path)."""

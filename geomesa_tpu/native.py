@@ -141,6 +141,8 @@ def zranges_native(qlo, qhi, bits_per_dim, max_ranges, max_bits=-1):
     dims = len(qlo)
     qlo_a = np.ascontiguousarray(np.asarray(qlo, dtype=np.uint64))
     qhi_a = np.ascontiguousarray(np.asarray(qhi, dtype=np.uint64))
+    # gm_zranges merges down to <= max_ranges before writing, so this
+    # capacity is never exceeded
     cap = max(int(max_ranges) * 2 + 16, 64)
     out_lo = np.empty(cap, dtype=np.uint64)
     out_hi = np.empty(cap, dtype=np.uint64)
@@ -149,17 +151,8 @@ def zranges_native(qlo, qhi, bits_per_dim, max_ranges, max_bits=-1):
         qlo_a, qhi_a, dims, bits_per_dim, max_ranges, max_bits,
         out_lo, out_hi, out_c, cap,
     )
-    if n < 0:  # capacity exceeded; retry bigger once
-        cap = cap * 8
-        out_lo = np.empty(cap, dtype=np.uint64)
-        out_hi = np.empty(cap, dtype=np.uint64)
-        out_c = np.empty(cap, dtype=np.uint8)
-        n = lib.gm_zranges(
-            qlo_a, qhi_a, dims, bits_per_dim, max_ranges, max_bits,
-            out_lo, out_hi, out_c, cap,
-        )
-        if n < 0:
-            return None
+    if n < 0:
+        return None
     return [
         IndexRange(int(out_lo[i]), int(out_hi[i]), bool(out_c[i]))
         for i in range(n)

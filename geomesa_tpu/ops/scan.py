@@ -22,23 +22,32 @@ def stage_columns(
     dtype=None,
 ):
     """Slice + upload the named device columns ("attr" scalar columns,
-    "attr__x"/"attr__y" point coordinates) as jax arrays."""
+    "attr__x"/"attr__y" point coordinates, "attr__hi"/"attr__lo" two-word
+    planes of int64 columns -- ops/int64lanes.py) as jax arrays."""
     import jax.numpy as jnp
+
+    from geomesa_tpu.ops.int64lanes import split_array_np
 
     stop = len(batch) if stop is None else stop
     out = {}
+    splits: dict = {}  # attr -> (hi, lo), computed once per i64 column
     for name in names:
         if name.endswith("__x") or name.endswith("__y"):
             attr = name[:-3]
             col = batch.column(attr)
             arr = col[start:stop, 0 if name.endswith("__x") else 1]
+        elif name.endswith("__hi") or name.endswith("__lo"):
+            attr = name[:-4]
+            if attr not in splits:
+                splits[attr] = split_array_np(batch.column(attr)[start:stop])
+            arr = splits[attr][0 if name.endswith("__hi") else 1]
         else:
             arr = batch.column(name)[start:stop]
         if dtype is not None and arr.dtype.kind == "f":
             arr = arr.astype(dtype)
         if arr.dtype in (np.int64, np.uint64):
-            # Date columns are epoch-ms int64; without x64 jax would silently
-            # downcast to int32 and ms literals would overflow.
+            # Residual int64 columns (non-split callers) need x64 lanes, else
+            # jax silently downcasts to int32 and ms literals overflow.
             from geomesa_tpu.jaxconf import require_x64
 
             require_x64()

@@ -98,3 +98,14 @@ def test_huge_interval_range_budget():
     ks_day = Z3KeySpace("geom", "dtg", TimePeriod.DAY)
     ranges = ks_day.scan_ranges(geoms, intervals, max_ranges=2000)
     assert len(ranges) == 1
+
+
+def test_wide_key_zranges_skips_native():
+    """dims * bits_per_dim > 64 must not reach the C path (uint64 prefix
+    shifts would be UB); the Python oracle handles wide keys."""
+    from geomesa_tpu.curves.zranges import zranges
+
+    lo, hi = (1, 2, 3), (2**22 - 2, 2**22 - 3, 2**22 - 5)
+    with_native = zranges(lo, hi, bits_per_dim=22)
+    without = zranges(lo, hi, bits_per_dim=22, use_native=False)
+    assert with_native == without

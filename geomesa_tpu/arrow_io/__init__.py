@@ -1,0 +1,36 @@
+"""Arrow columnar layer (ref: geomesa-arrow -- ArrowSimpleFeatureVector,
+vector/GeometryVector impls, io/DeltaWriter, io/ArrowStreamReader,
+ArrowEncodedSft [UNVERIFIED - empty reference mount]).
+
+Geometries are typed Arrow vectors, not WKT blobs: points are fixed-width
+``struct<x: float64, y: float64>`` (the reference's PointVector twin child
+vectors), lines are ``list<point>``, polygons ``list<list<point>>`` and so
+on. String attributes dictionary-encode. The SFT rides in schema metadata
+so a bare IPC stream is self-describing -- the reference's ArrowEncodedSft
+role. Sorted per-partition streams merge with a k-way heap, the client-side
+half of the reference's DeltaWriter/reader protocol.
+"""
+
+from geomesa_tpu.arrow_io.schema import (
+    arrow_schema_for,
+    batch_to_arrow,
+    arrow_to_batch,
+    sft_from_schema,
+)
+from geomesa_tpu.arrow_io.io import (
+    ArrowStreamWriter,
+    read_feature_stream,
+    merge_sorted_streams,
+    write_feature_stream,
+)
+
+__all__ = [
+    "arrow_schema_for",
+    "batch_to_arrow",
+    "arrow_to_batch",
+    "sft_from_schema",
+    "ArrowStreamWriter",
+    "read_feature_stream",
+    "write_feature_stream",
+    "merge_sorted_streams",
+]

@@ -1,0 +1,160 @@
+"""Arrow IPC streaming: writer, reader, k-way sorted merge.
+
+Ref roles (geomesa-arrow .../io/ [UNVERIFIED - empty reference mount]):
+- ``ArrowStreamWriter``/``write_feature_stream`` = DeltaWriter minus the
+  server/client delta protocol -- batches stream out under one
+  self-describing schema (SFT in metadata, dictionary-encoded strings).
+- ``read_feature_stream`` = ArrowStreamReader: streams FeatureBatches.
+- ``merge_sorted_streams`` = the reader's sorted-batch merge: given
+  per-partition streams each sorted by a key attribute, yields globally
+  sorted batches (heap merge on host; partitions were sorted on device by
+  the index build's lax.sort).
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import numpy as np
+
+from geomesa_tpu.arrow_io.schema import (
+    arrow_schema_for,
+    arrow_to_batch,
+    batch_to_arrow,
+    sft_from_schema,
+)
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import SimpleFeatureType
+
+
+class ArrowStreamWriter:
+    """Streams FeatureBatches to a binary file/buffer as Arrow IPC."""
+
+    def __init__(
+        self,
+        sink,
+        sft: SimpleFeatureType,
+        dict_encode: "tuple[str, ...] | None" = None,
+        with_visibility: bool = False,
+    ):
+        import pyarrow as pa
+
+        self.schema = arrow_schema_for(
+            sft, dict_encode, with_visibility=with_visibility
+        )
+        self.sft = sft
+        self._writer = pa.ipc.new_stream(sink, self.schema)
+        self.batches = 0
+
+    def write(self, batch: FeatureBatch) -> None:
+        self._writer.write_batch(batch_to_arrow(batch, self.schema))
+        self.batches += 1
+
+    def close(self) -> None:
+        self._writer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_feature_stream(sink, batches, sft=None, **kw) -> int:
+    """Write an iterable of FeatureBatches as one IPC stream; returns the
+    batch count."""
+    from geomesa_tpu.security import VIS_COLUMN
+
+    batches = iter(batches)
+    first = next(batches, None)
+    if first is None:
+        if sft is None:
+            raise ValueError("empty stream needs an explicit sft")
+        with ArrowStreamWriter(sink, sft, **kw):
+            pass
+        return 0
+    kw.setdefault("with_visibility", VIS_COLUMN in first.columns)
+    with ArrowStreamWriter(sink, sft or first.sft, **kw) as w:
+        w.write(first)
+        for b in batches:
+            w.write(b)
+        return w.batches
+
+
+def read_feature_stream(source, sft: "SimpleFeatureType | None" = None):
+    """Yield FeatureBatches from an IPC stream; the SFT comes from stream
+    metadata unless overridden."""
+    import pyarrow as pa
+
+    with pa.ipc.open_stream(source) as reader:
+        stream_sft = sft or sft_from_schema(reader.schema)
+        for rb in reader:
+            yield arrow_to_batch(rb, stream_sft)
+
+
+def merge_sorted_streams(streams, key: str, batch_size: int = 8192):
+    """K-way merge of per-partition FeatureBatch iterators, each already
+    sorted ascending by scalar attribute ``key``; yields globally sorted
+    batches of ~batch_size. Heap holds one (head value, stream) entry per
+    live stream."""
+    iters = [iter(s) for s in streams]
+    cursors: list = [None] * len(iters)  # per stream: [batch, vals, pos]
+    heap: list = []
+    sft = None
+
+    def load(sid: int) -> None:
+        nonlocal sft
+        b = next(iters[sid], None)
+        while b is not None and len(b) == 0:
+            b = next(iters[sid], None)
+        if b is None:
+            cursors[sid] = None
+            return
+        sft = sft or b.sft
+        cursors[sid] = [b, b.column(key), 0]
+        heapq.heappush(heap, (cursors[sid][1][0], sid))
+
+    for sid in range(len(iters)):
+        load(sid)
+
+    rows: list = []  # (batch, row-index) picks in output order
+    while heap:
+        _, sid = heapq.heappop(heap)
+        b, vals, pos = cursors[sid]
+        rows.append((b, pos))
+        pos += 1
+        if pos < len(b):
+            cursors[sid][2] = pos
+            heapq.heappush(heap, (vals[pos], sid))
+        else:
+            load(sid)
+        if len(rows) >= batch_size:
+            yield _take_rows(sft, rows)
+            rows = []
+    if rows:
+        yield _take_rows(sft, rows)
+
+
+def _take_rows(sft, rows) -> FeatureBatch:
+    """Gather (batch, row) picks into one FeatureBatch, grouped per source
+    batch so the column gathers stay vectorized."""
+    groups: dict = {}
+    for j, (batch, i) in enumerate(rows):
+        groups.setdefault(id(batch), (batch, []))[1].append((i, j))
+    n = len(rows)
+    pieces = []
+    for batch, picks in groups.values():
+        idx = np.array([i for i, _ in picks])
+        dst = np.array([j for _, j in picks])
+        pieces.append((batch.take(idx), dst))
+    out_cols: dict = {}
+    for a in sft.attributes:
+        first = pieces[0][0].columns[a.name]
+        buf = np.empty((n,) + first.shape[1:], dtype=first.dtype)
+        for taken, dst in pieces:
+            buf[dst] = taken.columns[a.name]
+        out_cols[a.name] = buf
+    fids = np.empty(n, dtype=object)
+    for taken, dst in pieces:
+        fids[dst] = taken.fids
+    return FeatureBatch.from_columns(sft, out_cols, fids)

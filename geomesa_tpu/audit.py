@@ -1,0 +1,125 @@
+"""Query audit log (ref: geomesa-index-api .../audit/ -- AuditWriter,
+AuditedEvent, AccumuloAuditWriter writing async to a ``<catalog>_queries``
+table [UNVERIFIED - empty reference mount]).
+
+Each executed query emits an AuditedEvent (who, type name, filter string,
+planning/scanning millis, hits). Events are appended asynchronously (a
+daemon writer thread draining a queue, like the reference's async writer)
+as JSON lines to ``<root>/_queries.jsonl`` for filesystem stores, or held
+in memory for in-memory stores.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class AuditedEvent:
+    store: str
+    type_name: str
+    filter: str
+    user: str = ""
+    planning_ms: float = 0.0
+    scanning_ms: float = 0.0
+    hits: int = 0
+    ts: float = field(default_factory=time.time)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), sort_keys=True)
+
+
+class AuditWriter:
+    """Async audit sink. Subclasses implement _write(event)."""
+
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._started = False
+        self._lock = threading.Lock()
+
+    def write(self, event: AuditedEvent) -> None:
+        with self._lock:
+            if not self._started:
+                self._thread.start()
+                self._started = True
+        self._q.put(event)
+
+    def flush(self, timeout: float = 5.0) -> None:
+        if self._started:
+            # unfinished_tasks (not empty()) -- the drain thread removes an
+            # event from the queue before _write completes
+            deadline = time.time() + timeout
+            while self._q.unfinished_tasks and time.time() < deadline:
+                time.sleep(0.005)
+
+    def _drain(self) -> None:
+        while True:
+            ev = self._q.get()
+            try:
+                self._write(ev)
+            except Exception:
+                pass  # audit must never take down the query path
+            finally:
+                self._q.task_done()
+
+    def _write(self, event: AuditedEvent) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class MemoryAuditWriter(AuditWriter):
+    def __init__(self):
+        super().__init__()
+        self.events: list = []
+
+    def _write(self, event: AuditedEvent) -> None:
+        self.events.append(event)
+
+
+class FileAuditWriter(AuditWriter):
+    """JSONL audit file -- the `<catalog>_queries` table analog."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._flock = threading.Lock()
+
+    def _write(self, event: AuditedEvent) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with self._flock, open(self.path, "a") as fh:
+            fh.write(event.to_json() + "\n")
+
+    def read_events(self) -> list:
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path) as fh:
+            return [AuditedEvent(**json.loads(line)) for line in fh if line.strip()]
+
+
+def observe_query(store, type_name, plan, t0, t1, t2, result, audit_writer):
+    """Bump query metrics and emit the audit event (ref AuditWriter +
+    micrometer instrumentation); shared by every store implementation and
+    guaranteed never to throw into the query path."""
+    try:
+        from geomesa_tpu.metrics import queries_run, query_seconds
+
+        queries_run.inc(store=store, type=type_name)
+        query_seconds.observe(t2 - t0)
+        if audit_writer is not None:
+            audit_writer.write(
+                AuditedEvent(
+                    store=store,
+                    type_name=type_name,
+                    filter=str(plan.query.filter),
+                    planning_ms=(t1 - t0) * 1e3,
+                    scanning_ms=(t2 - t1) * 1e3,
+                    hits=len(result),
+                )
+            )
+    except Exception:  # pragma: no cover - observability must not break reads
+        pass

@@ -1,0 +1,284 @@
+"""Avro feature serialization: container-file writer/reader per SFT.
+
+Ref role: geomesa-features/geomesa-feature-avro AvroFeatureSerializer +
+AvroDataFileWriter [UNVERIFIED - empty reference mount] -- the Avro export
+format and avro ingest input. No Avro library ships in this image, so this
+implements the Avro 1.x wire spec directly (zigzag varints, object
+container files, null codec): ~the same scope the reference gets from the
+avro-java dependency.
+
+Schema mapping: one Avro record per SFT; scalar attrs map to native Avro
+types (Date = long/timestamp-millis), geometries to WKT strings (the
+reference offers WKB or WKT geometry encodings; WKT keeps the files
+readable and the codec dependency-free). Every field is nullable via
+["null", T] unions, plus a non-null "__fid__" string field.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+
+import numpy as np
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import SimpleFeatureType
+
+MAGIC = b"Obj\x01"
+
+_AVRO_TYPES = {
+    "String": "string",
+    "Integer": "int",
+    "Long": "long",
+    "Float": "float",
+    "Double": "double",
+    "Boolean": "boolean",
+}
+
+
+def avro_schema(sft: SimpleFeatureType) -> dict:
+    fields = [{"name": "__fid__", "type": "string"}]
+    for a in sft.attributes:
+        if a.is_geometry:
+            t: object = "string"  # WKT
+        elif a.type_name == "Date":
+            t = {"type": "long", "logicalType": "timestamp-millis"}
+        else:
+            t = _AVRO_TYPES.get(a.type_name, "string")
+        fields.append({"name": a.name, "type": ["null", t]})
+    return {
+        "type": "record",
+        "name": sft.type_name or "feature",
+        "namespace": "geomesa_tpu",
+        "fields": fields,
+        "geomesa.sft.spec": sft.spec,
+    }
+
+
+# -- primitive encoders ------------------------------------------------------
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _unzigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def write_long(buf: io.BytesIO, n: int) -> None:
+    n = _zigzag(int(n)) & 0xFFFFFFFFFFFFFFFF
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            buf.write(bytes([b | 0x80]))
+        else:
+            buf.write(bytes([b]))
+            return
+
+
+def read_long(buf) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        (b,) = buf.read(1)
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return _unzigzag(acc)
+        shift += 7
+
+
+def write_bytes(buf, b: bytes) -> None:
+    write_long(buf, len(b))
+    buf.write(b)
+
+
+def read_bytes(buf) -> bytes:
+    return buf.read(read_long(buf))
+
+
+def write_string(buf, s: str) -> None:
+    write_bytes(buf, s.encode("utf-8"))
+
+
+# -- per-attribute value codecs ---------------------------------------------
+
+
+def _value_codec(type_name: str, is_geometry: bool):
+    """(write(buf, v), read(buf)) for the non-null branch."""
+    if is_geometry or type_name == "String":
+        if is_geometry:
+            from geomesa_tpu.geom.wkt import parse_wkt, to_wkt
+
+            return (
+                lambda buf, v: write_string(
+                    buf, v if isinstance(v, str) else to_wkt(v)
+                ),
+                lambda buf: parse_wkt(read_bytes(buf).decode("utf-8")),
+            )
+        return (
+            lambda buf, v: write_string(buf, str(v)),
+            lambda buf: read_bytes(buf).decode("utf-8"),
+        )
+    if type_name in ("Integer", "Long", "Date"):
+        return write_long, read_long
+    if type_name == "Float":
+        return (
+            lambda buf, v: buf.write(struct.pack("<f", float(v))),
+            lambda buf: struct.unpack("<f", buf.read(4))[0],
+        )
+    if type_name == "Double":
+        return (
+            lambda buf, v: buf.write(struct.pack("<d", float(v))),
+            lambda buf: struct.unpack("<d", buf.read(8))[0],
+        )
+    if type_name == "Boolean":
+        return (
+            lambda buf, v: buf.write(b"\x01" if v else b"\x00"),
+            lambda buf: buf.read(1) == b"\x01",
+        )
+    # unknown types: stringly
+    return (
+        lambda buf, v: write_string(buf, str(v)),
+        lambda buf: read_bytes(buf).decode("utf-8"),
+    )
+
+
+def _is_null(v) -> bool:
+    return v is None
+
+
+class AvroDataFileWriter:
+    """Writes FeatureBatches to an Avro object container file (null
+    codec), one record per feature."""
+
+    def __init__(self, sink, sft: SimpleFeatureType, sync_interval: int = 4000):
+        self.sink = sink
+        self.sft = sft
+        self.sync = os.urandom(16)
+        self.sync_interval = sync_interval
+        self._codecs = [
+            (a.name, a.is_geometry, _value_codec(a.type_name, a.is_geometry))
+            for a in sft.attributes
+        ]
+        header = io.BytesIO()
+        header.write(MAGIC)
+        meta = {
+            "avro.schema": json.dumps(avro_schema(sft)).encode(),
+            "avro.codec": b"null",
+        }
+        write_long(header, len(meta))
+        for k, v in meta.items():
+            write_string(header, k)
+            write_bytes(header, v)
+        write_long(header, 0)  # end of metadata map
+        header.write(self.sync)
+        sink.write(header.getvalue())
+
+    def write(self, batch: FeatureBatch) -> None:
+        for start in range(0, len(batch), self.sync_interval):
+            self._write_block(batch, start, min(len(batch), start + self.sync_interval))
+
+    def _write_block(self, batch: FeatureBatch, start: int, stop: int) -> None:
+        block = io.BytesIO()
+        from geomesa_tpu.geom import Point
+
+        for i in range(start, stop):
+            write_string(block, str(batch.fids[i]))
+            for name, is_geom, (enc, _) in self._codecs:
+                col = batch.columns[name]
+                if is_geom and col.dtype != object:
+                    v: object = Point(float(col[i, 0]), float(col[i, 1]))
+                else:
+                    v = col[i]
+                if _is_null(v):
+                    write_long(block, 0)  # union branch: null
+                else:
+                    write_long(block, 1)
+                    enc(block, v)
+        out = io.BytesIO()
+        write_long(out, stop - start)
+        write_bytes(out, block.getvalue())
+        out.write(self.sync)
+        self.sink.write(out.getvalue())
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_avro(sink, batch: FeatureBatch) -> None:
+    with AvroDataFileWriter(sink, batch.sft) as w:
+        w.write(batch)
+
+
+def read_avro(source, sft: "SimpleFeatureType | None" = None) -> FeatureBatch:
+    """Read an entire container file into one FeatureBatch. The SFT comes
+    from the embedded spec when present, else from the Avro schema shape,
+    unless given explicitly."""
+    if hasattr(source, "read"):
+        data = source.read()
+    else:
+        with open(source, "rb") as fh:
+            data = fh.read()
+    buf = io.BytesIO(data)
+    if buf.read(4) != MAGIC:
+        raise ValueError("not an Avro object container file")
+    meta: dict = {}
+    while True:
+        n = read_long(buf)
+        if n == 0:
+            break
+        if n < 0:  # spec: negative count means a byte size follows
+            n = -n
+            read_long(buf)
+        for _ in range(n):
+            k = read_bytes(buf).decode()
+            meta[k] = read_bytes(buf)
+    if meta.get("avro.codec", b"null") not in (b"null", b""):
+        raise ValueError(f"unsupported avro codec {meta['avro.codec']!r}")
+    schema = json.loads(meta["avro.schema"].decode())
+    if sft is None:
+        spec = schema.get("geomesa.sft.spec")
+        if not spec:
+            raise ValueError("avro file carries no geomesa spec; pass sft=")
+        sft = SimpleFeatureType.create(schema.get("name", "feature"), spec)
+    sync = buf.read(16)
+    codecs = [
+        (a.name, _value_codec(a.type_name, a.is_geometry))
+        for a in sft.attributes
+    ]
+    fids = []
+    rows: dict = {name: [] for name, _ in codecs}
+    while True:
+        head = buf.read(1)
+        if not head:
+            break
+        buf.seek(-1, 1)
+        count = read_long(buf)
+        block = io.BytesIO(read_bytes(buf))
+        if buf.read(16) != sync:
+            raise ValueError("sync marker mismatch (corrupt file)")
+        for _ in range(count):
+            fids.append(read_bytes(block).decode())
+            for name, (_, dec) in codecs:
+                branch = read_long(block)
+                rows[name].append(None if branch == 0 else dec(block))
+    cols: dict = {}
+    for a in sft.attributes:
+        vals = rows[a.name]
+        if a.is_geometry or a.column_dtype is None:
+            cols[a.name] = vals
+        else:
+            cols[a.name] = np.array(
+                [0 if v is None else v for v in vals], dtype=a.column_dtype
+            )
+    return FeatureBatch.from_columns(sft, cols, np.array(fids, dtype=object))

@@ -73,13 +73,28 @@ class FeatureBatch:
 
     @staticmethod
     def concat(batches: "list[FeatureBatch]") -> "FeatureBatch":
+        from geomesa_tpu.security import VIS_COLUMN
+
         if not batches:
             raise ValueError("no batches")
         sft = batches[0].sft
-        cols = {
-            name: np.concatenate([b.columns[name] for b in batches])
-            for name in batches[0].columns
-        }
+        names = set()
+        for b in batches:
+            names.update(b.columns)
+        cols = {}
+        for name in names:
+            parts = []
+            for b in batches:
+                if name in b.columns:
+                    parts.append(b.columns[name])
+                elif name == VIS_COLUMN:
+                    # unlabeled batches mixed with labeled ones: public rows
+                    parts.append(np.array([""] * len(b), dtype=object))
+                else:
+                    raise KeyError(
+                        f"column {name!r} missing from a concatenated batch"
+                    )
+            cols[name] = np.concatenate(parts)
         fids = np.concatenate([b.fids for b in batches])
         return FeatureBatch(sft, fids, cols)
 
@@ -98,6 +113,24 @@ class FeatureBatch:
             self.fids[idx],
             {k: v[idx] for k, v in self.columns.items()},
         )
+
+    def with_visibility(self, vis) -> "FeatureBatch":
+        """Attach per-feature visibility labels (security.VIS_COLUMN
+        reserved column; ref 'geomesa.feature.visibility' user data)."""
+        from geomesa_tpu.security import VIS_COLUMN
+
+        vis = np.asarray(vis, dtype=object)
+        if len(vis) != len(self):
+            raise ValueError("visibility length mismatch")
+        cols = dict(self.columns)
+        cols[VIS_COLUMN] = vis
+        return FeatureBatch(self.sft, self.fids, cols)
+
+    @property
+    def visibilities(self) -> "np.ndarray | None":
+        from geomesa_tpu.security import VIS_COLUMN
+
+        return self.columns.get(VIS_COLUMN)
 
     def point_coords(self, name: str | None = None):
         """(x, y) float64 arrays for a Point column (default geometry)."""
@@ -134,7 +167,13 @@ class FeatureBatch:
         """
         import pyarrow as pa
 
+        from geomesa_tpu.security import VIS_COLUMN
+
         arrays = {"__fid__": pa.array(self.fids.tolist())}
+        if VIS_COLUMN in self.columns:
+            arrays[VIS_COLUMN] = pa.array(
+                [str(v) for v in self.columns[VIS_COLUMN]], pa.string()
+            )
         for attr in self.sft.attributes:
             col = self.columns[attr.name]
             if attr.is_geometry:
@@ -177,7 +216,14 @@ class FeatureBatch:
             if "__fid__" in names
             else None
         )
-        return FeatureBatch.from_columns(sft, cols, fids)
+        batch = FeatureBatch.from_columns(sft, cols, fids)
+        from geomesa_tpu.security import VIS_COLUMN
+
+        if VIS_COLUMN in names:
+            batch = batch.with_visibility(
+                table.column(VIS_COLUMN).to_pylist()
+            )
+        return batch
 
 
 def _coerce_geometry(vals, is_point: bool) -> np.ndarray:

@@ -1,0 +1,180 @@
+"""Metrics registry with Prometheus text exposition (ref: geomesa-metrics
+-- dropwizard/micrometer reporters, micrometer/PrometheusSetup, wired into
+ingest/converters [UNVERIFIED - empty reference mount]).
+
+Tiny dependency-free core: Counter / Gauge / Histogram(+timer) with label
+support, a process-global registry, and the Prometheus text format for
+scraping. Converters and store write/query paths increment these; hosts
+can serve ``prometheus_text()`` from any HTTP endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_left
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self._values: dict = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **labels) -> tuple:
+        return tuple(sorted(labels.items()))
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_, "counter")
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self.labels(**labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self.labels(**labels), 0.0)
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_, "gauge")
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            self._values[self.labels(**labels)] = float(v)
+
+    def value(self, **labels) -> float:
+        return self._values.get(self.labels(**labels), 0.0)
+
+
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0
+)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram; ``time()`` context manager included."""
+
+    def __init__(self, name, help_="", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_, "histogram")
+        self.buckets = tuple(sorted(buckets))
+
+    def observe(self, v: float, **labels) -> None:
+        key = self.labels(**labels)
+        with self._lock:
+            st = self._values.setdefault(
+                key, {"counts": [0] * (len(self.buckets) + 1), "sum": 0.0, "n": 0}
+            )
+            # le-bucket: first bound >= v (cumulated at exposition time);
+            # past the last bound lands in the trailing +Inf slot
+            st["counts"][bisect_left(self.buckets, v)] += 1
+            st["sum"] += v
+            st["n"] += 1
+
+    def time(self, **labels):
+        return _Timer(self, labels)
+
+    def stats(self, **labels) -> dict:
+        return self._values.get(self.labels(**labels), {"counts": [], "sum": 0.0, "n": 0})
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: dict):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0, **self.labels)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: dict = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get(
+            name, lambda: Histogram(name, help_, buckets), Histogram
+        )
+
+    def _get(self, name, factory, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} is a {m.kind}")
+            return m
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (text/plain; version 0.0.4)."""
+        lines: list = []
+        for name, m in sorted(self._metrics.items()):
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            if isinstance(m, (Counter, Gauge)):
+                for key, v in sorted(m._values.items()):
+                    lines.append(f"{name}{_fmt_labels(key)} {_fmt_val(v)}")
+            else:
+                for key, st in sorted(m._values.items()):
+                    cum = 0
+                    for b, c in zip(
+                        m.buckets + (float("inf"),), st["counts"]
+                    ):
+                        cum += c
+                        lb = "+Inf" if b == float("inf") else _fmt_val(b)
+                        lines.append(
+                            f"{name}_bucket{_fmt_labels(key + (('le', lb),))} {cum}"
+                        )
+                    lines.append(f"{name}_sum{_fmt_labels(key)} {_fmt_val(st['sum'])}")
+                    lines.append(f"{name}_count{_fmt_labels(key)} {st['n']}")
+        return "\n".join(lines) + "\n"
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+def _fmt_labels(key) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _fmt_val(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+REGISTRY = MetricsRegistry()
+
+# canonical framework metrics (ref instruments converters + ingest)
+features_ingested = REGISTRY.counter(
+    "geomesa_features_ingested_total", "features written to stores"
+)
+features_failed = REGISTRY.counter(
+    "geomesa_convert_failures_total", "converter records failed"
+)
+queries_run = REGISTRY.counter(
+    "geomesa_queries_total", "queries executed"
+)
+query_seconds = REGISTRY.histogram(
+    "geomesa_query_duration_seconds", "end-to-end query latency"
+)

@@ -41,7 +41,16 @@ def run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
     hit_chunks: list[np.ndarray] = []
     if parts:
         use_device = bool(compiled.device_cols)
-        jitted = jax.jit(compiled.device_fn) if use_device else None
+        jitted = None
+        if use_device:
+            # Pallas tile kernel on real TPUs; XLA-fused jnp elsewhere
+            # (interpret-mode pallas would crawl) or when not tileable
+            scan = (
+                compiled.pallas_scan()
+                if jax.devices()[0].platform == "tpu"
+                else None
+            )
+            jitted = jax.jit(scan[1] if scan else compiled.device_fn)
         for p in parts:
             if use_device:
                 cols = stage_columns(
@@ -68,8 +77,19 @@ def run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
 
 
 def _post_process(batch: FeatureBatch, plan: QueryPlan) -> FeatureBatch:
-    """sort / max-features / projection (ref LocalQueryRunner)."""
+    """visibility / sort / max-features / projection (ref
+    LocalQueryRunner + Accumulo cell-visibility filtering)."""
     q = plan.query
+    # Accumulo semantics: a labeled feature is hidden unless the query's
+    # auths satisfy it -- including when no auths were supplied at all.
+    # Internal per-partition scans (fs store) defer this to the outer,
+    # global post-process so the real auths are the ones applied.
+    if not q.hints.get("internal_scan"):
+        from geomesa_tpu.security import filter_by_visibility
+
+        m = filter_by_visibility(batch, q.hints.get("auths", ()))
+        if m is not None:
+            batch = batch.take(np.nonzero(m)[0])
     if q.sort_by:
         order = np.argsort(batch.column(q.sort_by), kind="stable")
         if q.sort_desc:

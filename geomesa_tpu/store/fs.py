@@ -47,12 +47,22 @@ class _FsTypeState:
 
 class FileSystemDataStore:
     def __init__(
-        self, root: str, partition_size: int = DEFAULT_PARTITION_SIZE
+        self,
+        root: str,
+        partition_size: int = DEFAULT_PARTITION_SIZE,
+        audit: bool = False,
     ):
         self.root = root
         self.partition_size = partition_size
         self._types: dict[str, _FsTypeState] = {}
         os.makedirs(root, exist_ok=True)
+        self.audit_writer = None
+        if audit:  # the <catalog>_queries table analog
+            from geomesa_tpu.audit import FileAuditWriter
+
+            self.audit_writer = FileAuditWriter(
+                os.path.join(root, "_queries.jsonl")
+            )
         for name in sorted(os.listdir(root)):
             meta_path = os.path.join(root, name, "schema.json")
             if os.path.exists(meta_path):
@@ -203,8 +213,12 @@ class FileSystemDataStore:
 
     def query(self, type_name: str, query: "Query | str | ast.Filter" = ast.Include) -> QueryResult:
         """Partition-pruned scan over parquet files."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         st = self._types[type_name]
         plan = self.plan(type_name, query)
+        t1 = _time.perf_counter()
         # prune by manifest
         parts = st.partitions
         if plan.ranges is not None:
@@ -220,7 +234,10 @@ class FileSystemDataStore:
         # happens once, globally, after the merge
         import dataclasses
 
-        inner_plan = dataclasses.replace(plan, query=Query(filter=plan.filter))
+        inner_plan = dataclasses.replace(
+            plan,
+            query=Query(filter=plan.filter, hints={"internal_scan": True}),
+        )
         for p in parts:
             batch = self._read_partition(type_name, p.pid)
             scanned += len(batch)
@@ -248,9 +265,15 @@ class FileSystemDataStore:
             )
             out = empty
         from geomesa_tpu.query.runner import _post_process
+        from geomesa_tpu.audit import observe_query
 
         out = _post_process(out, plan)
-        return QueryResult(out, plan, scanned, total)
+        result = QueryResult(out, plan, scanned, total)
+        observe_query(
+            "fs", type_name, plan, t0, t1, _time.perf_counter(), result,
+            self.audit_writer,
+        )
+        return result
 
     def explain(self, type_name: str, query) -> str:
         return self.plan(type_name, query).explain()

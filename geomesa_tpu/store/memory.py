@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from geomesa_tpu.audit import observe_query
 from geomesa_tpu.features.batch import FeatureBatch
 from geomesa_tpu.features.sft import SimpleFeatureType
 from geomesa_tpu.filter import ast
@@ -36,9 +37,14 @@ class _TypeState:
 class MemoryDataStore:
     """create_schema / write / query / explain over in-memory partitions."""
 
-    def __init__(self, partition_size: int = DEFAULT_PARTITION_SIZE):
+    def __init__(
+        self,
+        partition_size: int = DEFAULT_PARTITION_SIZE,
+        audit_writer=None,
+    ):
         self._types: dict[str, _TypeState] = {}
         self.partition_size = partition_size
+        self.audit_writer = audit_writer  # geomesa_tpu.audit.AuditWriter
 
     # -- schema ------------------------------------------------------------
 
@@ -160,7 +166,11 @@ class MemoryDataStore:
         return plan_query(st.sft, indices, q, data_interval=st.data_interval)
 
     def query(self, type_name: str, query: "Query | str | ast.Filter" = ast.Include) -> QueryResult:
+        import time as _time
+
+        t0 = _time.perf_counter()
         plan = self.plan(type_name, query)  # flushes
+        t1 = _time.perf_counter()
         st = self._state(type_name)
         if st.data is None or len(st.data) == 0:
             from geomesa_tpu.query.runner import _post_process
@@ -172,8 +182,14 @@ class MemoryDataStore:
                     st.sft, {a.name: [] for a in st.sft.attributes}
                 )
             )
-            return QueryResult(_post_process(empty, plan), plan, 0, 0)
-        return run_query(st.indices[plan.index_name], plan)
+            result = QueryResult(_post_process(empty, plan), plan, 0, 0)
+        else:
+            result = run_query(st.indices[plan.index_name], plan)
+        observe_query(
+            "memory", type_name, plan, t0, t1, _time.perf_counter(), result,
+            self.audit_writer,
+        )
+        return result
 
     def explain(self, type_name: str, query: "Query | str | ast.Filter") -> str:
         return self.plan(type_name, query).explain()
@@ -202,3 +218,5 @@ def _as_query(q) -> Query:
     if isinstance(q, Query):
         return q
     return Query(filter=q)
+
+

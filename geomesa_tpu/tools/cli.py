@@ -114,16 +114,20 @@ def cmd_export(args):
     elif fmt == "json":
         _export_geojson(batch, out)
     elif fmt == "arrow":
-        import pyarrow as pa
+        # typed geometry vectors + dictionary strings + SFT metadata
+        from geomesa_tpu.arrow_io import write_feature_stream
 
-        table = batch.to_arrow()
-        with pa.OSFile(out, "wb") as sink:
-            with pa.ipc.new_file(sink, table.schema) as w:
-                w.write_table(table)
+        with open(out, "wb") as sink:
+            write_feature_stream(sink, [batch], sft=batch.sft)
     elif fmt == "parquet":
         import pyarrow.parquet as pq
 
         pq.write_table(batch.to_arrow(), out)
+    elif fmt == "avro":
+        from geomesa_tpu.features.avro import write_avro
+
+        with open(out, "wb") as fh:
+            write_avro(fh, batch)
     elif fmt == "bin":
         from geomesa_tpu.process import encode_bin
 
@@ -268,7 +272,7 @@ def main(argv=None) -> None:
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-q", "--cql")
     sp.add_argument("-F", "--format", default="csv",
-                    choices=["csv", "json", "arrow", "parquet", "bin"])
+                    choices=["csv", "json", "arrow", "parquet", "bin", "avro"])
     sp.add_argument("-o", "--output", default="-")
     sp.add_argument("-m", "--max-features", type=int)
     sp.add_argument("-a", "--attributes", help="comma-separated projection")

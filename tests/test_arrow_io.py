@@ -1,0 +1,166 @@
+"""Arrow columnar layer: typed geometry vectors, IPC round-trips,
+dictionary encoding, self-describing schemas, sorted-stream merge."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from geomesa_tpu.arrow_io import (
+    arrow_schema_for,
+    arrow_to_batch,
+    batch_to_arrow,
+    merge_sorted_streams,
+    read_feature_stream,
+    sft_from_schema,
+    write_feature_stream,
+)
+from geomesa_tpu.features import FeatureBatch, SimpleFeatureType
+from geomesa_tpu.geom import parse_wkt
+from geomesa_tpu.geom.wkt import to_wkt
+
+
+def point_batch(n=50, seed=3):
+    rng = np.random.default_rng(seed)
+    sft = SimpleFeatureType.create(
+        "pts", "name:String,count:Int,dtg:Date,*geom:Point:srid=4326"
+    )
+    return FeatureBatch.from_columns(
+        sft,
+        {
+            "name": rng.choice(["alpha", "beta", None], n),
+            "count": rng.integers(0, 9, n),
+            "dtg": rng.integers(1_577_836_800_000, 1_580_000_000_000, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+            ),
+        },
+    )
+
+
+class TestSchema:
+    def test_point_is_struct_vector(self):
+        sch = arrow_schema_for(point_batch().sft)
+        f = sch.field("geom")
+        assert pa.types.is_struct(f.type)
+        assert f.type.field("x").type == pa.float64()
+
+    def test_strings_dictionary_encode(self):
+        sch = arrow_schema_for(point_batch().sft)
+        assert pa.types.is_dictionary(sch.field("name").type)
+
+    def test_sft_round_trips_via_metadata(self):
+        sft = point_batch().sft
+        back = sft_from_schema(arrow_schema_for(sft))
+        assert back.spec == sft.spec
+        assert back.type_name == sft.type_name
+
+    def test_no_metadata_raises(self):
+        with pytest.raises(ValueError):
+            sft_from_schema(pa.schema([pa.field("a", pa.int32())]))
+
+
+class TestRoundTrip:
+    def test_point_batch(self):
+        batch = point_batch()
+        back = arrow_to_batch(batch_to_arrow(batch))
+        np.testing.assert_allclose(back.column("geom"), batch.column("geom"))
+        np.testing.assert_array_equal(back.column("dtg"), batch.column("dtg"))
+        np.testing.assert_array_equal(
+            back.column("count"), batch.column("count")
+        )
+        assert list(back.column("name")) == list(batch.column("name"))
+        assert [str(f) for f in back.fids] == [str(f) for f in batch.fids]
+
+    @pytest.mark.parametrize(
+        "type_name,wkt",
+        [
+            ("LineString", "LINESTRING (0 0, 1 1, 2 0)"),
+            ("Polygon", "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))"),
+            ("MultiPoint", "MULTIPOINT (1 2, 3 4)"),
+            (
+                "MultiLineString",
+                "MULTILINESTRING ((0 0, 1 1), (2 2, 3 3, 4 2))",
+            ),
+            (
+                "MultiPolygon",
+                "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 1, 0 0)), "
+                "((5 5, 7 5, 7 7, 5 7, 5 5), (5.5 5.5, 6 5.5, 6 6, 5.5 6, 5.5 5.5)))",
+            ),
+        ],
+    )
+    def test_nested_geometry_vectors(self, type_name, wkt):
+        sft = SimpleFeatureType.create("g", f"*geom:{type_name}:srid=4326")
+        g = parse_wkt(wkt)
+        batch = FeatureBatch.from_columns(
+            sft, {"geom": np.array([g, None, g], dtype=object)}
+        )
+        rb = batch_to_arrow(batch)
+        assert not pa.types.is_string(rb.schema.field("geom").type)  # typed!
+        back = arrow_to_batch(rb)
+        col = back.column("geom")
+        assert col[1] is None
+        assert to_wkt(col[0]) == to_wkt(g)
+        assert to_wkt(col[2]) == to_wkt(g)
+
+
+class TestIpcStream:
+    def test_stream_round_trip_self_describing(self):
+        b1, b2 = point_batch(seed=1), point_batch(seed=2)
+        buf = io.BytesIO()
+        n = write_feature_stream(buf, [b1, b2])
+        assert n == 2
+        buf.seek(0)
+        got = list(read_feature_stream(buf))  # no SFT passed: metadata
+        assert len(got) == 2
+        np.testing.assert_allclose(
+            got[0].column("geom"), b1.column("geom")
+        )
+        np.testing.assert_array_equal(got[1].column("dtg"), b2.column("dtg"))
+
+    def test_empty_stream_needs_sft(self):
+        buf = io.BytesIO()
+        with pytest.raises(ValueError):
+            write_feature_stream(buf, [])
+        buf = io.BytesIO()
+        sft = point_batch().sft
+        assert write_feature_stream(buf, [], sft=sft) == 0
+        buf.seek(0)
+        assert list(read_feature_stream(buf)) == []
+
+
+class TestSortedMerge:
+    def test_three_streams_merge_globally_sorted(self):
+        rng = np.random.default_rng(0)
+        batches = []
+        allvals = []
+        for s in range(3):
+            vals = np.sort(rng.integers(0, 10_000, 257))
+            allvals.append(vals)
+            sft = point_batch().sft
+            n = len(vals)
+            batches.append(
+                [
+                    FeatureBatch.from_columns(
+                        sft,
+                        {
+                            "name": np.array(["s%d" % s] * k, dtype=object),
+                            "count": np.zeros(k, np.int32),
+                            "dtg": chunk,
+                            "geom": np.zeros((k, 2)),
+                        },
+                        fids=np.arange(k),
+                    )
+                    for chunk in np.array_split(vals, 3)
+                    for k in [len(chunk)]
+                ]
+            )
+        out = list(merge_sorted_streams(batches, "dtg", batch_size=100))
+        merged = np.concatenate([b.column("dtg") for b in out])
+        expect = np.sort(np.concatenate(allvals))
+        np.testing.assert_array_equal(merged, expect)
+        assert all(len(b) <= 100 for b in out[:-1])
+
+    def test_merge_empty_streams(self):
+        assert list(merge_sorted_streams([[], []], "dtg")) == []

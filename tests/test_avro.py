@@ -1,0 +1,91 @@
+"""Avro container-file serializer round-trips (pure-python wire codec)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, SimpleFeatureType
+from geomesa_tpu.features.avro import (
+    AvroDataFileWriter,
+    read_avro,
+    read_long,
+    write_avro,
+    write_long,
+)
+from geomesa_tpu.geom import parse_wkt
+from geomesa_tpu.geom.wkt import to_wkt
+
+
+class TestVarints:
+    @pytest.mark.parametrize(
+        "v",
+        [0, 1, -1, 63, 64, -64, -65, 2**31 - 1, -(2**31), 2**62, -(2**62)],
+    )
+    def test_zigzag_round_trip(self, v):
+        buf = io.BytesIO()
+        write_long(buf, v)
+        buf.seek(0)
+        assert read_long(buf) == v
+
+    def test_small_values_one_byte(self):
+        for v in (0, -1, 1, -64, 63):
+            buf = io.BytesIO()
+            write_long(buf, v)
+            assert len(buf.getvalue()) == 1
+
+
+class TestContainerRoundTrip:
+    def test_point_batch(self, rng):
+        sft = SimpleFeatureType.create(
+            "t", "name:String,count:Int,score:Double,ok:Boolean,"
+            "dtg:Date,*geom:Point:srid=4326"
+        )
+        n = 500
+        batch = FeatureBatch.from_columns(
+            sft,
+            {
+                "name": rng.choice(["a", "b", None], n),
+                "count": rng.integers(-5, 100, n),
+                "score": rng.uniform(-1, 1, n),
+                "ok": rng.integers(0, 2, n).astype(bool),
+                "dtg": rng.integers(0, 2**45, n),
+                "geom": np.stack(
+                    [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)],
+                    axis=1,
+                ),
+            },
+        )
+        buf = io.BytesIO()
+        write_avro(buf, batch)
+        buf.seek(0)
+        back = read_avro(buf)  # SFT from embedded spec
+        assert back.sft.spec == sft.spec
+        np.testing.assert_array_equal(back.column("count"), batch.column("count"))
+        np.testing.assert_array_equal(back.column("dtg"), batch.column("dtg"))
+        np.testing.assert_array_equal(back.column("ok"), batch.column("ok"))
+        np.testing.assert_allclose(back.column("score"), batch.column("score"))
+        np.testing.assert_allclose(
+            back.column("geom"), batch.column("geom"), atol=1e-12
+        )
+        assert list(back.column("name")) == list(batch.column("name"))
+        assert [str(f) for f in back.fids] == [str(f) for f in batch.fids]
+
+    def test_multi_block_and_polygon(self, rng):
+        sft = SimpleFeatureType.create("p", "*geom:Polygon:srid=4326")
+        g = parse_wkt("POLYGON ((0 0, 3 0, 3 3, 0 3, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))")
+        n = 50
+        batch = FeatureBatch.from_columns(
+            sft, {"geom": np.array([g] * n, dtype=object)}
+        )
+        buf = io.BytesIO()
+        with AvroDataFileWriter(buf, sft, sync_interval=7) as w:
+            w.write(batch)  # forces 8 blocks
+        buf.seek(0)
+        back = read_avro(buf)
+        assert len(back) == n
+        assert to_wkt(back.column("geom")[n - 1]) == to_wkt(g)
+
+    def test_bad_magic_raises(self):
+        with pytest.raises(ValueError):
+            read_avro(io.BytesIO(b"nope"))

@@ -1,0 +1,110 @@
+"""Audit log + metrics registry (ref geomesa audit/metrics subsystems)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.audit import AuditedEvent, FileAuditWriter, MemoryAuditWriter
+from geomesa_tpu.features import FeatureBatch, SimpleFeatureType
+from geomesa_tpu.metrics import REGISTRY, MetricsRegistry
+from geomesa_tpu.store import MemoryDataStore
+from geomesa_tpu.store.fs import FileSystemDataStore
+
+
+def small_store(**kw):
+    sft = SimpleFeatureType.create("t", "count:Int,*geom:Point:srid=4326")
+    ds = MemoryDataStore(**kw)
+    ds.create_schema(sft)
+    ds.write(
+        "t", {"count": np.arange(10), "geom": np.zeros((10, 2))}
+    )
+    return ds
+
+
+class TestAudit:
+    def test_memory_store_audits_queries(self):
+        aw = MemoryAuditWriter()
+        ds = small_store(audit_writer=aw)
+        ds.query("t", "count < 5")
+        aw.flush()
+        assert len(aw.events) == 1
+        ev = aw.events[0]
+        assert ev.type_name == "t"
+        assert ev.hits == 5
+        assert ev.planning_ms >= 0 and ev.scanning_ms >= 0
+        assert "count" in ev.filter
+
+    def test_fs_store_audit_file(self, tmp_path):
+        root = str(tmp_path / "cat")
+        ds = FileSystemDataStore(root, audit=True)
+        sft = SimpleFeatureType.create("t", "count:Int,*geom:Point:srid=4326")
+        ds.create_schema(sft)
+        ds.write("t", {"count": np.arange(6), "geom": np.zeros((6, 2))})
+        ds.flush("t")
+        ds.query("t", "count >= 3")
+        ds.audit_writer.flush()
+        events = ds.audit_writer.read_events()
+        assert len(events) == 1
+        assert events[0].hits == 3
+        # round-trips through json
+        assert AuditedEvent(**{
+            k: v for k, v in events[0].__dict__.items()
+        }).hits == 3
+
+    def test_audit_never_breaks_query(self):
+        class Broken(MemoryAuditWriter):
+            def write(self, event):
+                raise RuntimeError("boom")
+
+        ds = small_store(audit_writer=Broken())
+        assert len(ds.query("t", "INCLUDE")) == 10  # no raise
+
+
+class TestMetrics:
+    def test_counter_labels(self):
+        r = MetricsRegistry()
+        c = r.counter("x_total", "help")
+        c.inc(store="a")
+        c.inc(2, store="a")
+        c.inc(store="b")
+        assert c.value(store="a") == 3
+        assert c.value(store="b") == 1
+        text = r.prometheus_text()
+        assert '# TYPE x_total counter' in text
+        assert 'x_total{store="a"} 3' in text
+
+    def test_histogram_buckets_cumulative(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        text = r.prometheus_text()
+        assert 'lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{le="1"} 3' in text
+        assert 'lat_seconds_bucket{le="10"} 4' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 5' in text
+        assert "lat_seconds_count 5" in text
+
+    def test_boundary_value_in_le_bucket(self):
+        r = MetricsRegistry()
+        h = r.histogram("h", buckets=(1.0, 2.0))
+        h.observe(1.0)  # le="1" must include exactly-1.0
+        assert 'h_bucket{le="1"} 1' in r.prometheus_text()
+
+    def test_gauge_and_kind_conflict(self):
+        r = MetricsRegistry()
+        g = r.gauge("g")
+        g.set(7, role="x")
+        assert g.value(role="x") == 7
+        with pytest.raises(TypeError):
+            r.counter("g")
+
+    def test_query_path_increments_global_registry(self):
+        before = REGISTRY.counter("geomesa_queries_total").value(
+            store="memory", type="t"
+        )
+        ds = small_store()
+        ds.query("t", "INCLUDE")
+        after = REGISTRY.counter("geomesa_queries_total").value(
+            store="memory", type="t"
+        )
+        assert after == before + 1

@@ -1,0 +1,181 @@
+"""Visibility expression parsing + auth filtering through the query path
+(ref geomesa-security VisibilityEvaluator semantics)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, SimpleFeatureType
+from geomesa_tpu.query.plan import Query
+from geomesa_tpu.security import (
+    AuthorizationsProvider,
+    VisibilityEvaluator,
+    VisibilityParseError,
+    parse_visibility,
+)
+from geomesa_tpu.store import MemoryDataStore
+
+
+class TestParsing:
+    @pytest.mark.parametrize(
+        "expr,auths,expect",
+        [
+            ("A", {"A"}, True),
+            ("A", {"B"}, False),
+            ("A&B", {"A", "B"}, True),
+            ("A&B", {"A"}, False),
+            ("A|B", {"B"}, True),
+            ("A|B", set(), False),
+            ("A&(B|C)", {"A", "C"}, True),
+            ("A&(B|C)", {"A"}, False),
+            ("A&(B|C)", {"B", "C"}, False),
+            ("(A|B)&(C|D)", {"B", "D"}, True),
+            ('"weird token"&A', {"weird token", "A"}, True),
+            ("", {"A"}, True),  # public
+            ("  ", set(), True),
+        ],
+    )
+    def test_evaluate(self, expr, auths, expect):
+        ev = VisibilityEvaluator(auths)
+        assert ev.can_see(expr) is expect
+
+    @pytest.mark.parametrize(
+        "bad", ["A&B|C", "A&&B", "(A", "A)", '"unterminated', "&A", "A!B"]
+    )
+    def test_parse_errors(self, bad):
+        with pytest.raises(VisibilityParseError):
+            parse_visibility(bad)
+
+    def test_none_is_public(self):
+        assert VisibilityEvaluator(set()).can_see(None)
+
+    def test_provider(self):
+        p = AuthorizationsProvider(["A", "B"])
+        assert p.get_authorizations() == ("A", "B")
+
+
+class TestQueryIntegration:
+    def make_store(self):
+        sft = SimpleFeatureType.create("s", "count:Int,*geom:Point:srid=4326")
+        n = 8
+        batch = FeatureBatch.from_columns(
+            sft,
+            {
+                "count": np.arange(n),
+                "geom": np.zeros((n, 2)),
+            },
+        ).with_visibility(
+            ["", "A", "B", "A&B", "A|B", "secret&(A|B)", "", "C"]
+        )
+        ds = MemoryDataStore()
+        ds.create_schema(sft)
+        ds.write("s", batch)
+        return ds
+
+    def query_counts(self, ds, auths):
+        res = ds.query("s", Query("INCLUDE", hints={"auths": auths}))
+        return sorted(res.batch.column("count").tolist())
+
+    def test_no_auths_sees_only_public(self):
+        ds = self.make_store()
+        assert self.query_counts(ds, ()) == [0, 6]
+
+    def test_single_auth(self):
+        ds = self.make_store()
+        assert self.query_counts(ds, ("A",)) == [0, 1, 4, 6]
+
+    def test_two_auths(self):
+        ds = self.make_store()
+        assert self.query_counts(ds, ("A", "B")) == [0, 1, 2, 3, 4, 6]
+
+    def test_secret_requires_both(self):
+        ds = self.make_store()
+        assert 5 in self.query_counts(ds, ("secret", "B"))
+        assert 5 not in self.query_counts(ds, ("secret",))
+
+    def test_unlabeled_store_unaffected(self):
+        sft = SimpleFeatureType.create("u", "count:Int,*geom:Point:srid=4326")
+        ds = MemoryDataStore()
+        ds.create_schema(sft)
+        ds.write(
+            "u", {"count": np.arange(4), "geom": np.zeros((4, 2))}
+        )
+        res = ds.query("u", Query("INCLUDE", hints={"auths": ("A",)}))
+        assert len(res.batch) == 4
+
+
+class TestVisibilityPersistence:
+    def test_fs_store_round_trips_labels(self, tmp_path):
+        from geomesa_tpu.store.fs import FileSystemDataStore
+
+        sft = SimpleFeatureType.create("s", "count:Int,*geom:Point:srid=4326")
+        root = str(tmp_path / "cat")
+        ds = FileSystemDataStore(root)
+        ds.create_schema(sft)
+        batch = FeatureBatch.from_columns(
+            sft, {"count": np.arange(3), "geom": np.zeros((3, 2))}
+        ).with_visibility(["secret", "secret", ""])
+        ds.write("s", batch)
+        ds.flush("s")
+        # reopen from disk: labels must survive the parquet round trip
+        ds2 = FileSystemDataStore(root)
+        res = ds2.query("s", Query("INCLUDE", hints={"auths": ()}))
+        assert sorted(res.batch.column("count").tolist()) == [2]
+        res = ds2.query("s", Query("INCLUDE", hints={"auths": ("secret",)}))
+        assert len(res.batch) == 3
+
+    def test_mixed_labeled_unlabeled_batches(self):
+        sft = SimpleFeatureType.create("m", "count:Int,*geom:Point:srid=4326")
+        ds = MemoryDataStore()
+        ds.create_schema(sft)
+        ds.write("m", {"count": [0, 1], "geom": np.zeros((2, 2))})
+        labeled = FeatureBatch.from_columns(
+            sft, {"count": [2, 3], "geom": np.zeros((2, 2))},
+            fids=np.array([10, 11]),
+        ).with_visibility(["secret", ""])
+        ds.write("m", labeled)
+        counts = sorted(
+            ds.query("m", Query("INCLUDE", hints={"auths": ()}))
+            .batch.column("count").tolist()
+        )
+        assert counts == [0, 1, 3]  # unlabeled rows public, secret hidden
+        # reversed write order (labeled first) must not crash either
+        ds2 = MemoryDataStore()
+        ds2.create_schema(SimpleFeatureType.create("m2", "count:Int,*geom:Point:srid=4326"))
+        ds2.write("m2", labeled_first := FeatureBatch.from_columns(
+            ds2.get_schema("m2"),
+            {"count": [9], "geom": np.zeros((1, 2))},
+        ).with_visibility(["secret"]))
+        ds2.write("m2", {"count": [7], "geom": np.zeros((1, 2))})
+        counts2 = sorted(
+            ds2.query("m2", Query("INCLUDE", hints={"auths": ()}))
+            .batch.column("count").tolist()
+        )
+        assert counts2 == [7]
+
+    def test_auths_none_fails_closed(self):
+        sft = SimpleFeatureType.create("n", "count:Int,*geom:Point:srid=4326")
+        ds = MemoryDataStore()
+        ds.create_schema(sft)
+        ds.write(
+            "n",
+            FeatureBatch.from_columns(
+                sft, {"count": [1], "geom": np.zeros((1, 2))}
+            ).with_visibility(["secret"]),
+        )
+        res = ds.query("n", Query("INCLUDE", hints={"auths": None}))
+        assert len(res.batch) == 0
+
+    def test_arrow_stream_carries_labels(self):
+        import io as _io
+
+        from geomesa_tpu.arrow_io import read_feature_stream, write_feature_stream
+
+        sft = SimpleFeatureType.create("a", "count:Int,*geom:Point:srid=4326")
+        batch = FeatureBatch.from_columns(
+            sft, {"count": [1, 2], "geom": np.zeros((2, 2))}
+        ).with_visibility(["A", ""])
+        buf = _io.BytesIO()
+        write_feature_stream(buf, [batch])
+        buf.seek(0)
+        (back,) = read_feature_stream(buf)
+        assert list(back.visibilities) == ["A", ""]

@@ -1,0 +1,116 @@
+"""GeoHash + WKB/TWKB codec tests (ref geomesa-utils geohash/WKBUtils)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom import parse_wkt
+from geomesa_tpu.geom.geohash import (
+    bbox_geohashes,
+    decode,
+    decode_bbox,
+    encode,
+    neighbors,
+)
+from geomesa_tpu.geom.wkb import from_twkb, from_wkb, to_twkb, to_wkb
+from geomesa_tpu.geom.wkt import to_wkt
+
+WKTS = [
+    "POINT (2.3488 48.8534)",
+    "LINESTRING (0 0, 1.5 1.5, 3 0)",
+    "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))",
+    "MULTIPOINT (1 2, -3 -4)",
+    "MULTILINESTRING ((0 0, 1 1), (2 2, 3 3))",
+    "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 1, 0 0)), ((5 5, 6 5, 6 6, 5 6, 5 5)))",
+]
+
+
+class TestGeoHash:
+    # canonical vectors (public geohash test values)
+    @pytest.mark.parametrize(
+        "lon,lat,gh",
+        [
+            (-5.6, 42.6, "ezs42"),
+            (2.3488, 48.8534, "u09tvmq"),
+            (-122.4194, 37.7749, "9q8yyk8"),
+            (0.0, 0.0, "s0000"),
+        ],
+    )
+    def test_known_hashes(self, lon, lat, gh):
+        assert encode(lon, lat, precision=len(gh)) == gh
+
+    def test_vectorized_matches_scalar(self, rng):
+        lon = rng.uniform(-180, 180, 200)
+        lat = rng.uniform(-90, 90, 200)
+        vec = encode(lon, lat, 8)
+        for i in range(0, 200, 17):
+            assert vec[i] == encode(float(lon[i]), float(lat[i]), 8)
+
+    def test_decode_contains_point(self, rng):
+        for _ in range(50):
+            lon = float(rng.uniform(-180, 180))
+            lat = float(rng.uniform(-90, 90))
+            gh = encode(lon, lat, 9)
+            (lo0, lo1), (la0, la1) = decode_bbox(gh)
+            assert lo0 <= lon <= lo1 and la0 <= lat <= la1
+            clon, clat = decode(gh)
+            assert abs(clon - lon) < 1e-3 and abs(clat - lat) < 1e-3
+
+    def test_neighbors(self):
+        ns = neighbors("u09tvmq")
+        assert len(ns) == 8
+        assert "u09tvmq" not in ns
+        # all neighbors share the 4-char prefix at this precision
+        assert all(n.startswith("u09t") for n in ns)
+
+    def test_invalid_char(self):
+        with pytest.raises(ValueError):
+            decode_bbox("abcl")  # 'l' is not base-32
+
+    def test_bbox_cover(self):
+        cells = bbox_geohashes(2.0, 48.0, 3.0, 49.0, 4)
+        assert encode(2.3488, 48.8534, 4) in cells
+        # every cell intersects the box
+        for gh in cells:
+            (lo0, lo1), (la0, la1) = decode_bbox(gh)
+            assert lo1 >= 2.0 and lo0 <= 3.0 and la1 >= 48.0 and la0 <= 49.0
+
+
+class TestWkb:
+    @pytest.mark.parametrize("wkt", WKTS)
+    def test_round_trip(self, wkt):
+        g = parse_wkt(wkt)
+        assert to_wkt(from_wkb(to_wkb(g))) == to_wkt(g)
+
+    def test_big_endian_read(self):
+        # hand-built big-endian POINT(1 2)
+        import struct
+
+        data = b"\x00" + struct.pack(">I", 1) + struct.pack(">dd", 1.0, 2.0)
+        g = from_wkb(data)
+        assert (g.x, g.y) == (1.0, 2.0)
+
+
+class TestTwkb:
+    @pytest.mark.parametrize("wkt", WKTS)
+    def test_round_trip_at_precision(self, wkt):
+        g = parse_wkt(wkt)
+        back = from_twkb(to_twkb(g, precision=7))
+        assert to_wkt(back) == to_wkt(g)  # coords are sub-precision ints
+
+    def test_compact_vs_wkb(self, rng):
+        coords = np.cumsum(rng.uniform(-0.001, 0.001, (500, 2)), axis=0) + [
+            2.0,
+            48.0,
+        ]
+        from geomesa_tpu.geom.base import LineString
+
+        g = LineString(np.round(coords, 6))
+        assert len(to_twkb(g, 6)) < len(to_wkb(g)) / 3  # delta varints win
+
+    def test_precision_rounding(self):
+        from geomesa_tpu.geom.base import Point
+
+        g = Point(1.23456789, -9.87654321)
+        back = from_twkb(to_twkb(g, precision=4))
+        assert back.x == pytest.approx(1.2346, abs=1e-9)
+        assert back.y == pytest.approx(-9.8765, abs=1e-9)

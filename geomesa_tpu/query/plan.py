@@ -153,6 +153,14 @@ def plan_query(
     )
 
 
+def as_query(q) -> Query:
+    """Coerce a Query | ECQL string | ast.Filter to a Query (shared by all
+    store implementations)."""
+    if isinstance(q, Query):
+        return q
+    return Query(filter=q)
+
+
 def _attr_equality(f: ast.Filter, attr: str):
     """Equality/IN value set for an attribute if the filter pins it
     (top-level or within an AND), else None."""

@@ -326,21 +326,56 @@ def st_within(a, b):
     return st_contains(b, a)
 
 
+def _segments_of(g) -> np.ndarray:
+    """(m, 4) [x0 y0 x1 y1] edge list; point-like geometries yield
+    zero-length segments so one distance formula covers every pair."""
+    va = _all_vertices(g)
+    if isinstance(g, (Point, MultiPoint)):
+        return np.concatenate([va, va], axis=1)
+    segs = []
+    if isinstance(g, LineString):
+        rings = [g.coords]
+    elif isinstance(g, Polygon):
+        rings = g.rings()
+    elif isinstance(g, MultiLineString):
+        rings = [l.coords for l in g.lines]
+    elif isinstance(g, MultiPolygon):
+        rings = [r for p in g.polygons for r in p.rings()]
+    else:
+        return np.concatenate([va, va], axis=1)
+    for r in rings:
+        r = np.asarray(r)
+        segs.append(np.concatenate([r[:-1], r[1:]], axis=1))
+    return np.concatenate(segs, axis=0)
+
+
+def _pt_seg_dist(pts: np.ndarray, segs: np.ndarray) -> float:
+    """min over all (point, segment) pairs of the exact point-to-segment
+    distance (clamped projection)."""
+    p = pts[:, None, :]
+    a = segs[None, :, 0:2]
+    d = segs[None, :, 2:4] - a
+    len2 = (d**2).sum(-1)
+    t = ((p - a) * d).sum(-1) / np.where(len2 == 0, 1.0, len2)
+    t = np.clip(np.where(len2 == 0, 0.0, t), 0.0, 1.0)
+    near = a + t[..., None] * d
+    return float(np.sqrt(((p - near) ** 2).sum(-1).min()))
+
+
 def st_distance(a, b):
-    """Planar distance. Point-vs-point is exact; other pairs use vertex
-    distance (0 when intersecting) -- the prefilter-grade metric."""
+    """Exact planar distance: 0 when intersecting, else the minimum
+    point-to-segment distance both ways (exact for non-crossing
+    geometries, since any crossing pair would have intersected)."""
 
     def fn(ga, gb):
         if isinstance(ga, Point) and isinstance(gb, Point):
             return float(np.hypot(ga.x - gb.x, ga.y - gb.y))
         if geometry_intersects(ga, gb):
             return 0.0
-        va, vb = _all_vertices(ga), _all_vertices(gb)
-        d2 = (
-            (va[:, None, 0] - vb[None, :, 0]) ** 2
-            + (va[:, None, 1] - vb[None, :, 1]) ** 2
+        return min(
+            _pt_seg_dist(_all_vertices(ga), _segments_of(gb)),
+            _pt_seg_dist(_all_vertices(gb), _segments_of(ga)),
         )
-        return float(np.sqrt(d2.min()))
 
     if isinstance(a, Geometry) and isinstance(b, Geometry):
         return fn(a, b)

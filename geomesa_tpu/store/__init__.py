@@ -1,13 +1,23 @@
 """DataStore API surface (maps reference L6 + L1).
 
-- ``api``:    store protocol + feature writer
-              (ref: geomesa-index-api .../index/geotools/GeoMesaDataStore)
 - ``memory``: in-memory columnar store -- the TestGeoMesaDataStore analog
               (ref: geomesa-index-api src/test TestGeoMesaDataStore; SURVEY
               section 4 calls this the most important testing idea)
 - ``fs``:     Parquet filesystem store (ref: geomesa-fs)
+- ``kv``:     sorted key-value store family -- one IndexAdapter over
+              pluggable sorted-KV engines (ref: geomesa-accumulo /
+              geomesa-hbase / geomesa-redis / geomesa-cassandra /
+              geomesa-bigtable adapters)
 """
 
+from geomesa_tpu.store.fs import FileSystemDataStore
+from geomesa_tpu.store.kv import KVDataStore, MemoryKV, SqliteKV
 from geomesa_tpu.store.memory import MemoryDataStore
 
-__all__ = ["MemoryDataStore"]
+__all__ = [
+    "FileSystemDataStore",
+    "KVDataStore",
+    "MemoryKV",
+    "MemoryDataStore",
+    "SqliteKV",
+]

@@ -31,7 +31,7 @@ from geomesa_tpu.filter import ast
 from geomesa_tpu.index.api import BuiltIndex, KeyRange, PartitionMeta
 from geomesa_tpu.index.build import DEFAULT_PARTITION_SIZE, build_index
 from geomesa_tpu.index.keyspaces import default_indices, keyspace_for
-from geomesa_tpu.query.plan import Query, QueryPlan, plan_query
+from geomesa_tpu.query.plan import Query, QueryPlan, as_query, plan_query
 from geomesa_tpu.query.runner import QueryResult, run_query
 
 
@@ -208,7 +208,7 @@ class FileSystemDataStore:
         self.flush(type_name)
         ks = keyspace_for(st.sft, st.primary)
         return plan_query(
-            st.sft, {st.primary: ks}, _as_query(query), data_interval=st.data_interval
+            st.sft, {st.primary: ks}, as_query(query), data_interval=st.data_interval
         )
 
     def query(self, type_name: str, query: "Query | str | ast.Filter" = ast.Include) -> QueryResult:
@@ -282,7 +282,4 @@ class FileSystemDataStore:
         return len(self.query(type_name, query))
 
 
-def _as_query(q) -> Query:
-    if isinstance(q, Query):
-        return q
-    return Query(filter=q)
+

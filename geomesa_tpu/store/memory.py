@@ -20,7 +20,7 @@ from geomesa_tpu.filter import ast
 from geomesa_tpu.index.api import BuiltIndex
 from geomesa_tpu.index.build import DEFAULT_PARTITION_SIZE, build_index
 from geomesa_tpu.index.keyspaces import default_indices, keyspace_for
-from geomesa_tpu.query.plan import Query, QueryPlan, plan_query
+from geomesa_tpu.query.plan import Query, QueryPlan, as_query, plan_query
 from geomesa_tpu.query.runner import QueryResult, run_query
 
 
@@ -120,27 +120,7 @@ class MemoryDataStore:
             st.stats = self._build_stats(st)
 
     def _build_stats(self, st: _TypeState):
-        """Write-time stats (ref MetadataBackedStats/StatUpdater): count,
-        MinMax per numeric/date attribute, Z3Histogram for point+time
-        schemas. Used by the stats API/CLI and selectivity estimates."""
-        from geomesa_tpu.stats import SeqStat
-        from geomesa_tpu.stats.sketches import (
-            CountStat,
-            MinMax,
-            Z3HistogramStat,
-        )
-
-        stats: list = [CountStat()]
-        for a in st.sft.attributes:
-            if a.column_dtype is not None and a.column_dtype != np.bool_:
-                stats.append(MinMax(a.name))
-        geom, dtg = st.sft.geom_field, st.sft.dtg_field
-        if geom and dtg and st.sft.descriptor(geom).is_point:
-            stats.append(Z3HistogramStat(geom, dtg, st.sft.z3_interval))
-        seq = SeqStat(stats)
-        if st.data is not None and len(st.data):
-            seq.observe_batch(st.data)
-        return seq
+        return build_default_stats(st.sft, st.data)
 
     def stats(self, type_name: str):
         """The maintained SeqStat for a type (ref GeoMesaStats.getStats).
@@ -159,7 +139,7 @@ class MemoryDataStore:
         key spaces so filter errors surface and explain() works uniformly."""
         st = self._state(type_name)
         self._flush(st)
-        q = _as_query(query)
+        q = as_query(query)
         indices = st.indices or {
             name: keyspace_for(st.sft, name) for name in default_indices(st.sft)
         }
@@ -214,9 +194,30 @@ class MemoryDataStore:
         return len(self.query(type_name, query))
 
 
-def _as_query(q) -> Query:
-    if isinstance(q, Query):
-        return q
-    return Query(filter=q)
+def build_default_stats(sft: SimpleFeatureType, data: "FeatureBatch | None"):
+    """Write-time stats (ref MetadataBackedStats/StatUpdater): count,
+    MinMax per numeric/date attribute, Z3Histogram for point+time
+    schemas. Used by the stats API/CLI and selectivity estimates."""
+    from geomesa_tpu.stats import SeqStat
+    from geomesa_tpu.stats.sketches import (
+        CountStat,
+        MinMax,
+        Z3HistogramStat,
+    )
+
+    stats: list = [CountStat()]
+    for a in sft.attributes:
+        if a.column_dtype is not None and a.column_dtype != np.bool_:
+            stats.append(MinMax(a.name))
+    geom, dtg = sft.geom_field, sft.dtg_field
+    if geom and dtg and sft.descriptor(geom).is_point:
+        stats.append(Z3HistogramStat(geom, dtg, sft.z3_interval))
+    seq = SeqStat(stats)
+    if data is not None and len(data):
+        seq.observe_batch(data)
+    return seq
+
+
+
 
 

@@ -1,0 +1,108 @@
+"""Binary feature serializer round-trips + lazy access (ref test role:
+geomesa-feature-kryo KryoFeatureSerializerTest)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.binser import (
+    FeatureSerializer,
+    deserialize_batch,
+    serialize_batch,
+)
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.geom import LineString, Point
+
+
+SFT = SimpleFeatureType.create(
+    "track",
+    "name:String,age:Int,weight:Double,alive:Boolean,dtg:Date,*geom:Point:srid=4326",
+)
+
+
+def test_roundtrip_scalar_types():
+    ser = FeatureSerializer(SFT)
+    values = ("alice", 41, 62.5, True, 1700000000000, (10.25, -33.5))
+    data = ser.serialize("f1", values)
+    fid, out, ud = ser.deserialize(data)
+    assert fid == "f1"
+    assert out[0] == "alice"
+    assert out[1] == 41
+    assert out[2] == 62.5
+    assert out[3] is True
+    assert out[4] == 1700000000000
+    assert (out[5].x, out[5].y) == (10.25, -33.5)
+    assert ud == {}
+
+
+def test_nulls_and_negative_ints():
+    sft = SimpleFeatureType.create("t", "a:Int,b:Long,c:String")
+    ser = FeatureSerializer(sft)
+    fid, out, _ = ser.deserialize(ser.serialize(7, (-123, None, None)))
+    assert fid == 7
+    assert out == (-123, None, None)
+
+
+def test_lazy_decodes_only_requested():
+    ser = FeatureSerializer(SFT)
+    data = ser.serialize("x", ("bob", 1, 2.0, False, 5, (0.0, 0.0)))
+    f = ser.lazy(data)
+    assert f.get("age") == 1
+    assert f._memo.keys() == {1}  # nothing else decoded
+    assert f.get("name") == "bob"
+    assert f.get(0) == "bob"
+
+
+def test_user_data_and_visibility_roundtrip():
+    b = FeatureBatch.from_columns(
+        SFT,
+        {
+            "name": ["a", "b"],
+            "age": [1, 2],
+            "weight": [1.0, 2.0],
+            "alive": [True, False],
+            "dtg": [10, 20],
+            "geom": [(0.0, 1.0), (2.0, 3.0)],
+        },
+        fids=np.array(["u", "v"], dtype=object),
+    ).with_visibility(["admin", ""])
+    rows = serialize_batch(b)
+    out = deserialize_batch(SFT, rows)
+    assert list(out.fids) == ["u", "v"]
+    assert list(out.visibilities) == ["admin", ""]
+    np.testing.assert_allclose(out.column("geom"), b.column("geom"))
+    np.testing.assert_array_equal(out.column("dtg"), [10, 20])
+
+
+def test_batch_roundtrip_line_geometry():
+    sft = SimpleFeatureType.create("lines", "n:Int,*geom:LineString")
+    line = LineString([(0.0, 0.0), (1.5, 2.5), (3.0, -1.0)])
+    b = FeatureBatch.from_columns(sft, {"n": [9], "geom": [line]})
+    out = deserialize_batch(sft, serialize_batch(b))
+    np.testing.assert_allclose(out.column("geom")[0].coords, line.coords)
+
+
+def test_projection_skips_columns():
+    b = FeatureBatch.from_columns(
+        SFT,
+        {
+            "name": ["a"],
+            "age": [5],
+            "weight": [1.0],
+            "alive": [True],
+            "dtg": [77],
+            "geom": [(1.0, 2.0)],
+        },
+    )
+    out = deserialize_batch(SFT, serialize_batch(b), columns=["age", "geom"])
+    assert set(out.columns) == {"age", "geom"}
+    assert out.column("age")[0] == 5
+    assert out.sft.attribute_names == ["age", "geom"]
+
+
+def test_schema_mismatch_rejected():
+    ser = FeatureSerializer(SFT)
+    other = FeatureSerializer(SimpleFeatureType.create("t", "a:Int"))
+    data = other.serialize(1, (2,))
+    with pytest.raises(ValueError, match="attributes"):
+        ser.lazy(data)

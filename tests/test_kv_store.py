@@ -1,0 +1,254 @@
+"""Sorted-KV DataStore: write/scan/filter parity with the in-memory
+columnar store (ref test role: AccumuloDataStoreQueryTest against
+MiniAccumuloCluster, here against MemoryKV and SqliteKV)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.filter.ecql import parse_ecql
+from geomesa_tpu.query.plan import Query
+from geomesa_tpu.store.kv import (
+    KVDataStore,
+    MemoryKV,
+    SqliteKV,
+    _enc_attr,
+    _enc_f64,
+    _enc_i32,
+    _enc_i64,
+    _incr,
+)
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "name:String,age:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def _columns(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    t = rng.integers(1600000000000, 1600000000000 + 28 * 86400000, n)
+    return {
+        "name": np.array([f"n{i % 17}" for i in range(n)], dtype=object),
+        "age": rng.integers(0, 100, n),
+        "dtg": t,
+        "geom": np.stack([x, y], axis=1),
+    }
+
+
+QUERIES = [
+    "bbox(geom, -50, -20, 40, 60) and dtg during 2020-09-14T00:00:00Z/2020-09-21T00:00:00Z",
+    "bbox(geom, 0, 0, 10, 10)",
+    "age > 50 and bbox(geom, -180, -90, 180, 90)",
+    "name = 'n3'",
+    "dtg after 2020-09-20T00:00:00Z",
+    "INCLUDE",
+]
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def kv_store(request, tmp_path):
+    if request.param == "memory":
+        ds = KVDataStore(MemoryKV(), catalog="cat")
+    else:
+        ds = KVDataStore(
+            SqliteKV(os.path.join(tmp_path, "cat.db")), catalog="cat"
+        )
+    yield ds
+    ds.close()
+
+
+class TestKeyCodec:
+    def test_i64_order(self):
+        vals = [-(2**62), -5, -1, 0, 1, 7, 2**62]
+        encs = [_enc_i64(v) for v in vals]
+        assert encs == sorted(encs)
+
+    def test_i32_order(self):
+        vals = [-100, -1, 0, 1, 100]
+        encs = [_enc_i32(v) for v in vals]
+        assert encs == sorted(encs)
+
+    def test_f64_order(self):
+        vals = [-1e300, -2.5, -0.0, 0.0, 1e-9, 3.7, 1e300]
+        encs = [_enc_f64(v) for v in vals]
+        assert encs == sorted(encs)
+
+    def test_str_order(self):
+        vals = ["", "a", "ab", "b"]
+        encs = [_enc_attr(v) for v in vals]
+        assert encs == sorted(encs)
+
+    def test_incr(self):
+        assert _incr(b"ab") == b"ac"
+        assert _incr(b"a\xff") == b"b"
+        assert _incr(b"\xff\xff") is None
+
+
+class TestBackends:
+    def test_memory_scan_order_and_bounds(self):
+        kv = MemoryKV()
+        kv.create_table("t")
+        kv.write("t", [(b"c", b"3"), (b"a", b"1"), (b"b", b"2")])
+        assert list(kv.scan("t", b"a", b"c")) == [(b"a", b"1"), (b"b", b"2")]
+        assert list(kv.scan("t", b"", None)) == [
+            (b"a", b"1"), (b"b", b"2"), (b"c", b"3"),
+        ]
+        kv.delete("t", [b"b"])
+        assert [k for k, _ in kv.scan("t", b"", None)] == [b"a", b"c"]
+
+    def test_sqlite_persistence(self, tmp_path):
+        path = os.path.join(tmp_path, "kv.db")
+        kv = SqliteKV(path)
+        kv.create_table("t")
+        kv.write("t", [(b"k1", b"v1"), (b"k0", b"v0")])
+        kv.close()
+        kv2 = SqliteKV(path)
+        assert list(kv2.scan("t", b"", None)) == [(b"k0", b"v0"), (b"k1", b"v1")]
+        kv2.close()
+
+
+class TestKVStoreParity:
+    def test_query_parity_with_memory_store(self, kv_store):
+        cols = _columns()
+        kv_store.create_schema("gdelt", SPEC)
+        kv_store.write("gdelt", cols)
+
+        oracle = MemoryDataStore()
+        oracle.create_schema("gdelt", SPEC)
+        oracle.write("gdelt", cols)
+
+        for q in QUERIES:
+            got = sorted(kv_store.query("gdelt", q).batch.fids)
+            want = sorted(oracle.query("gdelt", q).batch.fids)
+            assert got == want, f"mismatch for {q!r}"
+
+    def test_projection_sort_limit(self, kv_store):
+        kv_store.create_schema("gdelt", SPEC)
+        kv_store.write("gdelt", _columns())
+        res = kv_store.query(
+            "gdelt",
+            Query(
+                filter=parse_ecql("bbox(geom, -90, -45, 90, 45)"),
+                properties=["age", "geom"],
+                sort_by="age",
+                max_features=10,
+            ),
+        )
+        assert len(res) == 10
+        assert set(res.batch.columns) == {"age", "geom"}
+        ages = res.batch.column("age")
+        assert list(ages) == sorted(ages)
+
+    def test_prefilter_prunes_scanned_rows(self, kv_store):
+        kv_store.create_schema("gdelt", SPEC)
+        kv_store.write("gdelt", _columns(n=2000))
+        res = kv_store.query("gdelt", QUERIES[0])
+        # z-range pruning must beat a full scan
+        assert res.scanned < 2000
+        assert res.total == 2000
+
+
+class TestKVStoreLifecycle:
+    def test_reopen_from_disk(self, tmp_path):
+        path = os.path.join(tmp_path, "cat.db")
+        ds = KVDataStore(SqliteKV(path), catalog="cat")
+        ds.create_schema("pts", SPEC)
+        ds.write("pts", _columns(n=100))
+        before = sorted(ds.query("pts", QUERIES[1]).batch.fids)
+        ds.close()
+
+        ds2 = KVDataStore(SqliteKV(path), catalog="cat")
+        assert ds2.type_names == ["pts"]
+        assert ds2.get_schema("pts").spec.startswith("name:String")
+        assert sorted(ds2.query("pts", QUERIES[1]).batch.fids) == before
+        ds2.close()
+
+    def test_delete_and_get_by_ids(self, kv_store):
+        kv_store.create_schema("pts", SPEC)
+        kv_store.write("pts", _columns(n=50))
+        got = kv_store.get_by_ids("pts", [3, 7])
+        assert sorted(got.fids) == [3, 7]
+        assert kv_store.delete("pts", [3, 7]) == 2
+        assert len(kv_store.get_by_ids("pts", [3, 7])) == 0
+        assert len(kv_store.query("pts", "INCLUDE")) == 48
+
+    def test_age_off(self, kv_store):
+        kv_store.create_schema("pts", SPEC)
+        cols = _columns(n=100)
+        kv_store.write("pts", cols)
+        cutoff = int(np.median(cols["dtg"]))
+        removed = kv_store.age_off("pts", cutoff)
+        assert removed == int((cols["dtg"] < cutoff).sum())
+        left = kv_store.query("pts", "INCLUDE")
+        assert (left.batch.column("dtg") >= cutoff).all()
+
+    def test_remove_schema_drops_tables(self, kv_store):
+        kv_store.create_schema("pts", SPEC)
+        kv_store.write("pts", _columns(n=10))
+        kv_store.remove_schema("pts")
+        assert kv_store.type_names == []
+        assert all("pts" not in t for t in kv_store.backend.list_tables())
+
+    def test_visibility_rows_hidden_without_auths(self, kv_store):
+        kv_store.create_schema("pts", SPEC)
+        b = FeatureBatch.from_columns(
+            kv_store.get_schema("pts"), _columns(n=4)
+        ).with_visibility(["admin", "", "admin", ""])
+        kv_store.write("pts", b)
+        assert len(kv_store.query("pts", "INCLUDE")) == 2
+        res = kv_store.query(
+            "pts", Query(filter="INCLUDE", hints={"auths": ("admin",)})
+        )
+        assert len(res) == 4
+
+    def test_delete_leaves_no_stale_index_rows(self, kv_store):
+        # regression: TWKB-rounded geometry payloads used to shift z2 cells
+        # on re-keying, stranding rows in the secondary index tables
+        kv_store.create_schema("pts", SPEC)
+        cols = _columns(n=300, seed=42)
+        kv_store.write("pts", cols)
+        assert kv_store.delete("pts", list(range(300))) == 300
+        for table in kv_store.backend.list_tables():
+            if table.startswith("cat_pts_"):
+                rows = list(kv_store.backend.scan(table, b"", None))
+                assert rows == [], f"stale rows in {table}"
+        assert len(kv_store.query("pts", "bbox(geom,-180,-90,180,90)")) == 0
+
+    def test_string_fids_survive_reopen(self, tmp_path):
+        # regression: shard bytes must come from a process-stable hash
+        path = os.path.join(tmp_path, "cat.db")
+        ds = KVDataStore(SqliteKV(path), catalog="cat")
+        ds.create_schema("pts", SPEC)
+        fids = np.array([f"feat-{i}" for i in range(20)], dtype=object)
+        ds.write("pts", _columns(n=20), fids=fids)
+        ds.close()
+        import subprocess
+        import sys
+
+        # verify from a *different* process (different hash salt)
+        code = (
+            "from geomesa_tpu.store.kv import KVDataStore, SqliteKV\n"
+            f"ds = KVDataStore(SqliteKV({path!r}), catalog='cat')\n"
+            "got = ds.get_by_ids('pts', ['feat-3', 'feat-7'])\n"
+            "assert sorted(got.fids) == ['feat-3', 'feat-7'], got.fids\n"
+            "assert ds.delete('pts', ['feat-3']) == 1\n"
+        )
+        subprocess.run(
+            [sys.executable, "-c", code], check=True, cwd="/root/repo"
+        )
+
+    def test_stats_maintained(self, kv_store):
+        kv_store.create_schema("pts", SPEC)
+        kv_store.write("pts", _columns(n=30))
+        stats = kv_store.stats("pts")
+        js = stats.to_json()
+        assert any(s.get("count") == 30 for s in js if isinstance(s, dict))
+
+    def test_explain_mentions_ranges(self, kv_store):
+        kv_store.create_schema("pts", SPEC)
+        kv_store.write("pts", _columns(n=30))
+        text = kv_store.explain("pts", QUERIES[0])
+        assert "z3" in text and "Ranges" in text

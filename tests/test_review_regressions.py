@@ -109,3 +109,17 @@ def test_wide_key_zranges_skips_native():
     with_native = zranges(lo, hi, bits_per_dim=22)
     without = zranges(lo, hi, bits_per_dim=22, use_native=False)
     assert with_native == without
+
+
+def test_st_dwithin_exact_on_segment_interiors():
+    """st_distance must use point-to-segment distance, not vertex-to-vertex
+    (a point near a long edge's interior was wrongly reported far)."""
+    from geomesa_tpu.geom import LineString, Point, Polygon
+    from geomesa_tpu.sql.functions import st_distance, st_dwithin
+
+    p, line = Point(5, 1), LineString([(0, 0), (10, 0)])
+    assert st_distance(p, line) == 1.0
+    assert st_dwithin(p, line, 2.0)
+    poly = Polygon([(0, 0), (10, 0), (10, 10), (0, 10), (0, 0)])
+    assert st_distance(Point(5, -3), poly) == 3.0
+    assert st_distance(line, poly) == 0.0  # boundary contact

@@ -25,15 +25,28 @@ vectorized over record batches (columns in, columns out).
 from geomesa_tpu.convert.expression import Expression, parse_expression
 from geomesa_tpu.convert.delimited import DelimitedTextConverter
 from geomesa_tpu.convert.json_conv import JsonConverter
+from geomesa_tpu.convert.xml_conv import XmlConverter
+from geomesa_tpu.convert.fixedwidth import FixedWidthConverter
+from geomesa_tpu.convert.avro_conv import AvroConverter
+from geomesa_tpu.convert.jdbc import JdbcConverter
+from geomesa_tpu.convert.shp import ShapefileConverter
+
+_CONVERTERS = {
+    "delimited-text": DelimitedTextConverter,
+    "json": JsonConverter,
+    "xml": XmlConverter,
+    "fixed-width": FixedWidthConverter,
+    "avro": AvroConverter,
+    "jdbc": JdbcConverter,
+    "shp": ShapefileConverter,
+}
 
 
 def converter_for(config: dict, sft):
     kind = config.get("type")
-    if kind == "delimited-text":
-        return DelimitedTextConverter(config, sft)
-    if kind == "json":
-        return JsonConverter(config, sft)
-    raise ValueError(f"unknown converter type {kind!r}")
+    if kind not in _CONVERTERS:
+        raise ValueError(f"unknown converter type {kind!r}")
+    return _CONVERTERS[kind](config, sft)
 
 
 __all__ = [
@@ -41,5 +54,10 @@ __all__ = [
     "parse_expression",
     "DelimitedTextConverter",
     "JsonConverter",
+    "XmlConverter",
+    "FixedWidthConverter",
+    "AvroConverter",
+    "JdbcConverter",
+    "ShapefileConverter",
     "converter_for",
 ]

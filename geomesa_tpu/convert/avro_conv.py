@@ -1,0 +1,181 @@
+"""Avro converter: ingest from Avro object container files.
+
+Ref role: geomesa-convert-avro AvroConverter [UNVERIFIED - empty reference
+mount]. Unlike ``features/avro.py`` (our own export format, which embeds
+the SFT spec), this reads *arbitrary* Avro container files: a generic
+decoder walks the embedded writer schema (records of scalars, nullable
+unions, arrays of scalars) and binds each top-level field as ``$name`` for
+the field transforms. The reference uses avro-java GenericRecord + an
+``avroPath`` language; top-level-field binding covers the same configs
+without a second path DSL.
+
+    {
+      "type": "avro",
+      "id-field": "$id",
+      "fields": [
+        {"name": "geom", "transform": "point($lon, $lat)"},
+        {"name": "dtg",  "transform": "millisToDate($ts)"},
+      ],
+    }
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+
+import numpy as np
+
+from geomesa_tpu.convert.delimited import ConvertResult
+from geomesa_tpu.convert.expression import parse_expression
+from geomesa_tpu.features.avro import MAGIC, read_bytes, read_long
+from geomesa_tpu.features.batch import FeatureBatch
+
+
+def _decoder(schema):
+    """Build value-decoder(buf) for an Avro schema node (generic subset)."""
+    if isinstance(schema, str):
+        t = schema
+        if t == "null":
+            return lambda buf: None
+        if t == "boolean":
+            return lambda buf: buf.read(1) == b"\x01"
+        if t in ("int", "long"):
+            return read_long
+        if t == "float":
+            return lambda buf: struct.unpack("<f", buf.read(4))[0]
+        if t == "double":
+            return lambda buf: struct.unpack("<d", buf.read(8))[0]
+        if t == "string":
+            return lambda buf: read_bytes(buf).decode()
+        if t == "bytes":
+            return read_bytes
+        raise ValueError(f"unsupported avro type {t!r}")
+    if isinstance(schema, list):  # union: tag = branch index
+        branches = [_decoder(s) for s in schema]
+
+        def dec_union(buf, branches=branches):
+            return branches[read_long(buf)](buf)
+
+        return dec_union
+    t = schema.get("type")
+    if t in ("record",):
+        fields = [(f["name"], _decoder(f["type"])) for f in schema["fields"]]
+
+        def dec_record(buf, fields=fields):
+            return {name: d(buf) for name, d in fields}
+
+        return dec_record
+    if t == "array":
+        item = _decoder(schema["items"])
+
+        def dec_array(buf, item=item):
+            out = []
+            while True:
+                n = read_long(buf)
+                if n == 0:
+                    return out
+                if n < 0:
+                    n = -n
+                    read_long(buf)  # skip byte-size hint
+                out.extend(item(buf) for _ in range(n))
+
+        return dec_array
+    if t == "enum":
+        symbols = schema["symbols"]
+        return lambda buf, symbols=symbols: symbols[read_long(buf)]
+    if t == "fixed":
+        size = int(schema["size"])
+        return lambda buf, size=size: buf.read(size)
+    if t in ("map",):
+        val = _decoder(schema["values"])
+
+        def dec_map(buf, val=val):
+            out = {}
+            while True:
+                n = read_long(buf)
+                if n == 0:
+                    return out
+                if n < 0:
+                    n = -n
+                    read_long(buf)
+                for _ in range(n):
+                    out[read_bytes(buf).decode()] = val(buf)
+
+        return dec_map
+    return _decoder(t)  # {"type": "string", ...} wrapper
+
+
+def read_generic_avro(data: bytes) -> list:
+    """All records of a container file as a list of dicts."""
+    buf = io.BytesIO(data)
+    if buf.read(4) != MAGIC:
+        raise ValueError("not an Avro object container file")
+    meta: dict = {}
+    while True:
+        n = read_long(buf)
+        if n == 0:
+            break
+        if n < 0:
+            n = -n
+            read_long(buf)
+        for _ in range(n):
+            k = read_bytes(buf).decode()
+            meta[k] = read_bytes(buf)
+    if meta.get("avro.codec", b"null") not in (b"null", b""):
+        raise ValueError(f"unsupported avro codec {meta['avro.codec']!r}")
+    schema = json.loads(meta["avro.schema"].decode())
+    dec = _decoder(schema)
+    sync = buf.read(16)
+    records = []
+    while True:
+        head = buf.read(1)
+        if not head:
+            break
+        buf.seek(-1, 1)
+        count = read_long(buf)
+        block = io.BytesIO(read_bytes(buf))
+        for _ in range(count):
+            records.append(dec(block))
+        if buf.read(16) != sync:
+            raise ValueError("avro sync marker mismatch")
+    return records
+
+
+class AvroConverter:
+    def __init__(self, config: dict, sft):
+        self.sft = sft
+        self.fields = [
+            (
+                f["name"],
+                f.get("path"),  # optional top-level field name
+                parse_expression(f["transform"]) if f.get("transform") else None,
+            )
+            for f in config["fields"]
+        ]
+        self.id_expr = (
+            parse_expression(config["id-field"]) if config.get("id-field") else None
+        )
+
+    def process(self, data: bytes) -> ConvertResult:
+        if hasattr(data, "read"):
+            data = data.read()
+        records = read_generic_avro(data)
+        cols: dict = {}
+        if records:
+            for key in records[0]:
+                cols[key] = np.array([r.get(key) for r in records], dtype=object)
+        out = {}
+        for name, path, transform in self.fields:
+            if transform is not None:
+                out[name] = transform(cols)
+            elif path is not None:
+                out[name] = cols[path]
+            elif name in cols:
+                out[name] = cols[name]
+            else:
+                raise ValueError(f"field {name!r} needs path or transform")
+        fids = self.id_expr(cols) if self.id_expr else None
+        batch = FeatureBatch.from_columns(self.sft, out, fids)
+        return ConvertResult(batch, len(batch), 0)

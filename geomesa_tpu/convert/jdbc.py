@@ -1,0 +1,79 @@
+"""SQL/JDBC converter: ingest from a relational query.
+
+Ref role: geomesa-convert-jdbc JdbcConverter [UNVERIFIED - empty reference
+mount]: connect with a JDBC URL, run a statement, and bind result columns
+positionally -- ``$0`` is the row id and ``$1..$N`` are SELECT columns
+(1-based, like the delimited converter). Here the driver is stdlib
+``sqlite3`` (the only RDBMS in the image); the config's ``connection`` is
+a sqlite path or URI.
+
+    {
+      "type": "jdbc",
+      "connection": "file.db",
+      "id-field": "$1::string",
+      "fields": [
+        {"name": "name", "transform": "$2"},
+        {"name": "geom", "transform": "point($3::double, $4::double)"},
+      ],
+    }
+
+``process(sql)`` takes the SELECT statement (the reference streams the
+input file as statements; passing the query directly is the Python-native
+shape).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+
+import numpy as np
+
+from geomesa_tpu.convert.delimited import ConvertResult, _rowwise
+from geomesa_tpu.convert.expression import parse_expression
+from geomesa_tpu.features.batch import FeatureBatch
+
+
+class JdbcConverter:
+    def __init__(self, config: dict, sft):
+        self.sft = sft
+        self.connection = config["connection"]
+        opts = config.get("options", {})
+        self.error_mode = opts.get("error-mode", "skip-bad-records")
+        self.fields = [
+            (f["name"], parse_expression(f["transform"])) for f in config["fields"]
+        ]
+        self.id_expr = (
+            parse_expression(config["id-field"]) if config.get("id-field") else None
+        )
+
+    def process(self, sql: str) -> ConvertResult:
+        conn = sqlite3.connect(self.connection)
+        try:
+            rows = conn.execute(sql).fetchall()
+        finally:
+            conn.close()
+        cols: dict = {}
+        width = len(rows[0]) if rows else 0
+        for i in range(width):
+            cols[str(i + 1)] = np.array([r[i] for r in rows], dtype=object)
+        cols["0"] = np.array(
+            [" ".join(str(v) for v in r) for r in rows], dtype=object
+        )
+        out = {}
+        failed = 0
+        ok = np.ones(len(rows), dtype=bool)
+        for name, expr in self.fields:
+            try:
+                out[name] = expr(cols)
+            except Exception:
+                if self.error_mode == "raise-errors":
+                    raise
+                out[name], ok = _rowwise(expr, cols, ok)
+        if not np.all(ok):
+            failed = int((~ok).sum())
+            keep = np.nonzero(ok)[0]
+            out = {k: (v[keep] if len(v) == len(ok) else v) for k, v in out.items()}
+            cols = {k: v[keep] for k, v in cols.items()}
+        fids = self.id_expr(cols) if self.id_expr else None
+        batch = FeatureBatch.from_columns(self.sft, out, fids)
+        return ConvertResult(batch, len(batch), failed)

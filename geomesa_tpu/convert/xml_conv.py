@@ -1,0 +1,116 @@
+"""XML converter.
+
+Ref role: geomesa-convert-xml XmlConverter [UNVERIFIED - empty reference
+mount] -- declarative ingest from XML documents. The reference evaluates
+javax XPath expressions per feature element; here the path language is the
+ElementTree subset (``tag``, ``a/b``, ``.//tag``, ``tag[@k='v']``) plus a
+trailing ``/@attr`` or ``/text()`` selector, which covers the converter
+configs the reference ships in tests.
+
+Config shape (mirrors the JSON converter):
+
+    {
+      "type": "xml",
+      "feature-path": ".//Feature",      # element iteration path
+      "id-field": "$id",
+      "fields": [
+        {"name": "id",   "path": "@id"},
+        {"name": "name", "path": "Name/text()"},
+        {"name": "geom", "path": "Pos", "transform": "..."},
+      ],
+    }
+
+Each field's ``path`` is evaluated against the feature element and bound as
+``$name`` for transforms; with no transform the extracted string is the
+value.
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from geomesa_tpu.convert.delimited import ConvertResult
+from geomesa_tpu.convert.expression import parse_expression
+from geomesa_tpu.features.batch import FeatureBatch
+
+
+def xml_select(elem: ET.Element, path: str):
+    """Evaluate a path with optional trailing /@attr or /text()."""
+    attr = None
+    want_text = False
+    if path.startswith("@"):
+        return elem.get(path[1:])
+    if "/@" in path:
+        path, attr = path.rsplit("/@", 1)
+    elif path.endswith("/text()"):
+        path = path[: -len("/text()")]
+        want_text = True
+    target = elem if path in (".", "") else elem.find(path)
+    if target is None:
+        return None
+    if attr is not None:
+        return target.get(attr)
+    if want_text:
+        return target.text
+    # bare element path: its text content (the common converter case)
+    return target.text
+
+
+class XmlConverter:
+    def __init__(self, config: dict, sft):
+        self.sft = sft
+        self.feature_path = config.get("feature-path", ".")
+        opts = config.get("options", {})
+        self.error_mode = opts.get("error-mode", "skip-bad-records")
+        self.fields = [
+            (
+                f["name"],
+                f.get("path"),
+                parse_expression(f["transform"]) if f.get("transform") else None,
+            )
+            for f in config["fields"]
+        ]
+        self.id_expr = (
+            parse_expression(config["id-field"]) if config.get("id-field") else None
+        )
+
+    def process(self, text: str) -> ConvertResult:
+        root = ET.fromstring(text)
+        if self.feature_path in (".", ""):
+            records = [root]
+        else:
+            records = list(root.iterfind(self.feature_path))
+        raw: dict = {}
+        for name, path, _ in self.fields:
+            if path:
+                raw[name] = np.array(
+                    [xml_select(r, path) for r in records], dtype=object
+                )
+        cols = dict(raw)
+        out = {}
+        failed = 0
+        ok = np.ones(len(records), dtype=bool)
+        for name, path, transform in self.fields:
+            if transform is not None:
+                try:
+                    out[name] = transform(cols)
+                except Exception:
+                    if self.error_mode == "raise-errors":
+                        raise
+                    from geomesa_tpu.convert.delimited import _rowwise
+
+                    out[name], ok = _rowwise(transform, cols, ok)
+            elif path is not None:
+                out[name] = raw[name]
+            else:
+                raise ValueError(f"field {name!r} needs path or transform")
+        if not np.all(ok):
+            failed = int((~ok).sum())
+            keep = np.nonzero(ok)[0]
+            out = {k: (v[keep] if len(v) == len(ok) else v) for k, v in out.items()}
+            cols = {k: v[keep] for k, v in cols.items()}
+        fids = self.id_expr(cols) if self.id_expr else None
+        batch = FeatureBatch.from_columns(self.sft, out, fids)
+        return ConvertResult(batch, len(batch), failed)

@@ -327,26 +327,16 @@ def st_within(a, b):
 
 
 def _segments_of(g) -> np.ndarray:
-    """(m, 4) [x0 y0 x1 y1] edge list; point-like geometries yield
-    zero-length segments so one distance formula covers every pair."""
+    """(m, 4) [x0 y0 x1 y1] edge list (rings include holes, via the shared
+    predicates helper); point-like geometries yield zero-length segments so
+    one distance formula covers every pair."""
+    from geomesa_tpu.geom.predicates import _segments_of as _geom_segments
+
+    segs = _geom_segments(g)
+    if segs is not None:
+        return segs
     va = _all_vertices(g)
-    if isinstance(g, (Point, MultiPoint)):
-        return np.concatenate([va, va], axis=1)
-    segs = []
-    if isinstance(g, LineString):
-        rings = [g.coords]
-    elif isinstance(g, Polygon):
-        rings = g.rings()
-    elif isinstance(g, MultiLineString):
-        rings = [l.coords for l in g.lines]
-    elif isinstance(g, MultiPolygon):
-        rings = [r for p in g.polygons for r in p.rings()]
-    else:
-        return np.concatenate([va, va], axis=1)
-    for r in rings:
-        r = np.asarray(r)
-        segs.append(np.concatenate([r[:-1], r[1:]], axis=1))
-    return np.concatenate(segs, axis=0)
+    return np.concatenate([va, va], axis=1)
 
 
 def _pt_seg_dist(pts: np.ndarray, segs: np.ndarray) -> float:
@@ -372,10 +362,12 @@ def st_distance(a, b):
             return float(np.hypot(ga.x - gb.x, ga.y - gb.y))
         if geometry_intersects(ga, gb):
             return 0.0
-        return min(
-            _pt_seg_dist(_all_vertices(ga), _segments_of(gb)),
-            _pt_seg_dist(_all_vertices(gb), _segments_of(ga)),
-        )
+        # point sets come from the segment endpoints so hole-ring vertices
+        # participate (shells alone would overestimate near holes)
+        sa, sb = _segments_of(ga), _segments_of(gb)
+        pa = np.concatenate([sa[:, 0:2], sa[:, 2:4]], axis=0)
+        pb = np.concatenate([sb[:, 0:2], sb[:, 2:4]], axis=0)
+        return min(_pt_seg_dist(pa, sb), _pt_seg_dist(pb, sa))
 
     if isinstance(a, Geometry) and isinstance(b, Geometry):
         return fn(a, b)

@@ -422,7 +422,13 @@ class KVDataStore:
             out.append(b"".join(parts))
         return out
 
-    def write(self, type_name: str, columns_or_batch, fids=None) -> int:
+    def write(
+        self, type_name: str, columns_or_batch, fids=None, assume_new: bool = False
+    ) -> int:
+        """Upsert features. Re-writing an existing fid replaces all of its
+        index rows (the old z/attribute rows are removed first, so queries
+        never see stale locations). ``assume_new=True`` skips the
+        existing-fid lookup for bulk loads of known-fresh data."""
         sft = self._types[type_name]
         if isinstance(columns_or_batch, FeatureBatch):
             batch = columns_or_batch
@@ -430,6 +436,11 @@ class KVDataStore:
             batch = FeatureBatch.from_columns(sft, columns_or_batch, fids)
         if not len(batch):
             return 0
+        if not assume_new:
+            old = self.get_by_ids(type_name, list(batch.fids))
+            if len(old):
+                self._delete_rows(type_name, old)
+                self._stats_remove(type_name, len(old))
         values = serialize_batch(batch)
         shards = self._shard_of(batch.fids)
         for index in default_indices(sft):
@@ -455,16 +466,32 @@ class KVDataStore:
             )
         return len(batch)
 
-    def delete(self, type_name: str, fids) -> int:
-        batch = self.get_by_ids(type_name, fids)
-        if not len(batch):
-            return 0
+    def _delete_rows(self, type_name: str, batch: FeatureBatch) -> None:
         sft = self._types[type_name]
         shards = self._shard_of(batch.fids)
         for index in default_indices(sft):
             ks = keyspace_for(sft, index)
             rows = self._row_keys(ks, batch, shards)
             self.backend.delete(self._table(type_name, index), rows)
+
+    def _stats_remove(self, type_name: str, n: int) -> None:
+        """Decrement the exact count on delete; sketch stats (MinMax/HLL/
+        TopK/histograms) cannot unobserve and stay conservative, matching
+        the reference's delete-time stats behavior."""
+        from geomesa_tpu.stats.sketches import CountStat
+
+        st = self.stats(type_name)
+        for s in st.stats:
+            if isinstance(s, CountStat):
+                s.count = max(0, s.count - n)
+        self._meta_put(f"{type_name}~stats", pickle.dumps(st))
+
+    def delete(self, type_name: str, fids) -> int:
+        batch = self.get_by_ids(type_name, fids)
+        if not len(batch):
+            return 0
+        self._delete_rows(type_name, batch)
+        self._stats_remove(type_name, len(batch))
         return len(batch)
 
     def age_off(self, type_name: str, before_ms: int) -> int:
@@ -577,7 +604,7 @@ class KVDataStore:
             buf_k.clear()
             buf_v.clear()
 
-        for lo, hi in self._byte_ranges(ks, plan):
+        for lo, hi in _coalesce(self._byte_ranges(ks, plan)):
             for k, v in self.backend.scan(table, lo, hi):
                 buf_k.append(k)
                 buf_v.append(v)
@@ -635,6 +662,23 @@ class KVDataStore:
 
     def close(self) -> None:
         self.backend.close()
+
+
+def _coalesce(ranges: list) -> list:
+    """Merge overlapping/adjacent byte ranges so each key is scanned at
+    most once (per-envelope z-ranges from OR'd predicates can overlap)."""
+    if len(ranges) <= 1:
+        return ranges
+    ranges = sorted(ranges)
+    out = [ranges[0]]
+    for lo, hi in ranges[1:]:
+        plo, phi = out[-1]
+        if lo <= phi:
+            if hi > phi:
+                out[-1] = (plo, hi)
+        else:
+            out.append((lo, hi))
+    return out
 
 
 def _is_neg_inf(v) -> bool:

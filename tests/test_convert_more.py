@@ -1,0 +1,325 @@
+"""XML / fixed-width / Avro / JDBC / Shapefile converters."""
+
+import io
+import sqlite3
+import struct
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert import converter_for
+from geomesa_tpu.features.avro import write_avro
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.geom import MultiLineString, Point, Polygon
+
+SPEC = "name:String,age:Int,*geom:Point"
+SFT = SimpleFeatureType.create("people", SPEC)
+
+
+# -- xml ---------------------------------------------------------------------
+
+XML_DOC = """<?xml version="1.0"?>
+<doc>
+  <Feature id="f1"><Name>Alice</Name><Age>34</Age><Lon>2.35</Lon><Lat>48.85</Lat></Feature>
+  <Feature id="f2"><Name>Bob</Name><Age>41</Age><Lon>-0.12</Lon><Lat>51.5</Lat></Feature>
+</doc>
+"""
+
+XML_CONFIG = {
+    "type": "xml",
+    "feature-path": ".//Feature",
+    "id-field": "$fid",
+    "fields": [
+        {"name": "fid", "path": "@id"},
+        {"name": "name", "path": "Name/text()"},
+        {"name": "age", "path": "Age", "transform": "$age::int"},
+        {"name": "lon", "path": "Lon"},
+        {"name": "lat", "path": "Lat"},
+        {"name": "geom", "transform": "point($lon::double, $lat::double)"},
+    ],
+}
+
+
+def test_xml_converter():
+    sft = SimpleFeatureType.create(
+        "p", "fid:String,name:String,age:Int,lon:Double,lat:Double,*geom:Point"
+    )
+    res = converter_for(XML_CONFIG, sft).process(XML_DOC)
+    assert res.success == 2 and res.failed == 0
+    assert list(res.batch.fids) == ["f1", "f2"]
+    assert list(res.batch.column("name")) == ["Alice", "Bob"]
+    assert res.batch.column("age").tolist() == [34, 41]
+    np.testing.assert_allclose(
+        res.batch.column("geom"), [[2.35, 48.85], [-0.12, 51.5]]
+    )
+
+
+def test_xml_attribute_and_missing():
+    cfg = {
+        "type": "xml",
+        "feature-path": ".//Feature",
+        "fields": [
+            {"name": "name", "path": "Name"},
+            {"name": "age", "path": "Missing", "transform": "stringToInt($age, 0)"},
+            {"name": "geom", "transform": "point(Lon($0), 0)"},
+        ],
+    }
+    # missing path yields None -> stringToInt default kicks in
+    sft2 = SimpleFeatureType.create("p", "name:String,age:Int,*geom:Point")
+    cfg["fields"][2] = {"name": "geom", "transform": "point(1, 2)"}
+    res = converter_for(cfg, sft2).process(XML_DOC)
+    assert res.batch.column("age").tolist() == [0, 0]
+
+
+# -- fixed width -------------------------------------------------------------
+
+
+def test_fixed_width_converter():
+    cfg = {
+        "type": "fixed-width",
+        "id-field": "$name",
+        "fields": [
+            {"name": "name", "start": 0, "width": 6},
+            {"name": "age", "start": 6, "width": 3, "transform": "$age::int"},
+            {"name": "lat", "start": 9, "width": 6},
+            {"name": "lon", "start": 15, "width": 7},
+            {"name": "geom", "transform": "point($lon::double, $lat::double)"},
+        ],
+    }
+    sft = SimpleFeatureType.create(
+        "p", "name:String,age:Int,lat:Double,lon:Double,*geom:Point"
+    )
+    data = "Alice  34 48.85   2.35\nBob    41 51.50  -0.12\n"
+    res = converter_for(cfg, sft).process(data)
+    assert res.success == 2
+    assert list(res.batch.fids) == ["Alice", "Bob"]
+    np.testing.assert_allclose(res.batch.column("lat"), [48.85, 51.5])
+
+
+def test_fixed_width_bad_row_skipped():
+    cfg = {
+        "type": "fixed-width",
+        "fields": [
+            {"name": "age", "start": 0, "width": 3, "transform": "$age::int"},
+        ],
+    }
+    sft = SimpleFeatureType.create("p", "age:Int")
+    res = converter_for(cfg, sft).process("34\nxx\n41\n")
+    assert res.success == 2 and res.failed == 1
+    assert res.batch.column("age").tolist() == [34, 41]
+
+
+# -- avro --------------------------------------------------------------------
+
+
+def test_avro_converter_roundtrip():
+    # write a container file with our own writer, re-ingest it generically
+    src_sft = SimpleFeatureType.create("src", "name:String,age:Int,*geom:Point")
+    batch = FeatureBatch.from_columns(
+        src_sft,
+        {
+            "name": ["Alice", "Bob"],
+            "age": [34, 41],
+            "geom": np.array([[2.35, 48.85], [-0.12, 51.5]]),
+        },
+        fids=["a", "b"],
+    )
+    buf = io.BytesIO()
+    write_avro(buf, batch)
+    cfg = {
+        "type": "avro",
+        "id-field": "$__fid__",
+        "fields": [
+            {"name": "name", "path": "name"},
+            {"name": "age", "transform": "$age::int"},
+            # geom came back as WKT text
+            {"name": "geom", "transform": "$geom"},
+        ],
+    }
+    res = converter_for(cfg, SFT).process(buf.getvalue())
+    assert res.success == 2
+    assert list(res.batch.fids) == ["a", "b"]
+    assert list(res.batch.column("name")) == ["Alice", "Bob"]
+    np.testing.assert_allclose(
+        res.batch.column("geom"), [[2.35, 48.85], [-0.12, 51.5]]
+    )
+
+
+def test_avro_generic_decoder_types():
+    from geomesa_tpu.convert.avro_conv import read_generic_avro
+    from geomesa_tpu.features.avro import MAGIC, write_bytes, write_long, write_string
+
+    import json as _json
+
+    schema = {
+        "type": "record",
+        "name": "r",
+        "fields": [
+            {"name": "s", "type": "string"},
+            {"name": "d", "type": "double"},
+            {"name": "u", "type": ["null", "long"]},
+            {"name": "arr", "type": {"type": "array", "items": "int"}},
+        ],
+    }
+    buf = io.BytesIO()
+    buf.write(MAGIC)
+    write_long(buf, 2)
+    write_string(buf, "avro.schema")
+    write_bytes(buf, _json.dumps(schema).encode())
+    write_string(buf, "avro.codec")
+    write_bytes(buf, b"null")
+    write_long(buf, 0)
+    sync = b"0123456789abcdef"
+    buf.write(sync)
+    block = io.BytesIO()
+    # record 1: "hi", 2.5, null, [1,2]
+    write_string(block, "hi")
+    block.write(struct.pack("<d", 2.5))
+    write_long(block, 0)
+    write_long(block, 2)
+    write_long(block, 1)
+    write_long(block, 2)
+    write_long(block, 0)
+    # record 2: "yo", -1.0, 7, []
+    write_string(block, "yo")
+    block.write(struct.pack("<d", -1.0))
+    write_long(block, 1)
+    write_long(block, 7)
+    write_long(block, 0)
+    write_long(buf, 2)
+    write_bytes(buf, block.getvalue())
+    buf.write(sync)
+    recs = read_generic_avro(buf.getvalue())
+    assert recs == [
+        {"s": "hi", "d": 2.5, "u": None, "arr": [1, 2]},
+        {"s": "yo", "d": -1.0, "u": 7, "arr": []},
+    ]
+
+
+# -- jdbc --------------------------------------------------------------------
+
+
+def test_jdbc_converter(tmp_path):
+    db = str(tmp_path / "x.db")
+    with sqlite3.connect(db) as conn:
+        conn.execute("CREATE TABLE pts (id TEXT, name TEXT, lon REAL, lat REAL)")
+        conn.executemany(
+            "INSERT INTO pts VALUES (?,?,?,?)",
+            [("a", "Alice", 2.35, 48.85), ("b", "Bob", -0.12, 51.5)],
+        )
+    cfg = {
+        "type": "jdbc",
+        "connection": db,
+        "id-field": "$1",
+        "fields": [
+            {"name": "name", "transform": "$2"},
+            {"name": "age", "transform": "lit(0)::int"},
+            {"name": "geom", "transform": "point($3::double, $4::double)"},
+        ],
+    }
+    res = converter_for(cfg, SFT).process("SELECT id, name, lon, lat FROM pts ORDER BY id")
+    assert res.success == 2
+    assert list(res.batch.fids) == ["a", "b"]
+    np.testing.assert_allclose(
+        res.batch.column("geom"), [[2.35, 48.85], [-0.12, 51.5]]
+    )
+
+
+# -- shapefile ---------------------------------------------------------------
+
+
+def _mk_shp(shapes: list) -> bytes:
+    """Build a minimal .shp byte blob from (type, payload) tuples."""
+    records = []
+    for i, (stype, payload) in enumerate(shapes):
+        content = struct.pack("<i", stype) + payload
+        header = struct.pack(">ii", i + 1, len(content) // 2)
+        records.append(header + content)
+    body = b"".join(records)
+    total_words = (100 + len(body)) // 2
+    hdr = struct.pack(">i", 9994) + b"\x00" * 20 + struct.pack(">i", total_words)
+    hdr += struct.pack("<ii", 1000, shapes[0][0] if shapes else 0)
+    hdr += struct.pack("<8d", 0, 0, 0, 0, 0, 0, 0, 0)
+    return hdr + body
+
+
+def _mk_dbf(names, rows) -> bytes:
+    fields = b""
+    for name in names:
+        fields += name.encode().ljust(11, b"\x00") + b"C" + b"\x00" * 4
+        fields += bytes([20, 0]) + b"\x00" * 14
+    header_size = 32 + len(fields) + 1
+    record_size = 1 + 20 * len(names)
+    hdr = bytes([3, 120, 1, 1]) + struct.pack(
+        "<iHH", len(rows), header_size, record_size
+    )
+    hdr += b"\x00" * 20 + fields + b"\x0d"
+    body = b""
+    for row in rows:
+        body += b" " + b"".join(str(v).encode().ljust(20) for v in row)
+    return hdr + body
+
+
+def test_shp_points_with_dbf():
+    shp = _mk_shp(
+        [
+            (1, struct.pack("<dd", 2.35, 48.85)),
+            (1, struct.pack("<dd", -0.12, 51.5)),
+        ]
+    )
+    dbf = _mk_dbf(["NAME"], [["Alice"], ["Bob"]])
+    cfg = {
+        "type": "shp",
+        "id-field": "$NAME",
+        "fields": [
+            {"name": "name", "transform": "$NAME"},
+            {"name": "age", "transform": "lit(1)::int"},
+            {"name": "geom", "transform": "$geom"},
+        ],
+    }
+    res = converter_for(cfg, SFT).process(shp, dbf=dbf)
+    assert res.success == 2
+    assert list(res.batch.fids) == ["Alice", "Bob"]
+    np.testing.assert_allclose(
+        res.batch.column("geom"), [[2.35, 48.85], [-0.12, 51.5]]
+    )
+
+
+def test_shp_polygon_and_polyline():
+    from geomesa_tpu.convert.shp import read_shp
+
+    # square polygon, CW ring (outer): (0,0) (0,1) (1,1) (1,0) back to (0,0)
+    ring = np.array([[0, 0], [0, 1], [1, 1], [1, 0], [0, 0]], dtype="<f8")
+    poly_payload = (
+        struct.pack("<4d", 0, 0, 1, 1)
+        + struct.pack("<ii", 1, len(ring))
+        + struct.pack("<i", 0)
+        + ring.tobytes()
+    )
+    line = np.array([[0, 0], [2, 2], [4, 0]], dtype="<f8")
+    line_payload = (
+        struct.pack("<4d", 0, 0, 4, 2)
+        + struct.pack("<ii", 1, len(line))
+        + struct.pack("<i", 0)
+        + line.tobytes()
+    )
+    geoms = read_shp(_mk_shp([(5, poly_payload)]))
+    assert isinstance(geoms[0], Polygon)
+    np.testing.assert_allclose(geoms[0].shell, ring)
+    geoms = read_shp(_mk_shp([(3, line_payload)]))
+    np.testing.assert_allclose(geoms[0].coords, line)
+
+
+def test_shp_default_field_mapping(tmp_path):
+    shp = _mk_shp([(1, struct.pack("<dd", 1.0, 2.0))])
+    dbf = _mk_dbf(["name", "age"], [["Ann", 3]])
+    p = tmp_path / "pts.shp"
+    p.write_bytes(shp)
+    (tmp_path / "pts.dbf").write_bytes(dbf)
+    cfg = {"type": "shp"}
+    sft = SimpleFeatureType.create("p", "name:String,age:Int,*geom:Point")
+    res = converter_for(cfg, sft).process(str(p))
+    assert res.success == 1
+    assert list(res.batch.column("name")) == ["Ann"]
+    assert res.batch.column("age").tolist() == [3]

@@ -123,3 +123,85 @@ def test_st_dwithin_exact_on_segment_interiors():
     poly = Polygon([(0, 0), (10, 0), (10, 10), (0, 10), (0, 0)])
     assert st_distance(Point(5, -3), poly) == 3.0
     assert st_distance(line, poly) == 0.0  # boundary contact
+
+
+# -- round-2 review findings -------------------------------------------------
+
+
+def _kv_store():
+    from geomesa_tpu.store.kv import KVDataStore, MemoryKV
+
+    ds = KVDataStore(MemoryKV())
+    ds.create_schema(
+        SimpleFeatureType.create("t", "name:String,dtg:Date,*geom:Point")
+    )
+    return ds
+
+
+def test_kv_overlapping_or_ranges_no_duplicates():
+    ds = _kv_store()
+    ds.write(
+        "t",
+        {"name": ["a"], "dtg": [1000], "geom": np.array([[5.0, 5.0]])},
+        fids=["f0"],
+    )
+    q = ds.query("t", "bbox(geom, 0, 0, 10, 10) or bbox(geom, 2, 2, 12, 12)")
+    assert list(q.batch.fids) == ["f0"]  # scanned once despite overlapping ranges
+
+
+def test_kv_upsert_replaces_index_rows():
+    ds = _kv_store()
+    ds.write(
+        "t",
+        {"name": ["old"], "dtg": [1000], "geom": np.array([[5.0, 5.0]])},
+        fids=["f7"],
+    )
+    ds.write(
+        "t",
+        {"name": ["new"], "dtg": [2000], "geom": np.array([[50.0, 50.0]])},
+        fids=["f7"],
+    )
+    # stale z3 row at the old location must be gone
+    q_old = ds.query("t", "bbox(geom, 0, 0, 10, 10)")
+    assert len(q_old.batch) == 0
+    q_new = ds.query("t", "bbox(geom, 45, 45, 55, 55)")
+    assert list(q_new.batch.column("name")) == ["new"]
+    # and the exact count reflects one live feature
+    q_all = ds.query("t")
+    assert q_all.total == 1
+    # delete removes it everywhere, permanently
+    assert ds.delete("t", ["f7"]) == 1
+    assert len(ds.query("t").batch) == 0
+    assert ds.query("t").total == 0
+
+
+def test_kv_delete_updates_count_stat():
+    ds = _kv_store()
+    ds.write(
+        "t",
+        {
+            "name": ["a", "b", "c"],
+            "dtg": [1, 2, 3],
+            "geom": np.array([[0.0, 0.0], [1.0, 1.0], [2.0, 2.0]]),
+        },
+        fids=["x", "y", "z"],
+    )
+    assert ds.query("t").total == 3
+    ds.delete("t", ["x", "y"])
+    assert ds.query("t").total == 1
+
+
+def test_st_distance_polygon_hole_vertices():
+    from geomesa_tpu.geom import LineString, Polygon
+    from geomesa_tpu.sql.functions import st_distance
+
+    shell = np.array([[0, 0], [6, 0], [6, 6], [0, 6], [0, 0]], dtype=float)
+    hole = np.array(
+        [[1, 2], [2, 2], [2, 1], [4, 1], [4, 4], [1, 4], [1, 2]], dtype=float
+    )
+    poly = Polygon(shell, (hole,))
+    seg = LineString(np.array([[2.2, 2.5], [2.5, 2.2]]))
+    d = st_distance(poly, seg)
+    # nearest point is the protruding hole corner (2, 2): the segment lies
+    # on x + y = 4.7, so the distance is 0.7 / sqrt(2)
+    assert abs(d - 0.7 / np.sqrt(2)) < 1e-9

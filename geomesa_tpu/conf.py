@@ -1,0 +1,89 @@
+"""Runtime system properties.
+
+Ref role: geomesa-utils .../conf/GeoMesaSystemProperties [UNVERIFIED -
+empty reference mount] -- the third config tier (SURVEY.md section 5:
+store params / SFT user-data / JVM system properties). Each property has a
+default, an environment override (``GEOMESA_TPU_<NAME>`` with dots as
+underscores), and a programmatic override for tests
+(``set_prop``/``clear_prop`` or the ``prop_override`` context manager).
+
+Properties:
+
+- ``scan.ranges.target``        max z-ranges per query plan (ref
+                                geomesa.scan.ranges.target)
+- ``query.timeout``             per-query wall-clock budget in ms; 0 = off
+                                (ref geomesa.query.timeout)
+- ``query.block.full.table``    raise instead of running a full-table scan
+                                (ref geomesa.scan.block.full.table)
+- ``query.max.features``        global cap on returned features; 0 = off
+- ``scan.chunk``                KV scan deserialization chunk size
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+
+def _parse_bool(v) -> bool:
+    return str(v).strip().lower() in ("true", "1", "t", "yes", "on")
+
+
+from geomesa_tpu.curves.zranges import DEFAULT_MAX_RANGES
+
+_DEFS = {
+    "scan.ranges.target": (DEFAULT_MAX_RANGES, int),
+    "query.timeout": (0, int),  # ms; 0 = unlimited
+    "query.block.full.table": (False, _parse_bool),
+    "query.max.features": (0, int),  # 0 = unlimited
+    "scan.chunk": (65536, int),
+}
+
+_overrides: dict = {}
+
+
+def _env_key(name: str) -> str:
+    return "GEOMESA_TPU_" + name.upper().replace(".", "_")
+
+
+def sys_prop(name: str):
+    """Resolve a property: programmatic override > env > default."""
+    if name not in _DEFS:
+        raise KeyError(f"unknown system property {name!r}")
+    default, parse = _DEFS[name]
+    if name in _overrides:
+        return _overrides[name]
+    env = os.environ.get(_env_key(name))
+    if env is not None:
+        return parse(env)
+    return default
+
+
+def set_prop(name: str, value) -> None:
+    if name not in _DEFS:
+        raise KeyError(f"unknown system property {name!r}")
+    _overrides[name] = _DEFS[name][1](value)
+
+
+def clear_prop(name: str) -> None:
+    _overrides.pop(name, None)
+
+
+_MISSING = object()
+
+
+@contextmanager
+def prop_override(name: str, value):
+    prev = _overrides.get(name, _MISSING)
+    set_prop(name, value)
+    try:
+        yield
+    finally:
+        if prev is _MISSING:
+            clear_prop(name)
+        else:
+            _overrides[name] = prev
+
+
+class QueryTimeout(RuntimeError):
+    """Raised when a query exceeds the ``query.timeout`` budget."""

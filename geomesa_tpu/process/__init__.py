@@ -10,6 +10,12 @@ aggregating server-side iterators).
 - ``tube``:     spatio-temporal corridor select (ref TubeSelectProcess)
 - ``statsproc``: Stat-DSL aggregation over query results (ref StatsProcess/
                  StatsIterator)
+- ``proximity``: features within distance of input geometries (ref
+                 ProximitySearchProcess)
+- ``route``:    along-route search with heading match (ref RouteSearchProcess)
+- ``dateoffset``: shift result timestamps (ref DateOffsetProcess)
+- ``conversion``: query results as Arrow IPC / BIN payloads (ref
+                 ArrowConversionProcess / BinConversionProcess)
 
 Aggregations run as device reductions (scatter-add, segment reductions)
 over the same staged columns the scan kernels use -- the rebuild's version
@@ -22,6 +28,10 @@ from geomesa_tpu.process.knn import knn
 from geomesa_tpu.process.sampling import sample
 from geomesa_tpu.process.statsproc import run_stats
 from geomesa_tpu.process.tube import tube_select
+from geomesa_tpu.process.proximity import proximity_search
+from geomesa_tpu.process.route import route_search
+from geomesa_tpu.process.dateoffset import date_offset, parse_duration_ms
+from geomesa_tpu.process.conversion import arrow_conversion, bin_conversion
 
 __all__ = [
     "density",
@@ -31,4 +41,10 @@ __all__ = [
     "sample",
     "run_stats",
     "tube_select",
+    "proximity_search",
+    "route_search",
+    "date_offset",
+    "parse_duration_ms",
+    "arrow_conversion",
+    "bin_conversion",
 ]

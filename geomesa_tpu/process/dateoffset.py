@@ -1,0 +1,50 @@
+"""Date offset: shift the time attribute of query results.
+
+Ref role: geomesa-process DateOffsetProcess [UNVERIFIED - empty reference
+mount]: returns the input collection with its date field offset by a
+period -- used to replay historical tracks as if current. Offsets may be
+given in millis or ISO-8601 duration strings (``P1D``, ``PT6H30M``,
+``-PT15S``).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from geomesa_tpu.features.batch import FeatureBatch
+
+_ISO = re.compile(
+    r"^(?P<sign>-)?P(?:(?P<d>\d+)D)?"
+    r"(?:T(?:(?P<h>\d+)H)?(?:(?P<m>\d+)M)?(?:(?P<s>\d+(?:\.\d+)?)S)?)?$"
+)
+
+
+def parse_duration_ms(offset) -> int:
+    """ISO-8601 duration (days and smaller) or millis -> signed millis."""
+    if isinstance(offset, (int, np.integer)):
+        return int(offset)
+    m = _ISO.match(str(offset).strip())
+    if not m or m.group(0) in ("P", "-P"):
+        raise ValueError(f"bad duration {offset!r}")
+    ms = (
+        int(m.group("d") or 0) * 86400_000
+        + int(m.group("h") or 0) * 3600_000
+        + int(m.group("m") or 0) * 60_000
+        + int(float(m.group("s") or 0) * 1000)
+    )
+    return -ms if m.group("sign") else ms
+
+
+def date_offset(
+    batch: FeatureBatch, offset, dtg_attr: "str | None" = None
+) -> FeatureBatch:
+    """New batch with the date column shifted by ``offset``."""
+    dtg_attr = dtg_attr or batch.sft.dtg_field
+    if dtg_attr is None:
+        raise ValueError("no date attribute")
+    ms = parse_duration_ms(offset)
+    cols = dict(batch.columns)
+    cols[dtg_attr] = batch.column(dtg_attr) + np.int64(ms)
+    return FeatureBatch(batch.sft, batch.fids, cols)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from geomesa_tpu.filter import ast
+from geomesa_tpu.query.plan import internal_query
 
 
 def _dist_deg(x, y, px, py):
@@ -45,13 +46,13 @@ def knn(
     batch = None
     while r <= max_radius_deg:
         f = ast.And((ast.BBox(geom, px - r, py - r, px + r, py + r), base))
-        res = store.query(type_name, f)
+        res = store.query(type_name, internal_query(f))
         if len(res) >= k:
             batch = res.batch
             break
         r *= 2
     if batch is None:
-        res = store.query(type_name, base)
+        res = store.query(type_name, internal_query(base))
         batch = res.batch
     if len(batch) == 0:
         return batch, np.array([])
@@ -65,7 +66,7 @@ def knn(
     # window's lon extent under-covers because the metric shrinks lon.
     rx = kth / max(np.cos(np.radians(py)), 0.01)
     f = ast.And((ast.BBox(geom, px - rx, py - kth, px + rx, py + kth), base))
-    batch = store.query(type_name, f).batch
+    batch = store.query(type_name, internal_query(f)).batch
     x, y = batch.point_coords(geom)
     d = _dist_deg(x, y, px, py)
     order = np.argsort(d, kind="stable")[:k]

@@ -1,0 +1,84 @@
+"""Proximity search: features within a distance of a set of input
+geometries.
+
+Ref role: geomesa-process ProximitySearchProcess [UNVERIFIED - empty
+reference mount]: wraps each input feature in a buffer and returns data
+features intersecting any buffer. Here: one bbox query over the expanded
+union envelope (index prune), then an exact vectorized point-to-segment
+distance pass over the candidates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.query.plan import internal_query
+from geomesa_tpu.geom import Geometry, Point
+
+
+def _as_geoms(inputs) -> list:
+    if isinstance(inputs, Geometry):
+        return [inputs]
+    out = []
+    for g in inputs:
+        if isinstance(g, Geometry):
+            out.append(g)
+        else:  # (x, y) pair
+            out.append(Point(float(g[0]), float(g[1])))
+    return out
+
+
+def proximity_search(
+    store,
+    type_name: str,
+    inputs,
+    distance_deg: float,
+    base_filter: "ast.Filter | str | None" = None,
+):
+    """Returns (batch, dist_deg): data features within ``distance_deg`` of
+    any input geometry, with the distance to the nearest input."""
+    from geomesa_tpu.filter.ecql import parse_ecql
+    from geomesa_tpu.sql.functions import _pt_seg_dist, _segments_of
+
+    geoms = _as_geoms(inputs)
+    if not geoms:
+        raise ValueError("no input geometries")
+    base = (
+        parse_ecql(base_filter)
+        if isinstance(base_filter, str)
+        else (base_filter or ast.Include)
+    )
+    sft = store.get_schema(type_name)
+    geom_field = sft.geom_field
+    # one expanded bbox PER input (not one union envelope: two far-apart
+    # inputs would otherwise pull in everything between them); the planner
+    # handles OR'd bboxes and overlapping ranges are coalesced downstream
+    boxes = tuple(
+        ast.BBox(
+            geom_field,
+            g.envelope.xmin - distance_deg,
+            g.envelope.ymin - distance_deg,
+            g.envelope.xmax + distance_deg,
+            g.envelope.ymax + distance_deg,
+        )
+        for g in geoms
+    )
+    f = ast.And((boxes[0] if len(boxes) == 1 else ast.Or(boxes), base))
+    batch = store.query(type_name, internal_query(f)).batch
+    if len(batch) == 0:
+        return batch, np.array([])
+    x, y = batch.point_coords(geom_field)
+    segs = np.concatenate([_segments_of(g) for g in geoms], axis=0)
+    pts = np.stack([x, y], axis=1)
+    # min distance from each candidate point to any input segment
+    p = pts[:, None, :]
+    a = segs[None, :, 0:2]
+    d = segs[None, :, 2:4] - a
+    len2 = (d**2).sum(-1)
+    t = ((p - a) * d).sum(-1) / np.where(len2 == 0, 1.0, len2)
+    t = np.clip(np.where(len2 == 0, 0.0, t), 0.0, 1.0)
+    near = a + t[..., None] * d
+    dist = np.sqrt(((p - near) ** 2).sum(-1)).min(axis=1)
+    keep = np.nonzero(dist <= distance_deg)[0]
+    return batch.take(keep), dist[keep]

@@ -1,0 +1,91 @@
+"""Route search: features along a route, optionally heading-matched.
+
+Ref role: geomesa-process RouteSearchProcess [UNVERIFIED - empty reference
+mount]: selects features within a buffer of a route LineString whose
+heading attribute (degrees clockwise from north) matches the route's local
+bearing within a tolerance. Returns the matches ordered by distance along
+the route (the reference's routing use case: vehicles on a road).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.query.plan import internal_query
+from geomesa_tpu.geom import LineString
+
+
+def route_search(
+    store,
+    type_name: str,
+    route,
+    buffer_deg: float,
+    heading_attr: "str | None" = None,
+    heading_tolerance_deg: float = 45.0,
+    bidirectional: bool = False,
+    base_filter: "ast.Filter | str | None" = None,
+):
+    """Returns (batch, dist_to_route_deg, dist_along_route_deg), ordered by
+    position along the route."""
+    from geomesa_tpu.filter.ecql import parse_ecql
+
+    if isinstance(route, LineString):
+        coords = np.asarray(route.coords, dtype=np.float64)
+    else:
+        coords = np.asarray(route, dtype=np.float64)
+    if coords.ndim != 2 or len(coords) < 2:
+        raise ValueError("route needs >= 2 coordinates")
+    base = (
+        parse_ecql(base_filter)
+        if isinstance(base_filter, str)
+        else (base_filter or ast.Include)
+    )
+    sft = store.get_schema(type_name)
+    geom_field = sft.geom_field
+    f = ast.And(
+        (
+            ast.BBox(
+                geom_field,
+                coords[:, 0].min() - buffer_deg,
+                coords[:, 1].min() - buffer_deg,
+                coords[:, 0].max() + buffer_deg,
+                coords[:, 1].max() + buffer_deg,
+            ),
+            base,
+        )
+    )
+    batch = store.query(type_name, internal_query(f)).batch
+    if len(batch) == 0:
+        return batch, np.array([]), np.array([])
+    x, y = batch.point_coords(geom_field)
+    pts = np.stack([x, y], axis=1)
+
+    a = coords[:-1]  # (m, 2) segment starts
+    d = coords[1:] - a  # (m, 2) segment vectors
+    seg_len = np.sqrt((d**2).sum(-1))
+    cum = np.concatenate([[0.0], np.cumsum(seg_len)])  # along-route offsets
+    len2 = (d**2).sum(-1)
+    t = ((pts[:, None, :] - a[None]) * d[None]).sum(-1) / np.where(
+        len2 == 0, 1.0, len2
+    )
+    t = np.clip(np.where(len2 == 0, 0.0, t), 0.0, 1.0)
+    near = a[None] + t[..., None] * d[None]
+    dist2 = ((pts[:, None, :] - near) ** 2).sum(-1)
+    seg_idx = dist2.argmin(axis=1)
+    rows = np.arange(len(pts))
+    dist = np.sqrt(dist2[rows, seg_idx])
+    along = cum[seg_idx] + t[rows, seg_idx] * seg_len[seg_idx]
+
+    keep = dist <= buffer_deg
+    if heading_attr is not None:
+        # route bearing per segment, degrees clockwise from north
+        bearing = np.degrees(np.arctan2(d[:, 0], d[:, 1])) % 360.0
+        h = np.asarray(batch.column(heading_attr), dtype=np.float64)
+        diff = np.abs((h - bearing[seg_idx] + 180.0) % 360.0 - 180.0)
+        if bidirectional:
+            diff = np.minimum(diff, 180.0 - diff)
+        keep &= diff <= heading_tolerance_deg
+    idx = np.nonzero(keep)[0]
+    order = idx[np.argsort(along[idx], kind="stable")]
+    return batch.take(order), dist[order], along[order]

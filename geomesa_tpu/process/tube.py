@@ -12,6 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from geomesa_tpu.filter import ast
+from geomesa_tpu.query.plan import internal_query
 
 
 def tube_select(
@@ -61,11 +62,11 @@ def tube_select(
                 base,
             )
         )
-        b = store.query(type_name, f).batch
+        b = store.query(type_name, internal_query(f)).batch
         if len(b):
             chunks.append(b)
     if not chunks:
-        return store.query(type_name, ast.Exclude).batch
+        return store.query(type_name, internal_query(ast.Exclude)).batch
     merged = chunks[0] if len(chunks) == 1 else FeatureBatch.concat(chunks)
     # dedupe by fid
     _, first = np.unique(merged.fids, return_index=True)

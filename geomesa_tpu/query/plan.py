@@ -27,8 +27,6 @@ from geomesa_tpu.filter.extract import (
 from geomesa_tpu.index.api import BuiltIndex, KeyRange
 from geomesa_tpu.index.keyspaces import AttributeKeySpace, IdKeySpace
 
-from geomesa_tpu.curves.zranges import DEFAULT_MAX_RANGES
-
 
 @dataclass
 class Query:
@@ -88,11 +86,30 @@ def plan_query(
     sft: SimpleFeatureType,
     indices: dict,
     query: Query,
-    max_ranges: int = DEFAULT_MAX_RANGES,
+    max_ranges: "int | None" = None,
     data_interval: "tuple[int, int] | None" = None,
 ) -> QueryPlan:
     """indices: {name: BuiltIndex | IndexKeySpace} -- planning only needs
-    the key spaces, so disk-backed stores can plan before loading data."""
+    the key spaces, so disk-backed stores can plan before loading data.
+
+    The interceptor chain (geomesa_tpu.query.interceptor) rewrites the
+    query before planning and can veto the finished plan; ``max_ranges``
+    defaults to the three-tier config resolution (SFT user-data
+    ``geomesa.scan.ranges.target``, then the system property)."""
+    from geomesa_tpu.conf import sys_prop
+    from geomesa_tpu.query.interceptor import (
+        apply_interceptors,
+        guard_plan,
+        interceptors_for,
+    )
+
+    chain = interceptors_for(sft)
+    query = apply_interceptors(chain, query, sft)
+    if max_ranges is None:
+        ud = sft.user_data or {}
+        max_ranges = int(
+            ud.get("geomesa.scan.ranges.target") or sys_prop("scan.ranges.target")
+        )
     f = query.parsed()
     geom_field = sft.geom_field
     dtg_field = sft.dtg_field
@@ -140,7 +157,7 @@ def plan_query(
                 geoms, intervals, max_ranges, data_interval=data_interval
             )
     compiled = compile_filter(f, sft)
-    return QueryPlan(
+    plan = QueryPlan(
         sft=sft,
         query=query,
         filter=f,
@@ -151,6 +168,8 @@ def plan_query(
         time_bounds=intervals,
         candidates=candidates,
     )
+    guard_plan(chain, plan)
+    return plan
 
 
 def as_query(q) -> Query:
@@ -159,6 +178,13 @@ def as_query(q) -> Query:
     if isinstance(q, Query):
         return q
     return Query(filter=q)
+
+
+def internal_query(f) -> Query:
+    """A maintenance/candidate-scan query: exempt from user-facing caps
+    like the global ``query.max.features`` (truncating an age-off sweep or
+    a kNN candidate scan would corrupt the result)."""
+    return Query(filter=f, hints={"internal": True})
 
 
 def _attr_equality(f: ast.Filter, attr: str):

@@ -31,7 +31,13 @@ from geomesa_tpu.filter import ast
 from geomesa_tpu.index.api import BuiltIndex, KeyRange, PartitionMeta
 from geomesa_tpu.index.build import DEFAULT_PARTITION_SIZE, build_index
 from geomesa_tpu.index.keyspaces import default_indices, keyspace_for
-from geomesa_tpu.query.plan import Query, QueryPlan, as_query, plan_query
+from geomesa_tpu.query.plan import (
+    Query,
+    QueryPlan,
+    as_query,
+    internal_query,
+    plan_query,
+)
 from geomesa_tpu.query.runner import QueryResult, run_query
 
 
@@ -184,6 +190,30 @@ class FileSystemDataStore:
             st.data_interval = (int(col.min()), int(col.max()))
         self._save_meta(type_name)
 
+    def delete(self, type_name: str, fids) -> int:
+        """Drop features by id and compact the partition files."""
+        st = self._types[type_name]
+        self.flush(type_name)
+        if not st.partitions:
+            return 0
+        data = self._read_all(type_name)
+        keep = ~np.isin(data.fids, np.asarray(fids))
+        removed = int((~keep).sum())
+        if removed:
+            st.pending = [data.take(np.nonzero(keep)[0])]
+            st.partitions = []
+            self.flush(type_name)
+        return removed
+
+    def age_off(self, type_name: str, before_ms: int) -> int:
+        """Remove features older than a cutoff (ref AgeOffIterator)."""
+        st = self._types[type_name]
+        dtg = st.sft.dtg_field
+        if dtg is None:
+            raise ValueError(f"{type_name!r} has no Date field")
+        old = self.query(type_name, internal_query(ast.Compare("<", dtg, before_ms)))
+        return self.delete(type_name, list(old.batch.fids))
+
     def _read_partition(self, type_name: str, pid: int) -> FeatureBatch:
         st = self._types[type_name]
         if pid not in st.cache:
@@ -238,7 +268,15 @@ class FileSystemDataStore:
             plan,
             query=Query(filter=plan.filter, hints={"internal_scan": True}),
         )
+        from geomesa_tpu.conf import QueryTimeout, sys_prop
+
+        timeout_ms = sys_prop("query.timeout")
+        deadline = t0 + timeout_ms / 1000.0 if timeout_ms else None
         for p in parts:
+            if deadline and _time.perf_counter() > deadline:
+                raise QueryTimeout(
+                    f"query on {type_name!r} exceeded {timeout_ms}ms"
+                )
             batch = self._read_partition(type_name, p.pid)
             scanned += len(batch)
             local = BuiltIndex(

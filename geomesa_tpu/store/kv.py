@@ -501,8 +501,10 @@ class KVDataStore:
         dtg = sft.dtg_field
         if dtg is None:
             raise ValueError(f"{type_name!r} has no Date field")
+        from geomesa_tpu.query.plan import internal_query
+
         old = self.query(
-            type_name, Query(filter=ast.Compare("<", dtg, before_ms))
+            type_name, internal_query(ast.Compare("<", dtg, before_ms))
         )
         return self.delete(type_name, list(old.batch.fids))
 
@@ -604,12 +606,20 @@ class KVDataStore:
             buf_k.clear()
             buf_v.clear()
 
+        from geomesa_tpu.conf import QueryTimeout, sys_prop
+
+        timeout_ms = sys_prop("query.timeout")
+        deadline = t0 + timeout_ms / 1000.0 if timeout_ms else None
         for lo, hi in _coalesce(self._byte_ranges(ks, plan)):
             for k, v in self.backend.scan(table, lo, hi):
                 buf_k.append(k)
                 buf_v.append(v)
                 if len(buf_k) >= SCAN_CHUNK:
                     flush_chunk()
+                    if deadline and _time.perf_counter() > deadline:
+                        raise QueryTimeout(
+                            f"query on {type_name!r} exceeded {timeout_ms}ms"
+                        )
         flush_chunk()
 
         if chunks:

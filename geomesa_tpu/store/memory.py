@@ -101,6 +101,18 @@ class MemoryDataStore:
         st.indices = {}
         return removed
 
+    def age_off(self, type_name: str, before_ms: int) -> int:
+        """Remove features older than a cutoff (ref AgeOffIterator, run as
+        a sweep)."""
+        st = self._state(type_name)
+        dtg = st.sft.dtg_field
+        if dtg is None:
+            raise ValueError(f"{type_name!r} has no Date field")
+        from geomesa_tpu.query.plan import internal_query
+
+        old = self.query(type_name, internal_query(ast.Compare("<", dtg, before_ms)))
+        return self.delete(type_name, list(old.batch.fids))
+
     def _flush(self, st: _TypeState) -> None:
         if st.pending:
             batches = ([st.data] if st.data is not None else []) + st.pending

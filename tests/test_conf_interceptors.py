@@ -1,0 +1,236 @@
+"""System properties, query interceptors, guard rails, age-off."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.conf import (
+    QueryTimeout,
+    clear_prop,
+    prop_override,
+    set_prop,
+    sys_prop,
+)
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.filter import ast
+from geomesa_tpu.store.fs import FileSystemDataStore
+from geomesa_tpu.store.kv import KVDataStore, MemoryKV
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "name:String,dtg:Date,*geom:Point"
+
+
+def _write_points(ds, n=10):
+    ds.create_schema(SimpleFeatureType.create("t", SPEC))
+    ds.write(
+        "t",
+        {
+            "name": [f"n{i}" for i in range(n)],
+            "dtg": [1000 * (i + 1) for i in range(n)],
+            "geom": np.stack(
+                [np.linspace(0, 9, n), np.linspace(0, 9, n)], axis=1
+            ),
+        },
+        fids=[f"f{i}" for i in range(n)],
+    )
+    return ds
+
+
+def test_sys_prop_tiers(monkeypatch):
+    assert sys_prop("scan.ranges.target") == 2000
+    monkeypatch.setenv("GEOMESA_TPU_SCAN_RANGES_TARGET", "77")
+    assert sys_prop("scan.ranges.target") == 77
+    set_prop("scan.ranges.target", 11)
+    assert sys_prop("scan.ranges.target") == 11
+    clear_prop("scan.ranges.target")
+    assert sys_prop("scan.ranges.target") == 77
+    with pytest.raises(KeyError):
+        sys_prop("not.a.prop")
+
+
+def test_sft_user_data_ranges_tier():
+    ds = MemoryDataStore()
+    sft = SimpleFeatureType.create("t", SPEC)
+    sft.user_data["geomesa.scan.ranges.target"] = "3"
+    ds.create_schema(sft)
+    ds.write(
+        "t",
+        {"name": ["a"], "dtg": [1000], "geom": np.array([[1.0, 1.0]])},
+    )
+    plan = ds.plan("t", "bbox(geom, -60, -60, 60, 60)")
+    # the per-envelope budget floors at 16; the tier still shrinks the plan
+    assert plan.ranges is not None and len(plan.ranges) <= 16
+    del sft.user_data["geomesa.scan.ranges.target"]
+    default_plan = ds.plan("t", "bbox(geom, -60, -60, 60, 60)")
+    assert len(default_plan.ranges) > len(plan.ranges)
+
+
+def test_full_table_scan_guard():
+    ds = _write_points(MemoryDataStore())
+    assert len(ds.query("t").batch) == 10  # allowed by default
+    with prop_override("query.block.full.table", True):
+        with pytest.raises(ValueError, match="full-table scan"):
+            ds.query("t")
+        # pruning queries still fine
+        assert len(ds.query("t", "bbox(geom, 0, 0, 4, 4)").batch) == 5
+
+
+def test_full_table_scan_guard_via_user_data():
+    ds = MemoryDataStore()
+    sft = SimpleFeatureType.create("t", SPEC)
+    sft.user_data["geomesa.block.full.table"] = "true"
+    ds.create_schema(sft)
+    ds.write(
+        "t", {"name": ["a"], "dtg": [1000], "geom": np.array([[1.0, 1.0]])}
+    )
+    with pytest.raises(ValueError, match="blocked"):
+        ds.query("t")
+
+
+def test_max_features_property():
+    ds = _write_points(MemoryDataStore())
+    with prop_override("query.max.features", 4):
+        assert len(ds.query("t", "bbox(geom, -10, -10, 10, 10)").batch) == 4
+
+
+def test_custom_interceptor_from_user_data():
+    ds = MemoryDataStore()
+    sft = SimpleFeatureType.create("t", SPEC)
+    sft.user_data["geomesa.query.interceptors"] = (
+        "tests.test_conf_interceptors.OnlyFirstFive"
+    )
+    ds.create_schema(sft)
+    ds.write(
+        "t",
+        {
+            "name": [f"n{i}" for i in range(10)],
+            "dtg": [1000] * 10,
+            "geom": np.zeros((10, 2)),
+        },
+    )
+    assert len(ds.query("t").batch) == 5
+
+
+class OnlyFirstFive:
+    def rewrite(self, query, sft):
+        import dataclasses
+
+        return dataclasses.replace(query, max_features=5)
+
+    def guard(self, plan):
+        pass
+
+
+def test_kv_query_timeout():
+    ds = _write_points(KVDataStore(MemoryKV()))
+    with prop_override("query.timeout", 1):
+        import geomesa_tpu.store.kv as kvmod
+
+        old = kvmod.SCAN_CHUNK
+        kvmod.SCAN_CHUNK = 1  # force per-row deadline checks
+        try:
+            import time
+
+            real = time.perf_counter
+            state = {"t": real()}
+
+            def advancing():  # +1s per call: blows the 1ms budget instantly
+                state["t"] += 1.0
+                return state["t"]
+
+            with pytest.raises(QueryTimeout):
+                time.perf_counter = advancing
+                try:
+                    ds.query("t")
+                finally:
+                    time.perf_counter = real
+        finally:
+            kvmod.SCAN_CHUNK = old
+
+
+def test_age_off_memory_and_fs(tmp_path):
+    ds = _write_points(MemoryDataStore())
+    assert ds.age_off("t", before_ms=5500) == 5
+    assert len(ds.query("t").batch) == 5
+
+    fs = _write_points(FileSystemDataStore(str(tmp_path)))
+    fs.flush("t")
+    assert fs.age_off("t", before_ms=5500) == 5
+    assert len(fs.query("t").batch) == 5
+    # delete survives reopen
+    fs2 = FileSystemDataStore(str(tmp_path))
+    assert len(fs2.query("t").batch) == 5
+
+
+def test_fs_delete_all(tmp_path):
+    fs = _write_points(FileSystemDataStore(str(tmp_path)), n=3)
+    fs.flush("t")
+    assert fs.delete("t", ["f0", "f1", "f2"]) == 3
+    assert len(fs.query("t").batch) == 0
+
+
+def test_prop_override_restores_prior_override():
+    set_prop("query.timeout", 5000)
+    try:
+        with prop_override("query.timeout", 0):
+            assert sys_prop("query.timeout") == 0
+        assert sys_prop("query.timeout") == 5000
+    finally:
+        clear_prop("query.timeout")
+
+
+def test_internal_queries_bypass_max_features_cap():
+    ds = _write_points(MemoryDataStore())
+    with prop_override("query.max.features", 2):
+        # age_off must sweep ALL expired rows, not the first 2
+        assert ds.age_off("t", before_ms=5500) == 5
+        assert len(ds.query("t", "bbox(geom, -10, -10, 10, 10)").batch) == 2
+
+
+def test_proximity_far_apart_inputs_prunes():
+    from geomesa_tpu.geom import Point
+    from geomesa_tpu.process import proximity_search
+
+    ds = MemoryDataStore()
+    ds.create_schema(SimpleFeatureType.create("t", SPEC))
+    n = 50
+    ds.write(
+        "t",
+        {
+            "name": [f"n{i}" for i in range(n)],
+            "dtg": [1000] * n,
+            "geom": np.stack(
+                [np.linspace(-40, 40, n), np.zeros(n)], axis=1
+            ),
+        },
+    )
+    b, dist = proximity_search(ds, "t", [Point(-40, 0), Point(40, 0)], 0.5)
+    # only the two endpoints, nothing from the span in between
+    assert len(b) == 2
+
+
+def test_stateful_interceptor_cached_per_schema():
+    ds = MemoryDataStore()
+    sft = SimpleFeatureType.create("t", SPEC)
+    sft.user_data["geomesa.query.interceptors"] = (
+        "tests.test_conf_interceptors.CountingInterceptor"
+    )
+    ds.create_schema(sft)
+    ds.write(
+        "t", {"name": ["a"], "dtg": [1000], "geom": np.array([[1.0, 1.0]])}
+    )
+    ds.query("t")
+    ds.query("t")
+    chain_cache = sft.user_data["__geomesa.interceptor.instances__"]
+    assert chain_cache[1][0].calls >= 2  # same instance saw both queries
+
+
+class CountingInterceptor:
+    def __init__(self):
+        self.calls = 0
+
+    def rewrite(self, query, sft):
+        self.calls += 1
+        return query
+
+    def guard(self, plan):
+        pass

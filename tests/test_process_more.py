@@ -1,0 +1,118 @@
+"""Proximity / route / date-offset / conversion processes."""
+
+import io
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.geom import LineString, Point
+from geomesa_tpu.process import (
+    arrow_conversion,
+    bin_conversion,
+    date_offset,
+    parse_duration_ms,
+    proximity_search,
+    route_search,
+)
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "name:String,heading:Double,dtg:Date,*geom:Point"
+
+
+@pytest.fixture()
+def store():
+    ds = MemoryDataStore()
+    sft = SimpleFeatureType.create("ships", SPEC)
+    ds.create_schema(sft)
+    # three points along the x-axis route, one far away
+    ds.write(
+        "ships",
+        {
+            "name": ["a", "b", "c", "far"],
+            "heading": [90.0, 270.0, 85.0, 0.0],
+            "dtg": [1000, 2000, 3000, 4000],
+            "geom": np.array(
+                [[0.5, 0.05], [1.5, -0.08], [2.5, 0.0], [10.0, 5.0]]
+            ),
+        },
+        fids=["a", "b", "c", "far"],
+    )
+    return ds
+
+
+def test_proximity_search(store):
+    batch, dist = proximity_search(
+        store, "ships", [Point(0.5, 0.0), Point(2.5, 0.2)], 0.25
+    )
+    assert sorted(batch.column("name")) == ["a", "c"]
+    assert (dist <= 0.25).all()
+
+
+def test_proximity_search_segment_input(store):
+    # a line input catches everything within buffer of the whole segment
+    line = LineString(np.array([[0.0, 0.0], [3.0, 0.0]]))
+    batch, dist = proximity_search(store, "ships", [line], 0.1)
+    assert sorted(batch.column("name")) == ["a", "b", "c"]
+
+
+def test_route_search_orders_along_route(store):
+    route = np.array([[0.0, 0.0], [3.0, 0.0]])
+    batch, dist, along = route_search(store, "ships", route, 0.2)
+    assert list(batch.column("name")) == ["a", "b", "c"]
+    assert np.all(np.diff(along) > 0)
+    np.testing.assert_allclose(along, [0.5, 1.5, 2.5], atol=1e-9)
+
+
+def test_route_search_heading_filter(store):
+    route = np.array([[0.0, 0.0], [3.0, 0.0]])  # bearing 90 (due east)
+    batch, _, _ = route_search(
+        store, "ships", route, 0.2, heading_attr="heading",
+        heading_tolerance_deg=30.0,
+    )
+    # a (90) and c (85) match; b (270) is opposite
+    assert sorted(batch.column("name")) == ["a", "c"]
+    batch2, _, _ = route_search(
+        store, "ships", route, 0.2, heading_attr="heading",
+        heading_tolerance_deg=30.0, bidirectional=True,
+    )
+    assert sorted(batch2.column("name")) == ["a", "b", "c"]
+
+
+def test_date_offset():
+    assert parse_duration_ms("P1D") == 86400_000
+    assert parse_duration_ms("PT6H30M") == 23400_000
+    assert parse_duration_ms("-PT15S") == -15_000
+    assert parse_duration_ms(250) == 250
+    with pytest.raises(ValueError):
+        parse_duration_ms("nope")
+    sft = SimpleFeatureType.create("t", "dtg:Date,*geom:Point")
+    b = FeatureBatch.from_columns(
+        sft, {"dtg": [1000, 2000], "geom": np.array([[0.0, 0.0], [1.0, 1.0]])}
+    )
+    out = date_offset(b, "PT1M")
+    assert out.column("dtg").tolist() == [61000, 62000]
+    assert b.column("dtg").tolist() == [1000, 2000]  # input untouched
+
+
+def test_arrow_conversion_roundtrip(store):
+    from geomesa_tpu.arrow_io import read_feature_stream
+
+    payload = arrow_conversion(store, "ships", "BBOX(geom, 0, -1, 3, 1)")
+    batches = list(read_feature_stream(io.BytesIO(payload)))
+    names = sorted(
+        n for b in batches for n in (b.column("name") if len(b) else [])
+    )
+    assert names == ["a", "b", "c"]
+
+
+def test_bin_conversion(store):
+    from geomesa_tpu.process import decode_bin
+
+    payload = bin_conversion(
+        store, "ships", "name", query="BBOX(geom, 0, -1, 3, 1)", sort=True
+    )
+    rec = decode_bin(payload)
+    assert len(rec) == 3
+    assert list(rec["dtg"]) == [1, 2, 3]  # seconds, sorted

@@ -36,7 +36,7 @@ _DEFS = {
     "query.timeout": (0, int),  # ms; 0 = unlimited
     "query.block.full.table": (False, _parse_bool),
     "query.max.features": (0, int),  # 0 = unlimited
-    "scan.chunk": (65536, int),
+    "scan.chunk": (8192, int),  # KV scan deserialization chunk rows
 }
 
 _overrides: dict = {}

@@ -30,6 +30,7 @@ from geomesa_tpu.convert.fixedwidth import FixedWidthConverter
 from geomesa_tpu.convert.avro_conv import AvroConverter
 from geomesa_tpu.convert.jdbc import JdbcConverter
 from geomesa_tpu.convert.shp import ShapefileConverter
+from geomesa_tpu.convert.parquet_conv import ParquetConverter
 
 _CONVERTERS = {
     "delimited-text": DelimitedTextConverter,
@@ -39,6 +40,7 @@ _CONVERTERS = {
     "avro": AvroConverter,
     "jdbc": JdbcConverter,
     "shp": ShapefileConverter,
+    "parquet": ParquetConverter,
 }
 
 
@@ -59,5 +61,6 @@ __all__ = [
     "AvroConverter",
     "JdbcConverter",
     "ShapefileConverter",
+    "ParquetConverter",
     "converter_for",
 ]

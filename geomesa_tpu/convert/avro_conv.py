@@ -144,6 +144,8 @@ def read_generic_avro(data: bytes) -> list:
 
 
 class AvroConverter:
+    binary = True  # CLI opens input files in 'rb' mode
+
     def __init__(self, config: dict, sft):
         self.sft = sft
         self.fields = [

@@ -1,0 +1,88 @@
+"""Parquet converter: ingest from Parquet files.
+
+Ref role: geomesa-convert-parquet ParquetConverter [UNVERIFIED - empty
+reference mount]. Reads a Parquet file via pyarrow, binds each top-level
+column as ``$name`` for the field transforms (the reference binds Parquet
+group fields the same way through its avro-path-style language). Columns
+already in columnar form skip the per-record loop entirely — transforms
+run vectorized over the column arrays.
+
+    {
+      "type": "parquet",
+      "id-field": "$id",
+      "fields": [
+        {"name": "geom", "transform": "point($lon, $lat)"},
+        {"name": "dtg",  "transform": "millisToDate($ts)"},
+        {"name": "name", "path": "name"},
+      ],
+    }
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+from geomesa_tpu.convert.delimited import ConvertResult
+from geomesa_tpu.convert.expression import parse_expression
+from geomesa_tpu.features.batch import FeatureBatch
+
+
+def _column_to_numpy(col) -> np.ndarray:
+    """Arrow column -> numpy, preserving numeric dtypes, object for the rest."""
+    import pyarrow as pa
+
+    arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    if pa.types.is_floating(arr.type) or pa.types.is_integer(arr.type):
+        if arr.null_count == 0:
+            return arr.to_numpy(zero_copy_only=False)
+    if pa.types.is_timestamp(arr.type):
+        # epoch millis (matches the converter expression language's date units)
+        return arr.cast(pa.timestamp("ms")).cast(pa.int64()).to_numpy(
+            zero_copy_only=False
+        )
+    return np.array(arr.to_pylist(), dtype=object)
+
+
+class ParquetConverter:
+    binary = True  # CLI opens input files in 'rb' mode
+
+    def __init__(self, config: dict, sft):
+        self.sft = sft
+        self.fields = [
+            (
+                f["name"],
+                f.get("path"),
+                parse_expression(f["transform"]) if f.get("transform") else None,
+            )
+            for f in config["fields"]
+        ]
+        self.id_expr = (
+            parse_expression(config["id-field"]) if config.get("id-field") else None
+        )
+
+    def process(self, data) -> ConvertResult:
+        import pyarrow.parquet as pq
+
+        if hasattr(data, "read"):
+            data = data.read()
+        if isinstance(data, (bytes, bytearray)):
+            source = io.BytesIO(data)
+        else:
+            source = data  # path
+        table = pq.read_table(source)
+        cols = {name: _column_to_numpy(table[name]) for name in table.column_names}
+        out = {}
+        for name, path, transform in self.fields:
+            if transform is not None:
+                out[name] = transform(cols)
+            elif path is not None:
+                out[name] = cols[path]
+            elif name in cols:
+                out[name] = cols[name]
+            else:
+                raise ValueError(f"field {name!r} needs path or transform")
+        fids = self.id_expr(cols) if self.id_expr else None
+        batch = FeatureBatch.from_columns(self.sft, out, fids)
+        return ConvertResult(batch, len(batch), 0)

@@ -142,6 +142,8 @@ def read_dbf(data: bytes) -> "tuple[list, list[list]]":
 
 
 class ShapefileConverter:
+    binary = True  # CLI opens input files in 'rb' mode
+
     def __init__(self, config: dict, sft):
         self.sft = sft
         self.fields = [

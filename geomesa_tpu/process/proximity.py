@@ -39,7 +39,7 @@ def proximity_search(
     """Returns (batch, dist_deg): data features within ``distance_deg`` of
     any input geometry, with the distance to the nearest input."""
     from geomesa_tpu.filter.ecql import parse_ecql
-    from geomesa_tpu.sql.functions import _pt_seg_dist, _segments_of
+    from geomesa_tpu.sql.functions import _segments_of, pt_seg_project
 
     geoms = _as_geoms(inputs)
     if not geoms:
@@ -72,13 +72,7 @@ def proximity_search(
     segs = np.concatenate([_segments_of(g) for g in geoms], axis=0)
     pts = np.stack([x, y], axis=1)
     # min distance from each candidate point to any input segment
-    p = pts[:, None, :]
-    a = segs[None, :, 0:2]
-    d = segs[None, :, 2:4] - a
-    len2 = (d**2).sum(-1)
-    t = ((p - a) * d).sum(-1) / np.where(len2 == 0, 1.0, len2)
-    t = np.clip(np.where(len2 == 0, 0.0, t), 0.0, 1.0)
-    near = a + t[..., None] * d
-    dist = np.sqrt(((p - near) ** 2).sum(-1)).min(axis=1)
+    _, dist2 = pt_seg_project(pts, segs)
+    dist = np.sqrt(dist2.min(axis=1))
     keep = np.nonzero(dist <= distance_deg)[0]
     return batch.take(keep), dist[keep]

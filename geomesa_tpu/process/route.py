@@ -61,17 +61,13 @@ def route_search(
     x, y = batch.point_coords(geom_field)
     pts = np.stack([x, y], axis=1)
 
+    from geomesa_tpu.sql.functions import pt_seg_project
+
     a = coords[:-1]  # (m, 2) segment starts
     d = coords[1:] - a  # (m, 2) segment vectors
     seg_len = np.sqrt((d**2).sum(-1))
     cum = np.concatenate([[0.0], np.cumsum(seg_len)])  # along-route offsets
-    len2 = (d**2).sum(-1)
-    t = ((pts[:, None, :] - a[None]) * d[None]).sum(-1) / np.where(
-        len2 == 0, 1.0, len2
-    )
-    t = np.clip(np.where(len2 == 0, 0.0, t), 0.0, 1.0)
-    near = a[None] + t[..., None] * d[None]
-    dist2 = ((pts[:, None, :] - near) ** 2).sum(-1)
+    t, dist2 = pt_seg_project(pts, np.concatenate([a, coords[1:]], axis=1))
     seg_idx = dist2.argmin(axis=1)
     rows = np.arange(len(pts))
     dist = np.sqrt(dist2[rows, seg_idx])

@@ -6,11 +6,15 @@ planning and/or veto plans after (the reference's guard example is the
 full-table-scan block). Interceptors are declared in SFT user data as
 dotted class paths::
 
-    geomesa.query.interceptors = "my.module.MyInterceptor,other.Hook"
+    geomesa.query.interceptors = "my.module.MyInterceptor:other.Hook"
 
-and are instantiated once per (store, type). The built-in
-``FullTableScanGuard`` activates via the ``query.block.full.table`` system
-property or the ``geomesa.block.full.table`` SFT user-data flag.
+(``:`` separates multiple interceptors so the declaration survives the
+comma-delimited SFT spec string round-trip; ``,`` also works when the
+user data is built programmatically). Instances are created once per
+declaration and cached, so stateful interceptors keep state across
+queries. The built-in ``FullTableScanGuard`` activates via the
+``query.block.full.table`` system property or the
+``geomesa.block.full.table`` SFT user-data flag.
 """
 
 from __future__ import annotations
@@ -40,7 +44,9 @@ class FullTableScanGuard(QueryInterceptor):
     block-full-table guard)."""
 
     def guard(self, plan) -> None:
-        if plan.ranges is None:
+        # internal/maintenance scans (age-off sweeps, process fallbacks)
+        # are exempt, same as MaxFeaturesInterceptor
+        if plan.ranges is None and not plan.query.hints.get("internal"):
             raise ValueError(
                 f"full-table scan of {plan.sft.type_name!r} blocked "
                 f"(filter {plan.filter!r} prunes nothing; disable via the "
@@ -70,14 +76,28 @@ def _load_dotted(path: str):
     return getattr(importlib.import_module(mod), name)
 
 
-_CHAIN_CACHE_KEY = "__geomesa.interceptor.instances__"
+# instances cached per declaration string (NOT in sft.user_data: anything
+# placed there is serialized into the spec string and would corrupt
+# persisted schema.json manifests)
+_DECLARED_CACHE: dict = {}
+
+
+def _declared_instances(declared: str) -> list:
+    cached = _DECLARED_CACHE.get(declared)
+    if cached is None:
+        cached = []
+        for path in declared.replace(",", ":").split(":"):
+            if not path.strip():
+                continue
+            cls = _load_dotted(path)
+            cached.append(cls() if isinstance(cls, type) else cls)
+        _DECLARED_CACHE[declared] = cached
+    return cached
 
 
 def interceptors_for(sft) -> list:
     """The interceptor chain for a schema: built-ins (re-evaluated each
-    call, so property flips take effect) + user-data-declared classes.
-    Declared interceptors are instantiated once per schema and cached in
-    its user_data, so stateful interceptors keep state across queries."""
+    call, so property flips take effect) + user-data-declared classes."""
     chain: list = [MaxFeaturesInterceptor()]
     ud = getattr(sft, "user_data", None)
     if ud is None:
@@ -86,15 +106,7 @@ def interceptors_for(sft) -> list:
         chain.append(FullTableScanGuard())
     declared = ud.get(USER_DATA_KEY)
     if declared:
-        cached = ud.get(_CHAIN_CACHE_KEY)
-        if cached is None or cached[0] != declared:
-            instances = []
-            for path in str(declared).split(","):
-                cls = _load_dotted(path)
-                instances.append(cls() if isinstance(cls, type) else cls)
-            cached = (declared, instances)
-            ud[_CHAIN_CACHE_KEY] = cached
-        chain.extend(cached[1])
+        chain.extend(_declared_instances(str(declared)))
     return chain
 
 

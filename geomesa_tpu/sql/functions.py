@@ -339,9 +339,11 @@ def _segments_of(g) -> np.ndarray:
     return np.concatenate([va, va], axis=1)
 
 
-def _pt_seg_dist(pts: np.ndarray, segs: np.ndarray) -> float:
-    """min over all (point, segment) pairs of the exact point-to-segment
-    distance (clamped projection)."""
+def pt_seg_project(pts: np.ndarray, segs: np.ndarray):
+    """Clamped projection of each point onto each segment. ``pts`` is
+    (n, 2), ``segs`` is (m, 4) as [x0, y0, x1, y1]. Returns ``(t, dist2)``
+    with shape (n, m): the clamped parameter along each segment and the
+    squared point-to-segment distance."""
     p = pts[:, None, :]
     a = segs[None, :, 0:2]
     d = segs[None, :, 2:4] - a
@@ -349,7 +351,14 @@ def _pt_seg_dist(pts: np.ndarray, segs: np.ndarray) -> float:
     t = ((p - a) * d).sum(-1) / np.where(len2 == 0, 1.0, len2)
     t = np.clip(np.where(len2 == 0, 0.0, t), 0.0, 1.0)
     near = a + t[..., None] * d
-    return float(np.sqrt(((p - near) ** 2).sum(-1).min()))
+    return t, ((p - near) ** 2).sum(-1)
+
+
+def _pt_seg_dist(pts: np.ndarray, segs: np.ndarray) -> float:
+    """min over all (point, segment) pairs of the exact point-to-segment
+    distance (clamped projection)."""
+    _, dist2 = pt_seg_project(pts, segs)
+    return float(np.sqrt(dist2.min()))
 
 
 def st_distance(a, b):

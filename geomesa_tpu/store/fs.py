@@ -1,16 +1,17 @@
-"""Parquet filesystem DataStore.
+"""Parquet/ORC filesystem DataStore.
 
 The geomesa-fs analog (ref: geomesa-fs .../FileSystemDataStore,
-storage/api/PartitionScheme, parquet/ParquetFileSystemStorage [UNVERIFIED -
-empty reference mount]): data lives as sorted Parquet partition files plus a
-JSON manifest; queries prune partitions by the manifest's key bounds (the
-partition-scheme prune + parquet min/max pushdown, rolled together) and
-device-scan only surviving files.
+storage/api/PartitionScheme, parquet/ParquetFileSystemStorage and
+orc/OrcFileSystemStorage [UNVERIFIED - empty reference mount]): data lives
+as sorted Parquet (or ORC) partition files plus a JSON manifest; queries
+prune partitions by the manifest's key bounds (the partition-scheme prune +
+parquet min/max pushdown, rolled together) and device-scan only surviving
+files.
 
 Layout under ``root/<type_name>/``:
 
 - ``schema.json``   -- SFT spec + primary index + partition metadata
-- ``part-NNNNN.parquet`` -- sorted partition files (Arrow-compatible)
+- ``part-NNNNN.parquet`` (or ``.orc``) -- sorted partition files
 
 Durable state is exactly this directory (the reference's "source of truth
 stays on the object store" elasticity model, SURVEY.md section 5): a store
@@ -49,6 +50,28 @@ class _FsTypeState:
     pending: "list[FeatureBatch]" = field(default_factory=list)
     data_interval: "tuple[int, int] | None" = None
     cache: "dict[int, FeatureBatch]" = field(default_factory=dict)
+    encoding: str = "parquet"
+
+
+def _write_table(table, path: str, encoding: str) -> None:
+    if encoding == "orc":
+        import pyarrow.orc as orc
+
+        orc.write_table(table, path)
+    else:
+        import pyarrow.parquet as pq
+
+        pq.write_table(table, path)
+
+
+def _read_table(path: str, encoding: str):
+    if encoding == "orc":
+        import pyarrow.orc as orc
+
+        return orc.read_table(path)
+    import pyarrow.parquet as pq
+
+    return pq.read_table(path)
 
 
 class FileSystemDataStore:
@@ -57,9 +80,13 @@ class FileSystemDataStore:
         root: str,
         partition_size: int = DEFAULT_PARTITION_SIZE,
         audit: bool = False,
+        encoding: str = "parquet",
     ):
+        if encoding not in ("parquet", "orc"):
+            raise ValueError(f"unsupported encoding {encoding!r}")
         self.root = root
         self.partition_size = partition_size
+        self.encoding = encoding
         self._types: dict[str, _FsTypeState] = {}
         os.makedirs(root, exist_ok=True)
         self.audit_writer = None
@@ -103,6 +130,7 @@ class FileSystemDataStore:
             data_interval=tuple(meta["data_interval"])
             if meta.get("data_interval")
             else None,
+            encoding=meta.get("encoding", "parquet"),
         )
 
     def _save_meta(self, name: str) -> None:
@@ -110,6 +138,7 @@ class FileSystemDataStore:
         meta = {
             "spec": st.sft.spec,
             "primary": st.primary,
+            "encoding": st.encoding,
             "data_interval": st.data_interval,
             "partitions": [
                 {
@@ -135,7 +164,9 @@ class FileSystemDataStore:
             raise ValueError(f"schema {sft.type_name!r} exists")
         primary = default_indices(sft)[0]
         os.makedirs(self._dir(sft.type_name), exist_ok=True)
-        self._types[sft.type_name] = _FsTypeState(sft, primary)
+        self._types[sft.type_name] = _FsTypeState(
+            sft, primary, encoding=self.encoding
+        )
         self._save_meta(sft.type_name)
         return sft
 
@@ -175,12 +206,12 @@ class FileSystemDataStore:
         for f in os.listdir(d):
             if f.startswith("part-"):
                 os.unlink(os.path.join(d, f))
-        import pyarrow.parquet as pq
-
         for p in built.partitions:
             sub = built.batch.take(np.arange(p.start, p.stop))
-            pq.write_table(
-                sub.to_arrow(), os.path.join(d, f"part-{p.pid:05d}.parquet")
+            _write_table(
+                sub.to_arrow(),
+                os.path.join(d, f"part-{p.pid:05d}.{st.encoding}"),
+                st.encoding,
             )
         st.partitions = built.partitions
         st.cache = {}
@@ -206,21 +237,18 @@ class FileSystemDataStore:
         return removed
 
     def age_off(self, type_name: str, before_ms: int) -> int:
-        """Remove features older than a cutoff (ref AgeOffIterator)."""
-        st = self._types[type_name]
-        dtg = st.sft.dtg_field
-        if dtg is None:
-            raise ValueError(f"{type_name!r} has no Date field")
-        old = self.query(type_name, internal_query(ast.Compare("<", dtg, before_ms)))
-        return self.delete(type_name, list(old.batch.fids))
+        from geomesa_tpu.store.ageoff import age_off
+
+        return age_off(self, type_name, self._types[type_name].sft, before_ms)
 
     def _read_partition(self, type_name: str, pid: int) -> FeatureBatch:
         st = self._types[type_name]
         if pid not in st.cache:
-            import pyarrow.parquet as pq
-
-            t = pq.read_table(
-                os.path.join(self._dir(type_name), f"part-{pid:05d}.parquet")
+            t = _read_table(
+                os.path.join(
+                    self._dir(type_name), f"part-{pid:05d}.{st.encoding}"
+                ),
+                st.encoding,
             )
             st.cache[pid] = FeatureBatch.from_arrow(t, st.sft)
         return st.cache[pid]

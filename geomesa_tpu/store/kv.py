@@ -495,18 +495,9 @@ class KVDataStore:
         return len(batch)
 
     def age_off(self, type_name: str, before_ms: int) -> int:
-        """Remove features older than a cutoff (ref AgeOffIterator,
-        run as a sweep rather than a compaction hook)."""
-        sft = self._types[type_name]
-        dtg = sft.dtg_field
-        if dtg is None:
-            raise ValueError(f"{type_name!r} has no Date field")
-        from geomesa_tpu.query.plan import internal_query
+        from geomesa_tpu.store.ageoff import age_off
 
-        old = self.query(
-            type_name, internal_query(ast.Compare("<", dtg, before_ms))
-        )
-        return self.delete(type_name, list(old.batch.fids))
+        return age_off(self, type_name, self._types[type_name], before_ms)
 
     # -- stats --------------------------------------------------------------
 
@@ -610,17 +601,24 @@ class KVDataStore:
 
         timeout_ms = sys_prop("query.timeout")
         deadline = t0 + timeout_ms / 1000.0 if timeout_ms else None
+        chunk_rows = sys_prop("scan.chunk") or SCAN_CHUNK
+
+        def check_deadline():
+            if deadline and _time.perf_counter() > deadline:
+                raise QueryTimeout(
+                    f"query on {type_name!r} exceeded {timeout_ms}ms"
+                )
+
         for lo, hi in _coalesce(self._byte_ranges(ks, plan)):
+            check_deadline()  # per range, so small scans still time out
             for k, v in self.backend.scan(table, lo, hi):
                 buf_k.append(k)
                 buf_v.append(v)
-                if len(buf_k) >= SCAN_CHUNK:
+                if len(buf_k) >= chunk_rows:
                     flush_chunk()
-                    if deadline and _time.perf_counter() > deadline:
-                        raise QueryTimeout(
-                            f"query on {type_name!r} exceeded {timeout_ms}ms"
-                        )
+                    check_deadline()
         flush_chunk()
+        check_deadline()
 
         if chunks:
             out = chunks[0] if len(chunks) == 1 else FeatureBatch.concat(chunks)

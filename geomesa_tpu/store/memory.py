@@ -102,16 +102,9 @@ class MemoryDataStore:
         return removed
 
     def age_off(self, type_name: str, before_ms: int) -> int:
-        """Remove features older than a cutoff (ref AgeOffIterator, run as
-        a sweep)."""
-        st = self._state(type_name)
-        dtg = st.sft.dtg_field
-        if dtg is None:
-            raise ValueError(f"{type_name!r} has no Date field")
-        from geomesa_tpu.query.plan import internal_query
+        from geomesa_tpu.store.ageoff import age_off
 
-        old = self.query(type_name, internal_query(ast.Compare("<", dtg, before_ms)))
-        return self.delete(type_name, list(old.batch.fids))
+        return age_off(self, type_name, self._state(type_name).sft, before_ms)
 
     def _flush(self, st: _TypeState) -> None:
         if st.pending:

@@ -84,9 +84,10 @@ def cmd_ingest(args):
     with open(args.converter) as fh:
         config = json.load(fh)
     conv = converter_for(config, sft)
+    binary = getattr(conv, "binary", False)
     total = failed = 0
     for path in args.files:
-        with open(path) as fh:
+        with open(path, "rb" if binary else "r") as fh:
             res = conv.process(fh.read())
         store.write(args.feature_name, res.batch)
         total += res.success
@@ -123,6 +124,10 @@ def cmd_export(args):
         import pyarrow.parquet as pq
 
         pq.write_table(batch.to_arrow(), out)
+    elif fmt == "orc":
+        import pyarrow.orc as orc
+
+        orc.write_table(batch.to_arrow(), out)
     elif fmt == "avro":
         from geomesa_tpu.features.avro import write_avro
 
@@ -272,7 +277,7 @@ def main(argv=None) -> None:
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-q", "--cql")
     sp.add_argument("-F", "--format", default="csv",
-                    choices=["csv", "json", "arrow", "parquet", "bin", "avro"])
+                    choices=["csv", "json", "arrow", "parquet", "orc", "bin", "avro"])
     sp.add_argument("-o", "--output", default="-")
     sp.add_argument("-m", "--max-features", type=int)
     sp.add_argument("-a", "--attributes", help="comma-separated projection")

@@ -121,30 +121,25 @@ class OnlyFirstFive:
 
 
 def test_kv_query_timeout():
+    # no scan.chunk shrinking: the deadline must fire even when the whole
+    # scan fits in one buffer (checked per range and after the final flush)
     ds = _write_points(KVDataStore(MemoryKV()))
     with prop_override("query.timeout", 1):
-        import geomesa_tpu.store.kv as kvmod
+        import time
 
-        old = kvmod.SCAN_CHUNK
-        kvmod.SCAN_CHUNK = 1  # force per-row deadline checks
-        try:
-            import time
+        real = time.perf_counter
+        state = {"t": real()}
 
-            real = time.perf_counter
-            state = {"t": real()}
+        def advancing():  # +1s per call: blows the 1ms budget instantly
+            state["t"] += 1.0
+            return state["t"]
 
-            def advancing():  # +1s per call: blows the 1ms budget instantly
-                state["t"] += 1.0
-                return state["t"]
-
-            with pytest.raises(QueryTimeout):
-                time.perf_counter = advancing
-                try:
-                    ds.query("t")
-                finally:
-                    time.perf_counter = real
-        finally:
-            kvmod.SCAN_CHUNK = old
+        with pytest.raises(QueryTimeout):
+            time.perf_counter = advancing
+            try:
+                ds.query("t")
+            finally:
+                time.perf_counter = real
 
 
 def test_age_off_memory_and_fs(tmp_path):
@@ -220,8 +215,51 @@ def test_stateful_interceptor_cached_per_schema():
     )
     ds.query("t")
     ds.query("t")
-    chain_cache = sft.user_data["__geomesa.interceptor.instances__"]
-    assert chain_cache[1][0].calls >= 2  # same instance saw both queries
+    from geomesa_tpu.query.interceptor import _DECLARED_CACHE
+
+    cached = _DECLARED_CACHE["tests.test_conf_interceptors.CountingInterceptor"]
+    assert cached[0].calls >= 2  # same instance saw both queries
+    # the cache must NOT leak into user_data (it would corrupt sft.spec
+    # and brick persisted schema.json manifests)
+    assert all(not k.startswith("__") for k in sft.user_data)
+    SimpleFeatureType.create("t", sft.spec)  # spec still round-trips
+
+
+def test_interceptors_persist_through_fs_store(tmp_path):
+    # declared interceptor chains (incl. multiple, ':'-separated) survive
+    # the spec round-trip through schema.json
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    sft = SimpleFeatureType.create("t", SPEC)
+    sft.user_data["geomesa.query.interceptors"] = (
+        "tests.test_conf_interceptors.CountingInterceptor:"
+        "tests.test_conf_interceptors.OnlyFirstFive"
+    )
+    ds = FileSystemDataStore(str(tmp_path))
+    ds.create_schema(sft)
+    ds.write(
+        "t",
+        {
+            "name": [f"n{i}" for i in range(10)],
+            "dtg": [1000] * 10,
+            "geom": np.zeros((10, 2)),
+        },
+    )
+    ds.flush("t")
+    assert len(ds.query("t").batch) == 5  # OnlyFirstFive active
+    ds2 = FileSystemDataStore(str(tmp_path))  # reopen from disk
+    assert len(ds2.query("t").batch) == 5
+
+
+def test_full_table_scan_guard_exempts_internal():
+    from geomesa_tpu.query.plan import internal_query
+
+    ds = _write_points(MemoryDataStore())
+    with prop_override("query.block.full.table", True):
+        with pytest.raises(ValueError, match="full-table scan"):
+            ds.query("t")
+        # internal maintenance scans are exempt
+        assert len(ds.query("t", internal_query(ast.Include)).batch) == 10
 
 
 class CountingInterceptor:

@@ -1,0 +1,136 @@
+"""Parquet converter + ORC filesystem storage encoding."""
+
+import io
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from geomesa_tpu.convert import converter_for
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.filter.ecql import parse_instant
+from geomesa_tpu.store.fs import FileSystemDataStore
+
+SPEC = "name:String,count:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def _parquet_bytes():
+    table = pa.table(
+        {
+            "id": ["a", "b", "c"],
+            "name": ["alpha", "beta", "gamma"],
+            "count": pa.array([1, 2, 3], pa.int32()),
+            "ts": pa.array([1000, 2000, 3000], pa.timestamp("ms")),
+            "lon": [2.35, -0.12, 13.4],
+            "lat": [48.85, 51.5, 52.5],
+        }
+    )
+    sink = io.BytesIO()
+    pq.write_table(table, sink)
+    return sink.getvalue()
+
+
+def test_parquet_converter():
+    sft = SimpleFeatureType.create("p", SPEC)
+    cfg = {
+        "type": "parquet",
+        "id-field": "$id",
+        "fields": [
+            {"name": "name", "path": "name"},
+            {"name": "count", "transform": "$count::int"},
+            {"name": "dtg", "transform": "millisToDate($ts)"},
+            {"name": "geom", "transform": "point($lon, $lat)"},
+        ],
+    }
+    res = converter_for(cfg, sft).process(_parquet_bytes())
+    assert res.success == 3 and res.failed == 0
+    assert list(res.batch.fids) == ["a", "b", "c"]
+    assert res.batch.column("count").tolist() == [1, 2, 3]
+    assert res.batch.column("dtg").tolist() == [1000, 2000, 3000]
+    np.testing.assert_allclose(
+        res.batch.column("geom"),
+        [[2.35, 48.85], [-0.12, 51.5], [13.4, 52.5]],
+    )
+
+
+def test_parquet_converter_from_path(tmp_path):
+    path = tmp_path / "in.parquet"
+    path.write_bytes(_parquet_bytes())
+    sft = SimpleFeatureType.create("p", "name:String,*geom:Point")
+    cfg = {
+        "type": "parquet",
+        "fields": [
+            {"name": "name", "path": "name"},
+            {"name": "geom", "transform": "point($lon, $lat)"},
+        ],
+    }
+    with open(path, "rb") as fh:
+        res = converter_for(cfg, sft).process(fh)
+    assert res.success == 3
+
+
+def _fill(store, n=5000, seed=7):
+    store.create_schema("gdelt", SPEC)
+    rng = np.random.default_rng(seed)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    cols = {
+        "name": rng.choice(["alpha", "beta"], n),
+        "count": rng.integers(0, 100, n),
+        "dtg": rng.integers(t0, t1, n),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+        ),
+    }
+    store.write("gdelt", cols, fids=np.arange(n))
+    store.flush("gdelt")
+    return cols
+
+
+def test_fs_orc_roundtrip(tmp_path):
+    store = FileSystemDataStore(str(tmp_path), partition_size=1024, encoding="orc")
+    _fill(store)
+    files = os.listdir(tmp_path / "gdelt")
+    assert any(f.endswith(".orc") for f in files)
+    assert not any(f.endswith(".parquet") for f in files)
+    res = store.query(
+        "gdelt",
+        "BBOX(geom, -10, 40, 10, 55) AND "
+        "dtg DURING 2020-01-05T00:00:00Z/2020-01-20T00:00:00Z",
+    )
+    assert len(res) > 0
+    assert res.scanned < res.total  # manifest prune still applies
+
+
+def test_fs_orc_reopen(tmp_path):
+    store = FileSystemDataStore(str(tmp_path), encoding="orc")
+    _fill(store, n=500)
+    n1 = store.count("gdelt")
+    # reopen with default (parquet) encoding: per-type encoding persisted
+    store2 = FileSystemDataStore(str(tmp_path))
+    assert store2.count("gdelt") == n1 == 500
+
+
+def test_cli_export_orc(tmp_path, capsys):
+    from geomesa_tpu.tools.cli import main
+
+    store = FileSystemDataStore(str(tmp_path / "store"))
+    _fill(store, n=100)
+    out = str(tmp_path / "out.orc")
+    main(
+        [
+            "--root",
+            str(tmp_path / "store"),
+            "export",
+            "-f",
+            "gdelt",
+            "-F",
+            "orc",
+            "-o",
+            out,
+        ]
+    )
+    import pyarrow.orc as orc
+
+    assert orc.read_table(out).num_rows == 100

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import re
+
 import numpy as np
 
 GEOM_TYPES = {
@@ -155,14 +157,15 @@ class SimpleFeatureType:
         user_data: dict = {}
         if ";" in spec:
             spec, ud = spec.split(";", 1)
-            for kv in ud.split(","):
+            # values may contain backslash-escaped commas (see .spec)
+            for kv in re.split(r"(?<!\\),", ud):
                 kv = kv.strip()
                 if not kv:
                     continue
                 if "=" not in kv:
                     raise ValueError(f"bad user-data entry {kv!r}")
                 k, v = kv.split("=", 1)
-                user_data[k.strip()] = v.strip()
+                user_data[k.strip()] = v.strip().replace("\\,", ",")
         attrs = []
         for entry in spec.split(","):
             entry = entry.strip()
@@ -201,5 +204,8 @@ class SimpleFeatureType:
             parts.append(s)
         out = ",".join(parts)
         if self.user_data:
-            out += ";" + ",".join(f"{k}={v}" for k, v in self.user_data.items())
+            out += ";" + ",".join(
+                f"{k}={str(v).replace(',', chr(92) + ',')}"
+                for k, v in self.user_data.items()
+            )
         return out

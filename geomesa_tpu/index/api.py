@@ -67,6 +67,7 @@ class PartitionMeta:
     count: int
     bbox: "tuple[float, float, float, float] | None" = None
     time_range: "tuple[int, int] | None" = None
+    leaf: "str | None" = None  # fs partition-scheme directory leaf
 
     def overlaps(self, r: KeyRange) -> bool:
         return not (r.hi < self.key_lo or r.lo > self.key_hi)

@@ -51,6 +51,7 @@ class _FsTypeState:
     data_interval: "tuple[int, int] | None" = None
     cache: "dict[int, FeatureBatch]" = field(default_factory=dict)
     encoding: str = "parquet"
+    scheme: "object | None" = None  # PartitionScheme, from SFT user data
 
 
 def _write_table(table, path: str, encoding: str) -> None:
@@ -120,6 +121,7 @@ class FileSystemDataStore:
                 count=p["count"],
                 bbox=tuple(p["bbox"]) if p.get("bbox") else None,
                 time_range=tuple(p["time_range"]) if p.get("time_range") else None,
+                leaf=p.get("leaf"),
             )
             for p in meta["partitions"]
         ]
@@ -131,7 +133,15 @@ class FileSystemDataStore:
             if meta.get("data_interval")
             else None,
             encoding=meta.get("encoding", "parquet"),
+            scheme=self._scheme_of(sft),
         )
+
+    @staticmethod
+    def _scheme_of(sft: SimpleFeatureType):
+        from geomesa_tpu.store.partitions import USER_DATA_KEY, scheme_for
+
+        spec = sft.user_data.get(USER_DATA_KEY)
+        return scheme_for(str(spec)) if spec else None
 
     def _save_meta(self, name: str) -> None:
         st = self._types[name]
@@ -150,6 +160,7 @@ class FileSystemDataStore:
                     "count": p.count,
                     "bbox": list(p.bbox) if p.bbox else None,
                     "time_range": list(p.time_range) if p.time_range else None,
+                    "leaf": p.leaf,
                 }
                 for p in st.partitions
             ],
@@ -164,8 +175,15 @@ class FileSystemDataStore:
             raise ValueError(f"schema {sft.type_name!r} exists")
         primary = default_indices(sft)[0]
         os.makedirs(self._dir(sft.type_name), exist_ok=True)
+        scheme = self._scheme_of(sft)
+        if scheme is not None:
+            # normalize to the ':'-joined form so the declaration survives
+            # the comma-delimited spec string in schema.json
+            from geomesa_tpu.store.partitions import USER_DATA_KEY
+
+            sft.user_data[USER_DATA_KEY] = scheme.spec
         self._types[sft.type_name] = _FsTypeState(
-            sft, primary, encoding=self.encoding
+            sft, primary, encoding=self.encoding, scheme=scheme
         )
         self._save_meta(sft.type_name)
         return sft
@@ -200,26 +218,59 @@ class FileSystemDataStore:
         data = batches[0] if len(batches) == 1 else FeatureBatch.concat(batches)
         st.pending = []
         ks = keyspace_for(st.sft, st.primary)
-        built = build_index(ks, data, self.partition_size)
         # drop old files, write new
         d = self._dir(type_name)
-        for f in os.listdir(d):
-            if f.startswith("part-"):
-                os.unlink(os.path.join(d, f))
-        for p in built.partitions:
-            sub = built.batch.take(np.arange(p.start, p.stop))
-            _write_table(
-                sub.to_arrow(),
-                os.path.join(d, f"part-{p.pid:05d}.{st.encoding}"),
-                st.encoding,
-            )
-        st.partitions = built.partitions
+        for dirpath, _, files in os.walk(d):
+            for f in files:
+                if f.startswith("part-"):
+                    os.unlink(os.path.join(dirpath, f))
+        if st.scheme is not None and len(data):
+            # group rows by directory leaf; each leaf is sorted + manifested
+            # independently (the partition-scheme layout)
+            leaves = st.scheme.leaves(data)
+            all_parts: list = []
+            pid = 0
+            import dataclasses
+
+            for leaf in sorted(set(leaves)):
+                sub = data.take(np.nonzero(leaves == leaf)[0])
+                built = build_index(ks, sub, self.partition_size)
+                leaf_dir = os.path.join(d, leaf)
+                os.makedirs(leaf_dir, exist_ok=True)
+                for p in built.partitions:
+                    part = dataclasses.replace(p, pid=pid, leaf=leaf)
+                    chunk = built.batch.take(np.arange(p.start, p.stop))
+                    _write_table(
+                        chunk.to_arrow(),
+                        self._part_path(type_name, part),
+                        st.encoding,
+                    )
+                    all_parts.append(part)
+                    pid += 1
+            st.partitions = all_parts
+            full = data
+        else:
+            built = build_index(ks, data, self.partition_size)
+            for p in built.partitions:
+                sub = built.batch.take(np.arange(p.start, p.stop))
+                _write_table(
+                    sub.to_arrow(), self._part_path(type_name, p), st.encoding
+                )
+            st.partitions = built.partitions
+            full = built.batch
         st.cache = {}
         dtg = st.sft.dtg_field
-        if dtg is not None and len(built.batch):
-            col = built.batch.column(dtg)
+        if dtg is not None and len(full):
+            col = full.column(dtg)
             st.data_interval = (int(col.min()), int(col.max()))
         self._save_meta(type_name)
+
+    def _part_path(self, type_name: str, p: PartitionMeta) -> str:
+        st = self._types[type_name]
+        d = self._dir(type_name)
+        if p.leaf:
+            d = os.path.join(d, p.leaf)
+        return os.path.join(d, f"part-{p.pid:05d}.{st.encoding}")
 
     def delete(self, type_name: str, fids) -> int:
         """Drop features by id and compact the partition files."""
@@ -241,22 +292,17 @@ class FileSystemDataStore:
 
         return age_off(self, type_name, self._types[type_name].sft, before_ms)
 
-    def _read_partition(self, type_name: str, pid: int) -> FeatureBatch:
+    def _read_partition(self, type_name: str, p: PartitionMeta) -> FeatureBatch:
         st = self._types[type_name]
-        if pid not in st.cache:
-            t = _read_table(
-                os.path.join(
-                    self._dir(type_name), f"part-{pid:05d}.{st.encoding}"
-                ),
-                st.encoding,
-            )
-            st.cache[pid] = FeatureBatch.from_arrow(t, st.sft)
-        return st.cache[pid]
+        if p.pid not in st.cache:
+            t = _read_table(self._part_path(type_name, p), st.encoding)
+            st.cache[p.pid] = FeatureBatch.from_arrow(t, st.sft)
+        return st.cache[p.pid]
 
     def _read_all(self, type_name: str) -> FeatureBatch:
         st = self._types[type_name]
         return FeatureBatch.concat(
-            [self._read_partition(type_name, p.pid) for p in st.partitions]
+            [self._read_partition(type_name, p) for p in st.partitions]
         )
 
     # -- queries -----------------------------------------------------------
@@ -277,8 +323,16 @@ class FileSystemDataStore:
         st = self._types[type_name]
         plan = self.plan(type_name, query)
         t1 = _time.perf_counter()
-        # prune by manifest
+        # prune by partition-scheme leaves, then by manifest key ranges
         parts = st.partitions
+        if st.scheme is not None:
+            from geomesa_tpu.store.partitions import scheme_matches
+
+            parts = [
+                p
+                for p in parts
+                if p.leaf is None or scheme_matches(st.scheme, p.leaf, plan)
+            ]
         if plan.ranges is not None:
             parts = [
                 p for p in parts if any(p.overlaps(r) for r in plan.ranges)
@@ -305,7 +359,7 @@ class FileSystemDataStore:
                 raise QueryTimeout(
                     f"query on {type_name!r} exceeded {timeout_ms}ms"
                 )
-            batch = self._read_partition(type_name, p.pid)
+            batch = self._read_partition(type_name, p)
             scanned += len(batch)
             local = BuiltIndex(
                 ks,
@@ -324,7 +378,7 @@ class FileSystemDataStore:
         if chunks:
             out = chunks[0] if len(chunks) == 1 else FeatureBatch.concat(chunks)
         else:
-            empty = self._read_partition(type_name, st.partitions[0].pid).take(
+            empty = self._read_partition(type_name, st.partitions[0]).take(
                 np.array([], dtype=np.int64)
             ) if st.partitions else FeatureBatch.from_columns(
                 st.sft, {a.name: [] for a in st.sft.attributes}

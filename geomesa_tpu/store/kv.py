@@ -58,7 +58,7 @@ from geomesa_tpu.query.plan import Query, QueryPlan, as_query, plan_query
 from geomesa_tpu.query.runner import QueryResult, _post_process
 
 DEFAULT_SHARDS = 4  # ref ShardStrategy default z-shard count
-SCAN_CHUNK = 8192  # rows per server-side iterator batch
+# rows per server-side iterator batch: the 'scan.chunk' system property
 
 
 # ---------------------------------------------------------------------------
@@ -601,7 +601,7 @@ class KVDataStore:
 
         timeout_ms = sys_prop("query.timeout")
         deadline = t0 + timeout_ms / 1000.0 if timeout_ms else None
-        chunk_rows = sys_prop("scan.chunk") or SCAN_CHUNK
+        chunk_rows = max(1, sys_prop("scan.chunk"))
 
         def check_deadline():
             if deadline and _time.perf_counter() > deadline:

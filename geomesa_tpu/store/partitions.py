@@ -1,0 +1,365 @@
+"""Named filesystem partition schemes.
+
+Ref role: geomesa-fs storage/api/PartitionScheme + the stock schemes in
+common/partitions (Z2Scheme, XZ2Scheme, DateTimeScheme, AttributeScheme and
+composites like ``hourly,z2-2bit``) [UNVERIFIED - empty reference mount].
+A scheme maps each feature to a directory-leaf string and, at query time,
+decides whether an existing leaf can contain matching features (the
+partition prune). Unlike the reference's eager "filter -> partition list"
+enumeration, pruning here is a per-existing-leaf ``matches`` test -- same
+outcome, no range-explosion cap needed.
+
+Scheme spec strings (SFT user data ``geomesa.fs.partition-scheme``):
+
+- ``z2-<n>bit[s]``   -- point grid cells, n total z bits (n/2 per dim)
+- ``xz2-<n>bit[s]``  -- non-point extent cells at XZ2 precision n
+- ``yearly | monthly | weekly | daily | hourly | minute`` -- dtg buckets
+- ``attribute:<name>`` -- one leaf per attribute value
+- comma-joined composites, e.g. ``daily,z2-2bit`` (leaf paths nest)
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+import numpy as np
+
+from geomesa_tpu.curves import zorder
+from geomesa_tpu.curves.xz2 import XZ2SFC
+from geomesa_tpu.filter import ast
+from geomesa_tpu.geom import Envelope
+
+USER_DATA_KEY = "geomesa.fs.partition-scheme"
+
+
+class PartitionScheme:
+    """Base: subclasses define spec, depth (leaf path segments), leaves()
+    and matches()."""
+
+    spec: str
+    depth: int = 1
+
+    def leaves(self, batch) -> np.ndarray:
+        raise NotImplementedError
+
+    def matches(self, leaf: str, geom_bounds, time_bounds) -> bool:
+        """May this leaf contain features satisfying the extracted bounds?
+        Conservative: True when the scheme cannot tell."""
+        raise NotImplementedError
+
+
+# -- datetime ----------------------------------------------------------------
+
+_STEPS = {
+    # step -> (numpy datetime64 unit, leaf path segments)
+    "yearly": ("Y", 1),
+    "monthly": ("M", 2),
+    "daily": ("D", 3),
+    "hourly": ("h", 4),
+    "minute": ("m", 5),
+}
+
+_WEEK_MS = 7 * 86400 * 1000
+
+
+@dataclass
+class DateTimeScheme(PartitionScheme):
+    """dtg-bucket leaves: ``2020/01/05`` (daily), ``2020/01/05/13``
+    (hourly), ... Weekly uses epoch-week leaves ``W2609`` (the same
+    week-binning as the Z3 curve's BinnedTime)."""
+
+    step: str
+
+    def __post_init__(self):
+        if self.step != "weekly" and self.step not in _STEPS:
+            raise ValueError(f"unknown datetime step {self.step!r}")
+        self.spec = self.step
+        self.depth = 1 if self.step == "weekly" else _STEPS[self.step][1]
+
+    def _dtg_col(self, batch) -> np.ndarray:
+        dtg = batch.sft.dtg_field
+        if dtg is None:
+            raise ValueError("datetime partition scheme needs a Date field")
+        return np.asarray(batch.column(dtg), dtype=np.int64)
+
+    def leaves(self, batch) -> np.ndarray:
+        ms = self._dtg_col(batch)
+        if self.step == "weekly":
+            weeks = ms // _WEEK_MS
+            return np.array([f"W{w}" for w in weeks], dtype=object)
+        unit = _STEPS[self.step][0]
+        strs = np.datetime_as_string(
+            ms.astype("datetime64[ms]").astype(f"datetime64[{unit}]")
+        )
+        return np.array(
+            [
+                s.replace("-", "/").replace("T", "/").replace(":", "/")
+                for s in strs
+            ],
+            dtype=object,
+        )
+
+    def _bucket_ms(self, leaf: str) -> "tuple[int, int]":
+        if self.step == "weekly":
+            w = int(leaf[1:])
+            return w * _WEEK_MS, (w + 1) * _WEEK_MS
+        unit = _STEPS[self.step][0]
+        parts = leaf.split("/")
+        iso = parts[0]
+        if len(parts) > 1:
+            iso += "-" + parts[1]
+        if len(parts) > 2:
+            iso += "-" + parts[2]
+        if len(parts) > 3:
+            iso += "T" + parts[3]
+        if len(parts) > 4:
+            iso += ":" + parts[4]
+        start = np.datetime64(iso, unit)
+        return (
+            int(start.astype("datetime64[ms]").astype(np.int64)),
+            int((start + 1).astype("datetime64[ms]").astype(np.int64)),
+        )
+
+    def matches(self, leaf: str, geom_bounds, time_bounds) -> bool:
+        if time_bounds is None or time_bounds.unbounded:
+            return True
+        lo, hi = self._bucket_ms(leaf)  # [lo, hi)
+        for t0, t1 in time_bounds.values:
+            if t0 < hi and t1 >= lo:
+                return True
+        return False
+
+
+# -- z2 grid -----------------------------------------------------------------
+
+
+@dataclass
+class Z2Scheme(PartitionScheme):
+    """Point-grid leaves: the feature's z2 cell at ``bits`` total bits
+    (``bits/2`` per dimension), zero-padded decimal."""
+
+    bits: int
+
+    def __post_init__(self):
+        if self.bits % 2 or not (2 <= self.bits <= 32):
+            raise ValueError("z2 scheme bits must be even, in [2, 32]")
+        self.spec = f"z2-{self.bits}bits"
+        self.res = self.bits // 2  # bits per dimension
+        self.digits = len(str((1 << self.bits) - 1))
+
+    def _cells(self, x, y) -> np.ndarray:
+        n = 1 << self.res
+        ix = np.clip(((np.asarray(x) + 180.0) / 360.0 * n).astype(np.int64), 0, n - 1)
+        iy = np.clip(((np.asarray(y) + 90.0) / 180.0 * n).astype(np.int64), 0, n - 1)
+        return zorder.encode_2d_np(ix.astype(np.uint64), iy.astype(np.uint64))
+
+    def leaves(self, batch) -> np.ndarray:
+        geom = batch.sft.geom_field
+        col = batch.columns[geom]
+        if col.dtype != object:
+            x, y = col[:, 0], col[:, 1]
+        else:  # non-point: envelope centers
+            envs = [g.envelope for g in col]
+            x = np.array([(e.xmin + e.xmax) / 2 for e in envs])
+            y = np.array([(e.ymin + e.ymax) / 2 for e in envs])
+        return np.array(
+            [f"{int(z):0{self.digits}d}" for z in self._cells(x, y)], dtype=object
+        )
+
+    def _cell_env(self, leaf: str) -> Envelope:
+        ix, iy = zorder.decode_2d_np(np.array([int(leaf)], dtype=np.uint64))
+        n = 1 << self.res
+        w, h = 360.0 / n, 180.0 / n
+        xmin = -180.0 + float(ix[0]) * w
+        ymin = -90.0 + float(iy[0]) * h
+        return Envelope(xmin, ymin, xmin + w, ymin + h)
+
+    def matches(self, leaf: str, geom_bounds, time_bounds) -> bool:
+        if geom_bounds is None or geom_bounds.unbounded:
+            return True
+        cell = self._cell_env(leaf)
+        return any(env.intersects(cell) for env, _ in geom_bounds.values)
+
+
+@dataclass
+class XZ2Scheme(PartitionScheme):
+    """Non-point extent leaves: the geometry envelope's XZ2 code at
+    precision ``bits`` (ref XZ2Scheme; extent-preserving, so a leaf is
+    pruned via XZ2 ranges of the query box at the same precision)."""
+
+    bits: int
+
+    def __post_init__(self):
+        if not (1 <= self.bits <= 12):
+            raise ValueError("xz2 scheme bits must be in [1, 12]")
+        self.spec = f"xz2-{self.bits}bits"
+        self.sfc = XZ2SFC(self.bits)
+        max_code = np.atleast_1d(self.sfc.index(179.0, 89.0, 180.0, 90.0))[0]
+        self.digits = len(str(int(max_code)))
+
+    def leaves(self, batch) -> np.ndarray:
+        geom = batch.sft.geom_field
+        col = batch.columns[geom]
+        if col.dtype != object:
+            xmin = xmax = col[:, 0]
+            ymin = ymax = col[:, 1]
+        else:
+            envs = [g.envelope for g in col]
+            xmin = np.array([e.xmin for e in envs])
+            ymin = np.array([e.ymin for e in envs])
+            xmax = np.array([e.xmax for e in envs])
+            ymax = np.array([e.ymax for e in envs])
+        codes = self.sfc.index(xmin, ymin, xmax, ymax)
+        return np.array(
+            [f"{int(c):0{self.digits}d}" for c in np.atleast_1d(codes)],
+            dtype=object,
+        )
+
+    def matches(self, leaf: str, geom_bounds, time_bounds) -> bool:
+        if geom_bounds is None or geom_bounds.unbounded:
+            return True
+        code = int(leaf)
+        for env, _ in geom_bounds.values:
+            for r in self.sfc.ranges(env.xmin, env.ymin, env.xmax, env.ymax):
+                if r.lower <= code <= r.upper:
+                    return True
+        return False
+
+
+# -- attribute ---------------------------------------------------------------
+
+
+def _equality_values(f, attr: str) -> "set | None":
+    """Values ``attr`` may take under ``f``; None = unconstrained."""
+    if isinstance(f, ast.Compare) and f.attr == attr and f.op == "=":
+        return {f.value}
+    if isinstance(f, ast.In) and f.attr == attr:
+        return set(f.values)
+    if isinstance(f, ast.And):
+        out = None
+        for c in f.children:
+            v = _equality_values(c, attr)
+            if v is not None:
+                out = v if out is None else (out & v)
+        return out
+    if isinstance(f, ast.Or):
+        out: set = set()
+        for c in f.children:
+            v = _equality_values(c, attr)
+            if v is None:
+                return None  # one branch unconstrained -> no prune
+            out |= v
+        return out
+    return None
+
+
+_UNSAFE_LEAF = re.compile(r"[^A-Za-z0-9_.\-]")
+
+
+def _safe_leaf(v) -> str:
+    """Attribute value -> filesystem-safe single path segment (no '/',
+    no traversal, never empty)."""
+    s = _UNSAFE_LEAF.sub("_", str(v)).lstrip(".")
+    return s or "_"
+
+
+@dataclass
+class AttributeScheme(PartitionScheme):
+    """One leaf per attribute value (ref AttributeScheme). Pruning uses
+    equality / IN constraints extracted from the residual filter. Values
+    are sanitized to a single safe path segment."""
+
+    attr: str
+
+    def __post_init__(self):
+        self.spec = f"attribute:{self.attr}"
+
+    def leaves(self, batch) -> np.ndarray:
+        col = batch.column(self.attr)
+        return np.array([_safe_leaf(v) for v in col], dtype=object)
+
+    def matches(self, leaf: str, geom_bounds, time_bounds, filter=None) -> bool:
+        if filter is None:
+            return True
+        vals = _equality_values(filter, self.attr)
+        return vals is None or leaf in {_safe_leaf(v) for v in vals}
+
+
+# -- composite ---------------------------------------------------------------
+
+
+class CompositeScheme(PartitionScheme):
+    """Nested leaves, outer scheme first: ``daily,z2-2bit`` gives
+    ``2020/01/05/03`` paths."""
+
+    def __init__(self, parts: "list[PartitionScheme]"):
+        self.parts = parts
+        # ':' join so the spec survives the comma-delimited SFT spec string
+        # (scheme_for accepts either separator)
+        self.spec = ":".join(p.spec for p in parts)
+        self.depth = sum(p.depth for p in parts)
+
+    def leaves(self, batch) -> np.ndarray:
+        per_part = [p.leaves(batch) for p in self.parts]
+        return np.array(
+            ["/".join(row) for row in zip(*per_part)], dtype=object
+        )
+
+    def matches(self, leaf: str, geom_bounds, time_bounds, filter=None) -> bool:
+        segs = leaf.split("/")
+        off = 0
+        for p in self.parts:
+            sub = "/".join(segs[off : off + p.depth])
+            off += p.depth
+            if isinstance(p, AttributeScheme):
+                ok = p.matches(sub, geom_bounds, time_bounds, filter=filter)
+            else:
+                ok = p.matches(sub, geom_bounds, time_bounds)
+            if not ok:
+                return False
+        return True
+
+
+# -- parsing -----------------------------------------------------------------
+
+_ZBITS = re.compile(r"^(x?z2)-(\d+)bits?$")
+
+
+def scheme_for(spec: str) -> PartitionScheme:
+    """Parse a scheme spec string (see module docstring). Composites may
+    be ','- or ':'-joined; the ':' form is what persists through the SFT
+    spec round-trip."""
+    # 'attribute:name' contains ':' legitimately -- protect it, then split
+    protected = re.sub(r"\b(attr|attribute):", r"\1=", spec)
+    parts = [
+        s.strip().replace("=", ":", 1)
+        for s in re.split(r"[,:]", protected)
+        if s.strip()
+    ]
+    if not parts:
+        raise ValueError("empty partition scheme spec")
+    schemes = []
+    for part in parts:
+        m = _ZBITS.match(part)
+        if m:
+            cls = Z2Scheme if m.group(1) == "z2" else XZ2Scheme
+            schemes.append(cls(int(m.group(2))))
+        elif part in _STEPS or part == "weekly":
+            schemes.append(DateTimeScheme(part))
+        elif part.startswith(("attribute:", "attr:")):
+            schemes.append(AttributeScheme(part.split(":", 1)[1]))
+        elif part == "datetime":
+            schemes.append(DateTimeScheme("daily"))
+        else:
+            raise ValueError(f"unknown partition scheme {part!r}")
+    return schemes[0] if len(schemes) == 1 else CompositeScheme(schemes)
+
+
+def scheme_matches(scheme, leaf, plan) -> bool:
+    """Prune test against a QueryPlan's extracted bounds."""
+    if isinstance(scheme, (AttributeScheme, CompositeScheme)):
+        return scheme.matches(
+            leaf, plan.geom_bounds, plan.time_bounds, filter=plan.filter
+        )
+    return scheme.matches(leaf, plan.geom_bounds, plan.time_bounds)

@@ -251,6 +251,31 @@ def test_interceptors_persist_through_fs_store(tmp_path):
     assert len(ds2.query("t").batch) == 5
 
 
+def test_comma_user_data_survives_spec_roundtrip(tmp_path):
+    # commas inside user-data values are escaped in the spec string, so a
+    # ','-joined interceptor list no longer bricks a persisted store
+    sft = SimpleFeatureType.create("t", SPEC)
+    sft.user_data["geomesa.query.interceptors"] = (
+        "tests.test_conf_interceptors.CountingInterceptor,"
+        "tests.test_conf_interceptors.OnlyFirstFive"
+    )
+    rt = SimpleFeatureType.create("t", sft.spec)
+    assert rt.user_data == sft.user_data
+    ds = FileSystemDataStore(str(tmp_path))
+    ds.create_schema(sft)
+    ds.write(
+        "t",
+        {
+            "name": [f"n{i}" for i in range(10)],
+            "dtg": [1000] * 10,
+            "geom": np.zeros((10, 2)),
+        },
+    )
+    ds.flush("t")
+    ds2 = FileSystemDataStore(str(tmp_path))  # reopen must not raise
+    assert len(ds2.query("t").batch) == 5
+
+
 def test_full_table_scan_guard_exempts_internal():
     from geomesa_tpu.query.plan import internal_query
 

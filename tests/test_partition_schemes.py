@@ -1,0 +1,210 @@
+"""Named FS partition schemes: leaf assignment, pruning, store layout."""
+
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.filter.compile import evaluate_host
+from geomesa_tpu.filter.ecql import parse_ecql, parse_instant
+from geomesa_tpu.store.fs import FileSystemDataStore
+from geomesa_tpu.store.partitions import (
+    AttributeScheme,
+    CompositeScheme,
+    DateTimeScheme,
+    Z2Scheme,
+    XZ2Scheme,
+    scheme_for,
+)
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+
+
+def _batch(n=1000, seed=3):
+    sft = SimpleFeatureType.create("t", SPEC)
+    rng = np.random.default_rng(seed)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-01-10T00:00:00")
+    return FeatureBatch.from_columns(
+        sft,
+        {
+            "name": rng.choice(["a", "b", "c"], n),
+            "dtg": rng.integers(t0, t1, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+            ),
+        },
+        np.arange(n),
+    )
+
+
+def test_scheme_parsing():
+    assert isinstance(scheme_for("z2-2bit"), Z2Scheme)
+    assert isinstance(scheme_for("xz2-4bits"), XZ2Scheme)
+    assert isinstance(scheme_for("daily"), DateTimeScheme)
+    assert isinstance(scheme_for("attribute:name"), AttributeScheme)
+    comp = scheme_for("hourly,z2-2bit")
+    assert isinstance(comp, CompositeScheme)
+    assert comp.depth == 5  # 4 datetime segments + 1 z2
+    with pytest.raises(ValueError):
+        scheme_for("bogus")
+    with pytest.raises(ValueError):
+        scheme_for("z2-3bit")  # odd bits
+
+
+def test_datetime_leaves_and_buckets():
+    b = _batch(100)
+    for step, seg in [("daily", 3), ("hourly", 4), ("monthly", 2), ("yearly", 1)]:
+        s = DateTimeScheme(step)
+        leaves = s.leaves(b)
+        assert all(leaf.count("/") == seg - 1 for leaf in leaves)
+        # every feature's dtg falls inside its own leaf bucket
+        dtg = b.column("dtg")
+        for i in [0, 17, 99]:
+            lo, hi = s._bucket_ms(leaves[i])
+            assert lo <= int(dtg[i]) < hi
+    w = DateTimeScheme("weekly")
+    leaves = w.leaves(b)
+    assert all(leaf.startswith("W") for leaf in leaves)
+
+
+def test_z2_leaf_cells_contain_points():
+    b = _batch(200)
+    s = Z2Scheme(4)
+    leaves = s.leaves(b)
+    geom = b.columns["geom"]
+    for i in [0, 50, 150]:
+        env = s._cell_env(leaves[i])
+        assert env.xmin <= geom[i, 0] <= env.xmax
+        assert env.ymin <= geom[i, 1] <= env.ymax
+
+
+def test_fs_store_with_scheme_layout_and_prune(tmp_path):
+    sft = SimpleFeatureType.create("t", SPEC)
+    sft.user_data["geomesa.fs.partition-scheme"] = "daily,z2-2bit"
+    ds = FileSystemDataStore(str(tmp_path), partition_size=256)
+    ds.create_schema(sft)
+    n = 3000
+    rng = np.random.default_rng(5)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-01-10T00:00:00")
+    cols = {
+        "name": rng.choice(["a", "b"], n),
+        "dtg": rng.integers(t0, t1, n),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+        ),
+    }
+    ds.write("t", cols, fids=np.arange(n))
+    ds.flush("t")
+    # leaf directories exist: t/2020/01/05/<z>/part-*.parquet
+    assert (tmp_path / "t" / "2020" / "01" / "05").is_dir()
+
+    ecql = (
+        "BBOX(geom, -170, -80, -100, -10) AND "
+        "dtg DURING 2020-01-02T00:00:00Z/2020-01-04T00:00:00Z"
+    )
+    res = ds.query("t", ecql)
+    batch = FeatureBatch.from_columns(sft, cols, np.arange(n))
+    expected = np.sort(batch.fids[evaluate_host(parse_ecql(ecql), batch)])
+    np.testing.assert_array_equal(np.sort(res.batch.fids), expected)
+    assert res.scanned < res.total  # leaf prune actually skipped data
+
+    # reopen from disk: scheme + leaves persist
+    ds2 = FileSystemDataStore(str(tmp_path))
+    res2 = ds2.query("t", ecql)
+    np.testing.assert_array_equal(np.sort(res2.batch.fids), expected)
+
+
+def test_fs_attribute_scheme_prune(tmp_path):
+    sft = SimpleFeatureType.create("t", SPEC)
+    sft.user_data["geomesa.fs.partition-scheme"] = "attribute:name"
+    ds = FileSystemDataStore(str(tmp_path))
+    ds.create_schema(sft)
+    n = 600
+    rng = np.random.default_rng(9)
+    cols = {
+        "name": rng.choice(["a", "b", "c"], n),
+        "dtg": rng.integers(0, 10**6, n),
+        "geom": np.zeros((n, 2)),
+    }
+    ds.write("t", cols, fids=np.arange(n))
+    ds.flush("t")
+    assert (tmp_path / "t" / "a").is_dir()
+    res = ds.query("t", "name = 'a'")
+    assert res.scanned == (cols["name"] == "a").sum()  # only leaf 'a' read
+    assert len(res) == res.scanned
+    res_in = ds.query("t", "name IN ('a', 'b')")
+    assert len(res_in) == ((cols["name"] == "a") | (cols["name"] == "b")).sum()
+
+
+def test_minute_composite_scheme(tmp_path):
+    # 'minute' leaves must be 5 clean path segments so composites slice
+    # correctly (a ':' in the leaf previously broke depth accounting)
+    s = scheme_for("minute,z2-2bit")
+    b = _batch(50)
+    leaves = s.leaves(b)
+    assert all(leaf.count("/") == 5 for leaf in leaves)
+    sft = SimpleFeatureType.create("t", SPEC)
+    sft.user_data["geomesa.fs.partition-scheme"] = "minute,z2-2bit"
+    ds = FileSystemDataStore(str(tmp_path))
+    ds.create_schema(sft)
+    cols = {
+        "name": ["x"] * 10,
+        "dtg": np.arange(10) * 60_000 + parse_instant("2020-01-01T00:00:00"),
+        "geom": np.zeros((10, 2)),
+    }
+    ds.write("t", cols, fids=np.arange(10))
+    ds.flush("t")
+    res = ds.query(
+        "t", "dtg DURING 2020-01-01T00:00:00Z/2020-01-01T00:03:00Z"
+    )
+    assert len(res) == 4  # minutes 0..3 inclusive
+
+
+def test_attribute_scheme_sanitizes_path_values(tmp_path):
+    # hostile values must not escape the store root or add path segments
+    sft = SimpleFeatureType.create("t", SPEC)
+    sft.user_data["geomesa.fs.partition-scheme"] = "attribute:name"
+    ds = FileSystemDataStore(str(tmp_path / "store"))
+    ds.create_schema(sft)
+    names = ["../../escape", "a/b", "ok"]
+    ds.write(
+        "t",
+        {"name": names, "dtg": [0, 0, 0], "geom": np.zeros((3, 2))},
+        fids=np.arange(3),
+    )
+    ds.flush("t")
+    # nothing written outside the store root
+    outside = [
+        p
+        for p in (tmp_path).rglob("part-*")
+        if "store" not in p.parts
+    ]
+    assert outside == []
+    # queries still find everything, including sanitized-leaf features
+    assert ds.count("t") == 3
+    assert len(ds.query("t", "name = 'a/b'")) == 1
+
+
+def test_xz2_scheme_roundtrip(tmp_path):
+    from geomesa_tpu.geom import Polygon
+
+    sft = SimpleFeatureType.create("t", "name:String,*geom:Polygon")
+    sft.user_data["geomesa.fs.partition-scheme"] = "xz2-4bit"
+    ds = FileSystemDataStore(str(tmp_path))
+    ds.create_schema(sft)
+    polys = [
+        Polygon([(x, y), (x + 1, y), (x + 1, y + 1), (x, y + 1), (x, y)])
+        for x, y in [(-170, -80), (0, 0), (100, 40), (150, 70)]
+    ]
+    ds.write(
+        "t",
+        {"name": ["p0", "p1", "p2", "p3"], "geom": np.array(polys, dtype=object)},
+        fids=np.arange(4),
+    )
+    ds.flush("t")
+    res = ds.query("t", "BBOX(geom, -1, -1, 3, 3)")
+    assert list(res.batch.column("name")) == ["p1"]

@@ -17,9 +17,44 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-import re
-
 import numpy as np
+
+
+def _escape(v: str) -> str:
+    """Escape user-data values for the comma-delimited spec string."""
+    return v.replace("\\", "\\\\").replace(",", "\\,")
+
+
+def _unescape(v: str) -> str:
+    out = []
+    it = iter(v)
+    for c in it:
+        if c == "\\":
+            out.append(next(it, "\\"))
+        else:
+            out.append(c)
+    return "".join(out)
+
+
+def _split_escaped(s: str) -> list:
+    """Split on commas, honoring backslash escapes."""
+    out, cur, esc = [], [], False
+    for c in s:
+        if esc:
+            cur.append("\\")
+            cur.append(c)
+            esc = False
+        elif c == "\\":
+            esc = True
+        elif c == ",":
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+    if esc:
+        cur.append("\\")
+    out.append("".join(cur))
+    return out
 
 GEOM_TYPES = {
     "Point",
@@ -157,15 +192,16 @@ class SimpleFeatureType:
         user_data: dict = {}
         if ";" in spec:
             spec, ud = spec.split(";", 1)
-            # values may contain backslash-escaped commas (see .spec)
-            for kv in re.split(r"(?<!\\),", ud):
+            # values may contain backslash-escaped commas/backslashes
+            # (see .spec)
+            for kv in _split_escaped(ud):
                 kv = kv.strip()
                 if not kv:
                     continue
                 if "=" not in kv:
                     raise ValueError(f"bad user-data entry {kv!r}")
                 k, v = kv.split("=", 1)
-                user_data[k.strip()] = v.strip().replace("\\,", ",")
+                user_data[k.strip()] = _unescape(v.strip())
         attrs = []
         for entry in spec.split(","):
             entry = entry.strip()
@@ -205,7 +241,6 @@ class SimpleFeatureType:
         out = ",".join(parts)
         if self.user_data:
             out += ";" + ",".join(
-                f"{k}={str(v).replace(',', chr(92) + ',')}"
-                for k, v in self.user_data.items()
+                f"{k}={_escape(str(v))}" for k, v in self.user_data.items()
             )
         return out

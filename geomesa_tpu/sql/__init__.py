@@ -1,7 +1,9 @@
 """Spatial SQL function library + SpatialFrame (the Spark integration
 analog; ref: geomesa-spark geomesa-spark-sql -- SQLTypes,
-GeometricConstructorFunctions, SpatialRelationFunctions, GeoMesaRelation
-with spatial predicate pushdown [UNVERIFIED - empty reference mount]).
+GeometricConstructorFunctions, GeometricAccessorFunctions,
+GeometricOutputFunctions, GeometricProcessingFunctions,
+SpatialRelationFunctions, GeoMesaRelation with spatial predicate pushdown,
+and SpatialRDDProvider [UNVERIFIED - empty reference mount]).
 
 The reference registers ``st_*`` UDFs in Spark SQL and pushes spatial
 predicates down into GeoMesa query planning. The TPU-native analog keeps
@@ -9,37 +11,14 @@ the same function names and semantics but vectorizes over columnar numpy
 arrays directly (no JVM, no row UDF calls); SpatialFrame is the
 DataFrame-shaped lazy view whose filters push down into the store's
 planner (bbox/z3 pruning + fused device scan) instead of Spark relation
-pushdown.
+pushdown, with ``partitions()``/``map_partitions()`` as the RDD analog
+and ``spatial_join`` as the join pushdown.
+
+Every ``st_*`` function is re-exported here and listed in ``FUNCTIONS``.
 """
 
-from geomesa_tpu.sql.functions import (  # noqa: F401
-    st_area,
-    st_bufferPoint,
-    st_centroid,
-    st_contains,
-    st_disjoint,
-    st_distance,
-    st_distanceSphere,
-    st_dwithin,
-    st_envelope,
-    st_geomFromWKB,
-    st_geomFromWKT,
-    st_intersects,
-    st_length,
-    st_makeBBOX,
-    st_numPoints,
-    st_point,
-    st_within,
-    st_x,
-    st_y,
-)
+from geomesa_tpu.sql.functions import FUNCTIONS  # noqa: F401
+from geomesa_tpu.sql.functions import *  # noqa: F401,F403
 from geomesa_tpu.sql.frame import SpatialFrame  # noqa: F401
 
-__all__ = [
-    "SpatialFrame",
-    "st_point", "st_makeBBOX", "st_geomFromWKT", "st_geomFromWKB",
-    "st_x", "st_y", "st_area", "st_length", "st_centroid", "st_envelope",
-    "st_numPoints", "st_bufferPoint", "st_contains", "st_intersects",
-    "st_within", "st_disjoint", "st_dwithin", "st_distance",
-    "st_distanceSphere",
-]
+__all__ = ["SpatialFrame", "FUNCTIONS", *sorted(FUNCTIONS)]

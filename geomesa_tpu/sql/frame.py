@@ -92,3 +92,145 @@ class SpatialFrame:
 
     def __len__(self) -> int:
         return self.count()
+
+    # -- partitioned execution (ref SpatialRDDProvider: 1 Spark partition
+    # -- per range group; callers parallelize over the yielded batches) ----
+
+    def partitions(self):
+        """Yield per-storage-partition filtered FeatureBatches when the
+        store supports partitioned scans, else one batch."""
+        qp = getattr(self.store, "query_partitions", None)
+        if qp is not None:
+            yield from qp(self.type_name, self._query())
+        else:
+            b = self.collect()
+            if len(b):
+                yield b
+
+    def map_partitions(self, fn, parallelism: "int | None" = None) -> list:
+        """Apply ``fn`` to each partition batch on a thread pool (the
+        executor-side compute analog; numpy releases the GIL enough for
+        real overlap on IO-bound work)."""
+        parts = list(self.partitions())
+        if not parts:
+            return []
+        if parallelism is None or parallelism <= 1 or len(parts) == 1:
+            return [fn(p) for p in parts]
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=parallelism) as pool:
+            return list(pool.map(fn, parts))
+
+    # -- grouped aggregation ----------------------------------------------
+
+    def value_counts(self, attr: str) -> dict:
+        """Distinct values of ``attr`` -> feature count."""
+        vals, counts = np.unique(self.column(attr), return_counts=True)
+        return {v: int(c) for v, c in zip(vals.tolist(), counts.tolist())}
+
+    def group_by(self, attr: str, agg_attr: str, agg: str = "count") -> dict:
+        """Group rows by ``attr`` and aggregate ``agg_attr`` with one of
+        count|sum|min|max|mean."""
+        batch = self.collect()
+        keys = batch.column(attr)
+        vals = batch.column(agg_attr)
+        fns = {
+            "count": len,
+            "sum": lambda v: float(np.sum(v)),
+            "min": lambda v: float(np.min(v)),
+            "max": lambda v: float(np.max(v)),
+            "mean": lambda v: float(np.mean(v)),
+        }
+        if agg not in fns:
+            raise ValueError(f"unknown aggregation {agg!r}")
+        out: dict = {}
+        for k in np.unique(keys).tolist():
+            out[k] = fns[agg](vals[keys == k])
+        return out
+
+    # -- spatial join ------------------------------------------------------
+
+    def spatial_join(
+        self,
+        other: "SpatialFrame",
+        on: str = "intersects",
+        distance: "float | None" = None,
+    ):
+        """Join this frame's features against ``other``'s on a spatial
+        predicate (``intersects`` | ``contains`` | ``within`` |
+        ``dwithin`` with ``distance``). Returns (left_batch, right_batch,
+        pairs) where pairs is an (m, 2) index array into the two batches.
+
+        The right side's collected envelope is pushed down into the left
+        side's scan as a BBOX pre-filter (the reference's relation
+        pushdown), then pairs are refined with exact vectorized
+        predicates.
+        """
+        from geomesa_tpu.sql import functions as F
+
+        right = other.collect()
+        geom_r = right.sft.geom_field
+        rcol = right.columns[geom_r]
+        # bbox pushdown from the right side's extent
+        env = _extent(rcol)
+        left_frame = self
+        if env is not None:
+            pad = distance or 0.0
+            left_frame = self.where(
+                ast.BBox(
+                    _geom_field_of(self),
+                    env[0] - pad,
+                    env[1] - pad,
+                    env[2] + pad,
+                    env[3] + pad,
+                )
+            )
+        left = left_frame.collect()
+        lcol = left.columns[left.sft.geom_field]
+        preds = {
+            "intersects": F.st_intersects,
+            "contains": F.st_contains,
+            "within": F.st_within,
+        }
+        pairs = []
+        for j in range(len(right)):
+            g = _row_geom_of(rcol, j)
+            if on == "dwithin":
+                if distance is None:
+                    raise ValueError("dwithin join needs distance=")
+                m = F.st_dwithin(lcol, g, distance)
+            elif on in preds:
+                m = preds[on](lcol, g)
+            else:
+                raise ValueError(f"unknown join predicate {on!r}")
+            for i in np.nonzero(np.asarray(m))[0]:
+                pairs.append((int(i), j))
+        return left, right, np.array(pairs, dtype=np.int64).reshape(-1, 2)
+
+
+def _geom_field_of(frame: SpatialFrame) -> str:
+    return frame.store.get_schema(frame.type_name).geom_field
+
+
+def _extent(col):
+    if len(col) == 0:
+        return None
+    if col.dtype != object:
+        return (
+            float(col[:, 0].min()),
+            float(col[:, 1].min()),
+            float(col[:, 0].max()),
+            float(col[:, 1].max()),
+        )
+    e = col[0].envelope
+    for g in col[1:]:
+        e = e.expand(g.envelope)
+    return (e.xmin, e.ymin, e.xmax, e.ymax)
+
+
+def _row_geom_of(col, i):
+    if col.dtype != object:
+        from geomesa_tpu.geom import Point
+
+        return Point(float(col[i, 0]), float(col[i, 1]))
+    return col[i]

@@ -430,3 +430,549 @@ def st_distanceSphere(a, b):
     if isinstance(a, Point) and isinstance(b, Point):
         return float(d[0])
     return d
+
+
+# -- scalar-mapping helper ---------------------------------------------------
+
+
+def _map_geoms(geom, fn):
+    """Apply a Geometry -> value function over a scalar or column input."""
+    if isinstance(geom, Geometry):
+        return fn(geom)
+    if _is_point_col(geom):
+        return np.array(
+            [fn(Point(float(x), float(y))) for x, y in geom], dtype=object
+        )
+    return np.array([fn(g) for g in geom], dtype=object)
+
+
+# -- typed constructors (ref GeometricConstructorFunctions) ------------------
+
+
+def st_makeLine(points) -> LineString:
+    """Points (Point list or (n, 2) array) -> LineString."""
+    if isinstance(points, np.ndarray):
+        return LineString(points)
+    return LineString(
+        np.array([[p.x, p.y] for p in points], dtype=np.float64)
+    )
+
+
+def st_makePolygon(line) -> Polygon:
+    """Closed LineString (or coords) -> Polygon shell."""
+    coords = line.coords if isinstance(line, LineString) else np.asarray(line)
+    if not np.array_equal(coords[0], coords[-1]):
+        coords = np.concatenate([coords, coords[:1]], axis=0)
+    return Polygon(coords)
+
+
+st_makeBox2D = st_makeBBOX  # ref alias (two corner points in the reference)
+
+
+def _typed_from_text(wkt, cls, name):
+    g = st_geomFromWKT(wkt)
+    if isinstance(g, np.ndarray):
+        if any(not isinstance(v, cls) for v in g):
+            raise ValueError(f"{name} got non-{cls.__name__} WKT")
+        return g
+    if not isinstance(g, cls):
+        raise ValueError(f"{name} got {type(g).__name__}, not {cls.__name__}")
+    return g
+
+
+def st_pointFromText(wkt):
+    return _typed_from_text(wkt, Point, "st_pointFromText")
+
+
+def st_lineFromText(wkt):
+    return _typed_from_text(wkt, LineString, "st_lineFromText")
+
+
+def st_polygonFromText(wkt):
+    return _typed_from_text(wkt, Polygon, "st_polygonFromText")
+
+
+def st_mPointFromText(wkt):
+    return _typed_from_text(wkt, MultiPoint, "st_mPointFromText")
+
+
+def st_mLineFromText(wkt):
+    return _typed_from_text(wkt, MultiLineString, "st_mLineFromText")
+
+
+def st_mPolyFromText(wkt):
+    return _typed_from_text(wkt, MultiPolygon, "st_mPolyFromText")
+
+
+def st_geomFromGeoJSON(doc):
+    from geomesa_tpu.geom.geojson import from_geojson
+
+    if isinstance(doc, (dict, str, bytes)):
+        return from_geojson(doc)
+    return np.array([from_geojson(d) for d in doc], dtype=object)
+
+
+def st_geomFromGeoHash(gh, precision: "int | None" = None):
+    """GeoHash string -> its cell Polygon."""
+    from geomesa_tpu.geom import geohash
+
+    def one(h):
+        (xmin, xmax), (ymin, ymax) = geohash.decode_bbox(
+            h if precision is None else h[: (precision + 4) // 5]
+        )
+        return st_makeBBOX(xmin, ymin, xmax, ymax)
+
+    if isinstance(gh, str):
+        return one(gh)
+    return np.array([one(h) for h in gh], dtype=object)
+
+
+st_box2DFromGeoHash = st_geomFromGeoHash  # ref alias
+
+
+def st_pointFromGeoHash(gh, precision: "int | None" = None):
+    """GeoHash string -> cell-center Point."""
+    from geomesa_tpu.geom import geohash
+
+    def one(h):
+        lon, lat = geohash.decode(h)
+        return Point(lon, lat)
+
+    if isinstance(gh, str):
+        return one(gh)
+    return np.array([one(h) for h in gh], dtype=object)
+
+
+def st_castToPoint(geom):
+    return _cast(geom, Point)
+
+
+def st_castToLineString(geom):
+    return _cast(geom, LineString)
+
+
+def st_castToPolygon(geom):
+    return _cast(geom, Polygon)
+
+
+def _cast(geom, cls):
+    def one(g):
+        if not isinstance(g, cls):
+            raise ValueError(f"cannot cast {type(g).__name__} to {cls.__name__}")
+        return g
+
+    if isinstance(geom, Geometry):
+        return one(geom)
+    return _map_geoms(geom, one)
+
+
+# -- accessors (ref GeometricAccessorFunctions) ------------------------------
+
+
+def st_geometryType(geom):
+    return _scalar_or_col(geom, lambda g: type(g).__name__)
+
+
+def _scalar_or_col(geom, fn):
+    if isinstance(geom, Geometry):
+        return fn(geom)
+    return _map_geoms(geom, fn)
+
+
+def st_isEmpty(geom):
+    def one(g):
+        if isinstance(g, Point):
+            return bool(np.isnan(g.x))
+        if isinstance(g, LineString):
+            return len(g.coords) == 0
+        if isinstance(g, Polygon):
+            return len(g.shell) == 0
+        if isinstance(g, MultiPoint):
+            return len(g.points) == 0
+        if isinstance(g, MultiLineString):
+            return len(g.lines) == 0
+        if isinstance(g, MultiPolygon):
+            return len(g.polygons) == 0
+        return False
+
+    return _scalar_or_col(geom, one)
+
+
+def st_isCollection(geom):
+    return _scalar_or_col(
+        geom,
+        lambda g: isinstance(g, (MultiPoint, MultiLineString, MultiPolygon)),
+    )
+
+
+def st_isClosed(geom):
+    """Lines: first == last coordinate (points/polygons are closed)."""
+
+    def one(g):
+        if isinstance(g, LineString):
+            return bool(np.array_equal(g.coords[0], g.coords[-1]))
+        if isinstance(g, MultiLineString):
+            return all(
+                np.array_equal(l.coords[0], l.coords[-1]) for l in g.lines
+            )
+        return True
+
+    return _scalar_or_col(geom, one)
+
+
+def st_isRing(geom):
+    def one(g):
+        return isinstance(g, LineString) and bool(
+            np.array_equal(g.coords[0], g.coords[-1])
+        )
+
+    return _scalar_or_col(geom, one)
+
+
+def st_dimension(geom):
+    def one(g):
+        if isinstance(g, (Point, MultiPoint)):
+            return 0
+        if isinstance(g, (LineString, MultiLineString)):
+            return 1
+        return 2
+
+    return _scalar_or_col(geom, one)
+
+
+def st_coordDim(geom):
+    return _scalar_or_col(geom, lambda g: 2)  # xy-only geometry model
+
+
+def st_numGeometries(geom):
+    def one(g):
+        if isinstance(g, MultiPoint):
+            return len(g.points)
+        if isinstance(g, MultiLineString):
+            return len(g.lines)
+        if isinstance(g, MultiPolygon):
+            return len(g.polygons)
+        return 1
+
+    return _scalar_or_col(geom, one)
+
+
+def st_geometryN(geom, n: int):
+    """1-based part accessor (ref/JTS convention)."""
+
+    def one(g):
+        if isinstance(g, MultiPoint):
+            return g.points[n - 1]
+        if isinstance(g, MultiLineString):
+            return g.lines[n - 1]
+        if isinstance(g, MultiPolygon):
+            return g.polygons[n - 1]
+        if n != 1:
+            raise IndexError(f"geometry has 1 part, asked for {n}")
+        return g
+
+    return _scalar_or_col(geom, one)
+
+
+def st_exteriorRing(geom):
+    def one(g):
+        if isinstance(g, Polygon):
+            return LineString(g.shell)
+        raise ValueError("st_exteriorRing needs a Polygon")
+
+    return _scalar_or_col(geom, one)
+
+
+def st_interiorRingN(geom, n: int):
+    def one(g):
+        if isinstance(g, Polygon):
+            return LineString(g.holes[n - 1])
+        raise ValueError("st_interiorRingN needs a Polygon")
+
+    return _scalar_or_col(geom, one)
+
+
+def st_pointN(geom, n: int):
+    """1-based vertex accessor on lines (negative counts from the end)."""
+
+    def one(g):
+        if not isinstance(g, LineString):
+            raise ValueError("st_pointN needs a LineString")
+        c = g.coords[n - 1 if n > 0 else n]
+        return Point(float(c[0]), float(c[1]))
+
+    return _scalar_or_col(geom, one)
+
+
+def st_startPoint(geom):
+    return st_pointN(geom, 1)
+
+
+def st_endPoint(geom):
+    return st_pointN(geom, -1)
+
+
+# -- outputs (ref SpatialEncoders / output functions) ------------------------
+
+
+def st_asText(geom):
+    from geomesa_tpu.geom.wkt import to_wkt
+
+    return _scalar_or_col(geom, to_wkt)
+
+
+st_asWKT = st_asText
+
+
+def st_asBinary(geom):
+    from geomesa_tpu.geom.wkb import to_wkb
+
+    return _scalar_or_col(geom, to_wkb)
+
+
+st_asWKB = st_asBinary
+
+
+def st_asTWKB(geom, precision: int = 7):
+    from geomesa_tpu.geom.wkb import to_twkb
+
+    return _scalar_or_col(geom, lambda g: to_twkb(g, precision))
+
+
+def st_asGeoJSON(geom):
+    import json
+
+    from geomesa_tpu.geom.geojson import to_geojson
+
+    return _scalar_or_col(geom, lambda g: json.dumps(to_geojson(g)))
+
+
+def st_geoHash(geom, precision: int = 9):
+    """Point (or point column) -> GeoHash string(s)."""
+    from geomesa_tpu.geom import geohash
+
+    if isinstance(geom, Point):
+        return geohash.encode(geom.x, geom.y, precision)
+    if _is_point_col(geom):
+        return np.array(
+            [geohash.encode(x, y, precision) for x, y in geom], dtype=object
+        )
+
+    def one(g):
+        if not isinstance(g, Point):
+            raise ValueError(
+                f"st_geoHash needs Point geometries, got {type(g).__name__}"
+            )
+        return geohash.encode(g.x, g.y, precision)
+
+    return _map_geoms(geom, one)
+
+
+# -- processing (ref GeometricProcessingFunctions) ---------------------------
+
+
+def _map_coords(g, fn):
+    """Rebuild a geometry with transformed (n, 2) coordinate arrays."""
+    if isinstance(g, Point):
+        c = fn(np.array([[g.x, g.y]]))
+        return Point(float(c[0, 0]), float(c[0, 1]))
+    if isinstance(g, LineString):
+        return LineString(fn(g.coords))
+    if isinstance(g, Polygon):
+        return Polygon(fn(g.shell), tuple(fn(h) for h in g.holes))
+    if isinstance(g, MultiPoint):
+        return MultiPoint(tuple(_map_coords(p, fn) for p in g.points))
+    if isinstance(g, MultiLineString):
+        return MultiLineString(tuple(_map_coords(l, fn) for l in g.lines))
+    if isinstance(g, MultiPolygon):
+        return MultiPolygon(tuple(_map_coords(p, fn) for p in g.polygons))
+    raise ValueError(f"cannot transform {type(g).__name__}")
+
+
+def st_translate(geom, dx: float, dy: float):
+    def one(g):
+        return _map_coords(g, lambda c: c + np.array([dx, dy]))
+
+    return _scalar_or_col(geom, one)
+
+
+def st_convexHull(geom):
+    """Monotone-chain convex hull of all vertices."""
+
+    def one(g):
+        pts = np.unique(_all_vertices(g), axis=0)
+        if len(pts) == 1:
+            return Point(float(pts[0, 0]), float(pts[0, 1]))
+        if len(pts) == 2:
+            return LineString(pts)
+        pts = pts[np.lexsort((pts[:, 1], pts[:, 0]))]
+
+        def half(points):
+            out = []
+            for p in points:
+                while len(out) >= 2:
+                    u = out[-1] - out[-2]
+                    v = p - out[-2]
+                    if u[0] * v[1] - u[1] * v[0] <= 0:  # 2d cross product
+                        out.pop()
+                    else:
+                        break
+                out.append(p)
+            return out
+
+        lower = half(pts)
+        upper = half(pts[::-1])
+        hull = np.array(lower[:-1] + upper[:-1])
+        if len(hull) < 3:
+            return LineString(np.array([pts[0], pts[-1]]))
+        return Polygon(np.concatenate([hull, hull[:1]], axis=0))
+
+    return _scalar_or_col(geom, one)
+
+
+def st_closestPoint(a, b):
+    """Point on geometry ``a`` closest to point ``b``."""
+
+    def one(ga, gb):
+        if not isinstance(gb, Point):
+            raise ValueError("st_closestPoint expects a Point second arg")
+        if isinstance(ga, Point):
+            return ga
+        segs = _segments_of(ga)
+        pt = np.array([[gb.x, gb.y]])
+        t, dist2 = pt_seg_project(pt, segs)
+        j = int(dist2[0].argmin())
+        sa = segs[j, 0:2]
+        sd = segs[j, 2:4] - sa
+        c = sa + t[0, j] * sd
+        return Point(float(c[0]), float(c[1]))
+
+    if isinstance(a, Geometry) and isinstance(b, Point):
+        return one(a, b)
+    return _map_geoms(a, lambda g: one(g, b))
+
+
+def st_lengthSphere(geom):
+    """LineString length in meters over the sphere (haversine per segment)."""
+
+    def one(g):
+        segs = _segments_of(g)
+        if len(segs) == 0:
+            return 0.0
+        lon1, lat1, lon2, lat2 = (
+            np.radians(segs[:, 0]),
+            np.radians(segs[:, 1]),
+            np.radians(segs[:, 2]),
+            np.radians(segs[:, 3]),
+        )
+        h = (
+            np.sin((lat2 - lat1) / 2) ** 2
+            + np.cos(lat1) * np.cos(lat2) * np.sin((lon2 - lon1) / 2) ** 2
+        )
+        return float(
+            (2 * EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(h, 0, 1)))).sum()
+        )
+
+    return _scalar_or_col(geom, one)
+
+
+def st_antimeridianSafeGeom(geom):
+    """Split geometries that extend past lon +/-180 into an in-range
+    MultiPolygon/MultiLineString (ref st_antimeridianSafeGeom; the
+    reference's buffer ops can produce lon > 180 which must be wrapped
+    before indexing)."""
+
+    def clip_ring(coords, boundary, keep_right):
+        # Sutherland-Hodgman against the half-plane x <= boundary
+        # (keep_right False) or x >= boundary (True)
+        out = []
+        n = len(coords)
+        for i in range(n):
+            cur, nxt = coords[i], coords[(i + 1) % n]
+            cin = cur[0] >= boundary if keep_right else cur[0] <= boundary
+            nin = nxt[0] >= boundary if keep_right else nxt[0] <= boundary
+            if cin:
+                out.append(cur)
+            if cin != nin:
+                tpar = (boundary - cur[0]) / (nxt[0] - cur[0])
+                out.append(
+                    np.array([boundary, cur[1] + tpar * (nxt[1] - cur[1])])
+                )
+        return np.array(out) if len(out) >= 3 else None
+
+    def one(g):
+        e = g.envelope
+        if e.xmax <= 180.0 and e.xmin >= -180.0:
+            return g
+        if isinstance(g, Point):
+            x = ((g.x + 180.0) % 360.0) - 180.0
+            return Point(x, g.y)
+        if isinstance(g, Polygon):
+            ring = g.shell[:-1]
+            parts = []
+            if e.xmax > 180.0:  # spills east: split at +180
+                kept = clip_ring(ring, 180.0, keep_right=False)
+                wrapped = clip_ring(ring, 180.0, keep_right=True)
+                shift = np.array([-360.0, 0.0])
+            else:  # spills west: split at -180
+                kept = clip_ring(ring, -180.0, keep_right=True)
+                wrapped = clip_ring(ring, -180.0, keep_right=False)
+                shift = np.array([360.0, 0.0])
+            if kept is not None:
+                parts.append(Polygon(np.concatenate([kept, kept[:1]], axis=0)))
+            if wrapped is not None:
+                wrapped = wrapped + shift
+                parts.append(
+                    Polygon(np.concatenate([wrapped, wrapped[:1]], axis=0))
+                )
+            if not parts:
+                return g
+            return parts[0] if len(parts) == 1 else MultiPolygon(tuple(parts))
+        if isinstance(g, MultiPolygon):
+            parts = []
+            for p in g.polygons:
+                r = one(p)
+                parts.extend(
+                    r.polygons if isinstance(r, MultiPolygon) else [r]
+                )
+            return MultiPolygon(tuple(parts))
+        return g  # lines/others: left untouched
+
+    return _scalar_or_col(geom, one)
+
+
+st_idlSafeGeom = st_antimeridianSafeGeom  # ref alias
+
+
+def st_equals(a, b):
+    def fn(ga, gb):
+        if type(ga) is not type(gb):
+            return False
+        if isinstance(ga, Point):
+            return ga.x == gb.x and ga.y == gb.y
+        va, vb = _all_vertices(ga), _all_vertices(gb)
+        return va.shape == vb.shape and bool(np.allclose(va, vb))
+
+    def point_fast(pts, g, flipped):
+        if not isinstance(g, Point):
+            return np.zeros(len(pts), dtype=bool)
+        return (pts[:, 0] == g.x) & (pts[:, 1] == g.y)
+
+    return _pairwise(a, b, fn, point_fast)
+
+
+def st_covers(a, b):
+    """a covers b (boundary-inclusive contains; approximated by contains
+    with boundary tolerance on our grid model)."""
+    return st_contains(a, b)
+
+
+# -- registry ----------------------------------------------------------------
+
+FUNCTIONS = {
+    name: fn
+    for name, fn in list(globals().items())
+    if name.startswith("st_") and callable(fn)
+}
+
+__all__ = sorted(FUNCTIONS)

@@ -175,15 +175,8 @@ class FileSystemDataStore:
             raise ValueError(f"schema {sft.type_name!r} exists")
         primary = default_indices(sft)[0]
         os.makedirs(self._dir(sft.type_name), exist_ok=True)
-        scheme = self._scheme_of(sft)
-        if scheme is not None:
-            # normalize to the ':'-joined form so the declaration survives
-            # the comma-delimited spec string in schema.json
-            from geomesa_tpu.store.partitions import USER_DATA_KEY
-
-            sft.user_data[USER_DATA_KEY] = scheme.spec
         self._types[sft.type_name] = _FsTypeState(
-            sft, primary, encoding=self.encoding, scheme=scheme
+            sft, primary, encoding=self.encoding, scheme=self._scheme_of(sft)
         )
         self._save_meta(sft.type_name)
         return sft
@@ -315,15 +308,9 @@ class FileSystemDataStore:
             st.sft, {st.primary: ks}, as_query(query), data_interval=st.data_interval
         )
 
-    def query(self, type_name: str, query: "Query | str | ast.Filter" = ast.Include) -> QueryResult:
-        """Partition-pruned scan over parquet files."""
-        import time as _time
-
-        t0 = _time.perf_counter()
+    def _pruned_parts(self, type_name: str, plan: QueryPlan) -> list:
+        """Partition-scheme leaf prune, then manifest key-range prune."""
         st = self._types[type_name]
-        plan = self.plan(type_name, query)
-        t1 = _time.perf_counter()
-        # prune by partition-scheme leaves, then by manifest key ranges
         parts = st.partitions
         if st.scheme is not None:
             from geomesa_tpu.store.partitions import scheme_matches
@@ -337,6 +324,58 @@ class FileSystemDataStore:
             parts = [
                 p for p in parts if any(p.overlaps(r) for r in plan.ranges)
             ]
+        return parts
+
+    def query_partitions(self, type_name: str, query=ast.Include):
+        """Yield one filtered FeatureBatch per surviving partition (the
+        Spark SpatialRDDProvider analog: 1 partition per range group, so
+        callers can process partitions in parallel).
+
+        Row-local post-processing (visibility filtering, projection)
+        applies per partition; global sort / max-features do NOT -- they
+        have cross-partition semantics, same as Spark RDD partitions.
+        """
+        import dataclasses
+
+        st = self._types[type_name]
+        plan = self.plan(type_name, query)
+        ks = keyspace_for(st.sft, st.primary)
+        inner_plan = dataclasses.replace(
+            plan,
+            query=Query(filter=plan.filter, hints={"internal_scan": True}),
+        )
+        # per-partition outer pass: visibility + projection, no sort/limit
+        outer_plan = dataclasses.replace(
+            plan,
+            query=dataclasses.replace(
+                plan.query, sort_by=None, max_features=None
+            ),
+        )
+        from geomesa_tpu.query.runner import _post_process
+
+        for p in self._pruned_parts(type_name, plan):
+            batch = self._read_partition(type_name, p)
+            local = BuiltIndex(
+                ks,
+                batch,
+                {},
+                [PartitionMeta(0, 0, len(batch), p.key_lo, p.key_hi, len(batch))],
+            )
+            sub = run_query(local, inner_plan)
+            if len(sub.batch):
+                out = _post_process(sub.batch, outer_plan)
+                if len(out):
+                    yield out
+
+    def query(self, type_name: str, query: "Query | str | ast.Filter" = ast.Include) -> QueryResult:
+        """Partition-pruned scan over parquet files."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        st = self._types[type_name]
+        plan = self.plan(type_name, query)
+        t1 = _time.perf_counter()
+        parts = self._pruned_parts(type_name, plan)
         # scan each surviving file through the shared runner by wrapping it
         # as a single-partition BuiltIndex
         ks = keyspace_for(st.sft, st.primary)

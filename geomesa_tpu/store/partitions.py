@@ -157,12 +157,15 @@ class Z2Scheme(PartitionScheme):
     def leaves(self, batch) -> np.ndarray:
         geom = batch.sft.geom_field
         col = batch.columns[geom]
-        if col.dtype != object:
-            x, y = col[:, 0], col[:, 1]
-        else:  # non-point: envelope centers
-            envs = [g.envelope for g in col]
-            x = np.array([(e.xmin + e.xmax) / 2 for e in envs])
-            y = np.array([(e.ymin + e.ymax) / 2 for e in envs])
+        if col.dtype == object:
+            # a polygon's extent can span many cells, but a feature lives
+            # in exactly one leaf -- single-cell pruning would then drop
+            # results. Extent-preserving layout is what xz2 is for.
+            raise ValueError(
+                "z2 partition scheme requires a Point geometry field; "
+                "use an xz2 scheme for non-point geometries"
+            )
+        x, y = col[:, 0], col[:, 1]
         return np.array(
             [f"{int(z):0{self.digits}d}" for z in self._cells(x, y)], dtype=object
         )

@@ -1,0 +1,276 @@
+"""Wide st_* UDF surface, GeoJSON codec, SpatialFrame partitions/join."""
+
+import json
+
+import numpy as np
+import pytest
+
+import geomesa_tpu.sql as sql
+from geomesa_tpu.geom import (
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+from geomesa_tpu.geom.geojson import from_geojson, to_geojson
+from geomesa_tpu.sql import SpatialFrame
+from geomesa_tpu.sql.functions import FUNCTIONS
+
+SQUARE = sql.st_makeBBOX(0, 0, 10, 10)
+LINE = LineString(np.array([[0.0, 0.0], [3.0, 4.0], [3.0, 8.0]]))
+
+
+def test_registry_has_full_surface():
+    expected = {
+        "st_point", "st_makeBBOX", "st_makeLine", "st_makePolygon",
+        "st_geomFromWKT", "st_geomFromWKB", "st_geomFromGeoJSON",
+        "st_geomFromGeoHash", "st_pointFromGeoHash", "st_pointFromText",
+        "st_lineFromText", "st_polygonFromText", "st_castToPoint",
+        "st_castToPolygon", "st_geometryType", "st_isEmpty", "st_isClosed",
+        "st_isRing", "st_isCollection", "st_dimension", "st_coordDim",
+        "st_numGeometries", "st_geometryN", "st_exteriorRing",
+        "st_interiorRingN", "st_pointN", "st_startPoint", "st_endPoint",
+        "st_asText", "st_asBinary", "st_asGeoJSON", "st_asTWKB",
+        "st_geoHash", "st_translate", "st_convexHull", "st_closestPoint",
+        "st_lengthSphere", "st_antimeridianSafeGeom", "st_idlSafeGeom",
+        "st_equals", "st_covers", "st_intersects", "st_contains",
+        "st_within", "st_distance", "st_dwithin", "st_area", "st_centroid",
+    }
+    missing = expected - set(FUNCTIONS)
+    assert not missing, f"missing st_ functions: {sorted(missing)}"
+    assert len(FUNCTIONS) >= 60
+
+
+def test_constructors():
+    line = sql.st_makeLine([Point(0, 0), Point(1, 1), Point(2, 0)])
+    assert isinstance(line, LineString) and len(line.coords) == 3
+    poly = sql.st_makePolygon(line)
+    assert isinstance(poly, Polygon)
+    assert np.array_equal(poly.shell[0], poly.shell[-1])
+    p = sql.st_pointFromText("POINT (3 4)")
+    assert (p.x, p.y) == (3, 4)
+    with pytest.raises(ValueError):
+        sql.st_pointFromText("LINESTRING (0 0, 1 1)")
+    assert isinstance(sql.st_polygonFromText("POLYGON ((0 0, 1 0, 1 1, 0 0))"), Polygon)
+
+
+def test_geohash_functions():
+    gh = sql.st_geoHash(Point(2.35, 48.85), 9)
+    assert isinstance(gh, str) and len(gh) == 9
+    cell = sql.st_geomFromGeoHash(gh)
+    assert isinstance(cell, Polygon)
+    center = sql.st_pointFromGeoHash(gh)
+    assert abs(center.x - 2.35) < 0.01 and abs(center.y - 48.85) < 0.01
+    # vectorized over point columns
+    pts = np.array([[2.35, 48.85], [-0.12, 51.5]])
+    ghs = sql.st_geoHash(pts, 7)
+    assert len(ghs) == 2 and all(len(h) == 7 for h in ghs)
+
+
+def test_accessors():
+    assert sql.st_geometryType(SQUARE) == "Polygon"
+    assert sql.st_dimension(LINE) == 1 and sql.st_dimension(SQUARE) == 2
+    assert sql.st_numGeometries(SQUARE) == 1
+    mp = MultiPoint((Point(0, 0), Point(1, 1)))
+    assert sql.st_numGeometries(mp) == 2
+    assert sql.st_geometryN(mp, 2).x == 1
+    ring = sql.st_exteriorRing(SQUARE)
+    assert isinstance(ring, LineString) and sql.st_isRing(ring)
+    assert not sql.st_isClosed(LINE)
+    assert sql.st_startPoint(LINE).x == 0 and sql.st_endPoint(LINE).y == 8
+    assert sql.st_pointN(LINE, 2).y == 4
+    assert not sql.st_isEmpty(LINE)
+    assert sql.st_isCollection(mp) and not sql.st_isCollection(LINE)
+    assert sql.st_coordDim(LINE) == 2
+
+
+def test_outputs_roundtrip():
+    wkt = sql.st_asText(SQUARE)
+    assert sql.st_equals(sql.st_geomFromWKT(wkt), SQUARE)
+    wkb = sql.st_asBinary(LINE)
+    assert sql.st_equals(sql.st_geomFromWKB(wkb), LINE)
+    gj = sql.st_asGeoJSON(SQUARE)
+    assert json.loads(gj)["type"] == "Polygon"
+    assert sql.st_equals(sql.st_geomFromGeoJSON(gj), SQUARE)
+    twkb = sql.st_asTWKB(LINE)
+    from geomesa_tpu.geom.wkb import from_twkb
+
+    assert sql.st_equals(from_twkb(twkb), LINE)
+
+
+def test_geojson_all_types():
+    geoms = [
+        Point(1, 2),
+        LINE,
+        SQUARE,
+        MultiPoint((Point(0, 0), Point(1, 1))),
+        MultiLineString((LINE,)),
+        MultiPolygon((SQUARE,)),
+    ]
+    for g in geoms:
+        rt = from_geojson(to_geojson(g))
+        assert sql.st_equals(rt, g), type(g).__name__
+
+
+def test_processing():
+    t = sql.st_translate(Point(1, 1), 2, 3)
+    assert (t.x, t.y) == (3, 4)
+    hull = sql.st_convexHull(MultiPoint(tuple(
+        Point(x, y) for x, y in [(0, 0), (4, 0), (4, 4), (0, 4), (2, 2), (1, 1)]
+    )))
+    assert isinstance(hull, Polygon)
+    assert abs(sql.st_area(hull) - 16.0) < 1e-9  # interior points dropped
+    cp = sql.st_closestPoint(LINE, Point(6, 4))
+    assert abs(cp.x - 3) < 1e-9 and abs(cp.y - 4) < 1e-9
+    # ~111 km for 1 degree of latitude
+    merid = LineString(np.array([[0.0, 0.0], [0.0, 1.0]]))
+    assert abs(sql.st_lengthSphere(merid) - 111_195) < 500
+
+
+def test_regressions_from_review(tmp_path):
+    # st_equals point-column vs non-point: all False, no crash
+    res = sql.st_equals(np.zeros((3, 2)), SQUARE)
+    assert not res.any()
+    # st_geoHash of a non-point raises a clear error
+    with pytest.raises(ValueError, match="st_geoHash"):
+        sql.st_geoHash(np.array([SQUARE], dtype=object))
+    # west-spilling polygon wraps too
+    west = sql.st_makeBBOX(-185, 10, -175, 20)
+    safe = sql.st_antimeridianSafeGeom(west)
+    assert isinstance(safe, MultiPolygon)
+    assert all(
+        p.envelope.xmin >= -180 and p.envelope.xmax <= 180
+        for p in safe.polygons
+    )
+    # z2 scheme rejects non-point geometry fields
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.features.sft import SimpleFeatureType
+
+    sft = SimpleFeatureType.create("z", "name:String,*geom:Polygon")
+    sft.user_data["geomesa.fs.partition-scheme"] = "z2-4bit"
+    zs = FileSystemDataStore(str(tmp_path / "zs"))
+    zs.create_schema(sft)
+    zs.write("z", {"name": ["p"], "geom": np.array([SQUARE], dtype=object)})
+    with pytest.raises(ValueError, match="xz2"):
+        zs.flush("z")
+    # backslash-heavy user-data values survive the spec round-trip
+    s2 = SimpleFeatureType.create("t", "name:String,*geom:Point")
+    s2.user_data["a"] = "C:\\"
+    s2.user_data["b"] = "x,y"
+    rt = SimpleFeatureType.create("t", s2.spec)
+    assert rt.user_data == s2.user_data
+
+
+def test_partitions_respect_visibility_and_projection(tmp_path):
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.features.sft import SimpleFeatureType
+
+    ds = FileSystemDataStore(str(tmp_path))
+    sft = SimpleFeatureType.create("t", "name:String,dtg:Date,*geom:Point")
+    ds.create_schema(sft)
+    batch = FeatureBatch.from_columns(
+        sft,
+        {"name": ["open", "secret"], "dtg": [0, 0], "geom": np.zeros((2, 2))},
+        [0, 1],
+    ).with_visibility(["", "admin"])
+    ds.write("t", batch)
+    ds.flush("t")
+    frame = SpatialFrame(ds, "t")
+    names = [n for p in frame.partitions() for n in p.column("name")]
+    assert names == ["open"]  # visibility honored without auths
+    admin = frame.with_auths("admin")
+    names = sorted(n for p in admin.partitions() for n in p.column("name"))
+    assert names == ["open", "secret"]
+    proj = [list(p.sft.attribute_names) for p in frame.select("name").partitions()]
+    assert all(cols == ["name"] for cols in proj)
+
+
+def test_antimeridian_safe():
+    # polygon spilling past lon 180 splits into two in-range parts
+    poly = sql.st_makeBBOX(175, 10, 185, 20)
+    safe = sql.st_antimeridianSafeGeom(poly)
+    assert isinstance(safe, MultiPolygon)
+    envs = [p.envelope for p in safe.polygons]
+    assert all(e.xmin >= -180 and e.xmax <= 180 for e in envs)
+    assert abs(sum(sql.st_area(p) for p in safe.polygons) - sql.st_area(poly)) < 1e-6
+    # in-range geometry passes through unchanged
+    assert sql.st_antimeridianSafeGeom(SQUARE) is SQUARE
+    p = sql.st_antimeridianSafeGeom(Point(190.0, 5.0))
+    assert p.x == -170.0
+
+
+def _fill_store(tmp_path, n=5000):
+    from geomesa_tpu.filter.ecql import parse_instant
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    ds = FileSystemDataStore(str(tmp_path), partition_size=512)
+    ds.create_schema("t", "name:String,val:Int,dtg:Date,*geom:Point:srid=4326")
+    rng = np.random.default_rng(13)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write(
+        "t",
+        {
+            "name": rng.choice(["a", "b", "c"], n),
+            "val": rng.integers(0, 100, n),
+            "dtg": t0 + rng.integers(0, 10**8, n),
+            "geom": np.stack(
+                [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    ds.flush("t")
+    return ds
+
+
+def test_frame_partitions_and_map(tmp_path):
+    ds = _fill_store(tmp_path)
+    frame = SpatialFrame(ds, "t").where("BBOX(geom, -10, -10, 10, 10)")
+    parts = list(frame.partitions())
+    assert len(parts) > 1  # multiple storage partitions survive
+    assert sum(len(p) for p in parts) == frame.count()
+    counts = frame.map_partitions(len, parallelism=4)
+    assert sum(counts) == frame.count()
+
+
+def test_frame_group_by(tmp_path):
+    ds = _fill_store(tmp_path, n=1000)
+    frame = SpatialFrame(ds, "t")
+    vc = frame.value_counts("name")
+    assert sum(vc.values()) == 1000
+    means = frame.group_by("name", "val", "mean")
+    assert set(means) == set(vc)
+    assert all(0 <= v <= 100 for v in means.values())
+
+
+def test_frame_spatial_join(tmp_path):
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    ds = _fill_store(tmp_path, n=2000)
+    zones = FileSystemDataStore(str(tmp_path / "zones"))
+    zones.create_schema("z", "zone:String,*geom:Polygon")
+    zpolys = np.array(
+        [sql.st_makeBBOX(-5, -5, 0, 0), sql.st_makeBBOX(0, 0, 5, 5)],
+        dtype=object,
+    )
+    zones.write("z", {"zone": ["sw", "ne"], "geom": zpolys}, fids=[0, 1])
+    zones.flush("z")
+    pts = SpatialFrame(ds, "t")
+    zf = SpatialFrame(zones, "z")
+    left, right, pairs = pts.spatial_join(zf, on="within")
+    assert len(pairs) > 0
+    # verify each pair against the exact predicate
+    lg = left.columns["geom"]
+    for i, j in pairs[:50]:
+        assert sql.st_within(
+            Point(float(lg[i, 0]), float(lg[i, 1])), right.columns["geom"][j]
+        )
+    # oracle count: points in either box
+    g = ds.query("t").batch.columns["geom"]
+    in_sw = (g[:, 0] >= -5) & (g[:, 0] <= 0) & (g[:, 1] >= -5) & (g[:, 1] <= 0)
+    in_ne = (g[:, 0] >= 0) & (g[:, 0] <= 5) & (g[:, 1] >= 0) & (g[:, 1] <= 5)
+    assert len(pairs) == int(in_sw.sum() + in_ne.sum())

@@ -229,8 +229,6 @@ def _extent(col):
 
 
 def _row_geom_of(col, i):
-    if col.dtype != object:
-        from geomesa_tpu.geom import Point
+    from geomesa_tpu.sql.functions import _row_geom
 
-        return Point(float(col[i, 0]), float(col[i, 1]))
-    return col[i]
+    return _row_geom(col, i)

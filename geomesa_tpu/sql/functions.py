@@ -517,8 +517,9 @@ def st_geomFromGeoHash(gh, precision: "int | None" = None):
     from geomesa_tpu.geom import geohash
 
     def one(h):
+        # precision counts geohash characters, same unit as st_geoHash
         (xmin, xmax), (ymin, ymax) = geohash.decode_bbox(
-            h if precision is None else h[: (precision + 4) // 5]
+            h if precision is None else h[:precision]
         )
         return st_makeBBOX(xmin, ymin, xmax, ymax)
 
@@ -908,22 +909,29 @@ def st_antimeridianSafeGeom(geom):
             x = ((g.x + 180.0) % 360.0) - 180.0
             return Point(x, g.y)
         if isinstance(g, Polygon):
-            ring = g.shell[:-1]
-            parts = []
             if e.xmax > 180.0:  # spills east: split at +180
-                kept = clip_ring(ring, 180.0, keep_right=False)
-                wrapped = clip_ring(ring, 180.0, keep_right=True)
-                shift = np.array([-360.0, 0.0])
+                boundary, kept_right, shift = 180.0, False, -360.0
             else:  # spills west: split at -180
-                kept = clip_ring(ring, -180.0, keep_right=True)
-                wrapped = clip_ring(ring, -180.0, keep_right=False)
-                shift = np.array([360.0, 0.0])
-            if kept is not None:
-                parts.append(Polygon(np.concatenate([kept, kept[:1]], axis=0)))
-            if wrapped is not None:
-                wrapped = wrapped + shift
+                boundary, kept_right, shift = -180.0, True, 360.0
+
+            def side(ring_, right):
+                return clip_ring(ring_, boundary, keep_right=right)
+
+            def close(r):
+                return np.concatenate([r, r[:1]], axis=0)
+
+            parts = []
+            for right, dx in ((kept_right, 0.0), (not kept_right, shift)):
+                shell = side(g.shell[:-1], right)
+                if shell is None:
+                    continue
+                holes = []
+                for h in g.holes:
+                    hc = side(h[:-1], right)
+                    if hc is not None:
+                        holes.append(close(hc + np.array([dx, 0.0])))
                 parts.append(
-                    Polygon(np.concatenate([wrapped, wrapped[:1]], axis=0))
+                    Polygon(close(shell + np.array([dx, 0.0])), tuple(holes))
                 )
             if not parts:
                 return g

@@ -141,7 +141,11 @@ class FileSystemDataStore:
         from geomesa_tpu.store.partitions import USER_DATA_KEY, scheme_for
 
         spec = sft.user_data.get(USER_DATA_KEY)
-        return scheme_for(str(spec)) if spec else None
+        if not spec:
+            return None
+        scheme = scheme_for(str(spec))
+        scheme.validate(sft)  # fail fast, before any writes are accepted
+        return scheme
 
     def _save_meta(self, name: str) -> None:
         st = self._types[name]

@@ -48,6 +48,10 @@ class PartitionScheme:
         Conservative: True when the scheme cannot tell."""
         raise NotImplementedError
 
+    def validate(self, sft) -> None:
+        """Fail fast at schema-bind time when the SFT cannot support the
+        scheme (checked by create_schema, before any writes)."""
+
 
 # -- datetime ----------------------------------------------------------------
 
@@ -76,6 +80,12 @@ class DateTimeScheme(PartitionScheme):
             raise ValueError(f"unknown datetime step {self.step!r}")
         self.spec = self.step
         self.depth = 1 if self.step == "weekly" else _STEPS[self.step][1]
+
+    def validate(self, sft) -> None:
+        if sft.dtg_field is None:
+            raise ValueError(
+                f"datetime partition scheme {self.step!r} needs a Date field"
+            )
 
     def _dtg_col(self, batch) -> np.ndarray:
         dtg = batch.sft.dtg_field
@@ -147,6 +157,14 @@ class Z2Scheme(PartitionScheme):
         self.spec = f"z2-{self.bits}bits"
         self.res = self.bits // 2  # bits per dimension
         self.digits = len(str((1 << self.bits) - 1))
+
+    def validate(self, sft) -> None:
+        geom = sft.geom_field
+        if geom is None or sft.descriptor(geom).type_name != "Point":
+            raise ValueError(
+                "z2 partition scheme requires a Point geometry field; "
+                "use an xz2 scheme for non-point geometries"
+            )
 
     def _cells(self, x, y) -> np.ndarray:
         n = 1 << self.res
@@ -278,6 +296,12 @@ class AttributeScheme(PartitionScheme):
     def __post_init__(self):
         self.spec = f"attribute:{self.attr}"
 
+    def validate(self, sft) -> None:
+        if self.attr not in sft.attribute_names:
+            raise ValueError(
+                f"attribute partition scheme: no attribute {self.attr!r}"
+            )
+
     def leaves(self, batch) -> np.ndarray:
         col = batch.column(self.attr)
         return np.array([_safe_leaf(v) for v in col], dtype=object)
@@ -302,6 +326,10 @@ class CompositeScheme(PartitionScheme):
         # (scheme_for accepts either separator)
         self.spec = ":".join(p.spec for p in parts)
         self.depth = sum(p.depth for p in parts)
+
+    def validate(self, sft) -> None:
+        for p in self.parts:
+            p.validate(sft)
 
     def leaves(self, batch) -> np.ndarray:
         per_part = [p.leaves(batch) for p in self.parts]

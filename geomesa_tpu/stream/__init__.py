@@ -11,15 +11,30 @@
               persistence (ref: geomesa-lambda LambdaDataStore)
 """
 
-from geomesa_tpu.stream.log import FeatureLog, Put, Remove, Clear
-from geomesa_tpu.stream.live import LiveFeatureStore
+from geomesa_tpu.stream.log import (
+    CacheLoader,
+    Clear,
+    FeatureLog,
+    FileFeatureLog,
+    PartitionedFeatureLog,
+    Put,
+    Remove,
+)
+from geomesa_tpu.stream.messages import decode_message, encode_message
+from geomesa_tpu.stream.live import LiveDataStore, LiveFeatureStore
 from geomesa_tpu.stream.lambda_store import LambdaDataStore
 
 __all__ = [
     "FeatureLog",
+    "FileFeatureLog",
+    "PartitionedFeatureLog",
+    "CacheLoader",
     "Put",
     "Remove",
     "Clear",
+    "encode_message",
+    "decode_message",
     "LiveFeatureStore",
+    "LiveDataStore",
     "LambdaDataStore",
 ]

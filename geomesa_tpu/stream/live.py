@@ -35,12 +35,16 @@ class LiveFeatureStore:
         log: "FeatureLog | None" = None,
         expiry_ms: "int | None" = None,
         clock: Callable = lambda: int(_time.time() * 1000),
+        standalone: bool = False,
     ):
+        import threading
+
         self.sft = sft
         # explicit None check: an empty FeatureLog is falsy (__len__ == 0)
-        self.log = log if log is not None else FeatureLog()
+        self.log = log if log is not None else (None if standalone else FeatureLog())
         self.expiry_ms = expiry_ms
         self.clock = clock
+        self._lock = threading.RLock()
         self._batch = FeatureBatch.from_columns(
             sft, {a.name: [] for a in sft.attributes}, fids=np.array([], dtype=object)
         )
@@ -48,8 +52,9 @@ class LiveFeatureStore:
         self._written_ms: np.ndarray = np.array([], dtype=np.int64)
         self._listeners: list = []
         self._offset = 0
-        self.replay()
-        self.log.subscribe(self._on_message)
+        if self.log is not None:
+            self.replay()
+            self.log.subscribe(self._on_message)
 
     # -- log application ---------------------------------------------------
 
@@ -61,20 +66,35 @@ class LiveFeatureStore:
             self._offset += 1
 
     def _on_message(self, offset: int, msg) -> None:
-        if offset < self._offset:
-            return
+        # the log invokes subscribers outside its own lock, so two
+        # producers' callbacks can arrive out of order; a gap means an
+        # earlier message is still in flight -- catch up from the log in
+        # offset order instead of applying (or worse, dropping) this one
+        with self._lock:
+            if offset < self._offset:
+                return
+            if offset == self._offset:
+                self._apply(msg)
+                self._offset = offset + 1
+            else:
+                self.replay()
+
+    def apply(self, msg) -> None:
+        """Externally-driven application (e.g. a CacheLoader's partition
+        consumer threads); safe under concurrent callers."""
         self._apply(msg)
-        self._offset = offset + 1
 
     def _apply(self, msg) -> None:
-        if isinstance(msg, Put):
-            batch = FeatureBatch.from_columns(self.sft, msg.columns, msg.fids)
-            self._upsert(batch)
-        elif isinstance(msg, Remove):
-            self._remove(np.asarray(msg.fids))
-        elif isinstance(msg, Clear):
-            self._rebuild(self._batch.take(np.array([], dtype=np.int64)))
-        for cb in self._listeners:
+        with self._lock:
+            if isinstance(msg, Put):
+                batch = FeatureBatch.from_columns(self.sft, msg.columns, msg.fids)
+                self._upsert(batch)
+            elif isinstance(msg, Remove):
+                self._remove(np.asarray(msg.fids))
+            elif isinstance(msg, Clear):
+                self._rebuild(self._batch.take(np.array([], dtype=np.int64)))
+            listeners = list(self._listeners)
+        for cb in listeners:
             cb(msg)
 
     def _upsert(self, batch: FeatureBatch) -> None:
@@ -133,34 +153,129 @@ class LiveFeatureStore:
 
     # -- write-side convenience (producer role) ----------------------------
 
+    def _require_log(self):
+        if self.log is None:
+            raise ValueError(
+                "standalone LiveFeatureStore is consumer-only: feed it "
+                "via apply() (e.g. from a CacheLoader), or construct it "
+                "with a log to produce"
+            )
+        return self.log
+
     def put(self, columns: dict, fids) -> None:
-        self.log.append(Put(columns, np.asarray(fids)))
+        self._require_log().append(Put(columns, np.asarray(fids)))
 
     def remove(self, fids) -> None:
-        self.log.append(Remove(np.asarray(fids)))
+        self._require_log().append(Remove(np.asarray(fids)))
 
     def clear(self) -> None:
-        self.log.append(Clear())
+        self._require_log().append(Clear())
 
     # -- queries & CQ ------------------------------------------------------
 
     def query(self, filt: "ast.Filter | str" = ast.Include) -> FeatureBatch:
-        self._expire()
-        f = parse_ecql(filt) if isinstance(filt, str) else filt
-        if len(self._batch) == 0:
-            return self._batch
-        mask = evaluate_host(f, self._batch)
-        return self._batch.take(np.nonzero(mask)[0])
+        with self._lock:
+            self._expire()
+            f = parse_ecql(filt) if isinstance(filt, str) else filt
+            if len(self._batch) == 0:
+                return self._batch
+            mask = evaluate_host(f, self._batch)
+            return self._batch.take(np.nonzero(mask)[0])
 
     def snapshot(self) -> FeatureBatch:
-        self._expire()
-        return self._batch
+        with self._lock:
+            self._expire()
+            # copy: _upsert mutates columns in place, so handing out the
+            # live arrays would let later writes tear a reader's rows
+            return self._batch.take(np.arange(len(self._batch)))
 
     def __len__(self) -> int:
-        self._expire()
-        return len(self._batch)
+        with self._lock:
+            self._expire()
+            return len(self._batch)
 
     def add_listener(self, callback: Callable) -> None:
         """Continuous query: callback(message) after each applied change
         (ref FeatureListener events)."""
-        self._listeners.append(callback)
+        with self._lock:
+            self._listeners.append(callback)
+
+
+class LiveDataStore:
+    """Multi-type live store (ref: KafkaDataStore -- one live layer per
+    feature type; producer writes go to the type's log, consumers keep the
+    queryable current-state cache). With ``root`` set, each type's log is
+    a durable FileFeatureLog that survives restarts (the topic-replay
+    recovery model)."""
+
+    def __init__(
+        self,
+        root: "str | None" = None,
+        expiry_ms: "int | None" = None,
+    ):
+        self.root = root
+        self.expiry_ms = expiry_ms
+        self._types: dict = {}
+        if root is not None:
+            import os
+
+            os.makedirs(root, exist_ok=True)
+            for name in sorted(os.listdir(root)):
+                if name.endswith(".sft"):
+                    with open(os.path.join(root, name)) as fh:
+                        spec = fh.read()
+                    self._open_type(
+                        SimpleFeatureType.create(name[:-4], spec)
+                    )
+
+    def _open_type(self, sft: SimpleFeatureType) -> None:
+        log = None
+        if self.root is not None:
+            import os
+
+            from geomesa_tpu.stream.log import FileFeatureLog
+
+            log = FileFeatureLog(
+                os.path.join(self.root, f"{sft.type_name}.log"), sft
+            )
+        self._types[sft.type_name] = LiveFeatureStore(
+            sft, log=log, expiry_ms=self.expiry_ms
+        )
+
+    def create_schema(self, sft: "SimpleFeatureType | str", spec: "str | None" = None):
+        if isinstance(sft, str):
+            sft = SimpleFeatureType.create(sft, spec)
+        if sft.type_name in self._types:
+            raise ValueError(f"schema {sft.type_name!r} exists")
+        if self.root is not None:
+            import os
+
+            with open(
+                os.path.join(self.root, f"{sft.type_name}.sft"), "w"
+            ) as fh:
+                fh.write(sft.spec)
+        self._open_type(sft)
+        return sft
+
+    def get_schema(self, type_name: str) -> SimpleFeatureType:
+        return self._types[type_name].sft
+
+    @property
+    def type_names(self) -> list:
+        return list(self._types)
+
+    def layer(self, type_name: str) -> LiveFeatureStore:
+        return self._types[type_name]
+
+    def write(self, type_name: str, columns: dict, fids) -> int:
+        self._types[type_name].put(columns, fids)
+        return len(np.asarray(fids))
+
+    def remove(self, type_name: str, fids) -> None:
+        self._types[type_name].remove(fids)
+
+    def query(self, type_name: str, filt=ast.Include) -> FeatureBatch:
+        return self._types[type_name].query(filt)
+
+    def add_listener(self, type_name: str, callback: Callable) -> None:
+        self._types[type_name].add_listener(callback)

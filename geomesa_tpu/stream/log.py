@@ -65,3 +65,157 @@ class FeatureLog:
     def __len__(self) -> int:
         with self._lock:
             return len(self.messages)
+
+
+class FileFeatureLog:
+    """Durable append-only log: length-prefixed GeoMessage records in a
+    single file (the single-broker durability analog; ref: Kafka topic
+    persistence + cache rebuild from replay). Reopening the file recovers
+    the full message history."""
+
+    def __init__(self, path: str, sft):
+        import os
+
+        from geomesa_tpu.stream.messages import decode_message
+
+        self.path = path
+        self.sft = sft
+        self._lock = threading.Lock()
+        self._subscribers: list = []
+        self.messages: list = []
+        if os.path.exists(path):
+            with open(path, "rb") as fh:
+                data = fh.read()
+            off = 0
+            import struct
+
+            while off + 4 <= len(data):
+                (n,) = struct.unpack_from("<I", data, off)
+                if off + 4 + n > len(data):
+                    break  # torn tail record (crash mid-append): drop it
+                self.messages.append(
+                    decode_message(sft, data[off + 4 : off + 4 + n])
+                )
+                off += 4 + n
+            if off < len(data):
+                # truncate the torn tail so future appends start clean
+                with open(path, "r+b") as fh:
+                    fh.truncate(off)
+        self._fh = open(path, "ab")
+
+    def append(self, msg) -> int:
+        import struct
+
+        from geomesa_tpu.stream.messages import encode_message
+
+        payload = encode_message(self.sft, msg)
+        with self._lock:
+            self._fh.write(struct.pack("<I", len(payload)))
+            self._fh.write(payload)
+            self._fh.flush()
+            self.messages.append(msg)
+            offset = len(self.messages) - 1
+            subs = list(self._subscribers)
+        for cb in subs:
+            cb(offset, msg)
+        return offset
+
+    def read_from(self, offset: int = 0) -> list:
+        with self._lock:
+            return self.messages[offset:]
+
+    def subscribe(self, callback: Callable) -> None:
+        with self._lock:
+            self._subscribers.append(callback)
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.messages)
+
+
+class PartitionedFeatureLog:
+    """N-partition log with fid-hash routing (ref: Kafka topic partitions
+    keyed by feature id -- same fid always lands in the same partition, so
+    per-fid ordering is preserved under parallel consumption)."""
+
+    def __init__(self, n_partitions: int = 4, make_log=FeatureLog):
+        if n_partitions < 1:
+            raise ValueError("need at least 1 partition")
+        self.partitions = [make_log() for _ in range(n_partitions)]
+
+    def _pidx(self, fid) -> int:
+        # stable across processes (unlike hash()) for durable logs
+        import zlib
+
+        return zlib.crc32(str(fid).encode("utf-8")) % len(self.partitions)
+
+    def append(self, msg) -> None:
+        if isinstance(msg, Put):
+            fids = np.asarray(msg.fids)
+            parts = np.array([self._pidx(f) for f in fids.tolist()])
+            for p in np.unique(parts):
+                rows = np.nonzero(parts == p)[0]
+                cols = {k: np.asarray(v)[rows] for k, v in msg.columns.items()}
+                self.partitions[p].append(Put(cols, fids[rows]))
+        elif isinstance(msg, Remove):
+            fids = np.asarray(msg.fids)
+            parts = np.array([self._pidx(f) for f in fids.tolist()])
+            for p in np.unique(parts):
+                self.partitions[p].append(
+                    Remove(fids[np.nonzero(parts == p)[0]])
+                )
+        elif isinstance(msg, Clear):
+            for part in self.partitions:
+                part.append(msg)
+
+    def __len__(self) -> int:
+        return sum(len(p) for p in self.partitions)
+
+
+class CacheLoader:
+    """Per-partition consumer threads applying a PartitionedFeatureLog to
+    a LiveFeatureStore (ref: KafkaCacheLoader's per-partition consumer
+    threads). Poll-based so it works with durable logs written by other
+    processes."""
+
+    def __init__(self, store, plog: PartitionedFeatureLog, poll_ms: int = 20):
+        self.store = store
+        self.plog = plog
+        self.poll_ms = poll_ms
+        self._offsets = [0] * len(plog.partitions)
+        self._stop = threading.Event()
+        self._threads: list = []
+
+    def _run(self, pidx: int) -> None:
+        log = self.plog.partitions[pidx]
+        while not self._stop.is_set():
+            msgs = log.read_from(self._offsets[pidx])
+            if msgs:
+                for m in msgs:
+                    self.store.apply(m)
+                self._offsets[pidx] += len(msgs)
+            else:
+                self._stop.wait(self.poll_ms / 1000.0)
+
+    def start(self) -> None:
+        for i in range(len(self.plog.partitions)):
+            t = threading.Thread(target=self._run, args=(i,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def catch_up(self) -> None:
+        """Drain all partitions synchronously (deterministic tests)."""
+        for i, log in enumerate(self.plog.partitions):
+            msgs = log.read_from(self._offsets[i])
+            for m in msgs:
+                self.store.apply(m)
+            self._offsets[i] += len(msgs)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads = []

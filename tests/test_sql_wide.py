@@ -144,17 +144,34 @@ def test_regressions_from_review(tmp_path):
         p.envelope.xmin >= -180 and p.envelope.xmax <= 180
         for p in safe.polygons
     )
-    # z2 scheme rejects non-point geometry fields
+    # z2 scheme rejects non-point geometry fields at schema-bind time,
+    # before any writes are accepted
     from geomesa_tpu.store.fs import FileSystemDataStore
     from geomesa_tpu.features.sft import SimpleFeatureType
 
     sft = SimpleFeatureType.create("z", "name:String,*geom:Polygon")
     sft.user_data["geomesa.fs.partition-scheme"] = "z2-4bit"
     zs = FileSystemDataStore(str(tmp_path / "zs"))
-    zs.create_schema(sft)
-    zs.write("z", {"name": ["p"], "geom": np.array([SQUARE], dtype=object)})
     with pytest.raises(ValueError, match="xz2"):
-        zs.flush("z")
+        zs.create_schema(sft)
+    # geohash precision means characters in both directions
+    gh9 = sql.st_geoHash(Point(2.35, 48.85), 9)
+    cell = sql.st_geomFromGeoHash(gh9, 9)
+    assert sql.st_contains(cell, Point(2.35, 48.85))
+    e = cell.envelope
+    assert (e.xmax - e.xmin) < 0.0001  # ~5m cell, not a truncated 11-degree one
+    # antimeridian split carries interior rings
+    outer = np.array(
+        [[175.0, 0.0], [185.0, 0.0], [185.0, 10.0], [175.0, 10.0], [175.0, 0.0]]
+    )
+    hole = np.array(
+        [[177.0, 4.0], [183.0, 4.0], [183.0, 6.0], [177.0, 6.0], [177.0, 4.0]]
+    )
+    donut = Polygon(outer, (hole,))
+    safe = sql.st_antimeridianSafeGeom(donut)
+    assert isinstance(safe, MultiPolygon)
+    assert abs(sql.st_area(safe) - sql.st_area(donut)) < 1e-6
+    assert not sql.st_intersects(safe, Point(179.0, 5.0))  # inside the hole
     # backslash-heavy user-data values survive the spec round-trip
     s2 = SimpleFeatureType.create("t", "name:String,*geom:Point")
     s2.user_data["a"] = "C:\\"

@@ -375,7 +375,12 @@ def keyspace_for(sft: SimpleFeatureType, name: str):
     if name == "id":
         return IdKeySpace()
     if name.startswith("attr:"):
-        return AttributeKeySpace(name.split(":", 1)[1])
+        attr = name.split(":", 1)[1]
+        if attr not in sft.attribute_names:
+            raise ValueError(
+                f"attribute index {name!r}: schema has no attribute {attr!r}"
+            )
+        return AttributeKeySpace(attr)
     raise ValueError(f"unknown index {name!r}")
 
 

@@ -1,0 +1,138 @@
+"""Bulk maintenance + ETL jobs (ref: geomesa-jobs -- GeoMesaInputFormat/
+OutputFormat MapReduce distributed ingest/export, index back-population,
+attribute re-index; and geomesa-tools LocalConverterIngest's thread pool
+[UNVERIFIED - empty reference mount]).
+
+The reference distributes these over MapReduce; here the same jobs run on
+host thread pools over files/partitions (numpy + pyarrow release the GIL
+for the heavy parts), with the store APIs doing the per-chunk work:
+
+- ``parallel_ingest``     -- converter thread pool over input files
+- ``parallel_export``     -- one output file per storage partition
+- ``backpopulate_index``  -- KV add-index + back-population wrapper
+- ``reindex``             -- FS primary-index rewrite wrapper
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+
+@dataclass
+class IngestReport:
+    files: int
+    success: int
+    failed: int
+    errors: "list[tuple[str, str]]"  # (path, error)
+
+
+def parallel_ingest(
+    store,
+    type_name: str,
+    converter_config: dict,
+    files: "list[str]",
+    workers: int = 4,
+) -> IngestReport:
+    """Ingest many files through a converter on a thread pool (ref:
+    LocalConverterIngest / DistributedConverterIngest). Each worker parses
+    independently; writes are serialized into the store under a lock (the
+    store's pending-batch list is not thread-safe)."""
+    from geomesa_tpu.convert import converter_for
+
+    sft = store.get_schema(type_name)
+    conv_factory = lambda: converter_for(converter_config, sft)  # noqa: E731
+    binary = getattr(conv_factory(), "binary", False)
+    lock = threading.Lock()
+    success = failed = 0
+    errors: list = []
+
+    def one(path: str):
+        nonlocal success, failed
+        conv = conv_factory()  # converters are cheap; avoid shared state
+        try:
+            with open(path, "rb" if binary else "r") as fh:
+                res = conv.process(fh.read())
+        except Exception as e:  # collect, don't kill the pool
+            with lock:
+                errors.append((path, str(e)))
+            return
+        with lock:
+            store.write(type_name, res.batch)
+            success += res.success
+            failed += res.failed
+
+    if workers <= 1 or len(files) <= 1:
+        for p in files:
+            one(p)
+    else:
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(one, files))
+    if hasattr(store, "flush"):
+        store.flush(type_name)
+    return IngestReport(len(files), success, failed, errors)
+
+
+def parallel_export(
+    store,
+    type_name: str,
+    query,
+    out_dir: str,
+    fmt: str = "parquet",
+    workers: int = 4,
+) -> "list[str]":
+    """Export query results as one file per storage partition (ref:
+    distributed export / GeoMesaOutputFormat). Stores without partitioned
+    scans produce a single file. Returns the written paths."""
+    os.makedirs(out_dir, exist_ok=True)
+    qp = getattr(store, "query_partitions", None)
+    if qp is not None:
+        batches = list(qp(type_name, query))
+    else:
+        b = store.query(type_name, query).batch
+        batches = [b] if len(b) else []
+
+    def write_one(args) -> str:
+        i, batch = args
+        path = os.path.join(out_dir, f"part-{i:05d}.{fmt}")
+        table = batch.to_arrow()
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            pq.write_table(table, path)
+        elif fmt == "orc":
+            import pyarrow.orc as orc
+
+            orc.write_table(table, path)
+        elif fmt == "arrow":
+            from geomesa_tpu.arrow_io import write_feature_stream
+
+            with open(path, "wb") as sink:
+                write_feature_stream(sink, [batch], sft=batch.sft)
+        elif fmt == "avro":
+            from geomesa_tpu.features.avro import write_avro
+
+            with open(path, "wb") as fh:
+                write_avro(fh, batch)
+        else:
+            raise ValueError(f"unknown export format {fmt!r}")
+        return path
+
+    jobs = list(enumerate(batches))
+    if workers <= 1 or len(jobs) <= 1:
+        return [write_one(j) for j in jobs]
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return list(pool.map(write_one, jobs))
+
+
+def backpopulate_index(store, type_name: str, index: str) -> int:
+    """Enable + back-populate an index on a KV store (ref: geomesa-jobs
+    index back-population). Returns rows written."""
+    return store.add_index(type_name, index)
+
+
+def reindex(store, type_name: str, primary: str) -> None:
+    """Rewrite an FS store's files under a different primary index."""
+    store.reindex(type_name, primary)

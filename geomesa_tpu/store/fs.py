@@ -133,18 +133,33 @@ class FileSystemDataStore:
             if meta.get("data_interval")
             else None,
             encoding=meta.get("encoding", "parquet"),
-            scheme=self._scheme_of(sft),
+            scheme=self._scheme_of(sft, strict=False),
         )
 
     @staticmethod
-    def _scheme_of(sft: SimpleFeatureType):
+    def _scheme_of(sft: SimpleFeatureType, strict: bool = True):
         from geomesa_tpu.store.partitions import USER_DATA_KEY, scheme_for
 
         spec = sft.user_data.get(USER_DATA_KEY)
         if not spec:
             return None
-        scheme = scheme_for(str(spec))
-        scheme.validate(sft)  # fail fast, before any writes are accepted
+        try:
+            scheme = scheme_for(str(spec))
+            scheme.validate(sft)
+        except ValueError:
+            if strict:  # create_schema: fail fast, before any writes
+                raise
+            # loading persisted state: an invalid scheme must not brick
+            # the whole catalog -- files stay readable via their recorded
+            # leaf paths, only leaf pruning is lost
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "type %r: invalid partition scheme %r ignored on load",
+                sft.type_name,
+                spec,
+            )
+            return None
         return scheme
 
     def _save_meta(self, name: str) -> None:
@@ -215,6 +230,17 @@ class FileSystemDataStore:
         data = batches[0] if len(batches) == 1 else FeatureBatch.concat(batches)
         st.pending = []
         ks = keyspace_for(st.sft, st.primary)
+        try:
+            self._write_sorted(type_name, st, ks, data)
+        except Exception:
+            # old files may already be gone -- keep the full dataset in
+            # memory as pending so a corrected retry loses nothing
+            st.pending = [data]
+            st.partitions = []
+            st.cache = {}
+            raise
+
+    def _write_sorted(self, type_name, st, ks, data) -> None:
         # drop old files, write new
         d = self._dir(type_name)
         for dirpath, _, files in os.walk(d):
@@ -288,6 +314,43 @@ class FileSystemDataStore:
         from geomesa_tpu.store.ageoff import age_off
 
         return age_off(self, type_name, self._types[type_name].sft, before_ms)
+
+    # -- maintenance jobs (ref geomesa-jobs index back-population) ---------
+
+    def _rebuild_files(self, type_name: str) -> None:
+        """Re-sort + re-write every partition file under the current
+        primary/scheme (pending data included)."""
+        st = self._types[type_name]
+        if st.partitions:
+            st.pending = [self._read_all(type_name)] + st.pending
+            st.partitions = []
+        self.flush(type_name)
+        self._save_meta(type_name)  # persists primary/scheme even when empty
+
+    def reindex(self, type_name: str, primary: str) -> None:
+        """Switch the primary index and rebuild the sorted files (ref:
+        geomesa-jobs attribute re-index / index back-population; here the
+        sort order IS the index, so re-indexing is a rewrite)."""
+        st = self._types[type_name]
+        keyspace_for(st.sft, primary)  # validate against the schema
+        st.primary = primary
+        self._rebuild_files(type_name)
+
+    def repartition(self, type_name: str, scheme_spec: "str | None") -> None:
+        """Change (or drop) the directory partition scheme and rewrite the
+        layout."""
+        from geomesa_tpu.store.partitions import USER_DATA_KEY, scheme_for
+
+        st = self._types[type_name]
+        if scheme_spec:
+            scheme = scheme_for(scheme_spec)
+            scheme.validate(st.sft)
+            st.sft.user_data[USER_DATA_KEY] = scheme.spec
+        else:
+            scheme = None
+            st.sft.user_data.pop(USER_DATA_KEY, None)
+        st.scheme = scheme
+        self._rebuild_files(type_name)
 
     def _read_partition(self, type_name: str, p: PartitionMeta) -> FeatureBatch:
         st = self._types[type_name]

@@ -109,6 +109,19 @@ _COL_ENC = {
 }
 
 
+def _keyspace_attrs(ks) -> set:
+    """The schema attributes a keyspace reads to build its keys."""
+    return {
+        a
+        for a in (
+            getattr(ks, "geom_field", None),
+            getattr(ks, "dtg_field", None),
+            getattr(ks, "attr", None),
+        )
+        if a is not None
+    }
+
+
 def _incr(key: bytes) -> "bytes | None":
     """Smallest byte string > every string with prefix ``key`` (None =
     unbounded: key was all 0xff)."""
@@ -373,6 +386,72 @@ class KVDataStore:
     @property
     def type_names(self) -> list:
         return sorted(self._types)
+
+    def indices(self, type_name: str) -> list:
+        return default_indices(self._types[type_name])
+
+    def add_index(self, type_name: str, index: str) -> int:
+        """Add an index to an existing schema and back-populate it from
+        stored data (ref: geomesa-jobs index back-population / attribute
+        re-index MapReduce jobs). Returns rows written. Value blobs are
+        copied straight from the id table; only the key attributes are
+        deserialized."""
+        sft = self._types[type_name]
+        current = default_indices(sft)
+        if index in current:
+            raise ValueError(f"index {index!r} already enabled")
+        ks = keyspace_for(sft, index)  # validates name against the schema
+        table = self._table(type_name, index)
+        self.backend.create_table(table)
+        id_table = self._table(type_name, "id")
+        # only the attributes the keyspace reads get deserialized
+        key_attrs = [
+            a for a in sft.attribute_names if a in _keyspace_attrs(ks)
+        ] or None
+        written = 0
+        buf: list = []
+
+        def flush() -> int:
+            if not buf:
+                return 0
+            blobs = [v for _, v in buf]
+            batch = deserialize_batch(sft, blobs, key_attrs)
+            shards = self._shard_of(batch.fids)
+            rows = self._row_keys(ks, batch, shards)
+            self.backend.write(table, list(zip(rows, blobs)))
+            n = len(buf)
+            buf.clear()
+            return n
+
+        try:
+            for k, v in self.backend.scan(id_table, b"", None):
+                buf.append((k, v))
+                if len(buf) >= 8192:
+                    written += flush()
+            written += flush()
+        except Exception:
+            # don't leave a half-built orphan table behind
+            self.backend.drop_table(table)
+            raise
+        # persist the new index list in the schema's user data
+        sft.user_data["geomesa.indices"] = ",".join([*current, index])
+        self._meta_put(f"{type_name}~attributes", sft.spec.encode("utf-8"))
+        return written
+
+    def remove_index(self, type_name: str, index: str) -> None:
+        """Disable and drop an index (the id index is load-bearing for
+        upserts/deletes and cannot be removed)."""
+        sft = self._types[type_name]
+        current = default_indices(sft)
+        if index not in current:
+            raise ValueError(f"index {index!r} not enabled")
+        if index == "id":
+            raise ValueError("the id index cannot be removed")
+        self.backend.drop_table(self._table(type_name, index))
+        sft.user_data["geomesa.indices"] = ",".join(
+            i for i in current if i != index
+        )
+        self._meta_put(f"{type_name}~attributes", sft.spec.encode("utf-8"))
 
     def remove_schema(self, type_name: str) -> None:
         sft = self._types.pop(type_name)

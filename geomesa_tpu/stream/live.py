@@ -50,6 +50,8 @@ class LiveFeatureStore:
         )
         self._row_of: dict = {}
         self._written_ms: np.ndarray = np.array([], dtype=np.int64)
+        self._seqs: np.ndarray = np.array([], dtype=np.int64)
+        self._clear_seq = -1  # highest Clear barrier seen (seq'd streams)
         self._listeners: list = []
         self._offset = 0
         if self.log is not None:
@@ -86,18 +88,35 @@ class LiveFeatureStore:
 
     def _apply(self, msg) -> None:
         with self._lock:
+            seq = getattr(msg, "seq", None)
             if isinstance(msg, Put):
+                if seq is not None and seq < self._clear_seq:
+                    return  # sequenced before an already-applied Clear
                 batch = FeatureBatch.from_columns(self.sft, msg.columns, msg.fids)
-                self._upsert(batch)
+                self._upsert(batch, seq if seq is not None else -1)
             elif isinstance(msg, Remove):
                 self._remove(np.asarray(msg.fids))
             elif isinstance(msg, Clear):
-                self._rebuild(self._batch.take(np.array([], dtype=np.int64)))
+                if seq is None:
+                    self._drop_rows(np.ones(len(self._batch), dtype=bool))
+                else:
+                    # barrier: wipe only rows written before this Clear --
+                    # a partition's late Clear must not erase newer puts
+                    self._clear_seq = max(self._clear_seq, seq)
+                    self._drop_rows(self._seqs < seq)
             listeners = list(self._listeners)
         for cb in listeners:
             cb(msg)
 
-    def _upsert(self, batch: FeatureBatch) -> None:
+    def _drop_rows(self, dead: np.ndarray) -> None:
+        if not np.any(dead):
+            return
+        keep = ~dead
+        self._written_ms = self._written_ms[keep]
+        self._seqs = self._seqs[keep]
+        self._rebuild(self._batch.take(np.nonzero(keep)[0]))
+
+    def _upsert(self, batch: FeatureBatch, seq: int = -1) -> None:
         now = self.clock()
         incoming = np.asarray(batch.fids)
         existing_rows = np.array(
@@ -111,6 +130,7 @@ class LiveFeatureStore:
             for name in self._batch.columns:
                 self._batch.columns[name][rows] = batch.columns[name][src]
             self._written_ms[rows] = now
+            self._seqs[rows] = seq
         if np.any(fresh):
             src = np.nonzero(fresh)[0]
             add = batch.take(src)
@@ -123,6 +143,9 @@ class LiveFeatureStore:
             self._written_ms = np.concatenate(
                 [self._written_ms, np.full(len(add), now, dtype=np.int64)]
             )
+            self._seqs = np.concatenate(
+                [self._seqs, np.full(len(add), seq, dtype=np.int64)]
+            )
             self._batch = merged
             for i, f in enumerate(add.fids.tolist()):
                 self._row_of[f] = base + i
@@ -131,25 +154,23 @@ class LiveFeatureStore:
         rows = [self._row_of[f] for f in fids.tolist() if f in self._row_of]
         if not rows:
             return
-        keep = np.ones(len(self._batch), dtype=bool)
-        keep[rows] = False
-        self._written_ms = self._written_ms[keep]
-        self._rebuild(self._batch.take(np.nonzero(keep)[0]))
+        dead = np.zeros(len(self._batch), dtype=bool)
+        dead[rows] = True
+        self._drop_rows(dead)
 
     def _rebuild(self, batch: FeatureBatch) -> None:
         self._batch = batch
         self._row_of = {f: i for i, f in enumerate(batch.fids.tolist())}
         if len(batch) != len(self._written_ms):
             self._written_ms = np.full(len(batch), self.clock(), dtype=np.int64)
+        if len(batch) != len(self._seqs):
+            self._seqs = np.full(len(batch), -1, dtype=np.int64)
 
     def _expire(self) -> None:
         if self.expiry_ms is None or len(self._batch) == 0:
             return
         cutoff = self.clock() - self.expiry_ms
-        dead = self._written_ms < cutoff
-        if np.any(dead):
-            self._written_ms = self._written_ms[~dead]
-            self._rebuild(self._batch.take(np.nonzero(~dead)[0]))
+        self._drop_rows(self._written_ms < cutoff)
 
     # -- write-side convenience (producer role) ----------------------------
 
@@ -279,3 +300,10 @@ class LiveDataStore:
 
     def add_listener(self, type_name: str, callback: Callable) -> None:
         self._types[type_name].add_listener(callback)
+
+    def close(self) -> None:
+        """Close every type's durable log file handle."""
+        for store in self._types.values():
+            close = getattr(store.log, "close", None)
+            if close is not None:
+                close()

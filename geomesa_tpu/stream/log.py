@@ -19,20 +19,26 @@ import numpy as np
 
 @dataclass(frozen=True)
 class Put:
-    """Upsert a batch of features (columns keyed by attribute)."""
+    """Upsert a batch of features (columns keyed by attribute). ``seq`` is
+    a producer-side global sequence stamped by PartitionedFeatureLog: it
+    orders messages ACROSS partitions (per-fid order within a partition is
+    already guaranteed), which is what makes a broadcast Clear a correct
+    barrier under parallel consumption."""
 
     columns: dict
     fids: np.ndarray
+    seq: "int | None" = None
 
 
 @dataclass(frozen=True)
 class Remove:
     fids: np.ndarray
+    seq: "int | None" = None
 
 
 @dataclass(frozen=True)
 class Clear:
-    pass
+    seq: "int | None" = None
 
 
 @dataclass
@@ -145,6 +151,13 @@ class PartitionedFeatureLog:
         if n_partitions < 1:
             raise ValueError("need at least 1 partition")
         self.partitions = [make_log() for _ in range(n_partitions)]
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+
+    def _next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
 
     def _pidx(self, fid) -> int:
         # stable across processes (unlike hash()) for durable logs
@@ -153,23 +166,26 @@ class PartitionedFeatureLog:
         return zlib.crc32(str(fid).encode("utf-8")) % len(self.partitions)
 
     def append(self, msg) -> None:
+        seq = self._next_seq()
         if isinstance(msg, Put):
             fids = np.asarray(msg.fids)
             parts = np.array([self._pidx(f) for f in fids.tolist()])
             for p in np.unique(parts):
                 rows = np.nonzero(parts == p)[0]
                 cols = {k: np.asarray(v)[rows] for k, v in msg.columns.items()}
-                self.partitions[p].append(Put(cols, fids[rows]))
+                self.partitions[p].append(Put(cols, fids[rows], seq=seq))
         elif isinstance(msg, Remove):
             fids = np.asarray(msg.fids)
             parts = np.array([self._pidx(f) for f in fids.tolist()])
             for p in np.unique(parts):
                 self.partitions[p].append(
-                    Remove(fids[np.nonzero(parts == p)[0]])
+                    Remove(fids[np.nonzero(parts == p)[0]], seq=seq)
                 )
         elif isinstance(msg, Clear):
+            # broadcast with one seq: consumers treat it as a barrier so a
+            # partition's late Clear cannot wipe puts sequenced after it
             for part in self.partitions:
-                part.append(msg)
+                part.append(Clear(seq=seq))
 
     def __len__(self) -> int:
         return sum(len(p) for p in self.partitions)

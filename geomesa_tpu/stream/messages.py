@@ -26,8 +26,9 @@ _PUT, _REMOVE, _CLEAR = 0, 1, 2
 
 def encode_message(sft: SimpleFeatureType, msg) -> bytes:
     buf = io.BytesIO()
+    seq = -1 if getattr(msg, "seq", None) is None else int(msg.seq)
     if isinstance(msg, Put):
-        buf.write(struct.pack("<BBB", MAGIC, VERSION, _PUT))
+        buf.write(struct.pack("<BBBq", MAGIC, VERSION, _PUT, seq))
         batch = FeatureBatch.from_columns(sft, msg.columns, msg.fids)
         rows = serialize_batch(batch)
         buf.write(struct.pack("<I", len(rows)))
@@ -35,26 +36,27 @@ def encode_message(sft: SimpleFeatureType, msg) -> bytes:
             buf.write(struct.pack("<I", len(r)))
             buf.write(r)
     elif isinstance(msg, Remove):
-        buf.write(struct.pack("<BBB", MAGIC, VERSION, _REMOVE))
+        buf.write(struct.pack("<BBBq", MAGIC, VERSION, _REMOVE, seq))
         fids = [str(f).encode("utf-8") for f in np.asarray(msg.fids).tolist()]
         buf.write(struct.pack("<I", len(fids)))
         for f in fids:
             buf.write(struct.pack("<H", len(f)))
             buf.write(f)
     elif isinstance(msg, Clear):
-        buf.write(struct.pack("<BBB", MAGIC, VERSION, _CLEAR))
+        buf.write(struct.pack("<BBBq", MAGIC, VERSION, _CLEAR, seq))
     else:
         raise TypeError(f"cannot encode {type(msg).__name__}")
     return buf.getvalue()
 
 
 def decode_message(sft: SimpleFeatureType, data: bytes):
-    magic, version, kind = struct.unpack_from("<BBB", data, 0)
+    magic, version, kind, raw_seq = struct.unpack_from("<BBBq", data, 0)
     if magic != MAGIC:
         raise ValueError("not a GeoMessage")
     if version != VERSION:
         raise ValueError(f"unsupported GeoMessage version {version}")
-    off = 3
+    seq = None if raw_seq < 0 else raw_seq
+    off = 11
     if kind == _PUT:
         (count,) = struct.unpack_from("<I", data, off)
         off += 4
@@ -65,7 +67,7 @@ def decode_message(sft: SimpleFeatureType, data: bytes):
             rows.append(data[off : off + n])
             off += n
         batch = deserialize_batch(sft, rows)
-        return Put(dict(batch.columns), batch.fids)
+        return Put(dict(batch.columns), batch.fids, seq=seq)
     if kind == _REMOVE:
         (count,) = struct.unpack_from("<I", data, off)
         off += 4
@@ -75,7 +77,7 @@ def decode_message(sft: SimpleFeatureType, data: bytes):
             off += 2
             fids.append(data[off : off + n].decode("utf-8"))
             off += n
-        return Remove(np.array(fids, dtype=object))
+        return Remove(np.array(fids, dtype=object), seq=seq)
     if kind == _CLEAR:
-        return Clear()
+        return Clear(seq=seq)
     raise ValueError(f"unknown GeoMessage type {kind}")

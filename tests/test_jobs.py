@@ -1,0 +1,201 @@
+"""Jobs: parallel ingest/export, KV index back-population, FS re-index."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.jobs import (
+    backpopulate_index,
+    parallel_export,
+    parallel_ingest,
+)
+from geomesa_tpu.store.fs import FileSystemDataStore
+from geomesa_tpu.store.kv import KVDataStore, MemoryKV
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+
+CSV_CONFIG = {
+    "type": "delimited-text",
+    "format": "csv",
+    "id-field": "$1",
+    "fields": [
+        {"name": "name", "transform": "$2"},
+        {"name": "dtg", "transform": "$3::long"},
+        {"name": "geom", "transform": "point($4::double, $5::double)"},
+    ],
+}
+
+
+def _write_csvs(tmp_path, n_files=6, rows=50):
+    files = []
+    k = 0
+    for i in range(n_files):
+        lines = []
+        for _ in range(rows):
+            lines.append(f"f{k},n{k % 3},{k * 1000},{(k % 360) - 180},{(k % 180) - 90}")
+            k += 1
+        p = tmp_path / f"in{i}.csv"
+        p.write_text("\n".join(lines) + "\n")
+        files.append(str(p))
+    return files
+
+
+def test_parallel_ingest(tmp_path):
+    ds = FileSystemDataStore(str(tmp_path / "store"))
+    ds.create_schema("t", SPEC)
+    files = _write_csvs(tmp_path)
+    rep = parallel_ingest(ds, "t", CSV_CONFIG, files, workers=4)
+    assert rep.files == 6 and rep.failed == 0 and not rep.errors
+    assert rep.success == 300
+    assert ds.count("t") == 300
+
+
+def test_parallel_ingest_collects_errors(tmp_path):
+    ds = FileSystemDataStore(str(tmp_path / "store"))
+    ds.create_schema("t", SPEC)
+    files = _write_csvs(tmp_path, n_files=2)
+    files.append(str(tmp_path / "missing.csv"))
+    rep = parallel_ingest(ds, "t", CSV_CONFIG, files, workers=2)
+    assert rep.success == 100
+    assert len(rep.errors) == 1 and "missing.csv" in rep.errors[0][0]
+
+
+def test_parallel_export_partition_files(tmp_path):
+    ds = FileSystemDataStore(str(tmp_path / "store"), partition_size=64)
+    ds.create_schema("t", SPEC)
+    files = _write_csvs(tmp_path, n_files=4, rows=100)
+    parallel_ingest(ds, "t", CSV_CONFIG, files, workers=2)
+    out = str(tmp_path / "export")
+    paths = parallel_export(ds, "t", "INCLUDE", out, fmt="parquet", workers=4)
+    assert len(paths) > 1
+    import pyarrow.parquet as pq
+
+    total = sum(pq.read_table(p).num_rows for p in paths)
+    assert total == 400
+
+
+def test_kv_backpopulate_attribute_index():
+    ds = KVDataStore(MemoryKV())
+    ds.create_schema("t", SPEC)
+    n = 500
+    rng = np.random.default_rng(2)
+    ds.write(
+        "t",
+        {
+            "name": rng.choice(["a", "b", "c"], n),
+            "dtg": rng.integers(0, 10**6, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    assert "attr:name" not in ds.indices("t")
+    written = backpopulate_index(ds, "t", "attr:name")
+    assert written == n
+    assert "attr:name" in ds.indices("t")
+    # the new index serves equality queries with real pruning
+    res = ds.query("t", "name = 'a'")
+    plan = ds.plan("t", "name = 'a'")
+    assert plan.index_name == "attr:name"
+    oracle = ds.query("t", "INCLUDE").batch
+    expected = (oracle.column("name") == "a").sum()
+    assert len(res) == expected
+    assert res.scanned < n  # actually pruned via the new index
+    # new writes maintain the new index too
+    ds.write(
+        "t",
+        {"name": ["a"], "dtg": [1], "geom": np.zeros((1, 2))},
+        fids=["extra"],
+    )
+    assert len(ds.query("t", "name = 'a'")) == expected + 1
+    # duplicate add rejected; id index protected
+    with pytest.raises(ValueError):
+        ds.add_index("t", "attr:name")
+    with pytest.raises(ValueError):
+        ds.remove_index("t", "id")
+    ds.remove_index("t", "attr:name")
+    assert "attr:name" not in ds.indices("t")
+    assert len(ds.query("t", "name = 'a'")) == expected + 1  # still correct
+
+
+def test_invalid_attr_index_rejected_without_damage(tmp_path):
+    # KV: unknown attribute rejected up front, no orphan table, writes fine
+    kv = KVDataStore(MemoryKV())
+    kv.create_schema("t", SPEC)
+    kv.write("t", {"name": ["a"], "dtg": [0], "geom": np.zeros((1, 2))}, ["f0"])
+    with pytest.raises(ValueError, match="no attribute"):
+        kv.add_index("t", "attr:nope")
+    kv.write("t", {"name": ["b"], "dtg": [0], "geom": np.zeros((1, 2))}, ["f1"])
+    assert len(kv.query("t", "INCLUDE")) == 2
+
+    # FS: invalid reindex raises before data is lost; store still queryable
+    fs = FileSystemDataStore(str(tmp_path))
+    fs.create_schema("t", SPEC)
+    fs.write("t", {"name": ["a"], "dtg": [0], "geom": np.zeros((1, 2))}, ["f0"])
+    fs.flush("t")
+    with pytest.raises(ValueError, match="no attribute"):
+        fs.reindex("t", "attr:nope")
+    assert fs.count("t") == 1
+    fs2 = FileSystemDataStore(str(tmp_path))  # reopen still works
+    assert fs2.count("t") == 1
+
+
+def test_fs_flush_failure_preserves_data(tmp_path, monkeypatch):
+    # if the rewrite fails mid-flush the dataset stays pending in memory
+    fs = FileSystemDataStore(str(tmp_path))
+    fs.create_schema("t", SPEC)
+    fs.write("t", {"name": ["a", "b"], "dtg": [0, 1], "geom": np.zeros((2, 2))},
+             ["f0", "f1"])
+    fs.flush("t")
+    import geomesa_tpu.store.fs as fsmod
+
+    def boom(*a, **k):
+        raise RuntimeError("disk full")
+
+    monkeypatch.setattr(fsmod, "_write_table", boom)
+    with pytest.raises(RuntimeError):
+        fs.reindex("t", "z2")
+    monkeypatch.undo()
+    # data still pending; a retry fully recovers it
+    fs.flush("t")
+    assert fs.count("t") == 2
+
+
+def test_fs_reindex_and_repartition(tmp_path):
+    ds = FileSystemDataStore(str(tmp_path), partition_size=128)
+    ds.create_schema("t", SPEC)
+    n = 1000
+    rng = np.random.default_rng(4)
+    ds.write(
+        "t",
+        {
+            "name": rng.choice(["a", "b"], n),
+            "dtg": rng.integers(0, 10**6, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    ds.flush("t")
+    before = sorted(ds.query("t", "BBOX(geom, 0, 0, 90, 90)").batch.fids)
+    ds.reindex("t", "z2")
+    assert ds._types["t"].primary == "z2"
+    after = sorted(ds.query("t", "BBOX(geom, 0, 0, 90, 90)").batch.fids)
+    np.testing.assert_array_equal(before, after)
+    # reopen: new primary persisted
+    ds2 = FileSystemDataStore(str(tmp_path))
+    assert ds2._types["t"].primary == "z2"
+    # repartition into an attribute layout
+    ds2.repartition("t", "attribute:name")
+    assert (tmp_path / "t" / "a").is_dir()
+    assert ds2.count("t") == n
+    res = ds2.query("t", "name = 'b'")
+    assert res.scanned < n  # leaf pruned
+    # drop the scheme again
+    ds2.repartition("t", None)
+    assert ds2.count("t") == n

@@ -192,6 +192,30 @@ def test_out_of_order_subscriber_delivery_not_dropped():
     assert len(store) == 4  # both applied, none dropped
 
 
+def test_clear_barrier_across_partitions():
+    # a partition's late Clear must not wipe puts sequenced after it
+    plog = PartitionedFeatureLog(4)
+    store = LiveFeatureStore(SFT, standalone=True)
+    plog.append(_put(4))          # seq 1
+    plog.append(Clear())          # seq 2, broadcast to all partitions
+    plog.append(_put(4, base=10))  # seq 3
+    # adversarial consumption order: fully drain one partition at a time
+    for log in plog.partitions:
+        for m in log.read_from(0):
+            store.apply(m)
+    assert sorted(store.snapshot().fids.tolist()) == [
+        "f10", "f11", "f12", "f13"
+    ]
+
+
+def test_clear_seq_survives_wire_codec():
+    msg = Clear(seq=42)
+    rt = decode_message(SFT, encode_message(SFT, msg))
+    assert rt.seq == 42
+    p = decode_message(SFT, encode_message(SFT, Put(_put(2).columns, _put(2).fids, seq=7)))
+    assert p.seq == 7
+
+
 def test_live_expiry_still_works_with_facade():
     clock = {"t": 1000}
     store = LiveFeatureStore(

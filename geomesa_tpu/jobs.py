@@ -97,27 +97,9 @@ def parallel_export(
     def write_one(args) -> str:
         i, batch = args
         path = os.path.join(out_dir, f"part-{i:05d}.{fmt}")
-        table = batch.to_arrow()
-        if fmt == "parquet":
-            import pyarrow.parquet as pq
+        from geomesa_tpu.export import write_batch
 
-            pq.write_table(table, path)
-        elif fmt == "orc":
-            import pyarrow.orc as orc
-
-            orc.write_table(table, path)
-        elif fmt == "arrow":
-            from geomesa_tpu.arrow_io import write_feature_stream
-
-            with open(path, "wb") as sink:
-                write_feature_stream(sink, [batch], sft=batch.sft)
-        elif fmt == "avro":
-            from geomesa_tpu.features.avro import write_avro
-
-            with open(path, "wb") as fh:
-                write_avro(fh, batch)
-        else:
-            raise ValueError(f"unknown export format {fmt!r}")
+        write_batch(batch, path, fmt)
         return path
 
     jobs = list(enumerate(batches))

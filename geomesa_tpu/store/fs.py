@@ -228,8 +228,10 @@ class FileSystemDataStore:
         if st.partitions:
             batches = [self._read_all(type_name)] + batches
         data = batches[0] if len(batches) == 1 else FeatureBatch.concat(batches)
-        st.pending = []
+        # resolve the keyspace BEFORE clearing pending: a bad primary must
+        # not drop the buffered writes
         ks = keyspace_for(st.sft, st.primary)
+        st.pending = []
         try:
             self._write_sorted(type_name, st, ks, data)
         except Exception:
@@ -302,7 +304,8 @@ class FileSystemDataStore:
         if not st.partitions:
             return 0
         data = self._read_all(type_name)
-        keep = ~np.isin(data.fids, np.asarray(fids))
+        # object dtype: a mixed int/str id list must not collapse to all-str
+        keep = ~np.isin(data.fids, np.asarray(list(fids), dtype=object))
         removed = int((~keep).sum())
         if removed:
             st.pending = [data.take(np.nonzero(keep)[0])]
@@ -314,6 +317,22 @@ class FileSystemDataStore:
         from geomesa_tpu.store.ageoff import age_off
 
         return age_off(self, type_name, self._types[type_name].sft, before_ms)
+
+    def update_user_data(self, type_name: str, updates: dict) -> None:
+        """Set (or, with None values, remove) schema user-data entries and
+        persist the manifest (ref: UpdateSftCommand / KeywordsCommand)."""
+        st = self._types[type_name]
+        for k, v in updates.items():
+            if v is None:
+                st.sft.user_data.pop(k, None)
+            else:
+                st.sft.user_data[k] = v
+        self._save_meta(type_name)
+
+    def compact(self, type_name: str) -> None:
+        """Rewrite all partition files merged + freshly sorted (ref:
+        geomesa-fs CompactCommand)."""
+        self._rebuild_files(type_name)
 
     # -- maintenance jobs (ref geomesa-jobs index back-population) ---------
 

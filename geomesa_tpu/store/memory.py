@@ -94,7 +94,8 @@ class MemoryDataStore:
         self._flush(st)
         if st.data is None:
             return 0
-        keep = ~np.isin(st.data.fids, np.asarray(fids))
+        # object dtype: a mixed int/str id list must not collapse to all-str
+        keep = ~np.isin(st.data.fids, np.asarray(list(fids), dtype=object))
         removed = int((~keep).sum())
         st.pending = [st.data.take(np.nonzero(keep)[0])]
         st.data = None

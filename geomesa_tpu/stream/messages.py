@@ -20,7 +20,7 @@ from geomesa_tpu.features.sft import SimpleFeatureType
 from geomesa_tpu.stream.log import Clear, Put, Remove
 
 MAGIC = 0x47  # 'G'
-VERSION = 1
+VERSION = 2  # v2 added the i64 seq field to the header
 _PUT, _REMOVE, _CLEAR = 0, 1, 2
 
 

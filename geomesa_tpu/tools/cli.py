@@ -12,6 +12,16 @@ Runner/IngestCommand/ExportCommand/ExplainCommand/StatsCommand):
     geomesa-tpu explain        --root DIR -f NAME -q CQL
     geomesa-tpu count          --root DIR -f NAME [-q CQL]
     geomesa-tpu stats          --root DIR -f NAME -s STAT_SPEC [-q CQL]
+    geomesa-tpu stats-count | stats-bounds | stats-top-k | stats-histogram
+                | stats-analyze   (canned stat reports)
+    geomesa-tpu delete-features --root DIR -f NAME (-q CQL | --ids a,b)
+    geomesa-tpu age-off        --root DIR -f NAME --before ISO [--dry-run]
+    geomesa-tpu keywords       --root DIR -f NAME [-a KW...] [-r KW...]
+    geomesa-tpu convert        -s SPEC -C converter.json [-F fmt] FILES...
+    geomesa-tpu reindex        --root DIR -f NAME --index z2
+    geomesa-tpu repartition    --root DIR -f NAME [--scheme daily,z2-2bit]
+    geomesa-tpu compact        --root DIR -f NAME
+    geomesa-tpu env | version
 
 The store root is a FileSystemDataStore directory (Parquet partitions +
 manifests); --root defaults to $GEOMESA_TPU_ROOT.
@@ -108,42 +118,19 @@ def cmd_export(args):
     )
     res = store.query(args.feature_name, q)
     batch = res.batch
-    out = args.output
-    fmt = args.format
+    _write_export(batch, args.output, args.format, args.track_attr)
+    print(f"exported {len(batch)} features to {args.output} ({args.format})")
+
+
+def _write_export(batch, out, fmt, track_attr):
     if fmt == "csv":
         _export_csv(batch, out)
     elif fmt == "json":
         _export_geojson(batch, out)
-    elif fmt == "arrow":
-        # typed geometry vectors + dictionary strings + SFT metadata
-        from geomesa_tpu.arrow_io import write_feature_stream
-
-        with open(out, "wb") as sink:
-            write_feature_stream(sink, [batch], sft=batch.sft)
-    elif fmt == "parquet":
-        import pyarrow.parquet as pq
-
-        pq.write_table(batch.to_arrow(), out)
-    elif fmt == "orc":
-        import pyarrow.orc as orc
-
-        orc.write_table(batch.to_arrow(), out)
-    elif fmt == "avro":
-        from geomesa_tpu.features.avro import write_avro
-
-        with open(out, "wb") as fh:
-            write_avro(fh, batch)
-    elif fmt == "bin":
-        from geomesa_tpu.process import encode_bin
-
-        if not args.track_attr:
-            sys.exit("error: --track-attr required for bin export")
-        data = encode_bin(batch, args.track_attr, sort=True)
-        with open(out, "wb") as fh:
-            fh.write(data)
     else:
-        sys.exit(f"error: unknown format {fmt!r}")
-    print(f"exported {len(batch)} features to {out} ({fmt})")
+        from geomesa_tpu.export import write_batch
+
+        write_batch(batch, out, fmt, track_attr)
 
 
 def _export_csv(batch, out):
@@ -232,6 +219,268 @@ def cmd_explain(args):
     print(store.explain(args.feature_name, args.cql))
 
 
+def cmd_version(args):
+    import geomesa_tpu
+
+    print(f"geomesa-tpu {geomesa_tpu.__version__}")
+
+
+def cmd_env(args):
+    """Print the effective environment: root, schemas, system properties
+    (ref: EnvironmentCommand)."""
+    import jax
+
+    import geomesa_tpu
+    from geomesa_tpu.conf import _DEFS, sys_prop
+
+    root = args.root or os.environ.get("GEOMESA_TPU_ROOT")
+    print(f"geomesa-tpu {geomesa_tpu.__version__}")
+    print(f"root: {root or '(unset)'}")
+    print(f"jax backend: {jax.default_backend()} ({jax.device_count()} devices)")
+    print("system properties:")
+    for name in sorted(_DEFS):
+        print(f"  geomesa.{name} = {sys_prop(name)}")
+    if root and os.path.isdir(root):
+        store = _store(args)
+        print("schemas:")
+        for name in store.type_names:
+            print(f"  {name}")
+
+
+def cmd_delete_features(args):
+    from geomesa_tpu.query.plan import internal_query
+
+    store = _store(args)
+    if args.cql:
+        res = store.query(args.feature_name, internal_query(args.cql))
+        fids = list(res.batch.fids)
+    elif args.ids:
+        # include both forms of numeric-looking ids so they match features
+        # stored with either integer or string fids
+        fids = []
+        for s in args.ids.split(","):
+            fids.append(s)
+            if s.lstrip("-").isdigit():
+                fids.append(int(s))
+    else:
+        sys.exit("error: delete-features needs -q CQL or --ids")
+    n = store.delete(args.feature_name, fids)
+    print(f"deleted {n} features")
+
+
+def cmd_age_off(args):
+    from geomesa_tpu.filter import ast
+    from geomesa_tpu.filter.ecql import parse_instant
+    from geomesa_tpu.query.plan import internal_query
+
+    store = _store(args)
+    before = parse_instant(args.before)
+    if args.dry_run:
+        dtg = store.get_schema(args.feature_name).dtg_field
+        if dtg is None:
+            sys.exit(f"error: {args.feature_name!r} has no Date field")
+        n = len(
+            store.query(
+                args.feature_name,
+                internal_query(ast.Compare("<", dtg, before)),
+            )
+        )
+        print(f"would remove {n} features (dry run)")
+    else:
+        n = store.age_off(args.feature_name, before)
+        print(f"removed {n} features")
+
+
+KEYWORDS_KEY = "geomesa.keywords"
+
+
+def cmd_keywords(args):
+    store = _store(args)
+    sft = store.get_schema(args.feature_name)
+    current = [
+        k for k in str(sft.user_data.get(KEYWORDS_KEY, "")).split(",") if k
+    ]
+    changed = False
+    if args.add:
+        for k in args.add:
+            if k not in current:
+                current.append(k)
+                changed = True
+    if args.remove:
+        current = [k for k in current if k not in args.remove]
+        changed = True
+    if changed:
+        store.update_user_data(
+            args.feature_name,
+            {KEYWORDS_KEY: ",".join(current) if current else None},
+        )
+    for k in current:
+        print(k)
+
+
+def cmd_convert(args):
+    """Standalone converter run: parse files and export without a store
+    (ref: ConvertCommand)."""
+    from geomesa_tpu.convert import converter_for
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.features.sft import SimpleFeatureType
+
+    sft = SimpleFeatureType.create(args.feature_name or "converted", args.spec)
+    with open(args.converter) as fh:
+        config = json.load(fh)
+    conv = converter_for(config, sft)
+    binary = getattr(conv, "binary", False)
+    batches = []
+    failed = 0
+    for path in args.files:
+        with open(path, "rb" if binary else "r") as fh:
+            res = conv.process(fh.read())
+        failed += res.failed
+        if len(res.batch):
+            batches.append(res.batch)
+    if not batches:
+        sys.exit("error: no features converted")
+    batch = batches[0] if len(batches) == 1 else FeatureBatch.concat(batches)
+    args.format = args.format or "csv"
+    _write_export(batch, args.output, args.format, None)
+    print(
+        f"converted {len(batch)} features ({failed} failed) "
+        f"to {args.output} ({args.format})",
+        file=sys.stderr,
+    )
+
+
+def cmd_reindex(args):
+    store = _store(args)
+    store.reindex(args.feature_name, args.index)
+    print(f"reindexed {args.feature_name!r} on {args.index!r}")
+
+
+def cmd_repartition(args):
+    store = _store(args)
+    store.repartition(args.feature_name, args.scheme or None)
+    print(f"repartitioned {args.feature_name!r} ({args.scheme or 'no scheme'})")
+
+
+def cmd_compact(args):
+    store = _store(args)
+    store.compact(args.feature_name)
+    print(f"compacted {args.feature_name!r}")
+
+
+
+def _stat_json(stat) -> dict:
+    """to_json, with bulky payloads (HLL registers) swapped for estimates."""
+    j = stat.to_json()
+    if j.get("type") == "cardinality":
+        j = {
+            "type": "cardinality",
+            "attr": j.get("attr"),
+            "estimate": round(float(stat.estimate), 1),
+        }
+    return j
+
+
+def _run_stat(args, spec: str, store=None):
+    from geomesa_tpu.process import run_stats
+
+    if store is None:
+        store = _store(args)
+    return run_stats(store, args.feature_name, args.cql or "INCLUDE", spec)
+
+
+def cmd_stats_count(args):
+    seq = _run_stat(args, "Count()")
+    print(json.dumps(seq.stats[0].to_json()))
+
+
+def cmd_stats_bounds(args):
+    store = _store(args)
+    sft = store.get_schema(args.feature_name)
+    attrs = (
+        args.attributes.split(",")
+        if args.attributes
+        else [
+            a.name
+            for a in sft.attributes
+            if a.type_name in ("Integer", "Long", "Double", "Float", "Date")
+        ]
+    )
+    if attrs:
+        # one combined spec -> one scan for every attribute
+        seq = _run_stat(
+            args, ";".join(f'MinMax("{a}")' for a in attrs), store=store
+        )
+        for a, st in zip(attrs, seq.stats):
+            print(f"{a}: {json.dumps(_stat_json(st))}")
+    geom = sft.geom_field
+    if geom is not None:
+        res = store.query(args.feature_name, args.cql or "INCLUDE")
+        col = res.batch.columns.get(geom)
+        if col is not None and len(col):
+            if col.dtype != object:
+                bbox = [col[:, 0].min(), col[:, 1].min(), col[:, 0].max(), col[:, 1].max()]
+            else:
+                e = col[0].envelope
+                for g in col[1:]:
+                    e = e.expand(g.envelope)
+                bbox = [e.xmin, e.ymin, e.xmax, e.ymax]
+            print(f"{geom}: bbox {[round(float(v), 6) for v in bbox]}")
+
+
+def cmd_stats_top_k(args):
+    seq = _run_stat(args, f'TopK("{args.attribute}",{args.k})')
+    print(json.dumps(seq.stats[0].to_json()))
+
+
+def cmd_stats_histogram(args):
+    store = _store(args)
+    if args.min is None or args.max is None:
+        mm = _run_stat(
+            args, f'MinMax("{args.attribute}")', store=store
+        ).stats[0].to_json()
+        lo = args.min if args.min is not None else mm["min"]
+        hi = args.max if args.max is not None else mm["max"]
+        if lo is None or hi is None:
+            sys.exit(
+                "error: no data to derive histogram bounds from; "
+                "pass --min/--max"
+            )
+    else:
+        lo, hi = args.min, args.max
+    seq = _run_stat(
+        args,
+        f'Histogram("{args.attribute}",{args.bins},{float(lo)},{float(hi)})',
+        store=store,
+    )
+    print(json.dumps(seq.stats[0].to_json()))
+
+
+def cmd_stats_analyze(args):
+    """Summary stats for every attribute (ref: stats-analyze). One scan:
+    all attributes' stats ride a single combined spec."""
+    store = _store(args)
+    sft = store.get_schema(args.feature_name)
+    pieces = ["Count()"]
+    layout = []  # (attr, n_stats) in order
+    for a in sft.attributes:
+        if a.is_geometry:
+            continue
+        if a.type_name in ("Integer", "Long", "Double", "Float", "Date"):
+            pieces += [f'MinMax("{a.name}")', f'Cardinality("{a.name}")']
+        else:
+            pieces += [f'Cardinality("{a.name}")', f'TopK("{a.name}",5)']
+        layout.append((a.name, 2))
+    seq = _run_stat(args, ";".join(pieces), store=store)
+    stats = list(seq.stats)
+    print(json.dumps(stats[0].to_json()))
+    i = 1
+    for name, n in layout:
+        group = stats[i : i + n]
+        i += n
+        print(f"{name}: " + "; ".join(json.dumps(_stat_json(st)) for st in group))
+
+
 def cmd_count(args):
     store = _store(args)
     print(store.count(args.feature_name, args.cql or "INCLUDE"))
@@ -296,6 +545,71 @@ def main(argv=None) -> None:
     sp.add_argument("-s", "--stat-spec", required=True)
     sp.add_argument("-q", "--cql")
 
+    add("version", cmd_version)
+    add("env", cmd_env)
+
+    sp = add("delete-features", cmd_delete_features)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-q", "--cql")
+    sp.add_argument("--ids", help="comma-separated feature ids")
+
+    sp = add("age-off", cmd_age_off)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("--before", required=True, help="ISO instant cutoff")
+    sp.add_argument("--dry-run", action="store_true")
+
+    sp = add("keywords", cmd_keywords)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-a", "--add", nargs="*")
+    sp.add_argument("-r", "--remove", nargs="*")
+
+    sp = add("convert", cmd_convert)
+    sp.add_argument("-f", "--feature-name")
+    sp.add_argument("-s", "--spec", required=True)
+    sp.add_argument("-C", "--converter", required=True)
+    sp.add_argument("-F", "--format",
+                    choices=["csv", "json", "arrow", "parquet", "orc", "avro"])
+    sp.add_argument("-o", "--output", default="-")
+    sp.add_argument("files", nargs="+")
+
+    sp = add("reindex", cmd_reindex)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("--index", required=True, help="z3|z2|xz3|xz2|id|attr:<name>")
+
+    sp = add("repartition", cmd_repartition)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("--scheme", help="partition scheme spec; omit to drop")
+
+    sp = add("compact", cmd_compact)
+    sp.add_argument("-f", "--feature-name", required=True)
+
+    sp = add("stats-count", cmd_stats_count)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-q", "--cql")
+
+    sp = add("stats-bounds", cmd_stats_bounds)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-q", "--cql")
+    sp.add_argument("-a", "--attributes", help="comma-separated attributes")
+
+    sp = add("stats-top-k", cmd_stats_top_k)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-q", "--cql")
+    sp.add_argument("-a", "--attribute", required=True)
+    sp.add_argument("-k", type=int, default=10)
+
+    sp = add("stats-histogram", cmd_stats_histogram)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-q", "--cql")
+    sp.add_argument("-a", "--attribute", required=True)
+    sp.add_argument("--bins", type=int, default=10)
+    sp.add_argument("--min", type=float)
+    sp.add_argument("--max", type=float)
+
+    sp = add("stats-analyze", cmd_stats_analyze)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-q", "--cql")
+
     args = p.parse_args(argv)
     try:
         args.fn(args)
@@ -303,3 +617,10 @@ def main(argv=None) -> None:
         sys.exit(f"error: unknown schema or attribute {e}")
     except (ValueError, FileNotFoundError) as e:
         sys.exit(f"error: {e}")
+    except BrokenPipeError:
+        # downstream pipe (head, less) closed early -- not an error
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        os._exit(0)

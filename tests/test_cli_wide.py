@@ -1,0 +1,129 @@
+"""New CLI commands: stats-*, age-off, keywords, convert, reindex, etc."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter.ecql import parse_instant
+from geomesa_tpu.store.fs import FileSystemDataStore
+from geomesa_tpu.tools.cli import main
+
+SPEC = "name:String,val:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+@pytest.fixture
+def store_root(tmp_path):
+    root = str(tmp_path / "store")
+    ds = FileSystemDataStore(root)
+    ds.create_schema("t", SPEC)
+    n = 300
+    rng = np.random.default_rng(1)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write(
+        "t",
+        {
+            "name": rng.choice(["a", "b", "c"], n),
+            "val": rng.integers(0, 100, n),
+            "dtg": t0 + rng.integers(0, 10**9, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    ds.flush("t")
+    return root
+
+
+def _run(root, *args, capsys=None):
+    main(["--root", root, *args])
+
+
+def test_version_and_env(store_root, capsys):
+    main(["version"])
+    out = capsys.readouterr().out
+    assert "geomesa-tpu" in out
+    main(["--root", store_root, "env"])
+    out = capsys.readouterr().out
+    assert "system properties" in out and "schemas:" in out and "t" in out
+
+
+def test_stats_commands(store_root, capsys):
+    main(["--root", store_root, "stats-count", "-f", "t"])
+    assert json.loads(capsys.readouterr().out)["count"] == 300
+
+    main(["--root", store_root, "stats-bounds", "-f", "t"])
+    out = capsys.readouterr().out
+    assert "val:" in out and "dtg:" in out and "geom: bbox" in out
+
+    main(["--root", store_root, "stats-top-k", "-f", "t", "-a", "name", "-k", "2"])
+    top = json.loads(capsys.readouterr().out)
+    assert len(top["counters"]) == 2
+
+    main(["--root", store_root, "stats-histogram", "-f", "t", "-a", "val",
+          "--bins", "5"])
+    h = json.loads(capsys.readouterr().out)
+    assert sum(h["counts"]) == 300
+
+    main(["--root", store_root, "stats-analyze", "-f", "t"])
+    out = capsys.readouterr().out
+    assert '"count": 300' in out and "name:" in out
+
+
+def test_delete_features_and_age_off(store_root, capsys):
+    main(["--root", store_root, "delete-features", "-f", "t", "--ids", "0,1,2"])
+    assert "deleted 3" in capsys.readouterr().out
+    main(["--root", store_root, "age-off", "-f", "t",
+          "--before", "2020-01-06T00:00:00", "--dry-run"])
+    out = capsys.readouterr().out
+    assert "dry run" in out
+    n_dry = int(out.split()[2])
+    main(["--root", store_root, "age-off", "-f", "t",
+          "--before", "2020-01-06T00:00:00"])
+    assert f"removed {n_dry}" in capsys.readouterr().out
+    main(["--root", store_root, "count", "-f", "t"])
+    assert int(capsys.readouterr().out) == 297 - n_dry
+
+
+def test_keywords_roundtrip(store_root, capsys):
+    main(["--root", store_root, "keywords", "-f", "t", "-a", "gdelt", "news"])
+    assert capsys.readouterr().out.split() == ["gdelt", "news"]
+    # persisted across store reopen
+    main(["--root", store_root, "keywords", "-f", "t"])
+    assert capsys.readouterr().out.split() == ["gdelt", "news"]
+    main(["--root", store_root, "keywords", "-f", "t", "-r", "news"])
+    assert capsys.readouterr().out.split() == ["gdelt"]
+
+
+def test_convert_standalone(tmp_path, capsys):
+    src = tmp_path / "in.csv"
+    src.write_text("a,1.0,2.0\nb,3.0,4.0\n")
+    conv = tmp_path / "conv.json"
+    conv.write_text(json.dumps({
+        "type": "delimited-text",
+        "format": "csv",
+        "id-field": "$1",
+        "fields": [
+            {"name": "name", "transform": "$1"},
+            {"name": "geom", "transform": "point($2::double, $3::double)"},
+        ],
+    }))
+    out = tmp_path / "out.parquet"
+    main(["convert", "-s", "name:String,*geom:Point", "-C", str(conv),
+          "-F", "parquet", "-o", str(out), str(src)])
+    import pyarrow.parquet as pq
+
+    assert pq.read_table(str(out)).num_rows == 2
+
+
+def test_reindex_repartition_compact_cli(store_root, capsys):
+    main(["--root", store_root, "reindex", "-f", "t", "--index", "z2"])
+    assert "reindexed" in capsys.readouterr().out
+    main(["--root", store_root, "repartition", "-f", "t",
+          "--scheme", "attribute:name"])
+    assert "repartitioned" in capsys.readouterr().out
+    main(["--root", store_root, "compact", "-f", "t"])
+    assert "compacted" in capsys.readouterr().out
+    main(["--root", store_root, "count", "-f", "t"])
+    assert int(capsys.readouterr().out) == 300

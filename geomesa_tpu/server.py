@@ -1,0 +1,172 @@
+"""HTTP serving bridge: WFS-shaped JSON + Arrow IPC endpoints.
+
+Ref role: geomesa-gs-plugin -- the GeoServer packaging that exposes stores
+over OGC protocols -- plus the WPS process endpoints (geomesa-process)
+[UNVERIFIED - empty reference mount]. The reference keeps the serving
+layer out of the query hot path (GeoServer calls the same DataStore API);
+this bridge does the same: a thin stdlib ThreadingHTTPServer over any
+store object, with all planning/scan work done by the store.
+
+Endpoints (all GET):
+
+- ``/capabilities``                 -- type names + schemas (GetCapabilities)
+- ``/features/<type>?cql=&maxFeatures=&properties=&f=geojson|arrow``
+                                     -- GetFeature; Arrow IPC when f=arrow
+- ``/count/<type>?cql=``            -- hit count
+- ``/explain/<type>?cql=``          -- query plan text
+- ``/density/<type>?cql=&bbox=&width=&height=`` -- heatmap grid (WPS
+  DensityProcess analog), JSON {"counts": [[...]], "bbox": [...]}
+
+Errors return JSON ``{"error": ...}`` with 4xx/5xx status.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store = None  # injected by make_server
+
+    # quiet default request logging; hook point for real deployments
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, doc) -> None:
+        self._send(code, json.dumps(doc).encode("utf-8"), "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+        try:
+            url = urlparse(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            q = {k: v[0] for k, v in parse_qs(url.query).items()}
+            if parts == ["capabilities"]:
+                return self._capabilities()
+            if len(parts) == 2 and parts[0] in (
+                "features", "count", "explain", "density"
+            ):
+                handler = getattr(self, f"_{parts[0]}")
+                return handler(unquote(parts[1]), q)
+            self._json(404, {"error": f"no such endpoint {url.path!r}"})
+        except KeyError as e:
+            self._json(404, {"error": f"unknown schema or attribute {e}"})
+        except ValueError as e:
+            self._json(400, {"error": str(e)})
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # pragma: no cover - defensive
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+    # -- endpoints ---------------------------------------------------------
+
+    def _capabilities(self) -> None:
+        doc = {"types": {}}
+        for name in self.store.type_names:
+            sft = self.store.get_schema(name)
+            doc["types"][name] = {
+                "spec": sft.spec,
+                "geometry": sft.geom_field,
+                "dtg": sft.dtg_field,
+                "attributes": [
+                    {"name": a.name, "type": a.type_name}
+                    for a in sft.attributes
+                ],
+            }
+        self._json(200, doc)
+
+    def _query(self, type_name: str, q: dict):
+        from geomesa_tpu.query.plan import Query
+
+        max_features = q.get("maxFeatures")
+        props = q.get("properties")
+        return self.store.query(
+            type_name,
+            Query(
+                filter=q.get("cql", "INCLUDE"),
+                max_features=int(max_features) if max_features else None,
+                properties=props.split(",") if props else None,
+            ),
+        )
+
+    def _features(self, type_name: str, q: dict) -> None:
+        res = self._query(type_name, q)
+        fmt = q.get("f", "geojson")
+        if fmt == "arrow":
+            from geomesa_tpu.arrow_io import write_feature_stream
+
+            sink = io.BytesIO()
+            write_feature_stream(sink, [res.batch], sft=res.batch.sft)
+            self._send(
+                200, sink.getvalue(), "application/vnd.apache.arrow.stream"
+            )
+        elif fmt == "geojson":
+            from geomesa_tpu.export import feature_collection
+
+            self._json(200, feature_collection(res.batch))
+        else:
+            self._json(400, {"error": f"unknown format {fmt!r}"})
+
+    def _count(self, type_name: str, q: dict) -> None:
+        res = self._query(type_name, q)
+        self._json(200, {"count": len(res)})
+
+    def _explain(self, type_name: str, q: dict) -> None:
+        text = self.store.explain(type_name, q.get("cql", "INCLUDE"))
+        self._send(200, text.encode("utf-8"), "text/plain")
+
+    def _density(self, type_name: str, q: dict) -> None:
+        from geomesa_tpu.process import density
+
+        if "bbox" not in q:
+            raise ValueError("density needs bbox=xmin,ymin,xmax,ymax")
+        bbox = tuple(float(v) for v in q["bbox"].split(","))
+        if len(bbox) != 4:
+            raise ValueError("bbox must be xmin,ymin,xmax,ymax")
+        width = int(q.get("width", 256))
+        height = int(q.get("height", 256))
+        from geomesa_tpu.geom import Envelope
+
+        grid = density(
+            self.store,
+            type_name,
+            q.get("cql", "INCLUDE"),
+            Envelope(*bbox),
+            width,
+            height,
+        )
+        self._json(
+            200,
+            {
+                "bbox": list(bbox),
+                "width": width,
+                "height": height,
+                "counts": grid.tolist(),
+            },
+        )
+
+
+def make_server(store, host: str = "127.0.0.1", port: int = 0):
+    """Build a ThreadingHTTPServer bound to (host, port); port 0 picks an
+    ephemeral port (see ``server.server_address``)."""
+    handler = type("BoundHandler", (_Handler,), {"store": store})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_background(store, host: str = "127.0.0.1", port: int = 0):
+    """Start serving on a daemon thread; returns (server, thread). Stop
+    with ``server.shutdown()``."""
+    server = make_server(store, host, port)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread

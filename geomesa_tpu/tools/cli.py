@@ -172,40 +172,9 @@ def _export_csv(batch, out):
 
 
 def _export_geojson(batch, out):
-    import numpy as np
+    from geomesa_tpu.export import feature_collection
 
-    geom = batch.sft.geom_field
-    features = []
-    for i in range(len(batch)):
-        props = {}
-        geometry = None
-        for name in batch.sft.attribute_names:
-            c = batch.columns[name]
-            desc = batch.sft.descriptor(name)
-            if name == geom:
-                if c.dtype != object:
-                    geometry = {
-                        "type": "Point",
-                        "coordinates": [float(c[i, 0]), float(c[i, 1])],
-                    }
-                else:
-                    from geomesa_tpu.geom import to_wkt
-
-                    geometry = {"wkt": to_wkt(c[i])}
-            elif desc.type_name == "Date":
-                props[name] = str(np.datetime64(int(c[i]), "ms"))
-            else:
-                v = c[i]
-                props[name] = v.item() if hasattr(v, "item") else v
-        features.append(
-            {
-                "type": "Feature",
-                "id": str(batch.fids[i]),
-                "geometry": geometry,
-                "properties": props,
-            }
-        )
-    doc = {"type": "FeatureCollection", "features": features}
+    doc = feature_collection(batch)
     if out == "-":
         json.dump(doc, sys.stdout)
         print()
@@ -481,6 +450,21 @@ def cmd_stats_analyze(args):
         print(f"{name}: " + "; ".join(json.dumps(_stat_json(st)) for st in group))
 
 
+
+def cmd_serve(args):
+    """Serve the store over HTTP (GeoServer-bridge analog)."""
+    from geomesa_tpu.server import make_server
+
+    store = _store(args)
+    server = make_server(store, args.host, args.port)
+    host, port = server.server_address[:2]
+    print(f"serving {store.root} on http://{host}:{port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
 def cmd_count(args):
     store = _store(args)
     print(store.count(args.feature_name, args.cql or "INCLUDE"))
@@ -609,6 +593,10 @@ def main(argv=None) -> None:
     sp = add("stats-analyze", cmd_stats_analyze)
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-q", "--cql")
+
+    sp = add("serve", cmd_serve)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8080)
 
     args = p.parse_args(argv)
     try:

@@ -1,0 +1,138 @@
+"""HTTP serving bridge: capabilities, features (geojson/arrow), count,
+explain, density."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter.ecql import parse_instant
+from geomesa_tpu.server import serve_background
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    ds = MemoryDataStore()
+    ds.create_schema("gdelt", SPEC)
+    n = 2000
+    rng = np.random.default_rng(17)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write(
+        "gdelt",
+        {
+            "name": rng.choice(["a", "b"], n),
+            "dtg": t0 + rng.integers(0, 10**8, n),
+            "geom": np.stack(
+                [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    server, _ = serve_background(ds)
+    host, port = server.server_address[:2]
+    yield f"http://{host}:{port}", ds
+    server.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read()
+
+
+def test_capabilities(server_url):
+    url, _ = server_url
+    status, ctype, body = _get(f"{url}/capabilities")
+    assert status == 200 and "json" in ctype
+    doc = json.loads(body)
+    assert "gdelt" in doc["types"]
+    assert doc["types"]["gdelt"]["geometry"] == "geom"
+
+
+def test_features_geojson_matches_store(server_url):
+    url, ds = server_url
+    cql = "BBOX(geom, -5, -5, 5, 5)"
+    status, _, body = _get(
+        f"{url}/features/gdelt?cql={urllib.request.quote(cql)}"
+    )
+    assert status == 200
+    doc = json.loads(body)
+    expected = len(ds.query("gdelt", cql))
+    assert len(doc["features"]) == expected
+    f0 = doc["features"][0]
+    assert f0["geometry"]["type"] == "Point"
+    assert set(f0["properties"]) == {"name", "dtg"}
+
+
+def test_features_arrow(server_url):
+    url, ds = server_url
+    status, ctype, body = _get(f"{url}/features/gdelt?f=arrow&maxFeatures=50")
+    assert status == 200 and "arrow" in ctype
+    import io
+
+    from geomesa_tpu.arrow_io import read_feature_stream
+
+    batches = list(read_feature_stream(io.BytesIO(body)))
+    assert sum(len(b) for b in batches) == 50
+
+
+def test_count_and_explain(server_url):
+    url, ds = server_url
+    cql = urllib.request.quote("name = 'a'")
+    status, _, body = _get(f"{url}/count/gdelt?cql={cql}")
+    assert status == 200
+    assert json.loads(body)["count"] == len(ds.query("gdelt", "name = 'a'"))
+    status, ctype, body = _get(f"{url}/explain/gdelt?cql={cql}")
+    assert status == 200 and "text/plain" in ctype
+    assert b"Chosen index" in body
+
+
+def test_density_grid(server_url):
+    url, ds = server_url
+    status, _, body = _get(
+        f"{url}/density/gdelt?bbox=-20,-20,20,20&width=16&height=8"
+    )
+    assert status == 200
+    doc = json.loads(body)
+    counts = np.asarray(doc["counts"])
+    assert counts.shape == (8, 16)
+    assert counts.sum() == 2000  # every point lands in the grid
+
+
+def test_nan_values_serialize_as_null():
+    from geomesa_tpu.export import feature_collection
+
+    ds = MemoryDataStore()
+    ds.create_schema("t", "v:Double,*geom:Point")
+    ds.write("t", {"v": [float("nan"), 1.5], "geom": np.zeros((2, 2))}, [0, 1])
+    doc = feature_collection(ds.query("t").batch)
+    text = json.dumps(doc)
+    json.loads(text)  # strict parse succeeds
+    assert "NaN" not in text
+    vals = sorted(
+        (f["properties"]["v"] is None, f["properties"]["v"]) for f in doc["features"]
+    )
+    assert vals[0][1] == 1.5 and vals[1][1] is None
+
+
+def test_errors(server_url):
+    url, _ = server_url
+    status, _, body = _get_allow_error(f"{url}/features/nope")
+    assert status == 404
+    status, _, body = _get_allow_error(f"{url}/features/gdelt?cql=BAD%20CQL(")
+    assert status == 400
+    status, _, body = _get_allow_error(f"{url}/bogus")
+    assert status == 404
+    status, _, body = _get_allow_error(f"{url}/density/gdelt")
+    assert status == 400 and b"bbox" in body
+
+
+def _get_allow_error(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.headers.get("Content-Type"), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type"), e.read()

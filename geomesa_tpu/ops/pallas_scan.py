@@ -246,32 +246,39 @@ def build_pallas_scan(
         tail = _valid_mask(n)
 
         def kernel(*refs):
-            # TPU grids run sequentially per core, so a single (1, 1) SMEM
+            # TPU grids run sequentially per core, so a single (1, LANES)
             # output revisited by every step is a race-free accumulator.
+            # Per-LANE partials, NOT a scalar: a scalar-output reduce takes
+            # Mosaic's proxy path, which re-traces jnp.sum at LOWERING
+            # time under the global dtype config -- with x64 enabled that
+            # injects an int64 convert Mosaic cannot lower. The axis-0
+            # reduce keeps a (1, LANES) vector and lowers directly.
             *in_refs, out_ref = refs
             m = tail(tile_fn({c: r[...] for c, r in zip(cols, in_refs)}))
 
             @pl.when(pl.program_id(0) == 0)
             def _():
-                out_ref[0, 0] = jnp.int32(0)
+                out_ref[...] = jnp.zeros((1, LANES), jnp.int32)
 
-            # dtypes pinned: under x64, weak python ints / default sum
-            # accumulators promote to (unsupported) 64-bit lanes
-            out_ref[0, 0] = out_ref[0, 0] + jnp.sum(
-                m.astype(jnp.int32), dtype=jnp.int32
+            out_ref[...] = out_ref[...] + jnp.sum(
+                m.astype(jnp.int32), axis=0, dtype=jnp.int32, keepdims=True
             )
 
-        total = pl.pallas_call(
-            kernel,
-            grid=(grid,),
-            in_specs=_in_specs,
-            out_specs=pl.BlockSpec(
-                (1, 1), lambda i: (_zero(), _zero()), memory_space=pltpu.SMEM
-            ),
-            out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
-            interpret=interpret,
-        )(*mats)
-        return total[0, 0]
+        from geomesa_tpu.jaxconf import scoped_x64_off
+
+        with scoped_x64_off():
+            partials = pl.pallas_call(
+                kernel,
+                grid=(grid,),
+                in_specs=_in_specs,
+                out_specs=pl.BlockSpec(
+                    (1, LANES), lambda i: (_zero(), _zero())
+                ),
+                out_shape=jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+                interpret=interpret,
+            )(*mats)
+        # final 128-way fold runs in XLA outside the kernel
+        return jnp.sum(partials, dtype=jnp.int32)
 
     def mask_fn(coldict):
         n, grid, pad, mats = _prep(coldict)
@@ -282,14 +289,17 @@ def build_pallas_scan(
             m = tail(tile_fn({c: r[...] for c, r in zip(cols, in_refs)}))
             out_ref[...] = m.astype(jnp.int8)
 
-        m = pl.pallas_call(
-            kernel,
-            grid=(grid,),
-            in_specs=_in_specs,
-            out_specs=pl.BlockSpec((br, LANES), lambda i: (i, _zero())),
-            out_shape=jax.ShapeDtypeStruct((grid * br, LANES), jnp.int8),
-            interpret=interpret,
-        )(*mats)
+        from geomesa_tpu.jaxconf import scoped_x64_off
+
+        with scoped_x64_off():
+            m = pl.pallas_call(
+                kernel,
+                grid=(grid,),
+                in_specs=_in_specs,
+                out_specs=pl.BlockSpec((br, LANES), lambda i: (i, _zero())),
+                out_shape=jax.ShapeDtypeStruct((grid * br, LANES), jnp.int8),
+                interpret=interpret,
+            )(*mats)
         return m.reshape(-1)[:n].astype(bool)
 
     return count_fn, mask_fn, cols

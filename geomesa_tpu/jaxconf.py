@@ -25,18 +25,3 @@ def require_x64() -> None:
 
     jax.config.update("jax_enable_x64", True)
     _enabled = True
-
-
-def scoped_x64_off():
-    """Context manager tracing a region with 32-bit defaults even when
-    require_x64() was called (Pallas TPU kernels must stay 64-bit-free).
-    Uses the config-state context managers jax exposes for exactly this
-    kind of scoping; falls back across jax versions."""
-    try:
-        from jax.experimental import disable_x64  # removed in newer jax
-
-        return disable_x64()
-    except ImportError:
-        from jax._src.config import enable_x64  # config State: ctx-manager
-
-        return enable_x64(False)

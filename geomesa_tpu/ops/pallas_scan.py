@@ -200,7 +200,6 @@ def build_pallas_scan(
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     cols = supported_columns(f, sft)
     _check(bool(cols), "no device columns (constant filter)")
@@ -264,19 +263,14 @@ def build_pallas_scan(
                 m.astype(jnp.int32), axis=0, dtype=jnp.int32, keepdims=True
             )
 
-        from geomesa_tpu.jaxconf import scoped_x64_off
-
-        with scoped_x64_off():
-            partials = pl.pallas_call(
-                kernel,
-                grid=(grid,),
-                in_specs=_in_specs,
-                out_specs=pl.BlockSpec(
-                    (1, LANES), lambda i: (_zero(), _zero())
-                ),
-                out_shape=jax.ShapeDtypeStruct((1, LANES), jnp.int32),
-                interpret=interpret,
-            )(*mats)
+        partials = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=_in_specs,
+            out_specs=pl.BlockSpec((1, LANES), lambda i: (_zero(), _zero())),
+            out_shape=jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+            interpret=interpret,
+        )(*mats)
         # final 128-way fold runs in XLA outside the kernel
         return jnp.sum(partials, dtype=jnp.int32)
 
@@ -289,17 +283,14 @@ def build_pallas_scan(
             m = tail(tile_fn({c: r[...] for c, r in zip(cols, in_refs)}))
             out_ref[...] = m.astype(jnp.int8)
 
-        from geomesa_tpu.jaxconf import scoped_x64_off
-
-        with scoped_x64_off():
-            m = pl.pallas_call(
-                kernel,
-                grid=(grid,),
-                in_specs=_in_specs,
-                out_specs=pl.BlockSpec((br, LANES), lambda i: (i, _zero())),
-                out_shape=jax.ShapeDtypeStruct((grid * br, LANES), jnp.int8),
-                interpret=interpret,
-            )(*mats)
+        m = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=_in_specs,
+            out_specs=pl.BlockSpec((br, LANES), lambda i: (i, _zero())),
+            out_shape=jax.ShapeDtypeStruct((grid * br, LANES), jnp.int8),
+            interpret=interpret,
+        )(*mats)
         return m.reshape(-1)[:n].astype(bool)
 
     return count_fn, mask_fn, cols

@@ -144,3 +144,28 @@ class TestPallasScanParity:
         cols = stage_columns(batch, cf.device_cols)
         got = np.asarray(jax.jit(cf.device_fn)(cols))
         np.testing.assert_array_equal(got, cf.host_mask(batch))
+
+    def test_float64_boundary_precision_preserved(self):
+        """On the CPU (x64) parity path the kernel must compare staged
+        float64 coordinate planes at full precision -- an implicit f32
+        truncation would flip sub-f32-ulp boundary comparisons against
+        the host oracle."""
+        from geomesa_tpu.features.batch import FeatureBatch
+        from geomesa_tpu.filter import ast
+        from geomesa_tpu.filter.compile import evaluate_host
+
+        sft = SimpleFeatureType.create("t", "*geom:Point")
+        # point above the box edge by 5e-10 in f64, identical in f32
+        xmax = float(np.float32(10.1)) - 1e-9
+        x = np.full(4, np.float32(10.1) - 5e-10, dtype=np.float64)
+        batch = FeatureBatch.from_columns(
+            sft, {"geom": np.stack([x, np.zeros(4)], axis=1)}, np.arange(4)
+        )
+        f = ast.BBox("geom", -20.0, -1.0, xmax, 1.0)
+        cf = compile_filter(f, sft)
+        cols = stage_columns(batch, cf.device_cols)
+        assert cols["geom__x"].dtype == np.float64
+        host = int(evaluate_host(f, batch).sum())
+        count_fn, mask_fn = cf.pallas_scan()
+        assert host == int(count_fn(cols)) == 0
+        assert int(np.asarray(mask_fn(cols)).sum()) == 0

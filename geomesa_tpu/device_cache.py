@@ -1,0 +1,143 @@
+"""Device-resident index: pin a type's scan columns in accelerator memory
+and serve repeated queries at memory bandwidth.
+
+Ref role: the tablet-server block cache + the rebuild plan's "device
+partition refresh" (SURVEY.md section 7.9) [UNVERIFIED - empty reference
+mount]. The reference keeps hot tablets in tablet-server RAM; here the hot
+partitions' columnar scan planes (float32 coords, int32/uint32 hi/lo
+planes) live in HBM, so a query is one fused kernel launch with no
+host->device transfer. The durable store stays the source of truth; the
+resident copy is a cache refreshed after writes (or driven by a live
+layer's listener).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.filter import ast
+from geomesa_tpu.ops.scan import stage_columns
+from geomesa_tpu.query.plan import internal_query
+
+
+def _stageable_planes(sft: SimpleFeatureType) -> list:
+    """Device column names for every attribute the scan kernels can read."""
+    planes: list = []
+    for a in sft.attributes:
+        if a.is_geometry:
+            if a.is_point:
+                planes += [f"{a.name}__x", f"{a.name}__y"]
+            continue
+        dtype = a.column_dtype
+        if dtype == np.int64:
+            planes += [f"{a.name}__hi", f"{a.name}__lo"]
+        elif dtype in (np.float32, np.float64, np.int32):
+            planes.append(a.name)
+    return planes
+
+
+class DeviceIndex:
+    """Resident scan cache over one store type.
+
+    >>> di = DeviceIndex(store, "gdelt")
+    >>> di.count("BBOX(geom, -10, 35, 30, 60) AND dtg DURING ...")
+    >>> batch = di.query(...)        # mask on device, take on host
+    >>> store.write(...); store.flush(...); di.refresh()
+    """
+
+    def __init__(self, store, type_name: str, columns: "list[str] | None" = None):
+        self.store = store
+        self.type_name = type_name
+        self.sft = store.get_schema(type_name)
+        self._planes = columns or _stageable_planes(self.sft)
+        self._host_batch = None
+        self._cols = None
+        self._compiled: dict = {}
+        self.refresh()
+
+    # -- cache lifecycle ---------------------------------------------------
+
+    def refresh(self) -> None:
+        """Re-stage from the backing store (after writes / age-off)."""
+        res = self.store.query(self.type_name, internal_query(ast.Include))
+        self._host_batch = res.batch
+        self._cols = stage_columns(self._host_batch, self._planes)
+        self._compiled = {}
+
+    def __len__(self) -> int:
+        return len(self._host_batch)
+
+    @property
+    def nbytes(self) -> int:
+        """Resident device bytes."""
+        return int(sum(v.nbytes for v in self._cols.values()))
+
+    def attach_live(self, live_store) -> None:
+        """Refresh on every applied live-layer change (coarse; the
+        streaming refinement is per-partition donation)."""
+        live_store.add_listener(lambda _msg: self.refresh())
+
+    # -- queries -----------------------------------------------------------
+
+    def _compiled_for(self, query):
+        from geomesa_tpu.filter.compile import compile_filter
+        from geomesa_tpu.filter.ecql import parse_ecql
+
+        f = parse_ecql(query) if isinstance(query, str) else query
+        key = repr(f)
+        if key not in self._compiled:
+            import jax
+
+            compiled = compile_filter(f, self.sft)
+            missing = [c for c in compiled.device_cols if c not in self._cols]
+            if missing:
+                raise ValueError(
+                    f"columns {missing} not resident; construct DeviceIndex "
+                    f"with columns= including them"
+                )
+            scan = (
+                compiled.pallas_scan()
+                if jax.devices()[0].platform == "tpu"
+                else None
+            )
+            count_fn = jax.jit(
+                scan[0]
+                if scan
+                else (lambda c, _fn=compiled.device_fn: _fn(c).sum())
+            )
+            mask_fn = jax.jit(scan[1] if scan else compiled.device_fn)
+            self._compiled[key] = (compiled, count_fn, mask_fn)
+        return self._compiled[key]
+
+    def _resident_subset(self, compiled) -> dict:
+        return {c: self._cols[c] for c in compiled.device_cols}
+
+    def count(self, query) -> int:
+        """Fused device count; exact when the filter is fully on-device,
+        else falls through to query()."""
+        compiled, count_fn, _ = self._compiled_for(query)
+        if not compiled.device_cols:
+            return int(compiled.host_mask(self._host_batch).sum())
+        if not compiled.fully_on_device:
+            return len(self.query(query))
+        return int(count_fn(self._resident_subset(compiled)))
+
+    def mask(self, query) -> np.ndarray:
+        """Boolean hit mask over the resident rows."""
+        compiled, _, mask_fn = self._compiled_for(query)
+        if not compiled.device_cols:
+            return compiled.host_mask(self._host_batch)
+        m = np.asarray(mask_fn(self._resident_subset(compiled)))
+        if not compiled.fully_on_device:
+            idx = np.nonzero(m)[0]
+            if len(idx):
+                keep = compiled.residual_mask(self._host_batch.take(idx))
+                out = np.zeros(len(m), dtype=bool)
+                out[idx[keep]] = True
+                return out
+        return m
+
+    def query(self, query):
+        """FeatureBatch of hits (host-side take over the device mask)."""
+        return self._host_batch.take(np.nonzero(self.mask(query))[0])

@@ -81,3 +81,63 @@ def write_batch(batch, path: str, fmt: str, track_attr: "str | None" = None):
             fh.write(encode_bin(batch, track_attr, sort=True))
     else:
         raise ValueError(f"unknown export format {fmt!r}")
+
+
+LEAFLET_TEMPLATE = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8"/>
+<title>{title}</title>
+<link rel="stylesheet" href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
+<script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
+<style>html, body, #map {{ height: 100%; margin: 0; }}</style>
+</head>
+<body>
+<div id="map"></div>
+<script>
+var data = {geojson};
+var map = L.map('map');
+L.tileLayer('https://tile.openstreetmap.org/{{z}}/{{x}}/{{y}}.png',
+            {{maxZoom: 19, attribution: '&copy; OpenStreetMap'}}).addTo(map);
+var layer = L.geoJSON(data, {{
+  pointToLayer: function (f, latlng) {{
+    return L.circleMarker(latlng, {{radius: 4}});
+  }},
+  onEachFeature: function (f, l) {{
+    var rows = Object.entries(f.properties || {{}}).map(
+      function (e) {{ return '<b>' + e[0] + '</b>: ' + e[1]; }});
+    l.bindPopup('<b>id</b>: ' + f.id + '<br/>' + rows.join('<br/>'));
+  }}
+}}).addTo(map);
+if (data.features.length) {{ map.fitBounds(layer.getBounds().pad(0.2)); }}
+else {{ map.setView([0, 0], 2); }}
+</script>
+</body>
+</html>
+"""
+
+
+def write_leaflet_html(batch, path, title: str = "geomesa-tpu") -> None:
+    """Standalone Leaflet HTML map with the batch embedded as GeoJSON
+    (ref: geomesa-spark-jupyter-leaflet's L.map integration [UNVERIFIED -
+    empty reference mount]). ``path`` may be a filesystem path or a
+    text file object. Feature data is untrusted: string values are
+    HTML-escaped (popups render via innerHTML) and the embedded JSON
+    escapes '</' so a value cannot terminate the script element."""
+    import html as _html
+    import json
+
+    doc = feature_collection(batch)
+    for f in doc["features"]:
+        f["id"] = _html.escape(str(f["id"]))
+        f["properties"] = {
+            _html.escape(str(k)): _html.escape(v) if isinstance(v, str) else v
+            for k, v in f["properties"].items()
+        }
+    payload = json.dumps(doc).replace("</", "<\\/")
+    out = LEAFLET_TEMPLATE.format(title=_html.escape(title), geojson=payload)
+    if hasattr(path, "write"):
+        path.write(out)
+    else:
+        with open(path, "w") as fh:
+            fh.write(out)

@@ -14,6 +14,12 @@ import numpy as np
 from geomesa_tpu.features.batch import FeatureBatch
 
 
+def _default_platform() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
 def stage_columns(
     batch: FeatureBatch,
     names: "list[str]",
@@ -45,6 +51,11 @@ def stage_columns(
             arr = batch.column(name)[start:stop]
         if dtype is not None and arr.dtype.kind == "f":
             arr = arr.astype(dtype)
+        if arr.dtype == np.float64 and _default_platform() == "tpu":
+            # TPU storage format is float32 lanes (README design stance):
+            # the chip has no f64, and under x64 a float64 operand cannot
+            # feed the Mosaic kernels. Explicit, not a silent jnp downcast.
+            arr = arr.astype(np.float32)
         if arr.dtype in (np.int64, np.uint64):
             # Residual int64 columns (non-split callers) need x64 lanes, else
             # jax silently downcasts to int32 and ms literals overflow.

@@ -127,6 +127,14 @@ def _write_export(batch, out, fmt, track_attr):
         _export_csv(batch, out)
     elif fmt == "json":
         _export_geojson(batch, out)
+    elif fmt == "leaflet":
+        from geomesa_tpu.export import write_leaflet_html
+
+        write_leaflet_html(
+            batch,
+            sys.stdout if out == "-" else out,
+            title=batch.sft.type_name,
+        )
     else:
         from geomesa_tpu.export import write_batch
 
@@ -510,7 +518,7 @@ def main(argv=None) -> None:
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-q", "--cql")
     sp.add_argument("-F", "--format", default="csv",
-                    choices=["csv", "json", "arrow", "parquet", "orc", "bin", "avro"])
+                    choices=["csv", "json", "arrow", "parquet", "orc", "bin", "avro", "leaflet"])
     sp.add_argument("-o", "--output", default="-")
     sp.add_argument("-m", "--max-features", type=int)
     sp.add_argument("-a", "--attributes", help="comma-separated projection")

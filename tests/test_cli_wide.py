@@ -127,3 +127,43 @@ def test_reindex_repartition_compact_cli(store_root, capsys):
     assert "compacted" in capsys.readouterr().out
     main(["--root", store_root, "count", "-f", "t"])
     assert int(capsys.readouterr().out) == 300
+
+
+def test_leaflet_export(store_root, tmp_path, capsys):
+    out = str(tmp_path / "map.html")
+    main(["--root", store_root, "export", "-f", "t",
+          "-q", "BBOX(geom, -50, -50, 50, 50)", "-F", "leaflet", "-o", out])
+    html = open(out).read()
+    assert html.startswith("<!DOCTYPE html>")
+    assert "L.geoJSON" in html and "FeatureCollection" in html
+    import json as _json
+
+    start = html.index("var data = ") + len("var data = ")
+    end = html.index(";\nvar map")
+    doc = _json.loads(html[start:end])
+    assert len(doc["features"]) > 0
+
+
+def test_leaflet_export_escapes_hostile_values(tmp_path):
+    from geomesa_tpu.export import write_leaflet_html
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    import numpy as _np
+
+    sft = SimpleFeatureType.create("t", "name:String,*geom:Point")
+    batch = FeatureBatch.from_columns(
+        sft,
+        {
+            "name": ["</script><script>alert(1)</script>", "<img onerror=x>"],
+            "geom": _np.zeros((2, 2)),
+        },
+        ["</script>evil", "ok"],
+    )
+    out = tmp_path / "m.html"
+    write_leaflet_html(batch, str(out), title="<b>t</b>")
+    html = out.read_text()
+    assert "</script><script>alert" not in html  # cannot break out of JSON
+    assert "<img onerror" not in html  # popup values escaped
+    assert "<b>t</b>" not in html  # title escaped
+    # well-formed: exactly the two template script elements close
+    assert html.count("</script>") == 2

@@ -1,0 +1,115 @@
+"""Device-resident index: pinned columns, repeated queries, refresh."""
+
+import numpy as np
+
+from geomesa_tpu.device_cache import DeviceIndex
+from geomesa_tpu.filter.compile import evaluate_host
+from geomesa_tpu.filter.ecql import parse_ecql, parse_instant
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "name:String,val:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def _store(n=20000, seed=23):
+    ds = MemoryDataStore()
+    ds.create_schema("t", SPEC)
+    rng = np.random.default_rng(seed)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    ds.write(
+        "t",
+        {
+            "name": rng.choice(["a", "b", "c"], n),
+            "val": rng.integers(0, 100, n),
+            "dtg": rng.integers(t0, t1, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    return ds
+
+
+def test_resident_count_and_query_match_oracle():
+    ds = _store()
+    di = DeviceIndex(ds, "t")
+    assert len(di) == 20000 and di.nbytes > 0
+    all_batch = ds.query("t").batch
+    for ecql in [
+        "BBOX(geom, -10, 35, 30, 60) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-02-01T00:00:00Z",
+        "val >= 50 AND BBOX(geom, 0, 0, 90, 90)",
+        "BBOX(geom, -180, -90, 180, 90)",
+    ]:
+        expect = evaluate_host(parse_ecql(ecql), all_batch)
+        assert di.count(ecql) == int(expect.sum()), ecql
+        got = di.query(ecql)
+        np.testing.assert_array_equal(
+            np.sort(got.fids), np.sort(all_batch.fids[expect])
+        )
+
+
+def test_residual_filters_still_exact():
+    ds = _store(n=2000)
+    di = DeviceIndex(ds, "t")
+    # string equality is not a device predicate -> residual path
+    ecql = "name = 'a' AND BBOX(geom, -90, -45, 90, 45)"
+    all_batch = ds.query("t").batch
+    expect = evaluate_host(parse_ecql(ecql), all_batch)
+    assert di.count(ecql) == int(expect.sum())
+    np.testing.assert_array_equal(
+        np.sort(di.query(ecql).fids), np.sort(all_batch.fids[expect])
+    )
+
+
+def test_refresh_after_write():
+    ds = _store(n=100)
+    di = DeviceIndex(ds, "t")
+    assert di.count("INCLUDE") == 100
+    ds.write(
+        "t",
+        {
+            "name": ["z"],
+            "val": [1],
+            "dtg": [parse_instant("2020-01-15T00:00:00")],
+            "geom": np.array([[1.0, 2.0]]),
+        },
+        fids=["extra"],
+    )
+    assert di.count("INCLUDE") == 100  # stale until refresh
+    di.refresh()
+    assert di.count("INCLUDE") == 101
+
+
+def test_attach_live_refreshes():
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.stream import LiveFeatureStore
+
+    sft = SimpleFeatureType.create("t", SPEC)
+    live = LiveFeatureStore(sft)
+
+    class LiveAdapter:
+        """Minimal store facade over the live layer for DeviceIndex."""
+
+        def get_schema(self, _):
+            return sft
+
+        def query(self, _, q=None):
+            from geomesa_tpu.query.runner import QueryResult
+
+            b = live.snapshot()
+            return QueryResult(b, None, len(b), len(b))
+
+    di = DeviceIndex(LiveAdapter(), "t")
+    di.attach_live(live)
+    live.put(
+        {
+            "name": ["a"],
+            "val": [5],
+            "dtg": [0],
+            "geom": np.array([[3.0, 4.0]]),
+        },
+        ["f0"],
+    )
+    assert di.count("INCLUDE") == 1  # listener refreshed the residency

@@ -59,11 +59,12 @@ class DeviceIndex:
     # -- cache lifecycle ---------------------------------------------------
 
     def refresh(self) -> None:
-        """Re-stage from the backing store (after writes / age-off)."""
+        """Re-stage from the backing store (after writes / age-off).
+        Compiled filters are data-independent and persist; jit re-compiles
+        on its own if the row count changes shape."""
         res = self.store.query(self.type_name, internal_query(ast.Include))
         self._host_batch = res.batch
         self._cols = stage_columns(self._host_batch, self._planes)
-        self._compiled = {}
 
     def __len__(self) -> int:
         return len(self._host_batch)
@@ -73,10 +74,20 @@ class DeviceIndex:
         """Resident device bytes."""
         return int(sum(v.nbytes for v in self._cols.values()))
 
-    def attach_live(self, live_store) -> None:
+    def attach_live(self, live_store):
         """Refresh on every applied live-layer change (coarse; the
-        streaming refinement is per-partition donation)."""
-        live_store.add_listener(lambda _msg: self.refresh())
+        streaming refinement is per-partition donation). Returns a
+        zero-arg detach callable that unregisters the listener, releasing
+        this index for garbage collection."""
+        listener = lambda _msg: self.refresh()  # noqa: E731
+        live_store.add_listener(listener)
+
+        def detach() -> None:
+            remove = getattr(live_store, "remove_listener", None)
+            if remove is not None:
+                remove(listener)
+
+        return detach
 
     # -- queries -----------------------------------------------------------
 
@@ -87,8 +98,6 @@ class DeviceIndex:
         f = parse_ecql(query) if isinstance(query, str) else query
         key = repr(f)
         if key not in self._compiled:
-            import jax
-
             compiled = compile_filter(f, self.sft)
             missing = [c for c in compiled.device_cols if c not in self._cols]
             if missing:
@@ -96,17 +105,7 @@ class DeviceIndex:
                     f"columns {missing} not resident; construct DeviceIndex "
                     f"with columns= including them"
                 )
-            scan = (
-                compiled.pallas_scan()
-                if jax.devices()[0].platform == "tpu"
-                else None
-            )
-            count_fn = jax.jit(
-                scan[0]
-                if scan
-                else (lambda c, _fn=compiled.device_fn: _fn(c).sum())
-            )
-            mask_fn = jax.jit(scan[1] if scan else compiled.device_fn)
+            count_fn, mask_fn = compiled.jitted_scan()
             self._compiled[key] = (compiled, count_fn, mask_fn)
         return self._compiled[key]
 

@@ -434,6 +434,28 @@ class CompiledFilter:
                 self._pallas[key] = None
         return self._pallas[key]
 
+    def jitted_scan(self):
+        """(count_fn, mask_fn), jitted, choosing the Pallas tile kernels on
+        real TPUs and XLA-fused jnp elsewhere (interpret-mode pallas would
+        crawl) or when the filter isn't tileable. The single source of the
+        kernel-selection rule (used by the query runner and DeviceIndex);
+        cached per CompiledFilter."""
+        if not hasattr(self, "_jitted_scan"):
+            import jax
+
+            scan = (
+                self.pallas_scan()
+                if jax.devices()[0].platform == "tpu"
+                else None
+            )
+            if scan is not None:
+                count_fn, mask_fn = jax.jit(scan[0]), jax.jit(scan[1])
+            else:
+                mask_fn = jax.jit(self.device_fn)
+                count_fn = jax.jit(lambda c: self.device_fn(c).sum())
+            self._jitted_scan = (count_fn, mask_fn)
+        return self._jitted_scan
+
     def host_mask(self, batch: FeatureBatch) -> np.ndarray:
         """Exact full-filter mask (oracle path)."""
         return evaluate_host(self.filter, batch)

@@ -31,6 +31,28 @@ class QueryResult:
         return len(self.batch)
 
 
+MAX_RUN_PARTS = 8
+
+
+def _contiguous_runs(parts) -> "list[tuple[int, int]]":
+    """Merge adjacent surviving partitions into [start, stop) runs: the
+    predicate is elementwise, so one staging + one kernel launch per run
+    instead of per partition (a BatchScanner coalescing its ranges).
+    Runs cap at MAX_RUN_PARTS partitions so the set of kernel shapes --
+    and therefore jit recompiles across differently-pruned queries --
+    stays small."""
+    runs: list = []
+    counts: list = []
+    for p in parts:
+        if runs and runs[-1][1] == p.start and counts[-1] < MAX_RUN_PARTS:
+            runs[-1][1] = p.stop
+            counts[-1] += 1
+        else:
+            runs.append([p.start, p.stop])
+            counts.append(1)
+    return [(a, b) for a, b in runs]
+
+
 def run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
     import jax
 
@@ -43,28 +65,21 @@ def run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
         use_device = bool(compiled.device_cols)
         jitted = None
         if use_device:
-            # Pallas tile kernel on real TPUs; XLA-fused jnp elsewhere
-            # (interpret-mode pallas would crawl) or when not tileable
-            scan = (
-                compiled.pallas_scan()
-                if jax.devices()[0].platform == "tpu"
-                else None
-            )
-            jitted = jax.jit(scan[1] if scan else compiled.device_fn)
-        for p in parts:
+            _, jitted = compiled.jitted_scan()
+        for start, stop in _contiguous_runs(parts):
             if use_device:
                 cols = stage_columns(
-                    built.batch, compiled.device_cols, p.start, p.stop
+                    built.batch, compiled.device_cols, start, stop
                 )
                 mask = np.asarray(jitted(cols))
             else:
-                mask = np.ones(p.stop - p.start, dtype=bool)
+                mask = np.ones(stop - start, dtype=bool)
             idx = np.nonzero(mask)[0]
             if len(idx) and not compiled.fully_on_device:
-                cand = built.batch.take(idx + p.start)
+                cand = built.batch.take(idx + start)
                 idx = idx[compiled.residual_mask(cand)]
             if len(idx):
-                hit_chunks.append(idx + p.start)
+                hit_chunks.append(idx + start)
 
     if hit_chunks:
         rows = np.concatenate(hit_chunks)

@@ -221,6 +221,11 @@ class LiveFeatureStore:
         with self._lock:
             self._listeners.append(callback)
 
+    def remove_listener(self, callback: Callable) -> None:
+        with self._lock:
+            if callback in self._listeners:
+                self._listeners.remove(callback)
+
 
 class LiveDataStore:
     """Multi-type live store (ref: KafkaDataStore -- one live layer per

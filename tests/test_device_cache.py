@@ -113,3 +113,34 @@ def test_attach_live_refreshes():
         ["f0"],
     )
     assert di.count("INCLUDE") == 1  # listener refreshed the residency
+
+
+def test_detach_live_listener():
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.stream import LiveFeatureStore
+
+    sft = SimpleFeatureType.create("t", SPEC)
+    live = LiveFeatureStore(sft)
+
+    calls = []
+
+    class Adapter:
+        def get_schema(self, _):
+            return sft
+
+        def query(self, _, q=None):
+            from geomesa_tpu.query.runner import QueryResult
+
+            calls.append(1)
+            b = live.snapshot()
+            return QueryResult(b, None, len(b), len(b))
+
+    di = DeviceIndex(Adapter(), "t")
+    detach = di.attach_live(live)
+    live.put({"name": ["a"], "val": [1], "dtg": [0],
+              "geom": np.zeros((1, 2))}, ["f0"])
+    n_after_put = len(calls)
+    detach()
+    live.put({"name": ["b"], "val": [2], "dtg": [0],
+              "geom": np.zeros((1, 2))}, ["f1"])
+    assert len(calls) == n_after_put  # no refresh after detach

@@ -254,37 +254,63 @@ def serialize_batch(batch: FeatureBatch) -> "list[bytes]":
     return out
 
 
+def _decode_column_py(sft, feats, name) -> np.ndarray:
+    attr = sft.descriptor(name)
+    vals = [f.get(name) for f in feats]
+    if attr.is_point:
+        return np.array(
+            [(p.x, p.y) for p in vals], dtype=np.float64
+        ).reshape(len(vals), 2)
+    if attr.is_geometry:
+        return np.array(vals, dtype=object)
+    if attr.type_name == "Date":
+        return np.array(vals, dtype=np.int64)
+    if attr.column_dtype is not None:
+        return np.array(vals, dtype=attr.column_dtype)
+    return np.array(vals, dtype=object)
+
+
 def deserialize_batch(
     sft: SimpleFeatureType,
     rows: "list[bytes]",
     columns: "list[str] | None" = None,
+    use_native: bool = True,
 ) -> FeatureBatch:
     """Rebuild a columnar batch from value blobs. ``columns`` projects to a
     subset without decoding the rest (the projecting-reader transform path);
     the resulting batch still carries the full SFT with unrequested columns
-    absent."""
+    absent. Columns decode through the C++ batch pass (native/binser.cpp)
+    when available, with per-column Python fallback for anything it cannot
+    handle (non-point geometry, Bytes, nulls in numeric columns)."""
     from geomesa_tpu.security import VIS_USER_DATA
 
     ser = FeatureSerializer(sft)
-    feats = [ser.lazy(r) for r in rows]
     want = columns if columns is not None else [a.name for a in sft.attributes]
+
     cols: dict = {}
-    for name in want:
-        attr = sft.descriptor(name)
-        vals = [f.get(name) for f in feats]
-        if attr.is_point:
-            cols[name] = np.array(
-                [(p.x, p.y) for p in vals], dtype=np.float64
-            ).reshape(len(vals), 2)
-        elif attr.is_geometry:
-            cols[name] = np.array(vals, dtype=object)
-        elif attr.type_name == "Date":
-            cols[name] = np.array(vals, dtype=np.int64)
-        elif attr.column_dtype is not None:
-            cols[name] = np.array(vals, dtype=attr.column_dtype)
-        else:
-            cols[name] = np.array(vals, dtype=object)
-    fids = np.array([f.fid for f in feats])
+    fids = None
+    feats = None
+    ud_rows = None  # row indices carrying a user-data section
+
+    from geomesa_tpu import native
+
+    nat = (
+        native.binser_decode(sft, rows, want)
+        if native.enabled(use_native)
+        else None
+    )
+    if nat is not None:
+        nat_cols, fids, flags = nat
+        cols = {k: v for k, v in nat_cols.items() if v is not None}
+        ud_rows = np.nonzero(flags & 2)[0]
+    missing = [name for name in want if name not in cols]
+    if missing or fids is None:
+        feats = [ser.lazy(r) for r in rows]
+    for name in missing:
+        cols[name] = _decode_column_py(sft, feats, name)
+    if fids is None:
+        fids = np.array([f.fid for f in feats])
+
     if columns is not None:
         sub = SimpleFeatureType(
             sft.type_name,
@@ -294,7 +320,19 @@ def deserialize_batch(
         batch = FeatureBatch(sub, fids, cols)
     else:
         batch = FeatureBatch(sft, fids, cols)
-    vis = [f.user_data.get(VIS_USER_DATA, "") for f in feats]
-    if any(vis):
-        batch = batch.with_visibility(vis)
+
+    if ud_rows is not None:
+        if len(ud_rows):
+            # only the flagged rows parse their user-data section; the
+            # native pass already decoded everything else
+            vis = [""] * len(rows)
+            for i in ud_rows:
+                f = feats[i] if feats is not None else ser.lazy(rows[i])
+                vis[i] = f.user_data.get(VIS_USER_DATA, "")
+            if any(vis):
+                batch = batch.with_visibility(vis)
+    else:
+        vis = [f.user_data.get(VIS_USER_DATA, "") for f in feats]
+        if any(vis):
+            batch = batch.with_visibility(vis)
     return batch

@@ -53,9 +53,15 @@ def get_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and os.path.exists(
-            os.path.join(_LIB_DIR, "zorder.cpp")
-        ):
+        sources = [
+            os.path.join(_LIB_DIR, f)
+            for f in os.listdir(_LIB_DIR)
+            if f.endswith(".cpp")
+        ] if os.path.isdir(_LIB_DIR) else []
+        stale = os.path.exists(_LIB_PATH) and any(
+            os.path.getmtime(s) > os.path.getmtime(_LIB_PATH) for s in sources
+        )
+        if (not os.path.exists(_LIB_PATH) or stale) and sources:
             _build()
         if not os.path.exists(_LIB_PATH):
             return None
@@ -88,6 +94,25 @@ def get_lib():
             ctypes.c_int64,
         ]
         lib.gm_zranges.restype = ctypes.c_int64
+        try:
+            # newer symbols: a stale prebuilt .so may lack them -- degrade
+            # to no-binser rather than poisoning every native entry point
+            _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            _u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+            lib.binser_headers.argtypes = [
+                ctypes.c_char_p, _u64p, ctypes.c_int64, ctypes.c_int32,
+                _u64p, _i64p, _u64p, _u32p, _u8p,
+            ]
+            lib.binser_headers.restype = ctypes.c_int
+            lib.binser_column.argtypes = [
+                ctypes.c_char_p, _u64p, _u64p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_void_p, _u64p, _u32p, _u8p,
+            ]
+            lib.binser_column.restype = ctypes.c_int
+            lib._has_binser = True
+        except AttributeError:
+            lib._has_binser = False
         _lib = lib
         return _lib
 
@@ -157,3 +182,106 @@ def zranges_native(qlo, qhi, bits_per_dim, max_ranges, max_bits=-1):
         IndexRange(int(out_lo[i]), int(out_hi[i]), bool(out_c[i]))
         for i in range(n)
     ]
+
+
+# -- binary feature row batch decode (native/binser.cpp) ---------------------
+
+# attribute type -> (column code, numpy dtype); strings use span outputs
+_BINSER_CODES = {
+    "Integer": (0, np.int64),
+    "Long": (0, np.int64),
+    "Date": (0, np.int64),
+    "Float": (1, np.float32),
+    "Double": (2, np.float64),
+    "Boolean": (3, np.uint8),
+}
+
+
+def binser_decode(sft, rows, want):
+    """Decode value blobs columnar via the C++ pass.
+
+    Returns ``(cols, fids, flags)`` where cols maps requested attribute
+    names to numpy arrays (strings decoded from spans; None for columns
+    the native path cannot decode -- non-point geometry, Bytes, or
+    numeric columns containing nulls), fids is the id array, and flags
+    per row carries bit1 = has user-data. Returns None when the native
+    library is unavailable or a row is malformed (caller falls back)."""
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_has_binser", False) or not rows:
+        return None
+    attrs = {a.name: (i, a) for i, a in enumerate(sft.attributes)}
+    n = len(rows)
+    n_attrs = len(sft.attributes)
+    data = b"".join(rows)
+    row_off = np.zeros(n + 1, dtype=np.uint64)
+    np.cumsum([len(r) for r in rows], out=row_off[1:])
+    payload_base = np.empty(n, dtype=np.uint64)
+    fids_int = np.empty(n, dtype=np.int64)
+    fid_off = np.empty(n, dtype=np.uint64)
+    fid_len = np.empty(n, dtype=np.uint32)
+    flags = np.empty(n, dtype=np.uint8)
+    rc = lib.binser_headers(
+        data, row_off, n, n_attrs, payload_base, fids_int, fid_off, fid_len,
+        flags,
+    )
+    if rc != 0:
+        return None
+    if np.any(flags & 1):  # string fids: build from spans
+        fids = np.empty(n, dtype=object)
+        for i in range(n):
+            if flags[i] & 1:
+                o, l = int(fid_off[i]), int(fid_len[i])
+                fids[i] = data[o : o + l].decode("utf-8")
+            else:
+                fids[i] = int(fids_int[i])
+    else:
+        fids = fids_int.copy()
+
+    cols: dict = {}
+    nulls = np.empty(n, dtype=np.uint8)
+    str_off = np.empty(n, dtype=np.uint64)
+    str_len = np.empty(n, dtype=np.uint32)
+
+    def run(attr_i, code, out):
+        ptr = out.ctypes.data_as(ctypes.c_void_p) if out is not None else None
+        return lib.binser_column(
+            data, row_off, payload_base, n, n_attrs, attr_i, code,
+            ptr, str_off, str_len, nulls,
+        )
+
+    for name in want:
+        attr_i, a = attrs[name]
+        if a.is_point:
+            out = np.empty((n, 2), dtype=np.float64)
+            if run(attr_i, 4, out) != 0 or nulls.any():
+                cols[name] = None
+                continue
+            cols[name] = out
+        elif a.type_name in ("String", "UUID"):
+            if run(attr_i, 5, None) != 0:
+                cols[name] = None
+                continue
+            vals = np.empty(n, dtype=object)
+            for i in range(n):
+                if nulls[i]:
+                    vals[i] = None
+                else:
+                    o, l = int(str_off[i]), int(str_len[i])
+                    vals[i] = data[o : o + l].decode("utf-8")
+            cols[name] = vals
+        elif a.type_name in _BINSER_CODES:
+            code, _ = _BINSER_CODES[a.type_name]
+            out = np.zeros(
+                n, dtype=np.int64 if code == 0 else _BINSER_CODES[a.type_name][1]
+            )
+            if run(attr_i, code, out) != 0 or nulls.any():
+                cols[name] = None  # nulls: defer to the python decoder
+                continue
+            if a.type_name == "Integer":
+                out = out.astype(np.int32)
+            elif a.type_name == "Boolean":
+                out = out.astype(bool)  # matches COLUMN_DTYPES['Boolean']
+            cols[name] = out
+        else:
+            cols[name] = None  # geometry (non-point) / Bytes
+    return cols, fids, flags

@@ -1,0 +1,158 @@
+// Native batch decoder for the binary feature row format
+// (geomesa_tpu/features/binser.py -- the KryoFeatureSerializer-analog KV
+// value layout). Decodes whole columns across many rows in one pass: the
+// KV-store scan hot loop (ref role: the tablet-server side of
+// FilterTransformIterator's lazy Kryo decode, done columnar).
+//
+// Layout per row (little-endian):
+//   u8 version(=1) | u8 flags | fid(kind u8: 0 zigzag-varint, 1 len-str)
+//   u16 n_attrs | u32 x (n_attrs+1) payload offset table | payloads
+//   payload: u8 0=null else 1 + typed bytes
+//
+// Exposed entry points return 0 on success, or -(row_index+1) on a
+// malformed row so Python can fall back and report.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline bool read_varint(const uint8_t* p, uint64_t end, uint64_t* pos,
+                        uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (*pos < end && shift < 64) {
+    uint8_t b = p[(*pos)++];
+    v |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+inline int64_t unzigzag(uint64_t v) {
+  return (int64_t)(v >> 1) ^ -(int64_t)(v & 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse every row's header. Outputs:
+//   payload_base[i]: absolute offset of row i's payload area
+//   fids_int[i]    : integer fid (when fid kind is 0)
+//   fid_off/fid_len: utf-8 span of string fids (when kind is 1)
+//   flags_out[i]   : bit0 = string fid, bit1 = has user-data section
+int binser_headers(const uint8_t* data, const uint64_t* row_off, int64_t n,
+                   int32_t n_attrs, uint64_t* payload_base, int64_t* fids_int,
+                   uint64_t* fid_off, uint32_t* fid_len, uint8_t* flags_out) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t pos = row_off[i], end = row_off[i + 1];
+    if (pos + 3 > end || data[pos] != 1) return -(int)(i + 1);
+    uint8_t row_flags = data[pos + 1];
+    uint8_t kind = data[pos + 2];
+    pos += 3;
+    uint8_t flags = (row_flags & 0x01) ? 2 : 0;
+    if (kind == 0) {
+      uint64_t raw;
+      if (!read_varint(data, end, &pos, &raw)) return -(int)(i + 1);
+      fids_int[i] = unzigzag(raw);
+      fid_off[i] = 0;
+      fid_len[i] = 0;
+    } else {
+      uint64_t len;
+      if (!read_varint(data, end, &pos, &len)) return -(int)(i + 1);
+      if (pos + len > end) return -(int)(i + 1);
+      fid_off[i] = pos;
+      fid_len[i] = (uint32_t)len;
+      fids_int[i] = 0;
+      flags |= 1;
+      pos += len;
+    }
+    if (pos + 2 > end) return -(int)(i + 1);
+    uint16_t count;
+    std::memcpy(&count, data + pos, 2);
+    pos += 2;
+    if (count != (uint16_t)n_attrs) return -(int)(i + 1);
+    uint64_t tbl_bytes = 4ull * (n_attrs + 1);
+    if (pos + tbl_bytes > end) return -(int)(i + 1);
+    payload_base[i] = pos + tbl_bytes;
+    flags_out[i] = flags;
+  }
+  return 0;
+}
+
+// Decode one attribute across all rows.
+//   code 0: zigzag varint -> int64 out
+//   code 1: f32 out   code 2: f64 out   code 3: bool -> u8 out
+//   code 4: WKB point -> f64 out[(i,0)=x,(i,1)=y]
+//   code 5: string -> (str_off, str_len) spans into data
+// nulls[i] set to 1 for null payloads (outputs left zeroed).
+int binser_column(const uint8_t* data, const uint64_t* row_off,
+                  const uint64_t* payload_base, int64_t n, int32_t n_attrs,
+                  int32_t attr, int32_t code, void* out, uint64_t* str_off,
+                  uint32_t* str_len, uint8_t* nulls) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t base = payload_base[i];
+    uint64_t tbl = base - 4ull * (n_attrs + 1);
+    uint32_t o0, o1;
+    std::memcpy(&o0, data + tbl + 4ull * attr, 4);
+    std::memcpy(&o1, data + tbl + 4ull * (attr + 1), 4);
+    uint64_t lo = base + o0, hi = base + o1;
+    if (hi > row_off[i + 1] || lo > hi) return -(int)(i + 1);
+    nulls[i] = 0;
+    if (lo == hi || data[lo] == 0) {
+      nulls[i] = 1;
+      if (code == 5) {
+        str_off[i] = 0;
+        str_len[i] = 0;
+      }
+      continue;
+    }
+    lo += 1;  // skip the non-null marker
+    switch (code) {
+      case 0: {  // zigzag varint (Integer/Long/Date)
+        uint64_t raw, pos = lo;
+        if (!read_varint(data, hi, &pos, &raw)) return -(int)(i + 1);
+        ((int64_t*)out)[i] = unzigzag(raw);
+        break;
+      }
+      case 1: {
+        if (hi - lo < 4) return -(int)(i + 1);
+        std::memcpy((float*)out + i, data + lo, 4);
+        break;
+      }
+      case 2: {
+        if (hi - lo < 8) return -(int)(i + 1);
+        std::memcpy((double*)out + i, data + lo, 8);
+        break;
+      }
+      case 3: {
+        ((uint8_t*)out)[i] = data[lo] == 1 ? 1 : 0;
+        break;
+      }
+      case 4: {  // WKB point: byteorder u8 | u32 type | f64 x | f64 y
+        if (hi - lo < 21 || data[lo] != 1) return -(int)(i + 1);
+        uint32_t gtype;
+        std::memcpy(&gtype, data + lo + 1, 4);
+        if (gtype != 1) return -(int)(i + 1);
+        std::memcpy((double*)out + 2 * i, data + lo + 5, 8);
+        std::memcpy((double*)out + 2 * i + 1, data + lo + 13, 8);
+        break;
+      }
+      case 5: {  // string span
+        str_off[i] = lo;
+        str_len[i] = (uint32_t)(hi - lo);
+        break;
+      }
+      default:
+        return -(int)(i + 1);
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

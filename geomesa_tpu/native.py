@@ -235,7 +235,7 @@ def binser_decode(sft, rows, want):
             else:
                 fids[i] = int(fids_int[i])
     else:
-        fids = fids_int.copy()
+        fids = fids_int  # freshly allocated here; no aliasing to protect
 
     cols: dict = {}
     nulls = np.empty(n, dtype=np.uint8)

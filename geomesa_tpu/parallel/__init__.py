@@ -22,10 +22,18 @@ from geomesa_tpu.parallel.dist import (
     distributed_z3_sort,
     sharded_build_and_query_step,
 )
+from geomesa_tpu.parallel.multihost import (
+    global_mesh,
+    host_batches_to_global,
+    initialize,
+)
 
 __all__ = [
     "make_mesh",
     "sharded_count_scan",
     "distributed_z3_sort",
     "sharded_build_and_query_step",
+    "initialize",
+    "global_mesh",
+    "host_batches_to_global",
 ]

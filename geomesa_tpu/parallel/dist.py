@@ -55,13 +55,32 @@ def sharded_count_scan(mesh, device_fn, cols: dict, axis: str = "shard"):
     return jax.jit(step)(*ordered)
 
 
-def distributed_z3_sort(mesh, hi, lo, axis: str = "shard", capacity_factor: float = 2.0):
-    """Radix-exchange sort of (hi, lo) uint32 z-key pairs across the mesh.
+def distributed_z3_sort(
+    mesh,
+    hi,
+    lo,
+    axis: str = "shard",
+    capacity_factor: float = 2.0,
+    splitters: str = "sampled",
+    sample_per_shard: int = 64,
+):
+    """Exchange-sort of (hi, lo) uint32 z-key pairs across the mesh.
 
     Returns (hi, lo, valid) shard-partitioned arrays where shard s holds the
-    s-th globally-sorted key range (top log2(n_shards) bits of ``hi``),
-    locally sorted; ``valid`` masks padding introduced by the fixed-capacity
-    exchange.
+    s-th globally-sorted key range, locally sorted; ``valid`` masks padding
+    introduced by the fixed-capacity exchange.
+
+    ``splitters='sampled'`` (default) routes by globally-sampled key
+    quantiles, preceded by a round-robin rebalance pass so every
+    (source, dest) exchange block is provably within capacity even for
+    adversarial layouts (already-sorted or all-duplicate keys): after the
+    rebalance every source holds a near-uniform mix of the global key
+    distribution, so quantile routing sends ~local_n/n_shards rows per
+    destination. This handles arbitrary spatial skew (GDELT city
+    clusters; SURVEY.md hard part #5) at the price of one extra
+    all_to_all. ``'radix'`` routes by the top z bits in a single pass:
+    cheaper, but a hot cell overflows its destination's capacity and
+    drops rows (``valid`` reports what survived).
     """
     import jax
     import jax.numpy as jnp
@@ -75,6 +94,35 @@ def distributed_z3_sort(mesh, hi, lo, axis: str = "shard", capacity_factor: floa
     lo = jax.device_put(lo, NamedSharding(mesh, spec))
     local_n = hi.shape[0] // n_shards
     cap = int(np.ceil(local_n / n_shards * capacity_factor))
+    if splitters not in ("sampled", "radix"):
+        raise ValueError(f"unknown splitter strategy {splitters!r}")
+    k = min(sample_per_shard, local_n)
+
+    def exchange(jx, jnpx, h, l, v, dest, block_cap):
+        """Bucket rows by dest, all_to_all the (n_shards, cap) blocks,
+        return flattened received (h, l, valid). Invalid rows sort to the
+        end of their bucket so they can never displace valid rows."""
+        sort_key = dest * 2 + (~v).astype(jnp.int32)
+        order = jnpx.argsort(sort_key, stable=True)
+        h_s, l_s, v_s, d_s = h[order], l[order], v[order], dest[order]
+        start = jnpx.searchsorted(d_s, jnpx.arange(n_shards), side="left")
+        within = jnpx.arange(h.shape[0]) - start[d_s]
+        keep = (within < block_cap) & v_s
+        flat_idx = d_s * block_cap + within
+        flat_idx = jnpx.where(keep, flat_idx, n_shards * block_cap)
+        buf_h = jnpx.full((n_shards * block_cap + 1,), jnpx.uint32(0xFFFFFFFF))
+        buf_l = jnpx.full((n_shards * block_cap + 1,), jnpx.uint32(0xFFFFFFFF))
+        buf_v = jnpx.zeros((n_shards * block_cap + 1,), dtype=bool)
+        buf_h = buf_h.at[flat_idx].set(h_s)
+        buf_l = buf_l.at[flat_idx].set(l_s)
+        buf_v = buf_v.at[flat_idx].set(keep)
+        buf_h = buf_h[:-1].reshape(n_shards, block_cap)
+        buf_l = buf_l[:-1].reshape(n_shards, block_cap)
+        buf_v = buf_v[:-1].reshape(n_shards, block_cap)
+        buf_h = jx.lax.all_to_all(buf_h, axis, 0, 0, tiled=False)
+        buf_l = jx.lax.all_to_all(buf_l, axis, 0, 0, tiled=False)
+        buf_v = jx.lax.all_to_all(buf_v, axis, 0, 0, tiled=False)
+        return buf_h.reshape(-1), buf_l.reshape(-1), buf_v.reshape(-1)
 
     @partial(
         shard_map,
@@ -84,38 +132,58 @@ def distributed_z3_sort(mesh, hi, lo, axis: str = "shard", capacity_factor: floa
         check_vma=False,
     )
     def step(h, l):
-        # z bits 62..(63-bits): top `bits` bits of the 63-bit z live in hi
-        # bits (62-32)=30 .. (31-bits): shift (31 - bits) then mask.
-        dest = (h >> (31 - bits)) & (n_shards - 1) if bits else jnp.zeros_like(h)
-        dest = dest.astype(jnp.int32)
-        # stable-bucket locally: sort by dest so each bucket is contiguous
-        order = jnp.argsort(dest, stable=True)
-        h_s, l_s, d_s = h[order], l[order], dest[order]
-        # position of each row within its bucket
-        start = jnp.searchsorted(d_s, jnp.arange(n_shards), side="left")
-        within = jnp.arange(h.shape[0]) - start[d_s]
-        # scatter into (n_shards, cap) with sentinel padding; rows past cap
-        # are dropped (capacity_factor sized for skew)
-        keep = within < cap
-        flat_idx = d_s * cap + within
-        flat_idx = jnp.where(keep, flat_idx, n_shards * cap)  # spill slot
-        buf_h = jnp.full((n_shards * cap + 1,), jnp.uint32(0xFFFFFFFF))
-        buf_l = jnp.full((n_shards * cap + 1,), jnp.uint32(0xFFFFFFFF))
-        buf_v = jnp.zeros((n_shards * cap + 1,), dtype=bool)
-        buf_h = buf_h.at[flat_idx].set(h_s)
-        buf_l = buf_l.at[flat_idx].set(l_s)
-        buf_v = buf_v.at[flat_idx].set(keep)
-        buf_h = buf_h[:-1].reshape(n_shards, cap)
-        buf_l = buf_l[:-1].reshape(n_shards, cap)
-        buf_v = buf_v[:-1].reshape(n_shards, cap)
-        # ICI radix exchange: block s goes to shard s
-        buf_h = jax.lax.all_to_all(buf_h, axis, 0, 0, tiled=False)
-        buf_l = jax.lax.all_to_all(buf_l, axis, 0, 0, tiled=False)
-        buf_v = jax.lax.all_to_all(buf_v, axis, 0, 0, tiled=False)
-        rh = buf_h.reshape(-1)
-        rl = buf_l.reshape(-1)
-        rv = buf_v.reshape(-1)
-        # local sort by (hi, lo); sentinels (0xffffffff) sink to the end
+        v = jnp.ones(h.shape, dtype=bool)
+        if splitters == "sampled" and n_shards > 1:
+            # pass 1: round-robin rebalance -- dest cycles 0..n_shards-1,
+            # so each (source, dest) block carries exactly
+            # ceil(local_n/n_shards) rows: within capacity by construction
+            rr_cap = -(-h.shape[0] // n_shards)
+            rr_dest = (jnp.arange(h.shape[0]) % n_shards).astype(jnp.int32)
+            h, l, v = exchange(jax, jnp, h, l, v, rr_dest, rr_cap)
+            # pass 2: sample the (now well-mixed) local keys, all_gather,
+            # sort globally, take n_shards-1 quantile splitters; route by
+            # lexicographic (hi, lo) comparison against them. Valid rows
+            # are sampled first (invalid padding carries sentinel keys).
+            order = jnp.argsort(~v, stable=True)
+            hh, ll = h[order], l[order]
+            stride = max(1, local_n // k) if k else 1
+            sh_samp = hh[::stride][:k]
+            sl_samp = ll[::stride][:k]
+            gh = jax.lax.all_gather(sh_samp, axis).reshape(-1)
+            gl = jax.lax.all_gather(sl_samp, axis).reshape(-1)
+            gh, gl = jax.lax.sort((gh, gl), num_keys=2)
+            m = gh.shape[0]
+            q = (jnp.arange(1, n_shards) * m) // n_shards
+            sp_h, sp_l = gh[q], gl[q]  # (n_shards-1,)
+            gt = (h[:, None] > sp_h[None, :]) | (
+                (h[:, None] == sp_h[None, :]) & (l[:, None] > sp_l[None, :])
+            )
+            ge = (h[:, None] > sp_h[None, :]) | (
+                (h[:, None] == sp_h[None, :]) & (l[:, None] >= sp_l[None, :])
+            )
+            # rows equal to splitter keys may land on ANY shard in the
+            # tied range without breaking global order (equal keys are
+            # order-free) -- spread them round-robin so duplicate-heavy
+            # data cannot overload one destination
+            d_lo = gt.sum(axis=1).astype(jnp.int32)
+            d_hi = ge.sum(axis=1).astype(jnp.int32)
+            span = d_hi - d_lo + 1
+            dest = d_lo + (
+                jnp.arange(h.shape[0]).astype(jnp.int32) % span
+            )
+            rh, rl, rv = exchange(jax, jnp, h, l, v, dest, cap)
+        else:
+            if bits:
+                # z bits 62..(63-bits): top `bits` bits of the 63-bit z
+                # live in hi bits (62-32)=30 .. (31-bits)
+                dest = ((h >> (31 - bits)) & (n_shards - 1)).astype(jnp.int32)
+            else:
+                dest = jnp.zeros(h.shape, dtype=jnp.int32)
+            rh, rl, rv = exchange(jax, jnp, h, l, v, dest, cap)
+        # local sort by (hi, lo); sentinels (0xffffffff) sink to the end.
+        # invalid rows are forced to the sentinel key so they sort last
+        rh = jnp.where(rv, rh, jnp.uint32(0xFFFFFFFF))
+        rl = jnp.where(rv, rl, jnp.uint32(0xFFFFFFFF))
         rh, rl, rv = jax.lax.sort((rh, rl, rv), num_keys=2)
         return rh, rl, rv
 

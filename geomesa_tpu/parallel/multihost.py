@@ -1,0 +1,97 @@
+"""Multi-host (DCN) bootstrap + host-local data feeding.
+
+Ref role: the reference scales past one machine through its backend
+clusters (Accumulo tablet servers over Thrift, Spark executors) -- here
+multi-host scaling is a jax.distributed process group over DCN with ICI
+collectives inside each pod slice (SURVEY.md section 2.6 "communication
+backend" row) [UNVERIFIED - empty reference mount].
+
+Single-host meshes need none of this; these helpers make the same code run
+unchanged on multi-host pods:
+
+- :func:`initialize` -- jax.distributed bootstrap (no-op for 1 process)
+- :func:`global_mesh` -- Mesh over every process's devices
+- :func:`host_batches_to_global` -- per-host columnar slices ->
+  globally-sharded jax.Arrays (the distributed-ingest feed: each host
+  stages only its local rows; XLA addresses the union)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def initialize(
+    coordinator_address: "str | None" = None,
+    num_processes: "int | None" = None,
+    process_id: "int | None" = None,
+) -> None:
+    """Bootstrap the multi-host process group. With one process (or when
+    jax.distributed is already initialized) this is a no-op, so the same
+    entry point works from laptops to pods. Arguments default to the
+    JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID env vars
+    (the standard pod launcher contract)."""
+    import os
+
+    import jax
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes in (None, 1):
+        return  # single-process: nothing to coordinate
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # double-init is the documented no-op (error text varies by
+        # jax version: "already initialized" / "should only be called once")
+        msg = str(e)
+        if "already initialized" not in msg and "only be called once" not in msg:
+            raise
+
+
+def global_mesh(axes: "tuple[str, ...]" = ("shard",)):
+    """Mesh over ALL devices in the process group (jax.devices() spans
+    hosts after initialize()); same axis semantics as make_mesh."""
+    from geomesa_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(None, axes)
+
+
+def host_batches_to_global(mesh, cols: dict, axis: str = "shard") -> dict:
+    """Per-host columnar slices -> globally sharded jax.Arrays.
+
+    Each process passes ONLY its local rows (equal length per process);
+    the result is one global array per column, sharded over ``axis``
+    across every host's devices -- the multi-host ingest feed
+    (jax.make_array_from_process_local_data handles the addressing)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis))
+    out = {}
+    for name, arr in cols.items():
+        arr = np.asarray(arr)
+        out[name] = jax.make_array_from_process_local_data(
+            sharding, arr
+        )
+    return out
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def local_device_count() -> int:
+    import jax
+
+    return jax.local_device_count()

@@ -131,6 +131,7 @@ int binser_column(const uint8_t* data, const uint64_t* row_off,
         break;
       }
       case 3: {
+        if (lo >= hi) return -(int)(i + 1);
         ((uint8_t*)out)[i] = data[lo] == 1 ? 1 : 0;
         break;
       }

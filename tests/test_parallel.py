@@ -99,3 +99,113 @@ def test_full_build_and_query_step(mesh, rng):
     )
     # global order: concatenation of shards ascending
     np.testing.assert_array_equal(np.sort(z_dev), z_host)
+
+
+def test_sampled_splitters_survive_skew(mesh):
+    """All points in one hot cell: radix routing overflows one destination
+    and drops rows; sampled splitters keep every row and stay globally
+    sorted (SURVEY hard part #5, GDELT skew)."""
+    import jax.numpy as jnp
+
+    n = 4096
+    rng = np.random.default_rng(3)
+    # a single ~1km cell: all z keys share their high bits
+    x = rng.uniform(2.350, 2.351, n)
+    y = rng.uniform(48.850, 48.851, n)
+    t = rng.uniform(0, 3600.0, n)
+    sfc = Z3SFC()
+    hi, lo = sfc.index_jax_hi_lo(jnp.asarray(x), jnp.asarray(y), jnp.asarray(t))
+
+    rh, rl, rv = distributed_z3_sort(mesh, hi, lo, splitters="radix")
+    dropped_radix = n - int(np.asarray(rv).sum())
+    assert dropped_radix > 0  # the skew actually defeats radix routing
+
+    sh, sl, sv = distributed_z3_sort(mesh, hi, lo, splitters="sampled")
+    assert int(np.asarray(sv).sum()) == n  # nothing dropped
+    # global sortedness: concatenated valid keys are non-decreasing
+    h = np.asarray(sh)[np.asarray(sv)]
+    l = np.asarray(sl)[np.asarray(sv)]
+    z = (h.astype(np.uint64) << np.uint64(32)) | l.astype(np.uint64)
+    # per-shard slices are sorted and shard s's max <= shard s+1's min
+    per = np.asarray(sv).reshape(8, -1)
+    zs = np.asarray(sh).astype(np.uint64).reshape(8, -1) << np.uint64(32)
+    zs |= np.asarray(sl).astype(np.uint64).reshape(8, -1)
+    prev_max = None
+    for s in range(8):
+        vals = zs[s][per[s]]
+        assert np.all(np.diff(vals.astype(np.int64)) >= 0)
+        if len(vals):
+            if prev_max is not None:
+                assert vals[0] >= prev_max
+            prev_max = vals[-1]
+
+
+def test_multihost_helpers_single_process(mesh, rng):
+    """The multi-host entry points must work unchanged on one process:
+    initialize() no-ops, host slices become globally sharded arrays that
+    collectives consume."""
+    import jax
+
+    from geomesa_tpu.parallel import (
+        host_batches_to_global,
+        initialize,
+        sharded_count_scan,
+    )
+    from geomesa_tpu.parallel.multihost import global_mesh
+
+    initialize()  # no coordinator configured -> no-op
+    gm = global_mesh()
+    assert gm.shape["shard"] == len(jax.devices())
+
+    n = 1024
+    cols = {
+        "x": rng.uniform(-180, 180, n).astype(np.float32),
+        "y": rng.uniform(-90, 90, n).astype(np.float32),
+    }
+    gcols = host_batches_to_global(mesh, cols)
+    assert all(v.shape == (n,) for v in gcols.values())
+
+    def fn(local):
+        return (
+            (local["x"] >= -10)
+            & (local["x"] <= 30)
+            & (local["y"] >= 35)
+            & (local["y"] <= 60)
+        )
+
+    got = int(sharded_count_scan(mesh, fn, cols))
+    want = int(
+        (
+            (cols["x"] >= -10)
+            & (cols["x"] <= 30)
+            & (cols["y"] >= 35)
+            & (cols["y"] <= 60)
+        ).sum()
+    )
+    assert got == want
+
+
+def test_sampled_sort_adversarial_layouts(mesh):
+    """Already-globally-sorted input (each source holds one quantile) and
+    all-duplicate keys: both defeat naive splitter routing; the rebalance
+    pass + tie spreading must keep every row."""
+    import jax.numpy as jnp
+
+    n = 4096
+    # adversarial 1: globally sorted keys
+    z = np.sort(np.random.default_rng(0).integers(0, 2**62, n).astype(np.uint64))
+    hi = jnp.asarray((z >> np.uint64(32)).astype(np.uint32))
+    lo = jnp.asarray((z & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    sh, sl, sv = distributed_z3_sort(mesh, hi, lo, splitters="sampled")
+    assert int(np.asarray(sv).sum()) == n
+    got = np.sort(
+        (np.asarray(sh).astype(np.uint64) << np.uint64(32))
+        | np.asarray(sl).astype(np.uint64)
+    )[:n]
+    np.testing.assert_array_equal(np.sort(got), np.sort(z))
+
+    # adversarial 2: every key identical
+    hi2 = jnp.full(n, np.uint32(7), dtype=jnp.uint32)
+    lo2 = jnp.full(n, np.uint32(9), dtype=jnp.uint32)
+    sh2, sl2, sv2 = distributed_z3_sort(mesh, hi2, lo2, splitters="sampled")
+    assert int(np.asarray(sv2).sum()) == n

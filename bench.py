@@ -34,7 +34,18 @@ def main() -> None:
         default="pallas",
         help="fused scan kernel: hand-written Pallas tiles or XLA-fused jnp",
     )
+    ap.add_argument(
+        "--mode",
+        choices=("filter", "build"),
+        default="filter",
+        help="filter: bbox+time scan throughput (BASELINE config #1); "
+        "build: Z3 key encode + device sort, pts/sec (config #2)",
+    )
     args = ap.parse_args()
+
+    if args.mode == "build":
+        bench_build(args)
+        return
 
     import jax
     import jax.numpy as jnp
@@ -129,6 +140,78 @@ def main() -> None:
                 "value": round(feats_per_sec, 1),
                 "unit": "features/sec/chip",
                 "vs_baseline": round(feats_per_sec / baseline_per_chip, 2),
+            }
+        )
+    )
+
+
+def bench_build(args) -> None:
+    """Z3 index build on device: fused quantize+interleave key encode
+    (hi/lo uint32 lanes) + lexicographic sort (BASELINE config #2 shape:
+    OSM-GPS-style points, full build path minus file IO)."""
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.curves import Z3SFC
+
+    platform = jax.devices()[0].platform
+    n = args.n or ((1 << 26) if platform != "cpu" else (1 << 20))
+    log(f"platform={platform} device={jax.devices()[0]} n={n:,} (build mode)")
+    sfc = Z3SFC()
+    key = jax.random.PRNGKey(7)
+    kx, ky, kt = jax.random.split(key, 3)
+    x = jax.random.uniform(kx, (n,), jnp.float32, -180.0, 180.0)
+    y = jax.random.uniform(ky, (n,), jnp.float32, -90.0, 90.0)
+    t = jax.random.uniform(kt, (n,), jnp.float32, 0.0, 604800.0)
+    jax.block_until_ready((x, y, t))
+
+    @jax.jit
+    def build(xc, yc, tc):
+        hi, lo = sfc.index_jax_hi_lo(xc, yc, tc)
+        hi_s, lo_s = jax.lax.sort((hi, lo), num_keys=2)
+        # order-dependent checksum: forces the full sorted arrays to
+        # materialize (a bare block_until_ready does not sync through the
+        # remote-execution tunnel, and returning only extremes would let
+        # XLA reduce the sort to min/max)
+        w = jnp.arange(n, dtype=jnp.uint32)
+        return (hi_s * w).sum(), (lo_s * w).sum(), hi_s, lo_s
+
+    t0 = time.perf_counter()
+    first = build(x, y, t)
+    chk = int(first[0])
+    if args.check:
+        import numpy as np
+
+        hi_s = np.asarray(first[2]).astype(np.uint64)
+        lo_s = np.asarray(first[3]).astype(np.uint64)
+        got = (hi_s << np.uint64(32)) | lo_s
+        # oracle for the sort: the same device encode (f32 lanes -- the
+        # f64-parity of the encode itself is covered by the unit tests),
+        # host-sorted, must equal the device-sorted output exactly
+        hi_u, lo_u = jax.jit(sfc.index_jax_hi_lo)(x, y, t)
+        z_u = (np.asarray(hi_u).astype(np.uint64) << np.uint64(32)) | np.asarray(
+            lo_u
+        ).astype(np.uint64)
+        assert np.array_equal(got, np.sort(z_u)), "device sort != host sort"
+        log("sorted keys verified against host-sorted oracle")
+    del first  # drop the n-sized sorted arrays before the timing loop
+    log(f"compiled+first build in {time.perf_counter() - t0:.1f}s (chk {chk})")
+
+    times = []
+    for _ in range(args.iters):
+        t1 = time.perf_counter()
+        int(build(x, y, t)[0])  # scalar fetch = hard sync point
+        times.append(time.perf_counter() - t1)
+    median = sorted(times)[len(times) // 2]
+    pts_per_sec = n / median
+    log(f"median={median*1e3:.2f}ms -> {pts_per_sec/1e6:.0f}M pts/sec/chip")
+    print(
+        json.dumps(
+            {
+                "metric": "Z3 index build (encode + device sort)",
+                "value": round(pts_per_sec, 1),
+                "unit": "pts/sec/chip",
+                "vs_baseline": None,  # BASELINE.json: 'TBD at first measurement'
             }
         )
     )

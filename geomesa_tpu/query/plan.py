@@ -88,6 +88,7 @@ def plan_query(
     query: Query,
     max_ranges: "int | None" = None,
     data_interval: "tuple[int, int] | None" = None,
+    stats: "object | None" = None,
 ) -> QueryPlan:
     """indices: {name: BuiltIndex | IndexKeySpace} -- planning only needs
     the key spaces, so disk-backed stores can plan before loading data.
@@ -121,19 +122,32 @@ def plan_query(
     )
 
     # score every index (ref StrategyDecider: stat-based when stats exist,
-    # heuristic otherwise; here: heuristic + per-attribute route)
+    # heuristic otherwise)
+    est = _StatEstimator.build(stats) if stats is not None else None
     candidates: list[tuple[str, float]] = []
     for name, built in indices.items():
         ks = getattr(built, "keyspace", built)
         if isinstance(ks, AttributeKeySpace):
             bounds = extract_intervals(f, ks.attr)
             eq = _attr_equality(f, ks.attr)
-            cost = 0.5 if eq else (5.0 if not bounds.unbounded else float("inf"))
+            if est is not None:
+                cost = est.attr_cost(ks.attr, eq, bounds)
+            else:
+                cost = (
+                    0.5 if eq else (5.0 if not bounds.unbounded else float("inf"))
+                )
             candidates.append((name, cost))
         elif isinstance(ks, IdKeySpace):
             candidates.append((name, float("inf")))
         else:
-            candidates.append((name, ks.cost(geoms, intervals)))
+            heuristic = ks.cost(geoms, intervals)
+            if est is not None and heuristic != float("inf"):
+                cost = est.spatial_cost(ks, geoms, intervals)
+                if cost is None:
+                    cost = heuristic
+            else:
+                cost = heuristic
+            candidates.append((name, cost))
     # full scan fallback uses whichever index exists
     candidates.sort(key=lambda t: t[1])
     index_name = candidates[0][0] if candidates else None
@@ -170,6 +184,91 @@ def plan_query(
     )
     guard_plan(chain, plan)
     return plan
+
+
+class _StatEstimator:
+    """Stat-based candidate costing (ref StrategyDecider + GeoMesaStats):
+    costs are estimated rows scanned, derived from the write-time stats
+    (CountStat total, per-attribute MinMax, Z3Histogram occupancy)."""
+
+    def __init__(self, total, minmax, z3hist):
+        self.total = total
+        self.minmax = minmax  # attr -> MinMax
+        self.z3hist = z3hist
+
+    @staticmethod
+    def build(stats) -> "_StatEstimator | None":
+        from geomesa_tpu.stats.sketches import (
+            CountStat,
+            MinMax,
+            Z3HistogramStat,
+        )
+
+        total = None
+        minmax: dict = {}
+        z3hist = None
+        for s in getattr(stats, "stats", []):
+            if isinstance(s, CountStat):
+                total = s.count
+            elif isinstance(s, MinMax):
+                minmax[s.attr] = s
+            elif isinstance(s, Z3HistogramStat):
+                z3hist = s
+        if total is None:
+            return None
+        return _StatEstimator(total, minmax, z3hist)
+
+    def attr_cost(self, attr, eq, bounds) -> float:
+        if eq is not None:
+            # equality: assume high-cardinality attributes; bounded below
+            # so an exact-match never looks free, and above by the store
+            return max(1.0, min(self.total, self.total * 0.001 * len(eq)))
+        if bounds.unbounded:
+            return float("inf")
+        mm = self.minmax.get(attr)
+        if mm is None:
+            return self.total * 0.5
+        frac = 0.0
+        for lo, hi in bounds.values:
+            frac += mm.selectivity(lo, hi)
+        return self.total * min(1.0, frac)
+
+    def _time_fraction(self, ks, intervals) -> float:
+        mm = self.minmax.get(getattr(ks, "dtg_field", None))
+        if mm is None:
+            return 1.0
+        return min(
+            1.0, sum(mm.selectivity(lo, hi) for lo, hi in intervals.values)
+        )
+
+    def spatial_cost(self, ks, geoms, intervals) -> "float | None":
+        """Estimated rows for z3/xz3 (occupancy histogram) and z2/xz2
+        (area fraction x time fraction). Always in rows so candidates
+        stay comparable with attribute estimates; None only when no
+        estimate is possible at all."""
+        needs_time = "3" in getattr(ks, "name", "")
+        if geoms.empty or (needs_time and intervals.empty):
+            return 1.0
+        if needs_time and intervals.unbounded:
+            return None  # keyspace cost is inf anyway
+        if geoms.unbounded:
+            # no spatial prune: rows bounded only by the time fraction
+            tfrac = self._time_fraction(ks, intervals) if needs_time else 1.0
+            return max(1.0, self.total * tfrac)
+        if needs_time and self.z3hist is not None:
+            return max(
+                1.0, self.z3hist.estimate(geoms.values, intervals.values)
+            )
+        # area-fraction fallback (z2/xz2, or z3 without a histogram)
+        area = 0.0
+        for env, _ in geoms.values:
+            w = max(0.0, min(env.xmax, 180.0) - max(env.xmin, -180.0))
+            h = max(0.0, min(env.ymax, 90.0) - max(env.ymin, -90.0))
+            area += w * h
+        frac = min(1.0, area / (360.0 * 180.0))
+        if needs_time:
+            frac *= self._time_fraction(ks, intervals)
+        return max(1.0, self.total * frac)
 
 
 def as_query(q) -> Query:

@@ -106,6 +106,17 @@ class MinMax(Stat):
     def bounds(self):
         return (self.min, self.max)
 
+    def selectivity(self, lo, hi) -> float:
+        """Fraction of rows expected in [lo, hi] under a uniform-range
+        assumption (ref: stat-based attribute costing)."""
+        if self.min is None or self.max is None:
+            return 1.0
+        span = float(self.max) - float(self.min)
+        if span <= 0:
+            return 1.0 if lo <= self.min <= hi else 0.0
+        ov = min(float(hi), float(self.max)) - max(float(lo), float(self.min))
+        return max(0.0, min(1.0, ov / span))
+
     def to_json(self):
         return {
             "type": "minmax",
@@ -343,6 +354,63 @@ class Z3HistogramStat(Stat):
         for k, c in other.counts.items():
             self.counts[k] = self.counts.get(k, 0) + c
         return self
+
+    def estimate(self, envelopes, t_intervals_ms) -> float:
+        """Estimated rows intersecting any (envelope, time-interval) pair
+        (ref: the stat-based side of StrategyDecider). Each occupancy
+        cell's count is prorated by the fraction of its (lon, lat, time)
+        box the query covers (uniform-within-cell assumption), so the
+        estimate stays comparable with plain area-fraction costing."""
+        from geomesa_tpu.curves import TimePeriod
+        from geomesa_tpu.curves.binnedtime import max_offset, to_binned_time
+        from geomesa_tpu.curves.zorder import decode_3d_np
+
+        if not self.counts or not envelopes or not t_intervals_ms:
+            return 0.0
+        period = TimePeriod.parse(self.period)
+        mx_off = float(max_offset(period))
+        bpd = self.prefix_bits // 3
+        grid = 1 << bpd
+        cw_x, cw_y, cw_t = 360.0 / grid, 180.0 / grid, mx_off / grid
+        keys = np.fromiter(self.counts.keys(), dtype=np.int64)
+        cnts = np.fromiter(self.counts.values(), dtype=np.float64)
+        bins = keys >> np.int64(self.prefix_bits)
+        prefix = (keys & np.int64((1 << self.prefix_bits) - 1)).astype(np.uint64)
+        ix, iy, it = decode_3d_np(prefix << np.uint64(63 - self.prefix_bits))
+        # cell index at bpd-bit resolution per dimension
+        ix = (ix >> np.uint64(21 - bpd)).astype(np.int64)
+        iy = (iy >> np.uint64(21 - bpd)).astype(np.int64)
+        it = (it >> np.uint64(21 - bpd)).astype(np.int64)
+        cx0 = -180.0 + ix * cw_x
+        cy0 = -90.0 + iy * cw_y
+        ct0 = it * cw_t  # period-offset units
+
+        def overlap(lo, width, q0, q1):
+            return np.clip(
+                np.minimum(lo + width, q1) - np.maximum(lo, q0), 0.0, width
+            ) / width
+
+        # time fraction is envelope-independent: compute it once
+        tf = np.zeros(len(keys), dtype=np.float64)
+        for t0, t1 in t_intervals_ms:
+            b0, o0 = to_binned_time(np.int64(t0), period)
+            b1, o1 = to_binned_time(np.int64(t1), period)
+            b0, o0 = int(b0), float(o0)
+            b1, o1 = int(b1), float(o1)
+            # per-bin offset window: full bins cover [0, mx_off]
+            q0 = np.where(bins == b0, o0, 0.0)
+            q1 = np.where(bins == b1, o1, mx_off)
+            inside = (bins >= b0) & (bins <= b1)
+            tf = np.maximum(
+                tf, np.where(inside, overlap(ct0, cw_t, q0, q1), 0.0)
+            )
+        frac = np.zeros(len(keys), dtype=np.float64)
+        for env, _ in envelopes:
+            sp = overlap(cx0, cw_x, env.xmin, env.xmax) * overlap(
+                cy0, cw_y, env.ymin, env.ymax
+            )
+            frac = np.maximum(frac, sp * tf)
+        return float((cnts * frac).sum())
 
     def to_json(self):
         return {

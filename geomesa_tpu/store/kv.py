@@ -602,7 +602,11 @@ class KVDataStore:
             name: keyspace_for(sft, name) for name in default_indices(sft)
         }
         return plan_query(
-            sft, indices, q, data_interval=self._intervals.get(type_name)
+            sft,
+            indices,
+            q,
+            data_interval=self._intervals.get(type_name),
+            stats=self.stats(type_name),
         )
 
     def _byte_ranges(self, keyspace, plan: QueryPlan):

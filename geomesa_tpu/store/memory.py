@@ -149,7 +149,13 @@ class MemoryDataStore:
         indices = st.indices or {
             name: keyspace_for(st.sft, name) for name in default_indices(st.sft)
         }
-        return plan_query(st.sft, indices, q, data_interval=st.data_interval)
+        return plan_query(
+            st.sft,
+            indices,
+            q,
+            data_interval=st.data_interval,
+            stats=self.stats(type_name),
+        )
 
     def query(self, type_name: str, query: "Query | str | ast.Filter" = ast.Include) -> QueryResult:
         import time as _time

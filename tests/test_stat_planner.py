@@ -1,0 +1,123 @@
+"""Stat-based strategy decider: costs come from write-time stats."""
+
+import numpy as np
+
+from geomesa_tpu.filter.ecql import parse_instant
+from geomesa_tpu.store.kv import KVDataStore, MemoryKV
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = (
+    "name:String,val:Int:index=true,dtg:Date,*geom:Point:srid=4326"
+)
+
+
+def _fill(ds, n=20000, seed=5):
+    rng = np.random.default_rng(seed)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    ds.create_schema("t", SPEC)
+    ds.write(
+        "t",
+        {
+            "name": rng.choice(["a", "b"], n),
+            "val": rng.integers(0, 1000, n),
+            "dtg": rng.integers(t0, t1, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    return ds
+
+
+def test_costs_are_row_estimates():
+    ds = _fill(MemoryDataStore())
+    plan = ds.plan(
+        "t",
+        "BBOX(geom, -10, 35, 30, 60) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-01-15T00:00:00Z",
+    )
+    costs = dict(plan.candidates)
+    # z3 prunes space AND time: its estimate must beat space-only z2
+    assert plan.index_name == "z3"
+    assert costs["z3"] < costs["z2"]
+    # estimates are in rows: z3's should be near the true hit count
+    true = len(ds.query("t", plan.filter))
+    assert 0.2 * true <= max(costs["z3"], 1.0) <= 12 * max(true, 1)
+
+
+def test_selective_attr_range_beats_wide_bbox():
+    # a tight attribute range with a world-spanning bbox: stat costing
+    # must route through the attribute index, not the spatial one
+    ds = _fill(MemoryDataStore())
+    plan = ds.plan("t", "val BETWEEN 10 AND 12 AND BBOX(geom, -180, -90, 180, 90)")
+    costs = dict(plan.candidates)
+    assert costs["attr:val"] < costs["z2"]
+    assert plan.index_name == "attr:val"
+
+
+def test_empty_region_estimated_near_zero():
+    # all data in the eastern hemisphere; a western-hemisphere query
+    # should carry a near-zero z3 estimate
+    ds = MemoryDataStore()
+    ds.create_schema("t", SPEC)
+    n = 5000
+    rng = np.random.default_rng(8)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write(
+        "t",
+        {
+            "name": ["a"] * n,
+            "val": rng.integers(0, 10, n),
+            "dtg": t0 + rng.integers(0, 10**9, n),
+            "geom": np.stack(
+                [rng.uniform(10, 170, n), rng.uniform(-80, 80, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    plan = ds.plan(
+        "t",
+        "BBOX(geom, -170, -80, -10, 80) AND "
+        "dtg DURING 2020-01-02T00:00:00Z/2020-01-09T00:00:00Z",
+    )
+    costs = dict(plan.candidates)
+    assert costs["z3"] < 0.02 * n
+
+
+def test_kv_store_stats_survive_reopen(tmp_path):
+    import os
+
+    path = os.path.join(str(tmp_path), "kv.db")
+    from geomesa_tpu.store.kv import SqliteKV
+
+    _fill(KVDataStore(SqliteKV(path)), n=2000)
+    ds2 = KVDataStore(SqliteKV(path))
+    plan = ds2.plan(
+        "t",
+        "BBOX(geom, -10, 35, 30, 60) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-01-15T00:00:00Z",
+    )
+    assert plan.index_name == "z3"
+    assert dict(plan.candidates)["z3"] < 2000  # stat-based, not heuristic
+
+
+def test_attr_eq_beats_unbounded_spatial():
+    # the review repro: equality + time-only filter must route through the
+    # attribute index, not a near-full z3 scan (mixed cost scales bug)
+    ds = _fill(MemoryDataStore())
+    plan = ds.plan(
+        "t", "val = 7 AND dtg DURING 2020-01-01T00:00:00Z/2020-02-28T00:00:00Z"
+    )
+    costs = dict(plan.candidates)
+    assert plan.index_name == "attr:val"
+    assert costs["attr:val"] < costs["z3"]
+
+
+def test_huge_in_list_does_not_exceed_total():
+    ds = _fill(MemoryDataStore(), n=2000)
+    vals = ",".join(str(v) for v in range(1500))
+    plan = ds.plan("t", f"val IN ({vals})")
+    costs = dict(plan.candidates)
+    assert costs["attr:val"] <= 2000

@@ -1,0 +1,119 @@
+"""Timing + tracing instrumentation.
+
+Ref role: geomesa-utils MethodProfiling.profile(...) wrappers (debug-log
+timings around planning/scan phases) and the ``explain`` output as the
+de-facto query profiler [UNVERIFIED - empty reference mount]; SURVEY.md
+section 5 maps these to ``jax.profiler`` traces plus host-side timers.
+
+- :func:`profile` -- context manager / decorator accumulating wall-time
+  per label into a process-wide registry (the MethodProfiling analog)
+- :func:`timings` / :func:`reset` -- read back / clear the registry
+- :func:`device_trace` -- wrap a block in a ``jax.profiler`` trace dump
+  (TensorBoard-loadable) for kernel-level inspection
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Timer:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+
+    def observe(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        self.max_s = max(self.max_s, dt)
+
+
+@dataclass
+class _Registry:
+    timers: dict = field(default_factory=lambda: defaultdict(_Timer))
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+_REG = _Registry()
+
+
+@contextmanager
+def profile(label: str):
+    """``with profile("planning"): ...`` -- accumulate wall time under a
+    label. Nestable and thread-safe; negligible overhead when unused."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _REG.lock:
+            _REG.timers[label].observe(dt)
+
+
+def profiled(label: "str | None" = None):
+    """Decorator form of :func:`profile`."""
+
+    def deco(fn):
+        import functools
+
+        name = label or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with profile(name):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
+
+
+def timings() -> dict:
+    """label -> {count, total_ms, mean_ms, max_ms} snapshot."""
+    with _REG.lock:
+        return {
+            label: {
+                "count": t.count,
+                "total_ms": round(t.total_s * 1e3, 3),
+                "mean_ms": round(t.total_s / t.count * 1e3, 3) if t.count else 0.0,
+                "max_ms": round(t.max_s * 1e3, 3),
+            }
+            for label, t in _REG.timers.items()
+        }
+
+
+def reset() -> None:
+    with _REG.lock:
+        _REG.timers.clear()
+
+
+def report() -> str:
+    """Human-readable table of accumulated timings."""
+    rows = sorted(timings().items(), key=lambda kv: -kv[1]["total_ms"])
+    if not rows:
+        return "(no profile data)"
+    out = [f"{'label':<40} {'count':>7} {'total ms':>10} {'mean ms':>9} {'max ms':>9}"]
+    for label, t in rows:
+        out.append(
+            f"{label:<40} {t['count']:>7} {t['total_ms']:>10.1f} "
+            f"{t['mean_ms']:>9.2f} {t['max_ms']:>9.2f}"
+        )
+    return "\n".join(out)
+
+
+@contextmanager
+def device_trace(log_dir: str):
+    """Dump a jax.profiler trace for the enclosed block (kernel timings,
+    HBM traffic; open with TensorBoard's profile plugin)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -104,6 +104,8 @@ def plan_query(
         interceptors_for,
     )
 
+    from geomesa_tpu.profiling import profile
+
     chain = interceptors_for(sft)
     query = apply_interceptors(chain, query, sft)
     if max_ranges is None:
@@ -167,9 +169,10 @@ def plan_query(
             else:
                 ranges = ks.ranges_for_values(bounds)
         else:
-            ranges = ks.scan_ranges(
-                geoms, intervals, max_ranges, data_interval=data_interval
-            )
+            with profile("plan.scan_ranges"):
+                ranges = ks.scan_ranges(
+                    geoms, intervals, max_ranges, data_interval=data_interval
+                )
     compiled = compile_filter(f, sft)
     plan = QueryPlan(
         sft=sft,
@@ -191,14 +194,16 @@ class _StatEstimator:
     costs are estimated rows scanned, derived from the write-time stats
     (CountStat total, per-attribute MinMax, Z3Histogram occupancy)."""
 
-    def __init__(self, total, minmax, z3hist):
+    def __init__(self, total, minmax, z3hist, cardinality):
         self.total = total
         self.minmax = minmax  # attr -> MinMax
         self.z3hist = z3hist
+        self.cardinality = cardinality  # attr -> Cardinality (HLL)
 
     @staticmethod
     def build(stats) -> "_StatEstimator | None":
         from geomesa_tpu.stats.sketches import (
+            Cardinality,
             CountStat,
             MinMax,
             Z3HistogramStat,
@@ -207,6 +212,7 @@ class _StatEstimator:
         total = None
         minmax: dict = {}
         z3hist = None
+        cardinality: dict = {}
         for s in getattr(stats, "stats", []):
             if isinstance(s, CountStat):
                 total = s.count
@@ -214,15 +220,21 @@ class _StatEstimator:
                 minmax[s.attr] = s
             elif isinstance(s, Z3HistogramStat):
                 z3hist = s
+            elif isinstance(s, Cardinality):
+                cardinality[s.attr] = s
         if total is None:
             return None
-        return _StatEstimator(total, minmax, z3hist)
+        return _StatEstimator(total, minmax, z3hist, cardinality)
 
     def attr_cost(self, attr, eq, bounds) -> float:
         if eq is not None:
-            # equality: assume high-cardinality attributes; bounded below
-            # so an exact-match never looks free, and above by the store
-            return max(1.0, min(self.total, self.total * 0.001 * len(eq)))
+            card = self.cardinality.get(attr)
+            if card is not None and card.estimate >= 1.0:
+                # rows per distinct value x values requested (HLL-backed)
+                per_value = self.total / card.estimate
+            else:
+                per_value = self.total * 0.001  # high-cardinality guess
+            return max(1.0, min(self.total, per_value * len(eq)))
         if bounds.unbounded:
             return float("inf")
         mm = self.minmax.get(attr)
@@ -243,10 +255,13 @@ class _StatEstimator:
 
     def spatial_cost(self, ks, geoms, intervals) -> "float | None":
         """Estimated rows for z3/xz3 (occupancy histogram) and z2/xz2
-        (area fraction x time fraction). Always in rows so candidates
-        stay comparable with attribute estimates; None only when no
-        estimate is possible at all."""
-        needs_time = "3" in getattr(ks, "name", "")
+        (time-marginalized histogram, area-fraction fallback). Always in
+        rows so candidates stay comparable with attribute estimates; all
+        spatial candidates share the same data-aware model so clustered
+        data cannot bias the choice. None only when no estimate is
+        possible at all."""
+        # structural: temporal keyspaces (z3/xz3) carry a dtg_field
+        needs_time = getattr(ks, "dtg_field", None) is not None
         if geoms.empty or (needs_time and intervals.empty):
             return 1.0
         if needs_time and intervals.unbounded:
@@ -255,11 +270,13 @@ class _StatEstimator:
             # no spatial prune: rows bounded only by the time fraction
             tfrac = self._time_fraction(ks, intervals) if needs_time else 1.0
             return max(1.0, self.total * tfrac)
-        if needs_time and self.z3hist is not None:
-            return max(
-                1.0, self.z3hist.estimate(geoms.values, intervals.values)
-            )
-        # area-fraction fallback (z2/xz2, or z3 without a histogram)
+        if self.z3hist is not None:
+            if needs_time:
+                est = self.z3hist.estimate(geoms.values, intervals.values)
+            else:
+                est = self.z3hist.estimate_spatial(geoms.values)
+            return max(1.0, est)
+        # area-fraction fallback (no histogram: non-point or no-time schema)
         area = 0.0
         for env, _ in geoms.values:
             w = max(0.0, min(env.xmax, 180.0) - max(env.xmin, -180.0))

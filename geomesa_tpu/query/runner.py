@@ -54,6 +54,13 @@ def _contiguous_runs(parts) -> "list[tuple[int, int]]":
 
 
 def run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
+    from geomesa_tpu.profiling import profile
+
+    with profile("query.scan"):
+        return _run_query(built, plan)
+
+
+def _run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
     import jax
 
     parts = built.prune(plan.ranges)

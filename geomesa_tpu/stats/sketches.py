@@ -390,7 +390,9 @@ class Z3HistogramStat(Stat):
                 np.minimum(lo + width, q1) - np.maximum(lo, q0), 0.0, width
             ) / width
 
-        # time fraction is envelope-independent: compute it once
+        # time fraction is envelope-independent: compute it once. Disjoint
+        # query intervals SUM their per-cell coverage (clipped to 1);
+        # max would undercount an OR of ranges landing in one cell
         tf = np.zeros(len(keys), dtype=np.float64)
         for t0, t1 in t_intervals_ms:
             b0, o0 = to_binned_time(np.int64(t0), period)
@@ -401,16 +403,46 @@ class Z3HistogramStat(Stat):
             q0 = np.where(bins == b0, o0, 0.0)
             q1 = np.where(bins == b1, o1, mx_off)
             inside = (bins >= b0) & (bins <= b1)
-            tf = np.maximum(
-                tf, np.where(inside, overlap(ct0, cw_t, q0, q1), 0.0)
-            )
-        frac = np.zeros(len(keys), dtype=np.float64)
+            tf += np.where(inside, overlap(ct0, cw_t, q0, q1), 0.0)
+        tf = np.clip(tf, 0.0, 1.0)
+        sp = np.zeros(len(keys), dtype=np.float64)
         for env, _ in envelopes:
-            sp = overlap(cx0, cw_x, env.xmin, env.xmax) * overlap(
+            sp += overlap(cx0, cw_x, env.xmin, env.xmax) * overlap(
                 cy0, cw_y, env.ymin, env.ymax
             )
-            frac = np.maximum(frac, sp * tf)
-        return float((cnts * frac).sum())
+        sp = np.clip(sp, 0.0, 1.0)
+        return float((cnts * sp * tf).sum())
+
+    def estimate_spatial(self, envelopes) -> float:
+        """Estimated rows intersecting any envelope, time-marginalized
+        (drives z2/xz2 costing with the same data-aware model as z3)."""
+        from geomesa_tpu.curves.zorder import decode_3d_np
+
+        if not self.counts or not envelopes:
+            return 0.0
+        bpd = self.prefix_bits // 3
+        grid = 1 << bpd
+        cw_x, cw_y = 360.0 / grid, 180.0 / grid
+        keys = np.fromiter(self.counts.keys(), dtype=np.int64)
+        cnts = np.fromiter(self.counts.values(), dtype=np.float64)
+        prefix = (keys & np.int64((1 << self.prefix_bits) - 1)).astype(np.uint64)
+        ix, iy, _ = decode_3d_np(prefix << np.uint64(63 - self.prefix_bits))
+        ix = (ix >> np.uint64(21 - bpd)).astype(np.int64)
+        iy = (iy >> np.uint64(21 - bpd)).astype(np.int64)
+        cx0 = -180.0 + ix * cw_x
+        cy0 = -90.0 + iy * cw_y
+
+        def overlap(lo, width, q0, q1):
+            return np.clip(
+                np.minimum(lo + width, q1) - np.maximum(lo, q0), 0.0, width
+            ) / width
+
+        sp = np.zeros(len(keys), dtype=np.float64)
+        for env, _ in envelopes:
+            sp += overlap(cx0, cw_x, env.xmin, env.xmax) * overlap(
+                cy0, cw_y, env.ymin, env.ymax
+            )
+        return float((cnts * np.clip(sp, 0.0, 1.0)).sum())
 
     def to_json(self):
         return {

@@ -212,6 +212,7 @@ def build_default_stats(sft: SimpleFeatureType, data: "FeatureBatch | None"):
     schemas. Used by the stats API/CLI and selectivity estimates."""
     from geomesa_tpu.stats import SeqStat
     from geomesa_tpu.stats.sketches import (
+        Cardinality,
         CountStat,
         MinMax,
         Z3HistogramStat,
@@ -221,6 +222,9 @@ def build_default_stats(sft: SimpleFeatureType, data: "FeatureBatch | None"):
     for a in sft.attributes:
         if a.column_dtype is not None and a.column_dtype != np.bool_:
             stats.append(MinMax(a.name))
+        if a.indexed and not a.is_geometry:
+            # equality-selectivity input for the stat-based planner
+            stats.append(Cardinality(a.name))
     geom, dtg = sft.geom_field, sft.dtg_field
     if geom and dtg and sft.descriptor(geom).is_point:
         stats.append(Z3HistogramStat(geom, dtg, sft.z3_interval))

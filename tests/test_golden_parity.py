@@ -1,0 +1,103 @@
+"""Golden parity fixtures (SURVEY.md section 4 rebuild plan item 4):
+a deterministic GDELT-like slice with canned queries whose exact
+feature-id sets are pinned. Guards cross-round regressions in the whole
+stack (quantization, range decomposition, planner, scan, residuals) --
+any drift in the result SET is a correctness break even if counts match.
+
+The fixture is self-seeding: ids are derived from a fixed RNG; expected
+sets were computed by the host oracle (evaluate_host) and are asserted
+against BOTH the oracle and every store implementation, so the pins catch
+oracle drift too.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.filter.compile import evaluate_host
+from geomesa_tpu.filter.ecql import parse_ecql, parse_instant
+from geomesa_tpu.store.fs import FileSystemDataStore
+from geomesa_tpu.store.kv import KVDataStore, MemoryKV
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "name:String,val:Int,dtg:Date,*geom:Point:srid=4326"
+N = 20000
+
+QUERIES = [
+    "BBOX(geom, -10, 35, 30, 60) AND "
+    "dtg DURING 2020-01-05T00:00:00Z/2020-01-20T00:00:00Z",
+    "BBOX(geom, 100, -50, 179, 20) AND name = 'a'",
+    "val BETWEEN 10 AND 20 AND BBOX(geom, -180, -90, 0, 90)",
+    "dtg DURING 2020-02-01T00:00:00Z/2020-02-02T00:00:00Z",
+    "BBOX(geom, -0.5, -0.5, 0.5, 0.5)",
+]
+
+# sha256 of the sorted hit-id list, comma-joined -- pinned golden outputs.
+# If an intentional semantic change moves these, recompute via
+# _digest(oracle_ids) and document why in the commit message.
+GOLDEN = {
+    0: "290f6059137d1f5094134bddd4f427e2d9cbac02fa375122808d705d02480bff",  # 82 hits
+    1: "2a3cdc5345205613de4c74717d57339b95a7a38b367c2286a61d5ef5890dd110",  # 547 hits
+    2: "044fb3a8f6ed17fae37eb9f662765c7e83c7ba7ffd608fb12d85136935f24e7a",  # 1097 hits
+    3: "bd707307e77798394ad31b8b5590d8a211aa669b96e5492dc0231e272f12ea81",  # 344 hits
+    4: "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855",  # 0 hits
+}
+
+
+def _data():
+    rng = np.random.default_rng(20260730)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    return {
+        "name": rng.choice(["a", "b", "c"], N),
+        "val": rng.integers(0, 100, N),
+        "dtg": rng.integers(t0, t1, N),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, N), rng.uniform(-90, 90, N)], axis=1
+        ),
+    }
+
+
+def _digest(ids) -> str:
+    joined = ",".join(str(i) for i in sorted(int(v) for v in ids))
+    return hashlib.sha256(joined.encode()).hexdigest()
+
+
+@pytest.fixture(scope="module")
+def oracle_sets():
+    sft = SimpleFeatureType.create("g", SPEC)
+    batch = FeatureBatch.from_columns(sft, _data(), np.arange(N))
+    out = {}
+    for i, q in enumerate(QUERIES):
+        mask = evaluate_host(parse_ecql(q), batch)
+        out[i] = set(batch.fids[mask].tolist())
+    return out
+
+
+def test_oracle_matches_golden_digests(oracle_sets):
+    for i, ids in oracle_sets.items():
+        assert _digest(ids) == GOLDEN[i], f"query {i} drifted from golden"
+
+
+@pytest.mark.parametrize(
+    "make_store",
+    [
+        lambda tmp: MemoryDataStore(),
+        lambda tmp: KVDataStore(MemoryKV()),
+        lambda tmp: FileSystemDataStore(str(tmp), partition_size=2048),
+    ],
+    ids=["memory", "kv", "fs"],
+)
+def test_stores_match_golden(tmp_path, oracle_sets, make_store):
+    ds = make_store(tmp_path)
+    ds.create_schema("g", SPEC)
+    ds.write("g", _data(), fids=np.arange(N))
+    if hasattr(ds, "flush"):
+        ds.flush("g")
+    for i, q in enumerate(QUERIES):
+        got = set(int(v) for v in ds.query("g", q).batch.fids)
+        assert got == oracle_sets[i], f"query {i}: store != oracle"
+        assert _digest(got) == GOLDEN[i], f"query {i}: store != golden"

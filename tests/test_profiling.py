@@ -1,0 +1,39 @@
+"""Profiling registry + instrumentation of the query path."""
+
+import numpy as np
+
+from geomesa_tpu import profiling
+from geomesa_tpu.store.memory import MemoryDataStore
+
+
+def test_profile_registry():
+    profiling.reset()
+    with profiling.profile("unit.block"):
+        pass
+
+    @profiling.profiled("unit.fn")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2 and f(2) == 3
+    t = profiling.timings()
+    assert t["unit.block"]["count"] == 1
+    assert t["unit.fn"]["count"] == 2
+    assert "unit.fn" in profiling.report()
+    profiling.reset()
+    assert profiling.timings() == {}
+
+
+def test_query_path_is_instrumented():
+    profiling.reset()
+    ds = MemoryDataStore()
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    ds.write(
+        "t",
+        {"dtg": np.arange(100) * 1000, "geom": np.zeros((100, 2))},
+        fids=np.arange(100),
+    )
+    ds.query("t", "BBOX(geom, -1, -1, 1, 1)")
+    t = profiling.timings()
+    assert t.get("query.scan", {}).get("count", 0) >= 1
+    profiling.reset()

@@ -121,3 +121,60 @@ def test_huge_in_list_does_not_exceed_total():
     plan = ds.plan("t", f"val IN ({vals})")
     costs = dict(plan.candidates)
     assert costs["attr:val"] <= 2000
+
+
+def test_clustered_data_same_model_for_z2_and_z3():
+    # all points in one 4x4-degree box: z2 must not win on a bogus
+    # uniform-area assumption when z3 prunes time too
+    ds = MemoryDataStore()
+    ds.create_schema("t", SPEC)
+    n = 20000
+    rng = np.random.default_rng(11)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    ds.write(
+        "t",
+        {
+            "name": ["a"] * n,
+            "val": rng.integers(0, 10, n),
+            "dtg": rng.integers(t0, t1, n),
+            "geom": np.stack(
+                [rng.uniform(10, 14, n), rng.uniform(40, 44, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    plan = ds.plan(
+        "t",
+        "BBOX(geom, 10, 40, 14, 44) AND "
+        "dtg DURING 2020-01-08T00:00:00Z/2020-01-15T00:00:00Z",
+    )
+    costs = dict(plan.candidates)
+    assert plan.index_name == "z3"
+    assert costs["z3"] < costs["z2"]
+    # both spatial candidates use the histogram: z2's estimate is far above
+    # the bogus uniform-area number (4x4 deg / whole world * n would be ~5)
+    assert costs["z2"] > 100
+
+
+def test_low_cardinality_equality_estimate():
+    # 'name' has 2 values; equality selectivity must come from the HLL,
+    # not a 0.1% guess (indexed attribute -> cardinality stat exists)
+    ds = MemoryDataStore()
+    ds.create_schema("t", "name:String:index=true,dtg:Date,*geom:Point")
+    n = 10000
+    rng = np.random.default_rng(4)
+    ds.write(
+        "t",
+        {
+            "name": rng.choice(["a", "b"], n),
+            "dtg": rng.integers(0, 10**9, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    plan = ds.plan("t", "name = 'a'")
+    costs = dict(plan.candidates)
+    assert 0.3 * n <= costs["attr:name"] <= 0.7 * n  # ~n/2, not n/1000

@@ -229,9 +229,10 @@ class _StatEstimator:
     def attr_cost(self, attr, eq, bounds) -> float:
         if eq is not None:
             card = self.cardinality.get(attr)
-            if card is not None and card.estimate >= 1.0:
+            distinct = card.estimate if card is not None else 0.0
+            if distinct >= 1.0:
                 # rows per distinct value x values requested (HLL-backed)
-                per_value = self.total / card.estimate
+                per_value = self.total / distinct
             else:
                 per_value = self.total * 0.001  # high-cardinality guess
             return max(1.0, min(self.total, per_value * len(eq)))

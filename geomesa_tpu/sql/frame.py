@@ -87,6 +87,32 @@ class SpatialFrame:
 
         return batch_to_arrow(self.collect())
 
+    def to_pandas(self):
+        """Collect as a pandas DataFrame (fid index; geometries as
+        objects, points as WKT like the reference's DataFrame view)."""
+        import pandas as pd
+
+        batch = self.collect()
+        data = {}
+        for name in batch.sft.attribute_names:
+            c = batch.columns[name]
+            desc = batch.sft.descriptor(name)
+            if desc.is_point and c.dtype != object:
+                from geomesa_tpu.geom import Point, to_wkt
+
+                data[name] = [
+                    to_wkt(Point(float(x), float(y))) for x, y in c
+                ]
+            elif desc.is_geometry:
+                from geomesa_tpu.geom import to_wkt
+
+                data[name] = [to_wkt(g) for g in c]
+            elif desc.type_name == "Date":
+                data[name] = np.array(c, dtype="datetime64[ms]")
+            else:
+                data[name] = c
+        return pd.DataFrame(data, index=pd.Index(batch.fids, name="fid"))
+
     def column(self, name: str) -> np.ndarray:
         return self.collect().column(name)
 

@@ -359,40 +359,16 @@ class Z3HistogramStat(Stat):
         """Estimated rows intersecting any (envelope, time-interval) pair
         (ref: the stat-based side of StrategyDecider). Each occupancy
         cell's count is prorated by the fraction of its (lon, lat, time)
-        box the query covers (uniform-within-cell assumption), so the
-        estimate stays comparable with plain area-fraction costing."""
-        from geomesa_tpu.curves import TimePeriod
-        from geomesa_tpu.curves.binnedtime import max_offset, to_binned_time
-        from geomesa_tpu.curves.zorder import decode_3d_np
+        box the query covers (uniform-within-cell assumption); disjoint
+        query ranges SUM their per-cell coverage (clipped to 1)."""
+        from geomesa_tpu.curves.binnedtime import to_binned_time
 
         if not self.counts or not envelopes or not t_intervals_ms:
             return 0.0
-        period = TimePeriod.parse(self.period)
-        mx_off = float(max_offset(period))
-        bpd = self.prefix_bits // 3
-        grid = 1 << bpd
-        cw_x, cw_y, cw_t = 360.0 / grid, 180.0 / grid, mx_off / grid
-        keys = np.fromiter(self.counts.keys(), dtype=np.int64)
-        cnts = np.fromiter(self.counts.values(), dtype=np.float64)
-        bins = keys >> np.int64(self.prefix_bits)
-        prefix = (keys & np.int64((1 << self.prefix_bits) - 1)).astype(np.uint64)
-        ix, iy, it = decode_3d_np(prefix << np.uint64(63 - self.prefix_bits))
-        # cell index at bpd-bit resolution per dimension
-        ix = (ix >> np.uint64(21 - bpd)).astype(np.int64)
-        iy = (iy >> np.uint64(21 - bpd)).astype(np.int64)
-        it = (it >> np.uint64(21 - bpd)).astype(np.int64)
-        cx0 = -180.0 + ix * cw_x
-        cy0 = -90.0 + iy * cw_y
-        ct0 = it * cw_t  # period-offset units
-
-        def overlap(lo, width, q0, q1):
-            return np.clip(
-                np.minimum(lo + width, q1) - np.maximum(lo, q0), 0.0, width
-            ) / width
-
-        # time fraction is envelope-independent: compute it once. Disjoint
-        # query intervals SUM their per-cell coverage (clipped to 1);
-        # max would undercount an OR of ranges landing in one cell
+        keys, cnts, bins, (cx0, cy0, ct0), (cw_x, cw_y, cw_t), mx_off, period = (
+            self._cells()
+        )
+        # time fraction is envelope-independent: compute it once
         tf = np.zeros(len(keys), dtype=np.float64)
         for t0, t1 in t_intervals_ms:
             b0, o0 = to_binned_time(np.int64(t0), period)
@@ -403,46 +379,60 @@ class Z3HistogramStat(Stat):
             q0 = np.where(bins == b0, o0, 0.0)
             q1 = np.where(bins == b1, o1, mx_off)
             inside = (bins >= b0) & (bins <= b1)
-            tf += np.where(inside, overlap(ct0, cw_t, q0, q1), 0.0)
+            tf += np.where(inside, self._overlap(ct0, cw_t, q0, q1), 0.0)
         tf = np.clip(tf, 0.0, 1.0)
-        sp = np.zeros(len(keys), dtype=np.float64)
+        sp = self._spatial_fraction(envelopes, cx0, cy0, cw_x, cw_y)
+        return float((cnts * sp * tf).sum())
+
+    def _cells(self):
+        """Decode occupancy keys -> (keys, counts, bins, cx0, cy0, ct0) cell
+        origins at the coarse grid resolution (shared by both estimators)."""
+        from geomesa_tpu.curves import TimePeriod
+        from geomesa_tpu.curves.binnedtime import max_offset
+        from geomesa_tpu.curves.zorder import decode_3d_np
+
+        period = TimePeriod.parse(self.period)
+        mx_off = float(max_offset(period))
+        bpd = self.prefix_bits // 3
+        grid = 1 << bpd
+        keys = np.fromiter(self.counts.keys(), dtype=np.int64)
+        cnts = np.fromiter(self.counts.values(), dtype=np.float64)
+        bins = keys >> np.int64(self.prefix_bits)
+        prefix = (keys & np.int64((1 << self.prefix_bits) - 1)).astype(np.uint64)
+        ix, iy, it = decode_3d_np(prefix << np.uint64(63 - self.prefix_bits))
+        ix = (ix >> np.uint64(21 - bpd)).astype(np.int64)
+        iy = (iy >> np.uint64(21 - bpd)).astype(np.int64)
+        it = (it >> np.uint64(21 - bpd)).astype(np.int64)
+        cw = (360.0 / grid, 180.0 / grid, mx_off / grid)
+        origins = (
+            -180.0 + ix * cw[0],
+            -90.0 + iy * cw[1],
+            it * cw[2],
+        )
+        return keys, cnts, bins, origins, cw, mx_off, period
+
+    @staticmethod
+    def _overlap(lo, width, q0, q1):
+        return np.clip(
+            np.minimum(lo + width, q1) - np.maximum(lo, q0), 0.0, width
+        ) / width
+
+    def _spatial_fraction(self, envelopes, cx0, cy0, cw_x, cw_y):
+        sp = np.zeros(len(cx0), dtype=np.float64)
         for env, _ in envelopes:
-            sp += overlap(cx0, cw_x, env.xmin, env.xmax) * overlap(
+            sp += self._overlap(cx0, cw_x, env.xmin, env.xmax) * self._overlap(
                 cy0, cw_y, env.ymin, env.ymax
             )
-        sp = np.clip(sp, 0.0, 1.0)
-        return float((cnts * sp * tf).sum())
+        return np.clip(sp, 0.0, 1.0)
 
     def estimate_spatial(self, envelopes) -> float:
         """Estimated rows intersecting any envelope, time-marginalized
         (drives z2/xz2 costing with the same data-aware model as z3)."""
-        from geomesa_tpu.curves.zorder import decode_3d_np
-
         if not self.counts or not envelopes:
             return 0.0
-        bpd = self.prefix_bits // 3
-        grid = 1 << bpd
-        cw_x, cw_y = 360.0 / grid, 180.0 / grid
-        keys = np.fromiter(self.counts.keys(), dtype=np.int64)
-        cnts = np.fromiter(self.counts.values(), dtype=np.float64)
-        prefix = (keys & np.int64((1 << self.prefix_bits) - 1)).astype(np.uint64)
-        ix, iy, _ = decode_3d_np(prefix << np.uint64(63 - self.prefix_bits))
-        ix = (ix >> np.uint64(21 - bpd)).astype(np.int64)
-        iy = (iy >> np.uint64(21 - bpd)).astype(np.int64)
-        cx0 = -180.0 + ix * cw_x
-        cy0 = -90.0 + iy * cw_y
-
-        def overlap(lo, width, q0, q1):
-            return np.clip(
-                np.minimum(lo + width, q1) - np.maximum(lo, q0), 0.0, width
-            ) / width
-
-        sp = np.zeros(len(keys), dtype=np.float64)
-        for env, _ in envelopes:
-            sp += overlap(cx0, cw_x, env.xmin, env.xmax) * overlap(
-                cy0, cw_y, env.ymin, env.ymax
-            )
-        return float((cnts * np.clip(sp, 0.0, 1.0)).sum())
+        _, cnts, _, (cx0, cy0, _), (cw_x, cw_y, _), _, _ = self._cells()
+        sp = self._spatial_fraction(envelopes, cx0, cy0, cw_x, cw_y)
+        return float((cnts * sp).sum())
 
     def to_json(self):
         return {

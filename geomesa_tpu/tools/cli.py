@@ -87,24 +87,20 @@ def cmd_remove_schema(args):
 
 
 def cmd_ingest(args):
-    from geomesa_tpu.convert import converter_for
-
     store = _store(args)
-    sft = store.get_schema(args.feature_name)
     with open(args.converter) as fh:
         config = json.load(fh)
-    conv = converter_for(config, sft)
-    binary = getattr(conv, "binary", False)
-    total = failed = 0
-    for path in args.files:
-        with open(path, "rb" if binary else "r") as fh:
-            res = conv.process(fh.read())
-        store.write(args.feature_name, res.batch)
-        total += res.success
-        failed += res.failed
-        print(f"  {path}: {res.success} ingested, {res.failed} failed")
-    store.flush(args.feature_name)
-    print(f"ingested {total} features ({failed} failed)")
+    from geomesa_tpu.jobs import parallel_ingest
+
+    rep = parallel_ingest(
+        store, args.feature_name, config, args.files,
+        workers=args.workers,
+    )
+    for path, err in rep.errors:
+        print(f"  {path}: ERROR {err}", file=sys.stderr)
+    print(f"ingested {rep.success} features ({rep.failed} failed)")
+    if rep.errors:
+        sys.exit(1)
 
 
 def cmd_export(args):
@@ -512,6 +508,8 @@ def main(argv=None) -> None:
     sp = add("ingest", cmd_ingest)
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-C", "--converter", required=True, help="converter config json")
+    sp.add_argument("-t", "--workers", type=int, default=4,
+                    help="parser thread pool size (ref LocalConverterIngest)")
     sp.add_argument("files", nargs="+")
 
     sp = add("export", cmd_export)

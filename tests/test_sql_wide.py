@@ -291,3 +291,43 @@ def test_frame_spatial_join(tmp_path):
     in_sw = (g[:, 0] >= -5) & (g[:, 0] <= 0) & (g[:, 1] >= -5) & (g[:, 1] <= 0)
     in_ne = (g[:, 0] >= 0) & (g[:, 0] <= 5) & (g[:, 1] >= 0) & (g[:, 1] <= 5)
     assert len(pairs) == int(in_sw.sum() + in_ne.sum())
+
+
+def test_frame_to_pandas(tmp_path):
+    ds = _fill_store(tmp_path, n=200)
+    df = SpatialFrame(ds, "t").where("BBOX(geom, -10, -10, 10, 10)").to_pandas()
+    assert df.index.name == "fid"
+    assert set(df.columns) == {"name", "val", "dtg", "geom"}
+    assert len(df) == SpatialFrame(ds, "t").where("BBOX(geom, -10, -10, 10, 10)").count()
+    assert df["geom"].iloc[0].startswith("POINT")
+    assert str(df["dtg"].dtype).startswith("datetime64")
+
+
+def test_cli_ingest_workers(tmp_path, capsys):
+    import json as _json
+
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.tools.cli import main
+
+    root = str(tmp_path / "store")
+    FileSystemDataStore(root).create_schema(
+        "t", "name:String,*geom:Point"
+    )
+    files = []
+    for i in range(3):
+        p = tmp_path / f"in{i}.csv"
+        p.write_text(f"x{i},1.0,2.0\ny{i},3.0,4.0\n")
+        files.append(str(p))
+    conv = tmp_path / "c.json"
+    conv.write_text(_json.dumps({
+        "type": "delimited-text", "format": "csv", "id-field": "$1",
+        "fields": [
+            {"name": "name", "transform": "$1"},
+            {"name": "geom", "transform": "point($2::double, $3::double)"},
+        ],
+    }))
+    main(["--root", root, "ingest", "-f", "t", "-C", str(conv),
+          "-t", "3", *files])
+    assert "ingested 6 features" in capsys.readouterr().out
+    main(["--root", root, "count", "-f", "t"])
+    assert int(capsys.readouterr().out) == 6

@@ -24,14 +24,30 @@ def _hash64(values: np.ndarray) -> np.ndarray:
         h *= np.uint64(0x94D049BB133111EB)
         h ^= h >> np.uint64(31)
         return h
-    out = np.empty(len(values), dtype=np.uint64)
-    for i, v in enumerate(values):
-        out[i] = np.uint64(
-            int.from_bytes(
-                hashlib.blake2b(str(v).encode(), digest_size=8).digest(), "little"
-            )
-        )
-    return out
+    # strings/objects: vectorized FNV-1a over a fixed-width byte matrix
+    # (the per-element blake2b loop made stats updates the fs-flush
+    # bottleneck at bench scales). Rows longer than 256 bytes hash their
+    # prefix -- fine for sketch-quality hashing.
+    s = np.asarray(values, dtype="U")
+    b = np.char.encode(s, "utf-8", "replace")
+    if b.dtype.itemsize == 0:  # all-empty column
+        return np.full(len(b), np.uint64(0xCBF29CE484222325))
+    width = min(b.dtype.itemsize, 256)
+    mat = np.frombuffer(
+        np.ascontiguousarray(b).tobytes(), dtype=np.uint8
+    ).reshape(len(b), b.dtype.itemsize)[:, :width]
+    h = np.full(len(b), np.uint64(0xCBF29CE484222325))
+    prime = np.uint64(0x100000001B3)
+    live = np.ones(len(b), dtype=bool)
+    for j in range(width):
+        c = mat[:, j]
+        live = live & (c != 0)  # S-dtype zero-pads; stop at first NUL
+        h = np.where(live, (h ^ c.astype(np.uint64)) * prime, h)
+    # final avalanche so short strings spread across the register space
+    h ^= h >> np.uint64(33)
+    h *= np.uint64(0xFF51AFD7ED558CCD)
+    h ^= h >> np.uint64(33)
+    return h
 
 
 def _bit_length(x: np.ndarray) -> np.ndarray:
@@ -443,4 +459,55 @@ class Z3HistogramStat(Stat):
             "prefix_bits": self.prefix_bits,
             "nonzero": len(self.counts),
             "total": sum(self.counts.values()),
+            # full occupancy map: needed for the round-trip that feeds
+            # reopened stores' stat-based planning
+            "cells": {str(k): int(v) for k, v in self.counts.items()},
         }
+
+
+# -- JSON codec (store-metadata persistence; completes to_json round-trip) ---
+
+
+def stat_from_json(d: dict):
+    """Inverse of each Stat.to_json (used by store metadata persistence;
+    no pickle: manifests are plain JSON an operator may edit)."""
+    import base64
+
+    t = d.get("type")
+    if t == "count":
+        return CountStat(count=int(d["count"]))
+    if t == "minmax":
+        return MinMax(d["attr"], d.get("min"), d.get("max"), int(d.get("count", 0)))
+    if t == "cardinality":
+        regs = np.frombuffer(
+            base64.b64decode(d["registers"]), dtype=np.uint8
+        ).copy()
+        return Cardinality(d["attr"], int(d["p"]), regs)
+    if t == "topk":
+        s = TopK(d["attr"], int(d.get("k", 10)))
+        s.counters = {k: int(v) for k, v in d.get("counters", {}).items()}
+        return s
+    if t == "histogram":
+        s = Histogram(d["attr"], int(d["bins"]), float(d["lo"]), float(d["hi"]))
+        s.counts = np.asarray(d["counts"], dtype=np.int64)
+        return s
+    if t == "z3histogram":
+        s = Z3HistogramStat(
+            d["geom"],
+            d["dtg"],
+            d.get("period", "week"),
+            int(d.get("prefix_bits", 12)),
+        )
+        s.counts = {int(k): int(v) for k, v in d.get("cells", {}).items()}
+        return s
+    raise ValueError(f"unknown stat json type {t!r}")
+
+
+def seq_to_json(seq) -> list:
+    return [s.to_json() for s in seq.stats]
+
+
+def seq_from_json(items: list):
+    from geomesa_tpu.stats.dsl import SeqStat
+
+    return SeqStat([stat_from_json(d) for d in items])

@@ -52,6 +52,7 @@ class _FsTypeState:
     cache: "dict[int, FeatureBatch]" = field(default_factory=dict)
     encoding: str = "parquet"
     scheme: "object | None" = None  # PartitionScheme, from SFT user data
+    stats: "object | None" = None  # SeqStat rebuilt at flush, persisted
 
 
 def _write_table(table, path: str, encoding: str) -> None:
@@ -134,7 +135,19 @@ class FileSystemDataStore:
             else None,
             encoding=meta.get("encoding", "parquet"),
             scheme=self._scheme_of(sft, strict=False),
+            stats=self._load_stats(meta.get("stats")),
         )
+
+    @staticmethod
+    def _load_stats(raw):
+        if not raw:
+            return None
+        from geomesa_tpu.stats.sketches import seq_from_json
+
+        try:
+            return seq_from_json(raw)
+        except Exception:
+            return None  # stats are advisory; never block opening
 
     @staticmethod
     def _scheme_of(sft: SimpleFeatureType, strict: bool = True):
@@ -164,11 +177,14 @@ class FileSystemDataStore:
 
     def _save_meta(self, name: str) -> None:
         st = self._types[name]
+        from geomesa_tpu.stats.sketches import seq_to_json
+
         meta = {
             "spec": st.sft.spec,
             "primary": st.primary,
             "encoding": st.encoding,
             "data_interval": st.data_interval,
+            "stats": seq_to_json(st.stats) if st.stats is not None else None,
             "partitions": [
                 {
                     "pid": p.pid,
@@ -288,6 +304,9 @@ class FileSystemDataStore:
         if dtg is not None and len(full):
             col = full.column(dtg)
             st.data_interval = (int(col.min()), int(col.max()))
+        from geomesa_tpu.store.memory import build_default_stats
+
+        st.stats = build_default_stats(st.sft, full)
         self._save_meta(type_name)
 
     def _part_path(self, type_name: str, p: PartitionMeta) -> str:
@@ -391,7 +410,11 @@ class FileSystemDataStore:
         self.flush(type_name)
         ks = keyspace_for(st.sft, st.primary)
         return plan_query(
-            st.sft, {st.primary: ks}, as_query(query), data_interval=st.data_interval
+            st.sft,
+            {st.primary: ks},
+            as_query(query),
+            data_interval=st.data_interval,
+            stats=st.stats,
         )
 
     def _pruned_parts(self, type_name: str, plan: QueryPlan) -> list:

@@ -40,7 +40,6 @@ from __future__ import annotations
 
 import bisect
 import json
-import pickle
 import sqlite3
 import struct
 import time as _time
@@ -107,6 +106,23 @@ _COL_ENC = {
     "value": _enc_attr,
     "fid": _enc_attr,
 }
+
+
+def _stats_bytes(seq) -> bytes:
+    """Stats persist as the JSON codec (no pickle in store metadata)."""
+    import json as _json
+
+    from geomesa_tpu.stats.sketches import seq_to_json
+
+    return _json.dumps(seq_to_json(seq)).encode("utf-8")
+
+
+def _stats_from_bytes(raw: bytes):
+    import json as _json
+
+    from geomesa_tpu.stats.sketches import seq_from_json
+
+    return seq_from_json(_json.loads(raw.decode("utf-8")))
 
 
 def _keyspace_attrs(ks) -> set:
@@ -531,7 +547,9 @@ class KVDataStore:
         # stats + data interval (ref StatUpdater flush)
         st = self.stats(type_name)
         st.observe_batch(batch)
-        self._meta_put(f"{type_name}~stats", pickle.dumps(st))
+        self._meta_put(
+            f"{type_name}~stats", _stats_bytes(st)
+        )
         dtg = sft.dtg_field
         if dtg is not None:
             col = batch.column(dtg)
@@ -563,7 +581,9 @@ class KVDataStore:
         for s in st.stats:
             if isinstance(s, CountStat):
                 s.count = max(0, s.count - n)
-        self._meta_put(f"{type_name}~stats", pickle.dumps(st))
+        self._meta_put(
+            f"{type_name}~stats", _stats_bytes(st)
+        )
 
     def delete(self, type_name: str, fids) -> int:
         batch = self.get_by_ids(type_name, fids)
@@ -584,7 +604,7 @@ class KVDataStore:
         if type_name not in self._stats:
             raw = self._meta_get(f"{type_name}~stats")
             if raw is not None:
-                self._stats[type_name] = pickle.loads(raw)
+                self._stats[type_name] = _stats_from_bytes(raw)
             else:
                 from geomesa_tpu.store.memory import build_default_stats
 

@@ -178,3 +178,77 @@ def test_low_cardinality_equality_estimate():
     plan = ds.plan("t", "name = 'a'")
     costs = dict(plan.candidates)
     assert 0.3 * n <= costs["attr:name"] <= 0.7 * n  # ~n/2, not n/1000
+
+
+def test_fs_store_stats_persist_and_plan(tmp_path):
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    ds = _fill(FileSystemDataStore(str(tmp_path)))
+    ds.flush("t")
+    plan = ds.plan(
+        "t",
+        "BBOX(geom, -10, 35, 30, 60) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-01-15T00:00:00Z",
+    )
+    cost = dict(plan.candidates)["z3"]
+    assert cost < 20000  # stat-based rows estimate, not a heuristic constant
+    # reopened store keeps the stats (no rescan needed to plan well)
+    ds2 = FileSystemDataStore(str(tmp_path))
+    plan2 = ds2.plan(
+        "t",
+        "BBOX(geom, -10, 35, 30, 60) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-01-15T00:00:00Z",
+    )
+    assert abs(dict(plan2.candidates)["z3"] - cost) < 1e-6
+
+
+def test_stats_json_codec_roundtrip():
+    # every stat type round-trips through the JSON codec (no pickle in
+    # store manifests) with estimates preserved
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.stats.sketches import seq_from_json, seq_to_json
+    from geomesa_tpu.store.memory import build_default_stats
+    import json as _json
+
+    sft = SimpleFeatureType.create("t", SPEC)
+    rng = np.random.default_rng(2)
+    n = 3000
+    t0 = parse_instant("2020-01-01T00:00:00")
+    batch = FeatureBatch.from_columns(
+        sft,
+        {
+            "name": rng.choice(["a", "b", "c"], n),
+            "val": rng.integers(0, 50, n),
+            "dtg": t0 + rng.integers(0, 10**9, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+            ),
+        },
+        np.arange(n),
+    )
+    seq = build_default_stats(sft, batch)
+    doc = _json.loads(_json.dumps(seq_to_json(seq)))  # strict JSON round-trip
+    rt = seq_from_json(doc)
+    for a, b in zip(seq.stats, rt.stats):
+        assert type(a) is type(b)
+        assert a.to_json() == b.to_json()
+
+
+def test_string_hash_vectorized_quality():
+    from geomesa_tpu.stats.sketches import Cardinality
+
+    # 50k distinct strings incl. shared prefixes: HLL estimate within 5%
+    vals = np.array(
+        [f"prefix-common-{i:06d}-suffix" for i in range(50000)], dtype=object
+    )
+    c = Cardinality("s")
+    c.observe(vals)
+    assert abs(c.estimate - 50000) / 50000 < 0.05
+    # equal values hash equally across calls
+    c2 = Cardinality("s")
+    c2.observe(vals[:1000])
+    c2.observe(vals[:1000])
+    c3 = Cardinality("s")
+    c3.observe(vals[:1000])
+    assert abs(c2.estimate - c3.estimate) < 1e-9

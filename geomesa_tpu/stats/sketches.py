@@ -205,6 +205,7 @@ class TopK(Stat):
     def observe(self, values):
         vals, counts = np.unique(np.asarray(values), return_counts=True)
         for v, c in zip(vals.tolist(), counts.tolist()):
+            v = str(v)  # canonical str keys: survives the JSON round trip
             if v in self.counters:
                 self.counters[v] += c
             elif len(self.counters) < self.k * 4:
@@ -216,6 +217,7 @@ class TopK(Stat):
 
     def merge(self, other):
         for v, c in other.counters.items():
+            v = str(v)
             self.counters[v] = self.counters.get(v, 0) + c
         return self
 
@@ -252,7 +254,8 @@ class Frequency(Stat):
         h = _hash64(v)
         for d in range(self.depth):
             # derive row hash: xor-fold with row-salt splitmix step
-            hd = h ^ (np.uint64(0x9E3779B97F4A7C15) * np.uint64(d + 1))
+            salt = np.uint64((0x9E3779B97F4A7C15 * (d + 1)) & 0xFFFFFFFFFFFFFFFF)
+            hd = h ^ salt
             idx = (hd % np.uint64(self.width)).astype(np.int64)
             np.add.at(self.table[d], idx, 1)
 
@@ -260,7 +263,8 @@ class Frequency(Stat):
         h = _hash64(np.array([value]))
         est = []
         for d in range(self.depth):
-            hd = h ^ (np.uint64(0x9E3779B97F4A7C15) * np.uint64(d + 1))
+            salt = np.uint64((0x9E3779B97F4A7C15 * (d + 1)) & 0xFFFFFFFFFFFFFFFF)
+            hd = h ^ salt
             est.append(int(self.table[d][int(hd[0] % np.uint64(self.width))]))
         return min(est)
 
@@ -275,6 +279,7 @@ class Frequency(Stat):
             "depth": self.depth,
             "width": self.width,
             "total": int(self.table[0].sum()),
+            "table": self.table.tolist(),
         }
 
 
@@ -491,6 +496,11 @@ def stat_from_json(d: dict):
         s = Histogram(d["attr"], int(d["bins"]), float(d["lo"]), float(d["hi"]))
         s.counts = np.asarray(d["counts"], dtype=np.int64)
         return s
+    if t == "frequency":
+        st = Frequency(d["attr"], int(d.get("depth", 4)), int(d.get("width", 1 << 12)))
+        if "table" in d:
+            st.table = np.asarray(d["table"], dtype=np.int64)
+        return st
     if t == "z3histogram":
         s = Z3HistogramStat(
             d["geom"],
@@ -501,10 +511,6 @@ def stat_from_json(d: dict):
         s.counts = {int(k): int(v) for k, v in d.get("cells", {}).items()}
         return s
     raise ValueError(f"unknown stat json type {t!r}")
-
-
-def seq_to_json(seq) -> list:
-    return [s.to_json() for s in seq.stats]
 
 
 def seq_from_json(items: list):

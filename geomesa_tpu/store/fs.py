@@ -177,14 +177,12 @@ class FileSystemDataStore:
 
     def _save_meta(self, name: str) -> None:
         st = self._types[name]
-        from geomesa_tpu.stats.sketches import seq_to_json
-
         meta = {
             "spec": st.sft.spec,
             "primary": st.primary,
             "encoding": st.encoding,
             "data_interval": st.data_interval,
-            "stats": seq_to_json(st.stats) if st.stats is not None else None,
+            "stats": st.stats.to_json() if st.stats is not None else None,
             "partitions": [
                 {
                     "pid": p.pid,

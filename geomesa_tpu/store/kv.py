@@ -112,17 +112,20 @@ def _stats_bytes(seq) -> bytes:
     """Stats persist as the JSON codec (no pickle in store metadata)."""
     import json as _json
 
-    from geomesa_tpu.stats.sketches import seq_to_json
-
-    return _json.dumps(seq_to_json(seq)).encode("utf-8")
+    return _json.dumps(seq.to_json()).encode("utf-8")
 
 
 def _stats_from_bytes(raw: bytes):
+    """None on undecodable blobs (e.g. a legacy pickled payload): stats
+    are advisory, a reopened store must keep working."""
     import json as _json
 
     from geomesa_tpu.stats.sketches import seq_from_json
 
-    return seq_from_json(_json.loads(raw.decode("utf-8")))
+    try:
+        return seq_from_json(_json.loads(raw.decode("utf-8")))
+    except Exception:
+        return None
 
 
 def _keyspace_attrs(ks) -> set:
@@ -603,8 +606,9 @@ class KVDataStore:
     def stats(self, type_name: str):
         if type_name not in self._stats:
             raw = self._meta_get(f"{type_name}~stats")
-            if raw is not None:
-                self._stats[type_name] = _stats_from_bytes(raw)
+            loaded = _stats_from_bytes(raw) if raw is not None else None
+            if loaded is not None:
+                self._stats[type_name] = loaded
             else:
                 from geomesa_tpu.store.memory import build_default_stats
 

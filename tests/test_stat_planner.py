@@ -207,7 +207,7 @@ def test_stats_json_codec_roundtrip():
     # store manifests) with estimates preserved
     from geomesa_tpu.features.batch import FeatureBatch
     from geomesa_tpu.features.sft import SimpleFeatureType
-    from geomesa_tpu.stats.sketches import seq_from_json, seq_to_json
+    from geomesa_tpu.stats.sketches import seq_from_json
     from geomesa_tpu.store.memory import build_default_stats
     import json as _json
 
@@ -228,7 +228,7 @@ def test_stats_json_codec_roundtrip():
         np.arange(n),
     )
     seq = build_default_stats(sft, batch)
-    doc = _json.loads(_json.dumps(seq_to_json(seq)))  # strict JSON round-trip
+    doc = _json.loads(_json.dumps(seq.to_json()))  # strict JSON round-trip
     rt = seq_from_json(doc)
     for a, b in zip(seq.stats, rt.stats):
         assert type(a) is type(b)
@@ -252,3 +252,42 @@ def test_string_hash_vectorized_quality():
     c3 = Cardinality("s")
     c3.observe(vals[:1000])
     assert abs(c2.estimate - c3.estimate) < 1e-9
+
+
+def test_legacy_stats_blob_does_not_brick_kv(tmp_path):
+    # a pre-JSON (pickled) ~stats blob must degrade to rebuilt defaults,
+    # not crash writes on reopen
+    import os
+    import pickle
+
+    from geomesa_tpu.store.kv import SqliteKV
+
+    path = os.path.join(str(tmp_path), "kv.db")
+    ds = _fill(KVDataStore(SqliteKV(path)), n=100)
+    ds._meta_put("t~stats", pickle.dumps({"legacy": True}))
+    ds.backend.close()
+    ds2 = KVDataStore(SqliteKV(path))
+    # write path works; stats rebuilt as advisory defaults
+    ds2.write(
+        "t",
+        {"name": ["x"], "val": [1], "dtg": [0], "geom": np.zeros((1, 2))},
+        fids=["extra"],
+    )
+    assert len(ds2.query("t", "INCLUDE")) == 101
+
+
+def test_topk_and_frequency_roundtrip_after_reobserve():
+    import json as _json
+
+    from geomesa_tpu.stats.sketches import Frequency, TopK, stat_from_json
+
+    t = TopK("v")
+    t.observe(np.array([1, 1, 2, 2, 3]))
+    rt = stat_from_json(_json.loads(_json.dumps(t.to_json())))
+    rt.observe(np.array([1, 1, 1]))
+    assert dict(rt.topk)["1"] == 5  # one canonical key, no split counts
+
+    f = Frequency("v")
+    f.observe(np.array([7, 7, 8]))
+    rf = stat_from_json(_json.loads(_json.dumps(f.to_json())))
+    assert rf.count(7) == 2 and rf.count(8) == 1

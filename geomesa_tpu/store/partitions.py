@@ -13,6 +13,7 @@ Scheme spec strings (SFT user data ``geomesa.fs.partition-scheme``):
 
 - ``z2-<n>bit[s]``   -- point grid cells, n total z bits (n/2 per dim)
 - ``xz2-<n>bit[s]``  -- non-point extent cells at XZ2 precision n
+- ``xz3-<n>bit[s]``  -- non-point extent + week-bin time cells (XZ3)
 - ``yearly | monthly | weekly | daily | hourly | minute`` -- dtg buckets
 - ``attribute:<name>`` -- one leaf per attribute value
 - comma-joined composites, e.g. ``daily,z2-2bit`` (leaf paths nest)
@@ -203,6 +204,22 @@ class Z2Scheme(PartitionScheme):
         return any(env.intersects(cell) for env, _ in geom_bounds.values)
 
 
+def _geom_envelopes(batch):
+    """Per-feature envelope bounds of the default geometry column (point
+    fast path; shared by the extent-preserving xz schemes)."""
+    geom = batch.sft.geom_field
+    col = batch.columns[geom]
+    if col.dtype != object:
+        return col[:, 0], col[:, 1], col[:, 0], col[:, 1]
+    envs = [g.envelope for g in col]
+    return (
+        np.array([e.xmin for e in envs]),
+        np.array([e.ymin for e in envs]),
+        np.array([e.xmax for e in envs]),
+        np.array([e.ymax for e in envs]),
+    )
+
+
 @dataclass
 class XZ2Scheme(PartitionScheme):
     """Non-point extent leaves: the geometry envelope's XZ2 code at
@@ -220,17 +237,7 @@ class XZ2Scheme(PartitionScheme):
         self.digits = len(str(int(max_code)))
 
     def leaves(self, batch) -> np.ndarray:
-        geom = batch.sft.geom_field
-        col = batch.columns[geom]
-        if col.dtype != object:
-            xmin = xmax = col[:, 0]
-            ymin = ymax = col[:, 1]
-        else:
-            envs = [g.envelope for g in col]
-            xmin = np.array([e.xmin for e in envs])
-            ymin = np.array([e.ymin for e in envs])
-            xmax = np.array([e.xmax for e in envs])
-            ymax = np.array([e.ymax for e in envs])
+        xmin, ymin, xmax, ymax = _geom_envelopes(batch)
         codes = self.sfc.index(xmin, ymin, xmax, ymax)
         return np.array(
             [f"{int(c):0{self.digits}d}" for c in np.atleast_1d(codes)],
@@ -245,6 +252,92 @@ class XZ2Scheme(PartitionScheme):
             for r in self.sfc.ranges(env.xmin, env.ymin, env.xmax, env.ymax):
                 if r.lower <= code <= r.upper:
                     return True
+        return False
+
+
+@dataclass
+class XZ3Scheme(PartitionScheme):
+    """Non-point spatio-temporal leaves: ``W<epoch-bin>/<xz3>`` -- the
+    geometry envelope's XZ3 code at precision ``bits`` inside its time
+    bin (ref XZ3 storage partitioning; extent-preserving like xz2, with
+    the same week-binned time as the Z3 curve)."""
+
+    bits: int
+    period: str = "week"
+    depth = 2
+
+    def __post_init__(self):
+        if not (1 <= self.bits <= 12):
+            raise ValueError("xz3 scheme bits must be in [1, 12]")
+        from geomesa_tpu.curves import TimePeriod
+        from geomesa_tpu.curves.xz3 import XZ3SFC
+
+        self.spec = f"xz3-{self.bits}bits"
+        self.sfc = XZ3SFC(TimePeriod.parse(self.period), self.bits)
+        # minimal-extent probe at the max corner: a full-extent window
+        # stops octree subdivision early and under-reports the code width
+        tm = self.sfc.t_max
+        probe = np.atleast_1d(
+            self.sfc.index(180.0, 90.0, tm, 180.0, 90.0, tm)
+        )[0]
+        self.digits = len(str(int(probe)))
+
+    def validate(self, sft) -> None:
+        if sft.geom_field is None or sft.dtg_field is None:
+            raise ValueError(
+                "xz3 partition scheme needs a geometry and a Date field"
+            )
+
+    def leaves(self, batch) -> np.ndarray:
+        from geomesa_tpu.curves.binnedtime import to_binned_time
+
+        xmin, ymin, xmax, ymax = _geom_envelopes(batch)
+        ms = np.asarray(batch.column(batch.sft.dtg_field), dtype=np.int64)
+        bins, off = to_binned_time(ms, self.period)
+        codes = np.atleast_1d(
+            self.sfc.index(xmin, ymin, off.astype(np.float64), xmax, ymax,
+                           off.astype(np.float64))
+        )
+        return np.array(
+            [
+                f"W{int(b)}/{int(c):0{self.digits}d}"
+                for b, c in zip(np.atleast_1d(bins), codes)
+            ],
+            dtype=object,
+        )
+
+    def matches(self, leaf: str, geom_bounds, time_bounds) -> bool:
+        from geomesa_tpu.curves.binnedtime import max_offset, to_binned_time
+
+        bin_part, code_part = leaf.split("/")
+        b = int(bin_part[1:])
+        code = int(code_part)
+        if time_bounds is not None and not time_bounds.unbounded:
+            mx = max_offset(self.period)
+            ok_t = False
+            windows = []
+            for t0, t1 in time_bounds.values:
+                b0, o0 = to_binned_time(np.int64(t0), self.period)
+                b1, o1 = to_binned_time(np.int64(t1), self.period)
+                if not (int(b0) <= b <= int(b1)):
+                    continue
+                ok_t = True
+                lo = float(o0) if b == int(b0) else 0.0
+                hi = float(o1) if b == int(b1) else float(mx)
+                windows.append((lo, hi))
+            if not ok_t:
+                return False
+        else:
+            windows = [(0.0, float(max_offset(self.period)))]
+        if geom_bounds is None or geom_bounds.unbounded:
+            return True
+        for env, _ in geom_bounds.values:
+            for lo, hi in windows:
+                for r in self.sfc.ranges(
+                    env.xmin, env.ymin, lo, env.xmax, env.ymax, hi
+                ):
+                    if r.lower <= code <= r.upper:
+                        return True
         return False
 
 
@@ -354,7 +447,7 @@ class CompositeScheme(PartitionScheme):
 
 # -- parsing -----------------------------------------------------------------
 
-_ZBITS = re.compile(r"^(x?z2)-(\d+)bits?$")
+_ZBITS = re.compile(r"^(x?z[23])-(\d+)bits?$")
 
 
 def scheme_for(spec: str) -> PartitionScheme:
@@ -374,8 +467,15 @@ def scheme_for(spec: str) -> PartitionScheme:
     for part in parts:
         m = _ZBITS.match(part)
         if m:
-            cls = Z2Scheme if m.group(1) == "z2" else XZ2Scheme
-            schemes.append(cls(int(m.group(2))))
+            kind = m.group(1)
+            if kind == "z2":
+                schemes.append(Z2Scheme(int(m.group(2))))
+            elif kind == "xz2":
+                schemes.append(XZ2Scheme(int(m.group(2))))
+            elif kind == "xz3":
+                schemes.append(XZ3Scheme(int(m.group(2))))
+            else:
+                raise ValueError(f"unknown partition scheme {part!r}")
         elif part in _STEPS or part == "weekly":
             schemes.append(DateTimeScheme(part))
         elif part.startswith(("attribute:", "attr:")):

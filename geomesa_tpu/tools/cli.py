@@ -351,6 +351,8 @@ def _stat_json(stat) -> dict:
             "attr": j.get("attr"),
             "estimate": round(float(stat.estimate), 1),
         }
+    j.pop("table", None)  # count-min table: thousands of ints
+    j.pop("cells", None)  # z3 histogram occupancy map
     return j
 
 

@@ -5,7 +5,6 @@ filters x 3 stores) passes clean; this seeded slice guards the property
 in CI time."""
 
 import random
-import tempfile
 
 import numpy as np
 import pytest
@@ -79,14 +78,16 @@ def _rand_filter(r: random.Random, depth=0) -> str:
 
 
 @pytest.fixture(scope="module")
-def setup():
+def setup(tmp_path_factory):
     cols = _data()
     sft = SimpleFeatureType.create("t", SPEC)
     batch = FeatureBatch.from_columns(sft, cols, np.arange(N))
     stores = {
         "memory": MemoryDataStore(),
         "kv": KVDataStore(MemoryKV()),
-        "fs": FileSystemDataStore(tempfile.mkdtemp(), partition_size=1024),
+        "fs": FileSystemDataStore(
+            str(tmp_path_factory.mktemp("fuzz_fs")), partition_size=1024
+        ),
     }
     for s in stores.values():
         s.create_schema("t", SPEC)

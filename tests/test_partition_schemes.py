@@ -208,3 +208,57 @@ def test_xz2_scheme_roundtrip(tmp_path):
     ds.flush("t")
     res = ds.query("t", "BBOX(geom, -1, -1, 3, 3)")
     assert list(res.batch.column("name")) == ["p1"]
+
+
+def test_xz3_scheme_roundtrip(tmp_path):
+    from geomesa_tpu.geom import Polygon
+
+    sft = SimpleFeatureType.create("t", "name:String,dtg:Date,*geom:Polygon")
+    sft.user_data["geomesa.fs.partition-scheme"] = "xz3-4bit"
+    ds = FileSystemDataStore(str(tmp_path))
+    ds.create_schema(sft)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    week = 7 * 86400 * 1000
+    polys = [
+        Polygon([(x, y), (x + 1, y), (x + 1, y + 1), (x, y + 1), (x, y)])
+        for x, y in [(-170, -80), (0, 0), (100, 40)]
+    ]
+    ds.write(
+        "t",
+        {
+            "name": ["p0", "p1", "p2"],
+            "dtg": [t0, t0, t0 + 3 * week],  # p2 in a different week bin
+            "geom": np.array(polys, dtype=object),
+        },
+        fids=np.arange(3),
+    )
+    ds.flush("t")
+    # leaf dirs: W<bin>/<code>
+    leaves = [p.leaf for p in ds._types["t"].partitions]
+    assert all(leaf and leaf.startswith("W") and "/" in leaf for leaf in leaves)
+    res = ds.query(
+        "t",
+        "BBOX(geom, -1, -1, 3, 3) AND "
+        "dtg DURING 2019-12-30T00:00:00Z/2020-01-08T00:00:00Z",
+    )
+    assert list(res.batch.column("name")) == ["p1"]
+    # time-only prune drops the other week bin entirely
+    res2 = ds.query(
+        "t", "dtg DURING 2019-12-30T00:00:00Z/2020-01-08T00:00:00Z"
+    )
+    assert sorted(res2.batch.column("name")) == ["p0", "p1"]
+    # scheme survives reopen
+    ds2 = FileSystemDataStore(str(tmp_path))
+    assert ds2.count("t") == 3
+
+
+def test_xz3_scheme_validation():
+    import pytest as _pytest
+
+    from geomesa_tpu.store.partitions import XZ3Scheme
+
+    s = scheme_for("xz3-4bit")
+    assert isinstance(s, XZ3Scheme)
+    sft = SimpleFeatureType.create("t", "name:String,*geom:Polygon")  # no dtg
+    with _pytest.raises(ValueError, match="Date"):
+        s.validate(sft)

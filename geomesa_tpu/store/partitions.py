@@ -333,12 +333,26 @@ class XZ3Scheme(PartitionScheme):
             return True
         for env, _ in geom_bounds.values:
             for lo, hi in windows:
-                for r in self.sfc.ranges(
+                for r in self._ranges_cached(
                     env.xmin, env.ymin, lo, env.xmax, env.ymax, hi
                 ):
                     if r.lower <= code <= r.upper:
                         return True
         return False
+
+    def _ranges_cached(self, xmin, ymin, lo, xmax, ymax, hi):
+        """matches() runs once per leaf but the octree decomposition only
+        depends on the query window: memoize it per (env, window)."""
+        if not hasattr(self, "_range_cache"):
+            self._range_cache = {}
+        key = (xmin, ymin, lo, xmax, ymax, hi)
+        if key not in self._range_cache:
+            if len(self._range_cache) > 256:
+                self._range_cache.clear()
+            self._range_cache[key] = self.sfc.ranges(
+                xmin, ymin, lo, xmax, ymax, hi
+            )
+        return self._range_cache[key]
 
 
 # -- attribute ---------------------------------------------------------------

@@ -25,3 +25,47 @@ def require_x64() -> None:
 
     jax.config.update("jax_enable_x64", True)
     _enabled = True
+
+
+def force_cpu_devices(n: int) -> None:
+    """Force the CPU jax platform with ``n`` virtual devices.
+
+    Must run before the jax backend initializes (it triggers init itself to
+    fail fast). Handles two axon-image quirks: the sitecustomize hook sets
+    ``jax.config.jax_platforms`` directly, which outranks the
+    ``JAX_PLATFORMS`` env var; and ``XLA_FLAGS`` may already carry a stale
+    ``--xla_force_host_platform_device_count`` with the wrong count, which
+    must be replaced, not skipped.
+
+    Used by tests/conftest.py (8-device test mesh, SURVEY.md section 4
+    rebuild test plan) and ``__graft_entry__.dryrun_multichip``.
+    """
+    import os
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags
+        )
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:
+        pass  # pre-0.9 jax, or backend already up: checked just below
+
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            f"need {n} cpu devices but the jax backend already initialized "
+            f"with {len(jax.devices())} ({jax.devices()[0].platform}) -- "
+            "force_cpu_devices must run before any other jax use in the "
+            "process"
+        )

@@ -4,21 +4,9 @@ Must run before any jax import (SURVEY.md section 4 rebuild test plan:
 multi-chip tests via host-platform device-count simulation).
 """
 
-import os
+from geomesa_tpu.jaxconf import force_cpu_devices
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-# The axon sitecustomize hook sets jax.config.jax_platforms directly (which
-# outranks the env var), so force the config back to cpu before any backend
-# initializes.
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8)
 
 import numpy as np
 import pytest

@@ -23,7 +23,7 @@ from geomesa_tpu.index.keyspaces import (
     Z3KeySpace,
     keyspace_for,
 )
-from geomesa_tpu.index.build import build_index
+from geomesa_tpu.index.build import build_index, build_index_device
 
 __all__ = [
     "IndexKeySpace",
@@ -38,4 +38,5 @@ __all__ = [
     "IdKeySpace",
     "keyspace_for",
     "build_index",
+    "build_index_device",
 ]

@@ -3,8 +3,10 @@
 The rebuild's analog of bulk ingest + table splits (ref: geomesa-accumulo
 bulk ingest MapReduce sort + AccumuloIndexAdapter table splits, SURVEY.md
 section 2.6 "Z-order bulk sort"). Host path uses numpy lexsort; the device
-path (jax.lax.sort over z keys, ICI radix exchange across a mesh) lives in
-geomesa_tpu.parallel and is exercised by the bench/dryrun.
+path (:func:`build_index_device`) encodes z keys on the mesh and globally
+sorts rows with the all_to_all splitter exchange, row ids riding the
+exchange as payload -- the MapReduce-bulk-sort-on-ICI analog, producing
+the same BuiltIndex the host path does.
 """
 
 from __future__ import annotations
@@ -16,18 +18,118 @@ from geomesa_tpu.index.api import BuiltIndex, PartitionMeta
 
 DEFAULT_PARTITION_SIZE = 1 << 20  # ~1M rows per partition
 
+# time bins (weeks/months/... since epoch) can be negative; bias them into
+# non-negative uint32 lane values so the lexicographic uint32 device sort
+# matches the host's signed-int sort. Full int32 bias: a smaller bias would
+# wrap far-past bins around to huge lane values and silently mis-sort.
+_BIN_BIAS = 1 << 31
+
 
 def build_index(
     keyspace,
     batch: FeatureBatch,
     partition_size: int = DEFAULT_PARTITION_SIZE,
+    mesh=None,
 ) -> BuiltIndex:
+    if mesh is not None:
+        return build_index_device(keyspace, batch, mesh, partition_size)
     keys = keyspace.index_keys(batch)
     cols = [keys[c] for c in keyspace.key_columns]
     order = _sort_order(cols)
     sorted_batch = batch.take(order)
     sorted_keys = {k: v[order] for k, v in keys.items()}
     partitions = make_partitions(keyspace, sorted_batch, sorted_keys, partition_size)
+    return BuiltIndex(keyspace, sorted_batch, sorted_keys, partitions)
+
+
+def build_index_device(
+    keyspace,
+    batch: FeatureBatch,
+    mesh,
+    partition_size: int = DEFAULT_PARTITION_SIZE,
+    axis: str = "shard",
+) -> BuiltIndex:
+    """Mesh-path index build for z3-family key spaces.
+
+    The z keys are encoded on device (hi/lo uint32 lanes), rows are
+    globally sorted across the mesh by (bin, z_hi, z_lo, row_id) via the
+    all_to_all splitter exchange -- the trailing row-id lane makes the
+    device sort stable over duplicate keys, so ties order exactly like
+    the host's stable lexsort and the resulting permutation materializes
+    the same sorted batch + partition manifest bit for bit. Overflow in
+    the exchange raises (a build must never silently lose rows).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.curves.binnedtime import to_binned_time
+    from geomesa_tpu.jaxconf import require_x64
+    from geomesa_tpu.parallel.dist import distributed_sort
+
+    # host-parity encode needs float64 quantization; without it the jnp
+    # coords silently downcast to float32 and the device keys disagree
+    # with the host planner's ranges
+    require_x64()
+
+    sfc = getattr(keyspace, "sfc", None)
+    if sfc is None or not hasattr(sfc, "index_jax_hi_lo"):
+        raise ValueError(
+            f"device build requires a key space with a hi/lo device encode; "
+            f"{keyspace.name!r} has none (use the host build)"
+        )
+    n = len(batch)
+    if n == 0:
+        return build_index(keyspace, batch, partition_size)
+
+    n_shards = mesh.shape[axis]
+    x, y = batch.point_coords(keyspace.geom_field)
+    ms = batch.column(keyspace.dtg_field)
+    b, off = to_binned_time(ms, keyspace.period)
+    if int(b.min()) < -_BIN_BIAS or int(b.max()) >= _BIN_BIAS - 1:
+        raise ValueError(
+            f"time bins [{b.min()}, {b.max()}] exceed the device-sortable "
+            "int32 range"
+        )
+
+    pad = (-n) % n_shards
+    if pad:
+        zf = np.zeros(pad)
+        x, y, off = (
+            np.concatenate([x, zf]),
+            np.concatenate([y, zf]),
+            np.concatenate([off, np.zeros(pad, dtype=off.dtype)]),
+        )
+        b = np.concatenate([b, np.zeros(pad, dtype=b.dtype)])
+    valid = np.arange(n + pad) < n
+    rid = np.arange(n + pad, dtype=np.uint32)
+
+    hi, lo = jax.jit(sfc.index_jax_hi_lo)(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(off)
+    )
+    bin_lane = jnp.asarray((b + _BIN_BIAS).astype(np.uint32))
+    (kb, kh, kl, kr), _, v = distributed_sort(
+        mesh,
+        (bin_lane, hi, lo, jnp.asarray(rid)),
+        axis=axis,
+        valid=jnp.asarray(valid),
+        on_overflow="raise",
+    )
+    kb, kh, kl = np.asarray(kb), np.asarray(kh), np.asarray(kl)
+    v = np.asarray(v)
+    order = np.asarray(kr)[v].astype(np.int64)
+    if order.shape[0] != n:  # pragma: no cover - overflow already raises
+        raise RuntimeError(
+            f"device build lost rows: {order.shape[0]} of {n} survived"
+        )
+    sorted_batch = batch.take(order)
+    z = (kh.astype(np.uint64) << np.uint64(32)) | kl.astype(np.uint64)
+    sorted_keys = {
+        "bin": (kb[v].astype(np.int64) - _BIN_BIAS).astype(np.int32),
+        "z": z[v],
+    }
+    partitions = make_partitions(
+        keyspace, sorted_batch, sorted_keys, partition_size
+    )
     return BuiltIndex(keyspace, sorted_batch, sorted_keys, partitions)
 
 

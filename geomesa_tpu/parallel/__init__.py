@@ -19,6 +19,7 @@ Everything compiles against virtual CPU meshes for tests and dry runs.
 from geomesa_tpu.parallel.mesh import make_mesh
 from geomesa_tpu.parallel.dist import (
     sharded_count_scan,
+    distributed_sort,
     distributed_z3_sort,
     sharded_build_and_query_step,
 )
@@ -31,6 +32,7 @@ from geomesa_tpu.parallel.multihost import (
 __all__ = [
     "make_mesh",
     "sharded_count_scan",
+    "distributed_sort",
     "distributed_z3_sort",
     "sharded_build_and_query_step",
     "initialize",

@@ -1,29 +1,28 @@
 """Distributed index build + scan steps (shard_map + XLA collectives).
 
 The pod-scale Z-order sort (SURVEY.md section 2.6 row "Z-order bulk sort"
-and section 7 hard part #5): each chip buckets its local rows by the high
-bits of the z key, exchanges buckets over ICI with ``all_to_all`` (radix
-exchange), and locally sorts -- yielding a globally z-sorted, shard-
-partitioned index. Scans run shard-local fused masks merged with ``psum``.
+and section 7 hard part #5): each chip buckets its local rows by sort key,
+exchanges buckets over ICI with ``all_to_all`` (radix exchange), and locally
+sorts -- yielding a globally sorted, shard-partitioned index. Row payloads
+(feature ids / column pytrees) ride the same exchange, so the device sort
+produces a queryable permutation, not just keys. Scans run shard-local
+fused masks merged with ``psum``.
 
 All functions are pure and jittable over a Mesh; fixed shapes throughout
-(bucket capacity is static -- over-capacity rows would be dropped, so
-callers size ``capacity_factor`` for their skew; the host pipeline re-salts
-hot shards like the reference's ShardStrategy does for hot tablets).
+(bucket capacity is static). Rows that would exceed a destination's
+capacity are counted with a ``psum`` and surfaced on the host via
+``on_overflow`` (raise by default -- silent loss is not an option for an
+index build).
 """
 
 from __future__ import annotations
 
+import warnings
 from functools import partial
 
 import numpy as np
 
-
-def _log2(n: int) -> int:
-    b = int(n).bit_length() - 1
-    if (1 << b) != n:
-        raise ValueError(f"device count {n} must be a power of two")
-    return b
+_SENTINEL = 0xFFFFFFFF
 
 
 def sharded_count_scan(mesh, device_fn, cols: dict, axis: str = "shard"):
@@ -55,6 +54,270 @@ def sharded_count_scan(mesh, device_fn, cols: dict, axis: str = "shard"):
     return jax.jit(step)(*ordered)
 
 
+def distributed_sort(
+    mesh,
+    keys,
+    axis: str = "shard",
+    capacity_factor: float = 2.0,
+    splitters: str = "sampled",
+    sample_per_shard: int = 64,
+    payload=None,
+    valid=None,
+    on_overflow: str = "raise",
+):
+    """Exchange-sort rows across the mesh by lexicographic uint32 key lanes.
+
+    ``keys`` is a tuple of same-length uint32 arrays, most-significant lane
+    first (a 63-bit z key is ``(hi, lo)``; a binned-time z3 key is
+    ``(bin, hi, lo)`` -- TPU-friendly 32-bit lanes instead of uint64).
+    ``payload`` is an optional pytree of arrays with leading dim ``n`` whose
+    rows travel with their keys through the exchange (the KV *value* of the
+    reference's bulk-ingest sort -- ref geomesa-accumulo-jobs bulk ingest
+    [UNVERIFIED, empty reference mount]). ``valid`` marks real rows (False =
+    padding added by the caller to reach a shard-divisible length).
+
+    Returns ``(keys, payload, valid)``: shard s of the output holds the s-th
+    globally-sorted key range, locally sorted, with padding masked by
+    ``valid`` (invalid rows carry sentinel keys and sort last per shard).
+
+    ``splitters='sampled'`` (default) routes by globally-sampled key
+    quantiles, preceded by a round-robin rebalance pass so every
+    (source, dest) exchange block is provably within capacity even for
+    adversarial layouts (already-sorted or all-duplicate keys): after the
+    rebalance every source holds a near-uniform mix of the global key
+    distribution, so quantile routing sends ~local_n/n_shards rows per
+    destination. This handles arbitrary spatial skew (GDELT city clusters;
+    SURVEY.md hard part #5) at the price of one extra all_to_all.
+    ``'radix'`` routes by the top 16 bits of lane 0 in a single pass:
+    cheaper, but requires lane 0 to spread (31 significant bits) and a hot
+    cell overflows its destination's capacity.
+
+    Overflowed rows are *counted on device* (psum across the mesh) and the
+    count is checked on host: ``on_overflow='raise'`` (default) raises
+    RuntimeError, ``'warn'`` warns, ``'ignore'`` skips the device fetch
+    (the ``valid`` output still reports survivors). Works for any shard-
+    axis size, power of two or not.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    if splitters not in ("sampled", "radix"):
+        raise ValueError(f"unknown splitter strategy {splitters!r}")
+    if on_overflow not in ("raise", "warn", "ignore"):
+        raise ValueError(f"unknown on_overflow mode {on_overflow!r}")
+
+    n_shards = mesh.shape[axis]
+    n_lanes = len(keys)
+    spec = P(axis)
+    sharding = NamedSharding(mesh, spec)
+    keys = tuple(jax.device_put(k, sharding) for k in keys)
+    payload_leaves, payload_def = jax.tree.flatten(
+        {} if payload is None else payload
+    )
+    payload_leaves = [jax.device_put(p, sharding) for p in payload_leaves]
+    n_extras = len(payload_leaves)
+    if valid is not None:
+        valid = jax.device_put(valid, sharding)
+    local_n = keys[0].shape[0] // n_shards
+    # +16 absorbs binomial fluctuation in quantile routing when the
+    # per-destination mean (local_n / n_shards) is small -- without it,
+    # tiny inputs overflow a 2x capacity factor on ordinary data
+    cap = int(np.ceil(local_n / n_shards * capacity_factor)) + 16
+    k_samp = min(sample_per_shard, local_n)
+
+    def exchange(ks, extras, v, dest, block_cap):
+        """Bucket rows by dest, all_to_all the (n_shards, cap) blocks,
+        return received (keys, extras, valid, dropped). Invalid rows sort
+        to the end of their bucket so they can never displace valid rows;
+        valid rows past capacity are dropped and counted.
+
+        Key lanes, the valid mask, and every 4-byte 1-D payload leaf are
+        bitcast and stacked into ONE uint32 buffer so the whole pass costs
+        a single all_to_all (per-collective latency dominates at these
+        block sizes); other payload dtypes ride their own collective."""
+        # clamp: an out-of-range dest would scatter out of bounds, and jax
+        # drops OOB scatter updates SILENTLY -- rows would vanish without
+        # being counted by the overflow accounting
+        dest = jnp.clip(dest, 0, n_shards - 1)
+        sort_key = dest * 2 + (~v).astype(jnp.int32)
+        order = jnp.argsort(sort_key, stable=True)
+        ks = [k[order] for k in ks]
+        extras = [e[order] for e in extras]
+        v_s, d_s = v[order], dest[order]
+        start = jnp.searchsorted(d_s, jnp.arange(n_shards), side="left")
+        within = jnp.arange(v.shape[0]) - start[d_s]
+        keep = (within < block_cap) & v_s
+        dropped = (v_s & ~keep).sum()
+        # non-kept rows scatter into a trash slot past the buffer
+        flat_idx = jnp.where(
+            keep, d_s * block_cap + within, n_shards * block_cap
+        )
+        slots = n_shards * block_cap + 1
+
+        def route(a, fill_or_row):
+            buf = jnp.broadcast_to(
+                fill_or_row, (slots,) + a.shape[1:]
+            ).astype(a.dtype)
+            buf = buf.at[flat_idx].set(a)
+            buf = buf[:-1].reshape((n_shards, block_cap) + a.shape[1:])
+            buf = jax.lax.all_to_all(buf, axis, 0, 0, tiled=False)
+            return buf.reshape((-1,) + a.shape[1:])
+
+        packable = {
+            i
+            for i, e in enumerate(extras)
+            if e.ndim == 1 and e.dtype.itemsize == 4
+        }
+        packed = [
+            jax.lax.bitcast_convert_type(extras[i], jnp.uint32)
+            for i in sorted(packable)
+        ]
+        stacked = jnp.stack(
+            list(ks) + [keep.astype(jnp.uint32)] + packed, axis=1
+        )
+        fill_row = jnp.array(
+            [_SENTINEL] * len(ks) + [0] * (1 + len(packed)),
+            dtype=jnp.uint32,
+        )
+        got = route(stacked, fill_row)
+        ks_r = [got[:, i] for i in range(len(ks))]
+        v_r = got[:, len(ks)] != 0
+        extras_r = list(extras)
+        for j, i in enumerate(sorted(packable)):
+            extras_r[i] = jax.lax.bitcast_convert_type(
+                got[:, len(ks) + 1 + j], extras[i].dtype
+            )
+        for i, e in enumerate(extras):
+            if i not in packable:
+                extras_r[i] = route(e, jnp.zeros((), e.dtype))
+        return ks_r, extras_r, v_r, dropped
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec,) * (n_lanes + n_extras + (valid is not None)),
+        out_specs=(
+            (spec,) * (n_lanes + n_extras) + (spec, P())
+        ),
+        check_vma=False,
+    )
+    def step(*args):
+        ks = list(args[:n_lanes])
+        extras = list(args[n_lanes : n_lanes + n_extras])
+        if valid is not None:
+            v = args[-1]
+        else:
+            v = jnp.ones(ks[0].shape, dtype=bool)
+        dropped_total = jnp.zeros((), jnp.int32)
+        if n_shards == 1:
+            pass  # nothing to exchange: straight to the local sort
+        elif splitters == "sampled":
+            # pass 1: rebalance -- each source sends an exactly-balanced
+            # ceil(local_n/n_shards) rows to every destination (within
+            # capacity by construction), but WHICH rows go where is
+            # decided by a multiplicative-hash shuffle: a plain
+            # i % n_shards cycle resonates with periodic data layouts
+            # (e.g. rows alternating between two ingest sources), leaving
+            # each shard with only a few splitter ranges and overflowing
+            # pass 2. The hash is a bijection on uint32, so argsort of it
+            # is a deterministic pseudo-random permutation.
+            rows = ks[0].shape[0]
+            rr_cap = -(-rows // n_shards)
+            mix = jnp.argsort(
+                jnp.arange(rows, dtype=jnp.uint32) * jnp.uint32(2654435761)
+            )
+            rr_dest = (
+                jnp.zeros(rows, jnp.int32)
+                .at[mix]
+                .set((jnp.arange(rows) % n_shards).astype(jnp.int32))
+            )
+            ks, extras, v, d1 = exchange(ks, extras, v, rr_dest, rr_cap)
+            dropped_total += d1.astype(jnp.int32)
+            # pass 2: sample the (now well-mixed) local keys, all_gather,
+            # sort globally, take n_shards-1 quantile splitters; route by
+            # lexicographic lane comparison against them. Valid rows are
+            # sampled first (invalid padding carries sentinel keys).
+            order = jnp.argsort(~v, stable=True)
+            stride = max(1, local_n // k_samp) if k_samp else 1
+            samp = [k[order][::stride][:k_samp] for k in ks]
+            gathered = [
+                jax.lax.all_gather(s, axis).reshape(-1) for s in samp
+            ]
+            gathered = jax.lax.sort(tuple(gathered), num_keys=n_lanes)
+            m = gathered[0].shape[0]
+            q = (jnp.arange(1, n_shards) * m) // n_shards
+            sps = [g[q] for g in gathered]  # (n_shards-1,) per lane
+            # lexicographic >, >= against every splitter
+            gt = jnp.zeros((ks[0].shape[0], n_shards - 1), dtype=bool)
+            eq = jnp.ones((ks[0].shape[0], n_shards - 1), dtype=bool)
+            for lane, sp in zip(ks, sps):
+                gt = gt | (eq & (lane[:, None] > sp[None, :]))
+                eq = eq & (lane[:, None] == sp[None, :])
+            # rows equal to splitter keys may land on ANY shard in the
+            # tied range without breaking global order (equal keys are
+            # order-free) -- spread them round-robin so duplicate-heavy
+            # data cannot overload one destination
+            d_lo = gt.sum(axis=1).astype(jnp.int32)
+            d_hi = (gt | eq).sum(axis=1).astype(jnp.int32)
+            span = d_hi - d_lo + 1
+            dest = d_lo + (
+                jnp.arange(ks[0].shape[0]).astype(jnp.int32) % span
+            )
+            ks, extras, v, d2 = exchange(ks, extras, v, dest, cap)
+            dropped_total += d2.astype(jnp.int32)
+        else:
+            # radix: scale lane 0's top 16 bits onto [0, n_shards) --
+            # for pow2 n this reduces to the plain high-bit shift, and it
+            # works for any n. Lane 0 is assumed to carry 31 significant
+            # bits (a z3 hi lane); a lane with bit 31 set would compute
+            # dest == n_shards, which the exchange clamps to the last
+            # shard (skewed routing, but no row loss).
+            top16 = (ks[0] >> 15).astype(jnp.uint32)
+            dest = ((top16 * jnp.uint32(n_shards)) >> 16).astype(jnp.int32)
+            ks, extras, v, d1 = exchange(ks, extras, v, dest, cap)
+            dropped_total += d1.astype(jnp.int32)
+        # local sort by key lanes; invalid rows are forced to the sentinel
+        # key in every lane so they sort last within the shard
+        ks = [jnp.where(v, k, jnp.uint32(_SENTINEL)) for k in ks]
+        perm = jnp.arange(ks[0].shape[0], dtype=jnp.int32)
+        sorted_ops = jax.lax.sort(
+            tuple(ks) + (v, perm), num_keys=n_lanes
+        )
+        ks = list(sorted_ops[:n_lanes])
+        v, perm = sorted_ops[n_lanes], sorted_ops[n_lanes + 1]
+        extras = [e[perm] for e in extras]
+        overflow = jax.lax.psum(dropped_total, axis)
+        return tuple(ks) + tuple(extras) + (v, overflow)
+
+    args = tuple(keys) + tuple(payload_leaves)
+    if valid is not None:
+        args = args + (valid,)
+    out = jax.jit(step)(*args)
+    keys_out = out[:n_lanes]
+    payload_out = jax.tree.unflatten(
+        payload_def, out[n_lanes : n_lanes + n_extras]
+    )
+    valid_out, overflow = out[n_lanes + n_extras], out[-1]
+    if on_overflow != "ignore":
+        ov = int(overflow)
+        if ov:
+            hint = (
+                "Raise capacity_factor."
+                if splitters == "sampled"
+                else "Raise capacity_factor or use splitters='sampled'."
+            )
+            msg = (
+                f"distributed_sort dropped {ov} rows: a destination shard "
+                f"exceeded its exchange capacity ({cap}/pass). " + hint
+            )
+            if on_overflow == "raise":
+                raise RuntimeError(msg)
+            warnings.warn(msg, RuntimeWarning, stacklevel=2)
+    return keys_out, payload_out, valid_out
+
+
 def distributed_z3_sort(
     mesh,
     hi,
@@ -63,137 +326,37 @@ def distributed_z3_sort(
     capacity_factor: float = 2.0,
     splitters: str = "sampled",
     sample_per_shard: int = 64,
+    payload=None,
+    on_overflow: str = "raise",
 ):
     """Exchange-sort of (hi, lo) uint32 z-key pairs across the mesh.
 
-    Returns (hi, lo, valid) shard-partitioned arrays where shard s holds the
-    s-th globally-sorted key range, locally sorted; ``valid`` masks padding
-    introduced by the fixed-capacity exchange.
-
-    ``splitters='sampled'`` (default) routes by globally-sampled key
-    quantiles, preceded by a round-robin rebalance pass so every
-    (source, dest) exchange block is provably within capacity even for
-    adversarial layouts (already-sorted or all-duplicate keys): after the
-    rebalance every source holds a near-uniform mix of the global key
-    distribution, so quantile routing sends ~local_n/n_shards rows per
-    destination. This handles arbitrary spatial skew (GDELT city
-    clusters; SURVEY.md hard part #5) at the price of one extra
-    all_to_all. ``'radix'`` routes by the top z bits in a single pass:
-    cheaper, but a hot cell overflows its destination's capacity and
-    drops rows (``valid`` reports what survived).
+    Returns ``(hi, lo, valid)`` -- or ``(hi, lo, payload, valid)`` when a
+    payload pytree rides along -- where shard s holds the s-th globally-
+    sorted key range, locally sorted; ``valid`` masks padding introduced by
+    the fixed-capacity exchange. See :func:`distributed_sort` for splitter
+    strategies and overflow semantics.
     """
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
-
-    n_shards = mesh.shape[axis]
-    bits = _log2(n_shards)
-    spec = P(axis)
-    hi = jax.device_put(hi, NamedSharding(mesh, spec))
-    lo = jax.device_put(lo, NamedSharding(mesh, spec))
-    local_n = hi.shape[0] // n_shards
-    cap = int(np.ceil(local_n / n_shards * capacity_factor))
-    if splitters not in ("sampled", "radix"):
-        raise ValueError(f"unknown splitter strategy {splitters!r}")
-    k = min(sample_per_shard, local_n)
-
-    def exchange(jx, jnpx, h, l, v, dest, block_cap):
-        """Bucket rows by dest, all_to_all the (n_shards, cap) blocks,
-        return flattened received (h, l, valid). Invalid rows sort to the
-        end of their bucket so they can never displace valid rows."""
-        sort_key = dest * 2 + (~v).astype(jnp.int32)
-        order = jnpx.argsort(sort_key, stable=True)
-        h_s, l_s, v_s, d_s = h[order], l[order], v[order], dest[order]
-        start = jnpx.searchsorted(d_s, jnpx.arange(n_shards), side="left")
-        within = jnpx.arange(h.shape[0]) - start[d_s]
-        keep = (within < block_cap) & v_s
-        flat_idx = d_s * block_cap + within
-        flat_idx = jnpx.where(keep, flat_idx, n_shards * block_cap)
-        buf_h = jnpx.full((n_shards * block_cap + 1,), jnpx.uint32(0xFFFFFFFF))
-        buf_l = jnpx.full((n_shards * block_cap + 1,), jnpx.uint32(0xFFFFFFFF))
-        buf_v = jnpx.zeros((n_shards * block_cap + 1,), dtype=bool)
-        buf_h = buf_h.at[flat_idx].set(h_s)
-        buf_l = buf_l.at[flat_idx].set(l_s)
-        buf_v = buf_v.at[flat_idx].set(keep)
-        buf_h = buf_h[:-1].reshape(n_shards, block_cap)
-        buf_l = buf_l[:-1].reshape(n_shards, block_cap)
-        buf_v = buf_v[:-1].reshape(n_shards, block_cap)
-        buf_h = jx.lax.all_to_all(buf_h, axis, 0, 0, tiled=False)
-        buf_l = jx.lax.all_to_all(buf_l, axis, 0, 0, tiled=False)
-        buf_v = jx.lax.all_to_all(buf_v, axis, 0, 0, tiled=False)
-        return buf_h.reshape(-1), buf_l.reshape(-1), buf_v.reshape(-1)
-
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(spec, spec),
-        out_specs=(spec, spec, spec),
-        check_vma=False,
+    (sh, sl), pay, valid = distributed_sort(
+        mesh,
+        (hi, lo),
+        axis=axis,
+        capacity_factor=capacity_factor,
+        splitters=splitters,
+        sample_per_shard=sample_per_shard,
+        payload=payload,
+        on_overflow=on_overflow,
     )
-    def step(h, l):
-        v = jnp.ones(h.shape, dtype=bool)
-        if splitters == "sampled" and n_shards > 1:
-            # pass 1: round-robin rebalance -- dest cycles 0..n_shards-1,
-            # so each (source, dest) block carries exactly
-            # ceil(local_n/n_shards) rows: within capacity by construction
-            rr_cap = -(-h.shape[0] // n_shards)
-            rr_dest = (jnp.arange(h.shape[0]) % n_shards).astype(jnp.int32)
-            h, l, v = exchange(jax, jnp, h, l, v, rr_dest, rr_cap)
-            # pass 2: sample the (now well-mixed) local keys, all_gather,
-            # sort globally, take n_shards-1 quantile splitters; route by
-            # lexicographic (hi, lo) comparison against them. Valid rows
-            # are sampled first (invalid padding carries sentinel keys).
-            order = jnp.argsort(~v, stable=True)
-            hh, ll = h[order], l[order]
-            stride = max(1, local_n // k) if k else 1
-            sh_samp = hh[::stride][:k]
-            sl_samp = ll[::stride][:k]
-            gh = jax.lax.all_gather(sh_samp, axis).reshape(-1)
-            gl = jax.lax.all_gather(sl_samp, axis).reshape(-1)
-            gh, gl = jax.lax.sort((gh, gl), num_keys=2)
-            m = gh.shape[0]
-            q = (jnp.arange(1, n_shards) * m) // n_shards
-            sp_h, sp_l = gh[q], gl[q]  # (n_shards-1,)
-            gt = (h[:, None] > sp_h[None, :]) | (
-                (h[:, None] == sp_h[None, :]) & (l[:, None] > sp_l[None, :])
-            )
-            ge = (h[:, None] > sp_h[None, :]) | (
-                (h[:, None] == sp_h[None, :]) & (l[:, None] >= sp_l[None, :])
-            )
-            # rows equal to splitter keys may land on ANY shard in the
-            # tied range without breaking global order (equal keys are
-            # order-free) -- spread them round-robin so duplicate-heavy
-            # data cannot overload one destination
-            d_lo = gt.sum(axis=1).astype(jnp.int32)
-            d_hi = ge.sum(axis=1).astype(jnp.int32)
-            span = d_hi - d_lo + 1
-            dest = d_lo + (
-                jnp.arange(h.shape[0]).astype(jnp.int32) % span
-            )
-            rh, rl, rv = exchange(jax, jnp, h, l, v, dest, cap)
-        else:
-            if bits:
-                # z bits 62..(63-bits): top `bits` bits of the 63-bit z
-                # live in hi bits (62-32)=30 .. (31-bits)
-                dest = ((h >> (31 - bits)) & (n_shards - 1)).astype(jnp.int32)
-            else:
-                dest = jnp.zeros(h.shape, dtype=jnp.int32)
-            rh, rl, rv = exchange(jax, jnp, h, l, v, dest, cap)
-        # local sort by (hi, lo); sentinels (0xffffffff) sink to the end.
-        # invalid rows are forced to the sentinel key so they sort last
-        rh = jnp.where(rv, rh, jnp.uint32(0xFFFFFFFF))
-        rl = jnp.where(rv, rl, jnp.uint32(0xFFFFFFFF))
-        rh, rl, rv = jax.lax.sort((rh, rl, rv), num_keys=2)
-        return rh, rl, rv
-
-    return jax.jit(step)(hi, lo)
+    if payload is None:
+        return sh, sl, valid
+    return sh, sl, pay, valid
 
 
 def sharded_build_and_query_step(mesh, sfc, x, y, t, query_bounds, axis: str = "shard"):
     """One full distributed 'index build + query' step, end to end on the
-    mesh: z3 hi/lo key encode (data-parallel) -> radix all_to_all exchange +
-    local sort (index build) -> fused bbox+time mask + psum count (query).
+    mesh: z3 hi/lo key encode (data-parallel) -> all_to_all splitter
+    exchange + local sort (index build) -> fused bbox+time mask + psum
+    count (query).
 
     Returns (sorted_hi, sorted_lo, valid, count). This is the step
     ``__graft_entry__.dryrun_multichip`` compiles over N virtual devices.

@@ -116,7 +116,13 @@ def test_sampled_splitters_survive_skew(mesh):
     sfc = Z3SFC()
     hi, lo = sfc.index_jax_hi_lo(jnp.asarray(x), jnp.asarray(y), jnp.asarray(t))
 
-    rh, rl, rv = distributed_z3_sort(mesh, hi, lo, splitters="radix")
+    # radix routing overflows and must be LOUD by default
+    with pytest.raises(RuntimeError, match="dropped"):
+        distributed_z3_sort(mesh, hi, lo, splitters="radix")
+    with pytest.warns(RuntimeWarning, match="dropped"):
+        rh, rl, rv = distributed_z3_sort(
+            mesh, hi, lo, splitters="radix", on_overflow="warn"
+        )
     dropped_radix = n - int(np.asarray(rv).sum())
     assert dropped_radix > 0  # the skew actually defeats radix routing
 
@@ -209,3 +215,195 @@ def test_sampled_sort_adversarial_layouts(mesh):
     lo2 = jnp.full(n, np.uint32(9), dtype=jnp.uint32)
     sh2, sl2, sv2 = distributed_z3_sort(mesh, hi2, lo2, splitters="sampled")
     assert int(np.asarray(sv2).sum()) == n
+
+
+def test_device_index_build_matches_host(mesh):
+    """VERDICT round-1 item 2: the mesh sort carries row payloads, so the
+    device path builds a real queryable BuiltIndex -- bit-identical sorted
+    keys and the same query results as the host lexsort build."""
+    from geomesa_tpu.filter.ecql import parse_instant
+    from geomesa_tpu.index.build import build_index_device
+    from geomesa_tpu.query.runner import run_query
+    from geomesa_tpu.store import MemoryDataStore
+
+    store = MemoryDataStore(partition_size=2048)
+    store.create_schema("pts", "name:String,dtg:Date,*geom:Point:srid=4326")
+    rng = np.random.default_rng(17)
+    n = 20000
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    store.write(
+        "pts",
+        {
+            "name": rng.choice(["a", "b", "c"], n),
+            "dtg": rng.integers(t0, t1, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    ecql = (
+        "BBOX(geom, -5, 42, 8, 51) AND "
+        "dtg DURING 2020-01-05T00:00:00Z/2020-02-20T00:00:00Z"
+    )
+    plan = store.plan("pts", ecql)  # flushes + builds host indices
+    assert plan.index_name == "z3"
+    host_built = store._state("pts").indices["z3"]
+    dev_built = build_index_device(
+        host_built.keyspace, store._state("pts").data, mesh, partition_size=2048
+    )
+    # bit-identical sorted key columns (device encode == host encode)
+    np.testing.assert_array_equal(dev_built.keys["bin"], host_built.keys["bin"])
+    np.testing.assert_array_equal(dev_built.keys["z"], host_built.keys["z"])
+    np.testing.assert_array_equal(dev_built.batch.fids, host_built.batch.fids)
+    assert len(dev_built.partitions) == len(host_built.partitions)
+    # the same query plan scans both indices to the same result set
+    r_host = run_query(host_built, plan)
+    r_dev = run_query(dev_built, plan)
+    assert len(r_host) > 0
+    assert set(r_dev.batch.fids.tolist()) == set(r_host.batch.fids.tolist())
+
+
+def test_distributed_sort_payload_travels_with_rows(mesh):
+    """Column payloads (not just row ids) ride the exchange: each surviving
+    row's payload must still equal f(key)."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.parallel import distributed_sort
+
+    n = 8 * 512
+    rng = np.random.default_rng(5)
+    z = rng.integers(0, 2**62, n).astype(np.uint64)
+    hi = jnp.asarray((z >> np.uint64(32)).astype(np.uint32))
+    lo = jnp.asarray((z & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    # payload derived from the key so misrouting is detectable
+    pay = {
+        "f": jnp.asarray((z % 1000).astype(np.float32)),
+        "i": jnp.asarray((z % 255).astype(np.uint8)),
+    }
+    (sh, sl), pout, sv = distributed_sort(mesh, (hi, lo), payload=pay)
+    sh, sl, sv = np.asarray(sh), np.asarray(sl), np.asarray(sv)
+    zz = ((sh.astype(np.uint64) << np.uint64(32)) | sl.astype(np.uint64))[sv]
+    np.testing.assert_array_equal(np.sort(zz), np.sort(z))
+    np.testing.assert_array_equal(
+        np.asarray(pout["f"])[sv], (zz % 1000).astype(np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pout["i"])[sv], (zz % 255).astype(np.uint8)
+    )
+
+
+def test_sampled_sort_periodic_interleaved_clusters(mesh):
+    """Rows alternating between two clusters (interleaved ingest from two
+    sources) resonate with a plain i%n round-robin rebalance; the hashed
+    shuffle must keep every row. Also covers tiny inputs where the
+    per-destination mean is ~1 row."""
+    import jax.numpy as jnp
+
+    for n in (64, 4096):
+        i = np.arange(n)
+        z = np.where(i % 2 == 0, i * 7, (1 << 61) + i * 13).astype(np.uint64)
+        hi = jnp.asarray((z >> np.uint64(32)).astype(np.uint32))
+        lo = jnp.asarray((z & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+        sh, sl, sv = distributed_z3_sort(mesh, hi, lo, splitters="sampled")
+        assert int(np.asarray(sv).sum()) == n, f"rows lost at n={n}"
+        got = (
+            (np.asarray(sh).astype(np.uint64) << np.uint64(32))
+            | np.asarray(sl).astype(np.uint64)
+        )[np.asarray(sv)]
+        np.testing.assert_array_equal(got, np.sort(z))
+
+
+def test_device_build_rejects_out_of_range_bins():
+    """A bin beyond the int32 bias must raise, not silently mis-sort."""
+    from geomesa_tpu.index.build import _BIN_BIAS, build_index_device
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.index.keyspaces import Z3KeySpace
+
+    sft = SimpleFeatureType.create("b", "dtg:Date,*geom:Point:srid=4326")
+    # dtg in ms; a WEEK bin of 2**31 needs ms ~ 2**31 * 604800000 -- beyond
+    # int64? no: 1.3e18 < 9.2e18, representable
+    ms = np.array([(2**31 + 5) * 604800000], dtype=np.int64)
+    batch = FeatureBatch.from_columns(
+        sft, {"dtg": ms, "geom": np.array([[0.0, 0.0]])}, np.arange(1)
+    )
+    with pytest.raises(ValueError, match="device-sortable"):
+        build_index_device(Z3KeySpace("geom", "dtg"), batch, make_mesh(8))
+
+
+def test_device_build_stable_over_duplicate_keys():
+    """All-identical (bin, z) keys: the trailing row-id lane must make the
+    device sort reproduce the host lexsort's stable tie order exactly."""
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.index.build import build_index, build_index_device
+    from geomesa_tpu.index.keyspaces import Z3KeySpace
+
+    sft = SimpleFeatureType.create("dup", "dtg:Date,*geom:Point:srid=4326")
+    n = 64
+    batch = FeatureBatch.from_columns(
+        sft,
+        {
+            "dtg": np.full(n, 1577836800000, dtype=np.int64),
+            "geom": np.tile([[2.35, 48.85]], (n, 1)),
+        },
+        np.arange(n),
+    )
+    ks = Z3KeySpace("geom", "dtg")
+    host = build_index(ks, batch)
+    for n_dev in (8, 1):
+        dev = build_index_device(ks, batch, make_mesh(n_dev))
+        np.testing.assert_array_equal(dev.batch.fids, host.batch.fids)
+        np.testing.assert_array_equal(dev.keys["z"], host.keys["z"])
+
+
+def test_distributed_sort_single_device_mesh(rng):
+    """n_shards == 1 must skip the exchange (no radix lane assumptions)
+    and still produce a sorted, loss-free result -- including for lanes
+    with bit 31 set (biased bins)."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.parallel import distributed_sort
+
+    n = 512
+    lane0 = (rng.integers(0, 1 << 32, n, dtype=np.uint64)).astype(np.uint32)
+    lane1 = (rng.integers(0, 1 << 32, n, dtype=np.uint64)).astype(np.uint32)
+    (s0, s1), _, v = distributed_sort(
+        make_mesh(1), (jnp.asarray(lane0), jnp.asarray(lane1))
+    )
+    assert int(np.asarray(v).sum()) == n
+    z = (np.asarray(s0).astype(np.uint64) << np.uint64(32)) | np.asarray(
+        s1
+    ).astype(np.uint64)
+    np.testing.assert_array_equal(
+        z, np.sort((lane0.astype(np.uint64) << np.uint64(32)) | lane1)
+    )
+
+
+def test_radix_bit31_lane_no_silent_loss(mesh, rng):
+    """A 32-bit lane 0 (bit 31 set) would previously scatter out of bounds
+    and vanish rows without touching the overflow counter; dest clamping
+    must keep them accounted for: every row either survives or is counted
+    in the loud overflow error."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.parallel import distributed_sort
+
+    n = 8 * 512
+    lane0 = (rng.integers(0, 1 << 32, n, dtype=np.uint64)).astype(np.uint32)
+    lane1 = (rng.integers(0, 1 << 32, n, dtype=np.uint64)).astype(np.uint32)
+    try:
+        (s0, s1), _, v = distributed_sort(
+            mesh,
+            (jnp.asarray(lane0), jnp.asarray(lane1)),
+            splitters="radix",
+            on_overflow="raise",
+        )
+        survivors = int(np.asarray(v).sum())
+        assert survivors == n  # no error -> nothing may be missing
+    except RuntimeError as e:
+        # overflow is allowed (clamping skews the top half onto the last
+        # shard) but it must be LOUD and fully accounted
+        assert "dropped" in str(e)

@@ -62,10 +62,10 @@ def force_cpu_devices(n: int) -> None:
     except Exception:
         pass  # pre-0.9 jax, or backend already up: checked just below
 
-    if len(jax.devices()) < n:
+    devs = jax.devices()
+    if len(devs) < n or devs[0].platform != "cpu":
         raise RuntimeError(
             f"need {n} cpu devices but the jax backend already initialized "
-            f"with {len(jax.devices())} ({jax.devices()[0].platform}) -- "
-            "force_cpu_devices must run before any other jax use in the "
-            "process"
+            f"with {len(devs)} ({devs[0].platform}) -- force_cpu_devices "
+            "must run before any other jax use in the process"
         )

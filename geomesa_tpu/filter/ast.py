@@ -89,12 +89,16 @@ class BBox(Filter):
 
 @dataclass(frozen=True)
 class Intersects(Filter):
-    """Geometry intersection (also covers WITHIN(query contains data) as
-    issued by typical GeoServer clients; op records the original verb)."""
+    """Geometry relation predicate (op records the original verb; WITHIN =
+    data within query geometry as issued by typical GeoServer clients).
+    ``pattern`` carries the DE-9IM mask for op='relate'."""
 
     attr: str
     geometry: Geometry
-    op: str = "intersects"  # intersects | within | contains | disjoint
+    # intersects | within | contains | disjoint | crosses | touches |
+    # overlaps | equals | relate
+    op: str = "intersects"
+    pattern: "str | None" = None
 
 
 @dataclass(frozen=True)

@@ -135,6 +135,14 @@ def _host_spatial(f, batch: FeatureBatch) -> np.ndarray:
         return _host_bbox(
             ast.BBox(f.attr, env.xmin, env.ymin, env.xmax, env.ymax), batch
         )
+    if isinstance(f, ast.Intersects) and f.op in (
+        "crosses",
+        "touches",
+        "overlaps",
+        "equals",
+        "relate",
+    ):
+        return _host_relation(f, batch, desc)
     if desc.is_point:
         x, y = batch.point_coords(f.attr)
         if f.op == "contains" and not isinstance(geom, Point):
@@ -173,6 +181,53 @@ def _host_spatial(f, batch: FeatureBatch) -> np.ndarray:
     for i in np.nonzero(cand)[0]:
         out[i] = geometry_intersects(col[i], geom)
     return ~out if f.op == "disjoint" else out
+
+
+def _host_relation(f: "ast.Intersects", batch: FeatureBatch, desc) -> np.ndarray:
+    """CROSSES / TOUCHES / OVERLAPS / EQUALS / RELATE residual evaluation:
+    bbox prefilter, then the exact DE-9IM-lite predicate per candidate
+    (data geometry as first operand, matching ECQL argument order).
+    RELATE patterns can match disjoint features, so it skips the prefilter."""
+    from geomesa_tpu.geom.predicates import (
+        geometry_crosses,
+        geometry_overlaps,
+        geometry_relate_matches,
+        geometry_touches,
+    )
+
+    geom = f.geometry
+    if desc.is_point:
+        x, y = batch.point_coords(f.attr)
+
+        def rowgeom(i):
+            return Point(float(x[i]), float(y[i]))
+
+    else:
+        col = batch.column(f.attr)
+
+        def rowgeom(i):
+            return col[i]
+
+    if f.op == "relate":
+        cand = np.arange(len(batch))
+        fn = lambda g: geometry_relate_matches(g, geom, f.pattern)
+    else:
+        e = geom.envelope
+        cand = np.nonzero(
+            _host_bbox(ast.BBox(f.attr, e.xmin, e.ymin, e.xmax, e.ymax), batch)
+        )[0]
+        if f.op == "crosses":
+            fn = lambda g: geometry_crosses(g, geom)
+        elif f.op == "touches":
+            fn = lambda g: geometry_touches(g, geom)
+        elif f.op == "overlaps":
+            fn = lambda g: geometry_overlaps(g, geom)
+        else:  # equals via the DE-9IM equality mask
+            fn = lambda g: geometry_relate_matches(g, geom, "T*F**FFF*")
+    out = np.zeros(len(batch), dtype=bool)
+    for i in cand:
+        out[i] = fn(rowgeom(i))
+    return out
 
 
 def _points_in_multi(x, y, geom) -> np.ndarray:

@@ -50,7 +50,19 @@ _TOKEN_RE = re.compile(
     re.VERBOSE,
 )
 
-_SPATIAL = {"BBOX", "INTERSECTS", "WITHIN", "CONTAINS", "DISJOINT", "DWITHIN"}
+_SPATIAL = {
+    "BBOX",
+    "INTERSECTS",
+    "WITHIN",
+    "CONTAINS",
+    "DISJOINT",
+    "DWITHIN",
+    "CROSSES",
+    "TOUCHES",
+    "OVERLAPS",
+    "EQUALS",
+    "RELATE",
+}
 
 
 class _P:
@@ -209,7 +221,9 @@ def _primary(p: _P) -> ast.Filter:
     if upper == "EXCLUDE":
         p.next()
         return ast.Exclude
-    if upper in _SPATIAL:
+    # spatial verbs are only reserved when called like functions -- a
+    # column may legitimately be named 'overlaps' or 'equals'
+    if upper in _SPATIAL and p.peek(1)[0] == "lparen":
         return _spatial(p, upper)
     return _predicate(p)
 
@@ -261,6 +275,17 @@ def _spatial(p: _P, op: str) -> ast.Filter:
             "statute": 1609.34 / 111_320.0,
         }.get(units, 1.0)
         return ast.DWithin(attr, geom_poly, dist * factor)
+    if op == "RELATE":
+        p.expect("comma")
+        k, v = p.next()
+        if k != "string":
+            raise ValueError(f"RELATE expects a DE-9IM pattern string, got {v!r}")
+        pat = _unquote(v)
+        # fail at parse time, not deep inside a per-row scan
+        if len(pat) != 9 or any(c not in "*TF012" for c in pat.upper()):
+            raise ValueError(f"bad DE-9IM pattern {pat!r} (9 chars of *TF012)")
+        p.expect("rparen")
+        return ast.Intersects(attr, geom_poly, op="relate", pattern=pat)
     p.expect("rparen")
     return ast.Intersects(attr, geom_poly, op=op.lower())
 

@@ -54,7 +54,14 @@ def extract_geometries(f: ast.Filter, attr: str) -> FilterBounds:
         return FilterBounds.none()
     if isinstance(f, ast.BBox) and f.attr == attr:
         return FilterBounds(((f.envelope, None),))
-    if isinstance(f, ast.Intersects) and f.attr == attr and f.op != "disjoint":
+    # every relation except DISJOINT and RELATE implies the data geometry
+    # meets the query geometry's envelope (a RELATE pattern can select
+    # disjoint features, e.g. 'FF*FF****', so it must not prune)
+    if (
+        isinstance(f, ast.Intersects)
+        and f.attr == attr
+        and f.op not in ("disjoint", "relate")
+    ):
         return FilterBounds(((f.geometry.envelope, f.geometry),))
     if isinstance(f, ast.DWithin) and f.attr == attr:
         e = f.geometry.envelope

@@ -243,3 +243,438 @@ def segments_intersect(ax, ay, bx, by, cx, cy, dx, dy) -> np.ndarray:
         | on_seg(ax, ay, bx, by, dx, dy)
     )
     return proper | touch
+
+
+# -- DE-9IM-lite relation algebra --------------------------------------------
+# (ref: geomesa-spark SpatialRelationFunctions + JTS RelateOp [UNVERIFIED -
+# empty reference mount]). Exact for the common cases (shared edges built
+# from the same coordinates, proper crossings, containment); the documented
+# lite caveats: float-precision boundary contact is measure-zero fuzzy, and
+# a crossing that passes exactly through interior VERTICES of both
+# polylines (orientation tests all zero) is classified as touching.
+# Line-in-line coverage refines its samples at the covering line's
+# component endpoints, so gaps between collinear components are detected.
+
+
+def geometry_dimension(g) -> int:
+    """Topological dimension: 0 points, 1 lines, 2 areas."""
+    from geomesa_tpu.geom.base import (
+        LineString,
+        MultiLineString,
+        MultiPoint,
+        MultiPolygon,
+        Point,
+        Polygon,
+    )
+
+    if isinstance(g, (Point, MultiPoint)):
+        return 0
+    if isinstance(g, (LineString, MultiLineString)):
+        return 1
+    if isinstance(g, (Polygon, MultiPolygon)):
+        return 2
+    raise TypeError(f"unsupported geometry {type(g).__name__}")
+
+
+def _points_of(g):
+    from geomesa_tpu.geom.base import MultiPoint, Point
+
+    if isinstance(g, Point):
+        return [g]
+    if isinstance(g, MultiPoint):
+        return list(g.points)
+    return []
+
+
+def _polygons_of(g):
+    from geomesa_tpu.geom.base import MultiPolygon, Polygon
+
+    if isinstance(g, Polygon):
+        return [g]
+    if isinstance(g, MultiPolygon):
+        return list(g.polygons)
+    return []
+
+
+def _line_components(g):
+    from geomesa_tpu.geom.base import LineString, MultiLineString
+
+    if isinstance(g, LineString):
+        return [g]
+    if isinstance(g, MultiLineString):
+        return list(g.lines)
+    return []
+
+
+def _on_any_segment(x: float, y: float, segs) -> bool:
+    if segs is None or len(segs) == 0:
+        return False
+    px = np.full(len(segs), x)
+    py = np.full(len(segs), y)
+    return bool(
+        segments_intersect(
+            px, py, px, py, segs[:, 0], segs[:, 1], segs[:, 2], segs[:, 3]
+        ).any()
+    )
+
+
+def _strict_in_area(area, x: float, y: float) -> bool:
+    """Strictly inside (interior): odd-crossing inside and not on a ring."""
+    if _on_any_segment(x, y, _segments_of(area)):
+        return False
+    return _poly_contains_point(area, x, y)
+
+
+def _in_or_on_area(area, x: float, y: float) -> bool:
+    return _poly_contains_point(area, x, y) or _on_any_segment(
+        x, y, _segments_of(area)
+    )
+
+
+def interior_point(poly) -> "tuple[float, float]":
+    """A point strictly inside the polygon (mid-scanline construction:
+    works for concave shells and respects holes)."""
+    ys = np.unique(
+        np.concatenate([np.asarray(r)[:, 1] for r in poly.rings()])
+    )
+    candidates = (ys[:-1] + ys[1:]) / 2.0 if len(ys) > 1 else np.array([])
+    segs = _segments_of(poly)
+    for yc in candidates:
+        y1, y2 = segs[:, 1], segs[:, 3]
+        straddle = (y1 > yc) != (y2 > yc)
+        if not straddle.any():
+            continue
+        with np.errstate(divide="ignore", invalid="ignore"):
+            xs = segs[:, 0] + (yc - y1) * (segs[:, 2] - segs[:, 0]) / (
+                y2 - y1
+            )
+        xs = np.sort(xs[straddle])
+        for x1, x2 in zip(xs[:-1], xs[1:]):
+            xm = (float(x1) + float(x2)) / 2.0
+            if _strict_in_area(poly, xm, yc):
+                return xm, float(yc)
+    # degenerate (zero-area) polygon: fall back to the first vertex
+    return float(poly.shell[0, 0]), float(poly.shell[0, 1])
+
+
+def _proper_cross_any(sa, sb) -> bool:
+    """Any strictly-proper segment crossing (interiors pass through)."""
+    if sa is None or sb is None or len(sa) == 0 or len(sb) == 0:
+        return False
+    m, k = len(sa), len(sb)
+    A = np.repeat(sa, k, axis=0)
+    B = np.tile(sb, (m, 1))
+
+    def orient(ox, oy, px_, py_, qx, qy):
+        return np.sign((px_ - ox) * (qy - oy) - (py_ - oy) * (qx - ox))
+
+    d1 = orient(B[:, 0], B[:, 1], B[:, 2], B[:, 3], A[:, 0], A[:, 1])
+    d2 = orient(B[:, 0], B[:, 1], B[:, 2], B[:, 3], A[:, 2], A[:, 3])
+    d3 = orient(A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 0], B[:, 1])
+    d4 = orient(A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 2], B[:, 3])
+    return bool(((d1 * d2 < 0) & (d3 * d4 < 0)).any())
+
+
+def _collinear_overlap_any(sa, sb) -> bool:
+    """Any pair of collinear segments sharing positive-length extent."""
+    if sa is None or sb is None or len(sa) == 0 or len(sb) == 0:
+        return False
+    m, k = len(sa), len(sb)
+    A = np.repeat(sa, k, axis=0)
+    B = np.tile(sb, (m, 1))
+
+    def cross(ox, oy, px_, py_, qx, qy):
+        return (px_ - ox) * (qy - oy) - (py_ - oy) * (qx - ox)
+
+    col = (
+        (cross(A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 0], B[:, 1]) == 0)
+        & (cross(A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 2], B[:, 3]) == 0)
+    )
+    # project onto the dominant axis of A and require positive overlap
+    dx = np.abs(A[:, 2] - A[:, 0])
+    dy = np.abs(A[:, 3] - A[:, 1])
+    use_x = dx >= dy
+    a_lo = np.where(use_x, np.minimum(A[:, 0], A[:, 2]), np.minimum(A[:, 1], A[:, 3]))
+    a_hi = np.where(use_x, np.maximum(A[:, 0], A[:, 2]), np.maximum(A[:, 1], A[:, 3]))
+    b_lo = np.where(use_x, np.minimum(B[:, 0], B[:, 2]), np.minimum(B[:, 1], B[:, 3]))
+    b_hi = np.where(use_x, np.maximum(B[:, 0], B[:, 2]), np.maximum(B[:, 1], B[:, 3]))
+    overlap = np.minimum(a_hi, b_hi) - np.maximum(a_lo, b_lo)
+    return bool((col & (overlap > 0)).any())
+
+
+def _line_boundary_points(g):
+    """Boundary of a line = the endpoints of its open components (a closed
+    ring has no boundary). Lite: interior vertices of even degree across
+    components are not cancelled (mod-2 rule applied per component only)."""
+    pts = []
+    for comp in _line_components(g):
+        c = comp.coords
+        if len(c) and not (c[0, 0] == c[-1, 0] and c[0, 1] == c[-1, 1]):
+            pts.append((float(c[0, 0]), float(c[0, 1])))
+            pts.append((float(c[-1, 0]), float(c[-1, 1])))
+    return pts
+
+
+def _line_sample_points(g):
+    """Interior samples of a polyline: segment midpoints + interior
+    vertices (endpoints excluded -- they are boundary)."""
+    out = []
+    boundary = set(_line_boundary_points(g))
+    for comp in _line_components(g):
+        c = comp.coords
+        mids = (c[:-1] + c[1:]) / 2.0
+        out.extend((float(x), float(y)) for x, y in mids)
+        out.extend(
+            (float(x), float(y))
+            for x, y in c
+            if (float(x), float(y)) not in boundary
+        )
+    return out
+
+
+def _line_interior_intersects_area(line, area) -> bool:
+    sl = _segments_of(line)
+    if _proper_cross_any(sl, _segments_of(area)):
+        return True
+    return any(_strict_in_area(area, x, y) for x, y in _line_sample_points(line))
+
+
+def _covered(a, b) -> bool:
+    """Is a within the closure of b (lite: sample-point based)."""
+    da, db = geometry_dimension(a), geometry_dimension(b)
+    if da > db:
+        return False  # higher dim can't be covered by lower
+    if da == 0:
+        return all(geometry_intersects(p, b) for p in _points_of(a))
+    if da == 1:
+        sa = _segments_of(a)
+        samples = _line_sample_points(a) + _line_boundary_points(a)
+        if db == 1:
+            sb = _segments_of(b)
+            # refine: cut every segment of a at b's vertices that lie on
+            # it, and sample the cut midpoints -- a gap in b always starts
+            # and ends at b vertices, so midpoint samples between
+            # consecutive cuts expose it (plain midpoints would not)
+            bverts = np.unique(
+                np.concatenate([sb[:, :2], sb[:, 2:]], axis=0), axis=0
+            )
+            for x1, y1, x2, y2 in sa:
+                ts = [0.0, 1.0]
+                dx, dy = x2 - x1, y2 - y1
+                L2 = dx * dx + dy * dy
+                if L2 == 0:
+                    continue
+                for vx, vy in bverts:
+                    if (vx - x1) * dy - (vy - y1) * dx != 0:
+                        continue  # not on this segment's line
+                    t = ((vx - x1) * dx + (vy - y1) * dy) / L2
+                    if 0.0 < t < 1.0:
+                        ts.append(float(t))
+                ts.sort()
+                for t0, t1 in zip(ts[:-1], ts[1:]):
+                    tm = (t0 + t1) / 2.0
+                    samples.append((x1 + tm * dx, y1 + tm * dy))
+            return all(_on_any_segment(x, y, sb) for x, y in samples)
+        # line in area: every sample in-or-on, and no proper escape
+        # through the boundary
+        if _proper_cross_any(sa, _segments_of(b)):
+            return False
+        return all(_in_or_on_area(b, x, y) for x, y in samples)
+    # area in area
+    if _proper_cross_any(_segments_of(a), _segments_of(b)):
+        return False
+    for vx, vy in np.concatenate([r[:-1] for r in a.rings()]):
+        if not _in_or_on_area(b, float(vx), float(vy)):
+            return False
+    return all(
+        _in_or_on_area(b, *interior_point(p)) for p in _polygons_of(a)
+    )
+
+
+def _area_interiors_intersect(a, b) -> bool:
+    if _proper_cross_any(_segments_of(a), _segments_of(b)):
+        return True
+    for p in _polygons_of(a):
+        if _strict_in_area(b, *interior_point(p)):
+            return True
+    for p in _polygons_of(b):
+        if _strict_in_area(a, *interior_point(p)):
+            return True
+    return False
+
+
+def _interiors_intersect(a, b) -> bool:
+    """Do the interiors of a and b share a point (the II cell of DE-9IM)?
+    For a point geometry the interior is the point itself."""
+    da, db = geometry_dimension(a), geometry_dimension(b)
+    if da > db:
+        return _interiors_intersect(b, a)
+    if da == 0:
+        if db == 0:
+            bpts = {(p.x, p.y) for p in _points_of(b)}
+            return any((p.x, p.y) in bpts for p in _points_of(a))
+        if db == 1:
+            boundary = set(_line_boundary_points(b))
+            return any(
+                (p.x, p.y) not in boundary
+                and _on_any_segment(p.x, p.y, _segments_of(b))
+                for p in _points_of(a)
+            )
+        return any(_strict_in_area(b, p.x, p.y) for p in _points_of(a))
+    if da == 1:
+        if db == 1:
+            sa, sb = _segments_of(a), _segments_of(b)
+            if _proper_cross_any(sa, sb) or _collinear_overlap_any(sa, sb):
+                return True
+            # an interior sample of one lying on the interior of the other
+            # (both directions: the contact point may be a vertex of either)
+            bb = set(_line_boundary_points(b))
+            if any(
+                _on_any_segment(x, y, sb) and (x, y) not in bb
+                for x, y in _line_sample_points(a)
+            ):
+                return True
+            ba = set(_line_boundary_points(a))
+            return any(
+                _on_any_segment(x, y, sa) and (x, y) not in ba
+                for x, y in _line_sample_points(b)
+            )
+        return _line_interior_intersects_area(a, b)
+    return _area_interiors_intersect(a, b)
+
+
+def geometry_touches(a, b) -> bool:
+    """Geometries intersect but their interiors do not (OGC touches).
+    Always False for point/point pairs."""
+    if geometry_dimension(a) == 0 and geometry_dimension(b) == 0:
+        return False
+    if not geometry_intersects(a, b):
+        return False
+    return not _interiors_intersect(a, b)
+
+
+def geometry_crosses(a, b) -> bool:
+    """OGC crosses: interiors intersect in a lower dimension than the
+    geometries' max, and each geometry has parts outside the other.
+    Defined for point/line, point/area, line/area, line/line."""
+    da, db = geometry_dimension(a), geometry_dimension(b)
+    if da > db:
+        return geometry_crosses(b, a)
+    if da == 0 and db == 0:
+        return False
+    if da == 0:
+        pts = _points_of(a)
+        if len(pts) < 2:
+            return False  # a single point cannot also have an exterior part
+        inside = _interiors_intersect(a, b)
+        outside = any(not geometry_intersects(p, b) for p in pts)
+        return inside and outside
+    if da == 1 and db == 1:
+        sa, sb = _segments_of(a), _segments_of(b)
+        return _proper_cross_any(sa, sb) and not _collinear_overlap_any(
+            sa, sb
+        )
+    if da == 1 and db == 2:
+        if not _line_interior_intersects_area(a, b):
+            return False
+        samples = _line_sample_points(a) + _line_boundary_points(a)
+        return any(not _in_or_on_area(b, x, y) for x, y in samples)
+    return False  # area/area never crosses
+
+
+def geometry_overlaps(a, b) -> bool:
+    """OGC overlaps: same dimension, interiors intersect with that same
+    dimension, and neither is covered by the other."""
+    da, db = geometry_dimension(a), geometry_dimension(b)
+    if da != db:
+        return False
+    if da == 0:
+        apts = {(p.x, p.y) for p in _points_of(a)}
+        bpts = {(p.x, p.y) for p in _points_of(b)}
+        return bool(apts & bpts) and bool(apts - bpts) and bool(bpts - apts)
+    if da == 1:
+        sa, sb = _segments_of(a), _segments_of(b)
+        if not _collinear_overlap_any(sa, sb):
+            return False
+        return not _covered(a, b) and not _covered(b, a)
+    if not _area_interiors_intersect(a, b):
+        return False
+    return not _covered(a, b) and not _covered(b, a)
+
+
+def _boundary_geom(g):
+    """The topological boundary as a geometry (None = empty set):
+    area -> its rings as lines; open line -> its endpoints; point -> empty."""
+    from geomesa_tpu.geom.base import LineString, MultiLineString, MultiPoint, Point
+
+    d = geometry_dimension(g)
+    if d == 0:
+        return None
+    if d == 1:
+        pts = _line_boundary_points(g)
+        if not pts:
+            return None
+        return MultiPoint(tuple(Point(x, y) for x, y in pts))
+    return MultiLineString(tuple(LineString(r) for r in g.rings()))
+
+
+def _relate_cells(a, b):
+    """The 9 DE-9IM cells as lazy thunks, row-major over
+    (Interior, Boundary, Exterior) of a x b."""
+    ba, bb = _boundary_geom(a), _boundary_geom(b)
+    return (
+        lambda: _interiors_intersect(a, b),
+        lambda: bb is not None and _interiors_intersect(a, bb),
+        lambda: not _covered(a, b),
+        lambda: ba is not None and _interiors_intersect(ba, b),
+        lambda: ba is not None
+        and bb is not None
+        and geometry_intersects(ba, bb),
+        lambda: ba is not None and not _covered(ba, b),
+        lambda: not _covered(b, a),
+        lambda: bb is not None and not _covered(bb, a),
+        lambda: True,
+    )
+
+
+def geometry_relate(a, b) -> str:
+    """DE-9IM-lite matrix: 9 chars over (Interior, Boundary, Exterior) of
+    a x b, row-major -- 'T' = the sets intersect, 'F' = they do not.
+    Dimension digits are NOT computed (see relate_matches: pattern digits
+    match any non-empty cell)."""
+    return "".join("T" if cell() else "F" for cell in _relate_cells(a, b))
+
+
+def relate_matches(matrix: str, pattern: str) -> bool:
+    """Match a DE-9IM-lite matrix against a pattern. '*' matches anything;
+    'T' and dimension digits '0'/'1'/'2' match any non-empty cell; 'F'
+    matches empty. (Lite: we do not distinguish intersection dimensions.)"""
+    if len(matrix) != 9 or len(pattern) != 9:
+        raise ValueError(f"DE-9IM strings must be 9 chars: {matrix!r} {pattern!r}")
+    for m, p in zip(matrix, pattern.upper()):
+        if p == "*":
+            continue
+        if p in ("T", "0", "1", "2"):
+            if m != "T":
+                return False
+        elif p == "F":
+            if m != "F":
+                return False
+        else:
+            raise ValueError(f"bad DE-9IM pattern char {p!r}")
+    return True
+
+
+def geometry_relate_matches(a, b, pattern: str) -> bool:
+    """Pattern match without materializing the full matrix: only the cells
+    the pattern constrains are computed (most masks constrain 2-3 of 9,
+    and each cell costs segment-pair geometry work)."""
+    pattern = pattern.upper()
+    if len(pattern) != 9 or any(c not in "*TF012" for c in pattern):
+        raise ValueError(f"bad DE-9IM pattern {pattern!r} (9 chars of *TF012)")
+    for p, cell in zip(pattern, _relate_cells(a, b)):
+        if p == "*":
+            continue
+        if cell() != (p != "F"):
+            return False
+    return True

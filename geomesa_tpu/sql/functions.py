@@ -26,7 +26,12 @@ from geomesa_tpu.geom.base import (
     Polygon,
 )
 from geomesa_tpu.geom.predicates import (
+    geometry_crosses,
     geometry_intersects,
+    geometry_overlaps,
+    geometry_relate,
+    geometry_relate_matches,
+    geometry_touches,
     geometry_within,
     points_in_polygon,
 )
@@ -324,6 +329,49 @@ def st_contains(a, b):
 def st_within(a, b):
     """a within b."""
     return st_contains(b, a)
+
+
+def st_crosses(a, b):
+    """OGC crosses (ref SpatialRelationFunctions.ST_Crosses [UNVERIFIED -
+    empty reference mount]): interiors meet in a lower dimension and each
+    geometry extends outside the other."""
+    return _pairwise(a, b, geometry_crosses)
+
+
+def st_touches(a, b):
+    """OGC touches: geometries meet only at their boundaries."""
+    return _pairwise(a, b, geometry_touches)
+
+
+def st_overlaps(a, b):
+    """OGC overlaps: same dimension, interiors partially shared, neither
+    covers the other."""
+    return _pairwise(a, b, geometry_overlaps)
+
+
+def st_relate(a, b):
+    """DE-9IM-lite matrix string per pair ('T'/'F' cells; dimension digits
+    are not computed -- see geom.predicates.relate_matches)."""
+    if isinstance(a, Geometry) and isinstance(b, Geometry):
+        return geometry_relate(a, b)
+    av = a if not isinstance(a, Geometry) else None
+    bv = b if not isinstance(b, Geometry) else None
+    n = len(av) if av is not None else len(bv)
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        ga = a if av is None else _row_geom(a, i)
+        gb = b if bv is None else _row_geom(b, i)
+        out[i] = geometry_relate(ga, gb)
+    return out
+
+
+def st_relateBool(a, b, pattern: str):
+    """DE-9IM-lite pattern match (ref ST_RelateBool)."""
+
+    def fn(ga, gb):
+        return geometry_relate_matches(ga, gb, pattern)
+
+    return _pairwise(a, b, fn)
 
 
 def _segments_of(g) -> np.ndarray:

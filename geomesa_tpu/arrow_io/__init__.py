@@ -5,10 +5,13 @@ ArrowEncodedSft [UNVERIFIED - empty reference mount]).
 Geometries are typed Arrow vectors, not WKT blobs: points are fixed-width
 ``struct<x: float64, y: float64>`` (the reference's PointVector twin child
 vectors), lines are ``list<point>``, polygons ``list<list<point>>`` and so
-on. String attributes dictionary-encode. The SFT rides in schema metadata
-so a bare IPC stream is self-describing -- the reference's ArrowEncodedSft
-role. Sorted per-partition streams merge with a k-way heap, the client-side
-half of the reference's DeltaWriter/reader protocol.
+on. String attributes dictionary-encode; the DeltaWriter grows its
+dictionaries monotonically and ships only the new entries per batch (Arrow
+delta dictionary messages -- the reference's DeltaWriter protocol). The
+SFT rides in schema metadata so a bare IPC stream is self-describing --
+the reference's ArrowEncodedSft role. Sorted per-partition streams merge
+with a k-way heap into one unified-dictionary stream, the client-side half
+of the reference's DeltaWriter/reader protocol.
 """
 
 from geomesa_tpu.arrow_io.schema import (
@@ -19,9 +22,13 @@ from geomesa_tpu.arrow_io.schema import (
 )
 from geomesa_tpu.arrow_io.io import (
     ArrowStreamWriter,
-    read_feature_stream,
+    DeltaWriter,
+    merge_delta_streams,
     merge_sorted_streams,
+    read_feature_stream,
+    write_delta_stream,
     write_feature_stream,
+    write_merged_delta_stream,
 )
 
 __all__ = [
@@ -30,7 +37,11 @@ __all__ = [
     "arrow_to_batch",
     "sft_from_schema",
     "ArrowStreamWriter",
+    "DeltaWriter",
     "read_feature_stream",
     "write_feature_stream",
+    "write_delta_stream",
     "merge_sorted_streams",
+    "merge_delta_streams",
+    "write_merged_delta_stream",
 ]

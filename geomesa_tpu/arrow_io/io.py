@@ -158,3 +158,156 @@ def _take_rows(sft, rows) -> FeatureBatch:
     for taken, dst in pieces:
         fids[dst] = taken.fids
     return FeatureBatch.from_columns(sft, out_cols, fids)
+
+
+class DeltaWriter:
+    """Dictionary-delta streaming writer (ref geomesa-arrow io/DeltaWriter
+    [UNVERIFIED - empty reference mount]).
+
+    String dictionaries grow monotonically across batches and each IPC
+    message carries only the NEW dictionary entries (Arrow delta
+    dictionary messages, ``emit_dictionary_deltas``), so long exports and
+    server-side streaming aggregation never retransmit or rebuild a
+    dictionary. ``sort_key`` sorts EACH written batch independently; a
+    stream is globally sorted (mergeable with ``merge_delta_streams``)
+    only when the written batches form ascending runs -- use
+    ``write_delta_stream``, which sorts each input batch BEFORE chunking,
+    for that. Any Arrow IPC reader (including ``read_feature_stream``)
+    consumes the output; deltas are applied transparently.
+    """
+
+    def __init__(
+        self,
+        sink,
+        sft: SimpleFeatureType,
+        dict_encode: "tuple[str, ...] | None" = None,
+        sort_key: "str | None" = None,
+        with_visibility: bool = False,
+    ):
+        import pyarrow as pa
+
+        self.sft = sft
+        self.sort_key = sort_key
+        self.schema = arrow_schema_for(
+            sft, dict_encode, with_visibility=with_visibility
+        )
+        self._dict_ids: dict = {}  # field -> {value: index}
+        self._dict_values: dict = {}  # field -> [values in id order]
+        for f in self.schema:
+            if pa.types.is_dictionary(f.type):
+                self._dict_ids[f.name] = {}
+                self._dict_values[f.name] = []
+        self._writer = pa.ipc.new_stream(
+            sink,
+            self.schema,
+            options=pa.ipc.IpcWriteOptions(emit_dictionary_deltas=True),
+        )
+        self.batches = 0
+
+    def _encode_dict(self, name: str, col, field):
+        import pyarrow as pa
+
+        ids = self._dict_ids[name]
+        values = self._dict_values[name]
+        indices: list = []
+        for v in col:
+            if v is None:
+                indices.append(None)
+                continue
+            v = str(v)
+            i = ids.get(v)
+            if i is None:
+                i = ids[v] = len(values)
+                values.append(v)
+            indices.append(i)
+        return pa.DictionaryArray.from_arrays(
+            pa.array(indices, pa.int32()), pa.array(values, pa.string())
+        )
+
+    def write(self, batch: FeatureBatch) -> None:
+        if self.sort_key is not None:
+            order = np.argsort(batch.column(self.sort_key), kind="stable")
+            batch = batch.take(order)
+        self._writer.write_batch(
+            batch_to_arrow(batch, self.schema, string_encoder=self._encode_dict)
+        )
+        self.batches += 1
+
+    def dictionary(self, name: str) -> list:
+        """Current accumulated dictionary for a field (test/debug hook)."""
+        return list(self._dict_values[name])
+
+    def close(self) -> None:
+        self._writer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_delta_stream(
+    sink,
+    batches,
+    sft: "SimpleFeatureType | None" = None,
+    chunk_size: "int | None" = None,
+    **kw,
+) -> int:
+    """Write FeatureBatches as one dictionary-delta IPC stream; returns
+    the batch count. ``chunk_size`` re-chunks large batches so dictionary
+    deltas actually stream instead of arriving in one message.
+
+    ``sort_key`` (kwarg) sorts each INPUT batch before chunking, so the
+    chunks of one batch form a sorted run; global stream order across
+    multiple input batches is the caller's responsibility (each reference
+    server sorts only its own delta stream -- the reader's k-way merge
+    unifies them)."""
+    from geomesa_tpu.security import VIS_COLUMN
+
+    sort_key = kw.pop("sort_key", None)
+
+    def chunked():
+        for b in batches:
+            if sort_key is not None:
+                b = b.take(np.argsort(b.column(sort_key), kind="stable"))
+            if chunk_size is None or len(b) <= chunk_size:
+                yield b
+            else:
+                for i in range(0, len(b), chunk_size):
+                    yield b.take(np.arange(i, min(i + chunk_size, len(b))))
+
+    it = chunked()
+    first = next(it, None)
+    if first is None:
+        if sft is None:
+            raise ValueError("empty stream needs an explicit sft")
+        with DeltaWriter(sink, sft, **kw):
+            pass
+        return 0
+    kw.setdefault("with_visibility", VIS_COLUMN in first.columns)
+    with DeltaWriter(sink, sft or first.sft, **kw) as w:
+        w.write(first)
+        for b in it:
+            w.write(b)
+        return w.batches
+
+
+def merge_delta_streams(sources, key: str, batch_size: int = 8192):
+    """K-way merge of sorted Arrow IPC streams (delta-dictionary or plain)
+    into globally sorted FeatureBatches (ref ArrowStreamReader's sorted
+    merge). Each source is a binary file-like/buffer of one IPC stream."""
+    yield from merge_sorted_streams(
+        [read_feature_stream(s) for s in sources], key, batch_size
+    )
+
+
+def write_merged_delta_stream(
+    sink, sources, key: str, sft: "SimpleFeatureType | None" = None, **kw
+) -> int:
+    """Merge N sorted delta streams into ONE delta stream with unified
+    dictionaries (the client-side reduce of the reference's server-side
+    Arrow aggregation)."""
+    return write_delta_stream(
+        sink, merge_delta_streams(sources, key), sft=sft, **kw
+    )

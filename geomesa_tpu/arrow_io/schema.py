@@ -190,8 +190,13 @@ def _decode_geom_column(arr, type_name: str) -> np.ndarray:
 # -- batch <-> RecordBatch ---------------------------------------------------
 
 
-def batch_to_arrow(batch: FeatureBatch, schema=None):
-    """FeatureBatch -> pyarrow RecordBatch under the typed-vector schema."""
+def batch_to_arrow(batch: FeatureBatch, schema=None, string_encoder=None):
+    """FeatureBatch -> pyarrow RecordBatch under the typed-vector schema.
+
+    string_encoder: optional hook ``(attr_name, col, field) -> Array | None``
+    for dictionary fields (the DeltaWriter supplies one that encodes against
+    its monotonically growing dictionaries); None falls back to per-batch
+    encoding."""
     import pyarrow as pa
 
     from geomesa_tpu.security import VIS_COLUMN
@@ -218,11 +223,15 @@ def batch_to_arrow(batch: FeatureBatch, schema=None):
         elif attr.type_name == "Date":
             a = pa.array(col, type=pa.timestamp("ms"))
         elif attr.type_name == "String":
-            a = pa.array(
-                [None if v is None else str(v) for v in col], pa.string()
-            )
-            if pa.types.is_dictionary(field.type):
-                a = a.dictionary_encode()
+            a = None
+            if string_encoder is not None and pa.types.is_dictionary(field.type):
+                a = string_encoder(attr.name, col, field)
+            if a is None:
+                a = pa.array(
+                    [None if v is None else str(v) for v in col], pa.string()
+                )
+                if pa.types.is_dictionary(field.type):
+                    a = a.dictionary_encode()
         else:
             a = pa.array(col, type=field.type)
         arrays.append(a)

@@ -63,10 +63,10 @@ def write_batch(batch, path: str, fmt: str, track_attr: "str | None" = None):
 
         orc.write_table(batch.to_arrow(), path)
     elif fmt == "arrow":
-        from geomesa_tpu.arrow_io import write_feature_stream
+        from geomesa_tpu.arrow_io import write_delta_stream
 
         with open(path, "wb") as sink:
-            write_feature_stream(sink, [batch], sft=batch.sft)
+            write_delta_stream(sink, [batch], sft=batch.sft, chunk_size=1 << 16)
     elif fmt == "avro":
         from geomesa_tpu.features.avro import write_avro
 

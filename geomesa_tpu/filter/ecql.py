@@ -280,10 +280,10 @@ def _spatial(p: _P, op: str) -> ast.Filter:
         k, v = p.next()
         if k != "string":
             raise ValueError(f"RELATE expects a DE-9IM pattern string, got {v!r}")
-        pat = _unquote(v)
+        from geomesa_tpu.geom.predicates import validate_de9im_pattern
+
         # fail at parse time, not deep inside a per-row scan
-        if len(pat) != 9 or any(c not in "*TF012" for c in pat.upper()):
-            raise ValueError(f"bad DE-9IM pattern {pat!r} (9 chars of *TF012)")
+        pat = validate_de9im_pattern(_unquote(v))
         p.expect("rparen")
         return ast.Intersects(attr, geom_poly, op="relate", pattern=pat)
     p.expect("rparen")

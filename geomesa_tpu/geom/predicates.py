@@ -87,13 +87,32 @@ def _segments_of(geom) -> "np.ndarray | None":
     return None
 
 
+def _expand_pairs(sa: np.ndarray, sb: np.ndarray):
+    """All (m*k, 4) segment pairs of sa x sb, or None when either is
+    empty -- the one place the pairwise expansion lives."""
+    if sa is None or sb is None or len(sa) == 0 or len(sb) == 0:
+        return None
+    m, k = len(sa), len(sb)
+    return np.repeat(sa, k, axis=0), np.tile(sb, (m, 1))
+
+
+def _cross(ox, oy, px_, py_, qx, qy):
+    """Cross product of (p - o) x (q - o): the single orientation
+    primitive every predicate shares (any robustness/tolerance fix
+    happens here)."""
+    return (px_ - ox) * (qy - oy) - (py_ - oy) * (qx - ox)
+
+
+def _orient(ox, oy, px_, py_, qx, qy):
+    return np.sign(_cross(ox, oy, px_, py_, qx, qy))
+
+
 def _any_segments_cross(sa: np.ndarray, sb: np.ndarray) -> bool:
     """Do any segments of (m,4) array sa intersect any of (k,4) sb."""
-    m, k = len(sa), len(sb)
-    if m == 0 or k == 0:
+    pairs = _expand_pairs(sa, sb)
+    if pairs is None:
         return False
-    A = np.repeat(sa, k, axis=0)
-    B = np.tile(sb, (m, 1))
+    A, B = pairs
     hits = segments_intersect(
         A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 0], B[:, 1], B[:, 2], B[:, 3]
     )
@@ -140,15 +159,7 @@ def geometry_intersects(a, b) -> bool:
         if isinstance(other, (Polygon, MultiPolygon)):
             if _poly_contains_point(other, pt.x, pt.y):
                 return True
-        segs = _segments_of(other)
-        if segs is None:
-            return False
-        px = np.full(len(segs), pt.x)
-        py = np.full(len(segs), pt.y)
-        on = segments_intersect(
-            px, py, px, py, segs[:, 0], segs[:, 1], segs[:, 2], segs[:, 3]
-        )
-        return bool(on.any())
+        return _on_any_segment(pt.x, pt.y, _segments_of(other))
     sa, sb = _segments_of(a), _segments_of(b)
     if _any_segments_cross(sa, sb):
         return True
@@ -217,19 +228,15 @@ def geometry_within(inner, outer) -> bool:
 def segments_intersect(ax, ay, bx, by, cx, cy, dx, dy) -> np.ndarray:
     """Vectorized proper/improper segment intersection AB vs CD (orientation
     sign tests, inclusive of touching endpoints)."""
-
-    def orient(ox, oy, px_, py_, qx, qy):
-        return np.sign((px_ - ox) * (qy - oy) - (py_ - oy) * (qx - ox))
-
-    d1 = orient(cx, cy, dx, dy, ax, ay)
-    d2 = orient(cx, cy, dx, dy, bx, by)
-    d3 = orient(ax, ay, bx, by, cx, cy)
-    d4 = orient(ax, ay, bx, by, dx, dy)
+    d1 = _orient(cx, cy, dx, dy, ax, ay)
+    d2 = _orient(cx, cy, dx, dy, bx, by)
+    d3 = _orient(ax, ay, bx, by, cx, cy)
+    d4 = _orient(ax, ay, bx, by, dx, dy)
     proper = (d1 * d2 < 0) & (d3 * d4 < 0)
 
     def on_seg(ox, oy, px_, py_, qx, qy):
         return (
-            (orient(ox, oy, px_, py_, qx, qy) == 0)
+            (_orient(ox, oy, px_, py_, qx, qy) == 0)
             & (np.minimum(ox, px_) <= qx)
             & (qx <= np.maximum(ox, px_))
             & (np.minimum(oy, py_) <= qy)
@@ -359,36 +366,26 @@ def interior_point(poly) -> "tuple[float, float]":
 
 def _proper_cross_any(sa, sb) -> bool:
     """Any strictly-proper segment crossing (interiors pass through)."""
-    if sa is None or sb is None or len(sa) == 0 or len(sb) == 0:
+    pairs = _expand_pairs(sa, sb)
+    if pairs is None:
         return False
-    m, k = len(sa), len(sb)
-    A = np.repeat(sa, k, axis=0)
-    B = np.tile(sb, (m, 1))
-
-    def orient(ox, oy, px_, py_, qx, qy):
-        return np.sign((px_ - ox) * (qy - oy) - (py_ - oy) * (qx - ox))
-
-    d1 = orient(B[:, 0], B[:, 1], B[:, 2], B[:, 3], A[:, 0], A[:, 1])
-    d2 = orient(B[:, 0], B[:, 1], B[:, 2], B[:, 3], A[:, 2], A[:, 3])
-    d3 = orient(A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 0], B[:, 1])
-    d4 = orient(A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 2], B[:, 3])
+    A, B = pairs
+    d1 = _orient(B[:, 0], B[:, 1], B[:, 2], B[:, 3], A[:, 0], A[:, 1])
+    d2 = _orient(B[:, 0], B[:, 1], B[:, 2], B[:, 3], A[:, 2], A[:, 3])
+    d3 = _orient(A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 0], B[:, 1])
+    d4 = _orient(A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 2], B[:, 3])
     return bool(((d1 * d2 < 0) & (d3 * d4 < 0)).any())
 
 
 def _collinear_overlap_any(sa, sb) -> bool:
     """Any pair of collinear segments sharing positive-length extent."""
-    if sa is None or sb is None or len(sa) == 0 or len(sb) == 0:
+    pairs = _expand_pairs(sa, sb)
+    if pairs is None:
         return False
-    m, k = len(sa), len(sb)
-    A = np.repeat(sa, k, axis=0)
-    B = np.tile(sb, (m, 1))
-
-    def cross(ox, oy, px_, py_, qx, qy):
-        return (px_ - ox) * (qy - oy) - (py_ - oy) * (qx - ox)
-
+    A, B = pairs
     col = (
-        (cross(A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 0], B[:, 1]) == 0)
-        & (cross(A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 2], B[:, 3]) == 0)
+        (_cross(A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 0], B[:, 1]) == 0)
+        & (_cross(A[:, 0], A[:, 1], A[:, 2], A[:, 3], B[:, 2], B[:, 3]) == 0)
     )
     # project onto the dominant axis of A and require positive overlap
     dx = np.abs(A[:, 2] - A[:, 0])
@@ -645,23 +642,29 @@ def geometry_relate(a, b) -> str:
     return "".join("T" if cell() else "F" for cell in _relate_cells(a, b))
 
 
+def validate_de9im_pattern(pattern: str) -> str:
+    """Normalize + validate a DE-9IM pattern (the one shared rule: 9 chars
+    of ``*TF012``). Returns the uppercased pattern; raises ValueError.
+    Used by the matchers here and by the ECQL parser's parse-time check."""
+    p = pattern.upper()
+    if len(p) != 9 or any(c not in "*TF012" for c in p):
+        raise ValueError(
+            f"bad DE-9IM pattern {pattern!r} (9 chars of *TF012)"
+        )
+    return p
+
+
 def relate_matches(matrix: str, pattern: str) -> bool:
     """Match a DE-9IM-lite matrix against a pattern. '*' matches anything;
     'T' and dimension digits '0'/'1'/'2' match any non-empty cell; 'F'
     matches empty. (Lite: we do not distinguish intersection dimensions.)"""
-    if len(matrix) != 9 or len(pattern) != 9:
-        raise ValueError(f"DE-9IM strings must be 9 chars: {matrix!r} {pattern!r}")
-    for m, p in zip(matrix, pattern.upper()):
+    if len(matrix) != 9:
+        raise ValueError(f"DE-9IM matrix must be 9 chars: {matrix!r}")
+    for m, p in zip(matrix, validate_de9im_pattern(pattern)):
         if p == "*":
             continue
-        if p in ("T", "0", "1", "2"):
-            if m != "T":
-                return False
-        elif p == "F":
-            if m != "F":
-                return False
-        else:
-            raise ValueError(f"bad DE-9IM pattern char {p!r}")
+        if (m == "T") != (p != "F"):
+            return False
     return True
 
 
@@ -669,9 +672,7 @@ def geometry_relate_matches(a, b, pattern: str) -> bool:
     """Pattern match without materializing the full matrix: only the cells
     the pattern constrains are computed (most masks constrain 2-3 of 9,
     and each cell costs segment-pair geometry work)."""
-    pattern = pattern.upper()
-    if len(pattern) != 9 or any(c not in "*TF012" for c in pattern):
-        raise ValueError(f"bad DE-9IM pattern {pattern!r} (9 chars of *TF012)")
+    pattern = validate_de9im_pattern(pattern)
     for p, cell in zip(pattern, _relate_cells(a, b)):
         if p == "*":
             continue

@@ -20,17 +20,15 @@ def arrow_conversion(
     query=ast.Include,
     batch_size: int = 1 << 16,
 ) -> bytes:
-    """Query -> Arrow IPC stream bytes (ref ArrowConversionProcess)."""
-    from geomesa_tpu.arrow_io import write_feature_stream
+    """Query -> Arrow IPC stream bytes as dictionary-delta batches
+    (ref ArrowConversionProcess + DeltaWriter)."""
+    from geomesa_tpu.arrow_io import write_delta_stream
 
     res = store.query(type_name, query)
     sink = io.BytesIO()
-    b = res.batch
-    chunks = [
-        b.take(range(i, min(i + batch_size, len(b))))
-        for i in range(0, len(b), batch_size)
-    ]
-    write_feature_stream(sink, chunks, sft=b.sft)
+    write_delta_stream(
+        sink, [res.batch], sft=res.batch.sft, chunk_size=batch_size
+    )
     return sink.getvalue()
 
 

@@ -103,10 +103,14 @@ class _Handler(BaseHTTPRequestHandler):
         res = self._query(type_name, q)
         fmt = q.get("f", "geojson")
         if fmt == "arrow":
-            from geomesa_tpu.arrow_io import write_feature_stream
+            from geomesa_tpu.arrow_io import write_delta_stream
 
             sink = io.BytesIO()
-            write_feature_stream(sink, [res.batch], sft=res.batch.sft)
+            # dictionary-delta batches: clients consume incrementally and
+            # dictionaries never retransmit (ref DeltaWriter protocol)
+            write_delta_stream(
+                sink, [res.batch], sft=res.batch.sft, chunk_size=1 << 14
+            )
             self._send(
                 200, sink.getvalue(), "application/vnd.apache.arrow.stream"
             )

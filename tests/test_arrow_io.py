@@ -164,3 +164,166 @@ class TestSortedMerge:
 
     def test_merge_empty_streams(self):
         assert list(merge_sorted_streams([[], []], "dtg")) == []
+
+
+class TestDeltaWriter:
+    SPEC = "name:String,tag:String,count:Int,dtg:Date,*geom:Point:srid=4326"
+
+    def _batches(self, seed, n_batches=4, n=500):
+        from geomesa_tpu.features.batch import FeatureBatch
+        from geomesa_tpu.features.sft import SimpleFeatureType
+
+        sft = SimpleFeatureType.create("delta", self.SPEC)
+        rng = np.random.default_rng(seed)
+        out = []
+        fid = 0
+        for k in range(n_batches):
+            # vocabulary GROWS across batches: batch k introduces new words
+            vocab = [f"w{j}" for j in range((k + 1) * 3)]
+            out.append(
+                FeatureBatch.from_columns(
+                    sft,
+                    {
+                        "name": rng.choice(vocab, n),
+                        "tag": rng.choice(["a", "b"], n),
+                        "count": rng.integers(0, 100, n),
+                        "dtg": rng.integers(0, 10**9, n),
+                        "geom": np.stack(
+                            [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)],
+                            axis=1,
+                        ),
+                    },
+                    np.arange(fid, fid + n),
+                )
+            )
+            fid += n
+        return sft, out
+
+    def test_roundtrip_equals_plain_ipc(self):
+        import io as _io
+
+        from geomesa_tpu.arrow_io import (
+            read_feature_stream,
+            write_delta_stream,
+            write_feature_stream,
+        )
+        from geomesa_tpu.features.batch import FeatureBatch
+
+        sft, batches = self._batches(1)
+        delta, plain = _io.BytesIO(), _io.BytesIO()
+        assert write_delta_stream(delta, batches, sft=sft) == len(batches)
+        write_feature_stream(plain, batches, sft=sft)
+        got = FeatureBatch.concat(list(read_feature_stream(_io.BytesIO(delta.getvalue()))))
+        want = FeatureBatch.concat(list(read_feature_stream(_io.BytesIO(plain.getvalue()))))
+        np.testing.assert_array_equal(got.fids, want.fids)
+        for name in ("name", "tag", "count", "dtg"):
+            np.testing.assert_array_equal(got.columns[name], want.columns[name])
+        np.testing.assert_allclose(got.columns["geom"], want.columns["geom"])
+
+    def test_dictionaries_grow_monotonically(self):
+        import io as _io
+
+        from geomesa_tpu.arrow_io import DeltaWriter
+
+        sft, batches = self._batches(2)
+        sink = _io.BytesIO()
+        with DeltaWriter(sink, sft) as w:
+            prefixes = []
+            for b in batches:
+                w.write(b)
+                prefixes.append(w.dictionary("name"))
+        # each snapshot is a prefix of the next (monotone growth = deltas)
+        for a, b in zip(prefixes[:-1], prefixes[1:]):
+            assert b[: len(a)] == a
+        assert len(prefixes[-1]) > len(prefixes[0])
+
+    def test_delta_messages_on_wire(self):
+        """The IPC stream must contain dictionary DELTA messages, not
+        full replacements (isDelta flag in the message header)."""
+        import io as _io
+
+        import pyarrow.ipc as ipc
+
+        from geomesa_tpu.arrow_io import write_delta_stream
+
+        sft, batches = self._batches(3)
+        sink = _io.BytesIO()
+        write_delta_stream(sink, batches, sft=sft)
+        sink.seek(0)
+        kinds = [m.type for m in ipc.MessageReader.open_stream(sink)]
+        # growing vocab across 4 batches -> additional dictionary messages
+        # after the first (deltas; the stream format forbids replacements,
+        # so a successful write with >1 dictionary message means deltas)
+        assert kinds.count("dictionary") > 2, kinds
+        assert kinds.count("record batch") == len(batches)
+
+    def test_sorted_merge_unified_dictionaries(self):
+        import io as _io
+
+        from geomesa_tpu.arrow_io import (
+            read_feature_stream,
+            write_delta_stream,
+            write_merged_delta_stream,
+        )
+        from geomesa_tpu.features.batch import FeatureBatch
+
+        sft, batches = self._batches(4, n_batches=3, n=400)
+        # three independent sorted delta streams (as three servers would)
+        sources = []
+        all_counts = []
+        for b in batches:
+            order = np.argsort(b.columns["count"], kind="stable")
+            sb = b.take(order)
+            all_counts.append(sb.columns["count"])
+            s = _io.BytesIO()
+            write_delta_stream(s, [sb], sft=sft)
+            sources.append(_io.BytesIO(s.getvalue()))
+        merged_sink = _io.BytesIO()
+        write_merged_delta_stream(merged_sink, sources, "count", sft=sft)
+        got = FeatureBatch.concat(
+            list(read_feature_stream(_io.BytesIO(merged_sink.getvalue())))
+        )
+        c = got.columns["count"]
+        assert np.all(np.diff(c.astype(np.int64)) >= 0), "merge not sorted"
+        np.testing.assert_array_equal(
+            np.sort(np.concatenate(all_counts)), np.sort(c)
+        )
+        assert len(got) == sum(len(b) for b in batches)
+
+    def test_server_arrow_endpoint_emits_deltas(self):
+        """The HTTP bridge's f=arrow path streams delta batches."""
+        import io as _io
+
+        from geomesa_tpu.arrow_io import read_feature_stream
+        from geomesa_tpu.process.conversion import arrow_conversion
+        from geomesa_tpu.store import MemoryDataStore
+
+        store = MemoryDataStore()
+        sft, batches = self._batches(5, n_batches=2)
+        store.create_schema(sft)
+        for b in batches:
+            store.write("delta", b)
+        data = arrow_conversion(store, "delta", batch_size=256)
+        got = list(read_feature_stream(_io.BytesIO(data)))
+        assert sum(len(b) for b in got) == 1000
+        assert len(got) >= 4  # actually chunked
+
+    def test_sort_key_with_chunking_stays_sorted(self):
+        """Regression: sorting must happen BEFORE chunking, or chunked
+        streams are only per-chunk sorted and the k-way merge silently
+        misorders rows."""
+        import io as _io
+
+        from geomesa_tpu.arrow_io import read_feature_stream, write_delta_stream
+        from geomesa_tpu.features.batch import FeatureBatch
+
+        sft, batches = self._batches(6, n_batches=1, n=1000)
+        sink = _io.BytesIO()
+        write_delta_stream(
+            sink, batches, sft=sft, sort_key="count", chunk_size=100
+        )
+        got = FeatureBatch.concat(
+            list(read_feature_stream(_io.BytesIO(sink.getvalue())))
+        )
+        c = got.columns["count"].astype(np.int64)
+        assert np.all(np.diff(c) >= 0), "chunked stream not globally sorted"

@@ -60,9 +60,10 @@ class ArrowStreamWriter:
         self.close()
 
 
-def write_feature_stream(sink, batches, sft=None, **kw) -> int:
-    """Write an iterable of FeatureBatches as one IPC stream; returns the
-    batch count."""
+def _write_stream(writer_cls, sink, batches, sft=None, **kw) -> int:
+    """Shared stream-writing protocol for the plain and delta writers:
+    peek the first batch for the SFT / visibility auto-detect, stream the
+    rest, return the batch count (0-batch streams need an explicit sft)."""
     from geomesa_tpu.security import VIS_COLUMN
 
     batches = iter(batches)
@@ -70,15 +71,21 @@ def write_feature_stream(sink, batches, sft=None, **kw) -> int:
     if first is None:
         if sft is None:
             raise ValueError("empty stream needs an explicit sft")
-        with ArrowStreamWriter(sink, sft, **kw):
+        with writer_cls(sink, sft, **kw):
             pass
         return 0
     kw.setdefault("with_visibility", VIS_COLUMN in first.columns)
-    with ArrowStreamWriter(sink, sft or first.sft, **kw) as w:
+    with writer_cls(sink, sft or first.sft, **kw) as w:
         w.write(first)
         for b in batches:
             w.write(b)
         return w.batches
+
+
+def write_feature_stream(sink, batches, sft=None, **kw) -> int:
+    """Write an iterable of FeatureBatches as one IPC stream; returns the
+    batch count."""
+    return _write_stream(ArrowStreamWriter, sink, batches, sft, **kw)
 
 
 def read_feature_stream(source, sft: "SimpleFeatureType | None" = None):
@@ -137,7 +144,10 @@ def merge_sorted_streams(streams, key: str, batch_size: int = 8192):
 
 def _take_rows(sft, rows) -> FeatureBatch:
     """Gather (batch, row) picks into one FeatureBatch, grouped per source
-    batch so the column gathers stay vectorized."""
+    batch so the column gathers stay vectorized. Per-feature visibility
+    labels (the reserved security column) travel with their rows."""
+    from geomesa_tpu.security import VIS_COLUMN
+
     groups: dict = {}
     for j, (batch, i) in enumerate(rows):
         groups.setdefault(id(batch), (batch, []))[1].append((i, j))
@@ -157,7 +167,15 @@ def _take_rows(sft, rows) -> FeatureBatch:
     fids = np.empty(n, dtype=object)
     for taken, dst in pieces:
         fids[dst] = taken.fids
-    return FeatureBatch.from_columns(sft, out_cols, fids)
+    out = FeatureBatch.from_columns(sft, out_cols, fids)
+    if any(VIS_COLUMN in taken.columns for taken, _ in pieces):
+        vis = np.full(n, "", dtype=object)
+        for taken, dst in pieces:
+            v = taken.columns.get(VIS_COLUMN)
+            if v is not None:
+                vis[dst] = v
+        out = out.with_visibility(list(vis))
+    return out
 
 
 class DeltaWriter:
@@ -205,23 +223,36 @@ class DeltaWriter:
         self.batches = 0
 
     def _encode_dict(self, name: str, col, field):
+        """Vectorized: Arrow's native dictionary_encode builds the
+        per-batch dictionary in C++; only that (small) dictionary is
+        remapped to global ids in Python, then a numpy gather rewrites
+        the indices -- no per-row Python loop on the export hot path."""
         import pyarrow as pa
 
         ids = self._dict_ids[name]
         values = self._dict_values[name]
-        indices: list = []
-        for v in col:
-            if v is None:
-                indices.append(None)
-                continue
-            v = str(v)
+        try:
+            arr = pa.array(col, pa.string())
+        except (pa.ArrowInvalid, pa.ArrowTypeError):
+            # mixed/non-str objects: slow path, same as plain encoding
+            arr = pa.array(
+                [None if v is None else str(v) for v in col], pa.string()
+            )
+        enc = arr.dictionary_encode()
+        local = enc.dictionary.to_pylist()
+        lut = np.empty(max(len(local), 1), np.int32)
+        for j, v in enumerate(local):
             i = ids.get(v)
             if i is None:
                 i = ids[v] = len(values)
                 values.append(v)
-            indices.append(i)
+            lut[j] = i
+        valid = np.asarray(enc.indices.is_valid())
+        li = np.asarray(enc.indices.fill_null(0)).astype(np.int32)
+        gi = lut[li]
         return pa.DictionaryArray.from_arrays(
-            pa.array(indices, pa.int32()), pa.array(values, pa.string())
+            pa.array(gi, pa.int32(), mask=~valid),
+            pa.array(values, pa.string()),
         )
 
     def write(self, batch: FeatureBatch) -> None:
@@ -263,8 +294,6 @@ def write_delta_stream(
     multiple input batches is the caller's responsibility (each reference
     server sorts only its own delta stream -- the reader's k-way merge
     unifies them)."""
-    from geomesa_tpu.security import VIS_COLUMN
-
     sort_key = kw.pop("sort_key", None)
 
     def chunked():
@@ -277,20 +306,7 @@ def write_delta_stream(
                 for i in range(0, len(b), chunk_size):
                     yield b.take(np.arange(i, min(i + chunk_size, len(b))))
 
-    it = chunked()
-    first = next(it, None)
-    if first is None:
-        if sft is None:
-            raise ValueError("empty stream needs an explicit sft")
-        with DeltaWriter(sink, sft, **kw):
-            pass
-        return 0
-    kw.setdefault("with_visibility", VIS_COLUMN in first.columns)
-    with DeltaWriter(sink, sft or first.sft, **kw) as w:
-        w.write(first)
-        for b in it:
-            w.write(b)
-        return w.batches
+    return _write_stream(DeltaWriter, sink, chunked(), sft, **kw)
 
 
 def merge_delta_streams(sources, key: str, batch_size: int = 8192):

@@ -660,10 +660,12 @@ def relate_matches(matrix: str, pattern: str) -> bool:
     matches empty. (Lite: we do not distinguish intersection dimensions.)"""
     if len(matrix) != 9:
         raise ValueError(f"DE-9IM matrix must be 9 chars: {matrix!r}")
-    for m, p in zip(matrix, validate_de9im_pattern(pattern)):
+    for m, p in zip(matrix.upper(), validate_de9im_pattern(pattern)):
         if p == "*":
             continue
-        if (m == "T") != (p != "F"):
+        # a matrix cell is empty iff 'F' -- 'T' and dimension digits
+        # ('0'/'1'/'2', as standard JTS matrices carry) are all non-empty
+        if (m != "F") != (p != "F"):
             return False
     return True
 

@@ -1,0 +1,80 @@
+"""Worker for the two-process DCN test (tests/test_multihost_dcn.py).
+
+Each process forces the CPU platform with 4 virtual devices, joins the
+jax.distributed process group over a local coordinator, contributes its
+half of the data with host_batches_to_global, and runs the same
+sharded_count_scan -- the multi-host ingest + scan path of
+parallel/multihost.py, exercised with real cross-process collectives.
+
+Platform setup is manual (not jaxconf.force_cpu_devices) because the
+device-count check there would initialize the backend BEFORE
+jax.distributed.initialize, which must come first in a multi-process
+group.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    proc_id = int(sys.argv[1])
+    port = sys.argv[2]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 4)
+    except Exception:
+        pass
+
+    from geomesa_tpu.parallel.multihost import (
+        global_mesh,
+        host_batches_to_global,
+        initialize,
+    )
+
+    initialize(f"127.0.0.1:{port}", num_processes=2, process_id=proc_id)
+
+    import numpy as np
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.local_device_count() == 4, jax.local_device_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    from geomesa_tpu.parallel import sharded_count_scan
+
+    mesh = global_mesh()
+    assert mesh.shape["shard"] == 8
+
+    # identical global dataset on both processes; each contributes only
+    # its local half through the multi-host feed
+    rng = np.random.default_rng(0)
+    n = 8192
+    x = rng.uniform(-180, 180, n).astype(np.float32)
+    y = rng.uniform(-90, 90, n).astype(np.float32)
+    half = n // 2
+    lo = proc_id * half
+    cols = host_batches_to_global(
+        mesh, {"x": x[lo : lo + half], "y": y[lo : lo + half]}
+    )
+    for v in cols.values():
+        assert v.shape == (n,), v.shape  # global length, local halves
+
+    def fn(c):
+        return (c["x"] >= -10) & (c["x"] <= 30) & (c["y"] >= 0)
+
+    count = int(sharded_count_scan(mesh, fn, cols))
+    expect = int(((x >= -10) & (x <= 30) & (y >= 0)).sum())
+    assert count == expect, (count, expect)
+    print(f"proc{proc_id} DCN scan OK count={count}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -327,3 +327,42 @@ class TestDeltaWriter:
         )
         c = got.columns["count"].astype(np.int64)
         assert np.all(np.diff(c) >= 0), "chunked stream not globally sorted"
+
+    def test_merge_preserves_visibility_labels(self):
+        """Regression: the k-way merge must carry the reserved visibility
+        column through _take_rows, not silently drop security labels."""
+        import io as _io
+
+        from geomesa_tpu.arrow_io import (
+            read_feature_stream,
+            write_delta_stream,
+            write_merged_delta_stream,
+        )
+        from geomesa_tpu.features.batch import FeatureBatch
+        from geomesa_tpu.security import VIS_COLUMN
+
+        sft, batches = self._batches(7, n_batches=2, n=50)
+        sources = []
+        for k, b in enumerate(batches):
+            b = b.take(np.argsort(b.columns["count"], kind="stable"))
+            b = b.with_visibility([f"label{k}"] * len(b))
+            s = _io.BytesIO()
+            write_delta_stream(s, [b], sft=sft)
+            sources.append(_io.BytesIO(s.getvalue()))
+        sink = _io.BytesIO()
+        write_merged_delta_stream(sink, sources, "count", sft=sft)
+        got = FeatureBatch.concat(
+            list(read_feature_stream(_io.BytesIO(sink.getvalue())))
+        )
+        vis = got.columns.get(VIS_COLUMN)
+        assert vis is not None
+        assert set(vis.tolist()) == {"label0", "label1"}
+
+    def test_relate_matches_accepts_dimension_matrices(self):
+        """Regression: standard JTS-style matrices carry dimension digits;
+        a digit cell is non-empty (matches 'T', fails 'F')."""
+        from geomesa_tpu.geom.predicates import relate_matches
+
+        assert relate_matches("212101212", "T*T***T**")
+        assert not relate_matches("212101212", "FF*FF****")
+        assert relate_matches("FF2FF1212", "FF*FF****")

@@ -74,10 +74,22 @@ def _write_stream(writer_cls, sink, batches, sft=None, **kw) -> int:
         with writer_cls(sink, sft, **kw):
             pass
         return 0
-    kw.setdefault("with_visibility", VIS_COLUMN in first.columns)
+    auto_detect = "with_visibility" not in kw
+    want_vis = kw.setdefault("with_visibility", VIS_COLUMN in first.columns)
     with writer_cls(sink, sft or first.sft, **kw) as w:
         w.write(first)
         for b in batches:
+            if auto_detect and not want_vis and VIS_COLUMN in b.columns:
+                # never silently strip security labels: auto-detect fixed
+                # a label-free schema from the unlabeled first batch, so a
+                # later labeled batch cannot be represented — fail loudly.
+                # (An EXPLICIT with_visibility=False is the caller opting
+                # out of labels; that strips without complaint.)
+                raise ValueError(
+                    "batch carries visibility labels but the stream schema "
+                    "was auto-detected from an unlabeled first batch; pass "
+                    "with_visibility=True (or False to strip deliberately)"
+                )
             w.write(b)
         return w.batches
 
@@ -309,13 +321,36 @@ def write_delta_stream(
     return _write_stream(DeltaWriter, sink, chunked(), sft, **kw)
 
 
+def _open_stream_readers(sources, sft=None):
+    """Open each IPC source eagerly (schemas become available up front)
+    and return ([batch iterators], any_source_has_visibility)."""
+    import pyarrow as pa
+
+    from geomesa_tpu.security import VIS_COLUMN
+
+    readers = [pa.ipc.open_stream(s) for s in sources]
+
+    def batches(reader):
+        try:
+            stream_sft = sft or sft_from_schema(reader.schema)
+            for rb in reader:
+                yield arrow_to_batch(rb, stream_sft)
+        finally:
+            # deterministic close on exhaustion AND on abandonment (a
+            # consumer breaking out of the merge closes the generator,
+            # which runs this finally)
+            reader.close()
+
+    has_vis = any(VIS_COLUMN in r.schema.names for r in readers)
+    return [batches(r) for r in readers], has_vis
+
+
 def merge_delta_streams(sources, key: str, batch_size: int = 8192):
     """K-way merge of sorted Arrow IPC streams (delta-dictionary or plain)
     into globally sorted FeatureBatches (ref ArrowStreamReader's sorted
     merge). Each source is a binary file-like/buffer of one IPC stream."""
-    yield from merge_sorted_streams(
-        [read_feature_stream(s) for s in sources], key, batch_size
-    )
+    streams, _ = _open_stream_readers(sources)
+    yield from merge_sorted_streams(streams, key, batch_size)
 
 
 def write_merged_delta_stream(
@@ -323,7 +358,14 @@ def write_merged_delta_stream(
 ) -> int:
     """Merge N sorted delta streams into ONE delta stream with unified
     dictionaries (the client-side reduce of the reference's server-side
-    Arrow aggregation)."""
+    Arrow aggregation).
+
+    Visibility is decided from the SOURCE STREAM SCHEMAS, not the first
+    merged chunk: when any input stream carries labels, the output schema
+    must too, even if the first chunk of merged rows happens to be
+    entirely unlabeled."""
+    streams, has_vis = _open_stream_readers(sources, sft)
+    kw.setdefault("with_visibility", has_vis)
     return write_delta_stream(
-        sink, merge_delta_streams(sources, key), sft=sft, **kw
+        sink, merge_sorted_streams(streams, key), sft=sft, **kw
     )

@@ -22,6 +22,16 @@ def _dist_deg(x, y, px, py):
     return np.sqrt(dx * dx + dy * dy)
 
 
+def _k_nearest(batch, geom: str, px: float, py: float, k: int):
+    """(top-k batch, distances) of one candidate batch, nearest first."""
+    if len(batch) == 0:
+        return batch, np.array([])
+    x, y = batch.point_coords(geom)
+    d = _dist_deg(x, y, px, py)
+    order = np.argsort(d, kind="stable")[:k]
+    return batch.take(order), d[order]
+
+
 def knn(
     store,
     type_name: str,
@@ -32,7 +42,12 @@ def knn(
     initial_radius_deg: float = 0.05,
     max_radius_deg: float = 45.0,
 ):
-    """Returns (batch_of_k_nearest, distances_deg), nearest first."""
+    """Returns (batch_of_k_nearest, distances_deg), nearest first.
+
+    If fewer than k features exist inside the ``max_radius_deg`` box
+    around the target, only those are returned — the search never widens
+    past that box, so a sparse region costs one max-radius scan instead
+    of an unbounded base-filter scan."""
     from geomesa_tpu.filter.ecql import parse_ecql
 
     base = (
@@ -42,32 +57,35 @@ def knn(
     )
     sft = store.get_schema(type_name)
     geom = sft.geom_field
+
+    def window(rx: float, ry: float):
+        f = ast.And((ast.BBox(geom, px - rx, py - ry, px + rx, py + ry), base))
+        return store.query(type_name, internal_query(f)).batch
+
     r = initial_radius_deg
     batch = None
+    last_r = 0.0
     while r <= max_radius_deg:
-        f = ast.And((ast.BBox(geom, px - r, py - r, px + r, py + r), base))
-        res = store.query(type_name, internal_query(f))
+        res = window(r, r)
+        last_r = r
         if len(res) >= k:
-            batch = res.batch
+            batch = res
             break
         r *= 2
     if batch is None:
-        res = store.query(type_name, internal_query(base))
-        batch = res.batch
-    if len(batch) == 0:
-        return batch, np.array([])
-    x, y = batch.point_coords(geom)
-    d = _dist_deg(x, y, px, py)
-    order = np.argsort(d, kind="stable")[:k]
-    kth = float(d[order[-1]]) if len(order) else 0.0
+        # The expanding window exhausted max_radius_deg without reaching k
+        # hits. One final pass at exactly the max radius (skipped when the
+        # loop already scanned that box) and we are done: fewer than k
+        # features exist in the search area, and a confidence pass capped
+        # at the same radius could only re-scan a subset of this box.
+        if last_r != max_radius_deg:
+            res = window(max_radius_deg, max_radius_deg)
+        return _k_nearest(res, geom, px, py, k)
+    _, d = _k_nearest(batch, geom, px, py, k)
+    kth = float(d[-1]) if len(d) else 0.0
     # confidence pass: any point with corrected distance <= kth lies inside
     # the raw-degree box of half-extents (kth/cos(lat), kth) around the
     # target -- the k-th circle can poke outside the search window, and the
     # window's lon extent under-covers because the metric shrinks lon.
     rx = kth / max(np.cos(np.radians(py)), 0.01)
-    f = ast.And((ast.BBox(geom, px - rx, py - kth, px + rx, py + kth), base))
-    batch = store.query(type_name, internal_query(f)).batch
-    x, y = batch.point_coords(geom)
-    d = _dist_deg(x, y, px, py)
-    order = np.argsort(d, kind="stable")[:k]
-    return batch.take(order), d[order]
+    return _k_nearest(window(rx, kth), geom, px, py, k)

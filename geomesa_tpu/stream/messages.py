@@ -20,7 +20,10 @@ from geomesa_tpu.features.sft import SimpleFeatureType
 from geomesa_tpu.stream.log import Clear, Put, Remove
 
 MAGIC = 0x47  # 'G'
-VERSION = 2  # v2 added the i64 seq field to the header
+# v2 added the i64 seq field to the header; v3 made Remove fids
+# type-preserving (int fids no longer come back as strings on replay,
+# which silently missed every row keyed by an int fid)
+VERSION = 3
 _PUT, _REMOVE, _CLEAR = 0, 1, 2
 
 
@@ -37,11 +40,17 @@ def encode_message(sft: SimpleFeatureType, msg) -> bytes:
             buf.write(r)
     elif isinstance(msg, Remove):
         buf.write(struct.pack("<BBBq", MAGIC, VERSION, _REMOVE, seq))
-        fids = [str(f).encode("utf-8") for f in np.asarray(msg.fids).tolist()]
+        fids = np.asarray(msg.fids).tolist()
         buf.write(struct.pack("<I", len(fids)))
+        # type byte per fid, mirroring binser's fid rule: a Remove must
+        # round-trip to the same key the Put's fid round-trips to
         for f in fids:
-            buf.write(struct.pack("<H", len(f)))
-            buf.write(f)
+            if isinstance(f, (int, np.integer)):
+                buf.write(struct.pack("<Bq", 0, int(f)))
+            else:
+                enc = str(f).encode("utf-8")
+                buf.write(struct.pack("<BH", 1, len(enc)))
+                buf.write(enc)
     elif isinstance(msg, Clear):
         buf.write(struct.pack("<BBBq", MAGIC, VERSION, _CLEAR, seq))
     else:
@@ -53,7 +62,7 @@ def decode_message(sft: SimpleFeatureType, data: bytes):
     magic, version, kind, raw_seq = struct.unpack_from("<BBBq", data, 0)
     if magic != MAGIC:
         raise ValueError("not a GeoMessage")
-    if version != VERSION:
+    if version not in (2, VERSION):
         raise ValueError(f"unsupported GeoMessage version {version}")
     seq = None if raw_seq < 0 else raw_seq
     off = 11
@@ -73,6 +82,14 @@ def decode_message(sft: SimpleFeatureType, data: bytes):
         off += 4
         fids = []
         for _ in range(count):
+            if version >= 3:
+                (kind_b,) = struct.unpack_from("<B", data, off)
+                off += 1
+                if kind_b == 0:
+                    (v,) = struct.unpack_from("<q", data, off)
+                    off += 8
+                    fids.append(int(v))
+                    continue
             (n,) = struct.unpack_from("<H", data, off)
             off += 2
             fids.append(data[off : off + n].decode("utf-8"))

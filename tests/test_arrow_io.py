@@ -358,6 +358,63 @@ class TestDeltaWriter:
         assert vis is not None
         assert set(vis.tolist()) == {"label0", "label1"}
 
+    def test_merge_mixed_labeled_and_unlabeled_sources(self):
+        """Regression: visibility presence is decided from the SOURCE
+        stream schemas. With an unlabeled source whose keys sort first,
+        the first merged chunk is entirely unlabeled — a first-chunk
+        sniff would fix a label-free schema and silently strip every
+        later label."""
+        import io as _io
+
+        from geomesa_tpu.arrow_io import (
+            read_feature_stream,
+            write_delta_stream,
+            write_merged_delta_stream,
+        )
+        from geomesa_tpu.features.batch import FeatureBatch
+        from geomesa_tpu.security import VIS_COLUMN
+
+        sft, batches = self._batches(11, n_batches=2, n=9000)
+        lo, hi = batches
+        # unlabeled source occupies keys [0, 100): the whole first merge
+        # chunk (8192 rows) comes from it
+        lo.columns["count"] = np.asarray(lo.columns["count"]) % 100
+        hi.columns["count"] = np.asarray(hi.columns["count"]) % 100 + 20000
+        hi = hi.with_visibility(["secret"] * len(hi))
+        sources = []
+        for b in (lo, hi):
+            b = b.take(np.argsort(b.columns["count"], kind="stable"))
+            s = _io.BytesIO()
+            write_delta_stream(s, [b], sft=sft)
+            sources.append(_io.BytesIO(s.getvalue()))
+        sink = _io.BytesIO()
+        write_merged_delta_stream(sink, sources, "count", sft=sft)
+        got = FeatureBatch.concat(
+            list(read_feature_stream(_io.BytesIO(sink.getvalue())))
+        )
+        vis = got.columns.get(VIS_COLUMN)
+        assert vis is not None
+        labeled = np.asarray(vis) == "secret"
+        assert labeled.sum() == 9000
+        assert np.all(
+            np.asarray(got.columns["count"]).astype(np.int64)[labeled] >= 20000
+        )
+
+    def test_later_labeled_batch_on_unlabeled_stream_raises(self):
+        """No silent stripping: a labeled batch after an unlabeled first
+        batch must fail loudly, not lose its labels."""
+        import io as _io
+
+        import pytest as _pytest
+
+        from geomesa_tpu.arrow_io import write_feature_stream
+
+        sft, batches = self._batches(13, n_batches=2, n=50)
+        a, b = batches
+        b = b.with_visibility(["secret"] * len(b))
+        with _pytest.raises(ValueError, match="visibility"):
+            write_feature_stream(_io.BytesIO(), [a, b], sft=sft)
+
     def test_relate_matches_accepts_dimension_matrices(self):
         """Regression: standard JTS-style matrices carry dimension digits;
         a digit cell is non-empty (matches 'T', fails 'F')."""

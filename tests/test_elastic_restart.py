@@ -61,7 +61,8 @@ def test_restart_from_parquet_manifest_and_log_replay(tmp_path):
     # recent writes: some brand-new fids, some overwriting persisted ones
     live.put(_cols(rng, 500), fids=np.arange(10_000, 10_500))
     live.put(_cols(rng, 200), fids=np.arange(200))  # upserts
-    live.remove(np.arange(300, 320))  # live deletions of new... of old fids
+    # delete fids that ARE in the live layer, so remove-replay is exercised
+    live.remove(np.arange(10_480, 10_500))
 
     before = {q: _combined_fids(store, live, q) for q in QUERIES}
     assert any(len(v) for v in before.values())

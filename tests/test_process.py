@@ -95,6 +95,27 @@ class TestKnn:
         assert len(batch) == 10
         np.testing.assert_allclose(np.sort(dists), expected)
 
+    def test_exhausted_window_stays_clamped(self, store):
+        """When the expanding window runs out of radius before finding k
+        hits, the search stays clamped to the max-radius bbox instead of
+        falling back to an unbounded base-filter scan: a target far from
+        all data returns empty, not the whole table's nearest rows."""
+        batch, dists = knn(
+            store, "ais", 120.0, -40.0, 10,
+            initial_radius_deg=0.01, max_radius_deg=0.5,
+        )
+        assert len(batch) == 0
+
+    def test_exhausted_window_returns_in_radius_hits(self, store):
+        # k larger than the dataset: window exhausts, clamped fallback
+        # still returns everything within max_radius_deg of the target
+        batch, dists = knn(
+            store, "ais", 1.5, 50.5, 100000,
+            initial_radius_deg=0.01, max_radius_deg=2.0,
+        )
+        assert 0 < len(batch) < 30000
+        assert float(dists.max()) <= 2.0 * np.sqrt(2) + 1e-9
+
 
 class TestSampling:
     def test_fraction(self, store):

@@ -100,15 +100,23 @@ def write_feature_stream(sink, batches, sft=None, **kw) -> int:
     return _write_stream(ArrowStreamWriter, sink, batches, sft, **kw)
 
 
+def _reader_batches(reader, sft=None):
+    """Decode an OPEN IPC reader into FeatureBatches, closing it on
+    exhaustion and on abandonment (generator close runs the finally)."""
+    try:
+        stream_sft = sft or sft_from_schema(reader.schema)
+        for rb in reader:
+            yield arrow_to_batch(rb, stream_sft)
+    finally:
+        reader.close()
+
+
 def read_feature_stream(source, sft: "SimpleFeatureType | None" = None):
     """Yield FeatureBatches from an IPC stream; the SFT comes from stream
     metadata unless overridden."""
     import pyarrow as pa
 
-    with pa.ipc.open_stream(source) as reader:
-        stream_sft = sft or sft_from_schema(reader.schema)
-        for rb in reader:
-            yield arrow_to_batch(rb, stream_sft)
+    yield from _reader_batches(pa.ipc.open_stream(source), sft)
 
 
 def merge_sorted_streams(streams, key: str, batch_size: int = 8192):
@@ -329,20 +337,8 @@ def _open_stream_readers(sources, sft=None):
     from geomesa_tpu.security import VIS_COLUMN
 
     readers = [pa.ipc.open_stream(s) for s in sources]
-
-    def batches(reader):
-        try:
-            stream_sft = sft or sft_from_schema(reader.schema)
-            for rb in reader:
-                yield arrow_to_batch(rb, stream_sft)
-        finally:
-            # deterministic close on exhaustion AND on abandonment (a
-            # consumer breaking out of the merge closes the generator,
-            # which runs this finally)
-            reader.close()
-
     has_vis = any(VIS_COLUMN in r.schema.names for r in readers)
-    return [batches(r) for r in readers], has_vis
+    return [_reader_batches(r, sft) for r in readers], has_vis
 
 
 def merge_delta_streams(sources, key: str, batch_size: int = 8192):

@@ -89,6 +89,24 @@ class DeviceIndex:
 
         return detach
 
+    # -- subclass hooks ----------------------------------------------------
+
+    def _host_rows(self):
+        """Host mirror aligned row-for-row with the device columns."""
+        return self._host_batch
+
+    def _host_valid(self) -> "np.ndarray | None":
+        """Host-side validity over the mirror rows; None = all live."""
+        return None
+
+    def _staged_len(self) -> int:
+        """Rows staged on device (mirror length; may exceed live rows)."""
+        return len(self._host_batch)
+
+    def _make_scan_fns(self, compiled):
+        """(count_fn, mask_fn) taking the resident column subset."""
+        return compiled.jitted_scan()
+
     # -- queries -----------------------------------------------------------
 
     def _compiled_for(self, query):
@@ -102,10 +120,10 @@ class DeviceIndex:
             missing = [c for c in compiled.device_cols if c not in self._cols]
             if missing:
                 raise ValueError(
-                    f"columns {missing} not resident; construct DeviceIndex "
+                    f"columns {missing} not resident; construct the index "
                     f"with columns= including them"
                 )
-            count_fn, mask_fn = compiled.jitted_scan()
+            count_fn, mask_fn = self._make_scan_fns(compiled)
             self._compiled[key] = (compiled, count_fn, mask_fn)
         return self._compiled[key]
 
@@ -117,21 +135,27 @@ class DeviceIndex:
         else falls through to query()."""
         compiled, count_fn, _ = self._compiled_for(query)
         if not compiled.device_cols:
-            return int(compiled.host_mask(self._host_batch).sum())
+            m = compiled.host_mask(self._host_rows())
+            hv = self._host_valid()
+            return int((m & hv).sum() if hv is not None else m.sum())
         if not compiled.fully_on_device:
             return len(self.query(query))
         return int(count_fn(self._resident_subset(compiled)))
 
     def mask(self, query) -> np.ndarray:
-        """Boolean hit mask over the resident rows."""
+        """Boolean hit mask over the staged rows; rows absent from the
+        live set (evicted, in subclasses) are always False."""
         compiled, _, mask_fn = self._compiled_for(query)
         if not compiled.device_cols:
-            return compiled.host_mask(self._host_batch)
+            m = compiled.host_mask(self._host_rows())
+            hv = self._host_valid()
+            return (m & hv) if hv is not None else m
         m = np.asarray(mask_fn(self._resident_subset(compiled)))
+        m = m[: self._staged_len()]
         if not compiled.fully_on_device:
             idx = np.nonzero(m)[0]
             if len(idx):
-                keep = compiled.residual_mask(self._host_batch.take(idx))
+                keep = compiled.residual_mask(self._host_rows().take(idx))
                 out = np.zeros(len(m), dtype=bool)
                 out[idx[keep]] = True
                 return out
@@ -139,4 +163,259 @@ class DeviceIndex:
 
     def query(self, query):
         """FeatureBatch of hits (host-side take over the device mask)."""
-        return self._host_batch.take(np.nonzero(self.mask(query))[0])
+        return self._host_rows().take(np.nonzero(self.mask(query))[0])
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+class StreamingDeviceIndex(DeviceIndex):
+    """Delta-refreshed resident index: appends and evictions touch only
+    the changed rows instead of restaging every column (VERDICT round-1
+    item 9; ref role: the Kafka consumer keeping tablet caches warm,
+    SURVEY section 2.6 Kafka-consumer row [UNVERIFIED - empty reference
+    mount]).
+
+    Device columns live in fixed-capacity buffers with a boolean validity
+    plane. An append is ONE donated jit call per column set
+    (``dynamic_update_slice`` at the current row count); an eviction
+    flips validity bits. Deltas are padded to power-of-two row buckets so
+    jit recompiles stay bounded. When a append would overflow capacity,
+    or dead rows pass ``compact_threshold``, the index compacts: one full
+    restage at double capacity (amortized O(1) per appended row).
+
+    Scans run the XLA-fused path with the validity plane ANDed in (the
+    Pallas tile kernels do not read a validity column; padded buffers
+    would miscount there). ``attach_live`` applies per-message deltas:
+    Put -> upsert, Remove -> evict, Clear -> full refresh.
+    """
+
+    #: smallest device append bucket (rows); tiny puts pad up to this
+    MIN_DELTA_ROWS = 256
+
+    def __init__(
+        self,
+        store,
+        type_name: str,
+        columns: "list[str] | None" = None,
+        capacity: "int | None" = None,
+        compact_threshold: float = 0.5,
+    ):
+        self._capacity_hint = capacity
+        self.compact_threshold = compact_threshold
+        self.restages = 0  # full restages (init, growth, compaction)
+        self.delta_appends = 0  # appends served by the delta path
+        self._append_jit = None
+        self._evict_jit = None
+        super().__init__(store, type_name, columns)
+
+    # -- cache lifecycle ---------------------------------------------------
+
+    def refresh(self) -> None:
+        res = self.store.query(self.type_name, internal_query(ast.Include))
+        self._install(res.batch)
+
+    def _install(self, batch, min_cap: int = 0) -> None:
+        """Full (re)stage of ``batch`` into fresh capacity-padded buffers."""
+        import jax.numpy as jnp
+
+        n = len(batch)
+        cap = _next_pow2(
+            max(n, min_cap, self._capacity_hint or 0, self.MIN_DELTA_ROWS)
+        )
+        cols = stage_columns(batch, self._planes)
+        self._cols = {
+            k: jnp.concatenate([v, jnp.zeros(cap - n, v.dtype)])
+            if cap > n
+            else v
+            for k, v in cols.items()
+        }
+        self._valid = jnp.arange(cap) < n
+        self._cap = cap
+        self._n = n
+        self._n_dead = 0
+        self._parts = [batch]
+        self._host_cache = batch
+        self._valid_np = np.ones(n, dtype=bool)
+        self._row_of = {f: i for i, f in enumerate(batch.fids.tolist())}
+        self.restages += 1
+
+    def _host(self):
+        if self._host_cache is None:
+            from geomesa_tpu.features.batch import FeatureBatch
+
+            self._host_cache = (
+                self._parts[0]
+                if len(self._parts) == 1
+                else FeatureBatch.concat(self._parts)
+            )
+        return self._host_cache
+
+    def _live_rows(self):
+        """Host batch of only the live (non-evicted) rows."""
+        return self._host().take(np.nonzero(self._valid_np)[0])
+
+    # -- deltas ------------------------------------------------------------
+
+    def append(self, batch) -> None:
+        """Stage only the new rows; one donated device update per call.
+        Fids must be new — use upsert() when overwrites are possible."""
+        import jax
+        import jax.numpy as jnp
+
+        m = len(batch)
+        if m == 0:
+            return
+        pad = max(_next_pow2(m), self.MIN_DELTA_ROWS)
+        if self._n + pad > self._cap:
+            # grow: compact out dead rows, double capacity for headroom
+            from geomesa_tpu.features.batch import FeatureBatch
+
+            merged = FeatureBatch.concat([self._live_rows(), batch])
+            self._install(merged, min_cap=2 * len(merged))
+            return
+        delta = stage_columns(batch, self._planes)
+        delta = {
+            k: jnp.concatenate([v, jnp.zeros(pad - m, v.dtype)])
+            if pad > m
+            else v
+            for k, v in delta.items()
+        }
+        if not delta:
+            # no stageable planes (e.g. all-string schema): the device
+            # side is just the validity plane
+            upd = (jnp.arange(pad) < m) if pad > m else jnp.ones(m, bool)
+            self._valid = jax.lax.dynamic_update_slice_in_dim(
+                self._valid, upd, self._n, 0
+            )
+            self._finish_append(batch, m)
+            return
+        if self._append_jit is None:
+            def _append(cols, valid, delta, n, m):
+                out = {
+                    k: jax.lax.dynamic_update_slice_in_dim(
+                        buf, delta[k].astype(buf.dtype), n, 0
+                    )
+                    for k, buf in cols.items()
+                }
+                upd = jnp.arange(next(iter(delta.values())).shape[0]) < m
+                return out, jax.lax.dynamic_update_slice_in_dim(
+                    valid, upd, n, 0
+                )
+
+            self._append_jit = jax.jit(_append, donate_argnums=(0, 1))
+        self._cols, self._valid = self._append_jit(
+            self._cols, self._valid, delta, self._n, m
+        )
+        self._finish_append(batch, m)
+
+    def _finish_append(self, batch, m: int) -> None:
+        self._parts.append(batch)
+        self._host_cache = None
+        self._valid_np = np.concatenate(
+            [self._valid_np, np.ones(m, dtype=bool)]
+        )
+        for i, f in enumerate(batch.fids.tolist()):
+            self._row_of[f] = self._n + i
+        self._n += m
+        self.delta_appends += 1
+
+    def evict(self, fids) -> None:
+        """Drop rows by fid: flips validity bits on device, no restage."""
+        import jax
+        import jax.numpy as jnp
+
+        rows = [
+            self._row_of.pop(f)
+            for f in np.asarray(fids).tolist()
+            if f in self._row_of
+        ]
+        if not rows:
+            return
+        self._valid_np[rows] = False
+        self._n_dead += len(rows)
+        pad = max(_next_pow2(len(rows)), 64)
+        # out-of-range sentinel pads; mode='drop' discards them
+        idx = np.full(pad, self._cap, dtype=np.int32)
+        idx[: len(rows)] = rows
+        if self._evict_jit is None:
+            self._evict_jit = jax.jit(
+                lambda valid, rows: valid.at[rows].set(False, mode="drop"),
+                donate_argnums=(0,),
+            )
+        self._valid = self._evict_jit(self._valid, jnp.asarray(idx))
+        if self._n_dead > self.compact_threshold * max(self._n, 1):
+            self._install(self._live_rows(), min_cap=self._cap)
+
+    def upsert(self, batch) -> None:
+        """Evict any existing rows for the batch's fids, then append."""
+        existing = [f for f in batch.fids.tolist() if f in self._row_of]
+        if existing:
+            self.evict(np.asarray(existing, dtype=object))
+        self.append(batch)
+
+    def clear(self) -> None:
+        self._install(self._parts[0].take(np.array([], dtype=np.int64)))
+
+    def attach_live(self, live_store):
+        """Apply per-message deltas from a live store: Put upserts only
+        the changed rows, Remove evicts, Clear (or anything else) falls
+        back to a full refresh. Returns a detach callable."""
+        from geomesa_tpu.features.batch import FeatureBatch
+        from geomesa_tpu.stream.log import Put, Remove
+
+        def listener(msg):
+            if isinstance(msg, Put):
+                self.upsert(
+                    FeatureBatch.from_columns(self.sft, msg.columns, msg.fids)
+                )
+            elif isinstance(msg, Remove):
+                self.evict(np.asarray(msg.fids))
+            else:
+                self.refresh()
+
+        live_store.add_listener(listener)
+
+        def detach() -> None:
+            remove = getattr(live_store, "remove_listener", None)
+            if remove is not None:
+                remove(listener)
+
+        return detach
+
+    # -- query hooks (scan bodies live in DeviceIndex) ---------------------
+
+    def __len__(self) -> int:
+        return self._n - self._n_dead
+
+    @property
+    def nbytes(self) -> int:
+        return int(
+            sum(v.nbytes for v in self._cols.values()) + self._valid.nbytes
+        )
+
+    def _host_rows(self):
+        return self._host()
+
+    def _host_valid(self):
+        return self._valid_np
+
+    def _staged_len(self) -> int:
+        return self._n
+
+    def _make_scan_fns(self, compiled):
+        """Valid-aware jitted scans: the compiled filter's XLA mask ANDed
+        with the validity plane, fused in one dispatch. The wrappers read
+        ``self._valid`` at call time — appends and evictions replace it."""
+        import jax
+        import jax.numpy as jnp
+
+        mask_jit = jax.jit(lambda cols, valid: compiled.device_fn(cols) & valid)
+        count_jit = jax.jit(
+            lambda cols, valid: jnp.sum(compiled.device_fn(cols) & valid)
+        )
+        return (
+            lambda cols: count_jit(cols, self._valid),
+            lambda cols: mask_jit(cols, self._valid),
+        )

@@ -64,7 +64,7 @@ def knn(
 
     r = initial_radius_deg
     batch = None
-    last_r = 0.0
+    last_r = None  # radius of the last window actually scanned
     while r <= max_radius_deg:
         res = window(r, r)
         last_r = r

@@ -34,6 +34,48 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _default_n(args, platform: str) -> int:
+    """Rows resident on device: 2^28 = 3-4GB of planes fits v5e HBM with
+    headroom and amortizes dispatch latency; smaller elsewhere."""
+    return args.n or (
+        (1 << 28) if platform == "tpu"
+        else (1 << 27) if platform != "cpu"
+        else (1 << 20)
+    )
+
+
+def _measure(chain, inputs, args, k: int, n: int, bytes_per_row: int,
+             platform: str, label: str) -> dict:
+    """Timed median-of-iters protocol shared by the scan benchmarks: one
+    scalar fetch per chain dispatch is the only sync point."""
+    times = []
+    for _ in range(args.iters):
+        t = time.perf_counter()
+        int(chain(*inputs))
+        times.append(time.perf_counter() - t)
+    best = min(times) / k
+    per_inv = sorted(times)[len(times) // 2] / k
+    feats_per_sec = n / per_inv
+    gbps = n * bytes_per_row / per_inv / 1e9
+    hbm_pct = (
+        round(100.0 * gbps / V5E_HBM_PEAK_GBPS, 1)
+        if platform == "tpu"
+        else None
+    )
+    log(
+        f"{label} best={best*1e3:.2f}ms median={per_inv*1e3:.2f}ms per "
+        f"invocation ({bytes_per_row}B/row) -> "
+        f"{feats_per_sec/1e9:.2f}B features/sec/chip, {gbps:.0f} GB/s"
+        + (f" ({hbm_pct}% of v5e HBM peak)" if hbm_pct is not None else "")
+    )
+    return {
+        "value": round(feats_per_sec, 1),
+        "gbps": round(gbps, 1),
+        "hbm_pct": hbm_pct,
+        "per_invocation_ms": round(per_inv * 1e3, 3),
+    }
+
+
 def _chain(scan_fn, k):
     """One jitted dispatch running ``scan_fn`` k times: the barrier ties
     every input to the loop carry, so the loop body cannot be hoisted or
@@ -62,14 +104,7 @@ def bench_filter(args) -> dict:
     import numpy as np
 
     platform = jax.devices()[0].platform
-    # 2^28 rows = 4.3GB of columns: fits v5e HBM with headroom and
-    # amortizes dispatch latency (2^29 exhausts the chip). Non-TPU
-    # accelerators get the smaller default; override with --n
-    n = args.n or (
-        (1 << 28) if platform == "tpu"
-        else (1 << 27) if platform != "cpu"
-        else (1 << 20)
-    )
+    n = _default_n(args, platform)
     log(f"platform={platform} device={jax.devices()[0]} n={n:,}")
 
     from geomesa_tpu.features.sft import SimpleFeatureType
@@ -159,37 +194,143 @@ def bench_filter(args) -> dict:
     # the chain must have run the same kernel K times
     assert total == (k * hits) % (1 << 32), (total, hits, k)
 
-    times = []
-    for _ in range(args.iters):
-        t = time.perf_counter()
-        int(chain(cols))  # scalar fetch = the one hard sync point
-        times.append(time.perf_counter() - t)
-    best = min(times) / k
-    per_inv = sorted(times)[len(times) // 2] / k
-    feats_per_sec = n / per_inv
-    gbps = n * bytes_per_row / per_inv / 1e9
-    hbm_pct = (
-        round(100.0 * gbps / V5E_HBM_PEAK_GBPS, 1)
-        if platform == "tpu"
-        else None
-    )
-    log(
-        f"best={best*1e3:.2f}ms median={per_inv*1e3:.2f}ms per invocation "
-        f"({bytes_per_row}B/row) -> {feats_per_sec/1e9:.2f}B features/sec"
-        f"/chip, {gbps:.0f} GB/s"
-        + (f" ({hbm_pct}% of v5e HBM peak)" if hbm_pct is not None else "")
-    )
-
+    m = _measure(chain, (cols,), args, k, n, bytes_per_row, platform, "filter")
     baseline_per_chip = 62.5e6  # BASELINE.json north star / 8 chips
     return {
         "metric": "bbox+time filter throughput (fused device scan)",
-        "value": round(feats_per_sec, 1),
+        "value": m["value"],
         "unit": "features/sec/chip",
-        "vs_baseline": round(feats_per_sec / baseline_per_chip, 2),
-        "gbps": round(gbps, 1),
-        "hbm_pct": hbm_pct,
+        "vs_baseline": round(m["value"] / baseline_per_chip, 2),
+        "gbps": m["gbps"],
+        "hbm_pct": m["hbm_pct"],
         "chain": k,
-        "per_invocation_ms": round(per_inv * 1e3, 3),
+        "per_invocation_ms": m["per_invocation_ms"],
+        "n": n,
+    }
+
+
+def bench_zscan(args) -> dict:
+    """Z3Iterator-analog scan: filter by the resident KEY planes alone
+    (bin int32 + z hi/lo uint32 = 12B/row vs 16B/row of attribute
+    planes). The masked-compare kernel needs no de-interleave — Morton
+    spreading is monotonic (ops/zscan.py); loose cell-granular semantics,
+    exactly what the reference's Z3Iterator answers without residual
+    refinement."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from geomesa_tpu.curves import Z3SFC
+    from geomesa_tpu.curves.binnedtime import WEEK_MS
+    from geomesa_tpu.filter.ecql import parse_instant
+    from geomesa_tpu.ops import zscan
+
+    platform = jax.devices()[0].platform
+    n = _default_n(args, platform)
+    log(f"platform={platform} device={jax.devices()[0]} n={n:,} (zscan mode)")
+    sfc = Z3SFC()
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    qt0 = parse_instant("2020-01-10T00:00:00")
+    qt1 = parse_instant("2020-01-15T00:00:00")
+    qx0, qy0, qx1, qy1 = -10.0, 35.0, 30.0, 60.0
+
+    from geomesa_tpu.jaxconf import require_x64
+
+    require_x64()  # i64 only while deriving the resident planes
+    key = jax.random.PRNGKey(42)
+    kx, ky, kt = jax.random.split(key, 3)
+
+    def _coords():
+        x = jax.random.uniform(kx, (n,), jnp.float32, -180.0, 180.0)
+        y = jax.random.uniform(ky, (n,), jnp.float32, -90.0, 90.0)
+        dtg = jax.random.randint(kt, (n,), t0, t1, jnp.int64)
+        bins64 = dtg // WEEK_MS
+        off = ((dtg - bins64 * WEEK_MS) // 1000).astype(jnp.float32)
+        return x, y, off, bins64
+
+    @jax.jit
+    def make_planes():
+        x, y, off, bins64 = _coords()
+        z_hi, z_lo = sfc.index_jax_hi_lo(x, y, off)
+        # only the key planes leave this jit: the coordinate planes are
+        # scratch, freed before the timed loop (the --check oracle
+        # recomputes them from the same PRNG keys)
+        return bins64.astype(jnp.int32), z_hi, z_lo
+
+    bins, z_hi, z_lo = jax.block_until_ready(make_planes())
+    bounds_np, ids_np = zscan.z3_query_bounds(
+        sfc, qx0, qy0, qx1, qy1, qt0, qt1
+    )
+    bounds_np, ids_np = zscan.pad_bins(bounds_np, ids_np)
+    bounds, ids = jnp.asarray(bounds_np), jnp.asarray(ids_np)
+    log(f"query spans {int((ids_np >= 0).sum())} period bins "
+        f"(padded to {len(ids_np)})")
+
+    # XLA-fused path, deliberately: measured on v5e, the hand-tiled Pallas
+    # variant (zscan.build_z3_pallas_scan, CI-verified in interpret mode)
+    # tops out ~305 GB/s while XLA's fusion pipeline reaches ~410-450 GB/s
+    # for this pure compare+reduce shape — the opposite of the attribute
+    # filter scan, where the Pallas tiles win. Engine choice is per-kernel,
+    # decided by measurement (README component map).
+    def scan_fn(b, zh, zl):
+        return zscan.z3_zscan_mask(zh, zl, b, bounds, ids).sum()
+
+    bytes_per_row = 12  # int32 bin + 2x uint32 z planes
+    hits = int(jax.jit(scan_fn)(bins, z_hi, z_lo))
+    log(f"hits={hits:,} (selectivity {hits / n:.4%}, loose cell semantics)")
+
+    if args.check:
+        # independent oracle: per-dimension cell compare on the raw
+        # coordinate planes (no interleave anywhere in this path)
+        from geomesa_tpu.curves.binnedtime import bins_for_interval
+
+        cell_bounds = []
+        for b, lo_off, hi_off in bins_for_interval(qt0, qt1, sfc.period):
+            cell_bounds.append((b, (
+                int(sfc.lon.normalize(qx0)), int(sfc.lat.normalize(qy0)),
+                int(sfc.time.normalize(lo_off))), (
+                int(sfc.lon.normalize(qx1)), int(sfc.lat.normalize(qy1)),
+                int(sfc.time.normalize(hi_off)))))
+
+        @jax.jit
+        def oracle():
+            # identical PRNG keys -> identical coordinates; no interleave
+            # anywhere in this path, and nothing stays resident after
+            xa, ya, offa, bins64 = _coords()
+            nx = sfc.lon.normalize_jax(xa).astype(jnp.int32)
+            ny = sfc.lat.normalize_jax(ya).astype(jnp.int32)
+            nt = sfc.time.normalize_jax(offa).astype(jnp.int32)
+            m = jnp.zeros(n, bool)
+            for b, qlo, qhi in cell_bounds:
+                m_b = bins64.astype(jnp.int32) == b
+                m_b &= (nx >= qlo[0]) & (nx <= qhi[0])
+                m_b &= (ny >= qlo[1]) & (ny <= qhi[1])
+                m_b &= (nt >= qlo[2]) & (nt <= qhi[2])
+                m = m | m_b
+            return m.sum()
+
+        expect = int(oracle())
+        assert hits == expect, f"zscan {hits} != cell oracle {expect}"
+        log("count verified against per-dimension cell oracle")
+
+    k = args.chain
+    chain = _chain(scan_fn, k)
+    t_c = time.perf_counter()
+    total = int(chain(bins, z_hi, z_lo))
+    log(f"zscan chain (K={k}) compiled in {time.perf_counter() - t_c:.1f}s")
+    assert total == (k * hits) % (1 << 32), (total, hits, k)
+
+    m = _measure(
+        chain, (bins, z_hi, z_lo), args, k, n, bytes_per_row, platform,
+        "zscan",
+    )
+    return {
+        "metric": "key-only z scan (Z3Iterator analog)",
+        "value": m["value"],
+        "unit": "features/sec/chip",
+        "gbps": m["gbps"],
+        "hbm_pct": m["hbm_pct"],
         "n": n,
     }
 
@@ -305,19 +446,26 @@ def main() -> None:
     )
     ap.add_argument(
         "--mode",
-        choices=("all", "filter", "build"),
+        choices=("all", "filter", "zscan", "build"),
         default="all",
-        help="all: filter scan + Z3 build, one JSON line with both "
-        "(what the driver records); filter / build: that one alone",
+        help="all: filter scan + key-only z scan + Z3 build, one JSON "
+        "line with everything (what the driver records); "
+        "filter / zscan / build: that one alone",
     )
     args = ap.parse_args()
 
     if args.mode == "filter":
         out = bench_filter(args)
+    elif args.mode == "zscan":
+        out = bench_zscan(args)
     elif args.mode == "build":
         out = bench_build(args)
     else:
         out = bench_filter(args)
+        z = bench_zscan(args)
+        out["zscan_feats_per_sec"] = z["value"]
+        out["zscan_gbps"] = z["gbps"]
+        out["zscan_hbm_pct"] = z["hbm_pct"]
         build = bench_build(args)
         out["build_pts_per_sec"] = build["value"]
         out["build_chain"] = build["build_chain"]

@@ -202,19 +202,27 @@ class StreamingDeviceIndex(DeviceIndex):
         capacity: "int | None" = None,
         compact_threshold: float = 0.5,
     ):
+        import threading
+
         self._capacity_hint = capacity
         self.compact_threshold = compact_threshold
         self.restages = 0  # full restages (init, growth, compaction)
         self.delta_appends = 0  # appends served by the delta path
         self._append_jit = None
         self._evict_jit = None
+        # live-store listeners run OUTSIDE the store's lock (stream/live.py
+        # invokes callbacks unlocked, possibly from several producer
+        # threads), and the delta paths are order-sensitive stateful
+        # mutations of donated buffers -- serialize every mutation and scan
+        self._lock = threading.RLock()
         super().__init__(store, type_name, columns)
 
     # -- cache lifecycle ---------------------------------------------------
 
     def refresh(self) -> None:
-        res = self.store.query(self.type_name, internal_query(ast.Include))
-        self._install(res.batch)
+        with self._lock:
+            res = self.store.query(self.type_name, internal_query(ast.Include))
+            self._install(res.batch)
 
     def _install(self, batch, min_cap: int = 0) -> None:
         """Full (re)stage of ``batch`` into fresh capacity-padded buffers."""
@@ -261,6 +269,10 @@ class StreamingDeviceIndex(DeviceIndex):
     def append(self, batch) -> None:
         """Stage only the new rows; one donated device update per call.
         Fids must be new — use upsert() when overwrites are possible."""
+        with self._lock:
+            self._append_locked(batch)
+
+    def _append_locked(self, batch) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -323,6 +335,10 @@ class StreamingDeviceIndex(DeviceIndex):
 
     def evict(self, fids) -> None:
         """Drop rows by fid: flips validity bits on device, no restage."""
+        with self._lock:
+            self._evict_locked(fids)
+
+    def _evict_locked(self, fids) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -350,13 +366,15 @@ class StreamingDeviceIndex(DeviceIndex):
 
     def upsert(self, batch) -> None:
         """Evict any existing rows for the batch's fids, then append."""
-        existing = [f for f in batch.fids.tolist() if f in self._row_of]
-        if existing:
-            self.evict(np.asarray(existing, dtype=object))
-        self.append(batch)
+        with self._lock:
+            existing = [f for f in batch.fids.tolist() if f in self._row_of]
+            if existing:
+                self._evict_locked(np.asarray(existing, dtype=object))
+            self._append_locked(batch)
 
     def clear(self) -> None:
-        self._install(self._parts[0].take(np.array([], dtype=np.int64)))
+        with self._lock:
+            self._install(self._parts[0].take(np.array([], dtype=np.int64)))
 
     def attach_live(self, live_store):
         """Apply per-message deltas from a live store: Put upserts only
@@ -385,6 +403,18 @@ class StreamingDeviceIndex(DeviceIndex):
         return detach
 
     # -- query hooks (scan bodies live in DeviceIndex) ---------------------
+
+    def count(self, query) -> int:
+        with self._lock:
+            return super().count(query)
+
+    def mask(self, query) -> np.ndarray:
+        with self._lock:
+            return super().mask(query)
+
+    def query(self, query):
+        with self._lock:
+            return super().query(query)
 
     def __len__(self) -> int:
         return self._n - self._n_dead

@@ -1,0 +1,208 @@
+"""Z-only compare scan: masked key compare == per-dimension cell compare.
+
+The kernel's claim is that no de-interleave is needed: spreading is
+monotonic, so comparing (z & dim_mask) against spread bounds equals
+comparing the decoded dimension against the cell bounds. The oracle here
+decodes and compares per dimension — an independent path through the
+same bit layout.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curves import zorder
+from geomesa_tpu.curves.z3 import Z3SFC
+from geomesa_tpu.ops import zscan
+
+
+def _planes(z: np.ndarray):
+    import jax.numpy as jnp
+
+    return (
+        jnp.asarray((z >> np.uint64(32)).astype(np.uint32)),
+        jnp.asarray((z & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
+    )
+
+
+class TestZ3MaskedCompare:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
+    def test_fuzz_matches_decode_oracle(self, seed):
+        rng = np.random.default_rng(seed)
+        n = 20000
+        cx = rng.integers(0, 1 << 21, n).astype(np.uint64)
+        cy = rng.integers(0, 1 << 21, n).astype(np.uint64)
+        ct = rng.integers(0, 1 << 21, n).astype(np.uint64)
+        z = zorder.encode_3d_np(cx, cy, ct)
+        lo = np.sort(rng.integers(0, 1 << 21, (3, 2)), axis=1)
+        qlo, qhi = tuple(lo[:, 0]), tuple(lo[:, 1])
+        bounds = zscan.z3_dim_bounds(qlo, qhi)[None]  # B=1
+        bins = np.zeros(n, np.int32)
+        import jax.numpy as jnp
+
+        z_hi, z_lo = _planes(z)
+        got = np.asarray(
+            zscan.z3_zscan_mask(
+                z_hi, z_lo, jnp.asarray(bins), jnp.asarray(bounds),
+                jnp.asarray(np.array([0], np.int32)),
+            )
+        )
+        expect = (
+            (cx >= qlo[0]) & (cx <= qhi[0])
+            & (cy >= qlo[1]) & (cy <= qhi[1])
+            & (ct >= qlo[2]) & (ct <= qhi[2])
+        )
+        np.testing.assert_array_equal(got, expect)
+
+    def test_degenerate_single_cell_and_full_domain(self):
+        rng = np.random.default_rng(9)
+        n = 5000
+        cx = rng.integers(0, 1 << 21, n).astype(np.uint64)
+        cy = rng.integers(0, 1 << 21, n).astype(np.uint64)
+        ct = rng.integers(0, 1 << 21, n).astype(np.uint64)
+        z = zorder.encode_3d_np(cx, cy, ct)
+        import jax.numpy as jnp
+
+        z_hi, z_lo = _planes(z)
+        bins = jnp.zeros(n, jnp.int32)
+        ids = jnp.asarray(np.array([0], np.int32))
+        # full domain: everything matches
+        full = zscan.z3_dim_bounds((0, 0, 0), ((1 << 21) - 1,) * 3)[None]
+        assert bool(
+            zscan.z3_zscan_mask(z_hi, z_lo, bins, jnp.asarray(full), ids).all()
+        )
+        # single cell: exactly the rows in that cell
+        cell = (int(cx[0]), int(cy[0]), int(ct[0]))
+        one = zscan.z3_dim_bounds(cell, cell)[None]
+        got = np.asarray(
+            zscan.z3_zscan_mask(z_hi, z_lo, bins, jnp.asarray(one), ids)
+        )
+        expect = (cx == cell[0]) & (cy == cell[1]) & (ct == cell[2])
+        np.testing.assert_array_equal(got, expect)
+
+    def test_multi_bin_window(self):
+        """Per-bin bounds: edge bins partial, interior bins full, padded
+        ids never match."""
+        import jax.numpy as jnp
+
+        sfc = Z3SFC()
+        rng = np.random.default_rng(4)
+        n = 50000
+        lon = rng.uniform(-180, 180, n)
+        lat = rng.uniform(-90, 90, n)
+        t0 = np.datetime64("2020-01-01").astype("datetime64[ms]").astype(np.int64)
+        t = t0 + rng.integers(0, 30 * 86400_000, n)
+        from geomesa_tpu.curves.binnedtime import to_binned_time
+
+        bins_np, off = to_binned_time(t, sfc.period)
+        z = sfc.index(lon, lat, off)
+        z_hi, z_lo = _planes(z)
+
+        qx0, qy0, qx1, qy1 = -20.0, 10.0, 60.0, 70.0
+        qt0 = int(t0 + 3 * 86400_000)
+        qt1 = int(t0 + 17 * 86400_000)  # spans 3 week bins
+        bounds, ids = zscan.z3_query_bounds(sfc, qx0, qy0, qx1, qy1, qt0, qt1)
+        assert len(ids) == 3
+        bounds, ids = zscan.pad_bins(bounds, ids)
+        assert len(ids) == 4 and ids[-1] == -1
+        got = np.asarray(
+            zscan.z3_zscan_mask(
+                z_hi, z_lo,
+                jnp.asarray(bins_np.astype(np.int32)),
+                jnp.asarray(bounds), jnp.asarray(ids),
+            )
+        )
+        # oracle: quantized-cell (loose) semantics per dimension
+        nx = np.asarray(sfc.lon.normalize(lon)).astype(np.int64)
+        ny = np.asarray(sfc.lat.normalize(lat)).astype(np.int64)
+        qnx = (int(sfc.lon.normalize(qx0)), int(sfc.lon.normalize(qx1)))
+        qny = (int(sfc.lat.normalize(qy0)), int(sfc.lat.normalize(qy1)))
+        spatial = (nx >= qnx[0]) & (nx <= qnx[1]) & (ny >= qny[0]) & (ny <= qny[1])
+        nt = np.asarray(sfc.time.normalize(off)).astype(np.int64)
+        from geomesa_tpu.curves.binnedtime import bins_for_interval
+
+        temporal = np.zeros(n, bool)
+        for b, lo_off, hi_off in bins_for_interval(qt0, qt1, sfc.period):
+            lo_c = int(sfc.time.normalize(lo_off))
+            hi_c = int(sfc.time.normalize(hi_off))
+            temporal |= (bins_np == b) & (nt >= lo_c) & (nt <= hi_c)
+        np.testing.assert_array_equal(got, spatial & temporal)
+        # loose semantics contain the exact box (no false negatives)
+        exact = (
+            (lon >= qx0) & (lon <= qx1) & (lat >= qy0) & (lat <= qy1)
+            & (t >= qt0) & (t <= qt1)
+        )
+        assert not np.any(exact & ~got)
+
+
+class TestZ2MaskedCompare:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_fuzz_matches_cell_oracle(self, seed):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(seed)
+        n = 20000
+        cx = rng.integers(0, 1 << 31, n).astype(np.uint64)
+        cy = rng.integers(0, 1 << 31, n).astype(np.uint64)
+        z = zorder.encode_2d_np(cx, cy)
+        lo = np.sort(
+            rng.integers(0, 1 << 31, (2, 2), dtype=np.int64), axis=1
+        )
+        qlo, qhi = tuple(lo[:, 0]), tuple(lo[:, 1])
+        bounds = zscan.z2_dim_bounds(qlo, qhi)
+        z_hi = jnp.asarray((z >> np.uint64(32)).astype(np.uint32))
+        z_lo = jnp.asarray((z & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+        got = np.asarray(zscan.z2_zscan_mask(z_hi, z_lo, jnp.asarray(bounds)))
+        expect = (
+            (cx >= qlo[0]) & (cx <= qhi[0]) & (cy >= qlo[1]) & (cy <= qhi[1])
+        )
+        np.testing.assert_array_equal(got, expect)
+
+
+class TestZ3PallasKernel:
+    """The Pallas tile kernel (interpret mode on CPU = identical kernel
+    code) must agree with the XLA masked-compare path exactly."""
+
+    def _data(self, n=30000, seed=11):
+        import jax.numpy as jnp
+
+        sfc = Z3SFC()
+        rng = np.random.default_rng(seed)
+        lon = rng.uniform(-180, 180, n)
+        lat = rng.uniform(-90, 90, n)
+        t0 = np.datetime64("2020-01-01").astype("datetime64[ms]").astype(np.int64)
+        t = t0 + rng.integers(0, 30 * 86400_000, n)
+        from geomesa_tpu.curves.binnedtime import to_binned_time
+
+        bins_np, off = to_binned_time(t, sfc.period)
+        z = sfc.index(lon, lat, off)
+        return sfc, t0, (
+            jnp.asarray(bins_np.astype(np.int32)),
+            jnp.asarray((z >> np.uint64(32)).astype(np.uint32)),
+            jnp.asarray((z & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
+        )
+
+    def test_count_and_mask_match_xla_path(self):
+        import jax.numpy as jnp
+
+        sfc, t0, (bins, z_hi, z_lo) = self._data()
+        bounds, ids = zscan.z3_query_bounds(
+            sfc, -20.0, 10.0, 60.0, 70.0,
+            int(t0 + 3 * 86400_000), int(t0 + 17 * 86400_000),
+        )
+        bounds, ids = zscan.pad_bins(bounds, ids)
+        count_fn, mask_fn = zscan.build_z3_pallas_scan(bounds, ids)
+        expect = np.asarray(
+            zscan.z3_zscan_mask(
+                z_hi, z_lo, bins, jnp.asarray(bounds), jnp.asarray(ids)
+            )
+        )
+        got_mask = np.asarray(mask_fn(bins, z_hi, z_lo))
+        np.testing.assert_array_equal(got_mask, expect)
+        assert int(count_fn(bins, z_hi, z_lo)) == int(expect.sum())
+
+    def test_all_padded_bins_counts_zero(self):
+        sfc, t0, (bins, z_hi, z_lo) = self._data(n=1000)
+        bounds = np.zeros((2, 3, 6), np.uint32)
+        ids = np.full(2, -1, np.int32)
+        count_fn, _ = zscan.build_z3_pallas_scan(bounds, ids)
+        assert int(count_fn(bins, z_hi, z_lo)) == 0

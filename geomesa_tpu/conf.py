@@ -35,6 +35,9 @@ _DEFS = {
     "scan.ranges.target": (DEFAULT_MAX_RANGES, int),
     "query.timeout": (0, int),  # ms; 0 = unlimited
     "query.block.full.table": (False, _parse_bool),
+    # answer bbox(+during) queries straight from the index key at cell
+    # granularity, skipping residual refinement (ref geomesa.loose.bbox)
+    "query.loose.bbox": (False, _parse_bool),
     "query.max.features": (0, int),  # 0 = unlimited
     "scan.chunk": (8192, int),  # KV scan deserialization chunk rows
 }

@@ -37,6 +37,42 @@ def _stageable_planes(sft: SimpleFeatureType) -> list:
     return planes
 
 
+# reserved names for the index-key planes (leading underscore cannot clash
+# with attribute planes, which are always "<attr>" or "<attr>__suffix")
+Z_BIN, Z_HI, Z_LO = "__zbin", "__zhi", "__zlo"
+
+
+def _z_planes_np(batch, sft: SimpleFeatureType):
+    """(kind, planes) for the batch's index-key columns: Z3 (bin + z hi/lo)
+    when the SFT has a point geometry and a date field, Z2 (z hi/lo) for
+    point-only. kind is None when the SFT has no point geometry."""
+    from geomesa_tpu.curves.binnedtime import to_binned_time
+    from geomesa_tpu.curves.z2 import Z2SFC
+    from geomesa_tpu.curves.z3 import Z3SFC
+
+    geom = sft.geom_field
+    if geom is None or not sft.descriptor(geom).is_point:
+        return None, {}
+    x, y = batch.point_coords(geom)
+    dtg = sft.dtg_field
+    if dtg is not None:
+        sfc = Z3SFC()
+        bins, off = to_binned_time(batch.column(dtg), sfc.period)
+        z = sfc.index(np.asarray(x, np.float64), np.asarray(y, np.float64),
+                      np.asarray(off, np.float64))
+        return "z3", {
+            Z_BIN: bins.astype(np.int32),
+            Z_HI: (z >> np.uint64(32)).astype(np.uint32),
+            Z_LO: (z & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+        }
+    sfc = Z2SFC()
+    z = sfc.index(np.asarray(x, np.float64), np.asarray(y, np.float64))
+    return "z2", {
+        Z_HI: (z >> np.uint64(32)).astype(np.uint32),
+        Z_LO: (z & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+    }
+
+
 class DeviceIndex:
     """Resident scan cache over one store type.
 
@@ -44,17 +80,60 @@ class DeviceIndex:
     >>> di.count("BBOX(geom, -10, 35, 30, 60) AND dtg DURING ...")
     >>> batch = di.query(...)        # mask on device, take on host
     >>> store.write(...); store.flush(...); di.refresh()
+
+    With ``z_planes=True`` the index-key planes (Z3 bin + z hi/lo, or Z2
+    for date-less point schemas) stay resident too, and bbox(+during)
+    queries can be answered straight from the key at cell granularity —
+    the reference's loose-bbox mode (``geomesa.loose.bbox``): a superset
+    of the exact answer, one masked compare per row, 8-12B/row instead
+    of reading the coordinate planes. Opt in per call (``loose=True``)
+    or globally (``query.loose.bbox`` system property).
     """
 
-    def __init__(self, store, type_name: str, columns: "list[str] | None" = None):
+    def __init__(
+        self,
+        store,
+        type_name: str,
+        columns: "list[str] | None" = None,
+        z_planes: bool = False,
+    ):
         self.store = store
         self.type_name = type_name
         self.sft = store.get_schema(type_name)
         self._planes = columns or _stageable_planes(self.sft)
+        self._want_z = z_planes
+        self._z_kind = None
+        self._bin_range = None  # (min, max) period bins present
         self._host_batch = None
         self._cols = None
         self._compiled: dict = {}
+        self._z_jit = None
+        self._loose_cache: dict = {}  # (repr(f), bin_range) -> bounds
         self.refresh()
+
+    def _stage_batch(self, batch) -> dict:
+        """Attribute planes + (optionally) index-key planes for a batch.
+        Widens the observed bin range; callers doing a full restage reset
+        ``_bin_range`` to None first."""
+        import jax.numpy as jnp
+
+        cols = stage_columns(batch, self._planes)
+        if self._want_z:
+            self._z_kind, zp = _z_planes_np(batch, self.sft)
+            if self._z_kind == "z3" and len(batch):
+                lo, hi = int(zp[Z_BIN].min()), int(zp[Z_BIN].max())
+                rng = (
+                    (lo, hi)
+                    if self._bin_range is None
+                    else (min(self._bin_range[0], lo),
+                          max(self._bin_range[1], hi))
+                )
+                if rng != self._bin_range:
+                    self._bin_range = rng
+                    self._loose_cache.clear()  # stale keyed entries
+            for k, v in zp.items():
+                cols[k] = jnp.asarray(v)
+        return cols
 
     # -- cache lifecycle ---------------------------------------------------
 
@@ -64,7 +143,8 @@ class DeviceIndex:
         on its own if the row count changes shape."""
         res = self.store.query(self.type_name, internal_query(ast.Include))
         self._host_batch = res.batch
-        self._cols = stage_columns(self._host_batch, self._planes)
+        self._bin_range = None
+        self._cols = self._stage_batch(self._host_batch)
 
     def __len__(self) -> int:
         return len(self._host_batch)
@@ -89,6 +169,127 @@ class DeviceIndex:
 
         return detach
 
+    # -- loose (key-only) scans --------------------------------------------
+
+    def _bbox_during_parts(self, f):
+        """Split a filter into (envelope, window) when it is EXACTLY a
+        bbox on the default geometry, a during on the default date, or a
+        conjunction of the two — the only shapes the key planes answer."""
+        geom, dtg = self.sft.geom_field, self.sft.dtg_field
+        parts = f.children if isinstance(f, ast.And) else (f,)
+        env = window = None
+        for p in parts:
+            if isinstance(p, ast.BBox) and p.attr == geom and env is None:
+                env = (p.xmin, p.ymin, p.xmax, p.ymax)
+            elif (
+                isinstance(p, ast.During) and p.attr == dtg and window is None
+            ):
+                window = (int(p.t0), int(p.t1))
+            else:
+                return None
+        return env, window
+
+    def _loose_bounds(self, f):
+        """Device (bounds, ids) for the key-only scan, or None when the
+        filter shape / resident planes cannot answer it. ids is None for
+        the unbinned Z2 case. Cached per (filter, observed bin range) so
+        repeated loose queries stay single-dispatch — the loose analog of
+        the exact path's ``_compiled`` cache."""
+        key = (repr(f), self._bin_range)
+        if key in self._loose_cache:
+            return self._loose_cache[key]
+        lb = self._loose_bounds_uncached(f)
+        self._loose_cache[key] = lb
+        return lb
+
+    def _loose_bounds_uncached(self, f):
+        import jax.numpy as jnp
+
+        from geomesa_tpu.curves.z2 import Z2SFC
+        from geomesa_tpu.curves.z3 import Z3SFC
+        from geomesa_tpu.ops import zscan
+
+        if self._z_kind is None:
+            return None
+        parts = self._bbox_during_parts(f)
+        if parts is None:
+            return None
+        env, window = parts
+        if env is None and window is None:
+            return None  # INCLUDE: nothing to prune, use the normal path
+        if self._z_kind == "z2":
+            if window is not None:
+                return None  # no time in the key
+            sfc = Z2SFC()
+            qlo = (int(sfc.lon.normalize(env[0])), int(sfc.lat.normalize(env[1])))
+            qhi = (int(sfc.lon.normalize(env[2])), int(sfc.lat.normalize(env[3])))
+            return jnp.asarray(zscan.z2_dim_bounds(qlo, qhi)), None
+        sfc = Z3SFC()
+        if env is None:
+            env = (-180.0, -90.0, 180.0, 90.0)
+        if window is None:
+            if self._bin_range is None:
+                return None  # empty index; normal path returns empty too
+            from geomesa_tpu.curves.binnedtime import (
+                bin_to_millis,
+                max_offset,
+                offset_to_millis,
+            )
+
+            window = (
+                int(bin_to_millis(self._bin_range[0], sfc.period)),
+                int(bin_to_millis(self._bin_range[1], sfc.period))
+                + int(offset_to_millis(max_offset(sfc.period), sfc.period)),
+            )
+        bounds, ids = zscan.z3_query_bounds(sfc, env[0], env[1], env[2],
+                                            env[3], window[0], window[1])
+        if self._bin_range is not None:
+            keep = (ids >= self._bin_range[0]) & (ids <= self._bin_range[1])
+            bounds, ids = bounds[keep], ids[keep]
+        if len(ids) == 0:
+            bounds = np.zeros((1, 3, 6), np.uint32)
+            ids = np.full(1, -1, np.int32)  # matches nothing
+        if len(ids) > 64:
+            return None  # absurd window: fall back to the normal scan
+        bounds, ids = zscan.pad_bins(bounds, ids)
+        return jnp.asarray(bounds), jnp.asarray(ids)
+
+    def _z_mask_dev(self, bounds, ids):
+        """Device bool mask from the key planes (pre-validity)."""
+        import jax
+
+        from geomesa_tpu.ops import zscan
+
+        if self._z_jit is None:
+            self._z_jit = {
+                "z3": jax.jit(zscan.z3_zscan_mask),
+                "z2": jax.jit(zscan.z2_zscan_mask),
+            }
+        if ids is None:
+            return self._z_jit["z2"](
+                self._cols[Z_HI], self._cols[Z_LO], bounds
+            )
+        return self._z_jit["z3"](
+            self._cols[Z_HI], self._cols[Z_LO], self._cols[Z_BIN],
+            bounds, ids,
+        )
+
+    def _resolve_loose(self, loose: "bool | None") -> bool:
+        if loose is None:
+            from geomesa_tpu.conf import sys_prop
+
+            loose = bool(sys_prop("query.loose.bbox"))
+        return bool(loose) and self._z_kind is not None
+
+    def _loose_mask(self, f) -> "np.ndarray | None":
+        """Host bool mask over staged rows via the key planes, or None."""
+        lb = self._loose_bounds(f)
+        if lb is None:
+            return None
+        m = np.asarray(self._z_mask_dev(*lb))[: self._staged_len()]
+        hv = self._host_valid()
+        return (m & hv) if hv is not None else m
+
     # -- subclass hooks ----------------------------------------------------
 
     def _host_rows(self):
@@ -97,6 +298,10 @@ class DeviceIndex:
 
     def _host_valid(self) -> "np.ndarray | None":
         """Host-side validity over the mirror rows; None = all live."""
+        return None
+
+    def _device_valid(self):
+        """Device validity plane over staged rows; None = all live."""
         return None
 
     def _staged_len(self) -> int:
@@ -111,9 +316,8 @@ class DeviceIndex:
 
     def _compiled_for(self, query):
         from geomesa_tpu.filter.compile import compile_filter
-        from geomesa_tpu.filter.ecql import parse_ecql
 
-        f = parse_ecql(query) if isinstance(query, str) else query
+        f = self._parse(query)
         key = repr(f)
         if key not in self._compiled:
             compiled = compile_filter(f, self.sft)
@@ -130,10 +334,26 @@ class DeviceIndex:
     def _resident_subset(self, compiled) -> dict:
         return {c: self._cols[c] for c in compiled.device_cols}
 
-    def count(self, query) -> int:
+    def _parse(self, query):
+        from geomesa_tpu.filter.ecql import parse_ecql
+
+        return parse_ecql(query) if isinstance(query, str) else query
+
+    def count(self, query, loose: "bool | None" = None) -> int:
         """Fused device count; exact when the filter is fully on-device,
-        else falls through to query()."""
-        compiled, count_fn, _ = self._compiled_for(query)
+        else falls through to query(). With loose=True (or the
+        query.loose.bbox property) bbox(+during) filters are answered at
+        cell granularity from the resident key planes."""
+        f = self._parse(query)
+        if self._resolve_loose(loose):
+            lb = self._loose_bounds(f)
+            if lb is not None:
+                m = self._z_mask_dev(*lb)
+                dv = self._device_valid()
+                if dv is not None:
+                    m = m & dv
+                return int(m.sum())
+        compiled, count_fn, _ = self._compiled_for(f)
         if not compiled.device_cols:
             m = compiled.host_mask(self._host_rows())
             hv = self._host_valid()
@@ -142,10 +362,15 @@ class DeviceIndex:
             return len(self.query(query))
         return int(count_fn(self._resident_subset(compiled)))
 
-    def mask(self, query) -> np.ndarray:
+    def mask(self, query, loose: "bool | None" = None) -> np.ndarray:
         """Boolean hit mask over the staged rows; rows absent from the
         live set (evicted, in subclasses) are always False."""
-        compiled, _, mask_fn = self._compiled_for(query)
+        f = self._parse(query)
+        if self._resolve_loose(loose):
+            lm = self._loose_mask(f)
+            if lm is not None:
+                return lm
+        compiled, _, mask_fn = self._compiled_for(f)
         if not compiled.device_cols:
             m = compiled.host_mask(self._host_rows())
             hv = self._host_valid()
@@ -161,9 +386,11 @@ class DeviceIndex:
                 return out
         return m
 
-    def query(self, query):
+    def query(self, query, loose: "bool | None" = None):
         """FeatureBatch of hits (host-side take over the device mask)."""
-        return self._host_rows().take(np.nonzero(self.mask(query))[0])
+        return self._host_rows().take(
+            np.nonzero(self.mask(query, loose=loose))[0]
+        )
 
 
 def _next_pow2(n: int) -> int:
@@ -201,6 +428,7 @@ class StreamingDeviceIndex(DeviceIndex):
         columns: "list[str] | None" = None,
         capacity: "int | None" = None,
         compact_threshold: float = 0.5,
+        z_planes: bool = False,
     ):
         import threading
 
@@ -215,7 +443,7 @@ class StreamingDeviceIndex(DeviceIndex):
         # threads), and the delta paths are order-sensitive stateful
         # mutations of donated buffers -- serialize every mutation and scan
         self._lock = threading.RLock()
-        super().__init__(store, type_name, columns)
+        super().__init__(store, type_name, columns, z_planes=z_planes)
 
     # -- cache lifecycle ---------------------------------------------------
 
@@ -232,7 +460,8 @@ class StreamingDeviceIndex(DeviceIndex):
         cap = _next_pow2(
             max(n, min_cap, self._capacity_hint or 0, self.MIN_DELTA_ROWS)
         )
-        cols = stage_columns(batch, self._planes)
+        self._bin_range = None
+        cols = self._stage_batch(batch)
         self._cols = {
             k: jnp.concatenate([v, jnp.zeros(cap - n, v.dtype)])
             if cap > n
@@ -287,7 +516,7 @@ class StreamingDeviceIndex(DeviceIndex):
             merged = FeatureBatch.concat([self._live_rows(), batch])
             self._install(merged, min_cap=2 * len(merged))
             return
-        delta = stage_columns(batch, self._planes)
+        delta = self._stage_batch(batch)  # widens _bin_range for z planes
         delta = {
             k: jnp.concatenate([v, jnp.zeros(pad - m, v.dtype)])
             if pad > m
@@ -404,17 +633,17 @@ class StreamingDeviceIndex(DeviceIndex):
 
     # -- query hooks (scan bodies live in DeviceIndex) ---------------------
 
-    def count(self, query) -> int:
+    def count(self, query, loose: "bool | None" = None) -> int:
         with self._lock:
-            return super().count(query)
+            return super().count(query, loose=loose)
 
-    def mask(self, query) -> np.ndarray:
+    def mask(self, query, loose: "bool | None" = None) -> np.ndarray:
         with self._lock:
-            return super().mask(query)
+            return super().mask(query, loose=loose)
 
-    def query(self, query):
+    def query(self, query, loose: "bool | None" = None):
         with self._lock:
-            return super().query(query)
+            return super().query(query, loose=loose)
 
     def __len__(self) -> int:
         return self._n - self._n_dead
@@ -430,6 +659,9 @@ class StreamingDeviceIndex(DeviceIndex):
 
     def _host_valid(self):
         return self._valid_np
+
+    def _device_valid(self):
+        return self._valid
 
     def _staged_len(self) -> int:
         return self._n

@@ -33,36 +33,30 @@ def _hi_lo(v: np.ndarray) -> tuple[int, int]:
     return int(v >> U(32)), int(v & _LO32)
 
 
-def z3_dim_bounds(qlo: tuple, qhi: tuple) -> np.ndarray:
-    """Per-dimension masked-compare bounds for one Z3 cell box.
-
-    qlo/qhi: quantized (x, y, t) cell corners (21-bit ints, inclusive).
-    Returns uint32 array (3, 6): per dim d the columns are
-    (mask_hi, mask_lo, lo_hi, lo_lo, hi_hi, hi_lo), where mask keeps only
-    dim d's interleaved bit positions and lo/hi are the spread bounds.
-    """
-    out = np.empty((3, 6), np.uint32)
-    for d in range(3):
-        mask = zorder.split_3d_np(np.uint64(zorder.MAX_MASK_3D)) << U(d)
-        blo = zorder.split_3d_np(np.uint64(qlo[d])) << U(d)
-        bhi = zorder.split_3d_np(np.uint64(qhi[d])) << U(d)
+def _dim_bounds(qlo: tuple, qhi: tuple, split, max_mask: int, n_dims: int):
+    """Per-dimension masked-compare bounds for one z cell box: per dim d
+    the columns are (mask_hi, mask_lo, lo_hi, lo_lo, hi_hi, hi_lo), where
+    mask keeps only dim d's interleaved bit positions and lo/hi are the
+    spread (inclusive) cell bounds."""
+    out = np.empty((n_dims, 6), np.uint32)
+    for d in range(n_dims):
+        mask = split(np.uint64(max_mask)) << U(d)
+        blo = split(np.uint64(qlo[d])) << U(d)
+        bhi = split(np.uint64(qhi[d])) << U(d)
         out[d, 0:2] = _hi_lo(mask)
         out[d, 2:4] = _hi_lo(blo)
         out[d, 4:6] = _hi_lo(bhi)
     return out
+
+
+def z3_dim_bounds(qlo: tuple, qhi: tuple) -> np.ndarray:
+    """(3, 6) uint32 bounds for one Z3 cell box (21-bit x/y/t corners)."""
+    return _dim_bounds(qlo, qhi, zorder.split_3d_np, zorder.MAX_MASK_3D, 3)
 
 
 def z2_dim_bounds(qlo: tuple, qhi: tuple) -> np.ndarray:
-    """Per-dimension bounds for one Z2 cell box (31-bit x/y cells)."""
-    out = np.empty((2, 6), np.uint32)
-    for d in range(2):
-        mask = zorder.split_2d_np(np.uint64(zorder.MAX_MASK_2D)) << U(d)
-        blo = zorder.split_2d_np(np.uint64(qlo[d])) << U(d)
-        bhi = zorder.split_2d_np(np.uint64(qhi[d])) << U(d)
-        out[d, 0:2] = _hi_lo(mask)
-        out[d, 2:4] = _hi_lo(blo)
-        out[d, 4:6] = _hi_lo(bhi)
-    return out
+    """(2, 6) uint32 bounds for one Z2 cell box (31-bit x/y corners)."""
+    return _dim_bounds(qlo, qhi, zorder.split_2d_np, zorder.MAX_MASK_2D, 2)
 
 
 def _ge64(a_hi, a_lo, b_hi, b_lo):

@@ -331,3 +331,167 @@ class TestStreamingDeviceIndex:
             ds.write("t", dict(b.columns), fids=b.fids)
         all_batch, expect = _oracle(ds, self.ECQL)
         assert di.count(self.ECQL) == int(expect.sum())
+
+
+# -- loose (key-only) scans (ref geomesa.loose.bbox) ------------------------
+
+
+class TestLooseZScan:
+    ECQL = (
+        "BBOX(geom, -10, 35, 30, 60) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-02-01T00:00:00Z"
+    )
+
+    def _cell_oracle(self, batch, ecql_env, window_ms):
+        """Quantized-cell (loose) semantics computed independently."""
+        from geomesa_tpu.curves.binnedtime import (
+            bins_for_interval,
+            to_binned_time,
+        )
+        from geomesa_tpu.curves.z3 import Z3SFC
+
+        sfc = Z3SFC()
+        x, y = batch.point_coords()
+        dtg = batch.column("dtg")
+        bins, off = to_binned_time(dtg, sfc.period)
+        nx = np.asarray(sfc.lon.normalize(x)).astype(np.int64)
+        ny = np.asarray(sfc.lat.normalize(y)).astype(np.int64)
+        nt = np.asarray(sfc.time.normalize(off)).astype(np.int64)
+        x0, y0, x1, y1 = ecql_env
+        sp = (
+            (nx >= int(sfc.lon.normalize(x0)))
+            & (nx <= int(sfc.lon.normalize(x1)))
+            & (ny >= int(sfc.lat.normalize(y0)))
+            & (ny <= int(sfc.lat.normalize(y1)))
+        )
+        tm = np.zeros(len(batch), bool)
+        for b, lo, hi in bins_for_interval(window_ms[0], window_ms[1], sfc.period):
+            tm |= (
+                (bins == b)
+                & (nt >= int(sfc.time.normalize(lo)))
+                & (nt <= int(sfc.time.normalize(hi)))
+            )
+        return sp & tm
+
+    def test_loose_matches_cell_oracle_and_contains_exact(self):
+        ds = _store(n=20000)
+        di = DeviceIndex(ds, "t", z_planes=True)
+        all_batch = ds.query("t").batch
+        got = di.mask(self.ECQL, loose=True)
+        w = (parse_instant("2020-01-10T00:00:00"),
+             parse_instant("2020-02-01T00:00:00"))
+        expect = self._cell_oracle(all_batch, (-10, 35, 30, 60), w)
+        np.testing.assert_array_equal(got, expect)
+        # loose is a superset of exact
+        exact = evaluate_host(parse_ecql(self.ECQL), all_batch)
+        assert not np.any(exact & ~got)
+        assert di.count(self.ECQL, loose=True) == int(expect.sum())
+        fids = di.query(self.ECQL, loose=True).fids
+        np.testing.assert_array_equal(
+            np.sort(fids), np.sort(all_batch.fids[expect])
+        )
+
+    def test_loose_prop_enables_globally(self):
+        from geomesa_tpu.conf import prop_override
+
+        ds = _store(n=3000)
+        di = DeviceIndex(ds, "t", z_planes=True)
+        exact = di.count(self.ECQL)
+        with prop_override("query.loose.bbox", True):
+            loose = di.count(self.ECQL)
+        assert loose >= exact  # cell-granular superset
+
+    def test_non_bbox_filters_fall_back(self):
+        ds = _store(n=3000)
+        di = DeviceIndex(ds, "t", z_planes=True)
+        # val compare is not answerable from the key: loose must fall
+        # back to the exact path and still be correct
+        ecql = "val >= 50 AND BBOX(geom, 0, 0, 90, 90)"
+        all_batch = ds.query("t").batch
+        expect = evaluate_host(parse_ecql(ecql), all_batch)
+        assert di.count(ecql, loose=True) == int(expect.sum())
+
+    def test_bbox_only_uses_observed_bin_range(self):
+        ds = _store(n=5000)
+        di = DeviceIndex(ds, "t", z_planes=True)
+        all_batch = ds.query("t").batch
+        got = di.mask("BBOX(geom, -10, 35, 30, 60)", loose=True)
+        t_lo = int(all_batch.column("dtg").min())
+        t_hi = int(all_batch.column("dtg").max())
+        expect = self._cell_oracle(
+            all_batch, (-10, 35, 30, 60), (t_lo, t_hi)
+        )
+        np.testing.assert_array_equal(got, expect)
+
+    def test_streaming_loose_respects_validity(self):
+        from geomesa_tpu.device_cache import StreamingDeviceIndex
+
+        ds = _store(n=4000)
+        di = StreamingDeviceIndex(ds, "t", z_planes=True)
+        before = di.count(self.ECQL, loose=True)
+        hit_fids = di.query(self.ECQL, loose=True).fids
+        di.evict(hit_fids[:10])
+        assert di.count(self.ECQL, loose=True) == before - 10
+        got = set(di.query(self.ECQL, loose=True).fids.tolist())
+        assert not (got & set(hit_fids[:10].tolist()))
+
+    def test_streaming_append_widens_bins(self):
+        from geomesa_tpu.device_cache import StreamingDeviceIndex
+        from geomesa_tpu.features.batch import FeatureBatch
+
+        ds = _store(n=2000)
+        di = StreamingDeviceIndex(ds, "t", z_planes=True, capacity=8192)
+        sft = ds.get_schema("t")
+        # append rows in a LATER time bin than any original row
+        t_new = parse_instant("2020-06-15T00:00:00")
+        b = FeatureBatch.from_columns(
+            sft,
+            {
+                "name": ["x"] * 50,
+                "val": np.arange(50),
+                "dtg": np.full(50, t_new),
+                "geom": np.tile([[5.0, 50.0]], (50, 1)),
+            },
+            fids=np.arange(90000, 90050),
+        )
+        di.append(b)
+        q = ("BBOX(geom, 0, 45, 10, 55) AND "
+             "dtg DURING 2020-06-14T00:00:00Z/2020-06-16T00:00:00Z")
+        assert di.count(q, loose=True) == 50
+
+    def test_z2_planes_for_dateless_schema(self):
+        ds = MemoryDataStore()
+        ds.create_schema("p", "val:Int,*geom:Point")
+        rng = np.random.default_rng(3)
+        n = 5000
+        ds.write(
+            "p",
+            {
+                "val": rng.integers(0, 10, n),
+                "geom": np.stack(
+                    [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], 1
+                ),
+            },
+            fids=np.arange(n),
+        )
+        di = DeviceIndex(ds, "p", z_planes=True)
+        all_batch = ds.query("p").batch
+        got = di.mask("BBOX(geom, -10, 35, 30, 60)", loose=True)
+        from geomesa_tpu.curves.z2 import Z2SFC
+
+        sfc = Z2SFC()
+        x, y = all_batch.point_coords()
+        nx = np.asarray(sfc.lon.normalize(x)).astype(np.int64)
+        ny = np.asarray(sfc.lat.normalize(y)).astype(np.int64)
+        expect = (
+            (nx >= int(sfc.lon.normalize(-10)))
+            & (nx <= int(sfc.lon.normalize(30)))
+            & (ny >= int(sfc.lat.normalize(35)))
+            & (ny <= int(sfc.lat.normalize(60)))
+        )
+        np.testing.assert_array_equal(got, expect)
+        # at 31-bit cells loose == exact for any practical box
+        exact = evaluate_host(
+            parse_ecql("BBOX(geom, -10, 35, 30, 60)"), all_batch
+        )
+        assert not np.any(exact & ~got)

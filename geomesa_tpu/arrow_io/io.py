@@ -336,7 +336,14 @@ def _open_stream_readers(sources, sft=None):
 
     from geomesa_tpu.security import VIS_COLUMN
 
-    readers = [pa.ipc.open_stream(s) for s in sources]
+    readers = []
+    try:
+        for s in sources:
+            readers.append(pa.ipc.open_stream(s))
+    except BaseException:
+        for r in readers:  # don't leak the ones already opened
+            r.close()
+        raise
     has_vis = any(VIS_COLUMN in r.schema.names for r in readers)
     return [_reader_batches(r, sft) for r in readers], has_vis
 

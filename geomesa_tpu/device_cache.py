@@ -392,6 +392,191 @@ class DeviceIndex:
             np.nonzero(self.mask(query, loose=loose))[0]
         )
 
+    # -- pushdown stats (StatsIterator analog) -----------------------------
+
+    def stats(self, query, spec: str, loose: "bool | None" = None):
+        """Stat-DSL aggregation fused with the filter scan in ONE device
+        dispatch (ref StatsIterator: stats computed server-side during
+        the scan, never shipping features). Count, MinMax over resident
+        numeric/date planes, and fixed-bin Histogram over resident
+        float/int planes reduce on device; any other stat (strings, HLL,
+        TopK, Z3Histogram) observes the masked host rows instead. Filters
+        that are not fully device-expressible fall back to host
+        observation entirely.
+
+        Precision: MinMax over a float64 attribute reflects the device
+        STORAGE format — float32 on TPU (README design stance), float64
+        on the CPU test platform. Date (int64) MinMax is always exact via
+        lexicographic hi/lo reduction."""
+        from geomesa_tpu.stats import parse_stat
+        from geomesa_tpu.stats.sketches import CountStat, Histogram, MinMax
+
+        seq = parse_stat(spec)
+        f = self._parse(query)
+        kind = None
+        lb = None
+        if self._resolve_loose(loose):
+            lb = self._loose_bounds(f)
+            if lb is not None:
+                kind = "loose"
+        compiled = None
+        if kind is None:
+            compiled = self._compiled_for(f)[0]
+            if compiled.device_cols and compiled.fully_on_device:
+                kind = "exact"
+            else:
+                seq.observe_batch(self.query(f, loose=loose))
+                return seq
+
+        device_parts, host_parts = [], []
+        for s in seq.stats:
+            if isinstance(s, CountStat):
+                device_parts.append(("count", s))
+            elif isinstance(s, MinMax) and (
+                s.attr in self._cols or f"{s.attr}__hi" in self._cols
+            ):
+                device_parts.append(("minmax", s))
+            elif (
+                isinstance(s, Histogram)
+                and s.attr in self._cols
+                and self._cols[s.attr].dtype.kind in "fiu"
+            ):
+                device_parts.append(("hist", s))
+            else:
+                host_parts.append(s)
+
+        outs = self._stats_fused(
+            f, kind, lb, compiled, device_parts, need_mask=bool(host_parts)
+        )
+        n_hits = int(outs["__count"])
+        for tag, s in device_parts:
+            if tag == "count":
+                s.count += n_hits
+            elif tag == "minmax" and n_hits:
+                s.count += n_hits
+                if f"{s.attr}__hi" in self._cols:
+                    mn = (int(outs[f"{s.attr}__mnhi"]) << 32) | int(
+                        outs[f"{s.attr}__mnlo"]
+                    )
+                    mx = (int(outs[f"{s.attr}__mxhi"]) << 32) | int(
+                        outs[f"{s.attr}__mxlo"]
+                    )
+                else:
+                    mn = outs[f"{s.attr}__mn"].item()
+                    mx = outs[f"{s.attr}__mx"].item()
+                s.min = mn if s.min is None else min(s.min, mn)
+                s.max = mx if s.max is None else max(s.max, mx)
+            elif tag == "hist":
+                s.counts += np.asarray(outs[f"{s.attr}__hist"]).astype(
+                    np.int64
+                )
+        if host_parts:
+            # the fused dispatch already evaluated the filter: reuse its
+            # mask instead of paying a second full scan
+            hm = np.asarray(outs["__mask"])[: self._staged_len()]
+            rows = self._host_rows().take(np.nonzero(hm)[0])
+            from geomesa_tpu.stats.dsl import _observe_on_batch
+
+            for s in host_parts:
+                _observe_on_batch(s, rows)
+        return seq
+
+    def _stats_fused(self, f, kind, lb, compiled, device_parts, need_mask):
+        """Run (or reuse) the single fused jit for this (filter, parts)
+        pair: mask + every device reduction in one dispatch."""
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_stats_cache"):
+            self._stats_cache = {}
+        part_key = tuple(
+            (tag, s.attr if hasattr(s, "attr") else "",
+             getattr(s, "bins", 0), getattr(s, "lo", 0.0),
+             getattr(s, "hi", 0.0))
+            for tag, s in device_parts
+        )
+        key = (repr(f), kind, part_key, need_mask)
+        cached = self._stats_cache.get(key)
+        if cached is None:
+            parts_spec = part_key
+
+            def fused(cols, mask_args, valid):
+                if kind == "loose":
+                    from geomesa_tpu.ops import zscan
+
+                    bounds, ids = mask_args
+                    if ids is None:
+                        m = zscan.z2_zscan_mask(cols[Z_HI], cols[Z_LO], bounds)
+                    else:
+                        m = zscan.z3_zscan_mask(
+                            cols[Z_HI], cols[Z_LO], cols[Z_BIN], bounds, ids
+                        )
+                else:
+                    m = compiled.device_fn(cols)
+                if valid is not None:
+                    m = m & valid
+                out = {"__count": jnp.sum(m, dtype=jnp.int32)}
+                if need_mask:
+                    out["__mask"] = m
+                for tag, attr, bins, lo, hi in parts_spec:
+                    if tag == "minmax" and f"{attr}__hi" in cols:
+                        vhi, vlo = cols[f"{attr}__hi"], cols[f"{attr}__lo"]
+                        i32mx, i32mn = jnp.int32(2**31 - 1), jnp.int32(-(2**31))
+                        mnhi = jnp.min(jnp.where(m, vhi, i32mx))
+                        mxhi = jnp.max(jnp.where(m, vhi, i32mn))
+                        u32mx = jnp.uint32(0xFFFFFFFF)
+                        mnlo = jnp.min(
+                            jnp.where(m & (vhi == mnhi), vlo, u32mx)
+                        )
+                        mxlo = jnp.max(
+                            jnp.where(m & (vhi == mxhi), vlo, jnp.uint32(0))
+                        )
+                        out[f"{attr}__mnhi"] = mnhi
+                        out[f"{attr}__mnlo"] = mnlo
+                        out[f"{attr}__mxhi"] = mxhi
+                        out[f"{attr}__mxlo"] = mxlo
+                    elif tag == "minmax":
+                        v = cols[attr]
+                        big = (
+                            jnp.inf
+                            if v.dtype.kind == "f"
+                            else jnp.iinfo(v.dtype).max
+                        )
+                        small = (
+                            -jnp.inf
+                            if v.dtype.kind == "f"
+                            else jnp.iinfo(v.dtype).min
+                        )
+                        out[f"{attr}__mn"] = jnp.min(jnp.where(m, v, big))
+                        out[f"{attr}__mx"] = jnp.max(jnp.where(m, v, small))
+                    elif tag == "hist":
+                        # bin in the widest float available so the edges
+                        # match the host Histogram.bin_of (float64 under
+                        # x64/CPU; float32 is the TPU storage precision)
+                        wide = (
+                            jnp.float64
+                            if jax.config.jax_enable_x64
+                            else jnp.float32
+                        )
+                        v = cols[attr].astype(wide)
+                        scale = bins / (hi - lo) if hi > lo else 0.0
+                        idx = jnp.clip(
+                            jnp.floor((v - lo) * scale).astype(jnp.int32),
+                            0,
+                            bins - 1,
+                        )
+                        out[f"{attr}__hist"] = (
+                            jnp.zeros(bins, jnp.int32)
+                            .at[idx]
+                            .add(m.astype(jnp.int32))
+                        )
+                return out
+
+            cached = jax.jit(fused, static_argnames=())
+            self._stats_cache[key] = cached
+        mask_args = lb if kind == "loose" else None
+        return cached(self._cols, mask_args, self._device_valid())
+
 
 def _next_pow2(n: int) -> int:
     return 1 << max(int(n) - 1, 0).bit_length()
@@ -644,6 +829,10 @@ class StreamingDeviceIndex(DeviceIndex):
     def query(self, query, loose: "bool | None" = None):
         with self._lock:
             return super().query(query, loose=loose)
+
+    def stats(self, query, spec: str, loose: "bool | None" = None):
+        with self._lock:
+            return super().stats(query, spec, loose=loose)
 
     def __len__(self) -> int:
         return self._n - self._n_dead

@@ -133,6 +133,8 @@ def z3_query_bounds(
             z3_dim_bounds((qx[0], qy[0], qt[0]), (qx[1], qy[1], qt[1]))
         )
         ids.append(b)
+    if not bounds:  # empty/inverted window: zero bins, matches nothing
+        return np.zeros((0, 3, 6), np.uint32), np.array([], np.int32)
     return np.stack(bounds), np.array(ids, np.int32)
 
 

@@ -495,3 +495,91 @@ class TestLooseZScan:
             parse_ecql("BBOX(geom, -10, 35, 30, 60)"), all_batch
         )
         assert not np.any(exact & ~got)
+
+
+# -- pushdown stats (StatsIterator analog) ----------------------------------
+
+
+class TestDeviceStats:
+    ECQL = (
+        "BBOX(geom, -10, 35, 30, 60) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-02-01T00:00:00Z"
+    )
+    SPEC = 'Count();MinMax("val");MinMax("dtg");Histogram("val",10,0,100)'
+
+    def _host_oracle(self, ds, ecql, spec):
+        from geomesa_tpu.process import run_stats
+
+        return run_stats(ds, "t", ecql, spec)
+
+    def test_fused_stats_match_host_oracle(self):
+        ds = _store(n=20000)
+        di = DeviceIndex(ds, "t")
+        got = di.stats(self.ECQL, self.SPEC)
+        exp = self._host_oracle(ds, self.ECQL, self.SPEC)
+        g, e = got.to_json(), exp.to_json()
+        assert g[0] == e[0]  # count
+        assert g[1]["min"] == e[1]["min"] and g[1]["max"] == e[1]["max"]
+        assert g[2]["min"] == e[2]["min"] and g[2]["max"] == e[2]["max"]  # dtg i64
+        assert g[3]["counts"] == e[3]["counts"]
+
+    def test_host_fallback_parts_still_exact(self):
+        ds = _store(n=5000)
+        di = DeviceIndex(ds, "t")
+        spec = 'Count();TopK("name")'  # TopK is a host stat
+        got = di.stats(self.ECQL, spec)
+        exp = self._host_oracle(ds, self.ECQL, spec)
+        assert got.to_json() == exp.to_json()
+
+    def test_residual_filter_falls_back_entirely(self):
+        ds = _store(n=5000)
+        di = DeviceIndex(ds, "t")
+        ecql = "name = 'a' AND BBOX(geom, -90, -45, 90, 45)"
+        got = di.stats(ecql, 'Count();MinMax("val")')
+        exp = self._host_oracle(ds, ecql, 'Count();MinMax("val")')
+        assert got.to_json() == exp.to_json()
+
+    def test_loose_stats_use_key_planes(self):
+        ds = _store(n=8000)
+        di = DeviceIndex(ds, "t", z_planes=True)
+        got = di.stats(self.ECQL, "Count()", loose=True)
+        assert got.stats[0].count == di.count(self.ECQL, loose=True)
+
+    def test_streaming_stats_respect_validity(self):
+        from geomesa_tpu.device_cache import StreamingDeviceIndex
+
+        ds = _store(n=6000)
+        di = StreamingDeviceIndex(ds, "t")
+        before = di.stats(self.ECQL, 'Count();MinMax("val")')
+        n0 = before.stats[0].count
+        hits = di.query(self.ECQL)
+        di.evict(hits.fids[:15])
+        after = di.stats(self.ECQL, "Count()")
+        assert after.stats[0].count == n0 - 15
+
+    def test_empty_result_leaves_minmax_unset(self):
+        ds = _store(n=1000)
+        di = DeviceIndex(ds, "t")
+        got = di.stats("BBOX(geom, 170, 80, 171, 81) AND "
+                       "dtg DURING 2020-01-10T00:00:00Z/2020-01-11T00:00:00Z",
+                       'Count();MinMax("val")')
+        if got.stats[0].count == 0:
+            assert got.stats[1].min is None
+
+    def test_repeated_calls_reuse_compiled_fused_fn(self):
+        ds = _store(n=2000)
+        di = DeviceIndex(ds, "t")
+        di.stats(self.ECQL, self.SPEC)
+        assert len(di._stats_cache) == 1
+        di.stats(self.ECQL, self.SPEC)
+        assert len(di._stats_cache) == 1
+
+    def test_inverted_time_window_loose_returns_empty(self):
+        """Regression: an inverted DURING window must yield an empty loose
+        result, not crash in np.stack over zero bins."""
+        ds = _store(n=500)
+        di = DeviceIndex(ds, "t", z_planes=True)
+        q = ("BBOX(geom, -10, 35, 30, 60) AND "
+             "dtg DURING 2020-02-01T00:00:00Z/2020-01-01T00:00:00Z")
+        assert di.count(q, loose=True) == 0
+        assert len(di.query(q, loose=True)) == 0

@@ -28,6 +28,11 @@ def _stageable_planes(sft: SimpleFeatureType) -> list:
         if a.is_geometry:
             if a.is_point:
                 planes += [f"{a.name}__x", f"{a.name}__y"]
+            else:
+                # non-point geometries: envelope planes (device bbox +
+                # envelope prefilters for exact residual predicates)
+                planes += [f"{a.name}__x0", f"{a.name}__y0",
+                           f"{a.name}__x1", f"{a.name}__y1"]
             continue
         dtype = a.column_dtype
         if dtype == np.int64:
@@ -323,12 +328,21 @@ class DeviceIndex:
             compiled = compile_filter(f, self.sft)
             missing = [c for c in compiled.device_cols if c not in self._cols]
             if missing:
-                raise ValueError(
-                    f"columns {missing} not resident; construct the index "
-                    f"with columns= including them"
+                # a custom columns= list omits planes this filter wants on
+                # device: degrade to exact host evaluation rather than
+                # refusing a query the full-mirror path can answer
+                import warnings
+
+                warnings.warn(
+                    f"columns {missing} not resident; evaluating "
+                    f"{key!r} on host (pass columns= including them "
+                    f"for the device path)",
+                    stacklevel=3,
                 )
-            count_fn, mask_fn = self._make_scan_fns(compiled)
-            self._compiled[key] = (compiled, count_fn, mask_fn)
+                self._compiled[key] = (compiled, None, None)
+            else:
+                count_fn, mask_fn = self._make_scan_fns(compiled)
+                self._compiled[key] = (compiled, count_fn, mask_fn)
         return self._compiled[key]
 
     def _resident_subset(self, compiled) -> dict:
@@ -354,7 +368,7 @@ class DeviceIndex:
                     m = m & dv
                 return int(m.sum())
         compiled, count_fn, _ = self._compiled_for(f)
-        if not compiled.device_cols:
+        if not compiled.device_cols or count_fn is None:
             m = compiled.host_mask(self._host_rows())
             hv = self._host_valid()
             return int((m & hv).sum() if hv is not None else m.sum())
@@ -371,7 +385,7 @@ class DeviceIndex:
             if lm is not None:
                 return lm
         compiled, _, mask_fn = self._compiled_for(f)
-        if not compiled.device_cols:
+        if not compiled.device_cols or mask_fn is None:
             m = compiled.host_mask(self._host_rows())
             hv = self._host_valid()
             return (m & hv) if hv is not None else m
@@ -421,8 +435,8 @@ class DeviceIndex:
                 kind = "loose"
         compiled = None
         if kind is None:
-            compiled = self._compiled_for(f)[0]
-            if compiled.device_cols and compiled.fully_on_device:
+            compiled, cfn, _ = self._compiled_for(f)
+            if compiled.device_cols and compiled.fully_on_device and cfn:
                 kind = "exact"
             else:
                 seq.observe_batch(self.query(f, loose=loose))
@@ -445,31 +459,31 @@ class DeviceIndex:
             else:
                 host_parts.append(s)
 
+        if self._staged_len() == 0:
+            return seq  # nothing staged: zero-size reductions have no identity
         outs = self._stats_fused(
             f, kind, lb, compiled, device_parts, need_mask=bool(host_parts)
         )
         n_hits = int(outs["__count"])
-        for tag, s in device_parts:
+        for i, (tag, s) in enumerate(device_parts):
             if tag == "count":
                 s.count += n_hits
             elif tag == "minmax" and n_hits:
                 s.count += n_hits
                 if f"{s.attr}__hi" in self._cols:
-                    mn = (int(outs[f"{s.attr}__mnhi"]) << 32) | int(
-                        outs[f"{s.attr}__mnlo"]
+                    mn = (int(outs[f"{i}__mnhi"]) << 32) | int(
+                        outs[f"{i}__mnlo"]
                     )
-                    mx = (int(outs[f"{s.attr}__mxhi"]) << 32) | int(
-                        outs[f"{s.attr}__mxlo"]
+                    mx = (int(outs[f"{i}__mxhi"]) << 32) | int(
+                        outs[f"{i}__mxlo"]
                     )
                 else:
-                    mn = outs[f"{s.attr}__mn"].item()
-                    mx = outs[f"{s.attr}__mx"].item()
+                    mn = outs[f"{i}__mn"].item()
+                    mx = outs[f"{i}__mx"].item()
                 s.min = mn if s.min is None else min(s.min, mn)
                 s.max = mx if s.max is None else max(s.max, mx)
             elif tag == "hist":
-                s.counts += np.asarray(outs[f"{s.attr}__hist"]).astype(
-                    np.int64
-                )
+                s.counts += np.asarray(outs[f"{i}__hist"]).astype(np.int64)
         if host_parts:
             # the fused dispatch already evaluated the filter: reuse its
             # mask instead of paying a second full scan
@@ -518,7 +532,10 @@ class DeviceIndex:
                 out = {"__count": jnp.sum(m, dtype=jnp.int32)}
                 if need_mask:
                     out["__mask"] = m
-                for tag, attr, bins, lo, hi in parts_spec:
+                # outputs keyed by PART INDEX: two stats over the same
+                # attribute (e.g. histograms with different bin params)
+                # must not collide on one output slot
+                for i, (tag, attr, bins, lo, hi) in enumerate(parts_spec):
                     if tag == "minmax" and f"{attr}__hi" in cols:
                         vhi, vlo = cols[f"{attr}__hi"], cols[f"{attr}__lo"]
                         i32mx, i32mn = jnp.int32(2**31 - 1), jnp.int32(-(2**31))
@@ -531,10 +548,10 @@ class DeviceIndex:
                         mxlo = jnp.max(
                             jnp.where(m & (vhi == mxhi), vlo, jnp.uint32(0))
                         )
-                        out[f"{attr}__mnhi"] = mnhi
-                        out[f"{attr}__mnlo"] = mnlo
-                        out[f"{attr}__mxhi"] = mxhi
-                        out[f"{attr}__mxlo"] = mxlo
+                        out[f"{i}__mnhi"] = mnhi
+                        out[f"{i}__mnlo"] = mnlo
+                        out[f"{i}__mxhi"] = mxhi
+                        out[f"{i}__mxlo"] = mxlo
                     elif tag == "minmax":
                         v = cols[attr]
                         big = (
@@ -547,8 +564,8 @@ class DeviceIndex:
                             if v.dtype.kind == "f"
                             else jnp.iinfo(v.dtype).min
                         )
-                        out[f"{attr}__mn"] = jnp.min(jnp.where(m, v, big))
-                        out[f"{attr}__mx"] = jnp.max(jnp.where(m, v, small))
+                        out[f"{i}__mn"] = jnp.min(jnp.where(m, v, big))
+                        out[f"{i}__mx"] = jnp.max(jnp.where(m, v, small))
                     elif tag == "hist":
                         # bin in the widest float available so the edges
                         # match the host Histogram.bin_of (float64 under
@@ -565,7 +582,7 @@ class DeviceIndex:
                             0,
                             bins - 1,
                         )
-                        out[f"{attr}__hist"] = (
+                        out[f"{i}__hist"] = (
                             jnp.zeros(bins, jnp.int32)
                             .at[idx]
                             .add(m.astype(jnp.int32))

@@ -250,7 +250,10 @@ def _device_supported(f: ast.Filter, sft: SimpleFeatureType) -> bool:
     if isinstance(f, ast.Not):
         return _device_supported(f.child, sft)
     if isinstance(f, ast.BBox):
-        return sft.descriptor(f.attr).is_point
+        # point: coordinate compare; non-point: envelope-overlap compare on
+        # the staged bbox planes — which IS the exact BBOX semantics
+        # (_host_bbox evaluates envelope intersection for non-points)
+        return sft.descriptor(f.attr).is_geometry
     if isinstance(f, ast.Intersects):
         return (
             sft.descriptor(f.attr).is_point
@@ -258,7 +261,10 @@ def _device_supported(f: ast.Filter, sft: SimpleFeatureType) -> bool:
             and f.op in ("intersects", "within", "disjoint")
         )
     if isinstance(f, ast.DWithin):
-        return sft.descriptor(f.attr).is_point and isinstance(f.geometry, Point)
+        # (point, Point): exact distance compare. Every other shape's
+        # exact host semantics (_host_spatial) IS the padded-envelope
+        # bbox — the same compare runs on device instead.
+        return sft.descriptor(f.attr).is_geometry
     if isinstance(f, (ast.During, ast.Between)):
         dtype = sft.descriptor(f.attr).column_dtype
         return dtype is not None and dtype != np.bool_
@@ -288,6 +294,10 @@ def device_columns_for(f: ast.Filter, sft: SimpleFeatureType) -> list[str]:
         desc = sft.descriptor(attr)
         if desc.is_point:
             cols += [f"{attr}__x", f"{attr}__y"]
+        elif desc.is_geometry:
+            # non-point geometries: per-row envelope planes
+            cols += [f"{attr}__x0", f"{attr}__y0",
+                     f"{attr}__x1", f"{attr}__y1"]
         elif desc.column_dtype == np.int64:
             cols += [f"{attr}__hi", f"{attr}__lo"]
         elif desc.column_dtype is not None:
@@ -326,6 +336,17 @@ def build_device_fn(f: ast.Filter, sft: SimpleFeatureType) -> Callable:
             fn = rec(node.child)
             return lambda cols, n, fn=fn: ~fn(cols, n)
         if isinstance(node, ast.BBox):
+            if not sft.descriptor(node.attr).is_point:
+                pre = f"{node.attr}__"
+                def f_bbenv(cols, n, node=node, pre=pre):
+                    # envelope overlap == exact BBOX for non-points
+                    return (
+                        (cols[pre + "x1"] >= node.xmin)
+                        & (cols[pre + "x0"] <= node.xmax)
+                        & (cols[pre + "y1"] >= node.ymin)
+                        & (cols[pre + "y0"] <= node.ymax)
+                    )
+                return f_bbenv
             ax, ay = f"{node.attr}__x", f"{node.attr}__y"
             def f_bbox(cols, n, node=node, ax=ax, ay=ay):
                 x, y = cols[ax], cols[ay]
@@ -345,6 +366,18 @@ def build_device_fn(f: ast.Filter, sft: SimpleFeatureType) -> Callable:
                 return ~m if neg else m
             return f_int
         if isinstance(node, ast.DWithin):
+            if not (
+                sft.descriptor(node.attr).is_point
+                and isinstance(node.geometry, Point)
+            ):
+                # padded-envelope bbox == the exact host semantics for
+                # these shapes (_host_spatial)
+                e = node.geometry.envelope
+                return rec(ast.BBox(
+                    node.attr,
+                    e.xmin - node.distance, e.ymin - node.distance,
+                    e.xmax + node.distance, e.ymax + node.distance,
+                ))
             ax, ay = f"{node.attr}__x", f"{node.attr}__y"
             def f_dw(cols, n, node=node, ax=ax, ay=ay):
                 dx = cols[ax] - node.geometry.x
@@ -519,10 +552,33 @@ class CompiledFilter:
         return evaluate_host(self.residual_part, batch)
 
 
+def _envelope_prefilter(c: ast.Filter, sft: SimpleFeatureType):
+    """Device BBox prefilter implied by a residual spatial conjunct, or
+    None. Safe only for ops where a hit's envelope must intersect the
+    query geometry's envelope (everything except disjoint/relate — the
+    complement/arbitrary-matrix cases)."""
+    if isinstance(c, ast.Intersects) and c.op in (
+        "intersects", "within", "contains", "crosses", "touches",
+        "overlaps", "equals",
+    ):
+        if not sft.descriptor(c.attr).is_geometry:
+            return None
+        e = c.geometry.envelope
+        return ast.BBox(c.attr, e.xmin, e.ymin, e.xmax, e.ymax)
+    return None
+
+
 def compile_filter(f: ast.Filter, sft: SimpleFeatureType) -> CompiledFilter:
     conjuncts = list(f.children) if isinstance(f, ast.And) else [f]
     dev = [c for c in conjuncts if _device_supported(c, sft)]
     res = [c for c in conjuncts if not _device_supported(c, sft)]
+    # residual spatial conjuncts still contribute a device envelope
+    # prefilter (the classic bbox-then-exact split): the conjunct stays in
+    # the residual for exactness, but the device mask prunes candidates
+    for c in res:
+        pre = _envelope_prefilter(c, sft)
+        if pre is not None and _device_supported(pre, sft):
+            dev.append(pre)
     device_part: ast.Filter = (
         ast.Include if not dev else (dev[0] if len(dev) == 1 else ast.And(tuple(dev)))
     )

@@ -50,7 +50,8 @@ def supported_columns(f: ast.Filter, sft: SimpleFeatureType) -> list[str]:
 
     cols = device_columns_for(f, sft)
     for c in cols:
-        if c.endswith(("__x", "__y", "__hi", "__lo")):
+        if c.endswith(("__x", "__y", "__hi", "__lo",
+                       "__x0", "__y0", "__x1", "__y1")):
             continue
         dtype = sft.descriptor(c).column_dtype
         _check(
@@ -90,7 +91,19 @@ def _build_tile_fn(f: ast.Filter, sft: SimpleFeatureType):
             fn = rec(node.child)
             return lambda cols, fn=fn: ~fn(cols)
         if isinstance(node, ast.BBox):
-            _check(sft.descriptor(node.attr).is_point, "bbox on non-point")
+            if not sft.descriptor(node.attr).is_point:
+                pre = f"{node.attr}__"
+
+                def f_bbenv(cols, node=node, pre=pre):
+                    # envelope-overlap tile == exact BBOX for non-points
+                    return (
+                        (cols[pre + "x1"] >= node.xmin)
+                        & (cols[pre + "x0"] <= node.xmax)
+                        & (cols[pre + "y1"] >= node.ymin)
+                        & (cols[pre + "y0"] <= node.ymax)
+                    )
+
+                return f_bbenv
             ax, ay = f"{node.attr}__x", f"{node.attr}__y"
 
             def f_bbox(cols, node=node, ax=ax, ay=ay):
@@ -106,11 +119,18 @@ def _build_tile_fn(f: ast.Filter, sft: SimpleFeatureType):
         if isinstance(node, ast.DWithin):
             from geomesa_tpu.geom import Point
 
-            _check(
+            if not (
                 sft.descriptor(node.attr).is_point
-                and isinstance(node.geometry, Point),
-                "dwithin needs point column + point query geometry",
-            )
+                and isinstance(node.geometry, Point)
+            ):
+                # padded-envelope bbox tile (exact for these shapes —
+                # mirrors build_device_fn)
+                e = node.geometry.envelope
+                return rec(ast.BBox(
+                    node.attr,
+                    e.xmin - node.distance, e.ymin - node.distance,
+                    e.xmax + node.distance, e.ymax + node.distance,
+                ))
             ax, ay = f"{node.attr}__x", f"{node.attr}__y"
 
             def f_dw(cols, node=node, ax=ax, ay=ay):

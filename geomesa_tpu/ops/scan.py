@@ -38,7 +38,13 @@ def stage_columns(
     out = {}
     splits: dict = {}  # attr -> (hi, lo), computed once per i64 column
     for name in names:
-        if name.endswith("__x") or name.endswith("__y"):
+        if name.endswith(("__x0", "__y0", "__x1", "__y1")):
+            # per-row envelope planes of a non-point geometry column
+            attr = name[:-4]
+            bb = batch.bboxes(attr)
+            k = {"x0": 0, "y0": 1, "x1": 2, "y1": 3}[name[-2:]]
+            arr = bb[start:stop, k]
+        elif name.endswith("__x") or name.endswith("__y"):
             attr = name[:-3]
             col = batch.column(attr)
             arr = col[start:stop, 0 if name.endswith("__x") else 1]

@@ -583,3 +583,35 @@ class TestDeviceStats:
              "dtg DURING 2020-02-01T00:00:00Z/2020-01-01T00:00:00Z")
         assert di.count(q, loose=True) == 0
         assert len(di.query(q, loose=True)) == 0
+
+    def test_two_histograms_same_attr_do_not_collide(self):
+        ds = _store(n=3000)
+        di = DeviceIndex(ds, "t")
+        spec = 'Histogram("val",10,0,100);Histogram("val",5,0,50)'
+        got = di.stats(self.ECQL, spec)
+        exp = self._host_oracle(ds, self.ECQL, spec)
+        assert got.to_json() == exp.to_json()
+
+    def test_stats_on_empty_index(self):
+        ds = MemoryDataStore()
+        ds.create_schema("t", SPEC)
+        di = DeviceIndex(ds, "t")
+        got = di.stats("INCLUDE", 'Count();MinMax("val")')
+        assert got.stats[0].count == 0
+        assert got.stats[1].min is None
+
+    def test_missing_resident_columns_fall_back_to_host(self):
+        import warnings
+
+        ds = _store(n=2000)
+        di = DeviceIndex(ds, "t", columns=["val"])  # no geom planes
+        all_batch = ds.query("t").batch
+        ecql = "BBOX(geom, -10, 35, 30, 60) AND val >= 50"
+        expect = evaluate_host(parse_ecql(ecql), all_batch)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert di.count(ecql) == int(expect.sum())
+            np.testing.assert_array_equal(
+                np.sort(di.query(ecql).fids),
+                np.sort(all_batch.fids[expect]),
+            )

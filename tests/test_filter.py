@@ -212,3 +212,107 @@ class TestEvaluate:
         b = make_batch(10)
         assert evaluate_host(Include, b).all()
         assert not evaluate_host(Exclude, b).any()
+
+
+class TestNonPointDeviceBBox:
+    """Non-point geometries: device BBOX = envelope-overlap on the staged
+    bbox planes (exact: BBOX semantics for non-points IS envelope
+    intersection), and residual spatial predicates get a device envelope
+    prefilter."""
+
+    def _poly_batch(self, n=400, seed=12):
+        from geomesa_tpu.features.batch import FeatureBatch
+        from geomesa_tpu.features.sft import SimpleFeatureType
+        from geomesa_tpu.geom import Polygon
+
+        sft = SimpleFeatureType.create("polys", "val:Int,*geom:Polygon")
+        rng = np.random.default_rng(seed)
+        polys = []
+        for i in range(n):
+            cx, cy = rng.uniform(-170, 170), rng.uniform(-80, 80)
+            r = rng.uniform(0.1, 3.0)
+            ang = np.linspace(0, 2 * np.pi, 8)
+            ring = np.stack(
+                [cx + r * np.cos(ang), cy + r * np.sin(ang)], axis=1
+            )
+            ring[-1] = ring[0]
+            polys.append(Polygon(ring))
+        return sft, FeatureBatch.from_columns(
+            sft,
+            {"val": rng.integers(0, 100, n),
+             "geom": np.array(polys, dtype=object)},
+            fids=np.arange(n),
+        )
+
+    def test_device_bbox_matches_host(self):
+        from geomesa_tpu.filter.compile import compile_filter, evaluate_host
+        from geomesa_tpu.filter.ecql import parse_ecql
+        from geomesa_tpu.ops.scan import stage_columns
+
+        sft, batch = self._poly_batch()
+        f = parse_ecql("BBOX(geom, -20, -20, 40, 30)")
+        c = compile_filter(f, sft)
+        assert c.fully_on_device, "non-point bbox should be device-only now"
+        cols = stage_columns(batch, c.device_cols)
+        got = np.asarray(c.device_fn(cols))
+        expect = evaluate_host(f, batch)
+        np.testing.assert_array_equal(got, expect)
+
+    def test_intersects_gets_envelope_prefilter(self):
+        from geomesa_tpu.filter.compile import compile_filter, evaluate_host
+        from geomesa_tpu.filter.ecql import parse_ecql
+        from geomesa_tpu.ops.scan import stage_columns
+
+        sft, batch = self._poly_batch()
+        f = parse_ecql(
+            "INTERSECTS(geom, POLYGON((0 0, 25 0, 25 20, 0 20, 0 0)))"
+        )
+        c = compile_filter(f, sft)
+        assert not c.fully_on_device  # exact test stays residual
+        assert c.device_cols, "prefilter should stage envelope planes"
+        cols = stage_columns(batch, c.device_cols)
+        pre = np.asarray(c.device_fn(cols))
+        exact = evaluate_host(f, batch)
+        assert not np.any(exact & ~pre), "prefilter dropped a true hit"
+        assert pre.sum() < len(batch), "prefilter pruned nothing"
+
+    def test_device_index_over_polygons(self):
+        from geomesa_tpu.device_cache import DeviceIndex
+        from geomesa_tpu.filter.compile import evaluate_host
+        from geomesa_tpu.filter.ecql import parse_ecql
+        from geomesa_tpu.store.memory import MemoryDataStore
+
+        sft, batch = self._poly_batch(n=300)
+        ds = MemoryDataStore()
+        ds.create_schema("polys", "val:Int,*geom:Polygon")
+        ds.write("polys", dict(batch.columns), fids=batch.fids)
+        di = DeviceIndex(ds, "polys")
+        all_batch = ds.query("polys").batch
+        for ecql in [
+            "BBOX(geom, -20, -20, 40, 30)",
+            "BBOX(geom, -20, -20, 40, 30) AND val >= 50",
+            "INTERSECTS(geom, POLYGON((0 0, 25 0, 25 20, 0 20, 0 0)))",
+            "DWITHIN(geom, POINT(10 10), 5, kilometers)",
+        ]:
+            expect = evaluate_host(parse_ecql(ecql), all_batch)
+            assert di.count(ecql) == int(expect.sum()), ecql
+            np.testing.assert_array_equal(
+                np.sort(di.query(ecql).fids), np.sort(all_batch.fids[expect]),
+                err_msg=ecql,
+            )
+
+    def test_pallas_tile_kernel_handles_envelope_planes(self):
+        from geomesa_tpu.filter.compile import compile_filter, evaluate_host
+        from geomesa_tpu.filter.ecql import parse_ecql
+        from geomesa_tpu.ops.pallas_scan import build_pallas_scan
+        from geomesa_tpu.ops.scan import stage_columns
+
+        sft, batch = self._poly_batch()
+        f = parse_ecql("BBOX(geom, -20, -20, 40, 30)")
+        count_fn, mask_fn, cols_needed = build_pallas_scan(
+            f, sft, interpret=True
+        )
+        cols = stage_columns(batch, cols_needed)
+        expect = evaluate_host(f, batch)
+        np.testing.assert_array_equal(np.asarray(mask_fn(cols)), expect)
+        assert int(count_fn(cols)) == int(expect.sum())

@@ -92,18 +92,13 @@ def _build_tile_fn(f: ast.Filter, sft: SimpleFeatureType):
             return lambda cols, fn=fn: ~fn(cols)
         if isinstance(node, ast.BBox):
             if not sft.descriptor(node.attr).is_point:
-                pre = f"{node.attr}__"
+                # envelope-overlap tile: delegate so the compare stays
+                # bit-identical to the XLA path (single source, same as
+                # the During/Compare delegation below)
+                from geomesa_tpu.filter.compile import build_device_fn
 
-                def f_bbenv(cols, node=node, pre=pre):
-                    # envelope-overlap tile == exact BBOX for non-points
-                    return (
-                        (cols[pre + "x1"] >= node.xmin)
-                        & (cols[pre + "x0"] <= node.xmax)
-                        & (cols[pre + "y1"] >= node.ymin)
-                        & (cols[pre + "y0"] <= node.ymax)
-                    )
-
-                return f_bbenv
+                inner = build_device_fn(node, sft)
+                return lambda cols, inner=inner: inner(cols)
             ax, ay = f"{node.attr}__x", f"{node.attr}__y"
 
             def f_bbox(cols, node=node, ax=ax, ay=ay):
@@ -123,14 +118,12 @@ def _build_tile_fn(f: ast.Filter, sft: SimpleFeatureType):
                 sft.descriptor(node.attr).is_point
                 and isinstance(node.geometry, Point)
             ):
-                # padded-envelope bbox tile (exact for these shapes —
-                # mirrors build_device_fn)
-                e = node.geometry.envelope
-                return rec(ast.BBox(
-                    node.attr,
-                    e.xmin - node.distance, e.ymin - node.distance,
-                    e.xmax + node.distance, e.ymax + node.distance,
-                ))
+                # padded-envelope bbox: delegate to the single XLA-path
+                # implementation (build_device_fn rewrites to BBox)
+                from geomesa_tpu.filter.compile import build_device_fn
+
+                inner = build_device_fn(node, sft)
+                return lambda cols, inner=inner: inner(cols)
             ax, ay = f"{node.attr}__x", f"{node.attr}__y"
 
             def f_dw(cols, node=node, ax=ax, ay=ay):

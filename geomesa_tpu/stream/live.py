@@ -54,17 +54,92 @@ class LiveFeatureStore:
         self._clear_seq = -1  # highest Clear barrier seen (seq'd streams)
         self._listeners: list = []
         self._offset = 0
+        # -- ordered listener delivery --------------------------------------
+        # Tickets are issued UNDER the state lock (so delivery order ==
+        # state-mutation order) but callbacks run OUTSIDE it (so a listener
+        # may re-enter the store without lock-ordering deadlocks). Without
+        # this, an expiry Remove captured before a concurrent Put of the
+        # same fid could be delivered after it, desyncing delta caches.
+        self._notify_seq = 0  # next ticket to issue (under self._lock)
+        self._notify_next = 0  # next ticket to deliver
+        self._notify_cv = threading.Condition()
+        self._delivering = threading.local()
         if self.log is not None:
             self.replay()
             self.log.subscribe(self._on_message)
+
+    # -- ordered delivery ---------------------------------------------------
+
+    def _take_ticket(self, msg):
+        """Must hold self._lock. Returns a delivery payload for _deliver."""
+        t = self._notify_seq
+        self._notify_seq += 1
+        return (t, msg, list(self._listeners))
+
+    def _deliver(self, payloads) -> None:
+        """Deliver (ticket, msg, listeners) payloads (one, or a list) in
+        strict ticket order, outside any store lock. Re-entrant deliveries
+        triggered from inside a callback queue on the outer call
+        (same-thread order is sequential; waiting on the condition here
+        would self-deadlock).
+
+        Every issued ticket MUST advance or all later deliveries wedge on
+        the condition variable — so a raising listener cannot abort the
+        drain: callback exceptions are collected, every queued ticket is
+        still delivered and advanced, and the first exception re-raises
+        only after the queue is empty."""
+        if not payloads:
+            return
+        items = payloads if isinstance(payloads, list) else [payloads]
+        tl = self._delivering
+        if getattr(tl, "active", False):
+            tl.pending.extend(items)
+            return
+        tl.active = True
+        tl.pending = list(items)
+        first_exc = None
+        try:
+            while tl.pending:
+                t, msg, listeners = tl.pending.pop(0)
+                with self._notify_cv:
+                    while t != self._notify_next:
+                        self._notify_cv.wait()
+                try:
+                    for cb in listeners:
+                        try:
+                            cb(msg)
+                        except BaseException as e:  # noqa: BLE001
+                            if first_exc is None:
+                                first_exc = e
+                finally:
+                    with self._notify_cv:
+                        self._notify_next += 1
+                        self._notify_cv.notify_all()
+        finally:
+            tl.active = False
+        if first_exc is not None:
+            raise first_exc
 
     # -- log application ---------------------------------------------------
 
     def replay(self) -> None:
         """Rebuild state from the log (crash recovery; ref cache rebuild
         from topic replay)."""
+        payloads: list = []
+        try:
+            with self._lock:
+                self._replay_locked(payloads)
+        finally:
+            # payloads is filled IN PLACE so tickets issued before a
+            # partial replay failure still reach delivery (an undelivered
+            # ticket would wedge the store)
+            self._deliver(payloads)
+
+    def _replay_locked(self, payloads: list) -> None:
         for msg in self.log.read_from(self._offset):
-            self._apply(msg)
+            p = self._apply_locked(msg)
+            if p is not None:
+                payloads.append(p)
             self._offset += 1
 
     def _on_message(self, offset: int, msg) -> None:
@@ -72,14 +147,20 @@ class LiveFeatureStore:
         # producers' callbacks can arrive out of order; a gap means an
         # earlier message is still in flight -- catch up from the log in
         # offset order instead of applying (or worse, dropping) this one
-        with self._lock:
-            if offset < self._offset:
-                return
-            if offset == self._offset:
-                self._apply(msg)
-                self._offset = offset + 1
-            else:
-                self.replay()
+        payloads: list = []
+        try:
+            with self._lock:
+                if offset < self._offset:
+                    return
+                if offset == self._offset:
+                    p = self._apply_locked(msg)
+                    if p is not None:
+                        payloads.append(p)
+                    self._offset = offset + 1
+                else:
+                    self._replay_locked(payloads)
+        finally:
+            self._deliver(payloads)
 
     def apply(self, msg) -> None:
         """Externally-driven application (e.g. a CacheLoader's partition
@@ -87,26 +168,34 @@ class LiveFeatureStore:
         self._apply(msg)
 
     def _apply(self, msg) -> None:
-        with self._lock:
-            seq = getattr(msg, "seq", None)
-            if isinstance(msg, Put):
-                if seq is not None and seq < self._clear_seq:
-                    return  # sequenced before an already-applied Clear
-                batch = FeatureBatch.from_columns(self.sft, msg.columns, msg.fids)
-                self._upsert(batch, seq if seq is not None else -1)
-            elif isinstance(msg, Remove):
-                self._remove(np.asarray(msg.fids))
-            elif isinstance(msg, Clear):
-                if seq is None:
-                    self._drop_rows(np.ones(len(self._batch), dtype=bool))
-                else:
-                    # barrier: wipe only rows written before this Clear --
-                    # a partition's late Clear must not erase newer puts
-                    self._clear_seq = max(self._clear_seq, seq)
-                    self._drop_rows(self._seqs < seq)
-            listeners = list(self._listeners)
-        for cb in listeners:
-            cb(msg)
+        payload = None
+        try:
+            with self._lock:
+                payload = self._apply_locked(msg)
+        finally:
+            self._deliver(payload)
+
+    def _apply_locked(self, msg):
+        """Mutate under the held lock; returns the delivery payload (or
+        None for messages that changed nothing, e.g. stale sequenced
+        Puts)."""
+        seq = getattr(msg, "seq", None)
+        if isinstance(msg, Put):
+            if seq is not None and seq < self._clear_seq:
+                return None  # sequenced before an already-applied Clear
+            batch = FeatureBatch.from_columns(self.sft, msg.columns, msg.fids)
+            self._upsert(batch, seq if seq is not None else -1)
+        elif isinstance(msg, Remove):
+            self._remove(np.asarray(msg.fids))
+        elif isinstance(msg, Clear):
+            if seq is None:
+                self._drop_rows(np.ones(len(self._batch), dtype=bool))
+            else:
+                # barrier: wipe only rows written before this Clear --
+                # a partition's late Clear must not erase newer puts
+                self._clear_seq = max(self._clear_seq, seq)
+                self._drop_rows(self._seqs < seq)
+        return self._take_ticket(msg)
 
     def _drop_rows(self, dead: np.ndarray) -> None:
         if not np.any(dead):
@@ -166,11 +255,21 @@ class LiveFeatureStore:
         if len(batch) != len(self._seqs):
             self._seqs = np.full(len(batch), -1, dtype=np.int64)
 
-    def _expire(self) -> None:
+    def _expire(self):
+        """Drop aged-out rows; returns a ticketed delivery payload (or
+        None) for the CALLER to _deliver after releasing the lock —
+        expiry is a state change like any Remove, and attached caches
+        (DeviceIndex deltas) would silently diverge if it bypassed the
+        listeners."""
         if self.expiry_ms is None or len(self._batch) == 0:
-            return
+            return None
         cutoff = self.clock() - self.expiry_ms
-        self._drop_rows(self._written_ms < cutoff)
+        dead = self._written_ms < cutoff
+        if not np.any(dead):
+            return None
+        fids = np.asarray(self._batch.fids)[dead].copy()
+        self._drop_rows(dead)
+        return self._take_ticket(Remove(fids))
 
     # -- write-side convenience (producer role) ----------------------------
 
@@ -195,25 +294,44 @@ class LiveFeatureStore:
     # -- queries & CQ ------------------------------------------------------
 
     def query(self, filt: "ast.Filter | str" = ast.Include) -> FeatureBatch:
-        with self._lock:
-            self._expire()
-            f = parse_ecql(filt) if isinstance(filt, str) else filt
-            if len(self._batch) == 0:
-                return self._batch
-            mask = evaluate_host(f, self._batch)
-            return self._batch.take(np.nonzero(mask)[0])
+        expired = None
+        try:
+            with self._lock:
+                expired = self._expire()
+                f = parse_ecql(filt) if isinstance(filt, str) else filt
+                if len(self._batch) == 0:
+                    out = self._batch
+                else:
+                    mask = evaluate_host(f, self._batch)
+                    out = self._batch.take(np.nonzero(mask)[0])
+        finally:
+            # the rows are already dropped: the notification must go out
+            # even when filter parsing/evaluation raises
+            self._deliver(expired)
+        return out
 
     def snapshot(self) -> FeatureBatch:
-        with self._lock:
-            self._expire()
-            # copy: _upsert mutates columns in place, so handing out the
-            # live arrays would let later writes tear a reader's rows
-            return self._batch.take(np.arange(len(self._batch)))
+        expired = None
+        try:
+            with self._lock:
+                expired = self._expire()
+                # copy: _upsert mutates columns in place, so handing out
+                # the live arrays would let later writes tear a reader's
+                # rows
+                out = self._batch.take(np.arange(len(self._batch)))
+        finally:
+            self._deliver(expired)
+        return out
 
     def __len__(self) -> int:
-        with self._lock:
-            self._expire()
-            return len(self._batch)
+        expired = None
+        try:
+            with self._lock:
+                expired = self._expire()
+                n = len(self._batch)
+        finally:
+            self._deliver(expired)
+        return n
 
     def add_listener(self, callback: Callable) -> None:
         """Continuous query: callback(message) after each applied change

@@ -615,3 +615,39 @@ class TestDeviceStats:
                 np.sort(di.query(ecql).fids),
                 np.sort(all_batch.fids[expect]),
             )
+
+
+def test_streaming_index_tracks_live_expiry():
+    """Expiry is a state change like any Remove: an attached delta cache
+    must see it, not silently diverge (live.py _expire notifies
+    listeners with the expired fids)."""
+    from geomesa_tpu.device_cache import StreamingDeviceIndex
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.query.runner import QueryResult
+    from geomesa_tpu.stream import LiveFeatureStore
+
+    now = [1_000_000]
+    sft = SimpleFeatureType.create("t", SPEC)
+    live = LiveFeatureStore(sft, expiry_ms=500, clock=lambda: now[0])
+
+    class Adapter:
+        def get_schema(self, _):
+            return sft
+
+        def query(self, _, q=None):
+            b = live.snapshot()
+            return QueryResult(b, None, len(b), len(b))
+
+    di = StreamingDeviceIndex(Adapter(), "t", capacity=4096)
+    di.attach_live(live)
+    live.put({"name": ["a"] * 5, "val": np.arange(5), "dtg": np.zeros(5),
+              "geom": np.zeros((5, 2))}, [f"f{i}" for i in range(5)])
+    assert len(di) == 5
+    now[0] += 300
+    live.put({"name": ["b"] * 2, "val": np.arange(2), "dtg": np.zeros(2),
+              "geom": np.zeros((2, 2))}, ["g0", "g1"])
+    assert len(di) == 7
+    now[0] += 300  # first 5 rows are now older than 500ms
+    assert len(live) == 2  # triggers expiry + listener notification
+    assert len(di) == 2, "device cache missed the expiry"
+    assert di.count("INCLUDE") == 2

@@ -113,3 +113,94 @@ class TestLambda:
         assert lam.persistent.count("live") == 1
         got = lam.persistent.query("live", "INCLUDE").batch
         assert got.column("v")[0] == 2
+
+
+def test_ordered_delivery_under_concurrent_producers_and_expiry():
+    """Listener deliveries are ticketed in state-mutation order, so an
+    attached delta consumer converges to exactly the live state even
+    with concurrent producers and reader-triggered expiry."""
+    import threading
+
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.stream.live import LiveFeatureStore
+    from geomesa_tpu.stream.log import Put, Remove
+
+    sft = SimpleFeatureType.create("ev", "val:Int,*geom:Point")
+    now = [1_000_000]
+    live = LiveFeatureStore(
+        sft, standalone=True, expiry_ms=10_000, clock=lambda: now[0]
+    )
+
+    # a deliberately stateful order-sensitive consumer (mini delta cache)
+    state: dict = {}
+
+    def listener(msg):
+        if isinstance(msg, Put):
+            for i, f in enumerate(np.asarray(msg.fids).tolist()):
+                state[f] = int(np.asarray(msg.columns["val"])[i])
+        elif isinstance(msg, Remove):
+            for f in np.asarray(msg.fids).tolist():
+                state.pop(f, None)
+
+    live.add_listener(listener)
+
+    def producer(tid):
+        rng = np.random.default_rng(tid)
+        for k in range(60):
+            fid = int(rng.integers(0, 40))
+            if rng.random() < 0.25:
+                live.apply(Remove(np.array([fid])))
+            else:
+                live.apply(Put(
+                    {"val": np.array([tid * 1000 + k]),
+                     "geom": np.zeros((1, 2))},
+                    np.array([fid]),
+                ))
+            if k % 7 == 0:
+                now[0] += 500
+                len(live)  # reader: triggers expiry notifications
+
+    threads = [threading.Thread(target=producer, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    now[0] += 20_000
+    assert len(live) == 0  # everything expired at the end
+    assert state == {}, f"consumer diverged: {state}"
+
+
+def test_raising_listener_does_not_wedge_delivery():
+    """A listener exception must not strand tickets: later messages still
+    deliver to the other listeners, and the store keeps working."""
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.stream.live import LiveFeatureStore
+    from geomesa_tpu.stream.log import Put
+
+    sft = SimpleFeatureType.create("ev", "val:Int,*geom:Point")
+    live = LiveFeatureStore(sft, standalone=True)
+    seen = []
+
+    def bad(msg):
+        raise RuntimeError("listener bug")
+
+    live.add_listener(bad)
+    live.add_listener(lambda m: seen.append(m))
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="listener bug"):
+        live.apply(Put({"val": np.array([1]), "geom": np.zeros((1, 2))},
+                       np.array([0])))
+    # the second listener still got the message despite the first raising
+    assert len(seen) == 1
+    # and the store is not wedged: further messages flow (the bad
+    # listener raises again, after full delivery)
+    with _pytest.raises(RuntimeError, match="listener bug"):
+        live.apply(Put({"val": np.array([2]), "geom": np.zeros((1, 2))},
+                       np.array([1])))
+    assert len(seen) == 2  # good listener saw it despite the raise
+    live.remove_listener(bad)
+    live.apply(Put({"val": np.array([3]), "geom": np.zeros((1, 2))},
+                   np.array([2])))
+    assert len(seen) == 3  # delivery kept advancing throughout
+    assert len(live) == 3

@@ -68,6 +68,9 @@ def parallel_ingest(
         for p in files:
             one(p)
     else:
+        from geomesa_tpu.pyarrow_compat import preload_pyarrow
+
+        preload_pyarrow()
         with ThreadPoolExecutor(max_workers=workers) as pool:
             list(pool.map(one, files))
     if hasattr(store, "flush"):
@@ -105,6 +108,9 @@ def parallel_export(
     jobs = list(enumerate(batches))
     if workers <= 1 or len(jobs) <= 1:
         return [write_one(j) for j in jobs]
+    from geomesa_tpu.pyarrow_compat import preload_pyarrow
+
+    preload_pyarrow()
     with ThreadPoolExecutor(max_workers=workers) as pool:
         return list(pool.map(write_one, jobs))
 

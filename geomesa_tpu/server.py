@@ -16,6 +16,18 @@ Endpoints (all GET):
 - ``/explain/<type>?cql=``          -- query plan text
 - ``/density/<type>?cql=&bbox=&width=&height=`` -- heatmap grid (WPS
   DensityProcess analog), JSON {"counts": [[...]], "bbox": [...]}
+- ``/stats/<type>?cql=&stats=<Stat-DSL spec>&loose=`` -- server-side
+  aggregation (StatsProcess / StatsIterator analog), JSON stat list
+
+Resident mode (``make_server(store, resident=True)``, CLI ``serve
+--resident``) pins each type's scan columns AND index-key planes in
+device memory (DeviceIndex, the tablet-server block-cache analog):
+``/count``, ``/features`` and ``/stats`` answer from HBM in one fused
+dispatch, and ``loose=1`` switches bbox(+during) filters to the key-only
+cell-granular scan (geomesa.loose.bbox). The resident copy is a
+SNAPSHOT: after writing to the backing store, hit ``/refresh/<type>``
+(or restart) to restage — the durable store stays the source of truth,
+exactly the DeviceIndex contract.
 
 Errors return JSON ``{"error": ...}`` with 4xx/5xx status.
 """
@@ -31,6 +43,31 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 class _Handler(BaseHTTPRequestHandler):
     store = None  # injected by make_server
+    resident = False  # serve from device-pinned DeviceIndex caches
+    _resident_cache: dict = {}  # per-server-class: type -> DeviceIndex
+    _resident_lock = None  # per-server-class construction lock
+
+    def _di(self, type_name: str):
+        """Resident DeviceIndex for a type (resident mode only). Built
+        under a lock: handler threads race on the first request, and a
+        duplicate build would stage the whole dataset into device memory
+        twice."""
+        if not self.resident:
+            return None
+        cache = self._resident_cache
+        with self._resident_lock:
+            if type_name not in cache:
+                from geomesa_tpu.device_cache import DeviceIndex
+
+                cache[type_name] = DeviceIndex(
+                    self.store, type_name, z_planes=True
+                )
+            return cache[type_name]
+
+    @staticmethod
+    def _loose(q: dict) -> "bool | None":
+        v = q.get("loose")
+        return None if v is None else v.lower() in ("1", "true", "yes")
 
     # quiet default request logging; hook point for real deployments
     def log_message(self, fmt, *args):  # noqa: D102
@@ -54,7 +91,8 @@ class _Handler(BaseHTTPRequestHandler):
             if parts == ["capabilities"]:
                 return self._capabilities()
             if len(parts) == 2 and parts[0] in (
-                "features", "count", "explain", "density"
+                "features", "count", "explain", "density", "stats",
+                "refresh",
             ):
                 handler = getattr(self, f"_{parts[0]}")
                 return handler(unquote(parts[1]), q)
@@ -100,7 +138,16 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def _features(self, type_name: str, q: dict) -> None:
-        res = self._query(type_name, q)
+        di = self._di(type_name)
+        if di is not None and not q.get("properties"):
+            import numpy as np
+
+            batch = di.query(q.get("cql", "INCLUDE"), loose=self._loose(q))
+            mf = q.get("maxFeatures")
+            if mf and len(batch) > int(mf):
+                batch = batch.take(np.arange(int(mf)))
+        else:
+            batch = self._query(type_name, q).batch
         fmt = q.get("f", "geojson")
         if fmt == "arrow":
             from geomesa_tpu.arrow_io import write_delta_stream
@@ -109,7 +156,7 @@ class _Handler(BaseHTTPRequestHandler):
             # dictionary-delta batches: clients consume incrementally and
             # dictionaries never retransmit (ref DeltaWriter protocol)
             write_delta_stream(
-                sink, [res.batch], sft=res.batch.sft, chunk_size=1 << 14
+                sink, [batch], sft=batch.sft, chunk_size=1 << 14
             )
             self._send(
                 200, sink.getvalue(), "application/vnd.apache.arrow.stream"
@@ -117,13 +164,45 @@ class _Handler(BaseHTTPRequestHandler):
         elif fmt == "geojson":
             from geomesa_tpu.export import feature_collection
 
-            self._json(200, feature_collection(res.batch))
+            self._json(200, feature_collection(batch))
         else:
             self._json(400, {"error": f"unknown format {fmt!r}"})
 
     def _count(self, type_name: str, q: dict) -> None:
+        di = self._di(type_name)
+        if di is not None:
+            n = di.count(q.get("cql", "INCLUDE"), loose=self._loose(q))
+            return self._json(200, {"count": n})
         res = self._query(type_name, q)
         self._json(200, {"count": len(res)})
+
+    def _refresh(self, type_name: str, q: dict) -> None:
+        """Restage a type's resident planes from the backing store (call
+        after writes — the resident copy is a snapshot by design)."""
+        if not self.resident:
+            return self._json(
+                400, {"error": "server is not running in resident mode"}
+            )
+        di = self._di(type_name)
+        di.refresh()
+        self._json(200, {"refreshed": type_name, "rows": len(di)})
+
+    def _stats(self, type_name: str, q: dict) -> None:
+        spec = q.get("stats")
+        if not spec:
+            raise ValueError("stats endpoint needs stats=<Stat-DSL spec>")
+        di = self._di(type_name)
+        if di is not None:
+            seq = di.stats(
+                q.get("cql", "INCLUDE"), spec, loose=self._loose(q)
+            )
+        else:
+            from geomesa_tpu.process import run_stats
+
+            seq = run_stats(
+                self.store, type_name, q.get("cql", "INCLUDE"), spec
+            )
+        self._json(200, seq.to_json())
 
     def _explain(self, type_name: str, q: dict) -> None:
         text = self.store.explain(type_name, q.get("cql", "INCLUDE"))
@@ -160,17 +239,35 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
 
-def make_server(store, host: str = "127.0.0.1", port: int = 0):
+def make_server(
+    store, host: str = "127.0.0.1", port: int = 0, resident: bool = False
+):
     """Build a ThreadingHTTPServer bound to (host, port); port 0 picks an
-    ephemeral port (see ``server.server_address``)."""
-    handler = type("BoundHandler", (_Handler,), {"store": store})
+    ephemeral port (see ``server.server_address``). ``resident=True``
+    serves count/features/stats from device-pinned DeviceIndex caches
+    (built lazily per type on first access)."""
+    from geomesa_tpu.pyarrow_compat import preload_pyarrow
+
+    preload_pyarrow()  # handler threads serve Arrow; see pyarrow_compat
+    handler = type(
+        "BoundHandler",
+        (_Handler,),
+        {
+            "store": store,
+            "resident": resident,
+            "_resident_cache": {},
+            "_resident_lock": threading.Lock(),
+        },
+    )
     return ThreadingHTTPServer((host, port), handler)
 
 
-def serve_background(store, host: str = "127.0.0.1", port: int = 0):
+def serve_background(
+    store, host: str = "127.0.0.1", port: int = 0, resident: bool = False
+):
     """Start serving on a daemon thread; returns (server, thread). Stop
     with ``server.shutdown()``."""
-    server = make_server(store, host, port)
+    server = make_server(store, host, port, resident=resident)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread

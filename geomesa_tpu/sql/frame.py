@@ -144,6 +144,9 @@ class SpatialFrame:
             return [fn(p) for p in parts]
         from concurrent.futures import ThreadPoolExecutor
 
+        from geomesa_tpu.pyarrow_compat import preload_pyarrow
+
+        preload_pyarrow()
         with ThreadPoolExecutor(max_workers=parallelism) as pool:
             return list(pool.map(fn, parts))
 

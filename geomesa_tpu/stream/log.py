@@ -217,6 +217,9 @@ class CacheLoader:
                 self._stop.wait(self.poll_ms / 1000.0)
 
     def start(self) -> None:
+        from geomesa_tpu.pyarrow_compat import preload_pyarrow
+
+        preload_pyarrow()  # consumers deserialize batches off-thread
         for i in range(len(self.plog.partitions)):
             t = threading.Thread(target=self._run, args=(i,), daemon=True)
             t.start()

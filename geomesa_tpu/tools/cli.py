@@ -462,9 +462,10 @@ def cmd_serve(args):
     from geomesa_tpu.server import make_server
 
     store = _store(args)
-    server = make_server(store, args.host, args.port)
+    server = make_server(store, args.host, args.port, resident=args.resident)
     host, port = server.server_address[:2]
-    print(f"serving {store.root} on http://{host}:{port}")
+    mode = " (resident device caches)" if args.resident else ""
+    print(f"serving {store.root} on http://{host}:{port}{mode}")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -605,6 +606,12 @@ def main(argv=None) -> None:
     sp = add("serve", cmd_serve)
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=8080)
+    sp.add_argument(
+        "--resident",
+        action="store_true",
+        help="pin scan columns + index-key planes in device memory and "
+        "serve count/features/stats from fused device scans",
+    )
 
     args = p.parse_args(argv)
     try:

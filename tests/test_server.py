@@ -136,3 +136,130 @@ def _get_allow_error(url):
             return resp.status, resp.headers.get("Content-Type"), resp.read()
     except urllib.error.HTTPError as e:
         return e.code, e.headers.get("Content-Type"), e.read()
+
+
+# -- stats endpoint + resident mode -----------------------------------------
+
+
+def test_stats_endpoint(server_url):
+    url, ds = server_url
+    cql = "BBOX(geom, -5, -5, 5, 5)"
+    spec = 'Count();MinMax("dtg")'
+    status, _, body = _get(
+        f"{url}/stats/gdelt?cql={urllib.request.quote(cql)}"
+        f"&stats={urllib.request.quote(spec)}"
+    )
+    assert status == 200
+    doc = json.loads(body)
+    from geomesa_tpu.process import run_stats
+
+    exp = run_stats(ds, "gdelt", cql, spec).to_json()
+    assert doc == exp
+
+
+def test_stats_endpoint_requires_spec(server_url):
+    url, _ = server_url
+    import urllib.error
+
+    try:
+        _get(f"{url}/stats/gdelt")
+        raise AssertionError("should have 400'd")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+@pytest.fixture(scope="module")
+def resident_url():
+    ds = MemoryDataStore()
+    ds.create_schema("gdelt", SPEC)
+    n = 3000
+    rng = np.random.default_rng(5)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write(
+        "gdelt",
+        {
+            "name": rng.choice(["a", "b"], n),
+            "dtg": t0 + rng.integers(0, 10**8, n),
+            "geom": np.stack(
+                [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    server, _ = serve_background(ds, resident=True)
+    host, port = server.server_address[:2]
+    yield f"http://{host}:{port}", ds
+    server.shutdown()
+
+
+def test_resident_count_and_features_match_store(resident_url):
+    url, ds = resident_url
+    cql = "BBOX(geom, -5, -5, 5, 5)"
+    expect = len(ds.query("gdelt", cql))
+    status, _, body = _get(
+        f"{url}/count/gdelt?cql={urllib.request.quote(cql)}"
+    )
+    assert status == 200 and json.loads(body)["count"] == expect
+    status, _, body = _get(
+        f"{url}/features/gdelt?cql={urllib.request.quote(cql)}"
+    )
+    doc = json.loads(body)
+    assert len(doc["features"]) == expect
+
+
+def test_resident_loose_is_superset(resident_url):
+    url, ds = resident_url
+    cql = "BBOX(geom, -5, -5, 5, 5)"
+    exact = len(ds.query("gdelt", cql))
+    status, _, body = _get(
+        f"{url}/count/gdelt?cql={urllib.request.quote(cql)}&loose=1"
+    )
+    assert status == 200
+    assert json.loads(body)["count"] >= exact
+
+
+def test_resident_stats_pushdown(resident_url):
+    url, ds = resident_url
+    spec = 'Count();MinMax("dtg")'
+    status, _, body = _get(
+        f"{url}/stats/gdelt?stats={urllib.request.quote(spec)}"
+        f"&cql={urllib.request.quote('BBOX(geom, -5, -5, 5, 5)')}"
+    )
+    assert status == 200
+    doc = json.loads(body)
+    from geomesa_tpu.process import run_stats
+
+    exp = run_stats(
+        ds, "gdelt", "BBOX(geom, -5, -5, 5, 5)", spec
+    ).to_json()
+    assert doc == exp
+
+
+def test_resident_refresh_after_write(resident_url):
+    url, ds = resident_url
+    status, _, body = _get(f"{url}/count/gdelt?cql=INCLUDE")
+    before = json.loads(body)["count"]
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write(
+        "gdelt",
+        {"name": ["z"], "dtg": [t0], "geom": np.array([[0.0, 0.0]])},
+        fids=["fresh-row"],
+    )
+    # snapshot semantics: stale until refresh
+    status, _, body = _get(f"{url}/count/gdelt?cql=INCLUDE")
+    assert json.loads(body)["count"] == before
+    status, _, body = _get(f"{url}/refresh/gdelt")
+    assert status == 200 and json.loads(body)["rows"] == before + 1
+    status, _, body = _get(f"{url}/count/gdelt?cql=INCLUDE")
+    assert json.loads(body)["count"] == before + 1
+
+
+def test_refresh_rejected_without_resident_mode(server_url):
+    url, _ = server_url
+    import urllib.error
+
+    try:
+        _get(f"{url}/refresh/gdelt")
+        raise AssertionError("should have 400'd")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400

@@ -352,6 +352,33 @@ def distributed_z3_sort(
     return sh, sl, pay, valid
 
 
+def sharded_zscan_count(
+    mesh, bins, z_hi, z_lo, bounds, bin_ids, axis: str = "shard"
+):
+    """Mesh-wide key-only scan (the Z3Iterator analog at pod scale): each
+    shard masked-compares its resident key planes, psum merges. 8 bytes
+    of key per row per chip, no attribute reads — the distributed form
+    of ops/zscan."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.ops import zscan
+
+    bounds = jnp.asarray(bounds)
+    bin_ids = jnp.asarray(bin_ids)
+
+    def mask_fn(local):
+        return zscan.z3_zscan_mask(
+            local["__zhi"], local["__zlo"], local["__zbin"], bounds, bin_ids
+        )
+
+    return sharded_count_scan(
+        mesh,
+        mask_fn,
+        {"__zbin": bins, "__zhi": z_hi, "__zlo": z_lo},
+        axis=axis,
+    )
+
+
 def sharded_build_and_query_step(mesh, sfc, x, y, t, query_bounds, axis: str = "shard"):
     """One full distributed 'index build + query' step, end to end on the
     mesh: z3 hi/lo key encode (data-parallel) -> all_to_all splitter

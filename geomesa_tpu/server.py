@@ -48,18 +48,22 @@ class _Handler(BaseHTTPRequestHandler):
     _resident_lock = None  # per-server-class construction lock
 
     def _di(self, type_name: str):
-        """Resident DeviceIndex for a type (resident mode only). Built
-        under a lock: handler threads race on the first request, and a
-        duplicate build would stage the whole dataset into device memory
-        twice."""
+        """Resident index for a type (resident mode only). Streaming
+        flavor: its internal lock serializes refresh against concurrent
+        handler-thread scans. The dict read is the GIL-safe fast path;
+        the construction lock only guards first-touch builds (a duplicate
+        build would stage the whole dataset into device memory twice)."""
         if not self.resident:
             return None
         cache = self._resident_cache
+        di = cache.get(type_name)
+        if di is not None:
+            return di
         with self._resident_lock:
             if type_name not in cache:
-                from geomesa_tpu.device_cache import DeviceIndex
+                from geomesa_tpu.device_cache import StreamingDeviceIndex
 
-                cache[type_name] = DeviceIndex(
+                cache[type_name] = StreamingDeviceIndex(
                     self.store, type_name, z_planes=True
                 )
             return cache[type_name]
@@ -68,6 +72,24 @@ class _Handler(BaseHTTPRequestHandler):
     def _loose(q: dict) -> "bool | None":
         v = q.get("loose")
         return None if v is None else v.lower() in ("1", "true", "yes")
+
+    def _observe_resident(self, type_name: str, cql: str, t0, t1, hits):
+        """Metrics + audit parity with the store query pipeline (resident
+        scans bypass store.query, which would otherwise record these)."""
+        try:
+            from geomesa_tpu.audit import AuditedEvent
+            from geomesa_tpu.metrics import queries_run, query_seconds
+
+            queries_run.inc(store="resident", type=type_name)
+            query_seconds.observe(t1 - t0)
+            aw = getattr(self.store, "audit_writer", None)
+            if aw is not None:
+                aw.write(AuditedEvent(
+                    store="resident", type_name=type_name, filter=cql,
+                    planning_ms=0.0, scanning_ms=(t1 - t0) * 1e3, hits=hits,
+                ))
+        except Exception:  # pragma: no cover - observability must not break
+            pass
 
     # quiet default request logging; hook point for real deployments
     def log_message(self, fmt, *args):  # noqa: D102
@@ -140,12 +162,26 @@ class _Handler(BaseHTTPRequestHandler):
     def _features(self, type_name: str, q: dict) -> None:
         di = self._di(type_name)
         if di is not None and not q.get("properties"):
+            import time as _time
+
             import numpy as np
 
-            batch = di.query(q.get("cql", "INCLUDE"), loose=self._loose(q))
+            from geomesa_tpu.conf import sys_prop
+
+            t0 = _time.perf_counter()
+            cql = q.get("cql", "INCLUDE")
+            batch = di.query(cql, loose=self._loose(q))
+            # same caps the store pipeline's interceptors apply
             mf = q.get("maxFeatures")
-            if mf and len(batch) > int(mf):
-                batch = batch.take(np.arange(int(mf)))
+            cap = min(
+                int(mf) if mf else len(batch),
+                int(sys_prop("query.max.features") or 0) or len(batch),
+            )
+            if len(batch) > cap:
+                batch = batch.take(np.arange(cap))
+            self._observe_resident(
+                type_name, cql, t0, _time.perf_counter(), len(batch)
+            )
         else:
             batch = self._query(type_name, q).batch
         fmt = q.get("f", "geojson")
@@ -171,7 +207,15 @@ class _Handler(BaseHTTPRequestHandler):
     def _count(self, type_name: str, q: dict) -> None:
         di = self._di(type_name)
         if di is not None:
-            n = di.count(q.get("cql", "INCLUDE"), loose=self._loose(q))
+            import time as _time
+
+            t0 = _time.perf_counter()
+            cql = q.get("cql", "INCLUDE")
+            n = di.count(cql, loose=self._loose(q))
+            mf = q.get("maxFeatures")
+            if mf:  # parity: the plain path counts the capped result
+                n = min(n, int(mf))
+            self._observe_resident(type_name, cql, t0, _time.perf_counter(), n)
             return self._json(200, {"count": n})
         res = self._query(type_name, q)
         self._json(200, {"count": len(res)})
@@ -183,8 +227,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(
                 400, {"error": "server is not running in resident mode"}
             )
+        fresh = type_name not in self._resident_cache
         di = self._di(type_name)
-        di.refresh()
+        if not fresh:  # a first-touch build already staged current state
+            di.refresh()
         self._json(200, {"refreshed": type_name, "rows": len(di)})
 
     def _stats(self, type_name: str, q: dict) -> None:
@@ -193,8 +239,13 @@ class _Handler(BaseHTTPRequestHandler):
             raise ValueError("stats endpoint needs stats=<Stat-DSL spec>")
         di = self._di(type_name)
         if di is not None:
-            seq = di.stats(
-                q.get("cql", "INCLUDE"), spec, loose=self._loose(q)
+            import time as _time
+
+            t0 = _time.perf_counter()
+            cql = q.get("cql", "INCLUDE")
+            seq = di.stats(cql, spec, loose=self._loose(q))
+            self._observe_resident(
+                type_name, cql, t0, _time.perf_counter(), 0
             )
         else:
             from geomesa_tpu.process import run_stats

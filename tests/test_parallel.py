@@ -407,3 +407,43 @@ def test_radix_bit31_lane_no_silent_loss(mesh, rng):
         # overflow is allowed (clamping skews the top half onto the last
         # shard) but it must be LOUD and fully accounted
         assert "dropped" in str(e)
+
+
+def test_sharded_zscan_count_matches_host(mesh):
+    """Mesh-wide key-only scan: per-shard masked compare + psum equals
+    the host quantized-cell oracle."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.curves.binnedtime import to_binned_time
+    from geomesa_tpu.curves.z3 import Z3SFC
+    from geomesa_tpu.ops import zscan
+    from geomesa_tpu.parallel.dist import sharded_zscan_count
+
+    sfc = Z3SFC()
+    rng = np.random.default_rng(31)
+    n = 1 << 14
+    lon = rng.uniform(-180, 180, n)
+    lat = rng.uniform(-90, 90, n)
+    t0 = np.datetime64("2020-01-06").astype("datetime64[ms]").astype(np.int64)
+    t = t0 + rng.integers(0, 21 * 86400_000, n)
+    bins_np, off = to_binned_time(t, sfc.period)
+    z = sfc.index(lon, lat, off)
+    bounds, ids = zscan.z3_query_bounds(
+        sfc, -30.0, 20.0, 60.0, 70.0,
+        int(t0 + 2 * 86400_000), int(t0 + 9 * 86400_000),
+    )
+    bounds, ids = zscan.pad_bins(bounds, ids)
+    got = int(sharded_zscan_count(
+        mesh,
+        jnp.asarray(bins_np.astype(np.int32)),
+        jnp.asarray((z >> np.uint64(32)).astype(np.uint32)),
+        jnp.asarray((z & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
+        bounds, ids,
+    ))
+    expect = np.asarray(zscan.z3_zscan_mask(
+        jnp.asarray((z >> np.uint64(32)).astype(np.uint32)),
+        jnp.asarray((z & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
+        jnp.asarray(bins_np.astype(np.int32)),
+        jnp.asarray(bounds), jnp.asarray(ids),
+    )).sum()
+    assert got == int(expect)

@@ -263,3 +263,16 @@ def test_refresh_rejected_without_resident_mode(server_url):
         raise AssertionError("should have 400'd")
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_resident_respects_max_features_cap(resident_url):
+    url, _ = resident_url
+    from geomesa_tpu.conf import prop_override
+
+    with prop_override("query.max.features", 7):
+        status, _, body = _get(f"{url}/features/gdelt?cql=INCLUDE")
+    assert status == 200
+    assert len(json.loads(body)["features"]) == 7
+    # explicit maxFeatures caps the resident count like the plain path
+    status, _, body = _get(f"{url}/count/gdelt?cql=INCLUDE&maxFeatures=5")
+    assert json.loads(body)["count"] == 5

@@ -171,11 +171,14 @@ class _Handler(BaseHTTPRequestHandler):
             t0 = _time.perf_counter()
             cql = q.get("cql", "INCLUDE")
             batch = di.query(cql, loose=self._loose(q))
-            # same caps the store pipeline's interceptors apply
+            # interceptor parity: an EXPLICIT maxFeatures overrides the
+            # global cap (MaxFeaturesInterceptor rewrites only unbounded
+            # queries); the global cap applies otherwise
             mf = q.get("maxFeatures")
-            cap = min(
-                int(mf) if mf else len(batch),
-                int(sys_prop("query.max.features") or 0) or len(batch),
+            cap = (
+                int(mf)
+                if mf
+                else (int(sys_prop("query.max.features") or 0) or len(batch))
             )
             if len(batch) > cap:
                 batch = batch.take(np.arange(cap))
@@ -209,12 +212,17 @@ class _Handler(BaseHTTPRequestHandler):
         if di is not None:
             import time as _time
 
+            from geomesa_tpu.conf import sys_prop
+
             t0 = _time.perf_counter()
             cql = q.get("cql", "INCLUDE")
             n = di.count(cql, loose=self._loose(q))
+            # parity: the plain path counts the capped result; explicit
+            # maxFeatures overrides the global query.max.features cap
             mf = q.get("maxFeatures")
-            if mf:  # parity: the plain path counts the capped result
-                n = min(n, int(mf))
+            cap = int(mf) if mf else int(sys_prop("query.max.features") or 0)
+            if cap > 0:
+                n = min(n, cap)
             self._observe_resident(type_name, cql, t0, _time.perf_counter(), n)
             return self._json(200, {"count": n})
         res = self._query(type_name, q)
@@ -227,9 +235,20 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(
                 400, {"error": "server is not running in resident mode"}
             )
-        fresh = type_name not in self._resident_cache
-        di = self._di(type_name)
-        if not fresh:  # a first-touch build already staged current state
+        # freshness must be decided under the construction lock: a build
+        # that STARTED before the caller's writes may finish after them,
+        # and skipping refresh on that stale snapshot would lose the
+        # writes this endpoint exists to surface
+        with self._resident_lock:
+            fresh = type_name not in self._resident_cache
+            if fresh:
+                from geomesa_tpu.device_cache import StreamingDeviceIndex
+
+                self._resident_cache[type_name] = StreamingDeviceIndex(
+                    self.store, type_name, z_planes=True
+                )
+            di = self._resident_cache[type_name]
+        if not fresh:  # a fresh build already staged post-write state
             di.refresh()
         self._json(200, {"refreshed": type_name, "rows": len(di)})
 

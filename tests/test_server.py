@@ -271,8 +271,18 @@ def test_resident_respects_max_features_cap(resident_url):
 
     with prop_override("query.max.features", 7):
         status, _, body = _get(f"{url}/features/gdelt?cql=INCLUDE")
-    assert status == 200
-    assert len(json.loads(body)["features"]) == 7
+        assert status == 200
+        assert len(json.loads(body)["features"]) == 7
+        # interceptor parity: an EXPLICIT maxFeatures overrides the
+        # global cap, exactly like MaxFeaturesInterceptor
+        status, _, body = _get(
+            f"{url}/features/gdelt?cql=INCLUDE&maxFeatures=20"
+        )
+        assert len(json.loads(body)["features"]) == 20
+        # /count applies the global cap like the plain path counts the
+        # capped result
+        status, _, body = _get(f"{url}/count/gdelt?cql=INCLUDE")
+        assert json.loads(body)["count"] == 7
     # explicit maxFeatures caps the resident count like the plain path
     status, _, body = _get(f"{url}/count/gdelt?cql=INCLUDE&maxFeatures=5")
     assert json.loads(body)["count"] == 5

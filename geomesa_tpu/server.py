@@ -55,23 +55,42 @@ class _Handler(BaseHTTPRequestHandler):
         build would stage the whole dataset into device memory twice)."""
         if not self.resident:
             return None
-        cache = self._resident_cache
-        di = cache.get(type_name)
+        di = self._resident_cache.get(type_name)
         if di is not None:
             return di
-        with self._resident_lock:
-            if type_name not in cache:
-                from geomesa_tpu.device_cache import StreamingDeviceIndex
-
-                cache[type_name] = StreamingDeviceIndex(
-                    self.store, type_name, z_planes=True
-                )
-            return cache[type_name]
+        return self._build_locked(type_name)[0]
 
     @staticmethod
     def _loose(q: dict) -> "bool | None":
         v = q.get("loose")
         return None if v is None else v.lower() in ("1", "true", "yes")
+
+    @staticmethod
+    def _cap(q: dict) -> "int | None":
+        """Result cap with interceptor parity, shared by every resident
+        endpoint: an EXPLICIT maxFeatures (including 0) overrides the
+        global query.max.features, which applies only when the request is
+        unbounded (MaxFeaturesInterceptor semantics). None = uncapped."""
+        mf = q.get("maxFeatures")
+        if mf is not None:
+            return int(mf)
+        from geomesa_tpu.conf import sys_prop
+
+        g = int(sys_prop("query.max.features") or 0)
+        return g if g > 0 else None
+
+    def _build_locked(self, type_name: str):
+        """First-touch resident build under the construction lock;
+        returns (index, built_now)."""
+        cache = self._resident_cache
+        with self._resident_lock:
+            if type_name in cache:
+                return cache[type_name], False
+            from geomesa_tpu.device_cache import StreamingDeviceIndex
+
+            di = StreamingDeviceIndex(self.store, type_name, z_planes=True)
+            cache[type_name] = di
+            return di, True
 
     def _observe_resident(self, type_name: str, cql: str, t0, t1, hits):
         """Metrics + audit parity with the store query pipeline (resident
@@ -166,21 +185,11 @@ class _Handler(BaseHTTPRequestHandler):
 
             import numpy as np
 
-            from geomesa_tpu.conf import sys_prop
-
             t0 = _time.perf_counter()
             cql = q.get("cql", "INCLUDE")
             batch = di.query(cql, loose=self._loose(q))
-            # interceptor parity: an EXPLICIT maxFeatures overrides the
-            # global cap (MaxFeaturesInterceptor rewrites only unbounded
-            # queries); the global cap applies otherwise
-            mf = q.get("maxFeatures")
-            cap = (
-                int(mf)
-                if mf
-                else (int(sys_prop("query.max.features") or 0) or len(batch))
-            )
-            if len(batch) > cap:
+            cap = self._cap(q)
+            if cap is not None and len(batch) > cap:
                 batch = batch.take(np.arange(cap))
             self._observe_resident(
                 type_name, cql, t0, _time.perf_counter(), len(batch)
@@ -212,17 +221,12 @@ class _Handler(BaseHTTPRequestHandler):
         if di is not None:
             import time as _time
 
-            from geomesa_tpu.conf import sys_prop
-
             t0 = _time.perf_counter()
             cql = q.get("cql", "INCLUDE")
             n = di.count(cql, loose=self._loose(q))
-            # parity: the plain path counts the capped result; explicit
-            # maxFeatures overrides the global query.max.features cap
-            mf = q.get("maxFeatures")
-            cap = int(mf) if mf else int(sys_prop("query.max.features") or 0)
-            if cap > 0:
-                n = min(n, cap)
+            cap = self._cap(q)
+            if cap is not None:
+                n = min(n, cap)  # the plain path counts the capped result
             self._observe_resident(type_name, cql, t0, _time.perf_counter(), n)
             return self._json(200, {"count": n})
         res = self._query(type_name, q)
@@ -235,20 +239,12 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(
                 400, {"error": "server is not running in resident mode"}
             )
-        # freshness must be decided under the construction lock: a build
-        # that STARTED before the caller's writes may finish after them,
-        # and skipping refresh on that stale snapshot would lose the
-        # writes this endpoint exists to surface
-        with self._resident_lock:
-            fresh = type_name not in self._resident_cache
-            if fresh:
-                from geomesa_tpu.device_cache import StreamingDeviceIndex
-
-                self._resident_cache[type_name] = StreamingDeviceIndex(
-                    self.store, type_name, z_planes=True
-                )
-            di = self._resident_cache[type_name]
-        if not fresh:  # a fresh build already staged post-write state
+        # freshness is decided under the construction lock (inside
+        # _build_locked): a build that STARTED before the caller's writes
+        # may finish after them, and skipping refresh on that stale
+        # snapshot would lose the writes this endpoint exists to surface
+        di, built_now = self._build_locked(type_name)
+        if not built_now:  # a fresh build already staged post-write state
             di.refresh()
         self._json(200, {"refreshed": type_name, "rows": len(di)})
 

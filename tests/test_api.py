@@ -1,0 +1,123 @@
+"""GeoTools-shaped API: DataStoreFinder params -> store, feature sources,
+writers, SPI registration."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.api import (
+    DataStoreFinder,
+    register_factory,
+)
+
+SPEC = "name:String,val:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def _fill(ds, n=500, seed=7):
+    rng = np.random.default_rng(seed)
+    ds.create_schema("t", SPEC)
+    ds.write(
+        "t",
+        {
+            "name": rng.choice(["a", "b"], n),
+            "val": rng.integers(0, 100, n),
+            "dtg": rng.integers(1_577_000_000_000, 1_580_000_000_000, n),
+            "geom": np.stack(
+                [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    if hasattr(ds, "flush"):
+        ds.flush("t")
+    return ds
+
+
+class TestFinder:
+    def test_fs_params(self, tmp_path):
+        ds = DataStoreFinder.get_data_store({"fs.path": str(tmp_path)})
+        _fill(ds)
+        # a second finder call reopens the same durable store
+        ds2 = DataStoreFinder.get_data_store({"fs.path": str(tmp_path)})
+        assert ds2.get_type_names() == ["t"]
+
+    def test_kv_params(self):
+        ds = DataStoreFinder.get_data_store({"kv.catalog": "cat"})
+        _fill(ds)
+        assert ds.get_type_names() == ["t"]
+
+    def test_kv_sqlite_params(self, tmp_path):
+        p = str(tmp_path / "kv.db")
+        ds = DataStoreFinder.get_data_store({"kv.sqlite": p})
+        _fill(ds)
+        ds2 = DataStoreFinder.get_data_store({"kv.sqlite": p})
+        assert ds2.get_type_names() == ["t"]
+
+    def test_memory_params(self):
+        ds = DataStoreFinder.get_data_store({"memory": True})
+        _fill(ds)
+        assert ds.get_type_names() == ["t"]
+
+    def test_unknown_params_raise(self):
+        with pytest.raises(ValueError, match="no data store factory"):
+            DataStoreFinder.get_data_store({"bogus": 1})
+
+    def test_spi_registration(self):
+        sentinel = object()
+        register_factory(
+            lambda p: p.get("custom.proto") == "x",
+            lambda p: sentinel,
+        )
+        got = DataStoreFinder.get_data_store({"custom.proto": "x"})
+        assert got._store is sentinel
+
+
+class TestFeatureSource:
+    @pytest.fixture()
+    def source(self):
+        ds = _fill(DataStoreFinder.get_data_store({"memory": True}))
+        return ds, ds.get_feature_source("t")
+
+    def test_count_and_features_match_store(self, source):
+        ds, src = source
+        q = "BBOX(geom, -5, -5, 5, 5) AND val >= 50"
+        expect = ds.query("t", q).batch
+        assert src.get_count(q) == len(expect)
+        fc = src.get_features(q)
+        assert len(fc) == len(expect)
+        feats = list(fc)
+        assert {f.fid for f in feats} == set(expect.fids.tolist())
+        f0 = feats[0]
+        assert f0["val"] == f0.get_attribute("val")
+        assert set(f0.attributes) == {"name", "val", "dtg", "geom"}
+
+    def test_bounds(self, source):
+        ds, src = source
+        env = src.get_bounds()
+        assert env is not None
+        x, y = ds.query("t", "INCLUDE").batch.point_coords()
+        assert env.xmin == pytest.approx(x.min())
+        assert env.ymax == pytest.approx(y.max())
+        # empty query -> None bounds
+        assert src.get_bounds("val > 1000000") is None
+
+    def test_missing_type_raises(self, source):
+        ds, _ = source
+        with pytest.raises(KeyError):
+            ds.get_feature_source("nope")
+
+
+class TestFeatureWriter:
+    def test_append_writer_roundtrip(self):
+        ds = DataStoreFinder.get_data_store({"memory": True})
+        ds.create_schema("t", SPEC)
+        with ds.get_feature_writer_append("t") as w:
+            for i in range(5):
+                w.write(
+                    {"name": "n", "val": i, "dtg": 0,
+                     "geom": (float(i), float(i))},
+                    fid=f"f{i}",
+                )
+        src = ds.get_feature_source("t")
+        assert src.get_count() == 5
+        got = src.get_features("BBOX(geom, 2.5, 2.5, 10, 10)")
+        assert {f.fid for f in got} == {"f3", "f4"}

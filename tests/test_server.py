@@ -286,3 +286,10 @@ def test_resident_respects_max_features_cap(resident_url):
     # explicit maxFeatures caps the resident count like the plain path
     status, _, body = _get(f"{url}/count/gdelt?cql=INCLUDE&maxFeatures=5")
     assert json.loads(body)["count"] == 5
+
+
+def test_resident_count_max_features_zero(resident_url):
+    url, _ = resident_url
+    # explicit 0 caps to 0 (interceptor parity edge case)
+    status, _, body = _get(f"{url}/count/gdelt?cql=INCLUDE&maxFeatures=0")
+    assert status == 200 and json.loads(body)["count"] == 0

@@ -80,22 +80,61 @@ def _memory_factory(params: dict):
     return MemoryDataStore()
 
 
+class _LambdaStoreShim:
+    """Adapts the single-type LambdaDataStore to the multi-type store
+    protocol the GeoTools surface expects (type_names / get_schema /
+    query(type, q) / write(type, cols, fids))."""
+
+    def __init__(self, lam):
+        self.lam = lam
+
+    @property
+    def type_names(self) -> list:
+        return [self.lam.type_name]
+
+    def _check(self, type_name: str) -> None:
+        if type_name != self.lam.type_name:
+            raise KeyError(type_name)
+
+    def get_schema(self, type_name: str):
+        self._check(type_name)
+        return self.lam.sft
+
+    def query(self, type_name: str, q="INCLUDE"):
+        from geomesa_tpu.query.runner import QueryResult
+
+        self._check(type_name)
+        batch = self.lam.query(q if isinstance(q, str) else q.filter)
+        return QueryResult(batch, None, len(batch), len(batch))
+
+    def write(self, type_name: str, columns: dict, fids=None) -> None:
+        self._check(type_name)
+        self.lam.write(columns, fids)
+
+
 def _lambda_factory(params: dict):
     from geomesa_tpu.stream.lambda_store import LambdaDataStore
 
     persistent = DataStoreFinder.get_data_store(params["lambda.persistent"])
-    return LambdaDataStore(
-        persistent,
+    return _LambdaStoreShim(LambdaDataStore(
+        persistent._store,
         params["lambda.type"],
         persist_after_ms=int(params.get("lambda.persist.interval", 60_000)),
-    )
+    ))
+
+
+def _truthy(v) -> bool:
+    """Map<String,String> safe: 'false'/'0'/'no' strings mean False."""
+    if isinstance(v, str):
+        return v.lower() in ("1", "true", "yes")
+    return bool(v)
 
 
 _REGISTRY.register(lambda p: "fs.path" in p, _fs_factory)
 _REGISTRY.register(
     lambda p: "kv.catalog" in p or "kv.sqlite" in p, _kv_factory
 )
-_REGISTRY.register(lambda p: p.get("memory"), _memory_factory)
+_REGISTRY.register(lambda p: _truthy(p.get("memory")), _memory_factory)
 _REGISTRY.register(
     lambda p: "lambda.persistent" in p and "lambda.type" in p,
     _lambda_factory,
@@ -215,12 +254,21 @@ class FeatureWriter:
             a.name: [r[a.name] for r in self._rows]
             for a in self.sft.attributes
         }
-        if self.sft.geom_field:
-            g = self.sft.geom_field
-            if self.sft.descriptor(g).is_point:
-                cols[g] = np.asarray(
-                    [np.asarray(v, dtype=float) for v in cols[g]]
-                )
+        g = self.sft.geom_field
+        if g is not None and self.sft.descriptor(g).is_point:
+            # per-ROW coercion: from_columns coerces whole columns by the
+            # first element's type, but writer rows may mix WKT strings,
+            # Point objects, and (x, y) pairs
+            from geomesa_tpu.geom import Point, parse_wkt
+
+            def xy(v):
+                if isinstance(v, str):
+                    v = parse_wkt(v)
+                if isinstance(v, Point):
+                    return (v.x, v.y)
+                return tuple(np.asarray(v, dtype=float))
+
+            cols[g] = np.asarray([xy(v) for v in cols[g]], dtype=float)
         self._store.write(self.type_name, cols, fids=np.asarray(
             self._fids, dtype=object
         ))

@@ -73,7 +73,7 @@ class _Handler(BaseHTTPRequestHandler):
         unbounded (MaxFeaturesInterceptor semantics). None = uncapped."""
         mf = q.get("maxFeatures")
         if mf is not None:
-            return int(mf)
+            return max(0, int(mf))  # negatives behave like 0 (plain path)
         from geomesa_tpu.conf import sys_prop
 
         g = int(sys_prop("query.max.features") or 0)

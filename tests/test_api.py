@@ -121,3 +121,66 @@ class TestFeatureWriter:
         assert src.get_count() == 5
         got = src.get_features("BBOX(geom, 2.5, 2.5, 10, 10)")
         assert {f.fid for f in got} == {"f3", "f4"}
+
+
+class TestLambdaFactory:
+    def test_lambda_params_flow(self, tmp_path):
+        # the nested persistent params must describe a DURABLE store that
+        # already carries the schema (lambda wraps one existing type)
+        root = str(tmp_path)
+        pre = DataStoreFinder.get_data_store({"fs.path": root})
+        pre.create_schema("t", SPEC)
+        lam = DataStoreFinder.get_data_store(
+            {"lambda.persistent": {"fs.path": root}, "lambda.type": "t"}
+        )
+        assert lam.get_type_names() == ["t"]
+        with lam.get_feature_writer_append("t") as w:
+            w.write({"name": "a", "val": 1, "dtg": 0, "geom": (1.0, 2.0)},
+                    fid="L1")
+        assert lam.get_feature_source("t").get_count() == 1
+
+    def test_lambda_full_surface(self):
+        # persistent store must carry the schema before the lambda wraps it
+        import geomesa_tpu.api as api
+
+        persistent = DataStoreFinder.get_data_store({"memory": True})
+        persistent.create_schema("t", SPEC)
+        from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+        lam = api._LambdaStoreShim(LambdaDataStore(persistent._store, "t"))
+        ds = api.DataStoreAdapter(lam)
+        assert ds.get_type_names() == ["t"]
+        with ds.get_feature_writer_append("t") as w:
+            w.write({"name": "a", "val": 1, "dtg": 0, "geom": (1.0, 2.0)},
+                    fid="x1")
+        src = ds.get_feature_source("t")
+        assert src.get_count() == 1
+        assert {f.fid for f in src.get_features("BBOX(geom, 0, 1, 2, 3)")} == {"x1"}
+        with pytest.raises(KeyError):
+            ds.get_feature_source("nope")
+
+    def test_memory_param_string_false(self):
+        with pytest.raises(ValueError, match="no data store factory"):
+            DataStoreFinder.get_data_store({"memory": "false"})
+
+
+class TestWriterCoercion:
+    def test_wkt_and_tuple_geometries(self):
+        ds = DataStoreFinder.get_data_store({"memory": True})
+        ds.create_schema("t", SPEC)
+        with ds.get_feature_writer_append("t") as w:
+            w.write({"name": "a", "val": 1, "dtg": 0, "geom": "POINT (1 2)"})
+            w.write({"name": "b", "val": 2, "dtg": 0, "geom": (3.0, 4.0)})
+        src = ds.get_feature_source("t")
+        assert src.get_count() == 2
+        assert src.get_count("BBOX(geom, 0.5, 1.5, 1.5, 2.5)") == 1
+
+    def test_generated_fids_unique_across_sessions(self):
+        ds = DataStoreFinder.get_data_store({"memory": True})
+        ds.create_schema("t", SPEC)
+        for _ in range(2):  # two separate writer sessions, no fids given
+            with ds.get_feature_writer_append("t") as w:
+                for i in range(3):
+                    w.write({"name": "a", "val": i, "dtg": 0,
+                             "geom": (float(i), 0.0)})
+        assert ds.get_feature_source("t").get_count() == 6  # no upsert collisions

@@ -109,3 +109,56 @@ def test_differential_fuzz(setup):
                 f"filter {i} ({q!r}) on {name}: "
                 f"+{len(got - expect)} -{len(expect - got)}"
             )
+
+
+def test_differential_fuzz_device_index(setup):
+    """The resident device caches (full + streaming) must answer the same
+    random filters exactly; loose mode must be a superset that never
+    misses a true hit (its overscan is bounded by cell granularity)."""
+    batch, stores = setup
+    from geomesa_tpu.device_cache import DeviceIndex, StreamingDeviceIndex
+
+    ds = stores["memory"]
+    di = DeviceIndex(ds, "t", z_planes=True)
+    sdi = StreamingDeviceIndex(ds, "t", z_planes=True)
+    r = random.Random(20260731)
+    for i in range(N_FILTERS):
+        q = _rand_filter(r)
+        expect = set(batch.fids[evaluate_host(parse_ecql(q), batch)].tolist())
+        for name, idx in (("device", di), ("streaming", sdi)):
+            got = set(int(v) for v in idx.query(q).fids)
+            assert got == expect, (
+                f"filter {i} ({q!r}) on {name}: "
+                f"+{len(got - expect)} -{len(expect - got)}"
+            )
+            assert idx.count(q) == len(expect), (i, q, name)
+            loose = set(int(v) for v in idx.query(q, loose=True).fids)
+            # loose only kicks in for bbox(+during)-only filters; either
+            # way it must never drop a true hit when it applies
+            if loose != expect:
+                assert expect <= loose, (
+                    f"filter {i} ({q!r}) on {name}: loose dropped "
+                    f"{len(expect - loose)} true hits"
+                )
+
+
+def test_differential_fuzz_device_stats(setup):
+    """Fused device stats equal host-observed stats for random filters."""
+    batch, stores = setup
+    from geomesa_tpu.device_cache import DeviceIndex
+    from geomesa_tpu.stats import parse_stat
+
+    ds = stores["memory"]
+    di = DeviceIndex(ds, "t")
+    spec = 'Count();MinMax("val");MinMax("dtg");Histogram("val",12,-50,50)'
+    r = random.Random(20260801)
+    for i in range(12):
+        q = _rand_filter(r)
+        got = di.stats(q, spec)
+        exp = parse_stat(spec)
+        exp.observe_batch(
+            batch.take(np.nonzero(evaluate_host(parse_ecql(q), batch))[0])
+        )
+        g, e = got.to_json(), exp.to_json()
+        # float64 'val' is int here; dtg exact via hi/lo; all exact on CPU
+        assert g == e, f"filter {i} ({q!r}): {g} != {e}"

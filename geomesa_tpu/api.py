@@ -83,10 +83,14 @@ def _memory_factory(params: dict):
 class _LambdaStoreShim:
     """Adapts the single-type LambdaDataStore to the multi-type store
     protocol the GeoTools surface expects (type_names / get_schema /
-    query(type, q) / write(type, cols, fids))."""
+    query(type, q) / write(type, cols, fids)); everything else (persist,
+    live, count, ...) delegates to the wrapped store."""
 
     def __init__(self, lam):
         self.lam = lam
+
+    def __getattr__(self, name):
+        return getattr(self.lam, name)
 
     @property
     def type_names(self) -> list:
@@ -96,16 +100,30 @@ class _LambdaStoreShim:
         if type_name != self.lam.type_name:
             raise KeyError(type_name)
 
-    def get_schema(self, type_name: str):
-        self._check(type_name)
-        return self.lam.sft
-
     def query(self, type_name: str, q="INCLUDE"):
+        from geomesa_tpu.query.plan import Query
         from geomesa_tpu.query.runner import QueryResult
 
         self._check(type_name)
-        batch = self.lam.query(q if isinstance(q, str) else q.filter)
+        if isinstance(q, Query):
+            # honor max_features / sort / projection / visibility like
+            # every other store (runner post-processing over the merged
+            # live+persistent batch)
+            from types import SimpleNamespace
+
+            from geomesa_tpu.query.runner import _post_process
+
+            batch = self.lam.query(
+                q.filter if q.filter is not None else "INCLUDE"
+            )
+            batch = _post_process(batch, SimpleNamespace(query=q))
+        else:  # str or parsed ast.Filter: the store accepts both
+            batch = self.lam.query(q)
         return QueryResult(batch, None, len(batch), len(batch))
+
+    def get_schema(self, type_name: str):
+        self._check(type_name)
+        return self.lam.sft
 
     def write(self, type_name: str, columns: dict, fids=None) -> None:
         self._check(type_name)
@@ -250,25 +268,12 @@ class FeatureWriter:
     def close(self) -> None:
         if not self._rows:
             return
+        # from_columns' _coerce_geometry handles mixed WKT strings,
+        # Point objects, and (x, y) pairs per row
         cols = {
             a.name: [r[a.name] for r in self._rows]
             for a in self.sft.attributes
         }
-        g = self.sft.geom_field
-        if g is not None and self.sft.descriptor(g).is_point:
-            # per-ROW coercion: from_columns coerces whole columns by the
-            # first element's type, but writer rows may mix WKT strings,
-            # Point objects, and (x, y) pairs
-            from geomesa_tpu.geom import Point, parse_wkt
-
-            def xy(v):
-                if isinstance(v, str):
-                    v = parse_wkt(v)
-                if isinstance(v, Point):
-                    return (v.x, v.y)
-                return tuple(np.asarray(v, dtype=float))
-
-            cols[g] = np.asarray([xy(v) for v in cols[g]], dtype=float)
         self._store.write(self.type_name, cols, fids=np.asarray(
             self._fids, dtype=object
         ))

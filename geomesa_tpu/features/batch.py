@@ -236,19 +236,30 @@ def _coerce_geometry(vals, is_point: bool) -> np.ndarray:
             if is_point
             else np.array([], dtype=object)
         )
-    first = vals[0]
-    if isinstance(first, str):
-        vals = [parse_wkt(v) for v in vals]
-        first = vals[0]
     if is_point:
-        if isinstance(first, Point):
-            return np.array([(p.x, p.y) for p in vals], dtype=np.float64)
-        if isinstance(first, (tuple, list)):
-            return np.asarray(vals, dtype=np.float64)
-        raise TypeError(f"cannot coerce {type(first)} to Point column")
-    if isinstance(first, Geometry):
-        return np.array(vals, dtype=object)
-    raise TypeError(f"cannot coerce {type(first)} to geometry column")
+        try:  # fast path: homogeneous (x, y) pairs
+            arr = np.asarray(vals, dtype=np.float64)
+            if arr.ndim == 2 and arr.shape[1] == 2:
+                return arr
+        except (ValueError, TypeError):
+            pass
+
+        # per-ROW coercion: a column may mix WKT strings, Point objects,
+        # and coordinate pairs (e.g. rows collected by a feature writer)
+        def xy(v):
+            if isinstance(v, str):
+                v = parse_wkt(v)
+            if isinstance(v, Point):
+                return (v.x, v.y)
+            if isinstance(v, (tuple, list, np.ndarray)):
+                return tuple(np.asarray(v, dtype=np.float64))
+            raise TypeError(f"cannot coerce {type(v)} to Point column")
+
+        return np.asarray([xy(v) for v in vals], dtype=np.float64)
+    out = [parse_wkt(v) if isinstance(v, str) else v for v in vals]
+    if isinstance(out[0], Geometry):
+        return np.array(out, dtype=object)
+    raise TypeError(f"cannot coerce {type(out[0])} to geometry column")
 
 
 def _coerce_date(vals) -> np.ndarray:

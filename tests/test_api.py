@@ -184,3 +184,60 @@ class TestWriterCoercion:
                     w.write({"name": "a", "val": i, "dtg": 0,
                              "geom": (float(i), 0.0)})
         assert ds.get_feature_source("t").get_count() == 6  # no upsert collisions
+
+
+class TestLambdaShimParity:
+    def _lam(self, tmp_path):
+        import geomesa_tpu.api as api
+        from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+        pre = DataStoreFinder.get_data_store({"fs.path": str(tmp_path)})
+        pre.create_schema("t", SPEC)
+        return api.DataStoreAdapter(
+            api._LambdaStoreShim(LambdaDataStore(pre._store, "t"))
+        )
+
+    def test_persist_reachable_through_finder_path(self, tmp_path):
+        ds = self._lam(tmp_path)
+        with ds.get_feature_writer_append("t") as w:
+            w.write({"name": "a", "val": 1, "dtg": 0, "geom": (1.0, 2.0)},
+                    fid="p1")
+        assert ds.persist() == 0  # too fresh to move, but callable
+        assert ds.get_feature_source("t").get_count() == 1
+
+    def test_query_accepts_ast_and_honors_max_features(self, tmp_path):
+        from geomesa_tpu.filter.ecql import parse_ecql
+        from geomesa_tpu.query.plan import Query
+
+        ds = self._lam(tmp_path)
+        with ds.get_feature_writer_append("t") as w:
+            for i in range(6):
+                w.write({"name": "a", "val": i, "dtg": 0,
+                         "geom": (float(i), 0.0)}, fid=f"q{i}")
+        # parsed AST filter works like on every other store
+        got = ds.query("t", parse_ecql("val >= 3")).batch
+        assert len(got) == 3
+        # Query post-processing applies
+        got = ds.query("t", Query(filter="INCLUDE", max_features=2)).batch
+        assert len(got) == 2
+        got = ds.query(
+            "t", Query(filter="INCLUDE", sort_by="val", sort_desc=True)
+        ).batch
+        assert list(got.columns["val"][:2]) == [5, 4]
+
+
+def test_store_write_mixed_geometry_column():
+    """_coerce_geometry is per-row tolerant on every ingestion path."""
+    from geomesa_tpu.geom import Point
+
+    ds = DataStoreFinder.get_data_store({"memory": True})
+    ds.create_schema("t", SPEC)
+    ds.write(
+        "t",
+        {"name": ["a", "b", "c"], "val": [1, 2, 3], "dtg": [0, 0, 0],
+         "geom": ["POINT (1 2)", (3.0, 4.0), Point(5.0, 6.0)]},
+        fids=["m0", "m1", "m2"],
+    )
+    src = ds.get_feature_source("t")
+    assert src.get_count("BBOX(geom, 0.5, 1.5, 1.5, 2.5)") == 1
+    assert src.get_count() == 3

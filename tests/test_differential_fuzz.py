@@ -68,13 +68,35 @@ def _rand_filter(r: random.Random, depth=0) -> str:
             ]
         )
 
+    def spatial():
+        # polygon intersects (device point-in-polygon + prefilter paths)
+        # and dwithin (distance compare) — convex pentagon around a
+        # random center so the ring is always valid
+        cx, cy = r.uniform(-150, 150), r.uniform(-70, 70)
+        if r.random() < 0.5:
+            import math
+
+            rad = r.uniform(1, 25)
+            pts = [
+                (cx + rad * math.cos(2 * math.pi * k / 5),
+                 cy + rad * math.sin(2 * math.pi * k / 5))
+                for k in range(5)
+            ]
+            pts.append(pts[0])
+            ring = ", ".join(f"{x:.3f} {y:.3f}" for x, y in pts)
+            return f"INTERSECTS(geom, POLYGON(({ring})))"
+        return (
+            f"DWITHIN(geom, POINT({cx:.3f} {cy:.3f}), "
+            f"{r.uniform(0.5, 10):.3f}, kilometers)"
+        )
+
     x = r.random()
     if depth < 2 and x < 0.35:
         op = r.choice(["AND", "OR"])
         return f"({_rand_filter(r, depth + 1)} {op} {_rand_filter(r, depth + 1)})"
     if depth < 2 and x < 0.45:
         return f"NOT ({_rand_filter(r, depth + 1)})"
-    return r.choice([bbox, during, attr])()
+    return r.choice([bbox, during, attr, spatial])()
 
 
 @pytest.fixture(scope="module")

@@ -90,6 +90,8 @@ class _LambdaStoreShim:
         self.lam = lam
 
     def __getattr__(self, name):
+        if name == "lam":  # unpickling/deepcopy probes before __init__
+            raise AttributeError(name)
         return getattr(self.lam, name)
 
     @property
@@ -107,15 +109,14 @@ class _LambdaStoreShim:
         self._check(type_name)
         if isinstance(q, Query):
             # honor max_features / sort / projection / visibility like
-            # every other store (runner post-processing over the merged
-            # live+persistent batch)
+            # every other store: hints (auths) flow INTO the store so the
+            # persistent layer keeps authorized labeled rows, then runner
+            # post-processing applies the merge-wide caps
             from types import SimpleNamespace
 
             from geomesa_tpu.query.runner import _post_process
 
-            batch = self.lam.query(
-                q.filter if q.filter is not None else "INCLUDE"
-            )
+            batch = self.lam.query(q)
             batch = _post_process(batch, SimpleNamespace(query=q))
         else:  # str or parsed ast.Filter: the store accepts both
             batch = self.lam.query(q)

@@ -1,0 +1,58 @@
+"""Inter-process locking for shared storage roots.
+
+Ref role: geomesa-utils ``DistributedLocking`` (ZooKeeper-backed in the
+reference — [UNVERIFIED - empty reference mount]). This stack has no
+ZooKeeper; the coordination scope is a shared POSIX filesystem, so the
+lock is ``flock(2)`` on a sentinel file in the store root: exclusive for
+destructive maintenance (compaction rewrites partition files in place),
+shared for readers that must not observe a half-rewritten directory.
+
+flock is advisory and per open-file-description: every acquisition opens
+its own fd, so it works across processes AND across threads of one
+process. NFS caveat (same as any flock user): requires a server with
+lock support; local disks and most cluster filesystems are fine.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import time
+from contextlib import contextmanager
+
+
+class LockTimeout(TimeoutError):
+    pass
+
+
+@contextmanager
+def file_lock(
+    path: str,
+    *,
+    shared: bool = False,
+    timeout_s: float = 60.0,
+    poll_s: float = 0.02,
+):
+    """Hold ``path`` flock'd (exclusive by default) for the with-body.
+    Raises LockTimeout if another holder keeps it past ``timeout_s``."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    flags = (fcntl.LOCK_SH if shared else fcntl.LOCK_EX) | fcntl.LOCK_NB
+    deadline = time.monotonic() + timeout_s
+    try:
+        while True:
+            try:
+                fcntl.flock(fd, flags)
+                break
+            except (BlockingIOError, InterruptedError):
+                if time.monotonic() >= deadline:
+                    raise LockTimeout(
+                        f"lock {path!r} not acquired within {timeout_s}s"
+                    ) from None
+                time.sleep(poll_s)
+        yield
+    finally:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        finally:
+            os.close(fd)

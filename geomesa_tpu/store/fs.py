@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import os
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -53,6 +54,7 @@ class _FsTypeState:
     encoding: str = "parquet"
     scheme: "object | None" = None  # PartitionScheme, from SFT user data
     stats: "object | None" = None  # SeqStat rebuilt at flush, persisted
+    generation: "str | None" = None  # manifest token last read/written
 
 
 def _write_table(table, path: str, encoding: str) -> None:
@@ -86,11 +88,19 @@ class FileSystemDataStore:
     ):
         if encoding not in ("parquet", "orc"):
             raise ValueError(f"unsupported encoding {encoding!r}")
+        import threading
+
         self.root = root
         self.partition_size = partition_size
         self.encoding = encoding
         self._types: dict[str, _FsTypeState] = {}
         os.makedirs(root, exist_ok=True)
+        # inter-process coordination (DistributedLocking analog): one
+        # flock sentinel per store root; exclusive for in-place rewrites
+        # (flush/compact/reindex/repartition), shared for file reads so a
+        # reader never observes a half-rewritten directory
+        self._lock_path = os.path.join(root, ".lock")
+        self._lock_tl = threading.local()
         self.audit_writer = None
         if audit:  # the <catalog>_queries table analog
             from geomesa_tpu.audit import FileAuditWriter
@@ -103,14 +113,53 @@ class FileSystemDataStore:
             if os.path.exists(meta_path):
                 self._load_type(name)
 
+    # -- inter-process locking ---------------------------------------------
+
+    @contextmanager
+    def _exclusive(self):
+        """Exclusive store lock, re-entrant per thread (a locked rewrite
+        reads existing files through _read_partition)."""
+        from geomesa_tpu.locking import file_lock
+
+        depth = getattr(self._lock_tl, "depth", 0)
+        if depth > 0:
+            self._lock_tl.depth = depth + 1
+            try:
+                yield
+            finally:
+                self._lock_tl.depth -= 1
+            return
+        with file_lock(self._lock_path):
+            self._lock_tl.depth = 1
+            try:
+                yield
+            finally:
+                self._lock_tl.depth = 0
+
+    @contextmanager
+    def _shared(self):
+        from geomesa_tpu.locking import file_lock
+
+        if getattr(self._lock_tl, "depth", 0) > 0:
+            yield  # already under this thread's exclusive lock
+            return
+        with file_lock(self._lock_path, shared=True):
+            yield
+
     # -- schema / persistence ---------------------------------------------
 
     def _dir(self, type_name: str) -> str:
         return os.path.join(self.root, type_name)
 
     def _load_type(self, name: str) -> None:
-        with open(os.path.join(self._dir(name), "schema.json")) as fh:
-            meta = json.load(fh)
+        self._types[name] = self._read_state(name)
+
+    def _read_state(self, name: str) -> "_FsTypeState":
+        # shared lock: never read the manifest mid-rewrite (writers hold
+        # the exclusive lock across the atomic os.replace of schema.json)
+        with self._shared():
+            with open(os.path.join(self._dir(name), "schema.json")) as fh:
+                meta = json.load(fh)
         sft = SimpleFeatureType.create(name, meta["spec"])
         parts = [
             PartitionMeta(
@@ -126,7 +175,7 @@ class FileSystemDataStore:
             )
             for p in meta["partitions"]
         ]
-        self._types[name] = _FsTypeState(
+        return _FsTypeState(
             sft,
             meta["primary"],
             parts,
@@ -136,6 +185,7 @@ class FileSystemDataStore:
             encoding=meta.get("encoding", "parquet"),
             scheme=self._scheme_of(sft, strict=False),
             stats=self._load_stats(meta.get("stats")),
+            generation=meta.get("generation"),
         )
 
     @staticmethod
@@ -176,8 +226,12 @@ class FileSystemDataStore:
         return scheme
 
     def _save_meta(self, name: str) -> None:
+        import uuid
+
         st = self._types[name]
+        st.generation = uuid.uuid4().hex  # new manifest token
         meta = {
+            "generation": st.generation,
             "spec": st.sft.spec,
             "primary": st.primary,
             "encoding": st.encoding,
@@ -198,8 +252,13 @@ class FileSystemDataStore:
                 for p in st.partitions
             ],
         }
-        with open(os.path.join(self._dir(name), "schema.json"), "w") as fh:
+        # atomic: a concurrent opener must see either the old or the new
+        # manifest, never a truncated one
+        path = os.path.join(self._dir(name), "schema.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
             json.dump(meta, fh)
+        os.replace(tmp, path)
 
     def create_schema(self, sft: "SimpleFeatureType | str", spec: "str | None" = None):
         if isinstance(sft, str):
@@ -235,6 +294,53 @@ class FileSystemDataStore:
     def flush(self, type_name: str) -> None:
         """Merge pending + existing into freshly sorted partition files (the
         compaction step; ref geomesa-fs CompactCommand semantics)."""
+        st = self._types[type_name]
+        if not st.pending:  # checked before locking: queries flush eagerly
+            return
+        with self._exclusive():
+            self._refresh_from_disk(type_name)
+            self._flush_locked(type_name)
+
+    def _refresh_from_disk(self, type_name: str) -> None:
+        """Re-read the on-disk manifest under the HELD exclusive lock:
+        another process may have rewritten the directory since this
+        process snapshotted it, and merging from the stale view would
+        read deleted part files. Buffered pending rows survive; the disk
+        wins on everything else (partitions, primary, scheme, stats)."""
+        meta_path = os.path.join(self._dir(type_name), "schema.json")
+        if not os.path.exists(meta_path):
+            return
+        st = self._types.get(type_name)
+        try:
+            with open(meta_path) as fh:
+                disk_gen = json.load(fh).get("generation")
+        except (OSError, json.JSONDecodeError):
+            return  # unreadable manifest: keep our view
+        if st is not None and disk_gen == st.generation:
+            # nobody else wrote since we last read/wrote: our in-memory
+            # state may be deliberately AHEAD of disk (failed-flush
+            # recovery holds everything in pending; deletions may not be
+            # persisted yet) and must win
+            return
+        new = self._read_state(type_name)
+        if st is None:
+            self._types[type_name] = new
+            return
+        # in-place: callers (delete, plan, query) hold references to the
+        # state object across flushes -- rebinding would strand them on a
+        # dead object. Buffered pending rows survive; disk wins on the
+        # rest.
+        st.sft = new.sft
+        st.primary = new.primary
+        st.partitions = new.partitions
+        st.data_interval = new.data_interval
+        st.encoding = new.encoding
+        st.scheme = new.scheme
+        st.stats = new.stats
+        st.generation = new.generation
+        st.cache = {}
+
+    def _flush_locked(self, type_name: str) -> None:
         st = self._types[type_name]
         if not st.pending:
             return
@@ -349,49 +455,62 @@ class FileSystemDataStore:
     def compact(self, type_name: str) -> None:
         """Rewrite all partition files merged + freshly sorted (ref:
         geomesa-fs CompactCommand)."""
-        self._rebuild_files(type_name)
+        with self._exclusive():
+            self._refresh_from_disk(type_name)
+            self._rebuild_locked(type_name)
 
     # -- maintenance jobs (ref geomesa-jobs index back-population) ---------
 
     def _rebuild_files(self, type_name: str) -> None:
         """Re-sort + re-write every partition file under the current
         primary/scheme (pending data included)."""
+        with self._exclusive():
+            self._refresh_from_disk(type_name)
+            self._rebuild_locked(type_name)
+
+    def _rebuild_locked(self, type_name: str) -> None:
         st = self._types[type_name]
         if st.partitions:
             st.pending = [self._read_all(type_name)] + st.pending
             st.partitions = []
-        self.flush(type_name)
-        self._save_meta(type_name)  # persists primary/scheme even when empty
+        self._flush_locked(type_name)
+        # persists primary/scheme even when empty
+        self._save_meta(type_name)
 
     def reindex(self, type_name: str, primary: str) -> None:
         """Switch the primary index and rebuild the sorted files (ref:
         geomesa-jobs attribute re-index / index back-population; here the
         sort order IS the index, so re-indexing is a rewrite)."""
-        st = self._types[type_name]
-        keyspace_for(st.sft, primary)  # validate against the schema
-        st.primary = primary
-        self._rebuild_files(type_name)
+        with self._exclusive():
+            self._refresh_from_disk(type_name)  # BEFORE the mutation
+            st = self._types[type_name]
+            keyspace_for(st.sft, primary)  # validate against the schema
+            st.primary = primary
+            self._rebuild_locked(type_name)
 
     def repartition(self, type_name: str, scheme_spec: "str | None") -> None:
         """Change (or drop) the directory partition scheme and rewrite the
         layout."""
         from geomesa_tpu.store.partitions import USER_DATA_KEY, scheme_for
 
-        st = self._types[type_name]
-        if scheme_spec:
-            scheme = scheme_for(scheme_spec)
-            scheme.validate(st.sft)
-            st.sft.user_data[USER_DATA_KEY] = scheme.spec
-        else:
-            scheme = None
-            st.sft.user_data.pop(USER_DATA_KEY, None)
-        st.scheme = scheme
-        self._rebuild_files(type_name)
+        with self._exclusive():
+            self._refresh_from_disk(type_name)  # BEFORE the mutation
+            st = self._types[type_name]
+            if scheme_spec:
+                scheme = scheme_for(scheme_spec)
+                scheme.validate(st.sft)
+                st.sft.user_data[USER_DATA_KEY] = scheme.spec
+            else:
+                scheme = None
+                st.sft.user_data.pop(USER_DATA_KEY, None)
+            st.scheme = scheme
+            self._rebuild_locked(type_name)
 
     def _read_partition(self, type_name: str, p: PartitionMeta) -> FeatureBatch:
         st = self._types[type_name]
         if p.pid not in st.cache:
-            t = _read_table(self._part_path(type_name, p), st.encoding)
+            with self._shared():  # never read a half-rewritten directory
+                t = _read_table(self._part_path(type_name, p), st.encoding)
             st.cache[p.pid] = FeatureBatch.from_arrow(t, st.sft)
         return st.cache[p.pid]
 
@@ -404,8 +523,13 @@ class FileSystemDataStore:
     # -- queries -----------------------------------------------------------
 
     def plan(self, type_name: str, query: "Query | str | ast.Filter") -> QueryPlan:
-        st = self._types[type_name]
         self.flush(type_name)
+        with self._shared():
+            self._refresh_from_disk(type_name)  # another process may have written
+            return self._plan_locked(type_name, query)
+
+    def _plan_locked(self, type_name: str, query) -> QueryPlan:
+        st = self._types[type_name]
         ks = keyspace_for(st.sft, st.primary)
         return plan_query(
             st.sft,
@@ -475,12 +599,23 @@ class FileSystemDataStore:
                     yield out
 
     def query(self, type_name: str, query: "Query | str | ast.Filter" = ast.Include) -> QueryResult:
-        """Partition-pruned scan over parquet files."""
+        """Partition-pruned scan over parquet files. The SHARED lock is
+        held across plan + every partition read, so a concurrent writer's
+        in-place rewrite can neither unlink files mid-scan nor mix rows
+        from two manifest generations into one result."""
         import time as _time
 
         t0 = _time.perf_counter()
+        self.flush(type_name)  # exclusive if pending; BEFORE the shared lock
+        with self._shared():
+            return self._query_locked(type_name, query, t0)
+
+    def _query_locked(self, type_name: str, query, t0) -> QueryResult:
+        import time as _time
+
+        self._refresh_from_disk(type_name)
         st = self._types[type_name]
-        plan = self.plan(type_name, query)
+        plan = self._plan_locked(type_name, query)
         t1 = _time.perf_counter()
         parts = self._pruned_parts(type_name, plan)
         # scan each surviving file through the shared runner by wrapping it

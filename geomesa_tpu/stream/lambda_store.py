@@ -52,10 +52,22 @@ class LambdaDataStore:
         self.live.remove(batch.fids)
         return len(batch)
 
-    def query(self, filt: "ast.Filter | str" = ast.Include) -> FeatureBatch:
-        """Merged view: live wins per fid (it is strictly newer)."""
-        live = self.live.query(filt)
-        persisted = self.persistent.query(self.type_name, filt).batch
+    def query(self, filt: "ast.Filter | str | object" = ast.Include) -> FeatureBatch:
+        """Merged view: live wins per fid (it is strictly newer). A full
+        Query is accepted too: its FILTER and HINTS (auths!) reach the
+        persistent layer, while result caps (sort/max-features) are the
+        caller's job — they have merge-wide semantics."""
+        from geomesa_tpu.query.plan import Query
+
+        if isinstance(filt, Query):
+            inner = Query(filter=filt.filter, hints=filt.hints)
+            live = self.live.query(
+                filt.filter if filt.filter is not None else ast.Include
+            )
+        else:
+            inner = filt
+            live = self.live.query(filt)
+        persisted = self.persistent.query(self.type_name, inner).batch
         if len(persisted) == 0:
             return live
         if len(live) == 0:

@@ -241,3 +241,33 @@ def test_store_write_mixed_geometry_column():
     src = ds.get_feature_source("t")
     assert src.get_count("BBOX(geom, 0.5, 1.5, 1.5, 2.5)") == 1
     assert src.get_count() == 3
+
+def test_query_hints_auths_reach_persistent_layer():
+    """Visibility parity: auths in Query hints must flow through the
+    lambda shim to the persistent layer instead of being dropped."""
+    import geomesa_tpu.api as api
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.query.plan import Query
+    from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+    pre = DataStoreFinder.get_data_store({"memory": True})
+    pre.create_schema("t", SPEC)
+    sft = SimpleFeatureType.create("t", SPEC)
+    labeled = FeatureBatch.from_columns(
+        sft,
+        {"name": ["s"], "val": [1], "dtg": [0],
+         "geom": np.array([[1.0, 2.0]])},
+        fids=np.array(["sec1"], dtype=object),
+    ).with_visibility(["admin"])
+    pre._store.write("t", labeled)
+    ds = api.DataStoreAdapter(
+        api._LambdaStoreShim(LambdaDataStore(pre._store, "t"))
+    )
+    # no auths: labeled row hidden
+    assert len(ds.query("t", Query(filter="INCLUDE")).batch) == 0
+    # with auths: visible through the lambda shim
+    got = ds.query(
+        "t", Query(filter="INCLUDE", hints={"auths": ("admin",)})
+    ).batch
+    assert list(got.fids) == ["sec1"]

@@ -93,6 +93,11 @@ class DeviceIndex:
     of the exact answer, one masked compare per row, 8-12B/row instead
     of reading the coordinate planes. Opt in per call (``loose=True``)
     or globally (``query.loose.bbox`` system property).
+
+    Visibility: staging queries run with NO auths, so features carrying
+    visibility labels are hidden from the resident copy entirely — the
+    cache can never leak a labeled feature; serving labeled data
+    per-auth requires the store path, not the resident one.
     """
 
     def __init__(

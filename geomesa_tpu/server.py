@@ -18,6 +18,8 @@ Endpoints (all GET):
   DensityProcess analog), JSON {"counts": [[...]], "bbox": [...]}
 - ``/stats/<type>?cql=&stats=<Stat-DSL spec>&loose=`` -- server-side
   aggregation (StatsProcess / StatsIterator analog), JSON stat list
+- ``/metrics``                      -- Prometheus exposition text
+- ``/refresh/<type>``               -- restage a resident type after writes
 
 Resident mode (``make_server(store, resident=True)``, CLI ``serve
 --resident``) pins each type's scan columns AND index-key planes in
@@ -131,6 +133,14 @@ class _Handler(BaseHTTPRequestHandler):
             q = {k: v[0] for k, v in parse_qs(url.query).items()}
             if parts == ["capabilities"]:
                 return self._capabilities()
+            if parts == ["metrics"]:
+                from geomesa_tpu.metrics import REGISTRY
+
+                return self._send(
+                    200,
+                    REGISTRY.prometheus_text().encode("utf-8"),
+                    "text/plain; version=0.0.4",
+                )
             if len(parts) == 2 and parts[0] in (
                 "features", "count", "explain", "density", "stats",
                 "refresh",

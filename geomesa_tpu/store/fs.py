@@ -101,6 +101,10 @@ class FileSystemDataStore:
         # reader never observes a half-rewritten directory
         self._lock_path = os.path.join(root, ".lock")
         self._lock_tl = threading.local()
+        # flock serializes PROCESSES; this RLock serializes THREADS of
+        # this process (a ThreadingHTTPServer shares one store object,
+        # and _refresh_from_disk mutates shared state in place)
+        self._mem_lock = threading.RLock()
         self.audit_writer = None
         if audit:  # the <catalog>_queries table analog
             from geomesa_tpu.audit import FileAuditWriter
@@ -129,7 +133,7 @@ class FileSystemDataStore:
             finally:
                 self._lock_tl.depth -= 1
             return
-        with file_lock(self._lock_path):
+        with self._mem_lock, file_lock(self._lock_path):
             self._lock_tl.depth = 1
             try:
                 yield
@@ -143,7 +147,7 @@ class FileSystemDataStore:
         if getattr(self._lock_tl, "depth", 0) > 0:
             yield  # already under this thread's exclusive lock
             return
-        with file_lock(self._lock_path, shared=True):
+        with self._mem_lock, file_lock(self._lock_path, shared=True):
             yield
 
     # -- schema / persistence ---------------------------------------------
@@ -259,6 +263,12 @@ class FileSystemDataStore:
         with open(tmp, "w") as fh:
             json.dump(meta, fh)
         os.replace(tmp, path)
+        # tiny sidecar: staleness checks read ONLY this, not the whole
+        # manifest (which carries the full partition list)
+        gen_tmp = path + ".gen.tmp"
+        with open(gen_tmp, "w") as fh:
+            fh.write(st.generation)
+        os.replace(gen_tmp, path + ".gen")
 
     def create_schema(self, sft: "SimpleFeatureType | str", spec: "str | None" = None):
         if isinstance(sft, str):
@@ -312,8 +322,13 @@ class FileSystemDataStore:
             return
         st = self._types.get(type_name)
         try:
-            with open(meta_path) as fh:
-                disk_gen = json.load(fh).get("generation")
+            gen_path = meta_path + ".gen"
+            if os.path.exists(gen_path):
+                with open(gen_path) as fh:
+                    disk_gen = fh.read().strip() or None
+            else:  # pre-sidecar manifest: full parse fallback
+                with open(meta_path) as fh:
+                    disk_gen = json.load(fh).get("generation")
         except (OSError, json.JSONDecodeError):
             return  # unreadable manifest: keep our view
         if st is not None and disk_gen == st.generation:
@@ -356,10 +371,17 @@ class FileSystemDataStore:
             self._write_sorted(type_name, st, ks, data)
         except Exception:
             # old files may already be gone -- keep the full dataset in
-            # memory as pending so a corrected retry loses nothing
+            # memory as pending so a corrected retry loses nothing, and
+            # reconcile the on-disk manifest (best effort): other
+            # processes must not keep reading a partition list whose
+            # files were already unlinked
             st.pending = [data]
             st.partitions = []
             st.cache = {}
+            try:
+                self._save_meta(type_name)
+            except Exception:
+                pass  # the original error matters more
             raise
 
     def _write_sorted(self, type_name, st, ks, data) -> None:
@@ -421,20 +443,27 @@ class FileSystemDataStore:
         return os.path.join(d, f"part-{p.pid:05d}.{st.encoding}")
 
     def delete(self, type_name: str, fids) -> int:
-        """Drop features by id and compact the partition files."""
-        st = self._types[type_name]
-        self.flush(type_name)
-        if not st.partitions:
-            return 0
-        data = self._read_all(type_name)
-        # object dtype: a mixed int/str id list must not collapse to all-str
-        keep = ~np.isin(data.fids, np.asarray(list(fids), dtype=object))
-        removed = int((~keep).sum())
-        if removed:
-            st.pending = [data.take(np.nonzero(keep)[0])]
-            st.partitions = []
-            self.flush(type_name)
-        return removed
+        """Drop features by id and compact the partition files. One
+        exclusive section end to end: a writer slipping between the read
+        and the rewrite would have its rows resurrected or duplicated."""
+        with self._exclusive():
+            self._refresh_from_disk(type_name)
+            st = self._types[type_name]
+            self._flush_locked(type_name)
+            if not st.partitions:
+                return 0
+            data = self._read_all(type_name)
+            # object dtype: a mixed int/str id list must not collapse to
+            # all-str
+            keep = ~np.isin(
+                data.fids, np.asarray(list(fids), dtype=object)
+            )
+            removed = int((~keep).sum())
+            if removed:
+                st.pending = [data.take(np.nonzero(keep)[0])]
+                st.partitions = []
+                self._flush_locked(type_name)
+            return removed
 
     def age_off(self, type_name: str, before_ms: int) -> int:
         from geomesa_tpu.store.ageoff import age_off
@@ -443,21 +472,24 @@ class FileSystemDataStore:
 
     def update_user_data(self, type_name: str, updates: dict) -> None:
         """Set (or, with None values, remove) schema user-data entries and
-        persist the manifest (ref: UpdateSftCommand / KeywordsCommand)."""
-        st = self._types[type_name]
-        for k, v in updates.items():
-            if v is None:
-                st.sft.user_data.pop(k, None)
-            else:
-                st.sft.user_data[k] = v
-        self._save_meta(type_name)
+        persist the manifest (ref: UpdateSftCommand / KeywordsCommand).
+        Exclusive + refresh: _save_meta serializes the full partition
+        list, and writing it from a stale view would clobber another
+        process's flushed manifest."""
+        with self._exclusive():
+            self._refresh_from_disk(type_name)
+            st = self._types[type_name]
+            for k, v in updates.items():
+                if v is None:
+                    st.sft.user_data.pop(k, None)
+                else:
+                    st.sft.user_data[k] = v
+            self._save_meta(type_name)
 
     def compact(self, type_name: str) -> None:
         """Rewrite all partition files merged + freshly sorted (ref:
         geomesa-fs CompactCommand)."""
-        with self._exclusive():
-            self._refresh_from_disk(type_name)
-            self._rebuild_locked(type_name)
+        self._rebuild_files(type_name)
 
     # -- maintenance jobs (ref geomesa-jobs index back-population) ---------
 

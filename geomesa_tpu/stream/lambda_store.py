@@ -64,6 +64,14 @@ class LambdaDataStore:
             live = self.live.query(
                 filt.filter if filt.filter is not None else ast.Include
             )
+            # the live layer never consults auths itself: apply the same
+            # visibility rule the persistent layer's post-processing uses,
+            # or a labeled live row would leak to an unauthorized caller
+            from geomesa_tpu.security import filter_by_visibility
+
+            m = filter_by_visibility(live, filt.hints.get("auths", ()))
+            if m is not None:
+                live = live.take(np.nonzero(m)[0])
         else:
             inner = filt
             live = self.live.query(filt)

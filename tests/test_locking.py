@@ -46,6 +46,10 @@ class TestFileLock:
         with file_lock(path, shared=True):
             with file_lock(path, shared=True, timeout_s=1):
                 pass  # two readers fine
+            # ...but a writer cannot enter while a reader holds it
+            with pytest.raises(LockTimeout):
+                with file_lock(path, timeout_s=0.2):
+                    pass
 
     def test_exclusive_reentrancy_is_not_automatic(self, tmp_path):
         # flock on a second fd of the same file blocks even in-process:

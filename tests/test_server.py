@@ -293,3 +293,13 @@ def test_resident_count_max_features_zero(resident_url):
     # explicit 0 caps to 0 (interceptor parity edge case)
     status, _, body = _get(f"{url}/count/gdelt?cql=INCLUDE&maxFeatures=0")
     assert status == 200 and json.loads(body)["count"] == 0
+
+
+def test_metrics_endpoint(server_url):
+    url, _ = server_url
+    _get(f"{url}/count/gdelt?cql=INCLUDE")  # generate at least one query metric
+    status, ctype, body = _get(f"{url}/metrics")
+    assert status == 200 and "text/plain" in ctype
+    text = body.decode()
+    assert "geomesa_queries_total" in text
+    assert "# TYPE geomesa_query_duration_seconds histogram" in text

@@ -64,6 +64,16 @@ class FeatureBatch:
             out[attr.name] = col
         if n is None:
             n = 0
+        from geomesa_tpu.security import VIS_COLUMN
+
+        if VIS_COLUMN in columns:
+            # visibility rides along as a reserved column (it is not an SFT
+            # attribute); dropping it here would silently de-classify rows
+            # on any columns-dict round trip (e.g. live-layer Put replay)
+            vis = np.asarray(columns[VIS_COLUMN], dtype=object)
+            if len(vis) != n:
+                raise ValueError("visibility length mismatch")
+            out[VIS_COLUMN] = vis
         if fids is None:
             fids = np.arange(n)
         fids = np.asarray(fids)

@@ -87,5 +87,8 @@ def knn(
     # the raw-degree box of half-extents (kth/cos(lat), kth) around the
     # target -- the k-th circle can poke outside the search window, and the
     # window's lon extent under-covers because the metric shrinks lon.
-    rx = kth / max(np.cos(np.radians(py)), 0.01)
-    return _k_nearest(window(rx, kth), geom, px, py, k)
+    # ... but never wider than max_radius_deg: points beyond the cap are
+    # outside the search contract, and near the poles rx could otherwise
+    # blow up to 100x kth
+    rx = min(kth / max(np.cos(np.radians(py)), 0.01), max_radius_deg)
+    return _k_nearest(window(rx, min(kth, max_radius_deg)), geom, px, py, k)

@@ -55,6 +55,15 @@ class _FsTypeState:
     scheme: "object | None" = None  # PartitionScheme, from SFT user data
     stats: "object | None" = None  # SeqStat rebuilt at flush, persisted
     generation: "str | None" = None  # manifest token last read/written
+    # a failed flush already unlinked the old files; the only copy of the
+    # data lives in the writer's in-memory `pending`. The manifest is
+    # published with this flag so OTHER processes fail loudly instead of
+    # reading an empty-but-valid dataset
+    dirty: bool = False
+    # process-local (never persisted/refreshed): True only in the process
+    # whose failed flush raised the quarantine -- the one holding the data
+    # in `pending`. Only that process may flush (and thereby lift) it.
+    quarantine_owner: bool = False
 
 
 def _write_table(table, path: str, encoding: str) -> None:
@@ -190,6 +199,7 @@ class FileSystemDataStore:
             scheme=self._scheme_of(sft, strict=False),
             stats=self._load_stats(meta.get("stats")),
             generation=meta.get("generation"),
+            dirty=bool(meta.get("dirty", False)),
         )
 
     @staticmethod
@@ -236,6 +246,7 @@ class FileSystemDataStore:
         st.generation = uuid.uuid4().hex  # new manifest token
         meta = {
             "generation": st.generation,
+            "dirty": st.dirty,
             "spec": st.sft.spec,
             "primary": st.primary,
             "encoding": st.encoding,
@@ -353,10 +364,21 @@ class FileSystemDataStore:
         st.scheme = new.scheme
         st.stats = new.stats
         st.generation = new.generation
+        st.dirty = new.dirty
         st.cache = {}
 
     def _flush_locked(self, type_name: str) -> None:
         st = self._types[type_name]
+        if st.dirty and not st.quarantine_owner:
+            # another process's failed flush quarantined this dataset and
+            # that process alone holds the lost rows in memory. Flushing
+            # our own pending here would publish a clean manifest with only
+            # OUR rows -- turning the loud failure back into silent loss.
+            raise RuntimeError(
+                f"dataset {type_name!r} is quarantined: a flush failed "
+                "mid-rewrite in another process; retry there or restore "
+                "the files"
+            )
         if not st.pending:
             return
         batches = list(st.pending)
@@ -378,6 +400,8 @@ class FileSystemDataStore:
             st.pending = [data]
             st.partitions = []
             st.cache = {}
+            st.dirty = True  # quarantine: readers must not see "empty"
+            st.quarantine_owner = True
             try:
                 self._save_meta(type_name)
             except Exception:
@@ -433,6 +457,8 @@ class FileSystemDataStore:
         from geomesa_tpu.store.memory import build_default_stats
 
         st.stats = build_default_stats(st.sft, full)
+        st.dirty = False  # a successful rewrite lifts the quarantine
+        st.quarantine_owner = False
         self._save_meta(type_name)
 
     def _part_path(self, type_name: str, p: PartitionMeta) -> str:
@@ -562,6 +588,17 @@ class FileSystemDataStore:
 
     def _plan_locked(self, type_name: str, query) -> QueryPlan:
         st = self._types[type_name]
+        if st.dirty and not st.pending:
+            # another process's flush failed after unlinking the old files;
+            # the data exists only in THAT process's memory. An empty
+            # result here would be silent data loss -- fail loudly. (The
+            # quarantined writer itself still has `pending` and may serve
+            # and retry.)
+            raise RuntimeError(
+                f"dataset {type_name!r} is quarantined: a flush failed "
+                "mid-rewrite in another process; retry there or restore "
+                "the files"
+            )
         ks = keyspace_for(st.sft, st.primary)
         return plan_query(
             st.sft,

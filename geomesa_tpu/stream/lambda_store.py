@@ -64,17 +64,19 @@ class LambdaDataStore:
             live = self.live.query(
                 filt.filter if filt.filter is not None else ast.Include
             )
-            # the live layer never consults auths itself: apply the same
-            # visibility rule the persistent layer's post-processing uses,
-            # or a labeled live row would leak to an unauthorized caller
-            from geomesa_tpu.security import filter_by_visibility
-
-            m = filter_by_visibility(live, filt.hints.get("auths", ()))
-            if m is not None:
-                live = live.take(np.nonzero(m)[0])
+            auths = filt.hints.get("auths", ())
         else:
             inner = filt
             live = self.live.query(filt)
+            auths = ()  # no Query means no auths supplied: fail closed
+        # the live layer never consults auths itself: apply the same
+        # visibility rule the persistent layer's post-processing uses,
+        # or a labeled live row would leak to an unauthorized caller
+        from geomesa_tpu.security import filter_by_visibility
+
+        m = filter_by_visibility(live, auths)
+        if m is not None:
+            live = live.take(np.nonzero(m)[0])
         persisted = self.persistent.query(self.type_name, inner).batch
         if len(persisted) == 0:
             return live

@@ -22,7 +22,10 @@ from geomesa_tpu.stream.log import Clear, Put, Remove
 MAGIC = 0x47  # 'G'
 # v2 added the i64 seq field to the header; v3 made Remove fids
 # type-preserving (int fids no longer come back as strings on replay,
-# which silently missed every row keyed by an int fid)
+# which silently missed every row keyed by an int fid). Writers emit the
+# LOWEST version that can represent the message (v3 only when an int fid
+# forces it), so older v2 consumers sharing a partitioned log keep working
+# until an int fid actually appears.
 VERSION = 3
 _PUT, _REMOVE, _CLEAR = 0, 1, 2
 
@@ -31,7 +34,8 @@ def encode_message(sft: SimpleFeatureType, msg) -> bytes:
     buf = io.BytesIO()
     seq = -1 if getattr(msg, "seq", None) is None else int(msg.seq)
     if isinstance(msg, Put):
-        buf.write(struct.pack("<BBBq", MAGIC, VERSION, _PUT, seq))
+        # Put/Clear bodies are identical in v2 and v3: emit v2
+        buf.write(struct.pack("<BBBq", MAGIC, 2, _PUT, seq))
         batch = FeatureBatch.from_columns(sft, msg.columns, msg.fids)
         rows = serialize_batch(batch)
         buf.write(struct.pack("<I", len(rows)))
@@ -39,20 +43,25 @@ def encode_message(sft: SimpleFeatureType, msg) -> bytes:
             buf.write(struct.pack("<I", len(r)))
             buf.write(r)
     elif isinstance(msg, Remove):
-        buf.write(struct.pack("<BBBq", MAGIC, VERSION, _REMOVE, seq))
         fids = np.asarray(msg.fids).tolist()
+        has_int = any(isinstance(f, (int, np.integer)) for f in fids)
+        version = VERSION if has_int else 2
+        buf.write(struct.pack("<BBBq", MAGIC, version, _REMOVE, seq))
         buf.write(struct.pack("<I", len(fids)))
-        # type byte per fid, mirroring binser's fid rule: a Remove must
-        # round-trip to the same key the Put's fid round-trips to
+        # v3: type byte per fid, mirroring binser's fid rule: a Remove must
+        # round-trip to the same key the Put's fid round-trips to. v2 (all
+        # strings): bare length-prefixed utf-8, the legacy layout.
         for f in fids:
-            if isinstance(f, (int, np.integer)):
-                buf.write(struct.pack("<Bq", 0, int(f)))
-            else:
-                enc = str(f).encode("utf-8")
-                buf.write(struct.pack("<BH", 1, len(enc)))
-                buf.write(enc)
+            if version >= 3:
+                if isinstance(f, (int, np.integer)):
+                    buf.write(struct.pack("<Bq", 0, int(f)))
+                    continue
+                buf.write(struct.pack("<B", 1))
+            enc = str(f).encode("utf-8")
+            buf.write(struct.pack("<H", len(enc)))
+            buf.write(enc)
     elif isinstance(msg, Clear):
-        buf.write(struct.pack("<BBBq", MAGIC, VERSION, _CLEAR, seq))
+        buf.write(struct.pack("<BBBq", MAGIC, 2, _CLEAR, seq))
     else:
         raise TypeError(f"cannot encode {type(msg).__name__}")
     return buf.getvalue()

@@ -205,3 +205,118 @@ def test_st_distance_polygon_hole_vertices():
     # nearest point is the protruding hole corner (2, 2): the segment lies
     # on x + y = 4.7, so the distance is 0.7 / sqrt(2)
     assert abs(d - 0.7 / np.sqrt(2)) < 1e-9
+
+
+# -- advisor round-2 findings ------------------------------------------------
+
+
+def test_lambda_str_filter_fails_closed_on_visibility():
+    """A visibility-labeled live row must not leak to a caller using the
+    plain str/ast filter path (no auths supplied => no authorizations)."""
+    from geomesa_tpu.query.plan import Query
+    from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+    sft = SimpleFeatureType.create("lv", "count:Int,*geom:Point:srid=4326")
+    persistent = MemoryDataStore()
+    persistent.create_schema(sft)
+    lam = LambdaDataStore(persistent, "lv")
+    batch = FeatureBatch.from_columns(
+        sft, {"count": [1, 2], "geom": np.zeros((2, 2))}
+    ).with_visibility(["secret", ""])
+    lam.live.put(dict(batch.columns), batch.fids)
+    # str path: labeled row hidden, unlabeled row visible
+    got = lam.query("INCLUDE")
+    assert sorted(got.column("count").tolist()) == [2]
+    # Query path with the right auths still sees both
+    got = lam.query(Query("INCLUDE", hints={"auths": ("secret",)}))
+    assert sorted(got.column("count").tolist()) == [1, 2]
+
+
+def test_fs_failed_flush_quarantines_readers(tmp_path, monkeypatch):
+    """A failed flush must not publish an empty-but-valid manifest: other
+    processes fail loudly instead of reading a silently-empty dataset,
+    and a successful retry lifts the quarantine."""
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    root = str(tmp_path / "cat")
+    sft = SimpleFeatureType.create("q", "count:Int,*geom:Point:srid=4326")
+    ds = FileSystemDataStore(root)
+    ds.create_schema(sft)
+    ds.write("q", {"count": [1, 2], "geom": np.zeros((2, 2))})
+    boom = RuntimeError("disk full")
+
+    def bad_write(*a, **k):
+        raise boom
+
+    monkeypatch.setattr(ds, "_write_sorted", bad_write)
+    with pytest.raises(RuntimeError, match="disk full"):
+        ds.flush("q")
+    # a second process opening the store must not see "empty and fine"
+    ds2 = FileSystemDataStore(root)
+    with pytest.raises(RuntimeError, match="quarantined"):
+        ds2.query("q")
+    # ... nor may it flush its own writes: that would publish a clean
+    # manifest holding only ITS rows, silently dropping the lost ones
+    ds2.write("q", {"count": [99], "geom": np.zeros((1, 2))})
+    with pytest.raises(RuntimeError, match="quarantined"):
+        ds2.flush("q")
+    # the writer itself still holds the data in pending and can serve it
+    monkeypatch.undo()
+    assert sorted(ds.query("q").batch.column("count").tolist()) == [1, 2]
+    # ... and that query's flush retry lifted the quarantine for everyone
+    ds3 = FileSystemDataStore(root)
+    assert sorted(ds3.query("q").batch.column("count").tolist()) == [1, 2]
+
+
+def test_knn_confidence_pass_respects_max_radius():
+    """Near the poles the confidence window rx = kth/cos(lat) can blow up
+    ~100x; it must stay clamped to max_radius_deg."""
+    from geomesa_tpu.process.knn import knn
+
+    sft = SimpleFeatureType.create("kp", "count:Int,*geom:Point:srid=4326")
+    ds = MemoryDataStore()
+    ds.create_schema(sft)
+    xs = np.array([0.0, 1.0, 2.0, 170.0])
+    ys = np.array([89.5, 89.5, 89.5, 89.5])
+    ds.write(
+        "kp",
+        {"count": np.arange(4), "geom": np.stack([xs, ys], axis=1)},
+    )
+    seen = []
+    real_query = ds.query
+
+    def spy(type_name, q):
+        f = q.filter if hasattr(q, "filter") else q
+        seen.append(f)
+        return real_query(type_name, q)
+
+    ds.query = spy
+    knn(ds, "kp", 0.0, 89.5, k=3, initial_radius_deg=0.05, max_radius_deg=5.0)
+    # every bbox the search issued stays within the max-radius box
+    assert seen
+    for f in seen:
+        bb = f.children[0] if hasattr(f, "children") else f
+        assert bb.xmin >= 0.0 - 5.0 - 1e-9
+        assert bb.xmax <= 0.0 + 5.0 + 1e-9
+
+
+def test_geomessage_emits_lowest_compatible_version():
+    """Writers emit v2 unless an int fid forces v3, so v2 consumers on a
+    shared log keep working; everything still round-trips."""
+    from geomesa_tpu.stream.log import Clear, Put, Remove
+    from geomesa_tpu.stream.messages import decode_message, encode_message
+
+    sft = SimpleFeatureType.create("vm", "count:Int,*geom:Point:srid=4326")
+    put = Put({"count": [1], "geom": np.zeros((1, 2))}, np.array(["a"], dtype=object))
+    raw = encode_message(sft, put)
+    assert raw[1] == 2
+    assert list(decode_message(sft, raw).fids) == ["a"]
+    rm_str = Remove(np.array(["a", "b"], dtype=object))
+    raw = encode_message(sft, rm_str)
+    assert raw[1] == 2
+    assert list(decode_message(sft, raw).fids) == ["a", "b"]
+    rm_int = Remove(np.array([7, "b"], dtype=object))
+    raw = encode_message(sft, rm_int)
+    assert raw[1] == 3
+    back = decode_message(sft, raw).fids
+    assert list(back) == [7, "b"] and isinstance(back[0], int)

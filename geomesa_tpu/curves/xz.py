@@ -88,10 +88,13 @@ class XZSFC:
         l1 + 1. Result clamped to [0, g].
         """
         w = np.maximum.reduce(maxs - mins)  # max extent per object
-        # point boxes (w == 0) go to max depth; avoid log(0)/inf-cast noise
-        # by substituting a dummy before the log.
-        safe_w = np.where(w > 0, w, 1.0)
-        l1 = np.floor(np.log(safe_w) / np.log(0.5)).astype(np.int64)
+        # l1 = floor(log2(1/w)), computed EXACTLY from the float exponent
+        # (frexp: w = m * 2^e with m in [0.5, 1)) instead of a transcendental
+        # log whose rounding could flip the level at exact power-of-two
+        # extents -- and which the device encode could not reproduce
+        # bit-for-bit. Point boxes (w == 0) go to max depth.
+        m, e = np.frexp(np.where(w > 0, w, 1.0))
+        l1 = np.where(m == 0.5, 1 - e, -e).astype(np.int64)
         l1 = np.where(w <= 0, self.g, np.minimum(l1, self.g))
         # check fit one level deeper: max <= floor(min/w2)*w2 + 2*w2
         w2 = np.power(0.5, np.minimum(l1 + 1, self.g).astype(np.float64))
@@ -147,6 +150,93 @@ class XZSFC:
         maxs = np.clip(maxs, 0.0, 1.0)
         length = self.length(mins, maxs)
         return self.sequence_code(mins, length)
+
+    def _step_tables(self):
+        """(g, fanout) uint32 hi/lo tables of the per-level pre-order code
+        increment ``1 + quad * child_step(level)``: the device walk gathers
+        from these instead of doing 64-bit multiplies (TPU VPU has no
+        64-bit integer lanes)."""
+        from geomesa_tpu.curves.zorder import u64_hi_lo
+
+        f = self.fanout
+        tbl = np.array(
+            [
+                [1 + q * self._child_step(i) for q in range(f)]
+                for i in range(self.g)
+            ],
+            dtype=np.uint64,
+        )
+        return u64_hi_lo(tbl)
+
+    def index_jax_hi_lo(self, mins, maxs):
+        """Device XZ encode: normalized (dims, n) boxes -> (hi, lo) uint32.
+
+        Bit-identical to :meth:`index` when fed float64 (CPU/x64); float32
+        inputs (the TPU storage format) can differ by one level/cell at
+        exact bin boundaries, same caveat as the z-curve device encodes.
+        Inverted boxes are clamped to empty (``maxs < mins`` -> point box
+        at ``mins``) rather than raised: jit cannot raise data-dependently,
+        and staging feeds only pre-validated geometry envelopes.
+
+        The pre-order code accumulates in uint32 hi/lo lanes with explicit
+        carry; per-level step values come from a gathered constant table
+        (see :meth:`_step_tables`).
+        """
+        import jax.numpy as jnp
+
+        mins = jnp.clip(mins, 0.0, 1.0)
+        maxs = jnp.clip(maxs, 0.0, 1.0)
+        maxs = jnp.maximum(maxs, mins)
+        # -- resolution level (mirrors length(), exactly) -------------------
+        # min(floor(log2(1/w)), g) == count of levels l in [1, g] with
+        # w <= 2^-l: the compares are against exact power-of-two constants,
+        # so this equals the host's frexp-exact floor bit for bit (and needs
+        # no frexp/exp2, which don't lower on TPU under x64). w == 0 makes
+        # every compare true -> level g, the host's point-box rule.
+        w = maxs[0] - mins[0]
+        for d in range(1, self.dims):
+            w = jnp.maximum(w, maxs[d] - mins[d])
+        l1 = jnp.zeros(w.shape, dtype=jnp.int32)
+        for l in range(1, self.g + 1):
+            l1 = l1 + (w <= 2.0 ** -l).astype(jnp.int32)
+        # 0.5^k table gather: exact cell widths without a transcendental
+        pow_tbl = jnp.asarray(np.power(0.5, np.arange(self.g + 1)), w.dtype)
+        w2 = pow_tbl[jnp.minimum(l1 + 1, self.g)]
+        fits = jnp.ones(w.shape, dtype=bool)
+        for d in range(self.dims):
+            fits = fits & (
+                maxs[d] <= jnp.floor(mins[d] / w2) * w2 + 2 * w2
+            )
+        length = jnp.clip(
+            jnp.where((l1 < self.g) & fits, l1 + 1, l1), 0, self.g
+        )
+        # -- pre-order walk -------------------------------------------------
+        tbl_hi, tbl_lo = self._step_tables()
+        tbl_hi, tbl_lo = jnp.asarray(tbl_hi), jnp.asarray(tbl_lo)
+        point = mins
+        lo = jnp.zeros_like(point)
+        hi = jnp.ones_like(point)
+        cs_hi = jnp.zeros(point.shape[1], dtype=jnp.uint32)
+        cs_lo = jnp.zeros(point.shape[1], dtype=jnp.uint32)
+        for i in range(self.g):
+            active = i < length
+            center = (lo + hi) * 0.5
+            quad = jnp.zeros(point.shape[1], dtype=jnp.int32)
+            for d in range(self.dims):
+                quad = quad | ((point[d] >= center[d]).astype(jnp.int32) << d)
+            step_hi = tbl_hi[i][quad]
+            step_lo = tbl_lo[i][quad]
+            new_lo = cs_lo + step_lo
+            carry = (new_lo < cs_lo).astype(jnp.uint32)  # uint32 wrap
+            new_hi = cs_hi + step_hi + carry
+            cs_lo = jnp.where(active, new_lo, cs_lo)
+            cs_hi = jnp.where(active, new_hi, cs_hi)
+            upper = (
+                (quad[None, :] >> jnp.arange(self.dims)[:, None]) & 1
+            ) == 1
+            lo = jnp.where(active[None, :] & upper, center, lo)
+            hi = jnp.where(active[None, :] & ~upper, center, hi)
+        return cs_hi, cs_lo
 
     # -- query decomposition ----------------------------------------------
 

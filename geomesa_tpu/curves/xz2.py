@@ -46,6 +46,17 @@ class XZ2SFC:
         mins, maxs = self._windows(xmin, ymin, xmax, ymax)
         return self._xz.index(mins, maxs)
 
+    def index_jax_hi_lo(self, xmin, ymin, xmax, ymax):
+        """Device bbox encode -> (hi, lo) uint32 XZ2 code lanes."""
+        import jax.numpy as jnp
+
+        # divide (not multiply-by-reciprocal): bit-parity with host norm01
+        dx = self.x_hi - self.x_lo
+        dy = self.y_hi - self.y_lo
+        mins = jnp.stack([(xmin - self.x_lo) / dx, (ymin - self.y_lo) / dy])
+        maxs = jnp.stack([(xmax - self.x_lo) / dx, (ymax - self.y_lo) / dy])
+        return self._xz.index_jax_hi_lo(mins, maxs)
+
     def ranges(
         self, xmin, ymin, xmax, ymax, max_ranges: int = DEFAULT_MAX_RANGES
     ) -> list[IndexRange]:

@@ -49,6 +49,19 @@ class XZ3SFC:
         mins, maxs = self._windows(xmin, ymin, tmin, xmax, ymax, tmax)
         return self._xz.index(mins, maxs)
 
+    def index_jax_hi_lo(self, xmin, ymin, tmin, xmax, ymax, tmax):
+        """Device (bbox, offsets) encode -> (hi, lo) uint32 XZ3 lanes."""
+        import jax.numpy as jnp
+
+        # divide (not multiply-by-reciprocal): bit-parity with host norm01
+        mins = jnp.stack(
+            [(xmin + 180.0) / 360.0, (ymin + 90.0) / 180.0, tmin / self.t_max]
+        )
+        maxs = jnp.stack(
+            [(xmax + 180.0) / 360.0, (ymax + 90.0) / 180.0, tmax / self.t_max]
+        )
+        return self._xz.index_jax_hi_lo(mins, maxs)
+
     def ranges(
         self, xmin, ymin, tmin, xmax, ymax, tmax, max_ranges: int = DEFAULT_MAX_RANGES
     ) -> list[IndexRange]:

@@ -49,6 +49,11 @@ class Z2SFC:
         ny = self.lat.normalize_jax(y)
         return zorder.encode_2d_jax(nx, ny)
 
+    # uniform device-encode name across the SFC family (Z3/XZ2/XZ3 all
+    # expose index_jax_hi_lo; Z2's single device encode already returns the
+    # hi/lo pair)
+    index_jax_hi_lo = index_jax
+
     def ranges(
         self,
         xmin: float,

@@ -28,6 +28,18 @@ import numpy as np
 
 U = np.uint64
 
+
+def u64_hi_lo(v) -> "tuple[np.ndarray, np.ndarray]":
+    """uint64 value(s) -> (hi, lo) uint32 lane pair — THE 64-bit key lane
+    convention (TPU VPU has no 64-bit integer lanes); shared by key
+    staging, step tables, and scan-bound packing."""
+    v = np.asarray(v, dtype=np.uint64)
+    return (
+        (v >> U(32)).astype(np.uint32),
+        (v & U(0xFFFFFFFF)).astype(np.uint32),
+    )
+
+
 # ---------------------------------------------------------------------------
 # 2D (Z2): 31 bits/dim, magic-mask gather/scatter
 # ---------------------------------------------------------------------------

@@ -49,15 +49,17 @@ def build_index_device(
     partition_size: int = DEFAULT_PARTITION_SIZE,
     axis: str = "shard",
 ) -> BuiltIndex:
-    """Mesh-path index build for z3-family key spaces.
+    """Mesh-path index build for the spatial key spaces (z3/z2/xz3/xz2).
 
-    The z keys are encoded on device (hi/lo uint32 lanes), rows are
-    globally sorted across the mesh by (bin, z_hi, z_lo, row_id) via the
-    all_to_all splitter exchange -- the trailing row-id lane makes the
-    device sort stable over duplicate keys, so ties order exactly like
-    the host's stable lexsort and the resulting permutation materializes
-    the same sorted batch + partition manifest bit for bit. Overflow in
-    the exchange raises (a build must never silently lose rows).
+    The keys are encoded on device (hi/lo uint32 lanes; point schemas get
+    Morton z keys, non-point schemas the XZ extent codes of their geometry
+    envelopes), and rows are globally sorted across the mesh by
+    ([bin,] key_hi, key_lo, row_id) via the all_to_all splitter exchange
+    -- the trailing row-id lane makes the device sort stable over
+    duplicate keys, so ties order exactly like the host's stable lexsort
+    and the resulting permutation materializes the same sorted batch +
+    partition manifest bit for bit. Overflow in the exchange raises (a
+    build must never silently lose rows).
     """
     import jax
     import jax.numpy as jnp
@@ -71,62 +73,92 @@ def build_index_device(
     # with the host planner's ranges
     require_x64()
 
+    kind = keyspace.name
     sfc = getattr(keyspace, "sfc", None)
     if sfc is None or not hasattr(sfc, "index_jax_hi_lo"):
         raise ValueError(
             f"device build requires a key space with a hi/lo device encode; "
-            f"{keyspace.name!r} has none (use the host build)"
+            f"{kind!r} has none (use the host build)"
+        )
+    if kind not in ("z3", "z2", "xz3", "xz2"):
+        # the encode dispatch below is positional per kind; a custom key
+        # space with a device encode still needs a dispatch entry here
+        raise ValueError(
+            f"device build has no input dispatch for key space {kind!r} "
+            "(supported: z3/z2/xz3/xz2)"
         )
     n = len(batch)
     if n == 0:
         return build_index(keyspace, batch, partition_size)
 
     n_shards = mesh.shape[axis]
-    x, y = batch.point_coords(keyspace.geom_field)
-    ms = batch.column(keyspace.dtg_field)
-    b, off = to_binned_time(ms, keyspace.period)
-    if int(b.min()) < -_BIN_BIAS or int(b.max()) >= _BIN_BIAS - 1:
-        raise ValueError(
-            f"time bins [{b.min()}, {b.max()}] exceed the device-sortable "
-            "int32 range"
-        )
+    binned = kind in ("z3", "xz3")
+    if kind in ("z3", "z2"):
+        x, y = batch.point_coords(keyspace.geom_field)
+        coords = [np.asarray(x, np.float64), np.asarray(y, np.float64)]
+    else:
+        bb = batch.bboxes(keyspace.geom_field)
+        coords = [bb[:, k].astype(np.float64) for k in range(4)]
+    off = None
+    b = None
+    if binned:
+        ms = batch.column(keyspace.dtg_field)
+        b, off = to_binned_time(ms, keyspace.period)
+        off = np.asarray(off, np.float64)
+        if int(b.min()) < -_BIN_BIAS or int(b.max()) >= _BIN_BIAS - 1:
+            raise ValueError(
+                f"time bins [{b.min()}, {b.max()}] exceed the "
+                "device-sortable int32 range"
+            )
 
     pad = (-n) % n_shards
     if pad:
-        zf = np.zeros(pad)
-        x, y, off = (
-            np.concatenate([x, zf]),
-            np.concatenate([y, zf]),
-            np.concatenate([off, np.zeros(pad, dtype=off.dtype)]),
-        )
-        b = np.concatenate([b, np.zeros(pad, dtype=b.dtype)])
+        coords = [np.concatenate([c, np.zeros(pad)]) for c in coords]
+        if binned:
+            off = np.concatenate([off, np.zeros(pad)])
+            b = np.concatenate([b, np.zeros(pad, dtype=b.dtype)])
     valid = np.arange(n + pad) < n
     rid = np.arange(n + pad, dtype=np.uint32)
 
-    hi, lo = jax.jit(sfc.index_jax_hi_lo)(
-        jnp.asarray(x), jnp.asarray(y), jnp.asarray(off)
+    encode = jax.jit(sfc.index_jax_hi_lo)
+    if kind == "z3":
+        hi, lo = encode(*map(jnp.asarray, (*coords, off)))
+    elif kind == "z2":
+        hi, lo = encode(*map(jnp.asarray, coords))
+    elif kind == "xz3":
+        xmin, ymin, xmax, ymax = map(jnp.asarray, coords)
+        o = jnp.asarray(off)
+        hi, lo = encode(xmin, ymin, o, xmax, ymax, o)  # instantaneous rows
+    else:  # xz2
+        hi, lo = encode(*map(jnp.asarray, coords))
+
+    lanes = (hi, lo, jnp.asarray(rid))
+    if binned:
+        lanes = (jnp.asarray((b + _BIN_BIAS).astype(np.uint32)),) + lanes
+    sorted_lanes, _, v = distributed_sort(
+        mesh, lanes, axis=axis, valid=jnp.asarray(valid), on_overflow="raise"
     )
-    bin_lane = jnp.asarray((b + _BIN_BIAS).astype(np.uint32))
-    (kb, kh, kl, kr), _, v = distributed_sort(
-        mesh,
-        (bin_lane, hi, lo, jnp.asarray(rid)),
-        axis=axis,
-        valid=jnp.asarray(valid),
-        on_overflow="raise",
-    )
-    kb, kh, kl = np.asarray(kb), np.asarray(kh), np.asarray(kl)
     v = np.asarray(v)
+    kr = sorted_lanes[-1]
+    kh, kl = np.asarray(sorted_lanes[-3]), np.asarray(sorted_lanes[-2])
     order = np.asarray(kr)[v].astype(np.int64)
     if order.shape[0] != n:  # pragma: no cover - overflow already raises
         raise RuntimeError(
             f"device build lost rows: {order.shape[0]} of {n} survived"
         )
     sorted_batch = batch.take(order)
-    z = (kh.astype(np.uint64) << np.uint64(32)) | kl.astype(np.uint64)
+    key64 = (kh.astype(np.uint64) << np.uint64(32)) | kl.astype(np.uint64)
+    key_name = "z" if kind in ("z3", "z2") else "xz"
     sorted_keys = {
-        "bin": (kb[v].astype(np.int64) - _BIN_BIAS).astype(np.int32),
-        "z": z[v],
+        key_name: key64[v]
+        if kind in ("z3", "z2")
+        else key64[v].astype(np.int64)  # xz codes are int64 on the host
     }
+    if binned:
+        kb = np.asarray(sorted_lanes[0])
+        sorted_keys["bin"] = (kb[v].astype(np.int64) - _BIN_BIAS).astype(
+            np.int32
+        )
     partitions = make_partitions(
         keyspace, sorted_batch, sorted_keys, partition_size
     )

@@ -29,8 +29,8 @@ _LO32 = U(0xFFFFFFFF)
 
 
 def _hi_lo(v: np.ndarray) -> tuple[int, int]:
-    v = U(v)
-    return int(v >> U(32)), int(v & _LO32)
+    hi, lo = zorder.u64_hi_lo(v)
+    return int(hi), int(lo)
 
 
 def _dim_bounds(qlo: tuple, qhi: tuple, split, max_mask: int, n_dims: int):
@@ -136,6 +136,140 @@ def z3_query_bounds(
     if not bounds:  # empty/inverted window: zero bins, matches nothing
         return np.zeros((0, 3, 6), np.uint32), np.array([], np.int32)
     return np.stack(bounds), np.array(ids, np.int32)
+
+
+# -- XZ (extent-curve) key scans ---------------------------------------------
+#
+# XZ codes are pre-order tree walks, not Morton interleaves, so there is no
+# masked-compare trick: a query decomposes into a SMALL list of inclusive
+# [lo, hi] code ranges (budget-bounded, over-covering on truncation — see
+# curves/xz.py ranges()), and the device mask tests each row's hi/lo code
+# lanes against every range. R is static at trace time; pad with
+# never-matching entries (lo > hi) to bound recompiles.
+
+
+def xz_range_bounds(ranges) -> np.ndarray:
+    """IndexRange list -> (R, 4) uint32 rows [lo_hi, lo_lo, hi_hi, hi_lo]."""
+    out = np.empty((len(ranges), 4), np.uint32)
+    for i, r in enumerate(ranges):
+        out[i, 0:2] = _hi_lo(np.uint64(r.lower))
+        out[i, 2:4] = _hi_lo(np.uint64(r.upper))
+    return out
+
+
+_NEVER_RANGE = np.array(
+    [0xFFFFFFFF, 0xFFFFFFFF, 0, 0], np.uint32
+)  # lo = 2^64-1 > hi = 0: matches nothing
+
+
+def pad_ranges(bounds: np.ndarray, min_r: int = 1) -> np.ndarray:
+    """Pad the range axis (last-but-one) to a power of two with
+    never-matching entries so jit sees a bounded set of R shapes."""
+    r = bounds.shape[-2]
+    cap = max(min_r, 1 << max(r - 1, 0).bit_length())
+    if cap == r:
+        return bounds
+    pad_shape = bounds.shape[:-2] + (cap - r, 4)
+    return np.concatenate(
+        [bounds, np.broadcast_to(_NEVER_RANGE, pad_shape)], axis=-2
+    )
+
+
+def xz_range_mask(xz_hi, xz_lo, bounds):
+    """Boolean hit mask for unbinned XZ2 keys; bounds is (R, 4) uint32."""
+    m = None
+    for r in range(bounds.shape[0]):
+        mr = _ge64(xz_hi, xz_lo, bounds[r, 0], bounds[r, 1]) & _le64(
+            xz_hi, xz_lo, bounds[r, 2], bounds[r, 3]
+        )
+        m = mr if m is None else (m | mr)
+    return m
+
+
+def xz3_range_mask(xz_hi, xz_lo, bins, bounds, bin_ids):
+    """Boolean hit mask for binned XZ3 keys.
+
+    bounds: uint32 (B, R, 4) per-bin ranges; bin_ids: int32 (B,), -1 is
+    padding and never matches. B and R are static at trace time.
+    """
+    import jax.numpy as jnp
+
+    total = jnp.zeros(xz_hi.shape, bool)
+    for b in range(bounds.shape[0]):
+        total = total | (
+            (bins == bin_ids[b]) & xz_range_mask(xz_hi, xz_lo, bounds[b])
+        )
+    return total
+
+
+def xz2_query_bounds(
+    sfc, xmin: float, ymin: float, xmax: float, ymax: float,
+    max_ranges: int = 128,
+) -> np.ndarray:
+    """(R, 4) uint32 range bounds for one bbox (loose cell semantics: an
+    over-covering superset; truncation at max_ranges stays a superset)."""
+    return xz_range_bounds(sfc.ranges(xmin, ymin, xmax, ymax,
+                                      max_ranges=max_ranges))
+
+
+def xz3_query_bounds(
+    sfc,
+    xmin: float,
+    ymin: float,
+    xmax: float,
+    ymax: float,
+    tmin_ms: int,
+    tmax_ms: int,
+    max_ranges: int = 128,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(bounds (B, R, 4), bin_ids (B,)) for a bbox + absolute-ms window.
+
+    One entry per period bin, partial time extents on edge bins — the
+    XZ3 analog of :func:`z3_query_bounds`; interior whole-period bins
+    share one decomposition. Per-bin range lists are padded to a common R
+    with never-matching entries.
+    """
+    from geomesa_tpu.curves.binnedtime import bins_for_interval, max_offset
+
+    mx = max_offset(sfc.period)
+    per_bin: list = []
+    ids: list = []
+    whole_cache = None
+    for b, lo_off, hi_off in bins_for_interval(tmin_ms, tmax_ms, sfc.period):
+        whole = lo_off == 0 and hi_off == mx
+        if whole and whole_cache is not None:
+            rs = whole_cache
+        else:
+            rs = sfc.ranges(
+                np.array([xmin]), np.array([ymin]),
+                np.array([float(lo_off)]),
+                np.array([xmax]), np.array([ymax]),
+                np.array([float(hi_off)]),
+                max_ranges=max_ranges,
+            )
+            if whole:
+                whole_cache = rs
+        per_bin.append(xz_range_bounds(rs))
+        ids.append(b)
+    if not per_bin:
+        return np.zeros((0, 1, 4), np.uint32), np.array([], np.int32)
+    longest = max(len(p) for p in per_bin)
+    r_max = max(1, 1 << max(longest - 1, 0).bit_length())  # pow2 like pad_ranges
+    stacked = np.stack([pad_ranges(p, min_r=r_max) for p in per_bin])
+    return stacked, np.array(ids, np.int32)
+
+
+def kind_mask_fn(kind: str):
+    """Key-plane mask function for an index-key kind — the ONE dispatch
+    table shared by the direct loose path and the fused-stats closure
+    (binned kinds take (hi, lo, bins, bounds, ids); unbinned (hi, lo,
+    bounds))."""
+    return {
+        "z3": z3_zscan_mask,
+        "z2": z2_zscan_mask,
+        "xz3": xz3_range_mask,
+        "xz2": xz_range_mask,
+    }[kind]
 
 
 def build_z3_pallas_scan(

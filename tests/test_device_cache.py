@@ -651,3 +651,164 @@ def test_streaming_index_tracks_live_expiry():
     assert len(live) == 2  # triggers expiry + listener notification
     assert len(di) == 2, "device cache missed the expiry"
     assert di.count("INCLUDE") == 2
+
+
+# -- non-point (XZ extent-curve) resident serving ---------------------------
+
+POLY_SPEC = "name:String,dtg:Date,*geom:Polygon:srid=4326"
+
+
+def _poly_wkt(x, y, w, h):
+    return (
+        f"POLYGON (({x} {y}, {x + w} {y}, {x + w} {y + h}, "
+        f"{x} {y + h}, {x} {y}))"
+    )
+
+
+def _poly_store(n=4000, seed=7, with_time=True):
+    spec = POLY_SPEC if with_time else "name:String,*geom:Polygon:srid=4326"
+    ds = MemoryDataStore()
+    ds.create_schema("p", spec)
+    rng = np.random.default_rng(seed)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    cols = {
+        "name": rng.choice(["a", "b", "c"], n),
+        "geom": np.array(
+            [
+                _poly_wkt(
+                    rng.uniform(-170, 160),
+                    rng.uniform(-85, 75),
+                    rng.uniform(0.01, 5.0),
+                    rng.uniform(0.01, 5.0),
+                )
+                for _ in range(n)
+            ],
+            dtype=object,
+        ),
+    }
+    if with_time:
+        cols["dtg"] = rng.integers(t0, t1, n)
+    ds.write("p", cols, fids=np.arange(n))
+    return ds
+
+
+def test_nonpoint_stages_xz_key_planes():
+    from geomesa_tpu.device_cache import Z_BIN, Z_HI, Z_LO
+
+    ds = _poly_store(n=500)
+    di = DeviceIndex(ds, "p", z_planes=True)
+    assert di._z_kind == "xz3"
+    assert Z_BIN in di._cols and Z_HI in di._cols and Z_LO in di._cols
+    ds2 = _poly_store(n=500, with_time=False)
+    di2 = DeviceIndex(ds2, "p", z_planes=True)
+    assert di2._z_kind == "xz2"
+    assert Z_HI in di2._cols and Z_BIN not in di2._cols
+
+
+def test_nonpoint_loose_scan_is_superset_and_exact_query_matches():
+    """Loose xz mask: cell-granular superset of the exact bbox hits; the
+    exact (non-loose) path equals the store oracle."""
+    ds = _poly_store()
+    di = DeviceIndex(ds, "p", z_planes=True)
+    all_batch = ds.query("p").batch
+    ecql = (
+        "BBOX(geom, -5, 42, 8, 51) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-02-01T00:00:00Z"
+    )
+    expect = evaluate_host(parse_ecql(ecql), all_batch)
+    exact = di.count(ecql, loose=False)
+    assert exact == int(expect.sum())
+    loose = di.count(ecql, loose=True)
+    assert loose >= exact
+    lm = di.mask(ecql, loose=True)
+    em = di.mask(ecql, loose=False)
+    assert not np.any(em & ~lm), "loose xz mask dropped an exact hit"
+    # exact query results identical to the oracle
+    got = di.query(ecql, loose=False)
+    np.testing.assert_array_equal(
+        np.sort(got.fids), np.sort(all_batch.fids[expect])
+    )
+
+
+def test_nonpoint_xz2_loose_scan():
+    ds = _poly_store(with_time=False)
+    di = DeviceIndex(ds, "p", z_planes=True)
+    all_batch = ds.query("p").batch
+    ecql = "BBOX(geom, -5, 42, 8, 51)"
+    expect = evaluate_host(parse_ecql(ecql), all_batch)
+    assert di.count(ecql, loose=False) == int(expect.sum())
+    lm = di.mask(ecql, loose=True)
+    em = di.mask(ecql, loose=False)
+    assert lm.sum() >= em.sum()
+    assert not np.any(em & ~lm)
+    # pruning actually happens for a small window
+    assert lm.sum() < len(all_batch)
+
+
+def test_nonpoint_loose_stats_fused():
+    """Count stat through the fused loose path on xz key planes."""
+    ds = _poly_store()
+    di = DeviceIndex(ds, "p", z_planes=True)
+    ecql = (
+        "BBOX(geom, -5, 42, 8, 51) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-02-01T00:00:00Z"
+    )
+    seq = di.stats(ecql, "Count()", loose=True)
+    assert seq.stats[0].count == di.count(ecql, loose=True)
+
+
+def test_staging_device_encode_matches_numpy_oracle():
+    """VERDICT round-2 weak #4: staging encodes keys on DEVICE; planes
+    must be bit-identical to the host numpy oracle for every kind."""
+    from geomesa_tpu.device_cache import _z_planes_np
+
+    for mk, kind in [
+        (lambda: _store(n=3000), "z3"),
+        (lambda: _poly_store(n=1500), "xz3"),
+        (lambda: _poly_store(n=1500, with_time=False), "xz2"),
+    ]:
+        ds = mk()
+        tn = ds.type_names[0]
+        di = DeviceIndex(ds, tn, z_planes=True)
+        assert di._z_kind == kind
+        # the DEVICE path must have produced the planes: a latched fallback
+        # would make this parity test vacuously compare oracle to oracle
+        assert not di._z_encode_failed and di._z_encode_jit is not None
+        batch = ds.query(tn).batch
+        np_kind, np_planes = _z_planes_np(batch, di.sft)
+        assert np_kind == kind
+        for k, v in np_planes.items():
+            np.testing.assert_array_equal(
+                np.asarray(di._cols[k])[: len(batch)], v, err_msg=f"{kind}:{k}"
+            )
+
+
+def test_staging_device_encode_z2_and_x64_scoping():
+    """z2 staging parity + the scoped-x64 encode must not leak x64 into
+    the caller's config."""
+    import jax
+
+    from geomesa_tpu.device_cache import _z_planes_np
+
+    ds = MemoryDataStore()
+    ds.create_schema("z2t", "val:Int,*geom:Point:srid=4326")
+    rng = np.random.default_rng(3)
+    n = 2000
+    ds.write(
+        "z2t",
+        {
+            "val": rng.integers(0, 9, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+            ),
+        },
+    )
+    before = jax.config.jax_enable_x64
+    di = DeviceIndex(ds, "z2t", z_planes=True)
+    assert jax.config.jax_enable_x64 == before
+    assert di._z_kind == "z2"
+    batch = ds.query("z2t").batch
+    _, np_planes = _z_planes_np(batch, di.sft)
+    for k, v in np_planes.items():
+        np.testing.assert_array_equal(np.asarray(di._cols[k]), v)

@@ -118,3 +118,143 @@ class TestXZ3:
         codes = sfc.index(xmin, ymin, tmin, xmax, ymax, tmin + 100)
         ranges = sfc.ranges(-5.0, 42.0, 1000.0, 8.0, 51.0, 2000.0)
         assert np.mean(_covered(codes, ranges)) < 0.05
+
+
+def _u64(hi, lo):
+    return (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(
+        lo
+    ).astype(np.uint64)
+
+
+class TestDeviceEncode:
+    """index_jax_hi_lo must agree bit-for-bit with the host encode under
+    float64 (the CPU/x64 test platform; VERDICT round-2 item 1)."""
+
+    def test_xz2_parity_random(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        sfc = XZ2SFC()
+        xmin, ymin, xmax, ymax = _rand_boxes(rng, 50_000, -180, -90, 179, 89, 3.0)
+        xmax = np.minimum(xmax, 180.0)
+        ymax = np.minimum(ymax, 90.0)
+        host = sfc.index(xmin, ymin, xmax, ymax).astype(np.uint64)
+        hi, lo = jax.jit(sfc.index_jax_hi_lo)(
+            *map(jnp.asarray, (xmin, ymin, xmax, ymax))
+        )
+        np.testing.assert_array_equal(_u64(hi, lo), host)
+
+    def test_xz2_parity_adversarial(self):
+        import jax
+        import jax.numpy as jnp
+
+        sfc = XZ2SFC()
+        # degenerate points, whole world, exact power-of-two extents,
+        # lat/lon maxima
+        xmin = np.array([-180.0, 0.0, -180.0, 10.0, -45.0, 179.9])
+        ymin = np.array([-90.0, 0.0, -90.0, 10.0, -45.0, 89.9])
+        xmax = np.array(
+            [180.0, 0.0, -180.0 + 360.0 * 0.25, 10.0 + 360 * 2**-10,
+             -45.0 + 360 * 2**-12, 180.0]
+        )
+        ymax = np.array(
+            [90.0, 0.0, -90.0 + 180.0 * 0.25, 10.0 + 180 * 2**-10,
+             -45.0 + 180 * 2**-12, 90.0]
+        )
+        host = sfc.index(xmin, ymin, xmax, ymax).astype(np.uint64)
+        hi, lo = jax.jit(sfc.index_jax_hi_lo)(
+            *map(jnp.asarray, (xmin, ymin, xmax, ymax))
+        )
+        np.testing.assert_array_equal(_u64(hi, lo), host)
+
+    def test_xz3_parity_random(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        sfc = XZ3SFC()
+        xmin, ymin, xmax, ymax = _rand_boxes(rng, 50_000, -180, -90, 179, 89, 3.0)
+        xmax = np.minimum(xmax, 180.0)
+        ymax = np.minimum(ymax, 90.0)
+        tmin = rng.uniform(0, sfc.t_max, len(xmin))
+        tmax = np.minimum(
+            tmin + rng.uniform(0, sfc.t_max * 0.01, len(xmin)), sfc.t_max
+        )
+        host = sfc.index(xmin, ymin, tmin, xmax, ymax, tmax).astype(np.uint64)
+        hi, lo = jax.jit(sfc.index_jax_hi_lo)(
+            *map(jnp.asarray, (xmin, ymin, tmin, xmax, ymax, tmax))
+        )
+        np.testing.assert_array_equal(_u64(hi, lo), host)
+
+
+class TestDeviceRangeMask:
+    """The device xz key-range mask must agree with the host range cover
+    (same ranges, same codes) and keep the no-false-negative invariant."""
+
+    def test_xz2_mask_matches_host_cover(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        from geomesa_tpu.ops import zscan
+
+        sfc = XZ2SFC()
+        xmin, ymin, xmax, ymax = _rand_boxes(rng, 20_000, -20, 20, 30, 60, 2.0)
+        codes = sfc.index(xmin, ymin, xmax, ymax)
+        q = (-5.0, 42.0, 8.0, 51.0)
+        bounds = zscan.pad_ranges(zscan.xz2_query_bounds(sfc, *q))
+        hi = (codes.astype(np.uint64) >> np.uint64(32)).astype(np.uint32)
+        lo = (codes.astype(np.uint64) & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        m = np.asarray(
+            jax.jit(zscan.xz_range_mask)(
+                jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(bounds)
+            )
+        )
+        # no false negatives vs true box intersection
+        intersecting = (
+            (xmax >= q[0]) & (xmin <= q[2]) & (ymax >= q[1]) & (ymin <= q[3])
+        )
+        assert np.all(m[intersecting])
+        # the device mask equals the HOST cover for the same budgeted ranges
+        host_cover = _covered(
+            codes, sfc.ranges(*q, max_ranges=128)
+        )
+        np.testing.assert_array_equal(m, host_cover)
+        # and it prunes: far boxes mostly excluded
+        assert m.mean() < 0.5
+
+    def test_xz3_mask_binned(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        from geomesa_tpu.curves.binnedtime import to_binned_time
+        from geomesa_tpu.ops import zscan
+
+        sfc = XZ3SFC()
+        n = 20_000
+        xmin, ymin, xmax, ymax = _rand_boxes(rng, n, -20, 20, 30, 60, 2.0)
+        # ~5 weeks of instantaneous rows
+        ms = rng.integers(1_577_836_800_000, 1_580_860_800_000, n)
+        bins, off = to_binned_time(ms, sfc.period)
+        offf = off.astype(np.float64)
+        codes = sfc.index(xmin, ymin, offf, xmax, ymax, offf)
+        q = (-5.0, 42.0, 8.0, 51.0)
+        t0, t1 = 1_578_441_600_000, 1_580_256_000_000  # inner window
+        bounds, ids = zscan.xz3_query_bounds(sfc, *q, t0, t1)
+        bounds, ids = zscan.pad_bins(bounds, ids)
+        hi = (codes.astype(np.uint64) >> np.uint64(32)).astype(np.uint32)
+        lo = (codes.astype(np.uint64) & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        m = np.asarray(
+            jax.jit(zscan.xz3_range_mask)(
+                jnp.asarray(hi), jnp.asarray(lo),
+                jnp.asarray(bins.astype(np.int32)),
+                jnp.asarray(bounds), jnp.asarray(ids),
+            )
+        )
+        intersecting = (
+            (xmax >= q[0]) & (xmin <= q[2]) & (ymax >= q[1]) & (ymin <= q[3])
+            & (ms >= t0) & (ms <= t1)
+        )
+        assert intersecting.sum() > 0
+        assert np.all(m[intersecting]), "false negatives in device xz3 mask"
+        # rows entirely outside the time window's bins never match
+        outside_bins = ~np.isin(bins, ids[ids >= 0])
+        assert not np.any(m[outside_bins])

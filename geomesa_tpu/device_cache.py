@@ -50,53 +50,15 @@ Z_BIN, Z_HI, Z_LO = "__zbin", "__zhi", "__zlo"
 from geomesa_tpu.curves.zorder import u64_hi_lo as _split_u64
 
 
-def _z_schema_kind(sft: SimpleFeatureType):
-    """(kind, sfc) the schema's key planes use: z3/z2 for point geometries
-    (with/without a date field), xz3/xz2 extent curves for non-point ones,
-    (None, None) when the SFT has no geometry at all."""
-    from geomesa_tpu.curves.xz2 import XZ2SFC
-    from geomesa_tpu.curves.xz3 import XZ3SFC
-    from geomesa_tpu.curves.z2 import Z2SFC
-    from geomesa_tpu.curves.z3 import Z3SFC
-
-    geom = sft.geom_field
-    if geom is None:
-        return None, None
-    dtg = sft.dtg_field
-    if not sft.descriptor(geom).is_point:
-        # extent curve over the per-row geometry envelopes (ref XZ2/XZ3
-        # index key spaces are the non-point peers of Z2/Z3)
-        if dtg is not None:
-            return "xz3", XZ3SFC(g=sft.xz_precision)
-        return "xz2", XZ2SFC(sft.xz_precision)
-    if dtg is not None:
-        return "z3", Z3SFC()
-    return "z2", Z2SFC()
+from geomesa_tpu.index.keyplanes import (
+    encode_inputs as _encode_inputs_shared,
+    schema_kind as _z_schema_kind,
+)
 
 
 def _encode_inputs(batch, sft: SimpleFeatureType, kind, sfc):
-    """(coords, bins) host-side encode inputs for a batch: float64 coord
-    arrays in the sfc's positional encode order, plus the int32 period-bin
-    plane (or None for unbinned kinds). Time offsets ride inside coords."""
-    from geomesa_tpu.curves.binnedtime import to_binned_time
-
-    geom = sft.geom_field
-    bins = None
-    if kind in ("z3", "z2"):
-        x, y = batch.point_coords(geom)
-        coords = [np.asarray(x, np.float64), np.asarray(y, np.float64)]
-        if kind == "z3":
-            bins, off = to_binned_time(batch.column(sft.dtg_field), sfc.period)
-            coords.append(np.asarray(off, np.float64))
-    else:
-        bb = batch.bboxes(geom)
-        if kind == "xz3":
-            bins, off = to_binned_time(batch.column(sft.dtg_field), sfc.period)
-            offf = np.asarray(off, np.float64)
-            coords = [bb[:, 0], bb[:, 1], offf, bb[:, 2], bb[:, 3], offf]
-        else:
-            coords = [bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3]]
-    return coords, bins
+    return _encode_inputs_shared(batch, kind, sfc, sft.geom_field,
+                                 sft.dtg_field)
 
 
 def _z_planes_np(batch, sft: SimpleFeatureType):
@@ -212,7 +174,18 @@ class DeviceIndex:
                         self._z_encode_jit = jax.jit(sfc.index_jax_hi_lo)
                     hi, lo = self._z_encode_jit(*map(jnp.asarray, coords))
                     hi.block_until_ready()
-            except Exception:  # pragma: no cover - platform-dependent (no f64)
+            except Exception as e:  # pragma: no cover - platform (no f64)
+                import warnings
+
+                # loud latch: a silent fallback would hide a real device-
+                # encode regression behind the slow host pass it replaces
+                warnings.warn(
+                    f"device key encode unavailable ({type(e).__name__}: "
+                    f"{e}); staging falls back to the host encode for "
+                    "this index",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
                 self._z_encode_failed = True
                 self._z_encode_jit = None
                 hi, lo = _split_u64(np.asarray(sfc.index(*coords)))
@@ -291,10 +264,6 @@ class DeviceIndex:
     def _loose_bounds_uncached(self, f):
         import jax.numpy as jnp
 
-        from geomesa_tpu.curves.xz2 import XZ2SFC
-        from geomesa_tpu.curves.xz3 import XZ3SFC
-        from geomesa_tpu.curves.z2 import Z2SFC
-        from geomesa_tpu.curves.z3 import Z3SFC
         from geomesa_tpu.ops import zscan
 
         if self._z_kind is None:
@@ -305,26 +274,24 @@ class DeviceIndex:
         env, window = parts
         if env is None and window is None:
             return None  # INCLUDE: nothing to prune, use the normal path
+        # the SAME sfc the key planes were staged with (one dispatch table;
+        # a different curve here would silently break the loose-superset
+        # invariant)
+        _, sfc = _z_schema_kind(self.sft)
         if self._z_kind == "z2":
             if window is not None:
                 return None  # no time in the key
-            sfc = Z2SFC()
             qlo = (int(sfc.lon.normalize(env[0])), int(sfc.lat.normalize(env[1])))
             qhi = (int(sfc.lon.normalize(env[2])), int(sfc.lat.normalize(env[3])))
             return jnp.asarray(zscan.z2_dim_bounds(qlo, qhi)), None
         if self._z_kind == "xz2":
             if window is not None:
                 return None  # no time in the key
-            sfc = XZ2SFC(self.sft.xz_precision)
             bounds = zscan.pad_ranges(
                 zscan.xz2_query_bounds(sfc, env[0], env[1], env[2], env[3])
             )
             return jnp.asarray(bounds), None
-        binned_sfc = (
-            Z3SFC()
-            if self._z_kind == "z3"
-            else XZ3SFC(g=self.sft.xz_precision)
-        )
+        binned_sfc = sfc
         if env is None:
             env = (-180.0, -90.0, 180.0, 90.0)
         if window is None:

@@ -64,7 +64,6 @@ def build_index_device(
     import jax
     import jax.numpy as jnp
 
-    from geomesa_tpu.curves.binnedtime import to_binned_time
     from geomesa_tpu.jaxconf import require_x64
     from geomesa_tpu.parallel.dist import distributed_sort
 
@@ -93,44 +92,31 @@ def build_index_device(
 
     n_shards = mesh.shape[axis]
     binned = kind in ("z3", "xz3")
-    if kind in ("z3", "z2"):
-        x, y = batch.point_coords(keyspace.geom_field)
-        coords = [np.asarray(x, np.float64), np.asarray(y, np.float64)]
-    else:
-        bb = batch.bboxes(keyspace.geom_field)
-        coords = [bb[:, k].astype(np.float64) for k in range(4)]
-    off = None
-    b = None
-    if binned:
-        ms = batch.column(keyspace.dtg_field)
-        b, off = to_binned_time(ms, keyspace.period)
-        off = np.asarray(off, np.float64)
-        if int(b.min()) < -_BIN_BIAS or int(b.max()) >= _BIN_BIAS - 1:
-            raise ValueError(
-                f"time bins [{b.min()}, {b.max()}] exceed the "
-                "device-sortable int32 range"
-            )
+    # one shared kind-dispatch for encode-input marshaling (same table the
+    # resident cache stages with, so build and staging cannot drift)
+    from geomesa_tpu.index.keyplanes import encode_inputs
+
+    coords, b = encode_inputs(
+        batch, kind, sfc, keyspace.geom_field,
+        getattr(keyspace, "dtg_field", None),
+    )
+    if binned and (
+        int(b.min()) < -_BIN_BIAS or int(b.max()) >= _BIN_BIAS - 1
+    ):
+        raise ValueError(
+            f"time bins [{b.min()}, {b.max()}] exceed the "
+            "device-sortable int32 range"
+        )
 
     pad = (-n) % n_shards
     if pad:
         coords = [np.concatenate([c, np.zeros(pad)]) for c in coords]
         if binned:
-            off = np.concatenate([off, np.zeros(pad)])
             b = np.concatenate([b, np.zeros(pad, dtype=b.dtype)])
     valid = np.arange(n + pad) < n
     rid = np.arange(n + pad, dtype=np.uint32)
 
-    encode = jax.jit(sfc.index_jax_hi_lo)
-    if kind == "z3":
-        hi, lo = encode(*map(jnp.asarray, (*coords, off)))
-    elif kind == "z2":
-        hi, lo = encode(*map(jnp.asarray, coords))
-    elif kind == "xz3":
-        xmin, ymin, xmax, ymax = map(jnp.asarray, coords)
-        o = jnp.asarray(off)
-        hi, lo = encode(xmin, ymin, o, xmax, ymax, o)  # instantaneous rows
-    else:  # xz2
-        hi, lo = encode(*map(jnp.asarray, coords))
+    hi, lo = jax.jit(sfc.index_jax_hi_lo)(*map(jnp.asarray, coords))
 
     lanes = (hi, lo, jnp.asarray(rid))
     if binned:

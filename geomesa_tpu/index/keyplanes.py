@@ -1,0 +1,67 @@
+"""Schema -> index-key encode plumbing shared by the resident cache and
+the device index build.
+
+One kind-dispatch table for the four spatial key spaces (z3/z2 Morton for
+point geometries, xz3/xz2 extent curves for non-point) so the staging path
+(device_cache) and the mesh build path (index/build) cannot drift on
+encode-input marshaling. (ref: the Z3/Z2/XZ3/XZ2 IndexKeySpace family,
+SURVEY section 2.1 [UNVERIFIED - empty reference mount]).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.features.sft import SimpleFeatureType
+
+
+def schema_kind(sft: SimpleFeatureType):
+    """(kind, sfc) the schema's key planes use: z3/z2 for point geometries
+    (with/without a date field), xz3/xz2 extent curves for non-point ones,
+    (None, None) when the SFT has no geometry at all."""
+    from geomesa_tpu.curves.xz2 import XZ2SFC
+    from geomesa_tpu.curves.xz3 import XZ3SFC
+    from geomesa_tpu.curves.z2 import Z2SFC
+    from geomesa_tpu.curves.z3 import Z3SFC
+
+    geom = sft.geom_field
+    if geom is None:
+        return None, None
+    dtg = sft.dtg_field
+    if not sft.descriptor(geom).is_point:
+        # extent curve over the per-row geometry envelopes (ref XZ2/XZ3
+        # index key spaces are the non-point peers of Z2/Z3)
+        if dtg is not None:
+            return "xz3", XZ3SFC(g=sft.xz_precision)
+        return "xz2", XZ2SFC(sft.xz_precision)
+    if dtg is not None:
+        return "z3", Z3SFC()
+    return "z2", Z2SFC()
+
+
+def encode_inputs(batch, kind: str, sfc, geom_field: str, dtg_field=None):
+    """(coords, bins) host-side encode inputs for a batch: float64 coord
+    arrays in the sfc's positional encode order (``sfc.index(*coords)`` ==
+    ``sfc.index_jax_hi_lo(*coords)`` input contract), plus the int32
+    period-bin plane (or None for unbinned kinds). Time offsets ride
+    inside coords; geometry envelope extraction and time binning stay on
+    host (cheap vectorized passes; geometry parsing is host-side anyway).
+    """
+    from geomesa_tpu.curves.binnedtime import to_binned_time
+
+    bins = None
+    if kind in ("z3", "z2"):
+        x, y = batch.point_coords(geom_field)
+        coords = [np.asarray(x, np.float64), np.asarray(y, np.float64)]
+        if kind == "z3":
+            bins, off = to_binned_time(batch.column(dtg_field), sfc.period)
+            coords.append(np.asarray(off, np.float64))
+    else:
+        bb = batch.bboxes(geom_field)
+        if kind == "xz3":
+            bins, off = to_binned_time(batch.column(dtg_field), sfc.period)
+            offf = np.asarray(off, np.float64)
+            coords = [bb[:, 0], bb[:, 1], offf, bb[:, 2], bb[:, 3], offf]
+        else:
+            coords = [bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3]]
+    return coords, bins

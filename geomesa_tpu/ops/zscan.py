@@ -176,21 +176,25 @@ def pad_ranges(bounds: np.ndarray, min_r: int = 1) -> np.ndarray:
 
 
 def xz_range_mask(xz_hi, xz_lo, bounds):
-    """Boolean hit mask for unbinned XZ2 keys; bounds is (R, 4) uint32."""
-    m = None
-    for r in range(bounds.shape[0]):
-        mr = _ge64(xz_hi, xz_lo, bounds[r, 0], bounds[r, 1]) & _le64(
-            xz_hi, xz_lo, bounds[r, 2], bounds[r, 3]
-        )
-        m = mr if m is None else (m | mr)
-    return m
+    """Boolean hit mask for unbinned XZ2 keys; bounds is (R, 4) uint32.
+
+    One broadcasted compare over the range axis (not a Python unroll):
+    the (R, n) intermediates fuse into the reduction, and the trace stays
+    O(1) nodes regardless of R."""
+    import jax.numpy as jnp
+
+    zh, zl = xz_hi[None, :], xz_lo[None, :]
+    ge = _ge64(zh, zl, bounds[:, 0:1], bounds[:, 1:2])
+    le = _le64(zh, zl, bounds[:, 2:3], bounds[:, 3:4])
+    return jnp.any(ge & le, axis=0)
 
 
 def xz3_range_mask(xz_hi, xz_lo, bins, bounds, bin_ids):
     """Boolean hit mask for binned XZ3 keys.
 
     bounds: uint32 (B, R, 4) per-bin ranges; bin_ids: int32 (B,), -1 is
-    padding and never matches. B and R are static at trace time.
+    padding and never matches. The bin axis unrolls (B <= 64, typically
+    <= 8); the range axis is one broadcasted compare per bin.
     """
     import jax.numpy as jnp
 

@@ -22,6 +22,7 @@ from geomesa_tpu.parallel.dist import (
     distributed_sort,
     distributed_z3_sort,
     sharded_build_and_query_step,
+    sharded_query_scan,
 )
 from geomesa_tpu.parallel.multihost import (
     global_mesh,
@@ -35,6 +36,7 @@ __all__ = [
     "distributed_sort",
     "distributed_z3_sort",
     "sharded_build_and_query_step",
+    "sharded_query_scan",
     "initialize",
     "global_mesh",
     "host_batches_to_global",

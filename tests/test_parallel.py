@@ -77,8 +77,10 @@ def test_full_build_and_query_step(mesh, rng):
     t = rng.uniform(0, 604800, n)
     sfc = Z3SFC()
     bounds = (-10.0, 0.0, 30.0, 40.0, 10000.0, 300000.0)
-    sh, sl, sv, count = sharded_build_and_query_step(
-        mesh, sfc, jnp.asarray(x), jnp.asarray(y), jnp.asarray(t), bounds
+    sh, sl, sv, count, key_count, hit_rids, hit_valid = (
+        sharded_build_and_query_step(
+            mesh, sfc, jnp.asarray(x), jnp.asarray(y), jnp.asarray(t), bounds
+        )
     )
     expected = int(
         (
@@ -99,6 +101,63 @@ def test_full_build_and_query_step(mesh, rng):
     )
     # global order: concatenation of shards ascending
     np.testing.assert_array_equal(np.sort(z_dev), z_host)
+    # the query THROUGH the sorted index returns the exact row-id set the
+    # quantized-cell host oracle predicts (weak #6: key corruption in the
+    # exchange would change this set)
+    nx = sfc.lon.normalize(x).astype(np.int64)
+    ny = sfc.lat.normalize(y).astype(np.int64)
+    nt = sfc.time.normalize(t).astype(np.int64)
+    cell = (
+        (nx >= int(sfc.lon.normalize(bounds[0])))
+        & (nx <= int(sfc.lon.normalize(bounds[2])))
+        & (ny >= int(sfc.lat.normalize(bounds[1])))
+        & (ny <= int(sfc.lat.normalize(bounds[3])))
+        & (nt >= int(sfc.time.normalize(bounds[4])))
+        & (nt <= int(sfc.time.normalize(bounds[5])))
+    )
+    got = np.asarray(hit_rids)[np.asarray(hit_valid)]
+    assert int(key_count) == int(cell.sum()) == len(got)
+    np.testing.assert_array_equal(np.sort(got), np.nonzero(cell)[0])
+
+
+def test_sharded_query_scan_returns_features(mesh, rng):
+    """The mesh-wide scan streams back row ids AND payload columns, not a
+    count (the BatchScanPlan analog); truncation is loud."""
+    import jax.numpy as jnp
+    import pytest
+
+    from geomesa_tpu.parallel import sharded_query_scan
+
+    n = 8 * 512
+    x = rng.uniform(-180, 180, n)
+    val = rng.integers(0, 1000, n).astype(np.int32)
+    rid = np.arange(n, dtype=np.uint32)
+    fn = lambda local: (local["x"] >= 0) & (local["x"] <= 20)  # noqa: E731
+    ids, valid, pay, total = sharded_query_scan(
+        make_mesh(8),
+        fn,
+        {"x": jnp.asarray(x)},
+        jnp.asarray(rid),
+        payload={"val": jnp.asarray(val)},
+    )
+    expect = (x >= 0) & (x <= 20)
+    got_ids = np.asarray(ids)[np.asarray(valid)]
+    assert int(total) == int(expect.sum()) == len(got_ids)
+    np.testing.assert_array_equal(np.sort(got_ids), np.nonzero(expect)[0])
+    # payload rows ride aligned with their ids
+    got_val = np.asarray(pay["val"])[np.asarray(valid)]
+    order = np.argsort(got_ids)
+    np.testing.assert_array_equal(got_val[order], val[expect])
+    # a tiny cap truncates loudly
+    with pytest.raises(RuntimeError, match="truncated"):
+        sharded_query_scan(
+            make_mesh(8),
+            fn,
+            {"x": jnp.asarray(x)},
+            jnp.asarray(rid),
+            cap_per_shard=1,
+            payload={"val": jnp.asarray(val)},
+        )
 
 
 def test_sampled_splitters_survive_skew(mesh):
@@ -518,3 +577,50 @@ def test_device_index_build_z2_matches_host(mesh):
     dev = build_index_device(ks, batch, mesh, partition_size=1024)
     np.testing.assert_array_equal(dev.keys["z"], host.keys["z"])
     np.testing.assert_array_equal(dev.batch.fids, host.batch.fids)
+
+
+def test_exchange_at_scale_adversarial_layouts(mesh):
+    """VERDICT round-2 weak #2: the capacity math (dist.py) proven beyond
+    toy n — ~2^22 rows over 8 virtual devices, adversarial layouts
+    (uniform, pre-sorted, all-duplicate, hot-cluster), ZERO overflow at
+    the default capacity factor, and bounded wall clock."""
+    import time
+
+    import jax.numpy as jnp
+
+    from geomesa_tpu.parallel import distributed_sort
+
+    n = 1 << 22
+    rng_ = np.random.default_rng(11)
+    uniform = rng_.integers(0, 1 << 32, n, dtype=np.uint64).astype(np.uint32)
+    layouts = {
+        "uniform": uniform,
+        "presorted": np.sort(uniform),
+        "all-duplicate": np.full(n, 0x1234ABCD, np.uint32),
+        # hot cluster: 90% of rows in one tiny key neighborhood (GDELT
+        # city-cluster skew, SURVEY hard part #5)
+        "clustered": np.where(
+            rng_.random(n) < 0.9,
+            (0x40000000 + rng_.integers(0, 1024, n)).astype(np.uint32),
+            uniform,
+        ),
+    }
+    lo_lane = rng_.integers(0, 1 << 32, n, dtype=np.uint64).astype(np.uint32)
+    for name, hi_lane in layouts.items():
+        t0 = time.perf_counter()
+        (sh, sl), _, v = distributed_sort(
+            mesh,
+            (jnp.asarray(hi_lane), jnp.asarray(lo_lane)),
+            on_overflow="raise",  # zero overflow at DEFAULT capacity
+        )
+        sh = np.asarray(sh)
+        sv = np.asarray(v)
+        dt = time.perf_counter() - t0
+        assert sv.sum() == n, f"{name}: lost rows"
+        z = np.asarray(sh)[sv]
+        # shard concatenation is globally sorted on the hi lane
+        assert np.all(np.diff(z.astype(np.int64)) >= 0), f"{name}: unsorted"
+        # wall-clock bound: generous (covers jit compile + loaded CI
+        # hosts) but still catches a degenerated exchange, which would
+        # take many minutes at this size
+        assert dt < 300, f"{name}: exchange took {dt:.1f}s"

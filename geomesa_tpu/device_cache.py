@@ -504,20 +504,6 @@ class DeviceIndex:
 
         seq = parse_stat(spec)
         f = self._parse(query)
-        kind = None
-        lb = None
-        if self._resolve_loose(loose):
-            lb = self._loose_bounds(f)
-            if lb is not None:
-                kind = "loose"
-        compiled = None
-        if kind is None:
-            compiled, cfn, _ = self._compiled_for(f)
-            if compiled.device_cols and compiled.fully_on_device and cfn:
-                kind = "exact"
-            else:
-                seq.observe_batch(self.query(f, loose=loose))
-                return seq
 
         device_parts, host_parts = [], []
         for s in seq.stats:
@@ -539,8 +525,11 @@ class DeviceIndex:
         if self._staged_len() == 0:
             return seq  # nothing staged: zero-size reductions have no identity
         outs = self._stats_fused(
-            f, kind, lb, compiled, device_parts, need_mask=bool(host_parts)
+            f, loose, device_parts, need_mask=bool(host_parts)
         )
+        if outs is None:  # filter not fully device-expressible
+            seq.observe_batch(self.query(f, loose=loose))
+            return seq
         n_hits = int(outs["__count"])
         for i, (tag, s) in enumerate(device_parts):
             if tag == "count":
@@ -572,28 +561,44 @@ class DeviceIndex:
                 _observe_on_batch(s, rows)
         return seq
 
-    def _stats_fused(self, f, kind, lb, compiled, device_parts, need_mask):
-        """Run (or reuse) the single fused jit for this (filter, parts)
-        pair: mask + every device reduction in one dispatch."""
+    def _fused_agg(self, f, loose, agg_key, agg_build, extra=()):
+        """The pushdown-aggregation hook: ONE device dispatch computing
+        the filter mask (exact compiled predicate, or the loose key-plane
+        compare) fused with an arbitrary aggregation over the resident
+        columns — the generalized form of the reference's server-side
+        iterators (StatsIterator / DensityIterator / BinAggregating-
+        Iterator all aggregate next to the data without shipping
+        features). ``agg_build(cols, mask) -> dict of outputs`` runs
+        inside the jit; the compiled dispatch is cached per
+        (filter, kind, agg_key). ``extra`` is a tuple of RUNTIME device
+        arrays forwarded to ``agg_build(cols, mask, *extra)`` — values
+        that vary per call (e.g. a density viewport) belong there, not in
+        the closure/cache key, or every distinct value pays a recompile.
+        Returns the outputs dict, or None when the filter is not fully
+        device-expressible (caller falls back to a host path)."""
         import jax
-        import jax.numpy as jnp
 
-        if not hasattr(self, "_stats_cache"):
-            self._stats_cache = {}
-        part_key = tuple(
-            (tag, s.attr if hasattr(s, "attr") else "",
-             getattr(s, "bins", 0), getattr(s, "lo", 0.0),
-             getattr(s, "hi", 0.0))
-            for tag, s in device_parts
-        )
-        key = (repr(f), kind, part_key, need_mask)
-        cached = self._stats_cache.get(key)
+        kind = None
+        lb = None
+        if self._resolve_loose(loose):
+            lb = self._loose_bounds(f)
+            if lb is not None:
+                kind = "loose"
+        compiled = None
+        if kind is None:
+            compiled, cfn, _ = self._compiled_for(f)
+            if compiled.device_cols and compiled.fully_on_device and cfn:
+                kind = "exact"
+            else:
+                return None
+        if not hasattr(self, "_agg_cache"):
+            self._agg_cache = {}
+        key = (repr(f), kind, agg_key)
+        cached = self._agg_cache.get(key)
         if cached is None:
-            parts_spec = part_key
-
             z_kind = self._z_kind
 
-            def fused(cols, mask_args, valid):
+            def fused(cols, mask_args, valid, extra_args):
                 if kind == "loose":
                     from geomesa_tpu.ops import zscan
 
@@ -609,70 +614,180 @@ class DeviceIndex:
                     m = compiled.device_fn(cols)
                 if valid is not None:
                     m = m & valid
-                out = {"__count": jnp.sum(m, dtype=jnp.int32)}
-                if need_mask:
-                    out["__mask"] = m
-                # outputs keyed by PART INDEX: two stats over the same
-                # attribute (e.g. histograms with different bin params)
-                # must not collide on one output slot
-                for i, (tag, attr, bins, lo, hi) in enumerate(parts_spec):
-                    if tag == "minmax" and f"{attr}__hi" in cols:
-                        vhi, vlo = cols[f"{attr}__hi"], cols[f"{attr}__lo"]
-                        i32mx, i32mn = jnp.int32(2**31 - 1), jnp.int32(-(2**31))
-                        mnhi = jnp.min(jnp.where(m, vhi, i32mx))
-                        mxhi = jnp.max(jnp.where(m, vhi, i32mn))
-                        u32mx = jnp.uint32(0xFFFFFFFF)
-                        mnlo = jnp.min(
-                            jnp.where(m & (vhi == mnhi), vlo, u32mx)
-                        )
-                        mxlo = jnp.max(
-                            jnp.where(m & (vhi == mxhi), vlo, jnp.uint32(0))
-                        )
-                        out[f"{i}__mnhi"] = mnhi
-                        out[f"{i}__mnlo"] = mnlo
-                        out[f"{i}__mxhi"] = mxhi
-                        out[f"{i}__mxlo"] = mxlo
-                    elif tag == "minmax":
-                        v = cols[attr]
-                        big = (
-                            jnp.inf
-                            if v.dtype.kind == "f"
-                            else jnp.iinfo(v.dtype).max
-                        )
-                        small = (
-                            -jnp.inf
-                            if v.dtype.kind == "f"
-                            else jnp.iinfo(v.dtype).min
-                        )
-                        out[f"{i}__mn"] = jnp.min(jnp.where(m, v, big))
-                        out[f"{i}__mx"] = jnp.max(jnp.where(m, v, small))
-                    elif tag == "hist":
-                        # bin in the widest float available so the edges
-                        # match the host Histogram.bin_of (float64 under
-                        # x64/CPU; float32 is the TPU storage precision)
-                        wide = (
-                            jnp.float64
-                            if jax.config.jax_enable_x64
-                            else jnp.float32
-                        )
-                        v = cols[attr].astype(wide)
-                        scale = bins / (hi - lo) if hi > lo else 0.0
-                        idx = jnp.clip(
-                            jnp.floor((v - lo) * scale).astype(jnp.int32),
-                            0,
-                            bins - 1,
-                        )
-                        out[f"{i}__hist"] = (
-                            jnp.zeros(bins, jnp.int32)
-                            .at[idx]
-                            .add(m.astype(jnp.int32))
-                        )
-                return out
+                return agg_build(cols, m, *extra_args)
 
-            cached = jax.jit(fused, static_argnames=())
-            self._stats_cache[key] = cached
-        mask_args = lb if kind == "loose" else None
-        return cached(self._cols, mask_args, self._device_valid())
+            cached = jax.jit(fused)
+            self._agg_cache[key] = cached
+        return cached(
+            self._cols,
+            lb if kind == "loose" else None,
+            self._device_valid(),
+            extra,
+        )
+
+    def _stats_fused(self, f, loose, device_parts, need_mask):
+        """Stat-DSL reductions on the pushdown hook: mask + every device
+        reduction in one dispatch (None = caller falls back to host)."""
+        import jax
+        import jax.numpy as jnp
+
+        parts_spec = tuple(
+            (tag, s.attr if hasattr(s, "attr") else "",
+             getattr(s, "bins", 0), getattr(s, "lo", 0.0),
+             getattr(s, "hi", 0.0))
+            for tag, s in device_parts
+        )
+
+        def agg_build(cols, m):
+            out = {"__count": jnp.sum(m, dtype=jnp.int32)}
+            if need_mask:
+                out["__mask"] = m
+            # outputs keyed by PART INDEX: two stats over the same
+            # attribute (e.g. histograms with different bin params)
+            # must not collide on one output slot
+            for i, (tag, attr, bins, lo, hi) in enumerate(parts_spec):
+                if tag == "minmax" and f"{attr}__hi" in cols:
+                    vhi, vlo = cols[f"{attr}__hi"], cols[f"{attr}__lo"]
+                    i32mx, i32mn = jnp.int32(2**31 - 1), jnp.int32(-(2**31))
+                    mnhi = jnp.min(jnp.where(m, vhi, i32mx))
+                    mxhi = jnp.max(jnp.where(m, vhi, i32mn))
+                    u32mx = jnp.uint32(0xFFFFFFFF)
+                    mnlo = jnp.min(
+                        jnp.where(m & (vhi == mnhi), vlo, u32mx)
+                    )
+                    mxlo = jnp.max(
+                        jnp.where(m & (vhi == mxhi), vlo, jnp.uint32(0))
+                    )
+                    out[f"{i}__mnhi"] = mnhi
+                    out[f"{i}__mnlo"] = mnlo
+                    out[f"{i}__mxhi"] = mxhi
+                    out[f"{i}__mxlo"] = mxlo
+                elif tag == "minmax":
+                    v = cols[attr]
+                    big = (
+                        jnp.inf
+                        if v.dtype.kind == "f"
+                        else jnp.iinfo(v.dtype).max
+                    )
+                    small = (
+                        -jnp.inf
+                        if v.dtype.kind == "f"
+                        else jnp.iinfo(v.dtype).min
+                    )
+                    out[f"{i}__mn"] = jnp.min(jnp.where(m, v, big))
+                    out[f"{i}__mx"] = jnp.max(jnp.where(m, v, small))
+                elif tag == "hist":
+                    # bin in the widest float available so the edges
+                    # match the host Histogram.bin_of (float64 under
+                    # x64/CPU; float32 is the TPU storage precision)
+                    wide = (
+                        jnp.float64
+                        if jax.config.jax_enable_x64
+                        else jnp.float32
+                    )
+                    v = cols[attr].astype(wide)
+                    scale = bins / (hi - lo) if hi > lo else 0.0
+                    idx = jnp.clip(
+                        jnp.floor((v - lo) * scale).astype(jnp.int32),
+                        0,
+                        bins - 1,
+                    )
+                    out[f"{i}__hist"] = (
+                        jnp.zeros(bins, jnp.int32)
+                        .at[idx]
+                        .add(m.astype(jnp.int32))
+                    )
+            return out
+
+        part_key = ("stats", parts_spec, need_mask)
+        return self._fused_agg(f, loose, part_key, agg_build)
+
+    # -- pushdown density + BIN (Density/BinAggregating iterator analogs) --
+
+    def density(
+        self,
+        query,
+        envelope,
+        width: int,
+        height: int,
+        weight_attr: "str | None" = None,
+        loose: "bool | None" = None,
+    ) -> "np.ndarray | None":
+        """Fused density rasterization: filter mask + pixel scatter-add in
+        ONE device dispatch — no feature batch is ever materialized (ref
+        DensityIterator aggregates next to the data). Returns a
+        (height, width) float32 grid, or None when the filter or the
+        needed planes are not device-resident (caller falls back to the
+        store path)."""
+        import jax.numpy as jnp
+
+        from geomesa_tpu.process.density import _pixel_ids
+
+        geom = self.sft.geom_field
+        gx, gy = f"{geom}__x", f"{geom}__y"
+        if gx not in self._cols or gy not in self._cols:
+            return None  # non-point (or unstaged) geometry: host path
+        if weight_attr is not None and weight_attr not in self._cols:
+            return None
+        f = self._parse(query)
+
+        def agg_build(cols, m, env_arr):
+            px, py, inside = _pixel_ids(
+                cols[gx], cols[gy], env_arr, width, height, jnp
+            )
+            w = (
+                cols[weight_attr].astype(jnp.float32)
+                if weight_attr
+                else jnp.float32(1.0)
+            )
+            contrib = jnp.where(m & inside, w, jnp.float32(0.0))
+            grid = jnp.zeros(height * width, jnp.float32)
+            return {
+                "grid": grid.at[py * width + px]
+                .add(contrib)
+                .reshape(height, width)
+            }
+
+        # the viewport is a RUNTIME argument: one compiled kernel per
+        # (filter, width, height) serves every bbox a panning map client
+        # sends, instead of a recompile + retained cache entry per bbox
+        env_arr = jnp.asarray(
+            [envelope.xmin, envelope.ymin, envelope.xmax, envelope.ymax]
+        )
+        outs = self._fused_agg(
+            f, loose, ("density", width, height, weight_attr),
+            agg_build, extra=(env_arr,),
+        )
+        return None if outs is None else np.asarray(outs["grid"])
+
+    def bin_export(
+        self,
+        query,
+        track_attr: str,
+        dtg_attr: "str | None" = None,
+        geom_attr: "str | None" = None,
+        label_attr: "str | None" = None,
+        sort: bool = False,
+        loose: "bool | None" = None,
+    ) -> bytes:
+        """BIN track records over the device hit mask without
+        materializing a feature batch: only the 3-5 needed columns of
+        matching rows are touched on host (ref BinAggregatingIterator
+        builds the compact records server-side during the scan)."""
+        from geomesa_tpu.process.binexport import encode_bin_arrays
+
+        idx = np.nonzero(self.mask(query, loose=loose))[0]
+        host = self._host_rows()
+        x, y = host.point_coords(geom_attr)
+        dtg_attr = dtg_attr or self.sft.dtg_field
+        return encode_bin_arrays(
+            host.column(track_attr)[idx],
+            host.column(dtg_attr)[idx],
+            x[idx],
+            y[idx],
+            host.column(label_attr)[idx] if label_attr else None,
+            sort=sort,
+        )
 
 
 def _next_pow2(n: int) -> int:
@@ -930,6 +1045,24 @@ class StreamingDeviceIndex(DeviceIndex):
     def stats(self, query, spec: str, loose: "bool | None" = None):
         with self._lock:
             return super().stats(query, spec, loose=loose)
+
+    def density(self, query, envelope, width, height,
+                weight_attr=None, loose=None):
+        with self._lock:  # scans race donated-buffer mutations otherwise
+            return super().density(
+                query, envelope, width, height,
+                weight_attr=weight_attr, loose=loose,
+            )
+
+    def bin_export(self, query, track_attr, dtg_attr=None, geom_attr=None,
+                   label_attr=None, sort=False, loose=None):
+        # one lock span across mask + host-column reads: the host mirror
+        # and the device mask must come from the same snapshot
+        with self._lock:
+            return super().bin_export(
+                query, track_attr, dtg_attr=dtg_attr, geom_attr=geom_attr,
+                label_attr=label_attr, sort=sort, loose=loose,
+            )
 
     def __len__(self) -> int:
         return self._n - self._n_dead

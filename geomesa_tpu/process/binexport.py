@@ -44,6 +44,31 @@ def _label_pack(values: np.ndarray) -> np.ndarray:
     return out
 
 
+def encode_bin_arrays(
+    track_vals: np.ndarray,
+    dtg_ms: np.ndarray,
+    x: np.ndarray,
+    y: np.ndarray,
+    label_vals: "np.ndarray | None" = None,
+    sort: bool = False,
+) -> bytes:
+    """Column arrays -> BIN bytes (16B or 24B records). The column-level
+    entry point lets callers holding a device hit mask encode without
+    materializing a full feature batch (DeviceIndex.bin_export)."""
+    n = len(track_vals)
+    dt = DTYPE_24 if label_vals is not None else DTYPE_16
+    rec = np.empty(n, dtype=dt)
+    rec["track"] = _track_hash(np.asarray(track_vals))
+    rec["dtg"] = (np.asarray(dtg_ms) // 1000).astype(np.int32)
+    rec["lat"] = np.asarray(y).astype(np.float32)
+    rec["lon"] = np.asarray(x).astype(np.float32)
+    if label_vals is not None:
+        rec["label"] = _label_pack(np.asarray(label_vals))
+    if sort:
+        rec = rec[np.argsort(rec["dtg"], kind="stable")]
+    return rec.tobytes()
+
+
 def encode_bin(
     batch,
     track_attr: str,
@@ -55,19 +80,14 @@ def encode_bin(
     """FeatureBatch -> BIN bytes (16B or 24B records)."""
     dtg_attr = dtg_attr or batch.sft.dtg_field
     x, y = batch.point_coords(geom_attr)
-    dtg_s = (batch.column(dtg_attr) // 1000).astype(np.int32)
-    n = len(batch)
-    dt = DTYPE_24 if label_attr else DTYPE_16
-    rec = np.empty(n, dtype=dt)
-    rec["track"] = _track_hash(batch.column(track_attr))
-    rec["dtg"] = dtg_s
-    rec["lat"] = y.astype(np.float32)
-    rec["lon"] = x.astype(np.float32)
-    if label_attr:
-        rec["label"] = _label_pack(batch.column(label_attr))
-    if sort:
-        rec = rec[np.argsort(rec["dtg"], kind="stable")]
-    return rec.tobytes()
+    return encode_bin_arrays(
+        batch.column(track_attr),
+        batch.column(dtg_attr),
+        x,
+        y,
+        batch.column(label_attr) if label_attr else None,
+        sort=sort,
+    )
 
 
 def decode_bin(data: bytes, labels: bool = False) -> np.ndarray:

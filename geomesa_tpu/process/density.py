@@ -23,8 +23,25 @@ def density(
     height: int,
     weight_attr: "str | None" = None,
     use_device: bool = True,
+    device_index=None,
+    loose: "bool | None" = None,
 ) -> np.ndarray:
-    """(height, width) float32 grid of (weighted) feature counts."""
+    """(height, width) float32 grid of (weighted) feature counts.
+
+    With a resident ``device_index`` the whole thing is ONE fused device
+    dispatch (filter mask + scatter-add, no feature materialization — the
+    DensityIterator model); otherwise the store query materializes the
+    matched batch and the grid accumulates from its coordinates.
+    ``loose`` applies only to the resident path (key-plane cell
+    granularity, same contract as DeviceIndex.count/query)."""
+    if device_index is not None:
+        grid = device_index.density(
+            query, envelope, width, height, weight_attr=weight_attr,
+            loose=loose,
+        )
+        if grid is not None:
+            return grid
+        # filter or planes not resident: fall through to the store path
     res = store.query(type_name, query)
     batch = res.batch
     if len(batch) == 0:
@@ -42,12 +59,19 @@ def density(
     return _density_host(x, y, w, envelope, width, height)
 
 
-def _pixel_ids(x, y, env: Envelope, width: int, height: int, xp):
-    sx = width / (env.xmax - env.xmin)
-    sy = height / (env.ymax - env.ymin)
-    px = xp.clip(xp.floor((x - env.xmin) * sx), 0, width - 1)
-    py = xp.clip(xp.floor((y - env.ymin) * sy), 0, height - 1)
-    inside = (x >= env.xmin) & (x <= env.xmax) & (y >= env.ymin) & (y <= env.ymax)
+def _pixel_ids(x, y, env, width: int, height: int, xp):
+    """env: an Envelope, or a (xmin, ymin, xmax, ymax) 4-vector — the
+    vector form lets the device path pass the viewport as a RUNTIME array
+    so one compiled kernel serves every bbox."""
+    if hasattr(env, "xmin"):
+        xmin, ymin, xmax, ymax = env.xmin, env.ymin, env.xmax, env.ymax
+    else:
+        xmin, ymin, xmax, ymax = env[0], env[1], env[2], env[3]
+    sx = width / (xmax - xmin)
+    sy = height / (ymax - ymin)
+    px = xp.clip(xp.floor((x - xmin) * sx), 0, width - 1)
+    py = xp.clip(xp.floor((y - ymin) * sy), 0, height - 1)
+    inside = (x >= xmin) & (x <= xmax) & (y >= ymin) & (y <= ymax)
     return px.astype(xp.int32), py.astype(xp.int32), inside
 
 

@@ -6,8 +6,18 @@ from __future__ import annotations
 from geomesa_tpu.stats import SeqStat, parse_stat
 
 
-def run_stats(store, type_name: str, query, stat_spec: str) -> SeqStat:
-    """Evaluate a Stat-DSL spec over the features matching the query."""
+def run_stats(
+    store, type_name: str, query, stat_spec: str, device_index=None
+) -> SeqStat:
+    """Evaluate a Stat-DSL spec over the features matching the query.
+
+    With a resident ``device_index`` the aggregation fuses into the
+    device scan (DeviceIndex.stats — the StatsIterator model: stats
+    computed next to the data, features never shipped); otherwise the
+    store query materializes the matched batch and observes it host-side.
+    """
+    if device_index is not None:
+        return device_index.stats(query, stat_spec)
     seq = parse_stat(stat_spec)
     res = store.query(type_name, query)
     seq.observe_batch(res.batch)

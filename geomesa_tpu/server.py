@@ -296,14 +296,26 @@ class _Handler(BaseHTTPRequestHandler):
         height = int(q.get("height", 256))
         from geomesa_tpu.geom import Envelope
 
-        grid = density(
-            self.store,
-            type_name,
-            q.get("cql", "INCLUDE"),
-            Envelope(*bbox),
-            width,
-            height,
-        )
+        cql = q.get("cql", "INCLUDE")
+        env = Envelope(*bbox)
+        di = self._di(type_name)
+        grid = None
+        if di is not None:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            grid = di.density(cql, env, width, height,
+                              loose=self._loose(q))
+            if grid is not None:
+                # unweighted: the grid mass IS the in-window hit count
+                self._observe_resident(
+                    type_name, cql, t0, _time.perf_counter(),
+                    int(round(float(grid.sum()))),
+                )
+        if grid is None:
+            # no resident index, or filter/planes not device-expressible:
+            # the store path records its own metrics (observe_query)
+            grid = density(self.store, type_name, cql, env, width, height)
         self._json(
             200,
             {

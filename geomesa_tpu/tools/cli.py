@@ -356,12 +356,28 @@ def _stat_json(stat) -> dict:
     return j
 
 
-def _run_stat(args, spec: str, store=None):
+def _run_stat(args, spec: str, store=None, device_index=None):
     from geomesa_tpu.process import run_stats
 
     if store is None:
         store = _store(args)
-    return run_stats(store, args.feature_name, args.cql or "INCLUDE", spec)
+    if device_index is None:
+        device_index = _resident_index(args, store)
+    return run_stats(
+        store, args.feature_name, args.cql or "INCLUDE", spec,
+        device_index=device_index,
+    )
+
+
+def _resident_index(args, store):
+    """--resident: pin the type's scan + key planes on device so stats
+    fuse into the scan (DeviceIndex.stats) instead of materializing the
+    matched batch host-side."""
+    if not getattr(args, "resident", False):
+        return None
+    from geomesa_tpu.device_cache import DeviceIndex
+
+    return DeviceIndex(store, args.feature_name, z_planes=True)
 
 
 def cmd_stats_count(args):
@@ -410,9 +426,12 @@ def cmd_stats_top_k(args):
 
 def cmd_stats_histogram(args):
     store = _store(args)
+    # one resident staging shared by the bounds pass AND the histogram
+    # pass -- building it twice would stage the whole dataset twice
+    di = _resident_index(args, store)
     if args.min is None or args.max is None:
         mm = _run_stat(
-            args, f'MinMax("{args.attribute}")', store=store
+            args, f'MinMax("{args.attribute}")', store=store, device_index=di
         ).stats[0].to_json()
         lo = args.min if args.min is not None else mm["min"]
         hi = args.max if args.max is not None else mm["max"]
@@ -427,6 +446,7 @@ def cmd_stats_histogram(args):
         args,
         f'Histogram("{args.attribute}",{args.bins},{float(lo)},{float(hi)})',
         store=store,
+        device_index=di,
     )
     print(json.dumps(seq.stats[0].to_json()))
 
@@ -481,7 +501,10 @@ def cmd_stats(args):
     from geomesa_tpu.process import run_stats
 
     store = _store(args)
-    seq = run_stats(store, args.feature_name, args.cql or "INCLUDE", args.stat_spec)
+    seq = run_stats(
+        store, args.feature_name, args.cql or "INCLUDE", args.stat_spec,
+        device_index=_resident_index(args, store),
+    )
     for s in seq.stats:
         print(json.dumps(s.to_json()))
 
@@ -534,6 +557,7 @@ def main(argv=None) -> None:
     sp.add_argument("-q", "--cql")
 
     sp = add("stats", cmd_stats)
+    sp.add_argument("--resident", action="store_true", help="fuse stats into the device scan via a resident index")
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-s", "--stat-spec", required=True)
     sp.add_argument("-q", "--cql")
@@ -577,21 +601,25 @@ def main(argv=None) -> None:
     sp.add_argument("-f", "--feature-name", required=True)
 
     sp = add("stats-count", cmd_stats_count)
+    sp.add_argument("--resident", action="store_true", help="fuse stats into the device scan via a resident index")
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-q", "--cql")
 
     sp = add("stats-bounds", cmd_stats_bounds)
+    sp.add_argument("--resident", action="store_true", help="fuse stats into the device scan via a resident index")
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-q", "--cql")
     sp.add_argument("-a", "--attributes", help="comma-separated attributes")
 
     sp = add("stats-top-k", cmd_stats_top_k)
+    sp.add_argument("--resident", action="store_true", help="fuse stats into the device scan via a resident index")
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-q", "--cql")
     sp.add_argument("-a", "--attribute", required=True)
     sp.add_argument("-k", type=int, default=10)
 
     sp = add("stats-histogram", cmd_stats_histogram)
+    sp.add_argument("--resident", action="store_true", help="fuse stats into the device scan via a resident index")
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-q", "--cql")
     sp.add_argument("-a", "--attribute", required=True)
@@ -600,6 +628,7 @@ def main(argv=None) -> None:
     sp.add_argument("--max", type=float)
 
     sp = add("stats-analyze", cmd_stats_analyze)
+    sp.add_argument("--resident", action="store_true", help="fuse stats into the device scan via a resident index")
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-q", "--cql")
 

@@ -303,3 +303,22 @@ def test_metrics_endpoint(server_url):
     text = body.decode()
     assert "geomesa_queries_total" in text
     assert "# TYPE geomesa_query_duration_seconds histogram" in text
+
+
+def test_resident_density_fused(resident_url):
+    """/density in resident mode runs the fused device path and matches
+    the store-path grid."""
+    url, ds = resident_url
+    cql = "BBOX(geom, -5, -5, 5, 5)"
+    status, _, body = _get(
+        f"{url}/density/gdelt?bbox=-5,-5,5,5&width=16&height=8"
+        f"&cql={urllib.request.quote(cql)}"
+    )
+    assert status == 200
+    doc = json.loads(body)
+    from geomesa_tpu.geom import Envelope
+    from geomesa_tpu.process import density
+
+    ref = density(ds, "gdelt", cql, Envelope(-5, -5, 5, 5), 16, 8)
+    np.testing.assert_allclose(np.array(doc["counts"]), ref, rtol=1e-5)
+    assert np.array(doc["counts"]).sum() > 0

@@ -45,6 +45,15 @@ def _stageable_planes(sft: SimpleFeatureType) -> list:
 # reserved names for the index-key planes (leading underscore cannot clash
 # with attribute planes, which are always "<attr>" or "<attr>__suffix")
 Z_BIN, Z_HI, Z_LO = "__zbin", "__zhi", "__zlo"
+# reserved name for the visibility label-id plane (per-auth resident
+# serving: each row carries the id of its label expression in a small
+# vocabulary; a per-request auth table gathers to a bool mask on device)
+VIS_ID = "__visid"
+
+
+class _VisOverflow(Exception):
+    """Label vocabulary exceeded VIS_VOCAB_MAX: per-auth residency is
+    disabled and labeled rows fall back to the store path."""
 
 
 from geomesa_tpu.curves.zorder import u64_hi_lo as _split_u64
@@ -59,6 +68,17 @@ from geomesa_tpu.index.keyplanes import (
 def _encode_inputs(batch, sft: SimpleFeatureType, kind, sfc):
     return _encode_inputs_shared(batch, kind, sfc, sft.geom_field,
                                  sft.dtg_field)
+
+
+def _staging_query():
+    """The resident-cache staging scan: every row, visibility labels kept
+    raw (the cache enforces per-request auths itself via the label-id
+    plane) -- never expose this to user-facing queries."""
+    from geomesa_tpu.query.plan import Query
+
+    return Query(
+        filter=ast.Include, hints={"internal": True, "raw_visibility": True}
+    )
 
 
 def _z_planes_np(batch, sft: SimpleFeatureType):
@@ -93,11 +113,20 @@ class DeviceIndex:
     of reading the coordinate planes. Opt in per call (``loose=True``)
     or globally (``query.loose.bbox`` system property).
 
-    Visibility: staging queries run with NO auths, so features carrying
-    visibility labels are hidden from the resident copy entirely — the
-    cache can never leak a labeled feature; serving labeled data
-    per-auth requires the store path, not the resident one.
+    Visibility (per-auth resident serving, ref Accumulo cell
+    visibility): staging keeps EVERY row plus a compact label-id plane
+    (the distinct label expressions form a small vocabulary, capped at
+    ``VIS_VOCAB_MAX``). Each request's auths evaluate the vocabulary
+    once host-side into a bool table; the device scan gathers it by
+    label id and ANDs it into the hit mask, so secured features serve
+    from the fast path under the correct auths. No auths (the default)
+    means labeled rows are hidden — fail closed, the store semantics.
+    If the vocabulary overflows the cap, labeled rows are dropped from
+    the resident copy (served by the store path only) with a warning.
     """
+
+    #: distinct visibility expressions the resident cache will track
+    VIS_VOCAB_MAX = 4096
 
     def __init__(
         self,
@@ -120,6 +149,10 @@ class DeviceIndex:
         self._z_encode_jit = None
         self._z_encode_failed = False
         self._loose_cache: dict = {}  # (repr(f), bin_range) -> bounds
+        self._vis_vocab: "dict | None" = None  # label expr -> id
+        self._vis_disabled = False  # vocabulary overflowed: public-only
+        self._auth_tables: dict = {}  # sorted-auths tuple -> device table
+        self._visid_np = None  # host mirror of the VIS_ID plane
         self.refresh()
 
     def _stage_batch(self, batch) -> dict:
@@ -144,7 +177,117 @@ class DeviceIndex:
                     self._loose_cache.clear()  # stale keyed entries
             for k, v in zp.items():
                 cols[k] = jnp.asarray(v)
+        self._stage_vis(batch, cols)
         return cols
+
+    # -- visibility plane --------------------------------------------------
+
+    def _stage_vis(self, batch, cols: dict) -> None:
+        """Stage the label-id plane for a batch (extends the vocabulary;
+        raises _VisOverflow past VIS_VOCAB_MAX). Pure-public schemas (no
+        label ever seen) stage no plane at all."""
+        import jax.numpy as jnp
+
+        vis = batch.visibilities
+        norm = None
+        if vis is not None:
+            norm = np.array(
+                ["" if v is None else str(v) for v in vis], dtype=object
+            )
+        labeled = norm is not None and bool(np.any(norm != ""))
+        if self._vis_disabled:
+            if labeled:
+                raise _VisOverflow()
+            return
+        if self._vis_vocab is None:
+            if not labeled:
+                return  # no labels anywhere: zero overhead
+            self._vis_vocab = {"": 0}
+        if norm is None:
+            ids = np.zeros(len(batch), np.int32)
+        else:
+            ids = self._vocab_ids(norm)
+        cols[VIS_ID] = jnp.asarray(ids)
+        self._visid_np = (
+            ids
+            if self._visid_np is None
+            else np.concatenate([self._visid_np, ids])
+        )
+
+    def _vocab_ids(self, labels: np.ndarray) -> np.ndarray:
+        uniq, inv = np.unique(labels.astype(str), return_inverse=True)
+        mapped = np.empty(len(uniq), np.int32)
+        grew = False
+        for i, lab in enumerate(uniq.tolist()):
+            vid = self._vis_vocab.get(lab)
+            if vid is None:
+                if len(self._vis_vocab) >= self.VIS_VOCAB_MAX:
+                    raise _VisOverflow()
+                vid = len(self._vis_vocab)
+                self._vis_vocab[lab] = vid
+                grew = True
+            mapped[i] = vid
+        if grew:
+            self._auth_tables.clear()  # tables are per-vocabulary
+        return mapped[inv].astype(np.int32)
+
+    def _auth_table(self, auths):
+        """Device bool table over the vocabulary for one auth set: entry
+        v is True iff label v is visible under ``auths`` (None/() = no
+        authorizations: labeled rows hide, fail closed). Padded to a
+        power of two so jit shapes stay bounded as the vocabulary grows.
+        """
+        import jax.numpy as jnp
+
+        from geomesa_tpu.security import VisibilityEvaluator
+
+        key = tuple(sorted(str(a) for a in (auths or ())))
+        tab = self._auth_tables.get(key)
+        if tab is None:
+            cap = max(16, _next_pow2(len(self._vis_vocab)))
+            vals = np.zeros(cap, dtype=bool)
+            ev = VisibilityEvaluator(auths or ())
+            for lab, vid in self._vis_vocab.items():
+                vals[vid] = ev.can_see(lab if lab else None)
+            tab = jnp.asarray(vals)
+            self._auth_tables[key] = tab
+        return tab
+
+    def _apply_auths_np(self, m: np.ndarray, auths) -> np.ndarray:
+        """Host-side auth AND over a hit mask (the mask/query path; the
+        fused paths apply the same table on device)."""
+        if self._visid_np is None:
+            return m
+        tab = np.asarray(self._auth_table(auths))
+        return m & tab[self._visid_np[: len(m)]]
+
+    def _stage_checked(self, batch):
+        """(batch, cols) with the vocabulary-overflow fallback: on
+        overflow, per-auth residency is disabled and labeled rows are
+        dropped from the resident copy (the store path still serves
+        them), loudly."""
+        try:
+            return batch, self._stage_batch(batch)
+        except _VisOverflow:
+            import warnings
+
+            warnings.warn(
+                f"visibility vocabulary exceeds {self.VIS_VOCAB_MAX} "
+                "distinct labels; labeled rows leave the resident cache "
+                "and are served by the store path only",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            self._vis_disabled = True
+            self._vis_vocab = None
+            self._auth_tables.clear()
+            self._visid_np = None
+            vis = batch.visibilities
+            keep = np.array(
+                [v is None or str(v) == "" for v in vis], dtype=bool
+            )
+            batch = batch.take(np.nonzero(keep)[0])
+            return batch, self._stage_batch(batch)
 
     def _z_planes(self, batch):
         """Key planes for a batch: the jitted DEVICE encode (quantize +
@@ -200,10 +343,10 @@ class DeviceIndex:
         """Re-stage from the backing store (after writes / age-off).
         Compiled filters are data-independent and persist; jit re-compiles
         on its own if the row count changes shape."""
-        res = self.store.query(self.type_name, internal_query(ast.Include))
-        self._host_batch = res.batch
+        res = self.store.query(self.type_name, _staging_query())
         self._bin_range = None
-        self._cols = self._stage_batch(self._host_batch)
+        self._visid_np = None
+        self._host_batch, self._cols = self._stage_checked(res.batch)
 
     def __len__(self) -> int:
         return len(self._host_batch)
@@ -427,15 +570,41 @@ class DeviceIndex:
 
     def _parse(self, query):
         from geomesa_tpu.filter.ecql import parse_ecql
+        from geomesa_tpu.query.plan import Query
 
+        if isinstance(query, Query):
+            # a Query's hints (auths!) would be silently ignored here --
+            # refuse loudly instead of serving rows under the wrong auths
+            raise TypeError(
+                "DeviceIndex takes a CQL string or filter AST; pass "
+                "auths= explicitly (Query hints are store-path plumbing)"
+            )
         return parse_ecql(query) if isinstance(query, str) else query
 
-    def count(self, query, loose: "bool | None" = None) -> int:
+    def count(
+        self, query, loose: "bool | None" = None, auths=None
+    ) -> int:
         """Fused device count; exact when the filter is fully on-device,
         else falls through to query(). With loose=True (or the
         query.loose.bbox property) bbox(+during) filters are answered at
-        cell granularity from the resident key planes."""
+        cell granularity from the resident key planes. ``auths`` applies
+        per-request row security against the staged label-id plane
+        (None/() hides labeled rows — fail closed)."""
+        import jax.numpy as jnp
+
         f = self._parse(query)
+        if VIS_ID in (self._cols or {}):
+            # labeled data: the auth table must AND into the device mask
+            if self._staged_len() == 0:
+                return 0
+            outs = self._fused_agg(
+                f, loose, ("count",),
+                lambda cols, m: {"__count": jnp.sum(m, dtype=jnp.int32)},
+                auths=auths,
+            )
+            if outs is not None:
+                return int(outs["__count"])
+            return int(self.mask(f, loose=loose, auths=auths).sum())
         if self._resolve_loose(loose):
             lb = self._loose_bounds(f)
             if lb is not None:
@@ -453,39 +622,46 @@ class DeviceIndex:
             return len(self.query(query))
         return int(count_fn(self._resident_subset(compiled)))
 
-    def mask(self, query, loose: "bool | None" = None) -> np.ndarray:
+    def mask(
+        self, query, loose: "bool | None" = None, auths=None
+    ) -> np.ndarray:
         """Boolean hit mask over the staged rows; rows absent from the
-        live set (evicted, in subclasses) are always False."""
+        live set (evicted, in subclasses) are always False. When a
+        label-id plane is staged, the per-request ``auths`` verdict is
+        ANDed in (fail closed on None/())."""
         f = self._parse(query)
         if self._resolve_loose(loose):
             lm = self._loose_mask(f)
             if lm is not None:
-                return lm
+                return self._apply_auths_np(lm, auths)
         compiled, _, mask_fn = self._compiled_for(f)
         if not compiled.device_cols or mask_fn is None:
             m = compiled.host_mask(self._host_rows())
             hv = self._host_valid()
-            return (m & hv) if hv is not None else m
+            m = (m & hv) if hv is not None else m
+            return self._apply_auths_np(m, auths)
         m = np.asarray(mask_fn(self._resident_subset(compiled)))
         m = m[: self._staged_len()]
         if not compiled.fully_on_device:
             idx = np.nonzero(m)[0]
+            out = np.zeros(len(m), dtype=bool)
             if len(idx):
                 keep = compiled.residual_mask(self._host_rows().take(idx))
-                out = np.zeros(len(m), dtype=bool)
                 out[idx[keep]] = True
-                return out
-        return m
+            m = out
+        return self._apply_auths_np(m, auths)
 
-    def query(self, query, loose: "bool | None" = None):
+    def query(self, query, loose: "bool | None" = None, auths=None):
         """FeatureBatch of hits (host-side take over the device mask)."""
         return self._host_rows().take(
-            np.nonzero(self.mask(query, loose=loose))[0]
+            np.nonzero(self.mask(query, loose=loose, auths=auths))[0]
         )
 
     # -- pushdown stats (StatsIterator analog) -----------------------------
 
-    def stats(self, query, spec: str, loose: "bool | None" = None):
+    def stats(
+        self, query, spec: str, loose: "bool | None" = None, auths=None
+    ):
         """Stat-DSL aggregation fused with the filter scan in ONE device
         dispatch (ref StatsIterator: stats computed server-side during
         the scan, never shipping features). Count, MinMax over resident
@@ -525,10 +701,10 @@ class DeviceIndex:
         if self._staged_len() == 0:
             return seq  # nothing staged: zero-size reductions have no identity
         outs = self._stats_fused(
-            f, loose, device_parts, need_mask=bool(host_parts)
+            f, loose, device_parts, need_mask=bool(host_parts), auths=auths
         )
         if outs is None:  # filter not fully device-expressible
-            seq.observe_batch(self.query(f, loose=loose))
+            seq.observe_batch(self.query(f, loose=loose, auths=auths))
             return seq
         n_hits = int(outs["__count"])
         for i, (tag, s) in enumerate(device_parts):
@@ -561,7 +737,7 @@ class DeviceIndex:
                 _observe_on_batch(s, rows)
         return seq
 
-    def _fused_agg(self, f, loose, agg_key, agg_build, extra=()):
+    def _fused_agg(self, f, loose, agg_key, agg_build, extra=(), auths=None):
         """The pushdown-aggregation hook: ONE device dispatch computing
         the filter mask (exact compiled predicate, or the loose key-plane
         compare) fused with an arbitrary aggregation over the resident
@@ -593,12 +769,13 @@ class DeviceIndex:
                 return None
         if not hasattr(self, "_agg_cache"):
             self._agg_cache = {}
-        key = (repr(f), kind, agg_key)
+        has_vis = VIS_ID in self._cols
+        key = (repr(f), kind, agg_key, has_vis)
         cached = self._agg_cache.get(key)
         if cached is None:
             z_kind = self._z_kind
 
-            def fused(cols, mask_args, valid, extra_args):
+            def fused(cols, mask_args, valid, extra_args, auth_tab):
                 if kind == "loose":
                     from geomesa_tpu.ops import zscan
 
@@ -614,6 +791,10 @@ class DeviceIndex:
                     m = compiled.device_fn(cols)
                 if valid is not None:
                     m = m & valid
+                if auth_tab is not None:
+                    # per-request row security: gather the auth verdict
+                    # by label id (Accumulo cell visibility, on device)
+                    m = m & auth_tab[cols[VIS_ID]]
                 return agg_build(cols, m, *extra_args)
 
             cached = jax.jit(fused)
@@ -623,9 +804,10 @@ class DeviceIndex:
             lb if kind == "loose" else None,
             self._device_valid(),
             extra,
+            self._auth_table(auths) if has_vis else None,
         )
 
-    def _stats_fused(self, f, loose, device_parts, need_mask):
+    def _stats_fused(self, f, loose, device_parts, need_mask, auths=None):
         """Stat-DSL reductions on the pushdown hook: mask + every device
         reduction in one dispatch (None = caller falls back to host)."""
         import jax
@@ -700,7 +882,7 @@ class DeviceIndex:
             return out
 
         part_key = ("stats", parts_spec, need_mask)
-        return self._fused_agg(f, loose, part_key, agg_build)
+        return self._fused_agg(f, loose, part_key, agg_build, auths=auths)
 
     # -- pushdown density + BIN (Density/BinAggregating iterator analogs) --
 
@@ -712,6 +894,7 @@ class DeviceIndex:
         height: int,
         weight_attr: "str | None" = None,
         loose: "bool | None" = None,
+        auths=None,
     ) -> "np.ndarray | None":
         """Fused density rasterization: filter mask + pixel scatter-add in
         ONE device dispatch — no feature batch is ever materialized (ref
@@ -756,7 +939,7 @@ class DeviceIndex:
         )
         outs = self._fused_agg(
             f, loose, ("density", width, height, weight_attr),
-            agg_build, extra=(env_arr,),
+            agg_build, extra=(env_arr,), auths=auths,
         )
         return None if outs is None else np.asarray(outs["grid"])
 
@@ -769,22 +952,30 @@ class DeviceIndex:
         label_attr: "str | None" = None,
         sort: bool = False,
         loose: "bool | None" = None,
+        auths=None,
     ) -> bytes:
         """BIN track records over the device hit mask without
         materializing a feature batch: only the 3-5 needed columns of
         matching rows are touched on host (ref BinAggregatingIterator
         builds the compact records server-side during the scan)."""
+        from geomesa_tpu.features.batch import FeatureBatch
         from geomesa_tpu.process.binexport import encode_bin_arrays
 
-        idx = np.nonzero(self.mask(query, loose=loose))[0]
+        idx = np.nonzero(self.mask(query, loose=loose, auths=auths))[0]
         host = self._host_rows()
-        x, y = host.point_coords(geom_attr)
+        # O(hits) coordinate extraction: slice the geometry column FIRST,
+        # then decode coords on the selected rows only
+        gname = geom_attr or self.sft.geom_field
+        mini = FeatureBatch(
+            self.sft, host.fids[idx], {gname: host.column(gname)[idx]}
+        )
+        x, y = mini.point_coords(gname)
         dtg_attr = dtg_attr or self.sft.dtg_field
         return encode_bin_arrays(
             host.column(track_attr)[idx],
             host.column(dtg_attr)[idx],
-            x[idx],
-            y[idx],
+            x,
+            y,
             host.column(label_attr)[idx] if label_attr else None,
             sort=sort,
         )
@@ -846,19 +1037,20 @@ class StreamingDeviceIndex(DeviceIndex):
 
     def refresh(self) -> None:
         with self._lock:
-            res = self.store.query(self.type_name, internal_query(ast.Include))
+            res = self.store.query(self.type_name, _staging_query())
             self._install(res.batch)
 
     def _install(self, batch, min_cap: int = 0) -> None:
         """Full (re)stage of ``batch`` into fresh capacity-padded buffers."""
         import jax.numpy as jnp
 
+        self._bin_range = None
+        self._visid_np = None
+        batch, cols = self._stage_checked(batch)
         n = len(batch)
         cap = _next_pow2(
             max(n, min_cap, self._capacity_hint or 0, self.MIN_DELTA_ROWS)
         )
-        self._bin_range = None
-        cols = self._stage_batch(batch)
         self._cols = {
             k: jnp.concatenate([v, jnp.zeros(cap - n, v.dtype)])
             if cap > n
@@ -902,18 +1094,33 @@ class StreamingDeviceIndex(DeviceIndex):
         import jax
         import jax.numpy as jnp
 
+        from geomesa_tpu.features.batch import FeatureBatch
+
         m = len(batch)
         if m == 0:
             return
         pad = max(_next_pow2(m), self.MIN_DELTA_ROWS)
         if self._n + pad > self._cap:
             # grow: compact out dead rows, double capacity for headroom
-            from geomesa_tpu.features.batch import FeatureBatch
-
             merged = FeatureBatch.concat([self._live_rows(), batch])
             self._install(merged, min_cap=2 * len(merged))
             return
-        delta = self._stage_batch(batch)  # widens _bin_range for z planes
+        try:
+            delta = self._stage_batch(batch)  # widens _bin_range / vocab
+        except _VisOverflow:
+            # vocabulary overflow mid-stream: full restage applies the
+            # public-only fallback consistently
+            merged = FeatureBatch.concat([self._live_rows(), batch])
+            self._install(merged, min_cap=self._cap)
+            return
+        if set(delta) - set(self._cols):
+            # the delta introduced a NEW plane (first labeled rows on a
+            # previously unlabeled stream): the fixed buffers have no slot
+            # for it — silently dropping it would serve labeled rows as
+            # public. Full restage instead.
+            merged = FeatureBatch.concat([self._live_rows(), batch])
+            self._install(merged, min_cap=self._cap)
+            return
         delta = {
             k: jnp.concatenate([v, jnp.zeros(pad - m, v.dtype)])
             if pad > m
@@ -1030,38 +1237,42 @@ class StreamingDeviceIndex(DeviceIndex):
 
     # -- query hooks (scan bodies live in DeviceIndex) ---------------------
 
-    def count(self, query, loose: "bool | None" = None) -> int:
+    def count(self, query, loose: "bool | None" = None, auths=None) -> int:
         with self._lock:
-            return super().count(query, loose=loose)
+            return super().count(query, loose=loose, auths=auths)
 
-    def mask(self, query, loose: "bool | None" = None) -> np.ndarray:
+    def mask(
+        self, query, loose: "bool | None" = None, auths=None
+    ) -> np.ndarray:
         with self._lock:
-            return super().mask(query, loose=loose)
+            return super().mask(query, loose=loose, auths=auths)
 
-    def query(self, query, loose: "bool | None" = None):
+    def query(self, query, loose: "bool | None" = None, auths=None):
         with self._lock:
-            return super().query(query, loose=loose)
+            return super().query(query, loose=loose, auths=auths)
 
-    def stats(self, query, spec: str, loose: "bool | None" = None):
+    def stats(
+        self, query, spec: str, loose: "bool | None" = None, auths=None
+    ):
         with self._lock:
-            return super().stats(query, spec, loose=loose)
+            return super().stats(query, spec, loose=loose, auths=auths)
 
     def density(self, query, envelope, width, height,
-                weight_attr=None, loose=None):
+                weight_attr=None, loose=None, auths=None):
         with self._lock:  # scans race donated-buffer mutations otherwise
             return super().density(
                 query, envelope, width, height,
-                weight_attr=weight_attr, loose=loose,
+                weight_attr=weight_attr, loose=loose, auths=auths,
             )
 
     def bin_export(self, query, track_attr, dtg_attr=None, geom_attr=None,
-                   label_attr=None, sort=False, loose=None):
+                   label_attr=None, sort=False, loose=None, auths=None):
         # one lock span across mask + host-column reads: the host mirror
         # and the device mask must come from the same snapshot
         with self._lock:
             return super().bin_export(
                 query, track_attr, dtg_attr=dtg_attr, geom_attr=geom_attr,
-                label_attr=label_attr, sort=sort, loose=loose,
+                label_attr=label_attr, sort=sort, loose=loose, auths=auths,
             )
 
     def __len__(self) -> int:

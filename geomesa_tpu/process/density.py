@@ -14,6 +14,21 @@ import numpy as np
 from geomesa_tpu.geom import Envelope
 
 
+def _split_query(query, auths):
+    """(filter, auths) from a query that may be a full Query (whose auths
+    hint takes precedence) or a bare CQL string / filter AST."""
+    from geomesa_tpu.query.plan import Query
+
+    if isinstance(query, Query):
+        from geomesa_tpu.filter import ast
+
+        return (
+            query.filter if query.filter is not None else ast.Include,
+            query.hints.get("auths", auths),
+        )
+    return query, auths
+
+
 def density(
     store,
     type_name: str,
@@ -25,6 +40,7 @@ def density(
     use_device: bool = True,
     device_index=None,
     loose: "bool | None" = None,
+    auths=None,
 ) -> np.ndarray:
     """(height, width) float32 grid of (weighted) feature counts.
 
@@ -33,16 +49,21 @@ def density(
     DensityIterator model); otherwise the store query materializes the
     matched batch and the grid accumulates from its coordinates.
     ``loose`` applies only to the resident path (key-plane cell
-    granularity, same contract as DeviceIndex.count/query)."""
+    granularity, same contract as DeviceIndex.count/query). ``auths``
+    applies row security on BOTH paths; a full Query's auths hint wins.
+    """
+    from geomesa_tpu.query.plan import Query
+
+    filt, auths = _split_query(query, auths)
     if device_index is not None:
         grid = device_index.density(
-            query, envelope, width, height, weight_attr=weight_attr,
-            loose=loose,
+            filt, envelope, width, height, weight_attr=weight_attr,
+            loose=loose, auths=auths,
         )
         if grid is not None:
             return grid
         # filter or planes not resident: fall through to the store path
-    res = store.query(type_name, query)
+    res = store.query(type_name, Query(filter=filt, hints={"auths": auths}))
     batch = res.batch
     if len(batch) == 0:
         return np.zeros((height, width), dtype=np.float32)

@@ -7,7 +7,8 @@ from geomesa_tpu.stats import SeqStat, parse_stat
 
 
 def run_stats(
-    store, type_name: str, query, stat_spec: str, device_index=None
+    store, type_name: str, query, stat_spec: str, device_index=None,
+    auths=None,
 ) -> SeqStat:
     """Evaluate a Stat-DSL spec over the features matching the query.
 
@@ -15,9 +16,14 @@ def run_stats(
     device scan (DeviceIndex.stats — the StatsIterator model: stats
     computed next to the data, features never shipped); otherwise the
     store query materializes the matched batch and observes it host-side.
+    ``query`` may be a full Query (its auths hint wins) or a bare CQL
+    string / filter AST combined with ``auths``.
     """
     if device_index is not None:
-        return device_index.stats(query, stat_spec)
+        from geomesa_tpu.process.density import _split_query
+
+        filt, auths = _split_query(query, auths)
+        return device_index.stats(filt, stat_spec, auths=auths)
     seq = parse_stat(stat_spec)
     res = store.query(type_name, query)
     seq.observe_batch(res.batch)

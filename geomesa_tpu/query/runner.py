@@ -106,7 +106,11 @@ def _post_process(batch: FeatureBatch, plan: QueryPlan) -> FeatureBatch:
     # auths satisfy it -- including when no auths were supplied at all.
     # Internal per-partition scans (fs store) defer this to the outer,
     # global post-process so the real auths are the ones applied.
-    if not q.hints.get("internal_scan"):
+    # raw_visibility is the resident-cache STAGING escape hatch: the
+    # DeviceIndex stages every row plus a label-id plane and enforces
+    # visibility itself per request (device auth-table gather); it must
+    # never be set on a user-facing query.
+    if not q.hints.get("internal_scan") and not q.hints.get("raw_visibility"):
         from geomesa_tpu.security import filter_by_visibility
 
         m = filter_by_visibility(batch, q.hints.get("auths", ()))

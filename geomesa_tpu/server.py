@@ -68,6 +68,15 @@ class _Handler(BaseHTTPRequestHandler):
         return None if v is None else v.lower() in ("1", "true", "yes")
 
     @staticmethod
+    def _auths(q: dict) -> tuple:
+        """Request authorizations (``auths=A,B``); absent = none — labeled
+        features hide, fail closed, on both serving paths."""
+        v = q.get("auths")
+        if not v:
+            return ()
+        return tuple(a for a in (s.strip() for s in v.split(",")) if a)
+
+    @staticmethod
     def _cap(q: dict) -> "int | None":
         """Result cap with interceptor parity, shared by every resident
         endpoint: an EXPLICIT maxFeatures (including 0) overrides the
@@ -185,6 +194,7 @@ class _Handler(BaseHTTPRequestHandler):
                 filter=q.get("cql", "INCLUDE"),
                 max_features=int(max_features) if max_features else None,
                 properties=props.split(",") if props else None,
+                hints={"auths": self._auths(q)},
             ),
         )
 
@@ -197,7 +207,7 @@ class _Handler(BaseHTTPRequestHandler):
 
             t0 = _time.perf_counter()
             cql = q.get("cql", "INCLUDE")
-            batch = di.query(cql, loose=self._loose(q))
+            batch = di.query(cql, loose=self._loose(q), auths=self._auths(q))
             cap = self._cap(q)
             if cap is not None and len(batch) > cap:
                 batch = batch.take(np.arange(cap))
@@ -233,7 +243,7 @@ class _Handler(BaseHTTPRequestHandler):
 
             t0 = _time.perf_counter()
             cql = q.get("cql", "INCLUDE")
-            n = di.count(cql, loose=self._loose(q))
+            n = di.count(cql, loose=self._loose(q), auths=self._auths(q))
             cap = self._cap(q)
             if cap is not None:
                 n = min(n, cap)  # the plain path counts the capped result
@@ -268,15 +278,24 @@ class _Handler(BaseHTTPRequestHandler):
 
             t0 = _time.perf_counter()
             cql = q.get("cql", "INCLUDE")
-            seq = di.stats(cql, spec, loose=self._loose(q))
+            seq = di.stats(
+                cql, spec, loose=self._loose(q), auths=self._auths(q)
+            )
             self._observe_resident(
                 type_name, cql, t0, _time.perf_counter(), 0
             )
         else:
             from geomesa_tpu.process import run_stats
+            from geomesa_tpu.query.plan import Query
 
             seq = run_stats(
-                self.store, type_name, q.get("cql", "INCLUDE"), spec
+                self.store,
+                type_name,
+                Query(
+                    filter=q.get("cql", "INCLUDE"),
+                    hints={"auths": self._auths(q)},
+                ),
+                spec,
             )
         self._json(200, seq.to_json())
 
@@ -305,7 +324,7 @@ class _Handler(BaseHTTPRequestHandler):
 
             t0 = _time.perf_counter()
             grid = di.density(cql, env, width, height,
-                              loose=self._loose(q))
+                              loose=self._loose(q), auths=self._auths(q))
             if grid is not None:
                 # unweighted: the grid mass IS the in-window hit count
                 self._observe_resident(
@@ -314,8 +333,12 @@ class _Handler(BaseHTTPRequestHandler):
                 )
         if grid is None:
             # no resident index, or filter/planes not device-expressible:
-            # the store path records its own metrics (observe_query)
-            grid = density(self.store, type_name, cql, env, width, height)
+            # the store path records its own metrics (observe_query) and
+            # honors the SAME auths the resident path would have
+            grid = density(
+                self.store, type_name, cql, env, width, height,
+                auths=self._auths(q),
+            )
         self._json(
             200,
             {

@@ -322,3 +322,51 @@ def test_resident_density_fused(resident_url):
     ref = density(ds, "gdelt", cql, Envelope(-5, -5, 5, 5), 16, 8)
     np.testing.assert_allclose(np.array(doc["counts"]), ref, rtol=1e-5)
     assert np.array(doc["counts"]).sum() > 0
+
+
+def test_server_auths_param_resident_and_store():
+    """auths=A,B serves labeled rows from the resident fast path; absent
+    auths fail closed. Store-path (non-resident) behavior is identical."""
+    from geomesa_tpu.features.batch import FeatureBatch
+
+    for resident in (True, False):
+        ds = MemoryDataStore()
+        ds.create_schema("sec", SPEC)
+        n = 400
+        rng = np.random.default_rng(13)
+        t0 = parse_instant("2020-01-01T00:00:00")
+        batch = FeatureBatch.from_columns(
+            ds.get_schema("sec"),
+            {
+                "name": rng.choice(["a", "b"], n),
+                "dtg": t0 + rng.integers(0, 10**8, n),
+                "geom": np.stack(
+                    [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)], axis=1
+                ),
+            },
+            fids=np.arange(n),
+        ).with_visibility(rng.choice(["", "A", "A&B"], n))
+        ds.write("sec", batch)
+        server, _ = serve_background(ds, resident=resident)
+        host, port = server.server_address[:2]
+        url = f"http://{host}:{port}"
+        try:
+            from geomesa_tpu.query.plan import Query
+
+            cql = "BBOX(geom, -20, -20, 20, 20)"
+            for auths in ((), ("A",), ("A", "B")):
+                want = len(
+                    ds.query("sec", Query(cql, hints={"auths": auths})).batch
+                )
+                qs = f"&auths={','.join(auths)}" if auths else ""
+                status, _, body = _get(
+                    f"{url}/count/sec?cql={urllib.request.quote(cql)}{qs}"
+                )
+                assert status == 200
+                assert json.loads(body)["count"] == want, (resident, auths)
+                status, _, body = _get(
+                    f"{url}/features/sec?cql={urllib.request.quote(cql)}{qs}"
+                )
+                assert len(json.loads(body)["features"]) == want
+        finally:
+            server.shutdown()

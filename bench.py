@@ -220,17 +220,20 @@ def bench_filter(args) -> dict:
 
 def bench_zscan(args) -> dict:
     """Z3Iterator-analog scan: filter by the resident KEY planes alone
-    (bin int32 + z hi/lo uint32 = 12B/row vs 16B/row of attribute
-    planes). The masked-compare kernel needs no de-interleave — Morton
-    spreading is monotonic (ops/zscan.py); loose cell-granular semantics,
-    exactly what the reference's Z3Iterator answers without residual
-    refinement."""
+    (12B/row vs 16B/row of attribute planes). The headline engine is the
+    Pallas DIM-PLANE kernel: the key stored de-interleaved (nx, ny uint32
+    + packed (bin<<21|nt) word), answering the identical cell-granular
+    query with ~12 VPU ops/row where the interleaved masked-compare needs
+    ~46 and measures compute-bound (ops/zscan.py rationale). Loose cell
+    semantics, exactly what the reference's Z3Iterator answers without
+    residual refinement. The masked-compare engine stays as the --check
+    cross-check (two independent kernels must agree)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from geomesa_tpu.curves import Z3SFC
-    from geomesa_tpu.curves.binnedtime import WEEK_MS
+    from geomesa_tpu.curves.binnedtime import WEEK_MS, to_binned_time
     from geomesa_tpu.filter.ecql import parse_instant
     from geomesa_tpu.ops import zscan
 
@@ -243,6 +246,7 @@ def bench_zscan(args) -> dict:
     qt0 = parse_instant("2020-01-10T00:00:00")
     qt1 = parse_instant("2020-01-15T00:00:00")
     qx0, qy0, qx1, qy1 = -10.0, 35.0, 30.0, 60.0
+    bin_base = int(to_binned_time(np.array([t0]), sfc.period)[0][0])
 
     from geomesa_tpu.jaxconf import require_x64
 
@@ -261,87 +265,319 @@ def bench_zscan(args) -> dict:
     @jax.jit
     def make_planes():
         x, y, off, bins64 = _coords()
-        z_hi, z_lo = sfc.index_jax_hi_lo(x, y, off)
+        nx = sfc.lon.normalize_jax(x).astype(jnp.uint32)
+        ny = sfc.lat.normalize_jax(y).astype(jnp.uint32)
+        nt = sfc.time.normalize_jax(off).astype(jnp.uint32)
+        nx, ny, bt = zscan.z3_dim_planes(
+            sfc, nx, ny, nt, bins64.astype(jnp.uint32), bin_base
+        )
         # only the key planes leave this jit: the coordinate planes are
         # scratch, freed before the timed loop (the --check oracle
         # recomputes them from the same PRNG keys)
-        return bins64.astype(jnp.int32), z_hi, z_lo
+        return nx, ny, bt
 
-    bins, z_hi, z_lo = jax.block_until_ready(make_planes())
-    bounds_np, ids_np = zscan.z3_query_bounds(
-        sfc, qx0, qy0, qx1, qy1, qt0, qt1
+    nx, ny, bt = jax.block_until_ready(make_planes())
+    q = zscan.z3_dim_plane_query(
+        sfc, qx0, qy0, qx1, qy1, qt0, qt1, bin_base
     )
-    bounds_np, ids_np = zscan.pad_bins(bounds_np, ids_np)
-    bounds, ids = jnp.asarray(bounds_np), jnp.asarray(ids_np)
-    log(f"query spans {int((ids_np >= 0).sum())} period bins "
-        f"(padded to {len(ids_np)})")
+    assert q is not None
+    qnx, qny, bt_ranges = q
+    log(f"query covers {len(bt_ranges)} merged bt range(s)")
 
-    # XLA-fused path, deliberately: measured on v5e, the hand-tiled Pallas
-    # variant (zscan.build_z3_pallas_scan, CI-verified in interpret mode)
-    # tops out ~305 GB/s while XLA's fusion pipeline reaches ~410-450 GB/s
-    # for this pure compare+reduce shape — the opposite of the attribute
-    # filter scan, where the Pallas tiles win. Engine choice is per-kernel,
-    # decided by measurement (README component map).
-    def scan_fn(b, zh, zl):
-        return zscan.z3_zscan_mask(zh, zl, b, bounds, ids).sum()
+    count_fn, _ = zscan.build_z3_dimscan_pallas(qnx, qny, bt_ranges)
+    scan_fn = count_fn
 
-    bytes_per_row = 12  # int32 bin + 2x uint32 z planes
-    hits = int(jax.jit(scan_fn)(bins, z_hi, z_lo))
+    bytes_per_row = 12  # 3x uint32 dim planes
+    hits = int(jax.jit(scan_fn)(nx, ny, bt))
     log(f"hits={hits:,} (selectivity {hits / n:.4%}, loose cell semantics)")
 
     if args.check:
-        # independent oracle: per-dimension cell compare on the raw
-        # coordinate planes (no interleave anywhere in this path)
-        from geomesa_tpu.curves.binnedtime import bins_for_interval
+        # independent engine: the interleaved masked-compare over z hi/lo
+        # planes encoded by a SEPARATE kernel (Morton interleave) — the
+        # two layouts must agree exactly. Checked at a reduced n: holding
+        # BOTH key layouts at 2^28 rows would exhaust HBM, and engine
+        # equivalence is size-independent.
+        nc = min(n, 1 << 25)
 
-        cell_bounds = []
-        for b, lo_off, hi_off in bins_for_interval(qt0, qt1, sfc.period):
-            cell_bounds.append((b, (
-                int(sfc.lon.normalize(qx0)), int(sfc.lat.normalize(qy0)),
-                int(sfc.time.normalize(lo_off))), (
-                int(sfc.lon.normalize(qx1)), int(sfc.lat.normalize(qy1)),
-                int(sfc.time.normalize(hi_off)))))
+        def _coords_nc():
+            x = jax.random.uniform(kx, (nc,), jnp.float32, -180.0, 180.0)
+            y = jax.random.uniform(ky, (nc,), jnp.float32, -90.0, 90.0)
+            dtg = jax.random.randint(kt, (nc,), t0, t1, jnp.int64)
+            bins64 = dtg // WEEK_MS
+            off = ((dtg - bins64 * WEEK_MS) // 1000).astype(jnp.float32)
+            return x, y, off, bins64
+
+        bounds_np, ids_np = zscan.z3_query_bounds(
+            sfc, qx0, qy0, qx1, qy1, qt0, qt1
+        )
+        bounds_np, ids_np = zscan.pad_bins(bounds_np, ids_np)
+        bb, ii = jnp.asarray(bounds_np), jnp.asarray(ids_np)
 
         @jax.jit
-        def oracle():
-            # identical PRNG keys -> identical coordinates; no interleave
-            # anywhere in this path, and nothing stays resident after
-            xa, ya, offa, bins64 = _coords()
-            nx = sfc.lon.normalize_jax(xa).astype(jnp.int32)
-            ny = sfc.lat.normalize_jax(ya).astype(jnp.int32)
-            nt = sfc.time.normalize_jax(offa).astype(jnp.int32)
-            m = jnp.zeros(n, bool)
-            for b, qlo, qhi in cell_bounds:
-                m_b = bins64.astype(jnp.int32) == b
-                m_b &= (nx >= qlo[0]) & (nx <= qhi[0])
-                m_b &= (ny >= qlo[1]) & (ny <= qhi[1])
-                m_b &= (nt >= qlo[2]) & (nt <= qhi[2])
-                m = m | m_b
-            return m.sum()
+        def both_counts():
+            x, y, off, bins64 = _coords_nc()
+            z_hi, z_lo = sfc.index_jax_hi_lo(x, y, off)
+            mc = zscan.z3_zscan_mask(
+                z_hi, z_lo, bins64.astype(jnp.int32), bb, ii
+            ).sum()
+            nxc = sfc.lon.normalize_jax(x).astype(jnp.uint32)
+            nyc = sfc.lat.normalize_jax(y).astype(jnp.uint32)
+            ntc = sfc.time.normalize_jax(off).astype(jnp.uint32)
+            a, b, c = zscan.z3_dim_planes(
+                sfc, nxc, nyc, ntc, bins64.astype(jnp.uint32), bin_base
+            )
+            dc = zscan.z3_dimscan_mask(a, b, c, qnx, qny, bt_ranges).sum()
+            return mc, dc
 
-        expect = int(oracle())
-        assert hits == expect, f"zscan {hits} != cell oracle {expect}"
-        log("count verified against per-dimension cell oracle")
+        mc, dc = both_counts()
+        assert int(mc) == int(dc), f"masked {int(mc)} != dimscan {int(dc)}"
+        log(f"engines agree at n={nc:,}: masked-compare == dim-plane "
+            f"({int(mc):,} hits)")
 
     k = args.chain
     chain = _chain(scan_fn, k)
     t_c = time.perf_counter()
-    total = int(chain(bins, z_hi, z_lo))
+    total = int(chain(nx, ny, bt))
     log(f"zscan chain (K={k}) compiled in {time.perf_counter() - t_c:.1f}s")
     assert total == (k * hits) % (1 << 32), (total, hits, k)
 
     m = _measure(
-        chain, (bins, z_hi, z_lo), args, k, n, bytes_per_row, platform,
-        "zscan",
+        chain, (nx, ny, bt), args, k, n, bytes_per_row, platform,
+        "zscan(dim-plane pallas)",
     )
     return {
-        "metric": "key-only z scan (Z3Iterator analog)",
+        "metric": "key-only z scan (Z3Iterator analog, dim-plane kernel)",
         "value": m["value"],
         "unit": "features/sec/chip",
         "gbps": m["gbps"],
         "hbm_pct": m["hbm_pct"],
         "n": n,
     }
+
+
+def _gdelt_cols(args, n, skew: bool = False):
+    """Device-resident GDELT-shaped scan planes (x/y f32 + dtg hi/lo).
+    ``skew=True`` draws 90% of points from 64 city-sized Gaussian
+    clusters (GDELT's spatial skew, SURVEY hard part #5) instead of the
+    uniform sphere."""
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.filter.ecql import parse_instant
+    from geomesa_tpu.jaxconf import require_x64
+
+    require_x64()  # epoch-ms randint needs i64 while generating
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    key = jax.random.PRNGKey(43 if skew else 42)
+    # distinct subkeys per draw: reusing a key across draws makes cluster
+    # ids deterministically correlated with timestamps, distorting the
+    # space/time independence the skew experiment measures
+    kx, ky, kt, kc, km, kn1, kn2, kp = jax.random.split(key, 8)
+
+    @jax.jit
+    def make_cols():
+        if skew:
+            # cluster centres drawn once; points = centre + sigma noise
+            cx = jax.random.uniform(kc, (64,), jnp.float32, -170.0, 170.0)
+            cy = jax.random.uniform(km, (64,), jnp.float32, -80.0, 80.0)
+            cid = jax.random.randint(kp, (n,), 0, 64)
+            noise_x = jax.random.normal(kn1, (n,), jnp.float32) * 0.2
+            noise_y = jax.random.normal(kn2, (n,), jnp.float32) * 0.2
+            ux = jax.random.uniform(kx, (n,), jnp.float32, -180.0, 180.0)
+            uy = jax.random.uniform(ky, (n,), jnp.float32, -90.0, 90.0)
+            take_cluster = jax.random.uniform(
+                jax.random.fold_in(kp, 1), (n,)
+            ) < 0.9
+            x = jnp.where(take_cluster, cx[cid] + noise_x, ux)
+            y = jnp.where(take_cluster, cy[cid] + noise_y, uy)
+            x = jnp.clip(x, -180.0, 180.0)
+            y = jnp.clip(y, -90.0, 90.0)
+        else:
+            x = jax.random.uniform(kx, (n,), jnp.float32, -180.0, 180.0)
+            y = jax.random.uniform(ky, (n,), jnp.float32, -90.0, 90.0)
+        dtg = jax.random.randint(kt, (n,), t0, t1, jnp.int64)
+        return {
+            "geom__x": x,
+            "geom__y": y,
+            "dtg__hi": (dtg >> 32).astype(jnp.int32),
+            "dtg__lo": (dtg & 0xFFFFFFFF).astype(jnp.uint32),
+        }
+
+    import jax as _jax
+
+    return _jax.block_until_ready(make_cols())
+
+
+def _scan_metric(args, cols, ecql, label, engine=None):
+    """Compile one ECQL filter over resident cols, chain-time it, return
+    the _measure dict + hit count."""
+    import jax
+
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.filter.compile import compile_filter
+    from geomesa_tpu.filter.ecql import parse_ecql
+
+    platform = jax.devices()[0].platform
+    sft = SimpleFeatureType.create(
+        "gdelt", "count:Int,dtg:Date,*geom:Point:srid=4326"
+    )
+    compiled = compile_filter(parse_ecql(ecql), sft)
+    assert compiled.fully_on_device, ecql
+    engine = engine or args.engine
+    scan_fn = None
+    if engine == "pallas":
+        scan = compiled.pallas_scan()
+        if scan is not None:
+            scan_fn = scan[0]
+    if scan_fn is None:
+        def scan_fn(c):
+            return compiled.device_fn(c).sum()
+    n = len(next(iter(cols.values())))
+    sub = {k: cols[k] for k in compiled.device_cols}
+    bytes_per_row = sum(v.dtype.itemsize for v in sub.values())
+    hits = int(jax.jit(scan_fn)(sub))
+    k = args.chain
+    chain = _chain(scan_fn, k)
+    total = int(chain(sub))
+    assert total == (k * hits) % (1 << 32)
+    m = _measure(chain, (sub,), args, k, n, bytes_per_row, platform, label)
+    m["hits"] = hits
+    m["selectivity"] = round(hits / n, 6)
+    return m
+
+
+def bench_polygon(args) -> dict:
+    """BASELINE config #3 shape (NYC-taxi borough polygon + time range):
+    polygon-INTERSECTS + during over device-resident points — the device
+    point-in-polygon kernel (filter/compile points_in_polygon_jax), not a
+    bbox approximation."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    n = _default_n(args, platform)
+    log(f"platform={platform} n={n:,} (polygon mode)")
+    cols = _gdelt_cols(args, n)
+    # an 8-vertex non-convex "borough" over western Europe
+    poly = (
+        "POLYGON ((-10 35, 5 33, 12 38, 20 36, 25 47, 10 52, 2 48, "
+        "-6 50, -10 35))"
+    )
+    ecql = (
+        f"INTERSECTS(geom, {poly}) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-01-15T00:00:00Z"
+    )
+    # XLA engine: the Pallas point-in-polygon tile kernel trips a Mosaic
+    # bool-convert lowering recursion under x64 on the current TPU stack;
+    # the XLA-fused crossing-number kernel is the measured path
+    m = _scan_metric(args, cols, ecql, "polygon", engine="xla")
+    log(f"polygon hits={m['hits']:,} (selectivity {m['selectivity']:.4%})")
+    return m
+
+
+def bench_density_knn(args) -> dict:
+    """BASELINE config #4 shape (AIS kNN + spatio-temporal density):
+    the fused density kernel (mask + scatter-add, one dispatch) timed at
+    scan scale, plus the end-to-end kNN process wall clock on a resident
+    store."""
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    # scatter-add into 64K grid cells is XLA-scatter-bound (~0.15B rows/s
+    # on v5e — still >2x the per-chip north-star share, but 12x slower
+    # than the pure scans): smaller n + shorter chain keep the suite's
+    # wall clock sane without changing the per-row rate
+    n = args.n or ((1 << 26) if platform == "tpu" else (1 << 20))
+    log(f"platform={platform} n={n:,} (density mode)")
+    cols = _gdelt_cols(args, n)
+
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.filter.compile import compile_filter
+    from geomesa_tpu.filter.ecql import parse_ecql
+
+    sft = SimpleFeatureType.create(
+        "gdelt", "count:Int,dtg:Date,*geom:Point:srid=4326"
+    )
+    ecql = (
+        "BBOX(geom, -10, 35, 30, 60) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-01-15T00:00:00Z"
+    )
+    compiled = compile_filter(parse_ecql(ecql), sft)
+    W = H = 256
+
+    def density_fn(c):
+        m = compiled.device_fn(c)
+        x, y = c["geom__x"], c["geom__y"]
+        sx = W / 40.0
+        sy = H / 25.0
+        px = jnp.clip(jnp.floor((x - (-10.0)) * sx), 0, W - 1).astype(jnp.int32)
+        py = jnp.clip(jnp.floor((y - 35.0) * sy), 0, H - 1).astype(jnp.int32)
+        grid = jnp.zeros(H * W, jnp.float32)
+        grid = grid.at[py * W + px].add(m.astype(jnp.float32))
+        return grid.sum().astype(jnp.uint32)  # scalar sync, forces scatter
+
+    sub = {k: cols[k] for k in compiled.device_cols}
+    bytes_per_row = sum(v.dtype.itemsize for v in sub.values())
+    k = min(args.chain, 4)  # ~0.5s/invocation: a long chain buys nothing
+    chain = _chain(density_fn, k)
+    int(chain(sub))
+    m = _measure(
+        chain, (sub,), args, k, n, bytes_per_row, platform, "density"
+    )
+
+    # kNN end-to-end through the store surface (host planning + device
+    # scans; n kept modest — this measures the PROCESS, not the kernel)
+    import numpy as np
+    import time as _t
+
+    from geomesa_tpu.process.knn import knn
+    from geomesa_tpu.store.memory import MemoryDataStore
+
+    kn = min(1 << 18, n)  # end-to-end process metric; store path re-stages
+    # columns per window query, so row count mostly scales constant costs
+    rng = np.random.default_rng(3)
+    ds = MemoryDataStore()
+    ds.create_schema("ais", "dtg:Date,*geom:Point:srid=4326")
+    ds.write("ais", {
+        "dtg": rng.integers(1_577_836_800_000, 1_583_020_800_000, kn),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, kn), rng.uniform(-90, 90, kn)], axis=1
+        ),
+    })
+    ds.flush("ais") if hasattr(ds, "flush") else None
+    t0 = _t.perf_counter()
+    batch, _d = knn(ds, "ais", 2.35, 48.85, k=100)
+    knn_ms = (_t.perf_counter() - t0) * 1e3
+    assert len(batch) == 100
+    log(f"kNN k=100 over {kn:,} rows: {knn_ms:.0f}ms end-to-end")
+    m["knn_ms"] = round(knn_ms, 1)
+    m["knn_n"] = kn
+    return m
+
+
+def bench_sweep(args, cols) -> list:
+    """Selectivity sweep over the resident uniform columns: city-, country-
+    and continent-scale windows (round 2 measured ONE point in filter
+    space; selectivity-dependent effects were invisible)."""
+    out = []
+    for label, box in (
+        ("city", "BBOX(geom, 2.0, 48.5, 2.7, 49.0)"),
+        ("country", "BBOX(geom, -10, 35, 30, 60)"),
+        ("continent", "BBOX(geom, -30, 10, 60, 75)"),
+    ):
+        ecql = (
+            f"{box} AND "
+            "dtg DURING 2020-01-10T00:00:00Z/2020-02-20T00:00:00Z"
+        )
+        m = _scan_metric(args, cols, ecql, f"sweep:{label}")
+        out.append({
+            "window": label,
+            "selectivity": m["selectivity"],
+            "feats_per_sec": m["value"],
+            "gbps": m["gbps"],
+        })
+    return out
 
 
 def bench_build(args) -> dict:
@@ -380,13 +616,19 @@ def bench_build(args) -> dict:
     if args.check:
         import numpy as np
 
+        # reduced-n check: the oracle fetches the full sorted arrays to
+        # the host, and pulling GBs through the axon tunnel takes longer
+        # than the whole benchmark; sort correctness is size-independent
+        nc = min(n, 1 << 22)
+        xc_, yc_, tc_ = x[:nc], y[:nc], t[:nc]
+
         @jax.jit
         def build_full(xc, yc, tc):
             hi, lo = sfc.index_jax_hi_lo(xc, yc, tc)
-            rid = jnp.arange(n, dtype=jnp.uint32)
+            rid = jnp.arange(nc, dtype=jnp.uint32)
             return jax.lax.sort((hi, lo, rid), num_keys=2)
 
-        hi_s, lo_s, rid_s = build_full(x, y, t)
+        hi_s, lo_s, rid_s = build_full(xc_, yc_, tc_)
         hi_s = np.asarray(hi_s).astype(np.uint64)
         lo_s = np.asarray(lo_s).astype(np.uint64)
         got = (hi_s << np.uint64(32)) | lo_s
@@ -394,7 +636,7 @@ def bench_build(args) -> dict:
         # f64-parity of the encode itself is covered by the unit tests),
         # host-sorted, must equal the device-sorted output exactly; the
         # rid permutation must reproduce the unsorted keys
-        hi_u, lo_u = jax.jit(sfc.index_jax_hi_lo)(x, y, t)
+        hi_u, lo_u = jax.jit(sfc.index_jax_hi_lo)(xc_, yc_, tc_)
         z_u = (np.asarray(hi_u).astype(np.uint64) << np.uint64(32)) | np.asarray(
             lo_u
         ).astype(np.uint64)
@@ -431,6 +673,9 @@ def bench_build(args) -> dict:
 
 
 def main() -> None:
+    # deep jaxpr traces (polygon crossing-number unroll under the remote
+    # compile path) exceed the default 1000-frame recursion limit
+    sys.setrecursionlimit(100_000)
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=None, help="rows resident on device")
     ap.add_argument("--iters", type=int, default=10)
@@ -455,11 +700,12 @@ def main() -> None:
     )
     ap.add_argument(
         "--mode",
-        choices=("all", "filter", "zscan", "build"),
+        choices=(
+            "all", "filter", "zscan", "build", "polygon", "density", "sweep",
+        ),
         default="all",
-        help="all: filter scan + key-only z scan + Z3 build, one JSON "
-        "line with everything (what the driver records); "
-        "filter / zscan / build: that one alone",
+        help="all: every benchmark, one JSON line with everything (what "
+        "the driver records); any other value runs that one alone",
     )
     args = ap.parse_args()
 
@@ -469,12 +715,57 @@ def main() -> None:
         out = bench_zscan(args)
     elif args.mode == "build":
         out = bench_build(args)
+    elif args.mode == "polygon":
+        out = bench_polygon(args)
+    elif args.mode == "density":
+        out = bench_density_knn(args)
+    elif args.mode == "sweep":
+        import jax
+
+        n = _default_n(args, jax.devices()[0].platform)
+        out = {"sweep": bench_sweep(args, _gdelt_cols(args, n))}
     else:
         out = bench_filter(args)
         z = bench_zscan(args)
         out["zscan_feats_per_sec"] = z["value"]
         out["zscan_gbps"] = z["gbps"]
         out["zscan_hbm_pct"] = z["hbm_pct"]
+        # BASELINE config #3: polygon-intersects + time over resident points
+        p = bench_polygon(args)
+        out["polygon_feats_per_sec"] = p["value"]
+        out["polygon_gbps"] = p["gbps"]
+        out["polygon_hbm_pct"] = p["hbm_pct"]
+        out["polygon_selectivity"] = p["selectivity"]
+        # BASELINE config #4: fused density + end-to-end kNN
+        d = bench_density_knn(args)
+        out["density_feats_per_sec"] = d["value"]
+        out["density_hbm_pct"] = d["hbm_pct"]
+        out["knn_ms"] = d["knn_ms"]
+        # skewed (clustered) data: same flagship filter over GDELT-like
+        # city clusters — selectivity shifts, throughput must hold.
+        # Half-size columns: earlier phases' frees leave fragmented HBM,
+        # and a throughput sample needs bandwidth-saturating n, not max n
+        import gc
+
+        import jax as _jax
+
+        gc.collect()
+        n_sk = args.n or (
+            (1 << 27) if _jax.devices()[0].platform == "tpu" else (1 << 20)
+        )
+        skew_cols = _gdelt_cols(args, n_sk, skew=True)
+        sk = _scan_metric(
+            args, skew_cols,
+            "BBOX(geom, -10, 35, 30, 60) AND "
+            "dtg DURING 2020-01-10T00:00:00Z/2020-01-15T00:00:00Z",
+            "skewed-scan",
+        )
+        out["skew_feats_per_sec"] = sk["value"]
+        out["skew_selectivity"] = sk["selectivity"]
+        del skew_cols
+        gc.collect()
+        # selectivity sweep on uniform data
+        out["sweep"] = bench_sweep(args, _gdelt_cols(args, n_sk))
         build = bench_build(args)
         out["build_pts_per_sec"] = build["value"]
         out["build_chain"] = build["build_chain"]

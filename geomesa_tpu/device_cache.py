@@ -244,6 +244,11 @@ class DeviceIndex:
         key = tuple(sorted(str(a) for a in (auths or ())))
         tab = self._auth_tables.get(key)
         if tab is None:
+            if len(self._auth_tables) >= 256:
+                # bounded: the auth set comes straight from untrusted
+                # request input; an attacker cycling made-up auth strings
+                # must not grow device allocations without limit
+                self._auth_tables.clear()
             cap = max(16, _next_pow2(len(self._vis_vocab)))
             vals = np.zeros(cap, dtype=bool)
             ev = VisibilityEvaluator(auths or ())

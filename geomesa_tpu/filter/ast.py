@@ -82,6 +82,14 @@ class BBox(Filter):
     xmax: float
     ymax: float
 
+    def __post_init__(self):
+        # normalize numpy scalars (e.g. a kNN window computed in float64)
+        # to plain Python floats: numpy scalars are STRONG-typed under
+        # jax and would silently promote float32 device planes to float64
+        # inside the scan kernels — which Mosaic cannot lower on TPU
+        for f in ("xmin", "ymin", "xmax", "ymax"):
+            object.__setattr__(self, f, float(getattr(self, f)))
+
     @property
     def envelope(self) -> Envelope:
         return Envelope(self.xmin, self.ymin, self.xmax, self.ymax)
@@ -109,6 +117,10 @@ class DWithin(Filter):
     attr: str
     geometry: Geometry
     distance: float
+
+    def __post_init__(self):
+        # same numpy-scalar normalization as BBox (f64 promotion guard)
+        object.__setattr__(self, "distance", float(self.distance))
 
 
 @dataclass(frozen=True)

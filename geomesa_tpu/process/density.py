@@ -63,7 +63,15 @@ def density(
         if grid is not None:
             return grid
         # filter or planes not resident: fall through to the store path
-    res = store.query(type_name, Query(filter=filt, hints={"auths": auths}))
+    # a caller-supplied full Query keeps ALL its attributes/hints
+    # (max-features, sampling, ...) on the store path; only bare filters
+    # get wrapped to carry the auths
+    store_q = (
+        query
+        if isinstance(query, Query)
+        else Query(filter=filt, hints={"auths": auths})
+    )
+    res = store.query(type_name, store_q)
     batch = res.batch
     if len(batch) == 0:
         return np.zeros((height, width), dtype=np.float32)

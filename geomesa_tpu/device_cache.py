@@ -662,6 +662,64 @@ class DeviceIndex:
             np.nonzero(self.mask(query, loose=loose, auths=auths))[0]
         )
 
+    def bbox_window_query(self, xmin, ymin, xmax, ymax, auths=None):
+        """Bbox query with RUNTIME bounds: one compiled kernel serves
+        every window, where query()'s per-filter compile-and-cache would
+        pay a recompile per distinct bbox — the expanding-window search
+        pattern (kNN, proximity) probes dozens of bboxes per call.
+        Returns the matching host rows, or None when the coordinate
+        planes are not resident (caller falls back to query()). Bounds
+        are widened one ulp outward in the plane dtype, so a float32
+        resident copy can only over-include (safe for candidate scans)."""
+        import jax
+        import jax.numpy as jnp
+
+        geom = self.sft.geom_field
+        gx, gy = f"{geom}__x", f"{geom}__y"
+        if geom is None or gx not in self._cols:
+            return None
+        if getattr(self, "_window_jit", None) is None:
+            def wmask(cols, env, valid, auth_tab):
+                m = (
+                    (cols[gx] >= env[0])
+                    & (cols[gx] <= env[2])
+                    & (cols[gy] >= env[1])
+                    & (cols[gy] <= env[3])
+                )
+                if valid is not None:
+                    m = m & valid
+                if auth_tab is not None:
+                    m = m & auth_tab[cols[VIS_ID]]
+                return m
+
+            self._window_jit = jax.jit(wmask)
+        dt = np.dtype(self._cols[gx].dtype)
+        env = np.array(
+            [
+                np.nextafter(dt.type(xmin), dt.type(-np.inf)),
+                np.nextafter(dt.type(ymin), dt.type(-np.inf)),
+                np.nextafter(dt.type(xmax), dt.type(np.inf)),
+                np.nextafter(dt.type(ymax), dt.type(np.inf)),
+            ],
+            dtype=dt,
+        )
+        has_vis = VIS_ID in self._cols
+        # only the planes the mask reads: the full resident dict would pay
+        # a flatten/hash over every column per probe and retrace whenever
+        # an unrelated plane changes
+        sub = {gx: self._cols[gx], gy: self._cols[gy]}
+        if has_vis:
+            sub[VIS_ID] = self._cols[VIS_ID]
+        m = np.asarray(
+            self._window_jit(
+                sub,
+                jnp.asarray(env),
+                self._device_valid(),
+                self._auth_table(auths) if has_vis else None,
+            )
+        )[: self._staged_len()]
+        return self._host_rows().take(np.nonzero(m)[0])
+
     # -- pushdown stats (StatsIterator analog) -----------------------------
 
     def stats(
@@ -1278,6 +1336,12 @@ class StreamingDeviceIndex(DeviceIndex):
             return super().bin_export(
                 query, track_attr, dtg_attr=dtg_attr, geom_attr=geom_attr,
                 label_attr=label_attr, sort=sort, loose=loose, auths=auths,
+            )
+
+    def bbox_window_query(self, xmin, ymin, xmax, ymax, auths=None):
+        with self._lock:
+            return super().bbox_window_query(
+                xmin, ymin, xmax, ymax, auths=auths
             )
 
     def __len__(self) -> int:

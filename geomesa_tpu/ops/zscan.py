@@ -281,17 +281,30 @@ BT_BIN_SPAN = 1 << (32 - BT_TIME_BITS)  # max bins representable (2^11)
 
 def z3_dim_planes(sfc, nx, ny, nt, bins, bin_base: int):
     """Pack quantized dims + bins into the scan planes (host or device
-    arrays; works under numpy and jnp). ``bins - bin_base`` must lie in
-    [0, BT_BIN_SPAN) — callers derive bin_base from the data's min bin
-    and fall back to the masked-compare planes for wider spans."""
+    arrays; works under numpy and jnp, including inside jit).
+
+    Rows whose ``bins - bin_base`` falls outside [0, BT_BIN_SPAN - 1) get
+    the SENTINEL bt 0xFFFFFFFF — the top packable bin's space, which the
+    query builder refuses to address — so out-of-window rows are
+    deterministically unmatchable rather than silently wrapping into
+    another bin's key space. Callers derive bin_base from the data's min
+    bin (and fall back to the masked-compare planes for spans that do not
+    fit)."""
     if sfc.precision != BT_TIME_BITS:
         # nt wider than 21 bits would silently bleed into the bin field
         raise ValueError(
             f"dim-plane packing requires precision {BT_TIME_BITS} "
             f"(got {sfc.precision}); use the masked-compare planes"
         )
-    rel = bins - bin_base
-    bt = (rel.astype(nx.dtype) << BT_TIME_BITS) | nt
+    rel = (bins - bin_base).astype(nx.dtype)  # negatives wrap huge (u32)
+    bt = (rel << BT_TIME_BITS) | nt
+    oob = rel >= (BT_BIN_SPAN - 1)
+    if hasattr(bt, "at") and not isinstance(bt, np.ndarray):  # jnp path
+        import jax.numpy as jnp
+
+        bt = jnp.where(oob, jnp.uint32(0xFFFFFFFF), bt)
+    else:
+        bt = np.where(oob, np.uint32(0xFFFFFFFF), bt)
     return nx, ny, bt
 
 
@@ -318,7 +331,9 @@ def z3_dim_plane_query(
     ranges: list = []
     for b, lo_off, hi_off in bins_for_interval(tmin_ms, tmax_ms, sfc.period):
         rel = b - bin_base
-        if not (0 <= rel < BT_BIN_SPAN):
+        # top bin reserved: it is the out-of-window SENTINEL space of
+        # z3_dim_planes and must never be addressable by a query
+        if not (0 <= rel < BT_BIN_SPAN - 1):
             return None
         lo = (rel << BT_TIME_BITS) | int(sfc.time.normalize(lo_off))
         hi = (rel << BT_TIME_BITS) | int(sfc.time.normalize(hi_off))

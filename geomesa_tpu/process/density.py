@@ -64,13 +64,17 @@ def density(
             return grid
         # filter or planes not resident: fall through to the store path
     # a caller-supplied full Query keeps ALL its attributes/hints
-    # (max-features, sampling, ...) on the store path; only bare filters
-    # get wrapped to carry the auths
-    store_q = (
-        query
-        if isinstance(query, Query)
-        else Query(filter=filt, hints={"auths": auths})
-    )
+    # (max-features, sampling, ...) on the store path — with the RESOLVED
+    # auths merged in (the Query's own hint won in _split_query; a bare
+    # auths kwarg must not be dropped here)
+    if isinstance(query, Query):
+        import dataclasses
+
+        hints = dict(query.hints)
+        hints["auths"] = auths
+        store_q = dataclasses.replace(query, hints=hints)
+    else:
+        store_q = Query(filter=filt, hints={"auths": auths})
     res = store.query(type_name, store_q)
     batch = res.batch
     if len(batch) == 0:

@@ -41,13 +41,21 @@ def knn(
     base_filter: "ast.Filter | str | None" = None,
     initial_radius_deg: float = 0.05,
     max_radius_deg: float = 45.0,
+    device_index=None,
+    auths=None,
 ):
     """Returns (batch_of_k_nearest, distances_deg), nearest first.
 
     If fewer than k features exist inside the ``max_radius_deg`` box
     around the target, only those are returned — the search never widens
     past that box, so a sparse region costs one max-radius scan instead
-    of an unbounded base-filter scan."""
+    of an unbounded base-filter scan.
+
+    With a resident ``device_index`` each expanding-window probe is one
+    fused device scan over the pinned columns (no per-query column
+    staging — the store path re-uploads the scan planes on every window,
+    which dominates the search's wall clock); ``auths`` applies the
+    resident per-auth row security (store path: default fail-closed)."""
     from geomesa_tpu.filter.ecql import parse_ecql
 
     base = (
@@ -59,7 +67,17 @@ def knn(
     geom = sft.geom_field
 
     def window(rx: float, ry: float):
+        if device_index is not None and base is ast.Include:
+            # runtime-bounds kernel: ONE compile serves every window of
+            # the expanding search (per-filter compile would dominate)
+            got = device_index.bbox_window_query(
+                px - rx, py - ry, px + rx, py + ry, auths=auths
+            )
+            if got is not None:
+                return got
         f = ast.And((ast.BBox(geom, px - rx, py - ry, px + rx, py + ry), base))
+        if device_index is not None:
+            return device_index.query(f, auths=auths)
         return store.query(type_name, internal_query(f)).batch
 
     r = initial_radius_deg

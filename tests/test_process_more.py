@@ -116,3 +116,29 @@ def test_bin_conversion(store):
     rec = decode_bin(payload)
     assert len(rec) == 3
     assert list(rec["dtg"]) == [1, 2, 3]  # seconds, sorted
+
+
+def test_knn_resident_matches_store_path():
+    """kNN over a resident DeviceIndex returns exactly the store path's
+    neighbors (same expanding-window algorithm, fused window scans)."""
+    import numpy as np
+
+    from geomesa_tpu.device_cache import DeviceIndex
+    from geomesa_tpu.process.knn import knn
+    from geomesa_tpu.store.memory import MemoryDataStore
+
+    ds = MemoryDataStore()
+    ds.create_schema("kp", "c:Int,*geom:Point:srid=4326")
+    rng = np.random.default_rng(9)
+    n = 3000
+    ds.write("kp", {
+        "c": np.arange(n),
+        "geom": np.stack(
+            [rng.uniform(-30, 30, n), rng.uniform(-30, 30, n)], axis=1
+        ),
+    })
+    di = DeviceIndex(ds, "kp")
+    b_store, d_store = knn(ds, "kp", 2.0, 5.0, k=25)
+    b_res, d_res = knn(ds, "kp", 2.0, 5.0, k=25, device_index=di)
+    np.testing.assert_array_equal(b_res.fids, b_store.fids)
+    np.testing.assert_allclose(d_res, d_store)

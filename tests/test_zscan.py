@@ -300,3 +300,36 @@ class TestDimPlaneScan:
             1_577_836_800_000, 1_578_441_600_000, 10_000,
         )
         assert out is None
+
+    def test_out_of_window_rows_get_sentinel(self, rng):
+        """Rows outside the packable bin window become deterministically
+        unmatchable (sentinel bt), never another bin's key space."""
+        import jax.numpy as jnp
+
+        from geomesa_tpu.curves import Z3SFC
+        from geomesa_tpu.ops import zscan
+
+        sfc = Z3SFC()
+        nx = np.zeros(4, np.uint32)
+        ny = np.zeros(4, np.uint32)
+        nt = np.zeros(4, np.uint32)
+        bins = np.array([100, 99, 100 + zscan.BT_BIN_SPAN, 101], np.uint32)
+        _, _, bt = zscan.z3_dim_planes(sfc, nx, ny, nt, bins, 100)
+        assert bt[1] == 0xFFFFFFFF  # below window
+        assert bt[2] == 0xFFFFFFFF  # above window
+        assert bt[0] != 0xFFFFFFFF and bt[3] != 0xFFFFFFFF
+        # the reserved sentinel bin is never addressable by a query
+        lo_ms = 100 * (7 * 86400_000)
+        top = (100 + zscan.BT_BIN_SPAN - 1) * (7 * 86400_000)
+        assert zscan.z3_dim_plane_query(
+            sfc, 0.0, 0.0, 1.0, 1.0, top, top + 1000, 100
+        ) is None
+        # in-window queries never match the sentinel rows
+        dq = zscan.z3_dim_plane_query(
+            sfc, -180.0, -90.0, 180.0, 90.0, lo_ms, lo_ms + 10_000, 100
+        )
+        qnx, qny, rs = dq
+        m = np.asarray(zscan.z3_dimscan_mask(
+            jnp.asarray(nx), jnp.asarray(ny), jnp.asarray(bt), qnx, qny, rs
+        ))
+        assert not m[1] and not m[2]

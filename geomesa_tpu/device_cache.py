@@ -662,15 +662,22 @@ class DeviceIndex:
             np.nonzero(self.mask(query, loose=loose, auths=auths))[0]
         )
 
-    def bbox_window_query(self, xmin, ymin, xmax, ymax, auths=None):
-        """Bbox query with RUNTIME bounds: one compiled kernel serves
-        every window, where query()'s per-filter compile-and-cache would
-        pay a recompile per distinct bbox — the expanding-window search
-        pattern (kNN, proximity) probes dozens of bboxes per call.
-        Returns the matching host rows, or None when the coordinate
-        planes are not resident (caller falls back to query()). Bounds
-        are widened one ulp outward in the plane dtype, so a float32
-        resident copy can only over-include (safe for candidate scans)."""
+    def window_union_query(self, envs, times=None, auths=None):
+        """Candidate rows matching ANY of m runtime windows in ONE
+        dispatch — the corridor/buffer coarse pass (tube select: one
+        bbox+time window per track segment; proximity: one expanded bbox
+        per input geometry). Issuing them as separate queries would pay a
+        per-window kernel compile AND a per-window dispatch; here the
+        windows are runtime arrays (padded to a power of two, so one
+        compiled kernel serves any track length) broadcast against the
+        resident planes.
+
+        ``envs``: (m, 4) [xmin, ymin, xmax, ymax]; ``times``: optional
+        (m, 2) int64 [t_lo, t_hi] epoch-ms tested against the default
+        date field's hi/lo planes. Returns matching host rows, or None
+        when the needed planes are not resident. Bounds widen one ulp
+        outward (float32 residency can only over-include — candidate
+        semantics; callers run an exact refinement pass)."""
         import jax
         import jax.numpy as jnp
 
@@ -678,47 +685,96 @@ class DeviceIndex:
         gx, gy = f"{geom}__x", f"{geom}__y"
         if geom is None or gx not in self._cols:
             return None
-        if getattr(self, "_window_jit", None) is None:
-            def wmask(cols, env, valid, auth_tab):
-                m = (
-                    (cols[gx] >= env[0])
-                    & (cols[gx] <= env[2])
-                    & (cols[gy] >= env[1])
-                    & (cols[gy] <= env[3])
-                )
-                if valid is not None:
-                    m = m & valid
-                if auth_tab is not None:
-                    m = m & auth_tab[cols[VIS_ID]]
-                return m
-
-            self._window_jit = jax.jit(wmask)
+        dtg = self.sft.dtg_field
+        thi = tlo = None
+        if times is not None:
+            thi, tlo = f"{dtg}__hi", f"{dtg}__lo"
+            if dtg is None or thi not in self._cols:
+                return None
+        envs = np.asarray(envs, np.float64).reshape(-1, 4)
+        m = envs.shape[0]
+        cap = _next_pow2(max(m, 1))
         dt = np.dtype(self._cols[gx].dtype)
-        env = np.array(
-            [
-                np.nextafter(dt.type(xmin), dt.type(-np.inf)),
-                np.nextafter(dt.type(ymin), dt.type(-np.inf)),
-                np.nextafter(dt.type(xmax), dt.type(np.inf)),
-                np.nextafter(dt.type(ymax), dt.type(np.inf)),
-            ],
-            dtype=dt,
-        )
+        env_pad = np.empty((cap, 4), dt)
+        env_pad[:m, 0] = np.nextafter(envs[:, 0].astype(dt), dt.type(-np.inf))
+        env_pad[:m, 1] = np.nextafter(envs[:, 1].astype(dt), dt.type(-np.inf))
+        env_pad[:m, 2] = np.nextafter(envs[:, 2].astype(dt), dt.type(np.inf))
+        env_pad[:m, 3] = np.nextafter(envs[:, 3].astype(dt), dt.type(np.inf))
+        env_pad[m:] = [1.0, 1.0, 0.0, 0.0]  # inverted: matches nothing
+        targs = ()
+        if times is not None:
+            times = np.asarray(times, np.int64).reshape(-1, 2)
+            tp = np.zeros((cap, 2), np.int64)
+            tp[:m] = times
+            tp[m:] = [1, 0]  # inverted window
+            # int64 bounds as hi/lo uint32 lane pairs (TPU-safe)
+            targs = (
+                jnp.asarray((tp >> 32).astype(np.int32)),
+                jnp.asarray((tp & 0xFFFFFFFF).astype(np.uint32)),
+            )
+        use_time = times is not None
         has_vis = VIS_ID in self._cols
-        # only the planes the mask reads: the full resident dict would pay
-        # a flatten/hash over every column per probe and retrace whenever
-        # an unrelated plane changes
+        jit_key = ("union", use_time, has_vis)
+        if not hasattr(self, "_union_jits"):
+            self._union_jits = {}
+        fn = self._union_jits.get(jit_key)
+        if fn is None:
+            def umask(cols, env, tb, valid, auth_tab):
+                x = cols[gx][:, None]
+                y = cols[gy][:, None]
+                hit = (
+                    (x >= env[None, :, 0])
+                    & (x <= env[None, :, 2])
+                    & (y >= env[None, :, 1])
+                    & (y <= env[None, :, 3])
+                )
+                if tb is not None:
+                    bh, bl = tb
+                    vh = cols[thi][:, None]
+                    vl = cols[tlo][:, None]
+                    ge = (vh > bh[None, :, 0]) | (
+                        (vh == bh[None, :, 0]) & (vl >= bl[None, :, 0])
+                    )
+                    le = (vh < bh[None, :, 1]) | (
+                        (vh == bh[None, :, 1]) & (vl <= bl[None, :, 1])
+                    )
+                    hit = hit & ge & le
+                mask = jnp.any(hit, axis=1)
+                if valid is not None:
+                    mask = mask & valid
+                if auth_tab is not None:
+                    mask = mask & auth_tab[cols[VIS_ID]]
+                return mask
+
+            fn = jax.jit(umask)
+            self._union_jits[jit_key] = fn
         sub = {gx: self._cols[gx], gy: self._cols[gy]}
+        if use_time:
+            sub[thi] = self._cols[thi]
+            sub[tlo] = self._cols[tlo]
         if has_vis:
             sub[VIS_ID] = self._cols[VIS_ID]
-        m = np.asarray(
-            self._window_jit(
+        mask = np.asarray(
+            fn(
                 sub,
-                jnp.asarray(env),
+                jnp.asarray(env_pad),
+                targs if use_time else None,
                 self._device_valid(),
                 self._auth_table(auths) if has_vis else None,
             )
         )[: self._staged_len()]
-        return self._host_rows().take(np.nonzero(m)[0])
+        return self._host_rows().take(np.nonzero(mask)[0])
+
+    def bbox_window_query(self, xmin, ymin, xmax, ymax, auths=None):
+        """Bbox query with RUNTIME bounds: one compiled kernel serves
+        every window, where query()'s per-filter compile-and-cache would
+        pay a recompile per distinct bbox — the expanding-window search
+        pattern (kNN) probes dozens of bboxes per call. The m=1 case of
+        :meth:`window_union_query` (same kernel, widening, validity and
+        auth plumbing)."""
+        return self.window_union_query(
+            np.array([[xmin, ymin, xmax, ymax]], np.float64), auths=auths
+        )
 
     # -- pushdown stats (StatsIterator analog) -----------------------------
 
@@ -1338,11 +1394,10 @@ class StreamingDeviceIndex(DeviceIndex):
                 label_attr=label_attr, sort=sort, loose=loose, auths=auths,
             )
 
-    def bbox_window_query(self, xmin, ymin, xmax, ymax, auths=None):
+    def window_union_query(self, envs, times=None, auths=None):
+        # (bbox_window_query delegates here, so this one lock covers both)
         with self._lock:
-            return super().bbox_window_query(
-                xmin, ymin, xmax, ymax, auths=auths
-            )
+            return super().window_union_query(envs, times=times, auths=auths)
 
     def __len__(self) -> int:
         return self._n - self._n_dead

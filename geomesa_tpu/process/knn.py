@@ -54,8 +54,8 @@ def knn(
     With a resident ``device_index`` each expanding-window probe is one
     fused device scan over the pinned columns (no per-query column
     staging — the store path re-uploads the scan planes on every window,
-    which dominates the search's wall clock); ``auths`` applies the
-    resident per-auth row security (store path: default fail-closed)."""
+    which dominates the search's wall clock); ``auths`` applies row
+    security on BOTH paths (absent = none, fail closed)."""
     from geomesa_tpu.filter.ecql import parse_ecql
 
     base = (
@@ -78,7 +78,7 @@ def knn(
         f = ast.And((ast.BBox(geom, px - rx, py - ry, px + rx, py + ry), base))
         if device_index is not None:
             return device_index.query(f, auths=auths)
-        return store.query(type_name, internal_query(f)).batch
+        return store.query(type_name, internal_query(f, auths=auths)).batch
 
     r = initial_radius_deg
     batch = None

@@ -35,9 +35,15 @@ def proximity_search(
     inputs,
     distance_deg: float,
     base_filter: "ast.Filter | str | None" = None,
+    device_index=None,
+    auths=None,
 ):
     """Returns (batch, dist_deg): data features within ``distance_deg`` of
-    any input geometry, with the distance to the nearest input."""
+    any input geometry, with the distance to the nearest input.
+
+    With a resident ``device_index`` (and no base filter) the candidate
+    pass is ONE device dispatch over all input buffers
+    (window_union_query) instead of a compiled OR-of-bboxes store query."""
     from geomesa_tpu.filter.ecql import parse_ecql
     from geomesa_tpu.sql.functions import _segments_of, pt_seg_project
 
@@ -51,21 +57,37 @@ def proximity_search(
     )
     sft = store.get_schema(type_name)
     geom_field = sft.geom_field
-    # one expanded bbox PER input (not one union envelope: two far-apart
-    # inputs would otherwise pull in everything between them); the planner
-    # handles OR'd bboxes and overlapping ranges are coalesced downstream
-    boxes = tuple(
-        ast.BBox(
-            geom_field,
-            g.envelope.xmin - distance_deg,
-            g.envelope.ymin - distance_deg,
-            g.envelope.xmax + distance_deg,
-            g.envelope.ymax + distance_deg,
+    batch = None
+    if device_index is not None and base is ast.Include:
+        envs = np.array(
+            [
+                [
+                    g.envelope.xmin - distance_deg,
+                    g.envelope.ymin - distance_deg,
+                    g.envelope.xmax + distance_deg,
+                    g.envelope.ymax + distance_deg,
+                ]
+                for g in geoms
+            ]
         )
-        for g in geoms
-    )
-    f = ast.And((boxes[0] if len(boxes) == 1 else ast.Or(boxes), base))
-    batch = store.query(type_name, internal_query(f)).batch
+        batch = device_index.window_union_query(envs, auths=auths)
+    if batch is None:
+        # one expanded bbox PER input (not one union envelope: two
+        # far-apart inputs would otherwise pull in everything between
+        # them); the planner handles OR'd bboxes and overlapping ranges
+        # are coalesced downstream
+        boxes = tuple(
+            ast.BBox(
+                geom_field,
+                g.envelope.xmin - distance_deg,
+                g.envelope.ymin - distance_deg,
+                g.envelope.xmax + distance_deg,
+                g.envelope.ymax + distance_deg,
+            )
+            for g in geoms
+        )
+        f = ast.And((boxes[0] if len(boxes) == 1 else ast.Or(boxes), base))
+        batch = store.query(type_name, internal_query(f, auths=auths)).batch
     if len(batch) == 0:
         return batch, np.array([])
     x, y = batch.point_coords(geom_field)

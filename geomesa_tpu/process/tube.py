@@ -23,8 +23,15 @@ def tube_select(
     buffer_deg: float,
     max_dt_ms: int,
     base_filter: "ast.Filter | str | None" = None,
+    device_index=None,
+    auths=None,
 ):
-    """Returns the matching FeatureBatch."""
+    """Returns the matching FeatureBatch.
+
+    With a resident ``device_index`` (and no base filter) the coarse pass
+    runs as ONE device dispatch: every segment's bbox+time window rides a
+    runtime array into `window_union_query`, where the store path pays a
+    per-segment query (a kernel compile + staging each)."""
     from geomesa_tpu.features.batch import FeatureBatch
     from geomesa_tpu.filter.ecql import parse_ecql
 
@@ -39,38 +46,65 @@ def tube_select(
     track_xy = np.asarray(track_xy, dtype=np.float64)
     track_t = np.asarray(track_t_ms, dtype=np.int64)
 
-    # coarse pass: one bbox+time query per track segment (the reference's
-    # per-bin tube queries), unioned
-    chunks = []
-    seen = set()
-    for i in range(len(track_xy) - 1):
-        (x0, y0), (x1, y1) = track_xy[i], track_xy[i + 1]
-        f = ast.And(
-            (
-                ast.BBox(
-                    geom,
-                    min(x0, x1) - buffer_deg,
-                    min(y0, y1) - buffer_deg,
-                    max(x0, x1) + buffer_deg,
-                    max(y0, y1) + buffer_deg,
-                ),
-                ast.During(
-                    dtg,
-                    int(min(track_t[i], track_t[i + 1]) - max_dt_ms),
-                    int(max(track_t[i], track_t[i + 1]) + max_dt_ms),
-                ),
-                base,
-            )
+    merged = None
+    if device_index is not None and base is ast.Include and len(track_xy) > 1:
+        a, b = track_xy[:-1], track_xy[1:]
+        envs = np.stack(
+            [
+                np.minimum(a[:, 0], b[:, 0]) - buffer_deg,
+                np.minimum(a[:, 1], b[:, 1]) - buffer_deg,
+                np.maximum(a[:, 0], b[:, 0]) + buffer_deg,
+                np.maximum(a[:, 1], b[:, 1]) + buffer_deg,
+            ],
+            axis=1,
         )
-        b = store.query(type_name, internal_query(f)).batch
-        if len(b):
-            chunks.append(b)
-    if not chunks:
-        return store.query(type_name, internal_query(ast.Exclude)).batch
-    merged = chunks[0] if len(chunks) == 1 else FeatureBatch.concat(chunks)
-    # dedupe by fid
-    _, first = np.unique(merged.fids, return_index=True)
-    merged = merged.take(np.sort(first))
+        ta, tb = track_t[:-1], track_t[1:]
+        times = np.stack(
+            [
+                np.minimum(ta, tb) - max_dt_ms,
+                np.maximum(ta, tb) + max_dt_ms,
+            ],
+            axis=1,
+        )
+        merged = device_index.window_union_query(envs, times, auths=auths)
+    if merged is None:
+        # coarse pass: one bbox+time query per track segment (the
+        # reference's per-bin tube queries), unioned
+        chunks = []
+        for i in range(len(track_xy) - 1):
+            (x0, y0), (x1, y1) = track_xy[i], track_xy[i + 1]
+            f = ast.And(
+                (
+                    ast.BBox(
+                        geom,
+                        min(x0, x1) - buffer_deg,
+                        min(y0, y1) - buffer_deg,
+                        max(x0, x1) + buffer_deg,
+                        max(y0, y1) + buffer_deg,
+                    ),
+                    ast.During(
+                        dtg,
+                        int(min(track_t[i], track_t[i + 1]) - max_dt_ms),
+                        int(max(track_t[i], track_t[i + 1]) + max_dt_ms),
+                    ),
+                    base,
+                )
+            )
+            b = store.query(type_name, internal_query(f, auths=auths)).batch
+            if len(b):
+                chunks.append(b)
+        if not chunks:
+            return store.query(
+                type_name, internal_query(ast.Exclude, auths=auths)
+            ).batch
+        merged = (
+            chunks[0] if len(chunks) == 1 else FeatureBatch.concat(chunks)
+        )
+        # dedupe by fid (the union query is naturally deduped)
+        _, first = np.unique(merged.fids, return_index=True)
+        merged = merged.take(np.sort(first))
+    if len(merged) == 0:
+        return merged
 
     # fine pass: exact distance to the nearest segment + time consistency
     x, y = merged.point_coords(geom)

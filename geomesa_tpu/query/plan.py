@@ -297,11 +297,15 @@ def as_query(q) -> Query:
     return Query(filter=q)
 
 
-def internal_query(f) -> Query:
+def internal_query(f, auths=None) -> Query:
     """A maintenance/candidate-scan query: exempt from user-facing caps
     like the global ``query.max.features`` (truncating an age-off sweep or
-    a kNN candidate scan would corrupt the result)."""
-    return Query(filter=f, hints={"internal": True})
+    a kNN candidate scan would corrupt the result). ``auths`` carries the
+    caller's row-security context — omitted means none (fail closed)."""
+    hints = {"internal": True}
+    if auths is not None:
+        hints["auths"] = auths
+    return Query(filter=f, hints=hints)
 
 
 def _attr_equality(f: ast.Filter, attr: str):

@@ -184,3 +184,140 @@ def test_differential_fuzz_device_stats(setup):
         g, e = got.to_json(), exp.to_json()
         # float64 'val' is int here; dtg exact via hi/lo; all exact on CPU
         assert g == e, f"filter {i} ({q!r}): {g} != {e}"
+
+
+# -- non-point (polygon / xz key space) schemas ------------------------------
+
+POLY_SPEC = "name:String,val:Int,dtg:Date,*geom:Polygon:srid=4326"
+
+
+def _poly_data(n=2500):
+    rng = np.random.default_rng(17)
+    x = rng.uniform(-170, 160, n)
+    y = rng.uniform(-85, 75, n)
+    w = rng.uniform(0.01, 6.0, n)
+    h = rng.uniform(0.01, 6.0, n)
+    wkt = np.array(
+        [
+            f"POLYGON (({a:.5f} {b:.5f}, {a + c:.5f} {b:.5f}, "
+            f"{a + c:.5f} {b + d:.5f}, {a:.5f} {b + d:.5f}, "
+            f"{a:.5f} {b:.5f}))"
+            for a, b, c, d in zip(x, y, w, h)
+        ],
+        dtype=object,
+    )
+    return {
+        "name": rng.choice(["a", "b", "c"], n),
+        "val": rng.integers(-50, 50, n),
+        "dtg": rng.integers(T0, T1, n),
+        "geom": wkt,
+    }
+
+
+@pytest.fixture(scope="module")
+def poly_setup(tmp_path_factory):
+    cols = _poly_data()
+    n = len(cols["val"])
+    sft = SimpleFeatureType.create("p", POLY_SPEC)
+    batch = FeatureBatch.from_columns(sft, cols, np.arange(n))
+    stores = {
+        "memory": MemoryDataStore(),
+        "kv": KVDataStore(MemoryKV()),
+        "fs": FileSystemDataStore(
+            str(tmp_path_factory.mktemp("fuzz_fs_poly")), partition_size=512
+        ),
+    }
+    for s in stores.values():
+        s.create_schema("p", POLY_SPEC)
+        s.write("p", cols, fids=np.arange(n))
+        if hasattr(s, "flush"):
+            s.flush("p")
+    return batch, stores
+
+
+def _rand_poly_filter(r: random.Random, depth=0) -> str:
+    """bbox/during/attr/intersects over a non-point schema (xz3 primary)."""
+
+    def bbox():
+        x0, y0 = r.uniform(-180, 160), r.uniform(-90, 70)
+        return (
+            f"BBOX(geom, {x0:.3f}, {y0:.3f}, "
+            f"{x0 + r.uniform(1, 90):.3f}, {y0 + r.uniform(1, 50):.3f})"
+        )
+
+    def during():
+        import datetime
+
+        a = r.randint(T0, T1 - 1)
+        b = r.randint(a, T1)
+        f = lambda ms: datetime.datetime.fromtimestamp(  # noqa: E731
+            ms / 1000, datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%SZ")
+        return f"dtg DURING {f(a)}/{f(b)}"
+
+    def attr():
+        return r.choice(
+            [
+                f"val >= {r.randint(-50, 50)}",
+                f"name = '{r.choice('abc')}'",
+            ]
+        )
+
+    def isect():
+        cx, cy = r.uniform(-120, 120), r.uniform(-60, 60)
+        s = r.uniform(2, 30)
+        return (
+            f"INTERSECTS(geom, POLYGON(({cx:.3f} {cy:.3f}, "
+            f"{cx + s:.3f} {cy:.3f}, {cx + s:.3f} {cy + s:.3f}, "
+            f"{cx:.3f} {cy + s:.3f}, {cx:.3f} {cy:.3f})))"
+        )
+
+    x = r.random()
+    if depth < 2 and x < 0.3:
+        op = r.choice(["AND", "OR"])
+        return (
+            f"({_rand_poly_filter(r, depth + 1)} {op} "
+            f"{_rand_poly_filter(r, depth + 1)})"
+        )
+    if depth < 2 and x < 0.4:
+        return f"NOT ({_rand_poly_filter(r, depth + 1)})"
+    return r.choice([bbox, during, attr, isect])()
+
+
+def test_differential_fuzz_polygons(poly_setup):
+    """Random filters over a POLYGON schema (xz3/xz2 primary index path):
+    every store must match the host oracle exactly."""
+    batch, stores = poly_setup
+    r = random.Random(20260732)
+    for i in range(N_FILTERS):
+        q = _rand_poly_filter(r)
+        expect = set(batch.fids[evaluate_host(parse_ecql(q), batch)].tolist())
+        for name, s in stores.items():
+            got = set(int(v) for v in s.query("p", q).batch.fids)
+            assert got == expect, (
+                f"filter {i} ({q!r}) on {name}: "
+                f"+{len(got - expect)} -{len(expect - got)}"
+            )
+
+
+def test_differential_fuzz_polygon_device_index(poly_setup):
+    """The resident cache over a non-point schema (xz key planes): exact
+    results equal the oracle; loose xz mode never drops a true hit."""
+    batch, stores = poly_setup
+    from geomesa_tpu.device_cache import DeviceIndex
+
+    di = DeviceIndex(stores["memory"], "p", z_planes=True)
+    assert di._z_kind == "xz3"
+    r = random.Random(20260733)
+    for i in range(N_FILTERS // 2):
+        q = _rand_poly_filter(r)
+        expect = set(batch.fids[evaluate_host(parse_ecql(q), batch)].tolist())
+        got = set(int(v) for v in di.query(q).fids)
+        assert got == expect, f"filter {i} ({q!r})"
+        assert di.count(q) == len(expect)
+        loose = set(int(v) for v in di.query(q, loose=True).fids)
+        if loose != expect:
+            assert expect <= loose, (
+                f"filter {i} ({q!r}): loose xz dropped "
+                f"{len(expect - loose)} true hits"
+            )

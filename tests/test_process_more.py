@@ -142,3 +142,108 @@ def test_knn_resident_matches_store_path():
     b_res, d_res = knn(ds, "kp", 2.0, 5.0, k=25, device_index=di)
     np.testing.assert_array_equal(b_res.fids, b_store.fids)
     np.testing.assert_allclose(d_res, d_store)
+
+
+def test_tube_and_proximity_resident_match_store_path():
+    """Tube select and proximity search over a resident DeviceIndex (one
+    union-of-windows dispatch) return exactly the store path's results."""
+    import numpy as np
+
+    from geomesa_tpu.device_cache import DeviceIndex
+    from geomesa_tpu.process.proximity import proximity_search
+    from geomesa_tpu.process.tube import tube_select
+    from geomesa_tpu.store.memory import MemoryDataStore
+
+    ds = MemoryDataStore()
+    ds.create_schema("ais", "c:Int,dtg:Date,*geom:Point:srid=4326")
+    rng = np.random.default_rng(12)
+    n = 5000
+    t0 = 1_577_836_800_000
+    ds.write("ais", {
+        "c": np.arange(n),
+        "dtg": t0 + rng.integers(0, 86_400_000, n),
+        "geom": np.stack(
+            [rng.uniform(-10, 10, n), rng.uniform(-10, 10, n)], axis=1
+        ),
+    })
+    di = DeviceIndex(ds, "ais")
+    # a 12-segment track crossing the data
+    m = 13
+    track = np.stack(
+        [np.linspace(-8, 8, m), np.linspace(-6, 7, m) + 0.5 * np.sin(np.arange(m))],
+        axis=1,
+    )
+    track_t = t0 + np.linspace(0, 86_400_000, m).astype(np.int64)
+    b_store = tube_select(ds, "ais", track, track_t, 1.5, 3_600_000)
+    b_res = tube_select(
+        ds, "ais", track, track_t, 1.5, 3_600_000, device_index=di
+    )
+    assert len(b_store) > 0
+    np.testing.assert_array_equal(
+        np.sort(b_res.fids), np.sort(b_store.fids)
+    )
+
+    pts = [(-5.0, -2.0), (3.0, 4.0), (8.0, -8.0)]
+    bp_store, dp_store = proximity_search(ds, "ais", pts, 1.0)
+    bp_res, dp_res = proximity_search(
+        ds, "ais", pts, 1.0, device_index=di
+    )
+    assert len(bp_store) > 0
+    np.testing.assert_array_equal(
+        np.sort(bp_res.fids), np.sort(bp_store.fids)
+    )
+    np.testing.assert_allclose(
+        dp_res[np.argsort(bp_res.fids)], dp_store[np.argsort(bp_store.fids)]
+    )
+
+
+def test_processes_honor_auths_on_both_paths():
+    """tube/proximity/knn auths reach the STORE fallback path too (a
+    base filter forces it) — labeled rows must not silently vanish."""
+    import numpy as np
+
+    from geomesa_tpu.device_cache import DeviceIndex
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.process.knn import knn
+    from geomesa_tpu.process.proximity import proximity_search
+    from geomesa_tpu.process.tube import tube_select
+    from geomesa_tpu.store.memory import MemoryDataStore
+
+    ds = MemoryDataStore()
+    ds.create_schema("s", "c:Int,dtg:Date,*geom:Point:srid=4326")
+    rng = np.random.default_rng(4)
+    n = 500
+    t0 = 1_577_836_800_000
+    batch = FeatureBatch.from_columns(
+        ds.get_schema("s"),
+        {
+            "c": np.arange(n),
+            "dtg": t0 + rng.integers(0, 86_400_000, n),
+            "geom": np.stack(
+                [rng.uniform(-5, 5, n), rng.uniform(-5, 5, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    ).with_visibility(["secret"] * n)
+    ds.write("s", batch)
+    di = DeviceIndex(ds, "s")
+    track = np.array([[-4.0, -4.0], [4.0, 4.0]])
+    track_t = np.array([t0, t0 + 86_400_000])
+    for base in (None, "c >= 0"):  # device path, then forced store path
+        b = tube_select(
+            ds, "s", track, track_t, 2.0, 90_000_000,
+            base_filter=base, device_index=di, auths=("secret",),
+        )
+        assert len(b) > 0, f"tube base={base!r}"
+        p, _ = proximity_search(
+            ds, "s", [(0.0, 0.0)], 2.0,
+            base_filter=base, device_index=di, auths=("secret",),
+        )
+        assert len(p) > 0, f"proximity base={base!r}"
+    got, _ = knn(ds, "s", 0.0, 0.0, k=5, base_filter="c >= 0",
+                 device_index=di, auths=("secret",))
+    assert len(got) == 5
+    # and no auths = fail closed everywhere
+    b0 = tube_select(ds, "s", track, track_t, 2.0, 90_000_000,
+                     device_index=di)
+    assert len(b0) == 0

@@ -130,13 +130,17 @@ class XZSFC:
             hi = np.where(active[None, :], new_hi, hi)
         return cs
 
-    def index(self, mins: np.ndarray, maxs: np.ndarray) -> np.ndarray:
+    def index(self, mins: np.ndarray, maxs: np.ndarray,
+              use_native: bool = True) -> np.ndarray:
         """Normalized boxes -> XZ sequence codes (int64). (dims, n) arrays.
 
         Inverted boxes (min > max, e.g. an un-split antimeridian-crossing
         bbox) are rejected: silently encoding them would produce codes that
         range queries never cover (the reference's XZ2SFC likewise requires
         ordered bounds; antimeridian geometries must be split by the caller).
+
+        Uses the C++ walk (native/xz.cpp, bit-identical, ~20x) when built;
+        falls through to the vectorized numpy oracle otherwise.
         """
         mins = np.asarray(mins, dtype=np.float64)
         maxs = np.asarray(maxs, dtype=np.float64)
@@ -146,6 +150,12 @@ class XZSFC:
                 f"inverted box bounds at rows {bad.tolist()} (min > max); "
                 "split antimeridian-crossing geometries before indexing"
             )
+        from geomesa_tpu import native
+
+        if mins.ndim == 2 and native.enabled(use_native):
+            out = native.xz_index(mins, maxs, self.g, self.dims)
+            if out is not None:
+                return out
         mins = np.clip(mins, 0.0, 1.0)
         maxs = np.clip(maxs, 0.0, 1.0)
         length = self.length(mins, maxs)

@@ -729,14 +729,16 @@ class DeviceIndex:
                     & (y <= env[None, :, 3])
                 )
                 if tb is not None:
+                    from geomesa_tpu.ops.int64lanes import cmp_lanes_jax
+
                     bh, bl = tb
                     vh = cols[thi][:, None]
                     vl = cols[tlo][:, None]
-                    ge = (vh > bh[None, :, 0]) | (
-                        (vh == bh[None, :, 0]) & (vl >= bl[None, :, 0])
+                    ge = cmp_lanes_jax(
+                        ">=", vh, vl, bh[None, :, 0], bl[None, :, 0]
                     )
-                    le = (vh < bh[None, :, 1]) | (
-                        (vh == bh[None, :, 1]) & (vl <= bl[None, :, 1])
+                    le = cmp_lanes_jax(
+                        "<=", vh, vl, bh[None, :, 1], bl[None, :, 1]
                     )
                     hit = hit & ge & le
                 mask = jnp.any(hit, axis=1)

@@ -113,6 +113,15 @@ def get_lib():
             lib._has_binser = True
         except AttributeError:
             lib._has_binser = False
+        try:
+            _i64p2 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            lib.gm_xz_index.argtypes = [
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                _f64p, _f64p, _i64p2,
+            ]
+            lib._has_xz = True
+        except AttributeError:  # stale prebuilt .so without the symbol
+            lib._has_xz = False
         _lib = lib
         return _lib
 
@@ -153,6 +162,25 @@ def z3_index(x: np.ndarray, y: np.ndarray, t: np.ndarray, t_max: float) -> "np.n
     t = np.ascontiguousarray(t, dtype=np.float64)
     out = np.empty(len(x), dtype=np.uint64)
     lib.gm_z3_index(len(x), x, y, t, float(t_max), out)
+    return out
+
+
+def xz_index(mins: np.ndarray, maxs: np.ndarray, g: int, dims: int) -> "np.ndarray | None":
+    """Bulk XZ extent-curve encode: normalized (dims, n) boxes -> int64
+    sequence codes; bit-identical to curves/xz.py's walk (the oracle)."""
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_has_xz", False):
+        return None
+    # gm_xz_index uses fixed 32-slot / 3-slot stack buffers: reject out-of
+    # -contract parameters HERE (a public entry point must not rely on the
+    # caller having gone through XZSFC validation)
+    if dims not in (2, 3) or not (1 <= g <= 31):
+        return None
+    mins = np.ascontiguousarray(mins, dtype=np.float64)
+    maxs = np.ascontiguousarray(maxs, dtype=np.float64)
+    n = mins.shape[1]
+    out = np.empty(n, dtype=np.int64)
+    lib.gm_xz_index(n, np.int32(dims), np.int32(g), mins, maxs, out)
     return out
 
 

@@ -51,15 +51,13 @@ def _consts(v: int):
     return jnp.int32(vhi), jnp.uint32(vlo)
 
 
-def cmp_jax(op: str, hi, lo, v: int):
-    """Elementwise ``(hi, lo) <op> v`` where (hi, lo) encode int64 lanes.
-
-    op in {'=', '<>', '<', '<=', '>', '>='}. Pure jnp; traces inside both
-    XLA jit and Pallas kernels.
-    """
+def cmp_lanes_jax(op: str, hi, lo, vhi, vlo):
+    """Elementwise ``(hi, lo) <op> (vhi, vlo)`` where both sides encode
+    int64 as (signed hi, unsigned lo) lane pairs — THE order-isomorphism
+    compare; the bound side may be scalars OR arrays (broadcastable).
+    This is the single source of the signed-hi/unsigned-lo convention."""
     import jax.numpy as jnp
 
-    vhi, vlo = _consts(v)
     hi = hi.astype(jnp.int32)
     lo = lo.astype(jnp.uint32)
     if op == "=":
@@ -75,3 +73,15 @@ def cmp_jax(op: str, hi, lo, v: int):
     if op == ">=":
         return (hi > vhi) | ((hi == vhi) & (lo >= vlo))
     raise ValueError(op)
+
+
+def cmp_jax(op: str, hi, lo, v: int):
+    """Elementwise ``(hi, lo) <op> v`` where (hi, lo) encode int64 lanes.
+
+    op in {'=', '<>', '<', '<=', '>', '>='}. Pure jnp; traces inside both
+    XLA jit and Pallas kernels.
+    """
+    import jax.numpy as jnp
+
+    vhi, vlo = _consts(v)
+    return cmp_lanes_jax(op, hi, lo, vhi, vlo)

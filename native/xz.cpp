@@ -1,0 +1,93 @@
+// Native host kernel for the XZ extent-curve encode (non-point geometry
+// keys): the per-feature pre-order quad/octree walk of
+// geomesa_tpu/curves/xz.py, bit-identical by construction — same IEEE
+// double ops in the same order (frexp-exact level, power-of-two cell
+// widths via ldexp, the corner-descent walk). The Python implementation
+// is the oracle; tests assert exact equality.
+//
+// This is the ingest-side hot loop for polygon/line schemas (the XZ2/XZ3
+// analog of gm_z3_index): host staging and FS-store index builds encode
+// every row's envelope here when the device encode is unavailable.
+
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+// mins/maxs: dims contiguous arrays of n doubles each, laid out
+// [dim0[0..n), dim1[0..n), ...] (the (dims, n) C-order numpy layout),
+// already normalized to [0, 1] and validated (maxs >= mins) by the
+// caller. out: n int64 sequence codes. dims in {2, 3}; g <= 31 (2D) /
+// 20 (3D) so the code space fits int64 (validated Python-side).
+void gm_xz_index(int64_t n, int32_t dims, int32_t g, const double* mins,
+                 const double* maxs, int64_t* out) {
+  const int64_t fanout = 1LL << dims;
+  // child_step[i] = (fanout^(g-i) - 1) / (fanout - 1)
+  int64_t child_step[32];
+  for (int32_t i = 0; i < g; ++i) {
+    int64_t p = 1;
+    for (int32_t k = 0; k < g - i; ++k) p *= fanout;
+    child_step[i] = (p - 1) / (fanout - 1);
+  }
+  for (int64_t r = 0; r < n; ++r) {
+    double mn[3], mx[3];
+    double w = 0.0;
+    for (int32_t d = 0; d < dims; ++d) {
+      double a = mins[d * n + r];
+      double b = maxs[d * n + r];
+      if (a < 0.0) a = 0.0;
+      if (a > 1.0) a = 1.0;
+      if (b < 0.0) b = 0.0;
+      if (b > 1.0) b = 1.0;
+      mn[d] = a;
+      mx[d] = b;
+      double e = b - a;
+      if (d == 0 || e > w) w = e;
+    }
+    // l1 = floor(log2(1/w)), exact via the float exponent (numpy frexp
+    // semantics: w = m * 2^e, m in [0.5, 1))
+    int32_t l1;
+    if (w <= 0.0) {
+      l1 = g;
+    } else {
+      int e;
+      double m = std::frexp(w, &e);
+      l1 = (m == 0.5) ? (1 - e) : -e;
+      if (l1 > g) l1 = g;
+    }
+    // fit one level deeper? w2 = 0.5^min(l1+1, g), an exact power of two
+    int32_t k2 = l1 + 1 < g ? l1 + 1 : g;
+    double w2 = std::ldexp(1.0, -k2);
+    bool fits = true;
+    for (int32_t d = 0; d < dims; ++d) {
+      if (!(mx[d] <= std::floor(mn[d] / w2) * w2 + 2.0 * w2)) {
+        fits = false;
+        break;
+      }
+    }
+    int32_t length = (l1 < g && fits) ? l1 + 1 : l1;
+    if (length < 0) length = 0;
+    if (length > g) length = g;
+    // pre-order walk: descend toward the box corner, accumulating the
+    // sequence code
+    double lo[3] = {0.0, 0.0, 0.0};
+    double hi[3] = {1.0, 1.0, 1.0};
+    int64_t cs = 0;
+    for (int32_t i = 0; i < length; ++i) {
+      int64_t quad = 0;
+      for (int32_t d = 0; d < dims; ++d) {
+        double center = (lo[d] + hi[d]) * 0.5;
+        if (mn[d] >= center) {
+          quad |= (1LL << d);
+          lo[d] = center;
+        } else {
+          hi[d] = center;
+        }
+      }
+      cs += 1 + quad * child_step[i];
+    }
+    out[r] = cs;
+  }
+}
+
+}  // extern "C"

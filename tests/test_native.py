@@ -194,3 +194,29 @@ class TestBinserNative:
         deserialize_batch(sft, rows, use_native=True)
         t_nat = time.perf_counter() - t
         assert t_nat < t_py  # typically 5-20x; just pin the direction
+
+
+def test_native_xz_index_bit_identical(rng):
+    """C++ XZ extent-curve walk == the numpy oracle, including exact
+    power-of-two extents, degenerate point boxes and the whole space."""
+    from geomesa_tpu.curves.xz import XZSFC
+
+    if not native.enabled():
+        pytest.skip("native library unavailable")
+    if not getattr(native.get_lib(), "_has_xz", False):
+        pytest.skip("prebuilt library lacks gm_xz_index")
+    for dims, g in ((2, 12), (3, 12), (2, 20)):
+        sfc = XZSFC(g, dims)
+        n = 40_000
+        mins = rng.uniform(0, 0.98, (dims, n))
+        ext = rng.uniform(0, 0.05, (dims, n)) * rng.choice([0, 1], (dims, n))
+        maxs = np.minimum(mins + ext, 1.0)
+        nat = sfc.index(mins, maxs)
+        ora = sfc.index(mins, maxs, use_native=False)
+        np.testing.assert_array_equal(nat, ora)
+    sfc = XZSFC(12, 2)
+    mins = np.array([[0.0, 0.25, 0.5, 0.0], [0.0, 0.25, 0.5, 0.0]])
+    maxs = np.array([[1.0, 0.5, 0.5, 2.0**-12], [1.0, 0.5, 0.5, 2.0**-12]])
+    np.testing.assert_array_equal(
+        sfc.index(mins, maxs), sfc.index(mins, maxs, use_native=False)
+    )

@@ -595,6 +595,31 @@ def bench_sweep(args, cols) -> list:
     return out
 
 
+def _measure_build(args, build_step, inputs, n: int, label: str) -> float:
+    """Shared build-bench timing protocol: K chained invocations per
+    dispatch (the order-dependent checksum inside ``build_step`` forces
+    the full sorted arrays to materialize — a bare block_until_ready does
+    not sync through the remote-execution tunnel, and returning only
+    extremes would let XLA reduce the sort to min/max), median over
+    --iters. Returns rows/sec."""
+    k = args.chain_build
+    chain = _chain(build_step, k)
+    t0 = time.perf_counter()
+    chk = int(chain(*inputs))
+    log(f"{label} chain (K={k}) compiled+first in "
+        f"{time.perf_counter() - t0:.1f}s (chk {chk})")
+    times = []
+    for _ in range(args.iters):
+        t1 = time.perf_counter()
+        int(chain(*inputs))  # scalar fetch = hard sync point
+        times.append(time.perf_counter() - t1)
+    per_inv = sorted(times)[len(times) // 2] / k
+    rate = n / per_inv
+    log(f"{label} median={per_inv*1e3:.2f}ms per build -> "
+        f"{rate/1e6:.0f}M rows/sec/chip")
+    return rate
+
+
 def bench_build(args) -> dict:
     """Z3 index build on device: fused quantize+interleave key encode
     (hi/lo uint32 lanes) + lexicographic sort carrying a row-id payload
@@ -661,29 +686,83 @@ def bench_build(args) -> dict:
         del hi_s, lo_s, rid_s, got, z_u, perm
         log("sorted keys + rid permutation verified against host oracle")
 
-    k = args.chain_build
-    chain = _chain(build_step, k)
-    t0 = time.perf_counter()
-    chk = int(chain(x, y, t))
-    log(f"build chain (K={k}) compiled+first in "
-        f"{time.perf_counter() - t0:.1f}s (chk {chk})")
-
-    times = []
-    for _ in range(args.iters):
-        t1 = time.perf_counter()
-        int(chain(x, y, t))  # scalar fetch = hard sync point
-        times.append(time.perf_counter() - t1)
-    per_inv = sorted(times)[len(times) // 2] / k
-    pts_per_sec = n / per_inv
-    log(f"median={per_inv*1e3:.2f}ms per build -> "
-        f"{pts_per_sec/1e6:.0f}M pts/sec/chip")
+    pts_per_sec = _measure_build(args, build_step, (x, y, t), n, "z3 build")
     return {
         "metric": "Z3 index build (encode + device sort + rid payload)",
         "value": round(pts_per_sec, 1),
         "unit": "pts/sec/chip",
         "vs_baseline": None,  # BASELINE.json: 'TBD at first measurement'
-        "build_chain": k,
+        "build_chain": args.chain_build,
         "build_n": n,
+    }
+
+
+def bench_xz_build(args) -> dict:
+    """BASELINE config #5 shape (building-footprint XZ2/XZ3 non-point
+    indexing): device XZ extent-curve encode (the quad/octree walk in
+    uint32 hi/lo lanes) + lexicographic sort with a row-id payload — the
+    single-chip slice of the pod-scale non-point build (the mesh exchange
+    leg is proven by dryrun_multichip's xz3 parity check)."""
+    import jax
+    import jax.numpy as jnp
+
+    from geomesa_tpu.curves import XZ3SFC
+
+    platform = jax.devices()[0].platform
+    n = args.n or ((1 << 24) if platform != "cpu" else (1 << 18))
+    log(f"platform={platform} n={n:,} (xz build mode)")
+    sfc = XZ3SFC()
+    key = jax.random.PRNGKey(9)
+    kx, ky, kw, kh, kt = jax.random.split(key, 5)
+    xmin = jax.random.uniform(kx, (n,), jnp.float32, -170.0, 160.0)
+    ymin = jax.random.uniform(ky, (n,), jnp.float32, -85.0, 75.0)
+    xmax = xmin + jax.random.uniform(kw, (n,), jnp.float32, 0.001, 5.0)
+    ymax = ymin + jax.random.uniform(kh, (n,), jnp.float32, 0.001, 5.0)
+    off = jax.random.uniform(kt, (n,), jnp.float32, 0.0, float(sfc.t_max))
+    jax.block_until_ready((xmin, ymin, xmax, ymax, off))
+
+    def build_step(x0, y0, x1, y1, t):
+        hi, lo = sfc.index_jax_hi_lo(x0, y0, t, x1, y1, t)
+        rid = jnp.arange(n, dtype=jnp.uint32)
+        hi_s, lo_s, rid_s = jax.lax.sort((hi, lo, rid), num_keys=2)
+        w = jnp.arange(n, dtype=jnp.uint32)
+        return (hi_s * w).sum() + (lo_s * w).sum() + (rid_s * w).sum()
+
+    if args.check:
+        import numpy as np
+
+        # reduced-n check (tunnel transfer; sort math is size-independent):
+        # the device SORT must equal a host sort of the same device encode
+        # (f32 lanes — the encode's own f64 parity is covered by the unit
+        # tests, same convention as the z3 build check)
+        nc = min(n, 1 << 20)
+        sub = (xmin[:nc], ymin[:nc], xmax[:nc], ymax[:nc], off[:nc])
+
+        @jax.jit
+        def enc(x0, y0, x1, y1, t):
+            hi, lo = sfc.index_jax_hi_lo(x0, y0, t, x1, y1, t)
+            return hi, lo, jax.lax.sort((hi, lo), num_keys=2)
+
+        hi_u, lo_u, (hi_s, lo_s) = enc(*sub)
+        got = (np.asarray(hi_s).astype(np.uint64) << np.uint64(32)) | (
+            np.asarray(lo_s).astype(np.uint64)
+        )
+        raw = (np.asarray(hi_u).astype(np.uint64) << np.uint64(32)) | (
+            np.asarray(lo_u).astype(np.uint64)
+        )
+        assert np.array_equal(got, np.sort(raw)), \
+            "device xz sort != host sort of the same keys"
+        log(f"xz device sort verified vs host sort at n={nc:,}")
+
+    rate = _measure_build(
+        args, build_step, (xmin, ymin, xmax, ymax, off), n, "xz build"
+    )
+    return {
+        "metric": "XZ3 non-point index build (device tree-walk + sort)",
+        "value": round(rate, 1),
+        "unit": "envelopes/sec/chip",
+        "xz_build_chain": args.chain_build,
+        "xz_build_n": n,
     }
 
 
@@ -717,6 +796,7 @@ def main() -> None:
         "--mode",
         choices=(
             "all", "filter", "zscan", "build", "polygon", "density", "sweep",
+            "xzbuild",
         ),
         default="all",
         help="all: every benchmark, one JSON line with everything (what "
@@ -739,6 +819,8 @@ def main() -> None:
 
         n = _default_n(args, jax.devices()[0].platform)
         out = {"sweep": bench_sweep(args, _gdelt_cols(args, n))}
+    elif args.mode == "xzbuild":
+        out = bench_xz_build(args)
     else:
         out = bench_filter(args)
         z = bench_zscan(args)
@@ -785,6 +867,11 @@ def main() -> None:
         out["build_pts_per_sec"] = build["value"]
         out["build_chain"] = build["build_chain"]
         out["build_n"] = build["build_n"]
+        # BASELINE config #5: non-point (XZ3) build on device
+        xzb = bench_xz_build(args)
+        out["xz_build_envelopes_per_sec"] = xzb["value"]
+        out["xz_build_chain"] = xzb["xz_build_chain"]
+        out["xz_build_n"] = xzb["xz_build_n"]
     print(json.dumps(out))
 
 

@@ -741,9 +741,10 @@ def bench_xz_build(args) -> dict:
         @jax.jit
         def enc(x0, y0, x1, y1, t):
             hi, lo = sfc.index_jax_hi_lo(x0, y0, t, x1, y1, t)
-            return hi, lo, jax.lax.sort((hi, lo), num_keys=2)
+            rid = jnp.arange(nc, dtype=jnp.uint32)
+            return hi, lo, jax.lax.sort((hi, lo, rid), num_keys=2)
 
-        hi_u, lo_u, (hi_s, lo_s) = enc(*sub)
+        hi_u, lo_u, (hi_s, lo_s, rid_s) = enc(*sub)
         got = (np.asarray(hi_s).astype(np.uint64) << np.uint64(32)) | (
             np.asarray(lo_s).astype(np.uint64)
         )
@@ -752,7 +753,11 @@ def bench_xz_build(args) -> dict:
         )
         assert np.array_equal(got, np.sort(raw)), \
             "device xz sort != host sort of the same keys"
-        log(f"xz device sort verified vs host sort at n={nc:,}")
+        # the rid payload (which determines real row order in a build)
+        # must reproduce the sorted keys when applied to the unsorted ones
+        perm = np.asarray(rid_s).astype(np.int64)
+        assert np.array_equal(raw[perm], got), "xz rid payload mis-permuted"
+        log(f"xz device sort + rid permutation verified at n={nc:,}")
 
     rate = _measure_build(
         args, build_step, (xmin, ymin, xmax, ymax, off), n, "xz build"

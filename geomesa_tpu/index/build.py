@@ -18,6 +18,11 @@ from geomesa_tpu.index.api import BuiltIndex, PartitionMeta
 
 DEFAULT_PARTITION_SIZE = 1 << 20  # ~1M rows per partition
 
+# key spaces build_index_device can marshal encode inputs for — the ONE
+# dispatch list (keyspaces with a device encode still need an entry in the
+# per-kind input marshaling below); callers gate mesh routing on this
+DEVICE_BUILD_KINDS = ("z3", "z2", "xz3", "xz2")
+
 # time bins (weeks/months/... since epoch) can be negative; bias them into
 # non-negative uint32 lane values so the lexicographic uint32 device sort
 # matches the host's signed-int sort. Full int32 bias: a smaller bias would
@@ -79,7 +84,7 @@ def build_index_device(
             f"device build requires a key space with a hi/lo device encode; "
             f"{kind!r} has none (use the host build)"
         )
-    if kind not in ("z3", "z2", "xz3", "xz2"):
+    if kind not in DEVICE_BUILD_KINDS:
         # the encode dispatch below is positional per kind; a custom key
         # space with a device encode still needs a dispatch entry here
         raise ValueError(

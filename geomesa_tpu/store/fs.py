@@ -94,13 +94,19 @@ class FileSystemDataStore:
         partition_size: int = DEFAULT_PARTITION_SIZE,
         audit: bool = False,
         encoding: str = "parquet",
+        mesh=None,
     ):
+        """``mesh``: an optional ``jax.sharding.Mesh`` — flushes then build
+        their sorted indexes ON the device mesh (device key encode +
+        all_to_all exchange sort, bit-identical to the host build; falls
+        back to the host path for key spaces without a device encode)."""
         if encoding not in ("parquet", "orc"):
             raise ValueError(f"unsupported encoding {encoding!r}")
         import threading
 
         self.root = root
         self.partition_size = partition_size
+        self.mesh = mesh
         self.encoding = encoding
         self._types: dict[str, _FsTypeState] = {}
         os.makedirs(root, exist_ok=True)
@@ -425,7 +431,7 @@ class FileSystemDataStore:
 
             for leaf in sorted(set(leaves)):
                 sub = data.take(np.nonzero(leaves == leaf)[0])
-                built = build_index(ks, sub, self.partition_size)
+                built = self._build(ks, sub)
                 leaf_dir = os.path.join(d, leaf)
                 os.makedirs(leaf_dir, exist_ok=True)
                 for p in built.partitions:
@@ -441,7 +447,7 @@ class FileSystemDataStore:
             st.partitions = all_parts
             full = data
         else:
-            built = build_index(ks, data, self.partition_size)
+            built = self._build(ks, data)
             for p in built.partitions:
                 sub = built.batch.take(np.arange(p.start, p.stop))
                 _write_table(
@@ -460,6 +466,27 @@ class FileSystemDataStore:
         st.dirty = False  # a successful rewrite lifts the quarantine
         st.quarantine_owner = False
         self._save_meta(type_name)
+
+    #: below this row count a mesh build is routed to the host lexsort
+    #: anyway: per-shape jit traces + host->device transfer of tiny (e.g.
+    #: per-leaf) batches cost more than the sort they accelerate
+    MESH_BUILD_MIN_ROWS = 1 << 16
+
+    def _build(self, ks, data) -> BuiltIndex:
+        """Sorted-index build for a flush: on the device mesh when one was
+        supplied, the key space has a device encode, and the batch is big
+        enough to amortize the dispatch; host lexsort otherwise. Both
+        produce bit-identical BuiltIndexes (proven by the parity suite),
+        so the manifest/files do not depend on the path."""
+        from geomesa_tpu.index.build import DEVICE_BUILD_KINDS
+
+        if (
+            self.mesh is not None
+            and getattr(ks, "name", None) in DEVICE_BUILD_KINDS
+            and len(data) >= self.MESH_BUILD_MIN_ROWS
+        ):
+            return build_index(ks, data, self.partition_size, mesh=self.mesh)
+        return build_index(ks, data, self.partition_size)
 
     def _part_path(self, type_name: str, p: PartitionMeta) -> str:
         st = self._types[type_name]

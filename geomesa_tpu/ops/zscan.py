@@ -344,6 +344,190 @@ def z3_dim_plane_query(
     return qnx, qny, ranges
 
 
+def z3_dim_plane_qarr(
+    sfc,
+    env,
+    window,
+    bin_base: int,
+    bin_range: "tuple | None",
+    max_ranges: int = 8,
+) -> "tuple[np.ndarray, int] | None":
+    """RUNTIME query vector for the dim-plane scan: uint32
+    ``[qnx_lo, qnx_hi, qny_lo, qny_hi, (bt_lo, bt_hi) * R]`` with R padded
+    to a power of two by inverted (never-matching) ranges. One compiled
+    kernel per R bucket serves EVERY window — the serving path must not
+    pay a recompile per viewport the way baked-constant kernels do.
+
+    ``bin_range`` clamps to the bins actually staged (query bins outside
+    it match nothing by construction). Returns None when a surviving
+    query bin falls outside the packable window relative to ``bin_base``
+    (the caller falls back to another engine) or when the merged range
+    count exceeds ``max_ranges``.
+    """
+    from geomesa_tpu.curves.binnedtime import bins_for_interval
+
+    if sfc.precision != BT_TIME_BITS:
+        return None  # planes for this sfc cannot have been packed
+    xmin, ymin, xmax, ymax = env
+    qnx = (int(sfc.lon.normalize(xmin)), int(sfc.lon.normalize(xmax)))
+    qny = (int(sfc.lat.normalize(ymin)), int(sfc.lat.normalize(ymax)))
+    ranges: list = []
+    for b, lo_off, hi_off in bins_for_interval(
+        int(window[0]), int(window[1]), sfc.period
+    ):
+        if bin_range is not None and not (bin_range[0] <= b <= bin_range[1]):
+            continue  # bin not staged: matches nothing
+        rel = b - bin_base
+        # top bin reserved: the out-of-window SENTINEL space of
+        # z3_dim_planes must never be addressable by a query
+        if not (0 <= rel < BT_BIN_SPAN - 1):
+            return None
+        lo = (rel << BT_TIME_BITS) | int(sfc.time.normalize(lo_off))
+        hi = (rel << BT_TIME_BITS) | int(sfc.time.normalize(hi_off))
+        if ranges and lo == ranges[-1][1] + 1:
+            ranges[-1] = (ranges[-1][0], hi)
+        else:
+            ranges.append((lo, hi))
+    if len(ranges) > max_ranges:
+        return None
+    r = max(1, 1 << max(len(ranges) - 1, 0).bit_length())
+    out = np.empty(4 + 2 * r, np.uint32)
+    if ranges:
+        out[0:4] = [qnx[0], qnx[1], qny[0], qny[1]]
+    else:
+        out[0:4] = [1, 0, 1, 0]  # inverted: matches nothing
+    for k in range(r):
+        lo, hi = ranges[k] if k < len(ranges) else (0xFFFFFFFF, 0)
+        out[4 + 2 * k] = lo
+        out[5 + 2 * k] = hi
+    return out, r
+
+
+def z3_dimscan_mask_rt(nx, ny, bt, qarr, n_ranges: int):
+    """XLA-fused dim-plane mask with RUNTIME bounds (the fused-agg /
+    streaming engine; the Pallas kernel below is the count champion).
+    ``qarr`` is the vector from :func:`z3_dim_plane_qarr`; ``n_ranges``
+    is static (one trace per R bucket)."""
+    import jax.numpy as jnp
+
+    m = (nx >= qarr[0]) & (nx <= qarr[1])
+    m &= (ny >= qarr[2]) & (ny <= qarr[3])
+    tm = None
+    for k in range(n_ranges):
+        r = (bt >= qarr[4 + 2 * k]) & (bt <= qarr[5 + 2 * k])
+        tm = r if tm is None else (tm | r)
+    return m & tm
+
+
+def build_z3_dimscan_rt(
+    n_ranges: int,
+    *,
+    block_rows: int = 512,
+    interpret: "bool | None" = None,
+):
+    """Pallas dim-plane kernel with RUNTIME query bounds: (count_fn,
+    mask_fn) over ``(qarr, nx, ny, bt)``. The query vector rides in SMEM
+    via scalar prefetch, so ONE compiled kernel (per power-of-two R
+    bucket) serves every window — the serving-path requirement the
+    baked-constant builder below cannot meet. Same measured tiling as
+    :func:`build_z3_dimscan_pallas` (block_rows=512, 128 lanes).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    LANES = 128
+    br = block_rows
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    def _tile_mask(q_ref, nx_t, ny_t, bt_t):
+        m = (nx_t >= q_ref[0]) & (nx_t <= q_ref[1])
+        m &= (ny_t >= q_ref[2]) & (ny_t <= q_ref[3])
+        tm = None
+        for k in range(n_ranges):
+            r = (bt_t >= q_ref[4 + 2 * k]) & (bt_t <= q_ref[5 + 2 * k])
+            tm = r if tm is None else (tm | r)
+        return m & tm
+
+    def _prep(nx, ny, bt):
+        n = int(nx.shape[0])
+        grid = max(1, -(-n // (br * LANES)))
+        pad = grid * br * LANES - n
+        mats = [
+            jnp.pad(a, (0, pad)).reshape(grid * br, LANES)
+            for a in (nx, ny, bt)
+        ]
+        return n, grid, mats
+
+    def _tail(n):
+        def apply(m):
+            i = pl.program_id(0)
+            idx = (
+                i * br * LANES
+                + jax.lax.broadcasted_iota(jnp.int32, (br, LANES), 0) * LANES
+                + jax.lax.broadcasted_iota(jnp.int32, (br, LANES), 1)
+            )
+            return m & (idx < n)
+
+        return apply
+
+    def count_fn(qarr, nx, ny, bt):
+        n, grid, mats = _prep(nx, ny, bt)
+        tail = _tail(n)
+
+        def kernel(q_ref, a_ref, b_ref, c_ref, out_ref):
+            m = tail(_tile_mask(q_ref, a_ref[...], b_ref[...], c_ref[...]))
+
+            @pl.when(pl.program_id(0) == 0)
+            def _():
+                out_ref[...] = jnp.zeros((1, LANES), jnp.int32)
+
+            out_ref[...] = out_ref[...] + jnp.sum(
+                m.astype(jnp.int32), axis=0, dtype=jnp.int32, keepdims=True
+            )
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(grid,),
+            # index maps receive the prefetched scalar ref as a trailing arg
+            in_specs=[pl.BlockSpec((br, LANES), lambda i, q: (i, 0))] * 3,
+            out_specs=pl.BlockSpec((1, LANES), lambda i, q: (0, 0)),
+        )
+        partials = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+            interpret=interpret,
+        )(qarr, *mats)
+        return jnp.sum(partials, dtype=jnp.int32)
+
+    def mask_fn(qarr, nx, ny, bt):
+        n, grid, mats = _prep(nx, ny, bt)
+        tail = _tail(n)
+
+        def kernel(q_ref, a_ref, b_ref, c_ref, out_ref):
+            m = tail(_tile_mask(q_ref, a_ref[...], b_ref[...], c_ref[...]))
+            out_ref[...] = m.astype(jnp.int8)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((br, LANES), lambda i, q: (i, 0))] * 3,
+            out_specs=pl.BlockSpec((br, LANES), lambda i, q: (i, 0)),
+        )
+        m = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((grid * br, LANES), jnp.int8),
+            interpret=interpret,
+        )(qarr, *mats)
+        return m.reshape(-1)[:n].astype(bool)
+
+    return count_fn, mask_fn
+
+
 def _dim_tile_mask(qnx, qny, bt_ranges):
     import jax.numpy as jnp
 

@@ -764,19 +764,21 @@ def test_staging_device_encode_matches_numpy_oracle():
     from geomesa_tpu.device_cache import _z_planes_np
 
     for mk, kind in [
+        # dim_planes=False: z3 exercises the INTERLEAVED device encode
+        # here (the dim-plane staging parity lives in test_dimplane_cache)
         (lambda: _store(n=3000), "z3"),
         (lambda: _poly_store(n=1500), "xz3"),
         (lambda: _poly_store(n=1500, with_time=False), "xz2"),
     ]:
         ds = mk()
         tn = ds.type_names[0]
-        di = DeviceIndex(ds, tn, z_planes=True)
+        di = DeviceIndex(ds, tn, z_planes=True, dim_planes=False)
         assert di._z_kind == kind
         # the DEVICE path must have produced the planes: a latched fallback
         # would make this parity test vacuously compare oracle to oracle
         assert not di._z_encode_failed and di._z_encode_jit is not None
         batch = ds.query(tn).batch
-        np_kind, np_planes = _z_planes_np(batch, di.sft)
+        np_kind, np_planes, _bins = _z_planes_np(batch, di.sft)
         assert np_kind == kind
         for k, v in np_planes.items():
             np.testing.assert_array_equal(
@@ -809,7 +811,7 @@ def test_staging_device_encode_z2_and_x64_scoping():
     assert jax.config.jax_enable_x64 == before
     assert di._z_kind == "z2"
     batch = ds.query("z2t").batch
-    _, np_planes = _z_planes_np(batch, di.sft)
+    _, np_planes, _bins = _z_planes_np(batch, di.sft)
     for k, v in np_planes.items():
         np.testing.assert_array_equal(np.asarray(di._cols[k]), v)
 

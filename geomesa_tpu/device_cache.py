@@ -626,14 +626,32 @@ class DeviceIndex:
         bounds, ids = zscan.pad_bins(bounds, ids)
         return jnp.asarray(bounds), jnp.asarray(ids)
 
+    def _dim_args(self, lb):
+        """(count_fn, mask_fn, operands) for a dim-tagged loose-bounds
+        result — the ONE assembly point for the dim-plane kernel and its
+        resident operands (count(), mask() and loose_scan_kernel must
+        dispatch the identical kernel or the benchmarked engine drifts
+        from the served one)."""
+        _, qarr, r = lb
+        count_fn, mask_fn = self._dim_kernel(r)
+        return count_fn, mask_fn, (
+            qarr, self._cols[Z_NX], self._cols[Z_NY], self._cols[Z_BT]
+        )
+
     def _dim_kernel(self, n_ranges: int):
         """(count_fn, mask_fn) Pallas dim-plane kernels for one R bucket —
-        runtime query bounds, so ONE compile serves every window."""
+        runtime query bounds, so ONE compile serves every window. JITTED:
+        the raw builders chain several host-visible ops (pad, reshape,
+        pallas_call, sum) and each op is a separate ~100ms dispatch
+        through the remote tunnel; one jit makes a serve one dispatch."""
+        import jax
+
         from geomesa_tpu.ops import zscan
 
         fns = self._dim_kernels.get(n_ranges)
         if fns is None:
-            fns = zscan.build_z3_dimscan_rt(n_ranges)
+            cf, mf = zscan.build_z3_dimscan_rt(n_ranges)
+            fns = (jax.jit(cf), jax.jit(mf))
             self._dim_kernels[n_ranges] = fns
         return fns
 
@@ -646,11 +664,8 @@ class DeviceIndex:
         from geomesa_tpu.ops import zscan
 
         if len(lb) == 3 and lb[0] == "dim":
-            _, qarr, r = lb
-            _, mask_fn = self._dim_kernel(r)
-            return mask_fn(
-                qarr, self._cols[Z_NX], self._cols[Z_NY], self._cols[Z_BT]
-            )
+            _, mask_fn, kargs = self._dim_args(lb)
+            return mask_fn(*kargs)
         bounds, ids = lb
         if self._z_jit is None:
             self._z_jit = {
@@ -779,12 +794,8 @@ class DeviceIndex:
                 if len(lb) == 3 and lb[0] == "dim" and dv is None:
                     # the bandwidth-champion path: Pallas dim-plane count,
                     # one dispatch, 12B/row (VERDICT round-3 item 1)
-                    _, qarr, r = lb
-                    count_fn, _ = self._dim_kernel(r)
-                    return int(count_fn(
-                        qarr, self._cols[Z_NX], self._cols[Z_NY],
-                        self._cols[Z_BT],
-                    ))
+                    count_fn, _, kargs = self._dim_args(lb)
+                    return int(count_fn(*kargs))
                 m = self._z_mask_dev(lb)
                 if dv is not None:
                     m = m & dv
@@ -811,11 +822,8 @@ class DeviceIndex:
                 or VIS_ID in (self._cols or {}):
             return None
         if len(lb) == 3 and lb[0] == "dim":
-            _, qarr, r = lb
-            count_fn, _ = self._dim_kernel(r)
-            return count_fn, (
-                qarr, self._cols[Z_NX], self._cols[Z_NY], self._cols[Z_BT]
-            )
+            count_fn, _, kargs = self._dim_args(lb)
+            return count_fn, kargs
         import jax
         import jax.numpy as jnp
 
@@ -871,7 +879,7 @@ class DeviceIndex:
             np.nonzero(self.mask(query, loose=loose, auths=auths))[0]
         )
 
-    def window_union_query(self, envs, times=None, auths=None):
+    def window_union_query(self, envs, times=None, auths=None, base=None):
         """Candidate rows matching ANY of m runtime windows in ONE
         dispatch — the corridor/buffer coarse pass (tube select: one
         bbox+time window per track segment; proximity: one expanded bbox
@@ -883,8 +891,13 @@ class DeviceIndex:
 
         ``envs``: (m, 4) [xmin, ymin, xmax, ymax]; ``times``: optional
         (m, 2) int64 [t_lo, t_hi] epoch-ms tested against the default
-        date field's hi/lo planes. Returns matching host rows, or None
-        when the needed planes are not resident. Bounds widen one ulp
+        date field's hi/lo planes. ``base``: an optional extra filter
+        whose compiled device mask is ANDed into the union inside the
+        SAME dispatch (one compile per distinct base; the windows stay
+        runtime) — a corridor query with a CQL base filter must not fall
+        back to the per-segment store path (VERDICT round-3 weak #6).
+        Returns matching host rows, or None when the needed planes (or a
+        device-expressible base) are not resident. Bounds widen one ulp
         outward (float32 residency can only over-include — candidate
         semantics; callers run an exact refinement pass)."""
         import jax
@@ -900,6 +913,18 @@ class DeviceIndex:
             thi, tlo = f"{dtg}__hi", f"{dtg}__lo"
             if dtg is None or thi not in self._cols:
                 return None
+        compiled = None
+        base_f = self._parse(base) if base is not None else None
+        if base_f is ast.Include:
+            base_f = None
+        if base_f is not None:
+            compiled, cfn, _ = self._compiled_for(base_f)
+            if (
+                not compiled.device_cols
+                or not compiled.fully_on_device
+                or cfn is None  # wanted planes not resident
+            ):
+                return None  # base not fusable: store path instead
         envs = np.asarray(envs, np.float64).reshape(-1, 4)
         m = envs.shape[0]
         cap = _next_pow2(max(m, 1))
@@ -923,7 +948,10 @@ class DeviceIndex:
             )
         use_time = times is not None
         has_vis = VIS_ID in self._cols
-        jit_key = ("union", use_time, has_vis)
+        jit_key = (
+            "union", use_time, has_vis,
+            repr(base_f) if compiled is not None else None,
+        )
         if not hasattr(self, "_union_jits"):
             self._union_jits = {}
         fn = self._union_jits.get(jit_key)
@@ -951,6 +979,8 @@ class DeviceIndex:
                     )
                     hit = hit & ge & le
                 mask = jnp.any(hit, axis=1)
+                if compiled is not None:
+                    mask = mask & compiled.device_fn(cols)
                 if valid is not None:
                     mask = mask & valid
                 if auth_tab is not None:
@@ -963,6 +993,9 @@ class DeviceIndex:
         if use_time:
             sub[thi] = self._cols[thi]
             sub[tlo] = self._cols[tlo]
+        if compiled is not None:
+            for c in compiled.device_cols:
+                sub[c] = self._cols[c]
         if has_vis:
             sub[VIS_ID] = self._cols[VIS_ID]
         mask = np.asarray(
@@ -1006,17 +1039,20 @@ class DeviceIndex:
         gx, gy = f"{geom}__x", f"{geom}__y"
         if geom is None or gx not in self._cols:
             return None
+        # parse once; Include normalizes to no-filter so both spellings
+        # share one compiled kernel
+        f = self._parse(query) if query is not None else None
+        if f is ast.Include:
+            f = None
         compiled = None
-        if query is not None:
-            f = self._parse(query)
-            if f is not ast.Include:
-                compiled, cfn, _ = self._compiled_for(f)
-                if (
-                    not compiled.device_cols
-                    or not compiled.fully_on_device
-                    or cfn is None  # wanted planes not resident (columns=)
-                ):
-                    return None  # cannot fuse: window path instead
+        if f is not None:
+            compiled, cfn, _ = self._compiled_for(f)
+            if (
+                not compiled.device_cols
+                or not compiled.fully_on_device
+                or cfn is None  # wanted planes not resident (columns=)
+            ):
+                return None  # cannot fuse: window path instead
         n_staged = self._staged_len()
         if n_staged == 0:
             empty = self._host_rows().take(np.array([], np.int64))
@@ -1026,10 +1062,7 @@ class DeviceIndex:
         plane_n = int(self._cols[gx].shape[0])
         kk = min(_next_pow2(max(k, 1)), plane_n)
         has_vis = VIS_ID in self._cols
-        key = (
-            "knn", repr(self._parse(query)) if query is not None else None,
-            kk, has_vis,
-        )
+        key = ("knn", repr(f) if f is not None else None, kk, has_vis)
         if not hasattr(self, "_knn_jits"):
             self._knn_jits = {}
         fn = self._knn_jits.get(key)
@@ -1058,10 +1091,10 @@ class DeviceIndex:
         q = jnp.asarray(
             np.array([px, py, max_radius_deg], np.float32)
         )
-        sub = dict(self._cols) if compiled is not None else {
-            c: self._cols[c]
-            for c in ([gx, gy] + ([VIS_ID] if has_vis else []))
-        }
+        wanted = [gx, gy] + ([VIS_ID] if has_vis else [])
+        if compiled is not None:
+            wanted += [c for c in compiled.device_cols if c not in wanted]
+        sub = {c: self._cols[c] for c in wanted}
         d2, idx = fn(
             sub, q, self._device_valid(),
             self._auth_table(auths) if has_vis else None,
@@ -1746,10 +1779,12 @@ class StreamingDeviceIndex(DeviceIndex):
                 label_attr=label_attr, sort=sort, loose=loose, auths=auths,
             )
 
-    def window_union_query(self, envs, times=None, auths=None):
+    def window_union_query(self, envs, times=None, auths=None, base=None):
         # (bbox_window_query delegates here, so this one lock covers both)
         with self._lock:
-            return super().window_union_query(envs, times=times, auths=auths)
+            return super().window_union_query(
+                envs, times=times, auths=auths, base=base
+            )
 
     def knn(self, px, py, k, query=None, auths=None, max_radius_deg=45.0):
         with self._lock:

@@ -29,6 +29,10 @@ DEVICE_BUILD_KINDS = ("z3", "z2", "xz3", "xz2")
 # wrap far-past bins around to huge lane values and silently mis-sort.
 _BIN_BIAS = 1 << 31
 
+# per-curve device-encode jit wrappers (sfc dataclasses are frozen and
+# hashable); see the cache note at the use site
+_ENCODE_JITS: dict = {}
+
 
 def build_index(
     keyspace,
@@ -113,7 +117,13 @@ def build_index_device(
             "device-sortable int32 range"
         )
 
-    pad = (-n) % n_shards
+    # pad to a POWER-OF-TWO row bucket (then to a shard multiple): the
+    # encode + exchange jits retrace per input shape, and a ~30-60s
+    # remote compile per distinct flush size would dominate every flush.
+    # Bucketing bounds the shape set; the valid mask hides the padding.
+    cap = 1 << max(n - 1, 0).bit_length()
+    cap += (-cap) % n_shards
+    pad = cap - n
     if pad:
         coords = [np.concatenate([c, np.zeros(pad)]) for c in coords]
         if binned:
@@ -121,7 +131,13 @@ def build_index_device(
     valid = np.arange(n + pad) < n
     rid = np.arange(n + pad, dtype=np.uint32)
 
-    hi, lo = jax.jit(sfc.index_jax_hi_lo)(*map(jnp.asarray, coords))
+    enc = _ENCODE_JITS.get(sfc)
+    if enc is None:
+        # cached wrapper: a fresh jax.jit per build would re-compile the
+        # encode every flush (the jit cache lives on the wrapper)
+        enc = jax.jit(sfc.index_jax_hi_lo)
+        _ENCODE_JITS[sfc] = enc
+    hi, lo = enc(*map(jnp.asarray, coords))
 
     lanes = (hi, lo, jnp.asarray(rid))
     if binned:

@@ -76,6 +76,8 @@ def build_density_pallas(
         jax.lax.Precision.HIGHEST if weighted else jax.lax.Precision.DEFAULT
     )
 
+    _zero = lambda: jnp.int32(0)  # noqa: E731 (int32 index-map literal)
+
     def kernel(py_ref, px_ref, *rest):
         w_ref = rest[0] if weighted else None
         out_ref = rest[-1]
@@ -122,10 +124,14 @@ def build_density_pallas(
         out = pl.pallas_call(
             kernel,
             grid=(grid,),
+            # int32 index-map literals: a raw Python 0 traces to an i64
+            # constant under x64, which Mosaic cannot legalize
             in_specs=[
-                pl.BlockSpec((None, 1, R), lambda i: (i, 0, 0))
+                pl.BlockSpec(
+                    (None, 1, R), lambda i: (i, _zero(), _zero())
+                )
             ] * len(ins),
-            out_specs=pl.BlockSpec((HP, WP), lambda i: (0, 0)),
+            out_specs=pl.BlockSpec((HP, WP), lambda i: (_zero(), _zero())),
             out_shape=jax.ShapeDtypeStruct((HP, WP), acc_dtype),
             interpret=interpret,
         )(*ins)

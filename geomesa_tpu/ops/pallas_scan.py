@@ -162,7 +162,12 @@ def _build_tile_fn(f: ast.Filter, sft: SimpleFeatureType):
                     denom = (ey2 - ey1) if ey2 != ey1 else 1.0
                     xint = ex1 + (py - ey1) * (ex2 - ex1) / denom
                     crossings = crossings + (straddle & (px < xint))
-                m = crossings % 2 == 1
+                # parity via bitwise AND: `crossings % 2` trips an
+                # infinite _convert_element_type recursion in the Mosaic
+                # lowering when x64 is enabled (the weak int literal
+                # round-trips through i64) — pinned by
+                # tests/test_pallas_scan.py::test_mosaic_mod_recursion_repro
+                m = (crossings & 1) == 1
                 return ~m if neg else m
 
             return f_pip

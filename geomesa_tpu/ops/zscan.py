@@ -441,6 +441,7 @@ def build_z3_dimscan_rt(
     br = block_rows
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    _zero = lambda: jnp.int32(0)  # noqa: E731 (int32 index-map literal)
 
     def _tile_mask(q_ref, nx_t, ny_t, bt_t):
         m = (nx_t >= q_ref[0]) & (nx_t <= q_ref[1])
@@ -491,9 +492,15 @@ def build_z3_dimscan_rt(
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(grid,),
-            # index maps receive the prefetched scalar ref as a trailing arg
-            in_specs=[pl.BlockSpec((br, LANES), lambda i, q: (i, 0))] * 3,
-            out_specs=pl.BlockSpec((1, LANES), lambda i, q: (0, 0)),
+            # index maps receive the prefetched scalar ref as a trailing
+            # arg; literal indices must be int32 (a raw Python 0 traces
+            # to an i64 constant under x64, which Mosaic cannot legalize)
+            in_specs=[
+                pl.BlockSpec((br, LANES), lambda i, q: (i, _zero()))
+            ] * 3,
+            out_specs=pl.BlockSpec(
+                (1, LANES), lambda i, q: (_zero(), _zero())
+            ),
         )
         partials = pl.pallas_call(
             kernel,
@@ -514,8 +521,10 @@ def build_z3_dimscan_rt(
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(grid,),
-            in_specs=[pl.BlockSpec((br, LANES), lambda i, q: (i, 0))] * 3,
-            out_specs=pl.BlockSpec((br, LANES), lambda i, q: (i, 0)),
+            in_specs=[
+                pl.BlockSpec((br, LANES), lambda i, q: (i, _zero()))
+            ] * 3,
+            out_specs=pl.BlockSpec((br, LANES), lambda i, q: (i, _zero())),
         )
         m = pl.pallas_call(
             kernel,

@@ -24,6 +24,13 @@ import numpy as np
 
 _SENTINEL = 0xFFFFFFFF
 
+# jitted exchange-step cache: a fresh ``jax.jit(step)`` per call would
+# RE-COMPILE the whole exchange on every invocation (jit's in-memory
+# cache lives on the wrapper object) — ~30-60s per flush through the
+# remote TPU compiler. Keyed by every static the step closure bakes in;
+# input shapes are handled by the cached wrapper's own jit cache.
+_STEP_CACHE: dict = {}
+
 
 def sharded_count_scan(mesh, device_fn, cols: dict, axis: str = "shard"):
     """Data-parallel fused-mask count: each shard scans its resident slice,
@@ -294,7 +301,16 @@ def distributed_sort(
     args = tuple(keys) + tuple(payload_leaves)
     if valid is not None:
         args = args + (valid,)
-    out = jax.jit(step)(*args)
+    cache_key = (
+        "sort", mesh, axis, n_lanes, n_extras, valid is not None,
+        splitters, local_n, cap, k_samp,
+        tuple((str(p.dtype), p.ndim) for p in payload_leaves),
+    )
+    jitted = _STEP_CACHE.get(cache_key)
+    if jitted is None:
+        jitted = jax.jit(step)
+        _STEP_CACHE[cache_key] = jitted
+    out = jitted(*args)
     keys_out = out[:n_lanes]
     payload_out = jax.tree.unflatten(
         payload_def, out[n_lanes : n_lanes + n_extras]

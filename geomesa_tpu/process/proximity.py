@@ -41,9 +41,10 @@ def proximity_search(
     """Returns (batch, dist_deg): data features within ``distance_deg`` of
     any input geometry, with the distance to the nearest input.
 
-    With a resident ``device_index`` (and no base filter) the candidate
-    pass is ONE device dispatch over all input buffers
-    (window_union_query) instead of a compiled OR-of-bboxes store query."""
+    With a resident ``device_index`` the candidate pass is ONE device
+    dispatch over all input buffers (window_union_query; a CQL
+    ``base_filter``'s compiled device mask fuses into the same dispatch)
+    instead of a compiled OR-of-bboxes store query."""
     from geomesa_tpu.filter.ecql import parse_ecql
     from geomesa_tpu.sql.functions import _segments_of, pt_seg_project
 
@@ -58,7 +59,7 @@ def proximity_search(
     sft = store.get_schema(type_name)
     geom_field = sft.geom_field
     batch = None
-    if device_index is not None and base is ast.Include:
+    if device_index is not None:
         envs = np.array(
             [
                 [
@@ -70,7 +71,9 @@ def proximity_search(
                 for g in geoms
             ]
         )
-        batch = device_index.window_union_query(envs, auths=auths)
+        batch = device_index.window_union_query(
+            envs, auths=auths, base=None if base is ast.Include else base,
+        )
     if batch is None:
         # one expanded bbox PER input (not one union envelope: two
         # far-apart inputs would otherwise pull in everything between

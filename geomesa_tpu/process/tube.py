@@ -28,9 +28,10 @@ def tube_select(
 ):
     """Returns the matching FeatureBatch.
 
-    With a resident ``device_index`` (and no base filter) the coarse pass
-    runs as ONE device dispatch: every segment's bbox+time window rides a
-    runtime array into `window_union_query`, where the store path pays a
+    With a resident ``device_index`` the coarse pass runs as ONE device
+    dispatch: every segment's bbox+time window rides a runtime array into
+    `window_union_query` (a CQL ``base_filter``'s compiled device mask is
+    fused into the same dispatch), where the store path pays a
     per-segment query (a kernel compile + staging each)."""
     from geomesa_tpu.features.batch import FeatureBatch
     from geomesa_tpu.filter.ecql import parse_ecql
@@ -47,7 +48,7 @@ def tube_select(
     track_t = np.asarray(track_t_ms, dtype=np.int64)
 
     merged = None
-    if device_index is not None and base is ast.Include and len(track_xy) > 1:
+    if device_index is not None and len(track_xy) > 1:
         a, b = track_xy[:-1], track_xy[1:]
         envs = np.stack(
             [
@@ -66,7 +67,10 @@ def tube_select(
             ],
             axis=1,
         )
-        merged = device_index.window_union_query(envs, times, auths=auths)
+        merged = device_index.window_union_query(
+            envs, times, auths=auths,
+            base=None if base is ast.Include else base,
+        )
     if merged is None:
         # coarse pass: one bbox+time query per track segment (the
         # reference's per-bin tube queries), unioned

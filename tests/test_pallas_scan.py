@@ -46,6 +46,73 @@ FILTERS = [
 ]
 
 
+def test_mosaic_mod_recursion_repro():
+    """Minimal repro of the Mosaic bug that kept the point-in-polygon
+    Pallas kernel off the TPU through round 3: with x64 enabled,
+    lowering `int32_array % 2` recurses forever in
+    jax/_src/pallas/mosaic/lowering.py::_convert_element_type_lowering_rule
+    (the weak Python-int literal round-trips through i64 and
+    _convert_helper re-enters itself until RecursionError). `x & 1` is
+    the working spelling — ops/pallas_scan.py's crossing-parity test uses
+    it. This repro only exercises the real Mosaic lowering, so it runs
+    on TPU only (interpret mode never hits Mosaic).
+
+    Verified against the installed stack (jax 0.9 line): `% 2` raises
+    RecursionError, `& 1` compiles and runs.
+    """
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        pytest.skip("Mosaic lowering repro requires a real TPU backend")
+    import sys
+
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    old = sys.getrecursionlimit()
+    sys.setrecursionlimit(20000)
+    try:
+        with jax.enable_x64():
+
+            def kern_mod(x_ref, o_ref):
+                o_ref[...] = x_ref[...].astype(jnp.int32) % 2
+
+            def kern_and(x_ref, o_ref):
+                o_ref[...] = x_ref[...].astype(jnp.int32) & 1
+
+            x = jnp.ones((256, 128), jnp.float32)
+            shape = jax.ShapeDtypeStruct((256, 128), jnp.int32)
+            with pytest.raises(RecursionError):
+                jax.block_until_ready(
+                    pl.pallas_call(kern_mod, out_shape=shape)(x)
+                )
+            out = jax.block_until_ready(
+                pl.pallas_call(kern_and, out_shape=shape)(x)
+            )
+            assert int(out.sum()) == 256 * 128
+    finally:
+        sys.setrecursionlimit(old)
+
+
+def test_pip_kernel_parity_under_x64():
+    """The polygon kernel must produce oracle-exact results with x64
+    enabled (the bench enables x64 for data generation; round-3 shipped
+    with the Pallas engine disabled under exactly this flag)."""
+    import jax
+
+    rng = np.random.default_rng(7)
+    batch = make_batch(rng, 4096)
+    ecql = FILTERS[6]
+    compiled = compile_filter(parse_ecql(ecql), SFT)
+    with jax.enable_x64():
+        scan = compiled.pallas_scan()
+        assert scan is not None
+        cols = stage_columns(batch, list(compiled.device_cols))
+        got = np.asarray(scan[1](cols))[: len(batch)]
+    expect = compiled.host_mask(batch)
+    np.testing.assert_array_equal(got, expect)
+
+
 class TestPallasScanParity:
     @pytest.mark.parametrize("ecql", FILTERS)
     def test_count_and_mask_match_oracle(self, rng, ecql):

@@ -11,6 +11,7 @@ from geomesa_tpu.parallel import (
     sharded_build_and_query_step,
     sharded_count_scan,
 )
+from geomesa_tpu.parallel.dist import distributed_sort
 
 
 @pytest.fixture(scope="module")
@@ -66,6 +67,102 @@ def test_distributed_sort_globally_ordered(mesh, rng):
     )
     # no drops with uniform data at capacity 2x
     np.testing.assert_array_equal(merged, expected)
+
+
+class TestExchangeAtScale:
+    """VERDICT round-3 item 5: the exchange's capacity math and wall
+    clock, proven at 2^22 rows over 8 virtual devices — uniform, sorted,
+    all-duplicate and clustered layouts must all complete with ZERO
+    overflow at the default capacity factor, return a correct global
+    sort with an intact row-id payload, and finish within a wall-clock
+    bound."""
+
+    N = 1 << 22
+
+    def _layout(self, name, rng):
+        n = self.N
+        if name == "uniform":
+            hi = rng.integers(0, 1 << 31, n).astype(np.uint32)
+            lo = rng.integers(0, 1 << 32, n, dtype=np.uint64).astype(
+                np.uint32
+            )
+        elif name == "sorted":
+            hi = np.sort(rng.integers(0, 1 << 31, n)).astype(np.uint32)
+            lo = rng.integers(0, 1 << 32, n, dtype=np.uint64).astype(
+                np.uint32
+            )
+        elif name == "duplicate":
+            hi = np.full(n, 0x12345678, np.uint32)
+            lo = np.full(n, 0x9ABCDEF0, np.uint32)
+        else:  # clustered: 99% of keys in 4 tiny hot ranges
+            centers = np.array(
+                [0x100, 0x7FFF0000, 0x40000000, 0x2AAA0000], np.uint32
+            )
+            which = rng.integers(0, 4, n)
+            off = rng.integers(0, 64, n).astype(np.uint32)
+            hi = centers[which] + off
+            cold = rng.random(n) < 0.01
+            hi[cold] = rng.integers(0, 1 << 31, int(cold.sum())).astype(
+                np.uint32
+            )
+            lo = rng.integers(0, 1 << 32, n, dtype=np.uint64).astype(
+                np.uint32
+            )
+        return hi, lo
+
+    @pytest.mark.parametrize(
+        "layout", ["uniform", "sorted", "duplicate", "clustered"]
+    )
+    def test_2m_rows_zero_overflow_sorted_with_payload(self, mesh, layout):
+        import time
+
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(hash(layout) % (1 << 31))
+        hi, lo = self._layout(layout, rng)
+        rid = np.arange(self.N, dtype=np.uint32)
+        t0 = time.perf_counter()
+        # on_overflow='raise' IS the zero-overflow assertion at the
+        # default capacity_factor
+        (sh, sl), pay, sv = distributed_sort(
+            mesh, (jnp.asarray(hi), jnp.asarray(lo)),
+            payload={"rid": jnp.asarray(rid)},
+        )
+        sh = np.asarray(sh)
+        wall = time.perf_counter() - t0
+        # generous bound: 2^22 rows through two all_to_all passes + local
+        # sorts on an 8-virtual-device CPU mesh takes ~1-5s; a capacity
+        # or routing regression shows up as minutes (or a raise above)
+        assert wall < 120, f"{layout}: exchange took {wall:.0f}s"
+        sl, sv = np.asarray(sl), np.asarray(sv)
+        rid_out = np.asarray(pay["rid"])
+        z = (sh.astype(np.uint64) << np.uint64(32)) | sl.astype(np.uint64)
+        zin = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(
+            np.uint64
+        )
+        per = len(sh) // 8
+        prev_max = -1
+        got = []
+        for s in range(8):
+            vs = sv[s * per : (s + 1) * per]
+            zs = z[s * per : (s + 1) * per][vs]
+            assert np.all(np.diff(zs.astype(np.int64)) >= 0), (
+                f"{layout}: shard {s} not locally sorted"
+            )
+            if len(zs):
+                assert int(zs[0]) >= prev_max, (
+                    f"{layout}: shards out of global order"
+                )
+                prev_max = int(zs[-1])
+            got.append(zs)
+            # the payload permutation must reproduce the keys it rode with
+            rs = rid_out[s * per : (s + 1) * per][vs]
+            np.testing.assert_array_equal(
+                zin[rs], zs, err_msg=f"{layout}: rid payload mispermuted"
+            )
+        merged = np.concatenate(got)
+        assert len(merged) == self.N  # zero rows lost
+        np.testing.assert_array_equal(merged, np.sort(zin))
 
 
 def test_full_build_and_query_step(mesh, rng):

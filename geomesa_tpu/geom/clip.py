@@ -1,0 +1,376 @@
+"""Polygon boolean operations (intersection / union / difference).
+
+Ref role: the reference gets ``st_intersection`` / ``st_difference`` and
+friends from JTS's overlay engine (geomesa-spark-jts [UNVERIFIED - empty
+reference mount]). This is a from-scratch Greiner-Hormann clipper for
+SIMPLE polygons: concave shapes are fine, holes are not supported in v1
+(explicit NotImplementedError — silently wrong topology would be worse),
+and MultiPolygons distribute over their disjoint components.
+
+Degeneracies (a vertex exactly on the other polygon's edge, collinear
+overlapping edges) are handled the standard practical way: the clip
+polygon is retried with a deterministic sub-nanometer perturbation
+(~1e-9 of the bbox scale) until the configuration is generic. The
+perturbation is far below any geographic coordinate's meaningful
+precision; the test suite validates results against a Monte-Carlo
+point-membership oracle built on points_in_polygon.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.geom.base import MultiPolygon, Polygon
+
+
+class _Node:
+    __slots__ = (
+        "xy", "next", "prev", "neighbor", "is_inter", "entry", "visited",
+        "alpha",
+    )
+
+    def __init__(self, xy, alpha=0.0, is_inter=False):
+        self.xy = xy
+        self.next = None
+        self.prev = None
+        self.neighbor = None
+        self.is_inter = is_inter
+        self.entry = False
+        self.visited = False
+        self.alpha = alpha
+
+
+def _ring_of(poly: Polygon) -> np.ndarray:
+    rings = list(poly.rings())
+    if len(rings) > 1:
+        raise NotImplementedError(
+            "polygon boolean ops do not support holes (v1); subtract the "
+            "holes explicitly if needed"
+        )
+    c = np.asarray(rings[0], np.float64)
+    if np.array_equal(c[0], c[-1]):
+        c = c[:-1]
+    # normalize to CCW so entry/exit marking is orientation-independent
+    area2 = np.sum(c[:, 0] * np.roll(c[:, 1], -1) - np.roll(c[:, 0], -1) * c[:, 1])
+    if area2 < 0:
+        c = c[::-1]
+    return c
+
+
+def _build_list(ring: np.ndarray) -> _Node:
+    nodes = [_Node(tuple(p)) for p in ring]
+    for i, nd in enumerate(nodes):
+        nd.next = nodes[(i + 1) % len(nodes)]
+        nd.prev = nodes[i - 1]
+    return nodes[0]
+
+
+def _vertices(head: _Node):
+    n = head
+    while True:
+        yield n
+        n = n.next
+        if n is head:
+            break
+
+
+def _orig_edges(head: _Node):
+    """(node, next_original_node) pairs over the ORIGINAL polygon edges."""
+    orig = [n for n in _vertices(head) if not n.is_inter]
+    for i, a in enumerate(orig):
+        yield a, orig[(i + 1) % len(orig)]
+
+
+def _seg_inter(p1, p2, q1, q2):
+    """(t, u) of the proper crossing of segments p1p2 and q1q2, or None.
+    Returns None for parallel/degenerate configurations (endpoint
+    touches are 'degenerate' and trigger the perturbation retry)."""
+    r = (p2[0] - p1[0], p2[1] - p1[1])
+    s = (q2[0] - q1[0], q2[1] - q1[1])
+    rxs = r[0] * s[1] - r[1] * s[0]
+    if rxs == 0:
+        qp = (q1[0] - p1[0], q1[1] - p1[1])
+        if qp[0] * r[1] - qp[1] * r[0] == 0:
+            # collinear: overlap is degenerate, separation is a miss
+            return "degenerate" if _collinear_overlap(p1, p2, q1, q2) else None
+        return None
+    qp = (q1[0] - p1[0], q1[1] - p1[1])
+    t = (qp[0] * s[1] - qp[1] * s[0]) / rxs
+    u = (qp[0] * r[1] - qp[1] * r[0]) / rxs
+    eps = 1e-13
+    if -eps < t < eps or 1 - eps < t < 1 + eps or \
+       -eps < u < eps or 1 - eps < u < 1 + eps:
+        if -eps < t < 1 + eps and -eps < u < 1 + eps:
+            return "degenerate"  # endpoint on the other segment
+        return None
+    if 0 < t < 1 and 0 < u < 1:
+        return (t, u)
+    return None
+
+
+def _collinear_overlap(p1, p2, q1, q2) -> bool:
+    lo1, hi1 = sorted((p1[0], p2[0])), None
+    if p1[0] == p2[0]:  # vertical: compare on y
+        a = sorted((p1[1], p2[1]))
+        b = sorted((q1[1], q2[1]))
+    else:
+        a = sorted((p1[0], p2[0]))
+        b = sorted((q1[0], q2[0]))
+    return a[0] < b[1] and b[0] < a[1]
+
+
+def _point_in_ring(pt, ring: np.ndarray) -> bool:
+    from geomesa_tpu.geom.predicates import points_in_polygon
+
+    closed = np.concatenate([ring, ring[:1]], axis=0)
+    return bool(
+        points_in_polygon(
+            np.array([pt[0]]), np.array([pt[1]]), [closed]
+        )[0]
+    )
+
+
+def _insert_intersections(head_a: _Node, head_b: _Node) -> int:
+    """Find all proper crossings, link neighbor nodes. Returns the count;
+    raises _Degenerate on non-generic configurations."""
+    count = 0
+    for a1, a2 in list(_orig_edges(head_a)):
+        for b1, b2 in list(_orig_edges(head_b)):
+            got = _seg_inter(a1.xy, a2.xy, b1.xy, b2.xy)
+            if got is None:
+                continue
+            if got == "degenerate":
+                raise _Degenerate()
+            t, u = got
+            xy = (
+                a1.xy[0] + t * (a2.xy[0] - a1.xy[0]),
+                a1.xy[1] + t * (a2.xy[1] - a1.xy[1]),
+            )
+            na = _Node(xy, alpha=t, is_inter=True)
+            nb = _Node(xy, alpha=u, is_inter=True)
+            na.neighbor = nb
+            nb.neighbor = na
+            _insert_sorted(a1, a2, na)
+            _insert_sorted(b1, b2, nb)
+            count += 1
+    return count
+
+
+class _Degenerate(Exception):
+    pass
+
+
+def _insert_sorted(start: _Node, end_orig: _Node, node: _Node) -> None:
+    """Insert an intersection node between two ORIGINAL vertices, keeping
+    intersection nodes ordered by alpha."""
+    cur = start
+    while (
+        cur.next is not end_orig
+        and cur.next.is_inter
+        and cur.next.alpha < node.alpha
+    ):
+        cur = cur.next
+    node.next = cur.next
+    node.prev = cur
+    cur.next.prev = node
+    cur.next = node
+
+
+def _mark_entries(head: _Node, other_ring: np.ndarray, invert: bool) -> None:
+    """Classic GH phase 2: walking the polygon, each crossing toggles
+    containment in the other polygon; a node is an ENTRY if we were
+    outside before crossing (XOR ``invert`` for union/difference)."""
+    inside = _point_in_ring(head.xy, other_ring)
+    entry = not inside
+    for n in _vertices(head):
+        if n.is_inter:
+            n.entry = entry ^ invert
+            entry = not entry
+
+
+def _traverse(head_a: _Node) -> list:
+    """GH phase 3: walk unvisited intersection nodes into result rings."""
+    rings = []
+    inters = [n for n in _vertices(head_a) if n.is_inter]
+    for start in inters:
+        if start.visited:
+            continue
+        ring = []
+        cur = start
+        while not cur.visited:
+            cur.visited = True
+            cur.neighbor.visited = True
+            ring.append(cur.xy)
+            if cur.entry:
+                nxt = cur.next
+                while not nxt.is_inter:
+                    ring.append(nxt.xy)
+                    nxt = nxt.next
+            else:
+                nxt = cur.prev
+                while not nxt.is_inter:
+                    ring.append(nxt.xy)
+                    nxt = nxt.prev
+            cur = nxt.neighbor
+        if len(ring) >= 3:
+            rings.append(np.array(ring + [ring[0]], np.float64))
+    return rings
+
+
+def _clip_once(ra: np.ndarray, rb: np.ndarray, op: str):
+    head_a = _build_list(ra)
+    head_b = _build_list(rb)
+    n_inter = _insert_intersections(head_a, head_b)
+    if n_inter == 0:
+        a_in_b = _point_in_ring(ra[0], rb)
+        b_in_a = _point_in_ring(rb[0], ra)
+        if op == "intersection":
+            if a_in_b:
+                return [np.concatenate([ra, ra[:1]])]
+            if b_in_a:
+                return [np.concatenate([rb, rb[:1]])]
+            return []
+        if op == "union":
+            if a_in_b:
+                return [np.concatenate([rb, rb[:1]])]
+            if b_in_a:
+                return [np.concatenate([ra, ra[:1]])]
+            return [np.concatenate([ra, ra[:1]]),
+                    np.concatenate([rb, rb[:1]])]
+        # difference a - b
+        if a_in_b:
+            return []
+        if b_in_a:
+            raise NotImplementedError(
+                "difference would create a hole (clip polygon strictly "
+                "inside the subject); holes are unsupported in v1"
+            )
+        return [np.concatenate([ra, ra[:1]])]
+    # entry-mark inversion table (Kim & Kim formulation): intersection
+    # marks both normally; union inverts both; difference inverts the
+    # SUBJECT's marks (flipping the walk direction along A is equivalent
+    # to clipping A against B's reversed ring — validated against the
+    # Monte-Carlo membership oracle in tests/test_clip.py)
+    inv_a, inv_b = {
+        "intersection": (False, False),
+        "union": (True, True),
+        "difference": (True, False),
+    }[op]
+    _mark_entries(head_a, rb, inv_a)
+    _mark_entries(head_b, ra, inv_b)
+    return _traverse(head_a)
+
+
+def _perturb(ring: np.ndarray, k: int, scale: float) -> np.ndarray:
+    rng = np.random.default_rng(0xC11F + k)
+    return ring + (rng.random(ring.shape) - 0.5) * scale
+
+
+def clip_rings(ra: np.ndarray, rb: np.ndarray, op: str) -> list:
+    """Boolean op over two simple open rings -> list of closed rings.
+    Retries with a deterministic sub-nanometer perturbation of the clip
+    ring on degenerate (vertex-on-edge / collinear-overlap) inputs."""
+    span = max(
+        float(np.ptp(ra[:, 0])), float(np.ptp(ra[:, 1])),
+        float(np.ptp(rb[:, 0])), float(np.ptp(rb[:, 1])), 1e-9,
+    )
+    for k in range(6):
+        try:
+            return _clip_once(ra, rb if k == 0 else _perturb(
+                rb, k, span * 1e-9 * (10 ** k)
+            ), op)
+        except _Degenerate:
+            continue
+    raise ValueError(
+        "polygon boolean op did not reach a generic configuration after "
+        "perturbation retries"
+    )
+
+
+def _as_polys(g):
+    if isinstance(g, Polygon):
+        return [g]
+    if isinstance(g, MultiPolygon):
+        return list(g.polygons)
+    raise ValueError(
+        f"polygon boolean ops need (Multi)Polygon, got {type(g).__name__}"
+    )
+
+
+def _wrap(rings: list):
+    polys = [Polygon(r) for r in rings if abs(_ring_area2(r)) > 0]
+    if not polys:
+        return MultiPolygon(())
+    if len(polys) == 1:
+        return polys[0]
+    return MultiPolygon(tuple(polys))
+
+
+def _ring_area2(r: np.ndarray) -> float:
+    return float(
+        np.sum(r[:-1, 0] * r[1:, 1] - r[1:, 0] * r[:-1, 1])
+    )
+
+
+def polygon_intersection(a, b):
+    """A ∩ B over (Multi)Polygons (components distribute: multipolygon
+    parts are disjoint by construction)."""
+    rings = []
+    for pa in _as_polys(a):
+        ra = _ring_of(pa)
+        for pb in _as_polys(b):
+            rings += clip_rings(ra, _ring_of(pb), "intersection")
+    return _wrap(rings)
+
+
+def polygon_union(a, b):
+    """A ∪ B. Components are folded pairwise; parts that stay disjoint
+    accumulate into the output MultiPolygon."""
+    parts = [_ring_of(p) for p in _as_polys(a)]
+    for pb in _as_polys(b):
+        rb = _ring_of(pb)
+        merged = False
+        out = []
+        for ra in parts:
+            if not merged:
+                got = clip_rings(ra, rb, "union")
+                if len(got) == 1:
+                    rb = got[0][:-1]  # merged: keep folding the result
+                    merged = True
+                    continue
+            out.append(ra)
+        out.append(rb)
+        parts = out
+    return _wrap([np.concatenate([r, r[:1]]) if not np.array_equal(
+        r[0], r[-1]
+    ) else r for r in parts])
+
+
+def polygon_difference(a, b):
+    """A \\ B (sequential: A minus each component of B)."""
+    parts = [_ring_of(p) for p in _as_polys(a)]
+    for pb in _as_polys(b):
+        rb = _ring_of(pb)
+        nxt = []
+        for ra in parts:
+            for r in clip_rings(ra, rb, "difference"):
+                nxt.append(r[:-1])
+        parts = nxt
+    return _wrap([np.concatenate([r, r[:1]]) for r in parts])
+
+
+def polygon_sym_difference(a, b):
+    """(A \\ B) ∪ (B \\ A) — returned as the (possibly Multi) collection
+    of both directional differences (they are disjoint by construction)."""
+    d1 = polygon_difference(a, b)
+    d2 = polygon_difference(b, a)
+    rings = []
+    for g in (d1, d2):
+        for p in _as_polys(g) if not _is_empty(g) else []:
+            r = _ring_of(p)
+            rings.append(np.concatenate([r, r[:1]]))
+    return _wrap(rings)
+
+
+def _is_empty(g) -> bool:
+    return isinstance(g, MultiPolygon) and len(g.polygons) == 0

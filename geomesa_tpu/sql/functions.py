@@ -1023,6 +1023,383 @@ def st_covers(a, b):
     return st_contains(a, b)
 
 
+# -- constructor/cast aliases (ref naming variants) --------------------------
+
+st_makePoint = st_point  # ref alias (jts constructor name)
+st_geomFromText = st_geomFromWKT  # ref alias
+st_geometryFromText = st_geomFromWKT  # ref alias
+
+
+def st_makePointM(x, y, m):
+    """(x, y, m) -> point; the measure coordinate is DROPPED (this
+    framework's geometry model is 2-D — the reference's M rides JTS
+    coordinates but no indexed operation reads it)."""
+    return st_point(x, y)
+
+
+def st_pointFromWKB(wkb):
+    """WKB -> Point (raises if the bytes decode to a non-point)."""
+    out = st_geomFromWKB(wkb)
+
+    def check(g):
+        if not isinstance(g, Point):
+            raise ValueError(
+                f"st_pointFromWKB decoded a {type(g).__name__}"
+            )
+        return g
+
+    if isinstance(out, Geometry):
+        return check(out)
+    return np.array([check(g) for g in out], dtype=object)
+
+
+def st_castToGeometry(geom):
+    """Identity upcast (the reference narrows Spark UDT types; our
+    geometry columns are already dynamically typed)."""
+    return geom
+
+
+def st_byteArray(s):
+    """String -> UTF-8 bytes (ref utility cast)."""
+    if isinstance(s, (bytes, bytearray)):
+        return bytes(s)
+    if isinstance(s, str):
+        return s.encode("utf-8")
+    return np.array([st_byteArray(v) for v in s], dtype=object)
+
+
+def st_polygon(line):
+    """Closed LineString -> Polygon (ref st_polygon constructor)."""
+
+    def one(g):
+        if not isinstance(g, LineString):
+            raise ValueError("st_polygon expects a LineString")
+        c = np.asarray(g.coords, np.float64)
+        if len(c) < 4 or not np.array_equal(c[0], c[-1]):
+            raise ValueError("st_polygon needs a closed ring (>= 4 points)")
+        return Polygon(c)
+
+    return _scalar_or_col(line, one)
+
+
+# -- additional accessors ----------------------------------------------------
+
+
+def st_boundary(geom):
+    """Topological boundary: polygon -> its rings as (Multi)LineString,
+    linestring -> its endpoints as MultiPoint (empty when closed),
+    point -> empty GeometryCollection (represented as an empty
+    MultiPoint — the closest thing in this model)."""
+
+    def one(g):
+        if isinstance(g, Polygon):
+            rings = [LineString(r) for r in g.rings()]
+            return rings[0] if len(rings) == 1 else MultiLineString(
+                tuple(rings)
+            )
+        if isinstance(g, MultiPolygon):
+            rings = [
+                LineString(r) for p in g.polygons for r in p.rings()
+            ]
+            return MultiLineString(tuple(rings))
+        if isinstance(g, LineString):
+            c = np.asarray(g.coords)
+            if np.array_equal(c[0], c[-1]):
+                return MultiPoint(np.empty((0, 2)))
+            return MultiPoint(np.stack([c[0], c[-1]]))
+        if isinstance(g, MultiLineString):
+            pts = [
+                p
+                for l in g.lines
+                for p in (
+                    []
+                    if np.array_equal(l.coords[0], l.coords[-1])
+                    else [l.coords[0], l.coords[-1]]
+                )
+            ]
+            return MultiPoint(
+                np.stack(pts) if pts else np.empty((0, 2))
+            )
+        return MultiPoint(np.empty((0, 2)))  # points: empty boundary
+
+    return _scalar_or_col(geom, one)
+
+
+def _segments_self_intersect(c: np.ndarray) -> bool:
+    """Any non-adjacent segment pair of the path ``c`` crosses (shared
+    ring endpoints excluded)."""
+    n = len(c) - 1
+    if n < 2:
+        return False
+    a, b = c[:-1], c[1:]
+    closed = np.array_equal(c[0], c[-1])
+    for i in range(n - 1):
+        js = np.arange(i + 2, n)
+        if closed and i == 0 and len(js):
+            js = js[:-1]  # last segment is adjacent to the first
+        if len(js) == 0:
+            continue
+        p, r = a[i], b[i] - a[i]
+        q, s = a[js], b[js] - a[js]
+        rxs = r[0] * (s[:, 1]) - r[1] * (s[:, 0])
+        qp = q - p
+        t_num = qp[:, 0] * s[:, 1] - qp[:, 1] * s[:, 0]
+        u_num = qp[:, 0] * r[1] - qp[:, 1] * r[0]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            t = t_num / rxs
+            u = u_num / rxs
+        hit = (
+            (rxs != 0)
+            & (t > 1e-12) & (t < 1 - 1e-12)
+            & (u > 1e-12) & (u < 1 - 1e-12)
+        )
+        if bool(hit.any()):
+            return True
+    return False
+
+
+def st_isSimple(geom):
+    """No self-intersection (points/multipoints are always simple;
+    linestrings and polygon rings are checked pairwise)."""
+
+    def one(g):
+        if isinstance(g, (Point, MultiPoint)):
+            return True
+        if isinstance(g, LineString):
+            return not _segments_self_intersect(np.asarray(g.coords))
+        if isinstance(g, MultiLineString):
+            return all(one(l) for l in g.lines)
+        if isinstance(g, Polygon):
+            return not any(
+                _segments_self_intersect(np.asarray(r)) for r in g.rings()
+            )
+        if isinstance(g, MultiPolygon):
+            return all(one(p) for p in g.polygons)
+        return True
+
+    out = _scalar_or_col(geom, one)
+    return np.asarray(out, dtype=bool) if not isinstance(out, bool) else out
+
+
+def st_isValid(geom):
+    """Structural validity: rings closed with >= 4 points and simple
+    (no self-intersection); lines need >= 2 points. A light version of
+    the reference's JTS IsValidOp (no nested-hole topology checks)."""
+
+    def one(g):
+        if isinstance(g, Polygon):
+            for r in g.rings():
+                c = np.asarray(r)
+                if len(c) < 4 or not np.array_equal(c[0], c[-1]):
+                    return False
+                if _segments_self_intersect(c):
+                    return False
+            return True
+        if isinstance(g, MultiPolygon):
+            return all(one(p) for p in g.polygons)
+        if isinstance(g, LineString):
+            return len(g.coords) >= 2
+        if isinstance(g, MultiLineString):
+            return all(len(l.coords) >= 2 for l in g.lines)
+        return True
+
+    out = _scalar_or_col(geom, one)
+    return np.asarray(out, dtype=bool) if not isinstance(out, bool) else out
+
+
+# -- spheroid measures (WGS84 Vincenty) --------------------------------------
+
+_WGS84_A = 6_378_137.0
+_WGS84_B = 6_356_752.314245
+_WGS84_F = 1.0 / 298.257223563
+
+
+def _vincenty_m(lon1, lat1, lon2, lat2) -> np.ndarray:
+    """Vectorized Vincenty inverse distance (meters) on WGS84; falls back
+    to the haversine-sphere value for the rare non-converging antipodal
+    pairs."""
+    lon1, lat1, lon2, lat2 = (
+        np.asarray(v, np.float64) for v in (lon1, lat1, lon2, lat2)
+    )
+    U1 = np.arctan((1 - _WGS84_F) * np.tan(np.radians(lat1)))
+    U2 = np.arctan((1 - _WGS84_F) * np.tan(np.radians(lat2)))
+    L = np.radians(lon2 - lon1)
+    lam = L.copy()
+    sinU1, cosU1 = np.sin(U1), np.cos(U1)
+    sinU2, cosU2 = np.sin(U2), np.cos(U2)
+    sin_sig = cos_sig = sig = cos_sq_al = cos2sm = np.zeros_like(L)
+    for _ in range(24):
+        sin_lam, cos_lam = np.sin(lam), np.cos(lam)
+        sin_sig = np.sqrt(
+            (cosU2 * sin_lam) ** 2
+            + (cosU1 * sinU2 - sinU1 * cosU2 * cos_lam) ** 2
+        )
+        cos_sig = sinU1 * sinU2 + cosU1 * cosU2 * cos_lam
+        sig = np.arctan2(sin_sig, cos_sig)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            sin_al = np.where(
+                sin_sig != 0, cosU1 * cosU2 * sin_lam / sin_sig, 0.0
+            )
+        cos_sq_al = 1 - sin_al**2
+        with np.errstate(divide="ignore", invalid="ignore"):
+            cos2sm = np.where(
+                cos_sq_al != 0,
+                cos_sig - 2 * sinU1 * sinU2 / np.where(
+                    cos_sq_al == 0, 1.0, cos_sq_al
+                ),
+                0.0,
+            )
+        C = _WGS84_F / 16 * cos_sq_al * (
+            4 + _WGS84_F * (4 - 3 * cos_sq_al)
+        )
+        lam = L + (1 - C) * _WGS84_F * sin_al * (
+            sig
+            + C * sin_sig * (cos2sm + C * cos_sig * (-1 + 2 * cos2sm**2))
+        )
+    u_sq = cos_sq_al * (_WGS84_A**2 - _WGS84_B**2) / _WGS84_B**2
+    A = 1 + u_sq / 16384 * (
+        4096 + u_sq * (-768 + u_sq * (320 - 175 * u_sq))
+    )
+    B = u_sq / 1024 * (256 + u_sq * (-128 + u_sq * (74 - 47 * u_sq)))
+    d_sig = B * sin_sig * (
+        cos2sm
+        + B / 4 * (
+            cos_sig * (-1 + 2 * cos2sm**2)
+            - B / 6 * cos2sm * (-3 + 4 * sin_sig**2) * (-3 + 4 * cos2sm**2)
+        )
+    )
+    out = _WGS84_B * A * (sig - d_sig)
+    # coincident points: exactly zero (the iteration above is stable there)
+    return np.where((lon1 == lon2) & (lat1 == lat2), 0.0, out)
+
+
+def st_distanceSpheroid(a, b):
+    """Point-to-point distance in meters on the WGS84 spheroid (Vincenty
+    inverse; the reference delegates to GeodeticCalculator)."""
+
+    def coords(g):
+        if isinstance(g, Point):
+            return np.array([[g.x, g.y]])
+        if _is_point_col(g):
+            return g
+        return np.stack([[p.x, p.y] for p in g])
+
+    ca, cb = coords(a), coords(b)
+    n = max(len(ca), len(cb))
+    ca = np.broadcast_to(ca, (n, 2))
+    cb = np.broadcast_to(cb, (n, 2))
+    d = _vincenty_m(ca[:, 0], ca[:, 1], cb[:, 0], cb[:, 1])
+    if isinstance(a, Point) and isinstance(b, Point):
+        return float(d[0])
+    return d
+
+
+def st_lengthSpheroid(geom):
+    """Path length in meters on the WGS84 spheroid (per-segment Vincenty,
+    summed)."""
+
+    def one(g):
+        segs = _segments_of(g)
+        if len(segs) == 0:
+            return 0.0
+        return float(
+            _vincenty_m(
+                segs[:, 0], segs[:, 1], segs[:, 2], segs[:, 3]
+            ).sum()
+        )
+
+    return _scalar_or_col(geom, one)
+
+
+# -- affine transforms -------------------------------------------------------
+
+
+def st_rotate(geom, angle_rad: float):
+    """Rotate about the origin by ``angle_rad`` (counter-clockwise)."""
+    c, s = float(np.cos(angle_rad)), float(np.sin(angle_rad))
+    rot = np.array([[c, s], [-s, c]])
+
+    def one(g):
+        return _map_coords(g, lambda xy: xy @ rot)
+
+    return _scalar_or_col(geom, one)
+
+
+def st_scale(geom, xf: float, yf: float):
+    """Scale about the origin by (xf, yf)."""
+    f = np.array([xf, yf], np.float64)
+
+    def one(g):
+        return _map_coords(g, lambda xy: xy * f)
+
+    return _scalar_or_col(geom, one)
+
+
+# -- polygon boolean ops (geom/clip.py Greiner-Hormann engine) ---------------
+
+
+def _boolean_op(a, b, fn):
+    if isinstance(a, Geometry) and isinstance(b, Geometry):
+        return fn(a, b)
+    if isinstance(a, Geometry):
+        return np.array([fn(a, g) for g in b], dtype=object)
+    if isinstance(b, Geometry):
+        return np.array([fn(g, b) for g in a], dtype=object)
+    return np.array([fn(x, y) for x, y in zip(a, b)], dtype=object)
+
+
+def st_intersection(a, b):
+    """Polygon ∩ polygon (simple polygons, holes unsupported — see
+    geom/clip.py for the v1 contract)."""
+    from geomesa_tpu.geom.clip import polygon_intersection
+
+    return _boolean_op(a, b, polygon_intersection)
+
+
+def st_union(a, b):
+    from geomesa_tpu.geom.clip import polygon_union
+
+    return _boolean_op(a, b, polygon_union)
+
+
+def st_difference(a, b):
+    from geomesa_tpu.geom.clip import polygon_difference
+
+    return _boolean_op(a, b, polygon_difference)
+
+
+def st_symDifference(a, b):
+    from geomesa_tpu.geom.clip import polygon_sym_difference
+
+    return _boolean_op(a, b, polygon_sym_difference)
+
+
+def st_aggregateIntersection(geoms):
+    """Fold ∩ over a geometry column (ref aggregate UDF)."""
+    from geomesa_tpu.geom.clip import polygon_intersection
+
+    geoms = list(geoms)
+    if not geoms:
+        return MultiPolygon(())
+    acc = geoms[0]
+    for g in geoms[1:]:
+        acc = polygon_intersection(acc, g)
+    return acc
+
+
+def st_aggregateUnion(geoms):
+    """Fold ∪ over a geometry column (ref aggregate UDF)."""
+    from geomesa_tpu.geom.clip import polygon_union
+
+    geoms = list(geoms)
+    if not geoms:
+        return MultiPolygon(())
+    acc = geoms[0]
+    for g in geoms[1:]:
+        acc = polygon_union(acc, g)
+    return acc
+
+
 # -- registry ----------------------------------------------------------------
 
 FUNCTIONS = {

@@ -315,17 +315,21 @@ class DeviceIndex:
             return batch, self._stage_batch(batch)
 
     def _dim_usable(self, kind, sfc, bins) -> bool:
-        """Whether THIS install can pack the dim-plane layout: z3 key,
-        21-bit time precision, and the data's bin span inside the packable
-        window (top bin reserved for the out-of-range sentinel)."""
+        """Whether THIS install can pack the dim-plane layout: a z2 key
+        (always packs — 31-bit dims in uint32 planes, no time), or a z3
+        key with 21-bit time precision and the data's bin span inside the
+        packable window (top bin reserved for the out-of-range
+        sentinel)."""
         from geomesa_tpu.ops.zscan import BT_BIN_SPAN, BT_TIME_BITS
 
-        if self._dim_pref is False or kind != "z3":
+        if self._dim_pref is False or kind not in ("z3", "z2"):
             if self._dim_pref is True:
                 raise ValueError(
-                    "dim_planes=True requires a z3 (point + date) schema"
+                    "dim_planes=True requires a z3/z2 (point) schema"
                 )
             return False
+        if kind == "z2":
+            return True
         if sfc.precision != BT_TIME_BITS:
             if self._dim_pref is True:
                 raise ValueError(
@@ -342,6 +346,48 @@ class DeviceIndex:
                 "period bins; the bt word cannot pack them"
             )
         return span_ok
+
+    def _dim_planes_z2(self, sfc, coords):
+        """{Z_NX, Z_NY} planes for a z2 batch in dim mode (no time in
+        the key; no bin packing, so streaming appends never rebase)."""
+        import jax
+        import jax.numpy as jnp
+
+        x, y = coords
+        if len(x) == 0:
+            e = np.empty(0, np.uint32)
+            return {Z_NX: e, Z_NY: e.copy()}
+        if not self._z_encode_failed:
+            try:
+                with jax.enable_x64():
+                    if self._dim_encode_jit is None:
+
+                        def _enc2(x, y):
+                            nx = sfc.lon.normalize_jax(x).astype(jnp.uint32)
+                            ny = sfc.lat.normalize_jax(y).astype(jnp.uint32)
+                            return nx, ny
+
+                        self._dim_encode_jit = jax.jit(_enc2)
+                    nx, ny = self._dim_encode_jit(
+                        jnp.asarray(x), jnp.asarray(y)
+                    )
+                    ny.block_until_ready()
+                return {Z_NX: nx, Z_NY: ny}
+            except Exception as e:  # pragma: no cover - platform (no f64)
+                import warnings
+
+                warnings.warn(
+                    f"device key encode unavailable ({type(e).__name__}: "
+                    f"{e}); staging falls back to the host encode for "
+                    "this index",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                self._z_encode_failed = True
+                self._dim_encode_jit = None
+        nx = np.asarray(sfc.lon.normalize(x)).astype(np.uint32)
+        ny = np.asarray(sfc.lat.normalize(y)).astype(np.uint32)
+        return {Z_NX: nx, Z_NY: ny}
 
     def _dim_planes_for(self, sfc, coords, bins):
         """{Z_NX, Z_NY, Z_BT} planes for a z3 batch in dim mode. Devices
@@ -436,6 +482,8 @@ class DeviceIndex:
             # range before staging); delta batches keep the staged layout
             self._dim_mode = self._dim_usable(kind, sfc, bins)
         if self._dim_mode:
+            if kind == "z2":
+                return kind, self._dim_planes_z2(sfc, coords), bins
             return kind, self._dim_planes_for(sfc, coords, bins), bins
         if len(batch) == 0:
             return _z_planes_np(batch, self.sft)
@@ -561,6 +609,10 @@ class DeviceIndex:
         if self._z_kind == "z2":
             if window is not None:
                 return None  # no time in the key
+            if self._dim_mode:
+                # 2-plane dim scan; R=0 tags the unbinned kernel variant
+                qarr = zscan.z2_dim_plane_qarr(sfc, env)
+                return ("dim", jnp.asarray(qarr), 0)
             qlo = (int(sfc.lon.normalize(env[0])), int(sfc.lat.normalize(env[1])))
             qhi = (int(sfc.lon.normalize(env[2])), int(sfc.lat.normalize(env[3])))
             return jnp.asarray(zscan.z2_dim_bounds(qlo, qhi)), None
@@ -634,6 +686,10 @@ class DeviceIndex:
         from the served one)."""
         _, qarr, r = lb
         count_fn, mask_fn = self._dim_kernel(r)
+        if r == 0:  # unbinned z2: 2-plane kernel
+            return count_fn, mask_fn, (
+                qarr, self._cols[Z_NX], self._cols[Z_NY]
+            )
         return count_fn, mask_fn, (
             qarr, self._cols[Z_NX], self._cols[Z_NY], self._cols[Z_BT]
         )
@@ -650,7 +706,10 @@ class DeviceIndex:
 
         fns = self._dim_kernels.get(n_ranges)
         if fns is None:
-            cf, mf = zscan.build_z3_dimscan_rt(n_ranges)
+            if n_ranges == 0:  # unbinned z2: 2-plane kernel
+                cf, mf = zscan.build_z2_dimscan_rt()
+            else:
+                cf, mf = zscan.build_z3_dimscan_rt(n_ranges)
             fns = (jax.jit(cf), jax.jit(mf))
             self._dim_kernels[n_ranges] = fns
         return fns
@@ -1221,6 +1280,10 @@ class DeviceIndex:
             if lb is not None:
                 kind = "loose"
         compiled = None
+        if kind is None and f is ast.Include and self._cols:
+            # no filter: constant-true mask (full-viewport density /
+            # whole-type stats must not fall back to the store path)
+            kind = "include"
         if kind is None:
             compiled, cfn, _ = self._compiled_for(f)
             if compiled.device_cols and compiled.fully_on_device and cfn:
@@ -1241,13 +1304,24 @@ class DeviceIndex:
             n_ranges = lb[2] if dim_loose else 0
 
             def fused(cols, mask_args, valid, extra_args, auth_tab):
-                if dim_loose:
+                if kind == "include":
+                    import jax.numpy as jnp
+
+                    m = jnp.ones(
+                        next(iter(cols.values())).shape[0], bool
+                    )
+                elif dim_loose:
                     from geomesa_tpu.ops import zscan
 
-                    m = zscan.z3_dimscan_mask_rt(
-                        cols[Z_NX], cols[Z_NY], cols[Z_BT],
-                        mask_args, n_ranges,
-                    )
+                    if n_ranges == 0:  # unbinned z2: 2-plane mask
+                        m = zscan.z2_dimscan_mask_rt(
+                            cols[Z_NX], cols[Z_NY], mask_args
+                        )
+                    else:
+                        m = zscan.z3_dimscan_mask_rt(
+                            cols[Z_NX], cols[Z_NY], cols[Z_BT],
+                            mask_args, n_ranges,
+                        )
                 elif kind == "loose":
                     from geomesa_tpu.ops import zscan
 

@@ -403,6 +403,130 @@ def z3_dim_plane_qarr(
     return out, r
 
 
+def z2_dim_plane_qarr(sfc, env) -> np.ndarray:
+    """RUNTIME query vector for the UNBINNED 2-plane dim scan: uint32
+    ``[qnx_lo, qnx_hi, qny_lo, qny_hi]`` (the z2 analog of
+    :func:`z3_dim_plane_qarr`; no bt ranges — the key has no time)."""
+    xmin, ymin, xmax, ymax = env
+    return np.array(
+        [
+            int(sfc.lon.normalize(xmin)), int(sfc.lon.normalize(xmax)),
+            int(sfc.lat.normalize(ymin)), int(sfc.lat.normalize(ymax)),
+        ],
+        np.uint32,
+    )
+
+
+def z2_dimscan_mask_rt(nx, ny, qarr):
+    """XLA-fused 2-plane dim mask with RUNTIME bounds (z2 schemas)."""
+    m = (nx >= qarr[0]) & (nx <= qarr[1])
+    return m & (ny >= qarr[2]) & (ny <= qarr[3])
+
+
+def build_z2_dimscan_rt(
+    *,
+    block_rows: int = 512,
+    interpret: "bool | None" = None,
+):
+    """Pallas 2-plane dim kernel with RUNTIME bounds: (count_fn, mask_fn)
+    over ``(qarr, nx, ny)`` — the z2 sibling of
+    :func:`build_z3_dimscan_rt` (4 compares/row over 8B/row)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    LANES = 128
+    br = block_rows
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    _zero = lambda: jnp.int32(0)  # noqa: E731 (int32 index-map literal)
+
+    def _tile_mask(q_ref, nx_t, ny_t):
+        m = (nx_t >= q_ref[0]) & (nx_t <= q_ref[1])
+        return m & (ny_t >= q_ref[2]) & (ny_t <= q_ref[3])
+
+    def _prep(nx, ny):
+        n = int(nx.shape[0])
+        grid = max(1, -(-n // (br * LANES)))
+        pad = grid * br * LANES - n
+        mats = [
+            jnp.pad(a, (0, pad)).reshape(grid * br, LANES) for a in (nx, ny)
+        ]
+        return n, grid, mats
+
+    def _tail(n):
+        def apply(m):
+            i = pl.program_id(0)
+            idx = (
+                i * br * LANES
+                + jax.lax.broadcasted_iota(jnp.int32, (br, LANES), 0) * LANES
+                + jax.lax.broadcasted_iota(jnp.int32, (br, LANES), 1)
+            )
+            return m & (idx < n)
+
+        return apply
+
+    def count_fn(qarr, nx, ny):
+        n, grid, mats = _prep(nx, ny)
+        tail = _tail(n)
+
+        def kernel(q_ref, a_ref, b_ref, out_ref):
+            m = tail(_tile_mask(q_ref, a_ref[...], b_ref[...]))
+
+            @pl.when(pl.program_id(0) == 0)
+            def _():
+                out_ref[...] = jnp.zeros((1, LANES), jnp.int32)
+
+            out_ref[...] = out_ref[...] + jnp.sum(
+                m.astype(jnp.int32), axis=0, dtype=jnp.int32, keepdims=True
+            )
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((br, LANES), lambda i, q: (i, _zero()))
+            ] * 2,
+            out_specs=pl.BlockSpec(
+                (1, LANES), lambda i, q: (_zero(), _zero())
+            ),
+        )
+        partials = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+            interpret=interpret,
+        )(qarr, *mats)
+        return jnp.sum(partials, dtype=jnp.int32)
+
+    def mask_fn(qarr, nx, ny):
+        n, grid, mats = _prep(nx, ny)
+        tail = _tail(n)
+
+        def kernel(q_ref, a_ref, b_ref, out_ref):
+            m = tail(_tile_mask(q_ref, a_ref[...], b_ref[...]))
+            out_ref[...] = m.astype(jnp.int8)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((br, LANES), lambda i, q: (i, _zero()))
+            ] * 2,
+            out_specs=pl.BlockSpec((br, LANES), lambda i, q: (i, _zero())),
+        )
+        m = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((grid * br, LANES), jnp.int8),
+            interpret=interpret,
+        )(qarr, *mats)
+        return m.reshape(-1)[:n].astype(bool)
+
+    return count_fn, mask_fn
+
+
 def z3_dimscan_mask_rt(nx, ny, bt, qarr, n_ranges: int):
     """XLA-fused dim-plane mask with RUNTIME bounds (the fused-agg /
     streaming engine; the Pallas kernel below is the count champion).

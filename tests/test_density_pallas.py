@@ -157,11 +157,15 @@ def test_device_index_density_uses_pallas(monkeypatch):
         return orig(*a, **k)
 
     monkeypatch.setattr(dpal, "build_density_pallas", spy)
-    # a device-expressible filter: _fused_agg needs resident device cols
-    # (INCLUDE has none and falls back to the store path, as before)
     cql = "BBOX(geom, -179, -89, 179, 89)"
     grid = di.density(cql, env, width, height)
     assert built, "DeviceIndex.density did not build the Pallas kernel"
+    # INCLUDE (no filter) also serves from the resident path: the fused
+    # hook uses a constant-true mask (a full-viewport render must not
+    # fall back to the store)
+    g_inc = di.density("INCLUDE", env, width, height)
+    assert g_inc is not None
+    np.testing.assert_array_equal(g_inc, grid)  # bbox covers everything
     assert grid is not None and grid.shape == (height, width)
     # parity vs the host oracle on the same rows (pixel-center data)
     batch = ds.query("d").batch

@@ -807,7 +807,9 @@ def test_staging_device_encode_z2_and_x64_scoping():
         },
     )
     before = jax.config.jax_enable_x64
-    di = DeviceIndex(ds, "z2t", z_planes=True)
+    # dim_planes=False: this test checks the INTERLEAVED z2 encode parity
+    # (z2 now stages dim planes by default; see test_dimplane_cache)
+    di = DeviceIndex(ds, "z2t", z_planes=True, dim_planes=False)
     assert jax.config.jax_enable_x64 == before
     assert di._z_kind == "z2"
     batch = ds.query("z2t").batch

@@ -179,14 +179,83 @@ def test_dim_planes_true_raises_on_wide_span():
         DeviceIndex(wide, "gdelt", z_planes=True, dim_planes=True)
 
 
-def test_dim_planes_true_raises_on_non_z3():
+def test_dim_planes_true_raises_on_non_point():
+    """Non-point schemas (xz keys) cannot pack dim planes."""
+    from geomesa_tpu.geom.wkt import parse_wkt
+
     ds = MemoryDataStore()
-    ds.create_schema("nodate", "val:Int,*geom:Point:srid=4326")
-    ds.write("nodate", {
-        "val": np.arange(4), "geom": np.zeros((4, 2)),
+    ds.create_schema("polys", "val:Int,*geom:Polygon:srid=4326")
+    ds.write("polys", {
+        "val": np.arange(2),
+        "geom": np.array([
+            parse_wkt("POLYGON((0 0, 1 0, 1 1, 0 0))"),
+            parse_wkt("POLYGON((2 2, 3 2, 3 3, 2 2))"),
+        ], dtype=object),
     })
-    with pytest.raises(ValueError, match="z3"):
-        DeviceIndex(ds, "nodate", z_planes=True, dim_planes=True)
+    with pytest.raises(ValueError, match="z3/z2"):
+        DeviceIndex(ds, "polys", z_planes=True, dim_planes=True)
+
+
+class TestZ2Dim:
+    """Date-less point schemas stage the 2-plane dim layout."""
+
+    def _z2_store(self, n=3000, seed=4):
+        rng = np.random.default_rng(seed)
+        ds = MemoryDataStore()
+        ds.create_schema("z2t", "val:Int,*geom:Point:srid=4326")
+        ds.write("z2t", {
+            "val": rng.integers(0, 100, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)],
+                axis=1,
+            ),
+        })
+        return ds
+
+    def test_dim_mode_default_and_planes(self):
+        di = DeviceIndex(self._z2_store(), "z2t", z_planes=True)
+        assert di._z_kind == "z2" and di._dim_mode
+        assert Z_NX in di._cols and Z_NY in di._cols
+        assert Z_BT not in di._cols  # no time in the key
+        assert Z_HI not in di._cols
+
+    def test_loose_parity_vs_masked_compare(self):
+        ds = self._z2_store()
+        dim = DeviceIndex(ds, "z2t", z_planes=True)
+        cmp_ = DeviceIndex(ds, "z2t", z_planes=True, dim_planes=False)
+        np.testing.assert_array_equal(
+            dim.mask(BBOX_ONLY, loose=True),
+            cmp_.mask(BBOX_ONLY, loose=True),
+        )
+        assert dim.count(BBOX_ONLY, loose=True) == cmp_.count(
+            BBOX_ONLY, loose=True
+        )
+        # superset of exact
+        loose = dim.mask(BBOX_ONLY, loose=True)
+        exact = dim.mask(BBOX_ONLY, loose=False)
+        assert not np.any(exact & ~loose)
+
+    def test_kernel_and_fused_paths(self):
+        ds = self._z2_store()
+        di = DeviceIndex(ds, "z2t", z_planes=True)
+        got = di.loose_scan_kernel(BBOX_ONLY)
+        assert got is not None
+        fn, args = got
+        assert len(args) == 3  # (qarr, nx, ny): the 2-plane signature
+        assert int(fn(*args)) == di.count(BBOX_ONLY, loose=True)
+        seq = di.stats(BBOX_ONLY, "Count()", loose=True)
+        assert seq.stats[0].count == di.count(BBOX_ONLY, loose=True)
+
+    def test_streaming_append(self):
+        ds = self._z2_store(n=1000)
+        di = StreamingDeviceIndex(ds, "z2t", z_planes=True, capacity=8192)
+        extra = self._z2_store(n=500, seed=9)
+        di.append(extra.query("z2t").batch)
+        assert di.delta_appends == 1 and di._dim_mode
+        loose = di.mask(BBOX_ONLY, loose=True)
+        exact = di.mask(BBOX_ONLY, loose=False)
+        assert not np.any(exact & ~loose)
+        assert exact.sum() > 0
 
 
 def test_fused_stats_on_dim_planes():

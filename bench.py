@@ -245,7 +245,13 @@ def bench_zscan(args) -> dict:
     ny uint32 + packed (bin<<21|nt) word, ~12 VPU ops/row vs ~46 for the
     interleaved masked compare; 12B/row either way). Loose cell
     semantics — what the reference's Z3Iterator answers without residual
-    refinement."""
+    refinement.
+
+    Metric note: this kernel is ROW-RATE bound (~52B rows/s on v5e,
+    above the attribute filter's ~46B) — it reads 12B/row to the
+    filter's 16, so its GB/s and HBM% read LOWER even while it scans
+    MORE features per second. Compare feats/sec across legs, not HBM%.
+    """
     import jax
     import numpy as np
 
@@ -591,10 +597,22 @@ def bench_density_knn(args) -> dict:
     di = DeviceIndex(ds, "ais")
     t0 = _t.perf_counter()
     batch, _d = knn(ds, "ais", 2.35, 48.85, k=100, device_index=di)
-    knn_ms = (_t.perf_counter() - t0) * 1e3
+    cold_ms = (_t.perf_counter() - t0) * 1e3
     assert len(batch) == 100
-    log(f"kNN k=100 over {kn:,} resident rows: {knn_ms:.0f}ms end-to-end")
+    # the serving number is the WARM call (one fused dispatch; the cold
+    # call is dominated by the one-time top_k kernel compile, recorded
+    # separately): a map client's 2nd..Nth kNN never recompiles
+    reps = []
+    for _ in range(5):
+        t0 = _t.perf_counter()
+        b2, _d2 = knn(ds, "ais", 2.35, 48.85, k=100, device_index=di)
+        reps.append((_t.perf_counter() - t0) * 1e3)
+    knn_ms = sorted(reps)[len(reps) // 2]
+    assert np.array_equal(b2.fids, batch.fids)
+    log(f"kNN k=100 over {kn:,} resident rows: {knn_ms:.0f}ms warm "
+        f"({cold_ms:.0f}ms cold incl. compile)")
     m["knn_ms"] = round(knn_ms, 1)
+    m["knn_cold_ms"] = round(cold_ms, 1)
     m["knn_n"] = kn
     return m
 

@@ -18,6 +18,13 @@ Endpoints (all GET):
   DensityProcess analog), JSON {"counts": [[...]], "bbox": [...]}
 - ``/stats/<type>?cql=&stats=<Stat-DSL spec>&loose=`` -- server-side
   aggregation (StatsProcess / StatsIterator analog), JSON stat list
+- ``/knn/<type>?x=&y=&k=&cql=&maxRadius=`` -- k nearest features with
+  distances (KNearestNeighborSearchProcess analog; resident mode = one
+  fused distance+top_k dispatch)
+- ``/tube/<type>?track=x,y,t;...&buffer=&maxDt=&cql=`` -- corridor
+  search around a track (TubeSelectProcess analog)
+- ``/proximity/<type>?points=x,y;...&distance=&cql=`` -- features near
+  any input point, with distances (ProximitySearchProcess analog)
 - ``/metrics``                      -- Prometheus exposition text
 - ``/refresh/<type>``               -- restage a resident type after writes
 
@@ -152,7 +159,7 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             if len(parts) == 2 and parts[0] in (
                 "features", "count", "explain", "density", "stats",
-                "refresh",
+                "refresh", "knn", "tube", "proximity",
             ):
                 handler = getattr(self, f"_{parts[0]}")
                 return handler(unquote(parts[1]), q)
@@ -235,6 +242,89 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, feature_collection(batch))
         else:
             self._json(400, {"error": f"unknown format {fmt!r}"})
+
+    def _emit_features(self, batch, q: dict, extra=None) -> None:
+        """GeoJSON feature collection (optionally with extra per-feature
+        fields merged into properties, e.g. kNN distances)."""
+        from geomesa_tpu.export import feature_collection
+
+        doc = feature_collection(batch)
+        if extra:
+            for name, vals in extra.items():
+                for f, v in zip(doc["features"], vals):
+                    f["properties"][name] = v
+        self._json(200, doc)
+
+    # -- WPS process endpoints (knn / tube select / proximity search) ------
+
+    def _knn(self, type_name: str, q: dict) -> None:
+        """``/knn/<type>?x=&y=&k=&cql=&maxRadius=`` — k nearest features
+        (KNearestNeighborSearchProcess analog). In resident mode this is
+        ONE fused distance+top_k dispatch on the pinned columns."""
+        from geomesa_tpu.process.knn import knn
+
+        px, py = float(q["x"]), float(q["y"])
+        k = int(q.get("k", 10))
+        kwargs = {}
+        if q.get("maxRadius"):
+            kwargs["max_radius_deg"] = float(q["maxRadius"])
+        batch, dists = knn(
+            self.store, type_name, px, py, k,
+            base_filter=q.get("cql"),
+            device_index=self._di(type_name),
+            auths=self._auths(q),
+            **kwargs,
+        )
+        self._emit_features(
+            batch, q, extra={"knn_distance_deg": [float(d) for d in dists]}
+        )
+
+    def _tube(self, type_name: str, q: dict) -> None:
+        """``/tube/<type>?track=x,y,t;x,y,t;...&buffer=&maxDt=&cql=`` —
+        corridor search around a track (TubeSelectProcess analog; one
+        union-of-windows dispatch in resident mode)."""
+        import numpy as np
+
+        pts = [p for p in q["track"].split(";") if p]
+        trk = np.array([[float(v) for v in p.split(",")] for p in pts])
+        if trk.ndim != 2 or trk.shape[1] != 3 or len(trk) < 2:
+            raise ValueError(
+                "track must be 'x,y,t_ms;x,y,t_ms;...' with >= 2 points"
+            )
+        from geomesa_tpu.process.tube import tube_select
+
+        batch = tube_select(
+            self.store, type_name, trk[:, :2], trk[:, 2].astype(np.int64),
+            buffer_deg=float(q.get("buffer", 0.1)),
+            max_dt_ms=int(q.get("maxDt", 3_600_000)),
+            base_filter=q.get("cql"),
+            device_index=self._di(type_name),
+            auths=self._auths(q),
+        )
+        self._emit_features(batch, q)
+
+    def _proximity(self, type_name: str, q: dict) -> None:
+        """``/proximity/<type>?points=x,y;x,y&distance=&cql=`` — features
+        within a distance of any input point (ProximitySearchProcess
+        analog; one union-of-windows dispatch in resident mode)."""
+        from geomesa_tpu.geom.base import Point
+        from geomesa_tpu.process.proximity import proximity_search
+
+        pts = [p for p in q["points"].split(";") if p]
+        geoms = [
+            Point(*(float(v) for v in p.split(","))) for p in pts
+        ]
+        batch, dists = proximity_search(
+            self.store, type_name, geoms,
+            distance_deg=float(q.get("distance", 0.1)),
+            base_filter=q.get("cql"),
+            device_index=self._di(type_name),
+            auths=self._auths(q),
+        )
+        self._emit_features(
+            batch, q,
+            extra={"proximity_distance_deg": [float(d) for d in dists]},
+        )
 
     def _count(self, type_name: str, q: dict) -> None:
         di = self._di(type_name)

@@ -315,6 +315,49 @@ def test_dim_auths_fail_closed_and_serve_per_request():
     assert int(m.sum()) == admin_ct
 
 
+def test_fuzz_dim_vs_masked_compare_random_windows():
+    """Differential fuzz: 40 random bbox(+during) windows over z3 AND z2
+    dim-mode indexes must match the masked-compare engine bit for bit
+    (covers qarr construction, bin-range clamping, range merging and the
+    R-bucket padding across window shapes)."""
+    rng = np.random.default_rng(99)
+    ds3 = _store(n=5000, seed=31)
+    dim3 = DeviceIndex(ds3, "gdelt", z_planes=True)
+    cmp3 = DeviceIndex(ds3, "gdelt", z_planes=True, dim_planes=False)
+
+    ds2 = MemoryDataStore()
+    n = 4000
+    ds2.create_schema("z2f", "val:Int,*geom:Point:srid=4326")
+    ds2.write("z2f", {
+        "val": rng.integers(0, 9, n),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+        ),
+    })
+    dim2 = DeviceIndex(ds2, "z2f", z_planes=True)
+    cmp2 = DeviceIndex(ds2, "z2f", z_planes=True, dim_planes=False)
+
+    from geomesa_tpu.filter import ast
+
+    for i in range(40):
+        x0, y0 = rng.uniform(-185, 175), rng.uniform(-95, 85)
+        w = 10 ** rng.uniform(-2, 2.3)
+        h = 10 ** rng.uniform(-2, 2)
+        bbox = ast.BBox("geom", x0, y0, min(x0 + w, 180), min(y0 + h, 90))
+        # z3: random windows incl. degenerate/outside/bin-straddling
+        t_lo = T0 + int(rng.uniform(-30, 90) * DAY_MS)
+        t_hi = t_lo + int(10 ** rng.uniform(3, 7.2))
+        f3 = ast.And((bbox, ast.During("dtg", t_lo, t_hi)))
+        np.testing.assert_array_equal(
+            dim3.mask(f3, loose=True), cmp3.mask(f3, loose=True),
+            err_msg=f"z3 window {i}",
+        )
+        np.testing.assert_array_equal(
+            dim2.mask(bbox, loose=True), cmp2.mask(bbox, loose=True),
+            err_msg=f"z2 window {i}",
+        )
+
+
 class TestStreamingDim:
     def test_append_keeps_dim_mode_and_parity(self):
         ds = _store(n=2000)

@@ -370,3 +370,107 @@ def test_server_auths_param_resident_and_store():
                 assert len(json.loads(body)["features"]) == want
         finally:
             server.shutdown()
+
+
+def test_knn_endpoint(server_url):
+    """/knn returns k nearest features with distances, matching the
+    process-layer result."""
+    from urllib.parse import quote
+
+    from geomesa_tpu.process.knn import knn
+
+    url, ds = server_url
+    status, _, body = _get(f"{url}/knn/gdelt?x=2.0&y=5.0&k=7")
+    assert status == 200
+    doc = json.loads(body)
+    assert len(doc["features"]) == 7
+    dists = [f["properties"]["knn_distance_deg"] for f in doc["features"]]
+    assert dists == sorted(dists)
+    batch, want = knn(ds, "gdelt", 2.0, 5.0, k=7)
+    got_ids = [f["id"] for f in doc["features"]]
+    assert got_ids == [str(f) for f in batch.fids]
+    # with a base filter
+    status, _, body = _get(
+        f"{url}/knn/gdelt?x=2.0&y=5.0&k=5&cql={quote(chr(39).join(['name = ', 'a', '']))}"
+    )
+    assert status == 200
+    doc = json.loads(body)
+    assert len(doc["features"]) == 5
+    assert all(f["properties"]["name"] == "a" for f in doc["features"])
+
+
+def test_tube_endpoint(server_url):
+    url, ds = server_url
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = t0 + 10**8
+    track = f"-10,-10,{t0};0,0,{(t0 + t1) // 2};10,10,{t1}"
+    status, _, body = _get(
+        f"{url}/tube/gdelt?track={track}&buffer=2.0&maxDt={10**8}"
+    )
+    assert status == 200
+    doc = json.loads(body)
+    from geomesa_tpu.process.tube import tube_select
+
+    want = tube_select(
+        ds, "gdelt",
+        np.array([[-10, -10], [0, 0], [10, 10]], float),
+        np.array([t0, (t0 + t1) // 2, t1], np.int64),
+        buffer_deg=2.0, max_dt_ms=10**8,
+    )
+    assert sorted(f["id"] for f in doc["features"]) == sorted(
+        str(f) for f in want.fids
+    )
+    assert len(doc["features"]) > 0
+
+
+def test_proximity_endpoint(server_url):
+    url, ds = server_url
+    status, _, body = _get(
+        f"{url}/proximity/gdelt?points=0,0;5,5&distance=1.5"
+    )
+    assert status == 200
+    doc = json.loads(body)
+    from geomesa_tpu.geom.base import Point
+    from geomesa_tpu.process.proximity import proximity_search
+
+    want, wd = proximity_search(
+        ds, "gdelt", [Point(0, 0), Point(5, 5)], 1.5
+    )
+    assert sorted(f["id"] for f in doc["features"]) == sorted(
+        str(f) for f in want.fids
+    )
+    assert len(doc["features"]) > 0
+    for f in doc["features"]:
+        assert f["properties"]["proximity_distance_deg"] <= 1.5 + 1e-9
+
+
+def test_process_endpoints_resident_mode():
+    """The process endpoints work identically in resident mode (served
+    by the one-dispatch device paths)."""
+    ds = MemoryDataStore()
+    ds.create_schema("r", SPEC)
+    n = 1500
+    rng = np.random.default_rng(23)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write("r", {
+        "name": rng.choice(["a", "b"], n),
+        "dtg": t0 + rng.integers(0, 10**8, n),
+        "geom": np.stack(
+            [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+    server, _ = serve_background(ds, resident=True)
+    host, port = server.server_address[:2]
+    url = f"http://{host}:{port}"
+    try:
+        s1, _, b1 = _get(f"{url}/knn/r?x=1.0&y=1.0&k=9")
+        assert s1 == 200
+        from geomesa_tpu.process.knn import knn
+
+        want, _ = knn(ds, "r", 1.0, 1.0, k=9)
+        got = [f["id"] for f in json.loads(b1)["features"]]
+        assert got == [str(f) for f in want.fids]
+        s2, _, b2 = _get(f"{url}/proximity/r?points=2,2&distance=1.0")
+        assert s2 == 200 and len(json.loads(b2)["features"]) > 0
+    finally:
+        server.shutdown()

@@ -18,7 +18,14 @@ from geomesa_tpu.features.sft import SimpleFeatureType
 def schema_kind(sft: SimpleFeatureType):
     """(kind, sfc) the schema's key planes use: z3/z2 for point geometries
     (with/without a date field), xz3/xz2 extent curves for non-point ones,
-    (None, None) when the SFT has no geometry at all."""
+    (None, None) when the SFT has no geometry at all.
+
+    The curves honor the SAME user-data hints the durable key spaces do
+    (``geomesa.z3.interval``, ``geomesa.xz.precision`` — ref
+    SimpleFeatureTypes index hints): resident key planes packed with a
+    different period than the on-disk index would silently diverge from
+    the planner's per-bin decomposition."""
+    from geomesa_tpu.curves.binnedtime import TimePeriod
     from geomesa_tpu.curves.xz2 import XZ2SFC
     from geomesa_tpu.curves.xz3 import XZ3SFC
     from geomesa_tpu.curves.z2 import Z2SFC
@@ -32,10 +39,12 @@ def schema_kind(sft: SimpleFeatureType):
         # extent curve over the per-row geometry envelopes (ref XZ2/XZ3
         # index key spaces are the non-point peers of Z2/Z3)
         if dtg is not None:
-            return "xz3", XZ3SFC(g=sft.xz_precision)
+            return "xz3", XZ3SFC(
+                TimePeriod.parse(sft.z3_interval), sft.xz_precision
+            )
         return "xz2", XZ2SFC(sft.xz_precision)
     if dtg is not None:
-        return "z3", Z3SFC()
+        return "z3", Z3SFC(TimePeriod.parse(sft.z3_interval))
     return "z2", Z2SFC()
 
 

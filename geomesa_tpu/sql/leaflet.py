@@ -1,0 +1,166 @@
+"""Leaflet map rendering for feature batches and density grids.
+
+Ref role: geomesa-spark-jupyter-leaflet (the notebook visualization
+module [UNVERIFIED - empty reference mount]) — render query results and
+density heatmaps onto an interactive Leaflet map. Here the output is a
+SELF-CONTAINED HTML document (Leaflet CSS/JS from the public CDN; all
+DATA embedded inline as GeoJSON / a raw grid drawn onto a canvas image
+overlay), so it works from a notebook (``IPython.display.HTML``), a file
+on disk, or an HTTP response — no server round trips after load.
+
+    from geomesa_tpu.sql.leaflet import leaflet_map, save_map
+    html = leaflet_map(features=batch)                   # points/geoms
+    html = leaflet_map(density=(grid, env))              # heatmap
+    html = leaflet_map(features=batch, density=(g, env)) # both
+    save_map("map.html", features=batch)
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"/>
+<title>{title}</title>
+<link rel="stylesheet"
+ href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
+<script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
+<style>html,body,#map{{height:100%;margin:0}}</style>
+</head><body><div id="map"></div><script>
+var map = L.map('map').setView([{lat}, {lon}], {zoom});
+L.tileLayer('https://{{s}}.tile.openstreetmap.org/{{z}}/{{x}}/{{y}}.png',
+  {{maxZoom: 19, attribution: '&copy; OpenStreetMap'}}).addTo(map);
+{density_js}
+{features_js}
+</script></body></html>
+"""
+
+_DENSITY_JS = """
+var grid = {grid_json};
+var gh = grid.length, gw = grid[0].length;
+var cnv = document.createElement('canvas');
+cnv.width = gw; cnv.height = gh;
+var ctx = cnv.getContext('2d');
+var img = ctx.createImageData(gw, gh);
+var mx = 0;
+for (var r = 0; r < gh; r++)
+  for (var c = 0; c < gw; c++) if (grid[r][c] > mx) mx = grid[r][c];
+for (var r = 0; r < gh; r++) {{
+  for (var c = 0; c < gw; c++) {{
+    // grid row 0 = SOUTH edge; canvas row 0 = top -> flip vertically
+    var v = mx > 0 ? grid[gh - 1 - r][c] / mx : 0;
+    var i = 4 * (r * gw + c);
+    img.data[i] = 255;
+    img.data[i + 1] = Math.round(255 * (1 - v));
+    img.data[i + 2] = 0;
+    img.data[i + 3] = v > 0 ? Math.round(40 + 215 * v) : 0;
+  }}
+}}
+ctx.putImageData(img, 0, 0);
+L.imageOverlay(cnv.toDataURL(), [[{ymin}, {xmin}], [{ymax}, {xmax}]],
+  {{opacity: 0.7, interactive: false}}).addTo(map);
+"""
+
+_FEATURES_JS = """
+var fc = {geojson};
+L.geoJSON(fc, {{
+  pointToLayer: function (f, latlng) {{
+    return L.circleMarker(latlng,
+      {{radius: 4, weight: 1, color: '#1f6feb', fillOpacity: 0.7}});
+  }},
+  onEachFeature: function (f, layer) {{
+    if (f.properties) {{
+      var rows = Object.entries(f.properties).map(
+        function (kv) {{ return kv[0] + ': ' + kv[1]; }});
+      layer.bindPopup(rows.join('<br/>'));
+    }}
+  }}
+}}).addTo(map);
+"""
+
+
+def _env_tuple(env):
+    if hasattr(env, "xmin"):
+        return float(env.xmin), float(env.ymin), float(env.xmax), float(env.ymax)
+    e = [float(v) for v in env]
+    return e[0], e[1], e[2], e[3]
+
+
+def leaflet_map(
+    features=None,
+    density=None,
+    center=None,
+    zoom: "int | None" = None,
+    max_features: int = 10_000,
+    title: str = "geomesa-tpu map",
+) -> str:
+    """Self-contained Leaflet HTML for a FeatureBatch (or GeoJSON
+    feature-collection dict) and/or a ``(grid, envelope)`` density pair.
+
+    ``max_features`` caps the embedded GeoJSON (an interactive map with
+    millions of inline markers is unusable and tens of MB; run the
+    density path for full-data views). Center/zoom default to the data's
+    envelope."""
+    if features is None and density is None:
+        raise ValueError("leaflet_map needs features= and/or density=")
+
+    features_js = ""
+    fc = None
+    if features is not None:
+        if isinstance(features, dict):
+            fc = features
+        else:
+            from geomesa_tpu.export import feature_collection
+
+            batch = features
+            if len(batch) > max_features:
+                batch = batch.take(np.arange(max_features))
+            fc = feature_collection(batch)
+        features_js = _FEATURES_JS.format(geojson=json.dumps(fc))
+
+    density_js = ""
+    denv = None
+    if density is not None:
+        grid, env = density
+        grid = np.asarray(grid, np.float64)
+        denv = _env_tuple(env)
+        density_js = _DENSITY_JS.format(
+            grid_json=json.dumps(
+                [[round(float(v), 4) for v in row] for row in grid]
+            ),
+            xmin=denv[0], ymin=denv[1], xmax=denv[2], ymax=denv[3],
+        )
+
+    if center is None:
+        if denv is not None:
+            center = ((denv[1] + denv[3]) / 2, (denv[0] + denv[2]) / 2)
+        elif fc is not None and fc.get("features"):
+            xs, ys = [], []
+            for f in fc["features"]:
+                g = f.get("geometry") or {}
+                if g.get("type") == "Point":
+                    xs.append(g["coordinates"][0])
+                    ys.append(g["coordinates"][1])
+            center = (
+                (float(np.mean(ys)), float(np.mean(xs))) if xs else (0, 0)
+            )
+        else:
+            center = (0, 0)
+    return _PAGE.format(
+        title=title,
+        lat=center[0],
+        lon=center[1],
+        zoom=zoom if zoom is not None else 4,
+        density_js=density_js,
+        features_js=features_js,
+    )
+
+
+def save_map(path: str, **kwargs) -> str:
+    """Write :func:`leaflet_map` output to ``path``; returns the path."""
+    html = leaflet_map(**kwargs)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(html)
+    return path

@@ -315,6 +315,44 @@ def test_dim_auths_fail_closed_and_serve_per_request():
     assert int(m.sum()) == admin_ct
 
 
+def test_z3_interval_hint_reaches_resident_planes():
+    """``geomesa.z3.interval`` must drive the SAME period in the resident
+    key planes as in the durable key space (they diverged before round
+    4: schema_kind hardcoded WEEK)."""
+    from geomesa_tpu.curves.binnedtime import TimePeriod
+    from geomesa_tpu.index.keyplanes import schema_kind
+    from geomesa_tpu.index.keyspaces import keyspace_for
+
+    rng = np.random.default_rng(3)
+    n = 800
+    ds = MemoryDataStore()
+    ds.create_schema(
+        "d", "dtg:Date,*geom:Point:srid=4326;geomesa.z3.interval=day"
+    )
+    ds.write("d", {
+        "dtg": rng.integers(T0, T0 + 7 * DAY_MS, n),
+        "geom": np.stack(
+            [rng.uniform(-10, 10, n), rng.uniform(-10, 10, n)], axis=1
+        ),
+    })
+    sft = ds.get_schema("d")
+    _, sfc = schema_kind(sft)
+    assert sfc.period == TimePeriod.DAY
+    assert keyspace_for(sft, "z3").period == TimePeriod.DAY
+    di = DeviceIndex(ds, "d", z_planes=True)
+    assert di._dim_mode  # day precision is still 21 bits
+    ecql = (
+        "BBOX(geom, -5, -5, 5, 5) AND "
+        "dtg DURING 2020-01-02T00:00:00Z/2020-01-04T00:00:00Z"
+    )
+    loose = di.mask(ecql, loose=True)
+    exact = di.mask(ecql, loose=False)
+    assert not np.any(exact & ~loose) and exact.sum() > 0
+    # masked-compare engine agrees under the same period
+    cmp_ = DeviceIndex(ds, "d", z_planes=True, dim_planes=False)
+    np.testing.assert_array_equal(loose, cmp_.mask(ecql, loose=True))
+
+
 def test_fuzz_dim_vs_masked_compare_random_windows():
     """Differential fuzz: 40 random bbox(+during) windows over z3 AND z2
     dim-mode indexes must match the masked-compare engine bit for bit

@@ -1,0 +1,70 @@
+"""Leaflet HTML rendering (geomesa-spark-jupyter-leaflet analog)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.sql.leaflet import leaflet_map, save_map
+
+SFT = SimpleFeatureType.create("pts", "name:String,*geom:Point:srid=4326")
+
+
+def _batch(n=20):
+    rng = np.random.default_rng(1)
+    return FeatureBatch.from_columns(SFT, {
+        "name": [f"p{i}" for i in range(n)],
+        "geom": np.stack(
+            [rng.uniform(-10, 10, n), rng.uniform(40, 50, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+
+
+def test_features_map_embeds_geojson():
+    html = leaflet_map(features=_batch())
+    assert "L.geoJSON" in html and "leaflet" in html
+    # the embedded collection round-trips as JSON
+    start = html.index("var fc = ") + len("var fc = ")
+    end = html.index(";", start)
+    fc = json.loads(html[start:end])
+    assert len(fc["features"]) == 20
+    assert fc["features"][0]["properties"]["name"] == "p0"
+    # auto-center lands inside the data envelope
+    assert "setView([4" in html  # lat ~40-50
+
+
+def test_density_map_embeds_grid_and_bounds():
+    from geomesa_tpu.geom import Envelope
+
+    grid = np.zeros((8, 16), np.float32)
+    grid[2, 3] = 5.0
+    html = leaflet_map(density=(grid, Envelope(-10, 40, 10, 50)))
+    assert "imageOverlay" in html
+    assert "[[40.0, -10.0], [50.0, 10.0]]" in html
+    start = html.index("var grid = ") + len("var grid = ")
+    g = json.loads(html[start: html.index(";", start)])
+    assert len(g) == 8 and len(g[0]) == 16 and g[2][3] == 5.0
+
+
+def test_combined_and_cap(tmp_path):
+    from geomesa_tpu.geom import Envelope
+
+    big = _batch(50)
+    html = leaflet_map(
+        features=big,
+        density=(np.ones((4, 4)), Envelope(-10, 40, 10, 50)),
+        max_features=10,
+    )
+    start = html.index("var fc = ") + len("var fc = ")
+    fc = json.loads(html[start: html.index(";", start)])
+    assert len(fc["features"]) == 10  # capped
+    assert "imageOverlay" in html
+    p = save_map(str(tmp_path / "m.html"), features=_batch(3))
+    assert open(p).read().startswith("<!DOCTYPE html>")
+
+
+def test_requires_some_layer():
+    with pytest.raises(ValueError):
+        leaflet_map()

@@ -1091,8 +1091,12 @@ def main() -> None:
     elif args.mode == "pipeline":
         out = bench_pipeline(args)
     else:
-        out = bench_filter(args)
+        # zscan FIRST: its DeviceIndex staging is a long sequence of
+        # host->device transfers that measures 20-30x slower when another
+        # process contends for the tunnel mid-suite; fresh-process order
+        # also keeps the staging time representative
         z = bench_zscan(args)
+        out = bench_filter(args)
         out["zscan_feats_per_sec"] = z["value"]
         out["zscan_gbps"] = z["gbps"]
         out["zscan_hbm_pct"] = z["hbm_pct"]
@@ -1109,6 +1113,7 @@ def main() -> None:
         out["density_feats_per_sec"] = d["value"]
         out["density_hbm_pct"] = d["hbm_pct"]
         out["knn_ms"] = d["knn_ms"]
+        out["knn_cold_ms"] = d["knn_cold_ms"]
         # skewed (clustered) data: same flagship filter over GDELT-like
         # city clusters — selectivity shifts, throughput must hold.
         # Half-size columns: earlier phases' frees leave fragmented HBM,

@@ -3,9 +3,12 @@
 Ref role: the reference gets ``st_intersection`` / ``st_difference`` and
 friends from JTS's overlay engine (geomesa-spark-jts [UNVERIFIED - empty
 reference mount]). This is a from-scratch Greiner-Hormann clipper for
-SIMPLE polygons: concave shapes are fine, holes are not supported in v1
-(explicit NotImplementedError — silently wrong topology would be worse),
-and MultiPolygons distribute over their disjoint components.
+SIMPLE polygons: concave shapes are fine; MultiPolygons distribute over
+their disjoint components. INTERSECTION additionally supports holes on
+either side (shell intersection, then merged hole regions trim or carry
+through — the common clip-to-viewport case); union/difference still
+refuse holes explicitly (NotImplementedError — silently wrong topology
+would be worse).
 
 Degeneracies (a vertex exactly on the other polygon's edge, collinear
 overlapping edges) are handled the standard practical way: the clip
@@ -40,21 +43,36 @@ class _Node:
         self.alpha = alpha
 
 
-def _ring_of(poly: Polygon) -> np.ndarray:
-    rings = list(poly.rings())
-    if len(rings) > 1:
-        raise NotImplementedError(
-            "polygon boolean ops do not support holes (v1); subtract the "
-            "holes explicitly if needed"
-        )
-    c = np.asarray(rings[0], np.float64)
+def _norm_ring(ring) -> np.ndarray:
+    """Closed-or-open ring -> OPEN CCW-normalized float64 ring."""
+    c = np.asarray(ring, np.float64)
     if np.array_equal(c[0], c[-1]):
         c = c[:-1]
-    # normalize to CCW so entry/exit marking is orientation-independent
     area2 = np.sum(c[:, 0] * np.roll(c[:, 1], -1) - np.roll(c[:, 0], -1) * c[:, 1])
     if area2 < 0:
         c = c[::-1]
     return c
+
+
+def _ring_of(poly: Polygon) -> np.ndarray:
+    rings = list(poly.rings())
+    if len(rings) > 1:
+        raise NotImplementedError(
+            "this polygon boolean op does not support holes (v1); "
+            "intersection does — or subtract the holes explicitly"
+        )
+    return _norm_ring(rings[0])
+
+
+def _components(g) -> list:
+    """(Multi)Polygon -> [(open shell ring, [open hole rings...]), ...]."""
+    out = []
+    for p in _as_polys(g):
+        rings = list(p.rings())
+        out.append((
+            _norm_ring(rings[0]), [_norm_ring(h) for h in rings[1:]]
+        ))
+    return out
 
 
 def _build_list(ring: np.ndarray) -> _Node:
@@ -312,15 +330,87 @@ def _ring_area2(r: np.ndarray) -> float:
     )
 
 
+def _merge_regions(regions: list) -> list:
+    """Fold possibly-overlapping simple regions (open rings) into disjoint
+    ones via pairwise union. A union whose pieces nest (two horseshoes
+    closing a void) is refused — that topology needs full hole-aware
+    union."""
+    merged: list = []  # open rings, pairwise disjoint
+    for h in regions:
+        cur = h
+        out = []
+        for ex in merged:
+            got = clip_rings(ex, cur, "union")
+            if len(got) == 1:
+                cur = _norm_ring(got[0])  # overlapped: fold and continue
+            else:
+                out.append(ex)  # disjoint (union kept both): keep apart
+        out.append(cur)
+        merged = out
+    for i, r1 in enumerate(merged):
+        for r2 in merged[i + 1:]:
+            if _point_in_ring(r1[0], r2) or _point_in_ring(r2[0], r1):
+                raise NotImplementedError(
+                    "hole regions enclose one another after merging; "
+                    "this topology is not supported"
+                )
+    return merged
+
+
+def _subtract_regions(rings: list, regions: list) -> list:
+    """Closed simple rings minus disjoint simple regions (open rings) ->
+    [(closed shell, [closed holes...])]. Regions crossing a ring's
+    boundary trim/split it; regions strictly inside attach as holes;
+    disjoint regions are no-ops — all three cases fall out of the
+    simple-ring difference (whose 'would create a hole' refusal IS the
+    attach signal)."""
+    pieces = list(rings)
+    pending: list = []
+    for h in regions:
+        nxt = []
+        for r in pieces:
+            try:
+                # re-normalize: traversal outputs carry arbitrary
+                # orientation, the clip contract wants CCW open rings
+                nxt.extend(clip_rings(_norm_ring(r), h, "difference"))
+            except NotImplementedError:
+                nxt.append(r)  # strictly inside: attach after splitting
+                pending.append(h)
+        pieces = nxt
+    out = []
+    for r in pieces:
+        holes = [
+            np.concatenate([h, h[:1]])
+            for h in pending
+            if _point_in_ring(h[0], r[:-1])
+        ]
+        out.append((r, holes))
+    return out
+
+
 def polygon_intersection(a, b):
-    """A ∩ B over (Multi)Polygons (components distribute: multipolygon
-    parts are disjoint by construction)."""
-    rings = []
-    for pa in _as_polys(a):
-        ra = _ring_of(pa)
-        for pb in _as_polys(b):
-            rings += clip_rings(ra, _ring_of(pb), "intersection")
-    return _wrap(rings)
+    """A ∩ B over (Multi)Polygons, WITH hole support: per component pair
+    the shells intersect via Greiner-Hormann, then both sides' hole
+    regions (merged where they overlap) subtract from the result —
+    crossing holes trim the rings, contained holes carry through as
+    holes of the output. Multipolygon components distribute (parts are
+    disjoint by construction)."""
+    parts = []
+    for sa, ha in _components(a):
+        for sb, hb in _components(b):
+            got = clip_rings(sa, sb, "intersection")
+            if not got:
+                continue
+            holes = _merge_regions(ha + hb) if (ha or hb) else []
+            parts += _subtract_regions(got, holes)
+    polys = [
+        Polygon(r, tuple(hs)) if hs else Polygon(r)
+        for r, hs in parts
+        if abs(_ring_area2(r)) > 0
+    ]
+    if not polys:
+        return MultiPolygon(())
+    return polys[0] if len(polys) == 1 else MultiPolygon(tuple(polys))
 
 
 def polygon_union(a, b):

@@ -1349,8 +1349,8 @@ def _boolean_op(a, b, fn):
 
 
 def st_intersection(a, b):
-    """Polygon ∩ polygon (simple polygons, holes unsupported — see
-    geom/clip.py for the v1 contract)."""
+    """Polygon ∩ polygon (holes supported on either side; see
+    geom/clip.py for the contract)."""
     from geomesa_tpu.geom.clip import polygon_intersection
 
     return _boolean_op(a, b, polygon_intersection)

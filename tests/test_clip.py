@@ -190,16 +190,79 @@ def _poly_from_points(pts):
     )
 
 
-def test_holes_rejected():
-    outer = np.array(
-        [(0, 0), (8, 0), (8, 8), (0, 8), (0, 0)], np.float64
-    )
-    hole = np.array(
-        [(3, 3), (5, 3), (5, 5), (3, 5), (3, 3)], np.float64
-    )
-    holed = Polygon(outer, (hole,))
-    with pytest.raises(NotImplementedError, match="hole"):
-        polygon_intersection(holed, SQUARE)
+HOLED = Polygon(
+    np.array([(0, 0), (8, 0), (8, 8), (0, 8), (0, 0)], np.float64),
+    (np.array([(3, 3), (5, 3), (5, 5), (3, 5), (3, 3)], np.float64),),
+)
+
+
+class TestHoledIntersection:
+    """Intersection supports holes: crossing holes trim the result,
+    contained holes carry through, overlapping hole regions merge."""
+
+    def _mc_inter(self, a, b, rng, n=20000):
+        ea, eb = a.envelope, b.envelope
+        lo = np.minimum([ea.xmin, ea.ymin], [eb.xmin, eb.ymin]) - 0.5
+        hi = np.maximum([ea.xmax, ea.ymax], [eb.xmax, eb.ymax]) + 0.5
+        pts = rng.uniform(lo, hi, (n, 2))
+        out = polygon_intersection(a, b)
+        span = float(max(hi[0] - lo[0], hi[1] - lo[1]))
+        keep = ~_near_edge(pts, [a, b, out], span * 2e-3)
+        want = _inside(pts, a) & _inside(pts, b)
+        got = _inside(pts, out)
+        bad = np.nonzero(got[keep] != want[keep])[0]
+        assert len(bad) == 0, (
+            f"{len(bad)}/{keep.sum()} points disagree "
+            f"(first {pts[keep][bad[:3]]})"
+        )
+        return out
+
+    def test_hole_carried_through(self):
+        # clip region covers the hole entirely: hole survives in output
+        clip = _poly([(1, 1), (7, 1), (7, 7), (1, 7)])
+        out = self._mc_inter(HOLED, clip, np.random.default_rng(10))
+        from geomesa_tpu.sql.functions import st_area
+
+        assert st_area(out) == pytest.approx(36 - 4)
+        assert isinstance(out, Polygon) and len(list(out.rings())) == 2
+
+    def test_hole_crossing_boundary_trims(self):
+        # clip boundary passes THROUGH the hole: no hole in the output,
+        # the ring is trimmed around it
+        clip = _poly([(1, 1), (4, 1), (4, 7), (1, 7)])
+        out = self._mc_inter(HOLED, clip, np.random.default_rng(11))
+        from geomesa_tpu.sql.functions import st_area
+
+        assert st_area(out) == pytest.approx(3 * 6 - 1 * 2)
+
+    def test_hole_outside_clip_ignored(self):
+        clip = _poly([(0, 0), (2, 0), (2, 2), (0, 2)])
+        out = self._mc_inter(HOLED, clip, np.random.default_rng(12))
+        from geomesa_tpu.sql.functions import st_area
+
+        assert st_area(out) == pytest.approx(4.0)
+
+    def test_overlapping_holes_both_sides_merge(self):
+        other = Polygon(
+            np.array(
+                [(1, 1), (9, 1), (9, 9), (1, 9), (1, 1)], np.float64
+            ),
+            (np.array(
+                [(4, 4), (6, 4), (6, 6), (4, 6), (4, 4)], np.float64
+            ),),
+        )
+        out = self._mc_inter(HOLED, other, np.random.default_rng(13))
+        from geomesa_tpu.sql.functions import st_area
+
+        # shells overlap on 7x7; merged hole region = union of the two
+        # 2x2 holes overlapping on 1x1 -> area 4+4-1=7
+        assert st_area(out) == pytest.approx(49 - 7)
+
+    def test_union_difference_still_refuse_holes(self):
+        with pytest.raises(NotImplementedError, match="hole"):
+            polygon_union(HOLED, SQUARE)
+        with pytest.raises(NotImplementedError, match="hole"):
+            polygon_difference(HOLED, SQUARE)
 
 
 def test_sql_surface():

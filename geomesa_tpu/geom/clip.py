@@ -315,13 +315,23 @@ def _as_polys(g):
     )
 
 
-def _wrap(rings: list):
-    polys = [Polygon(r) for r in rings if abs(_ring_area2(r)) > 0]
+def _wrap_parts(parts: list):
+    """[(closed ring, [closed holes...])] -> (Multi)Polygon; one policy
+    for the empty/single/multi wrapping across every op."""
+    polys = [
+        Polygon(r, tuple(hs)) if hs else Polygon(r)
+        for r, hs in parts
+        if abs(_ring_area2(r)) > 0
+    ]
     if not polys:
         return MultiPolygon(())
     if len(polys) == 1:
         return polys[0]
     return MultiPolygon(tuple(polys))
+
+
+def _wrap(rings: list):
+    return _wrap_parts([(r, []) for r in rings])
 
 
 def _ring_area2(r: np.ndarray) -> float:
@@ -343,17 +353,25 @@ def _merge_regions(regions: list) -> list:
             got = clip_rings(ex, cur, "union")
             if len(got) == 1:
                 cur = _norm_ring(got[0])  # overlapped: fold and continue
-            else:
-                out.append(ex)  # disjoint (union kept both): keep apart
+                continue
+            # 2+ rings: either genuinely disjoint inputs, or an
+            # interlocking union that ENCLOSED A VOID (two horseshoes) —
+            # the void ring nests inside the outer ring. The nested case
+            # must refuse: emitting both rings as "holes" would
+            # double-count the void under even-odd membership.
+            for g1 in got:
+                for g2 in got:
+                    if g1 is not g2 and _point_in_ring(
+                        _norm_ring(g1)[0], _norm_ring(g2)
+                    ):
+                        raise NotImplementedError(
+                            "merged hole regions enclose a void "
+                            "(interlocking union); this topology is "
+                            "not supported"
+                        )
+            out.append(ex)  # disjoint: keep apart
         out.append(cur)
         merged = out
-    for i, r1 in enumerate(merged):
-        for r2 in merged[i + 1:]:
-            if _point_in_ring(r1[0], r2) or _point_in_ring(r2[0], r1):
-                raise NotImplementedError(
-                    "hole regions enclose one another after merging; "
-                    "this topology is not supported"
-                )
     return merged
 
 
@@ -396,21 +414,21 @@ def polygon_intersection(a, b):
     holes of the output. Multipolygon components distribute (parts are
     disjoint by construction)."""
     parts = []
-    for sa, ha in _components(a):
-        for sb, hb in _components(b):
+    comps_b = _components(b)
+    merged_cache: dict = {}
+    for i, (sa, ha) in enumerate(_components(a)):
+        for j, (sb, hb) in enumerate(comps_b):
             got = clip_rings(sa, sb, "intersection")
             if not got:
                 continue
-            holes = _merge_regions(ha + hb) if (ha or hb) else []
+            if ha or hb:
+                if (i, j) not in merged_cache:
+                    merged_cache[(i, j)] = _merge_regions(ha + hb)
+                holes = merged_cache[(i, j)]
+            else:
+                holes = []
             parts += _subtract_regions(got, holes)
-    polys = [
-        Polygon(r, tuple(hs)) if hs else Polygon(r)
-        for r, hs in parts
-        if abs(_ring_area2(r)) > 0
-    ]
-    if not polys:
-        return MultiPolygon(())
-    return polys[0] if len(polys) == 1 else MultiPolygon(tuple(polys))
+    return _wrap_parts(parts)
 
 
 def polygon_union(a, b):

@@ -258,6 +258,27 @@ class TestHoledIntersection:
         # 2x2 holes overlapping on 1x1 -> area 4+4-1=7
         assert st_area(out) == pytest.approx(49 - 7)
 
+    def test_interlocking_holes_void_refused(self):
+        """Two C-shaped holes whose union encloses a void: emitting both
+        rings as holes would double-count the void under even-odd
+        membership, so the merge must REFUSE (review repro)."""
+        c1 = np.array(
+            [(2, 2), (5, 2), (5, 3), (3, 3), (3, 5), (5, 5), (5, 6),
+             (2, 6), (2, 2)], np.float64,
+        )
+        c2 = np.array(
+            [(6, 2), (6, 6), (3.5, 6.5), (3.5, 5.5), (5.5, 5.5),
+             (5.5, 2.5), (4, 2.5), (4, 1.5), (6, 1.5)], np.float64,
+        )
+        c2 = np.concatenate([c2, c2[:1]])
+        shell = np.array(
+            [(0, 0), (10, 0), (10, 10), (0, 10), (0, 0)], np.float64
+        )
+        a = Polygon(shell, (c1,))
+        b = Polygon(shell, (c2,))
+        with pytest.raises(NotImplementedError, match="void|topology"):
+            polygon_intersection(a, b)
+
     def test_union_difference_still_refuse_holes(self):
         with pytest.raises(NotImplementedError, match="hole"):
             polygon_union(HOLED, SQUARE)

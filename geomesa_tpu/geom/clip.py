@@ -127,7 +127,6 @@ def _seg_inter(p1, p2, q1, q2):
 
 
 def _collinear_overlap(p1, p2, q1, q2) -> bool:
-    lo1, hi1 = sorted((p1[0], p2[0])), None
     if p1[0] == p2[0]:  # vertical: compare on y
         a = sorted((p1[1], p2[1]))
         b = sorted((q1[1], q2[1]))

@@ -148,9 +148,12 @@ def test_contained():
     # inner minus outer = empty
     out = polygon_difference(INNER, SQUARE)
     assert isinstance(out, MultiPolygon) and len(out.polygons) == 0
-    # outer minus inner would need a hole: v1 refuses loudly
-    with pytest.raises(NotImplementedError, match="hole"):
-        polygon_difference(SQUARE, INNER)
+    # outer minus inner CREATES a hole (supported via the hole-aware
+    # decomposition; refused loudly in the first cut of this module)
+    donut = polygon_difference(SQUARE, INNER)
+    assert isinstance(donut, Polygon)
+    assert len(list(donut.rings())) == 2
+    assert st_area(donut) == pytest.approx(15.0)
 
 
 def test_degenerate_shared_edge_retries():
@@ -279,11 +282,85 @@ class TestHoledIntersection:
         with pytest.raises(NotImplementedError, match="void|topology"):
             polygon_intersection(a, b)
 
-    def test_union_difference_still_refuse_holes(self):
+    def test_union_still_refuses_holes(self):
         with pytest.raises(NotImplementedError, match="hole"):
             polygon_union(HOLED, SQUARE)
+
+
+class TestHoledDifference:
+    """Difference supports holes on BOTH sides via the disjoint
+    decomposition A\\B = (shellA - merge(holesA + shellsB)) ∪ (A ∩
+    holesB)."""
+
+    def _mc(self, a, b, rng, n=20000):
+        ea, eb = a.envelope, b.envelope
+        lo = np.minimum([ea.xmin, ea.ymin], [eb.xmin, eb.ymin]) - 0.5
+        hi = np.maximum([ea.xmax, ea.ymax], [eb.xmax, eb.ymax]) + 0.5
+        pts = rng.uniform(lo, hi, (n, 2))
+        span = float(max(hi[0] - lo[0], hi[1] - lo[1]))
+        for fn, want in (
+            (polygon_difference,
+             _inside(pts, a) & ~_inside(pts, b)),
+            (polygon_sym_difference,
+             _inside(pts, a) ^ _inside(pts, b)),
+        ):
+            out = fn(a, b)
+            keep = ~_near_edge(pts, [a, b, out], span * 2e-3)
+            got = _inside(pts, out)
+            bad = np.nonzero(got[keep] != want[keep])[0]
+            assert len(bad) == 0, (
+                f"{fn.__name__}: {len(bad)} points disagree "
+                f"(first {pts[keep][bad[:3]]})"
+            )
+
+    def test_holed_subject_minus_simple(self):
+        from geomesa_tpu.sql.functions import st_area
+
+        clip = _poly([(5, 5), (12, 5), (12, 12), (5, 12)])
+        self._mc(HOLED, clip, np.random.default_rng(20))
+        out = polygon_difference(HOLED, clip)
+        # 8x8 shell minus 2x2 hole minus the 3x3 overlap corner, but the
+        # hole's (3..5,3..5) corner (5,5) touches the clip corner: area =
+        # 64 - 4 - 9 + 0 (hole and clip overlap only at the point (5,5))
+        assert st_area(out) == pytest.approx(64 - 4 - 9)
+
+    def test_simple_minus_holed(self):
+        """Subtracting a holed polygon keeps the part inside its hole."""
+        from geomesa_tpu.sql.functions import st_area
+
+        big = _poly([(-1, -1), (9, -1), (9, 9), (-1, 9)])
+        self._mc(big, HOLED, np.random.default_rng(21))
+        out = polygon_difference(big, HOLED)
+        # 10x10 minus (64 - 4) = 100 - 60 = 40, incl. the 2x2 island
+        # that survives inside HOLED's hole
+        assert st_area(out) == pytest.approx(40.0)
+        # the island is a separate disjoint component
+        assert isinstance(out, MultiPolygon)
+
+    def test_holed_minus_holed(self):
+        other = Polygon(
+            np.array(
+                [(4, 4), (12, 4), (12, 12), (4, 12), (4, 4)], np.float64
+            ),
+            (np.array(
+                [(6, 6), (7, 6), (7, 7), (6, 7), (6, 6)], np.float64
+            ),),
+        )
+        self._mc(HOLED, other, np.random.default_rng(22))
+
+    def test_island_in_hole_refused(self):
+        donut = Polygon(
+            np.array(
+                [(0, 0), (10, 0), (10, 10), (0, 10), (0, 0)], np.float64
+            ),
+            (np.array(
+                [(2, 2), (8, 2), (8, 8), (2, 8), (2, 2)], np.float64
+            ),),
+        )
+        island = _poly([(4, 4), (6, 4), (6, 6), (4, 6)])
+        world = MultiPolygon((donut, island))
         with pytest.raises(NotImplementedError, match="hole"):
-            polygon_difference(HOLED, SQUARE)
+            polygon_difference(SQUARE, world)
 
 
 def test_sql_surface():

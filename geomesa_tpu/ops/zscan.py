@@ -676,14 +676,16 @@ def build_z3_dimscan_pallas(
     block_rows: int = 512,
     interpret: "bool | None" = None,
 ):
-    """Pallas tile kernel over the de-interleaved key planes:
-    (count_fn, mask_fn) over (nx, ny, bt) uint32 1-D device planes.
+    """BAKED-CONSTANT Pallas tile kernel over the de-interleaved key
+    planes: (count_fn, mask_fn) over (nx, ny, bt) uint32 1-D device
+    planes, query bounds compiled in as uint32 constants.
 
-    Same tiling discipline as ops/pallas_scan.py; block_rows=512 measured
-    fastest on v5e (431-456 GB/s, above the attribute filter kernel —
-    non-pow2 block rows collapse to ~185 GB/s, keep it a power of two).
-    Query bounds bake in as uint32 constants, per-query compile-and-cache
-    like every other scan engine here.
+    Kept as a cross-check engine (tests compare it against the
+    runtime-bounds kernel and the XLA mask). SERVING uses
+    :func:`build_z3_dimscan_rt` instead — same tiling and speed (runtime
+    bounds measured within noise of baked constants), but one compile
+    per range bucket serves every window where this builder pays a
+    compile per distinct query.
     """
     import jax
     import jax.numpy as jnp
@@ -786,13 +788,15 @@ def build_z3_pallas_scan(
     block_rows: "int | None" = None,
     interpret: "bool | None" = None,
 ):
-    """Pallas tile kernel for the key-only scan: (count_fn, mask_fn) over
-    (bins int32, z_hi uint32, z_lo uint32) 1-D device planes.
+    """BAKED-CONSTANT Pallas kernel for the INTERLEAVED masked-compare
+    key scan: (count_fn, mask_fn) over (bins int32, z_hi uint32, z_lo
+    uint32) 1-D device planes — a cross-check engine for the interleaved
+    layout (the resident cache serves z3/z2 from dim planes via
+    build_z3_dimscan_rt; the interleaved layout remains for xz kinds and
+    wide-bin-span schemas, served by the XLA kind_mask_fn path).
 
-    The query bounds are baked into the kernel as uint32 constants — the
-    same per-query compile-and-cache pattern the filter path uses
-    (DeviceIndex._compiled); padded bin entries (id < 0) are skipped at
-    trace time, costing nothing. Same tiling discipline as
+    Query bounds bake in as uint32 constants; padded bin entries
+    (id < 0) are skipped at trace time, costing nothing. Same tiling discipline as
     ops/pallas_scan.py: (block_rows, 128) tiles DMA'd HBM->VMEM, a
     (1, 128) revisited accumulator tile for the count (TPU grids run
     sequentially per core), tail mask so padding rows never count, and

@@ -4,12 +4,13 @@ Ref role: the reference gets ``st_intersection`` / ``st_difference`` and
 friends from JTS's overlay engine (geomesa-spark-jts [UNVERIFIED - empty
 reference mount]). This is a from-scratch Greiner-Hormann clipper:
 concave shapes are fine; MultiPolygons distribute over their disjoint
-components. INTERSECTION and DIFFERENCE (and therefore symDifference)
-support holes on either side, and a difference may CREATE holes in its
-output. UNION still refuses holed inputs, and genuinely pathological
-topologies refuse loudly rather than clip silently wrong: hole-region
-merges that enclose a void (interlocking horseshoes) and multipolygons
-with a component inside another component's hole.
+components. All four ops (intersection, union, difference,
+symDifference) support holes on either side; difference and union may
+CREATE holes/voids in their output (a union that encloses a void routes
+through the exact A + (B \\ A) decomposition). The remaining loud
+refusals are genuinely pathological: hole-region merges that enclose a
+void during subtraction, and multipolygons with a component inside
+another component's hole.
 
 Degeneracies (a vertex exactly on the other polygon's edge, collinear
 overlapping edges) are handled the standard practical way: the clip
@@ -431,27 +432,60 @@ def polygon_intersection(a, b):
     return _wrap_parts(parts)
 
 
+def _union_via_difference(a, b):
+    """A ∪ B as A + (B \\ A): pieces have pairwise disjoint INTERIORS by
+    construction (they may touch along A's boundary), so membership and
+    area are exact for any topology the hole-aware difference accepts —
+    including unions that enclose a void and holed inputs. The trade-off
+    is aesthetic: an overlapping pair yields two touching components
+    instead of one merged ring."""
+    parts = []
+    for g in (a, polygon_difference(b, a)):
+        if _is_empty(g):
+            continue
+        for shell, holes in _components(g):
+            parts.append((
+                np.concatenate([shell, shell[:1]]),
+                [np.concatenate([h, h[:1]]) for h in holes],
+            ))
+    return _wrap_parts(parts)
+
+
 def polygon_union(a, b):
-    """A ∪ B. Components are folded pairwise; parts that stay disjoint
-    accumulate into the output MultiPolygon."""
-    parts = [_ring_of(p) for p in _as_polys(a)]
-    for pb in _as_polys(b):
-        rb = _ring_of(pb)
+    """A ∪ B. Simple inputs fold pairwise through the Greiner-Hormann
+    union (one merged ring where shapes overlap); holed inputs — and
+    simple pairs whose union ENCLOSES A VOID (interlocking horseshoes,
+    where the fold would silently emit overlapping rings) — route
+    through the exact disjoint decomposition A + (B \\ A)."""
+    comps_a = _components(a)
+    comps_b = _components(b)
+    if any(h for _, h in comps_a) or any(h for _, h in comps_b):
+        return _union_via_difference(a, b)
+    parts = [s for s, _ in comps_a]
+    for rb, _ in comps_b:
         merged = False
         out = []
         for ra in parts:
             if not merged:
                 got = clip_rings(ra, rb, "union")
                 if len(got) == 1:
-                    rb = got[0][:-1]  # merged: keep folding the result
+                    rb = _norm_ring(got[0])  # merged: keep folding
                     merged = True
                     continue
+                # 2+ rings: disjoint inputs, OR an interlocking union
+                # that enclosed a void (one output ring nests inside
+                # another) — the fold cannot represent that; use the
+                # exact decomposition for the whole operation
+                for g1 in got:
+                    for g2 in got:
+                        if g1 is not g2 and _point_in_ring(
+                            _norm_ring(g1)[0], _norm_ring(g2)
+                        ):
+                            return _union_via_difference(a, b)
             out.append(ra)
         out.append(rb)
         parts = out
-    return _wrap([np.concatenate([r, r[:1]]) if not np.array_equal(
-        r[0], r[-1]
-    ) else r for r in parts])
+    return _wrap([np.concatenate([r, r[:1]]) for r in parts])
 
 
 def _check_no_island_in_hole(comps: list) -> None:

@@ -282,9 +282,50 @@ class TestHoledIntersection:
         with pytest.raises(NotImplementedError, match="void|topology"):
             polygon_intersection(a, b)
 
-    def test_union_still_refuses_holes(self):
-        with pytest.raises(NotImplementedError, match="hole"):
-            polygon_union(HOLED, SQUARE)
+    def test_union_with_holes(self):
+        """Holed union routes through the A + (B \\ A) decomposition:
+        membership and area exact; the covered part of the hole fills."""
+        from geomesa_tpu.sql.functions import st_area
+
+        rng = np.random.default_rng(30)
+        pts = rng.uniform(-1, 9, (20000, 2))
+        out = polygon_union(HOLED, SQUARE)
+        span = 10.0
+        keep = ~_near_edge(pts, [HOLED, SQUARE, out], span * 2e-3)
+        want = _inside(pts, HOLED) | _inside(pts, SQUARE)
+        got = _inside(pts, out)
+        assert np.array_equal(got[keep], want[keep])
+        # SQUARE (0..4)^2 covers the hole corner (3..4, 3..4): hole
+        # shrinks from 4 to 3 in area
+        assert st_area(out) == pytest.approx(64 - 3)
+
+
+def test_union_enclosing_void_is_exact():
+    """Two interlocking C-frames whose union encloses a central void:
+    the pairwise fold would silently emit overlapping rings (area
+    double-counted); the fallback decomposition is exact."""
+    from geomesa_tpu.sql.functions import st_area
+
+    A = Polygon(np.array(
+        [(0, 0), (6, 0), (6, 2.5), (2, 2.5), (2, 3.5), (6, 3.5),
+         (6, 6), (0, 6), (0, 0)], np.float64,
+    ))
+    B = Polygon(np.array(
+        [(8, -0.5), (8, 6.5), (2.5, 6.5), (2.5, 4.5), (5, 4.5),
+         (5, 1.5), (2.5, 1.5), (2.5, -0.5), (8, -0.5)], np.float64,
+    ))
+    out = polygon_union(A, B)
+    rng = np.random.default_rng(31)
+    pts = rng.uniform((-1, -1.5), (9, 7.5), (20000, 2))
+    keep = ~_near_edge(pts, [A, B, out], 10 * 2e-3)
+    want = _inside(pts, A) | _inside(pts, B)
+    got = _inside(pts, out)
+    assert np.array_equal(got[keep], want[keep])
+    # area must NOT double-count the overlap (the old fold returned
+    # st_area == area(A) + area(B) == 63 here)
+    mc_area = 10.0 * 9.0 * want.mean()
+    assert abs(st_area(out) - mc_area) < 1.5
+    assert st_area(out) < 60.0
 
 
 class TestHoledDifference:

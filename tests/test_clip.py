@@ -404,6 +404,69 @@ class TestHoledDifference:
             polygon_difference(SQUARE, world)
 
 
+def _star(rng, cx, cy, r_lo, r_hi, n_pts=None):
+    """Random star polygon (radial, guaranteed simple, usually concave).
+
+    Angles are JITTERED-EVEN, not uniform-random: a random angular gap
+    over pi would let a boundary chord cut past the center, so the disc
+    r < r_lo*cos(gap/2) would NOT be contained — and a "hole" generated
+    inside that disc could poke outside its shell (an invalid polygon,
+    which the first cut of this fuzz fed to the clipper). With k >= 8
+    and ±30% jitter the gap stays under ~0.98 rad, so the disc of
+    radius ~0.88*r_lo is always covered."""
+    k = n_pts or int(rng.integers(8, 14))
+    base = np.arange(k) * (2 * np.pi / k)
+    th = base + rng.uniform(-0.3, 0.3, k) * (2 * np.pi / k)
+    rr = rng.uniform(r_lo, r_hi, k)
+    c = np.stack([cx + rr * np.cos(th), cy + rr * np.sin(th)], axis=1)
+    return np.concatenate([c, c[:1]])
+
+
+def test_fuzz_all_ops_holed_concave():
+    """Random concave star polygons (sometimes holed) through all four
+    boolean ops vs the Monte-Carlo membership oracle. Loud refusals
+    (pathological topology) are tolerated but must stay rare."""
+    rng = np.random.default_rng(77)
+    ops = {
+        "inter": (polygon_intersection, lambda A, B: A & B),
+        "union": (polygon_union, lambda A, B: A | B),
+        "diff": (polygon_difference, lambda A, B: A & ~B),
+        "sym": (polygon_sym_difference, lambda A, B: A ^ B),
+    }
+    refused = 0
+    checked = 0
+    for trial in range(12):
+        shell_a = _star(rng, 0, 0, 3.0, 6.0)
+        holes_a = ()
+        if trial % 2:
+            holes_a = (_star(rng, 0, 0, 0.5, 1.4, n_pts=6),)
+        a = Polygon(shell_a, holes_a)
+        off = rng.uniform(-3, 3, 2)
+        shell_b = _star(rng, off[0], off[1], 2.5, 5.5)
+        holes_b = ()
+        if trial % 3 == 0:
+            holes_b = (_star(rng, off[0], off[1], 0.4, 1.2, n_pts=6),)
+        b = Polygon(shell_b, holes_b)
+        pts = rng.uniform(-7, 7, (12000, 2)) + np.array([off[0] / 2, off[1] / 2])
+        in_a, in_b = _inside(pts, a), _inside(pts, b)
+        for name, (fn, pred) in ops.items():
+            try:
+                out = fn(a, b)
+            except NotImplementedError:
+                refused += 1
+                continue
+            keep = ~_near_edge(pts, [a, b, out], 14 * 2.5e-3)
+            want = pred(in_a, in_b)
+            got = _inside(pts, out)
+            bad = np.nonzero(got[keep] != want[keep])[0]
+            assert len(bad) == 0, (
+                f"trial {trial} {name}: {len(bad)}/{int(keep.sum())} "
+                f"points disagree (first {pts[keep][bad[:3]]})"
+            )
+            checked += 1
+    assert checked >= 36, (checked, refused)  # refusals must stay rare
+
+
 def test_sql_surface():
     from geomesa_tpu.sql import functions as F
 

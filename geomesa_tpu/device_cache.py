@@ -1165,6 +1165,127 @@ class DeviceIndex:
         idx, d2 = idx[ok][:k], d2[ok][:k]
         return self._host_rows().take(idx), np.sqrt(d2.astype(np.float64))
 
+    def window_pairs_query(self, envs, auths=None, base=None):
+        """Candidate (row, window) PAIRS for m runtime envelope windows —
+        the device coarse pass of a spatial JOIN (each right-side feature
+        contributes one envelope; the exact predicate refines per pair on
+        host). Where :meth:`window_union_query` collapses the window axis
+        with ``any``, this keeps it: windows are processed in groups of
+        64 with the per-row hit vector BIT-PACKED into two uint32 planes,
+        so each group's dispatch fetches 8B/row regardless of m.
+
+        ``envs``: (m, 4) [xmin, ymin, xmax, ymax]; ``base``: optional
+        extra filter fused on device (same contract as
+        window_union_query). Returns (rows, wins) int64 arrays (aligned;
+        candidate semantics — envelopes widen one ulp) or None when the
+        needed planes / base are not resident."""
+        import jax
+        import jax.numpy as jnp
+
+        geom = self.sft.geom_field
+        gx, gy = f"{geom}__x", f"{geom}__y"
+        if geom is None or gx not in self._cols:
+            return None
+        compiled = None
+        base_f = self._parse(base) if base is not None else None
+        if base_f is ast.Include:
+            base_f = None
+        if base_f is not None:
+            compiled, cfn, _ = self._compiled_for(base_f)
+            if (
+                not compiled.device_cols
+                or not compiled.fully_on_device
+                or cfn is None
+            ):
+                return None
+        envs = np.asarray(envs, np.float64).reshape(-1, 4)
+        m = envs.shape[0]
+        dt = np.dtype(self._cols[gx].dtype)
+        has_vis = VIS_ID in self._cols
+        jit_key = ("pairs", has_vis, repr(base_f) if compiled else None)
+        if not hasattr(self, "_union_jits"):
+            self._union_jits = {}
+        fn = self._union_jits.get(jit_key)
+        if fn is None:
+
+            def packed(cols, env, valid, auth_tab):
+                x = cols[gx][:, None]
+                y = cols[gy][:, None]
+                hit = (
+                    (x >= env[None, :, 0])
+                    & (x <= env[None, :, 2])
+                    & (y >= env[None, :, 1])
+                    & (y <= env[None, :, 3])
+                )  # (n, 64)
+                row_ok = None
+                if compiled is not None:
+                    row_ok = compiled.device_fn(cols)
+                if valid is not None:
+                    row_ok = valid if row_ok is None else (row_ok & valid)
+                if auth_tab is not None:
+                    av = auth_tab[cols[VIS_ID]]
+                    row_ok = av if row_ok is None else (row_ok & av)
+                if row_ok is not None:
+                    hit = hit & row_ok[:, None]
+                w = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+                lo = (hit[:, :32].astype(jnp.uint32) * w[None, :]).sum(
+                    axis=1, dtype=jnp.uint32
+                )
+                hi = (hit[:, 32:].astype(jnp.uint32) * w[None, :]).sum(
+                    axis=1, dtype=jnp.uint32
+                )
+                return lo, hi
+
+            fn = jax.jit(packed)
+            self._union_jits[jit_key] = fn
+        sub = {gx: self._cols[gx], gy: self._cols[gy]}
+        if compiled is not None:
+            for c in compiled.device_cols:
+                sub[c] = self._cols[c]
+        if has_vis:
+            sub[VIS_ID] = self._cols[VIS_ID]
+        n_staged = self._staged_len()
+        rows_out: list = []
+        wins_out: list = []
+        for g0 in range(0, max(m, 1), 64):
+            chunk = envs[g0 : g0 + 64]
+            env_pad = np.empty((64, 4), dt)
+            k = len(chunk)
+            env_pad[:k, 0] = np.nextafter(
+                chunk[:, 0].astype(dt), dt.type(-np.inf)
+            )
+            env_pad[:k, 1] = np.nextafter(
+                chunk[:, 1].astype(dt), dt.type(-np.inf)
+            )
+            env_pad[:k, 2] = np.nextafter(
+                chunk[:, 2].astype(dt), dt.type(np.inf)
+            )
+            env_pad[:k, 3] = np.nextafter(
+                chunk[:, 3].astype(dt), dt.type(np.inf)
+            )
+            env_pad[k:] = [1.0, 1.0, 0.0, 0.0]  # inverted: no matches
+            lo, hi = fn(
+                sub, jnp.asarray(env_pad), self._device_valid(),
+                self._auth_table(auths) if has_vis else None,
+            )
+            lo = np.asarray(lo)[:n_staged]
+            hi = np.asarray(hi)[:n_staged]
+            for half, words in ((0, lo), (32, hi)):
+                if not words.any():
+                    continue
+                bits = (
+                    (words[:, None] >> np.arange(32, dtype=np.uint32))
+                    & 1
+                ).astype(bool)  # (n, 32)
+                r, w = np.nonzero(bits)
+                keep = w + half < k
+                rows_out.append(r[keep].astype(np.int64))
+                wins_out.append((w[keep] + half + g0).astype(np.int64))
+        if not rows_out:
+            e = np.array([], np.int64)
+            return e, e.copy()
+        return np.concatenate(rows_out), np.concatenate(wins_out)
+
     def bbox_window_query(self, xmin, ymin, xmax, ymax, auths=None):
         """Bbox query with RUNTIME bounds: one compiled kernel serves
         every window, where query()'s per-filter compile-and-cache would
@@ -1866,6 +1987,10 @@ class StreamingDeviceIndex(DeviceIndex):
                 px, py, k, query=query, auths=auths,
                 max_radius_deg=max_radius_deg,
             )
+
+    def window_pairs_query(self, envs, auths=None, base=None):
+        with self._lock:
+            return super().window_pairs_query(envs, auths=auths, base=base)
 
     def __len__(self) -> int:
         return self._n - self._n_dead

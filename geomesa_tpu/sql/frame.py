@@ -184,22 +184,52 @@ class SpatialFrame:
         other: "SpatialFrame",
         on: str = "intersects",
         distance: "float | None" = None,
+        device_index=None,
     ):
         """Join this frame's features against ``other``'s on a spatial
         predicate (``intersects`` | ``contains`` | ``within`` |
         ``dwithin`` with ``distance``). Returns (left_batch, right_batch,
         pairs) where pairs is an (m, 2) index array into the two batches.
 
-        The right side's collected envelope is pushed down into the left
-        side's scan as a BBOX pre-filter (the reference's relation
-        pushdown), then pairs are refined with exact vectorized
-        predicates.
+        Default path: the right side's collected envelope is pushed down
+        into the left side's scan as a BBOX pre-filter (the reference's
+        relation pushdown), then each right row's exact predicate runs
+        vectorized over the left column — O(|R|) full-column passes.
+
+        With a resident ``device_index`` over this frame's type, the
+        coarse pass is instead a DEVICE join: every right row's padded
+        envelope rides a runtime window array and candidate (row, window)
+        pairs come back bit-packed (DeviceIndex.window_pairs_query, one
+        dispatch per 64 right rows, 8B/row fetched), with this frame's
+        filter fused on device; the exact predicate then refines each
+        window's few candidates — O(candidates) instead of O(|R| x |L|).
+        Falls back to the default path when the planes or the frame's
+        filter are not device-resident. NOTE: on the device path ``left``
+        is the resident mirror (all staged rows), so join indices address
+        it directly.
         """
         from geomesa_tpu.sql import functions as F
 
         right = other.collect()
         geom_r = right.sft.geom_field
         rcol = right.columns[geom_r]
+        preds = {
+            "intersects": F.st_intersects,
+            "contains": F.st_contains,
+            "within": F.st_within,
+        }
+        if on == "dwithin" and distance is None:
+            raise ValueError("dwithin join needs distance=")
+        if on not in preds and on != "dwithin":
+            raise ValueError(f"unknown join predicate {on!r}")
+
+        if device_index is not None and len(right):
+            got = self._device_join(
+                device_index, right, rcol, on, distance, preds
+            )
+            if got is not None:
+                return got
+
         # bbox pushdown from the right side's extent
         env = _extent(rcol)
         left_frame = self
@@ -216,25 +246,61 @@ class SpatialFrame:
             )
         left = left_frame.collect()
         lcol = left.columns[left.sft.geom_field]
-        preds = {
-            "intersects": F.st_intersects,
-            "contains": F.st_contains,
-            "within": F.st_within,
-        }
         pairs = []
         for j in range(len(right)):
             g = _row_geom_of(rcol, j)
             if on == "dwithin":
-                if distance is None:
-                    raise ValueError("dwithin join needs distance=")
                 m = F.st_dwithin(lcol, g, distance)
-            elif on in preds:
-                m = preds[on](lcol, g)
             else:
-                raise ValueError(f"unknown join predicate {on!r}")
+                m = preds[on](lcol, g)
             for i in np.nonzero(np.asarray(m))[0]:
                 pairs.append((int(i), j))
         return left, right, np.array(pairs, dtype=np.int64).reshape(-1, 2)
+
+    def _device_join(self, di, right, rcol, on, distance, preds):
+        """Device coarse pass + per-window exact refinement, or None when
+        the resident planes / this frame's filter cannot serve it."""
+        from geomesa_tpu.sql import functions as F
+
+        pad = distance or 0.0
+        envs = np.empty((len(right), 4), np.float64)
+        for j in range(len(right)):
+            e = _row_geom_of(rcol, j).envelope
+            envs[j] = (e.xmin - pad, e.ymin - pad, e.xmax + pad, e.ymax + pad)
+        base = self._filter if self._filter is not ast.Include else None
+        got = di.window_pairs_query(envs, base=base)
+        if got is None:
+            return None
+        rows, wins = got
+        left = di._host_rows()
+        lcol = left.columns[left.sft.geom_field]
+        out_l: list = []
+        out_r: list = []
+        order = np.argsort(wins, kind="stable")
+        rows, wins = rows[order], wins[order]
+        starts = np.searchsorted(wins, np.arange(len(right)))
+        ends = np.searchsorted(wins, np.arange(len(right)), side="right")
+        for j in range(len(right)):
+            cand = rows[starts[j] : ends[j]]
+            if len(cand) == 0:
+                continue
+            g = _row_geom_of(rcol, j)
+            sub = lcol[cand] if lcol.dtype == object else lcol[cand, :]
+            if on == "dwithin":
+                m = F.st_dwithin(sub, g, distance)
+            else:
+                m = preds[on](sub, g)
+            hit = cand[np.nonzero(np.asarray(m))[0]]
+            out_l.append(hit)
+            out_r.append(np.full(len(hit), j, np.int64))
+        pairs = (
+            np.stack(
+                [np.concatenate(out_l), np.concatenate(out_r)], axis=1
+            )
+            if out_l
+            else np.empty((0, 2), np.int64)
+        )
+        return left, right, pairs
 
 
 def _geom_field_of(frame: SpatialFrame) -> str:

@@ -331,3 +331,61 @@ def test_cli_ingest_workers(tmp_path, capsys):
     assert "ingested 6 features" in capsys.readouterr().out
     main(["--root", root, "count", "-f", "t"])
     assert int(capsys.readouterr().out) == 6
+
+
+def test_device_spatial_join_matches_host(tmp_path):
+    """The device coarse pass (window_pairs_query, bit-packed candidate
+    pairs) must produce the SAME pair set as the host join — incl. with
+    a frame filter fused on device, dwithin, and >64 right rows (the
+    64-window chunking boundary)."""
+    from geomesa_tpu.device_cache import DeviceIndex
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    ds = _fill_store(tmp_path, n=3000)
+    zones = FileSystemDataStore(str(tmp_path / "zones"))
+    zones.create_schema("z", "zone:String,*geom:Polygon")
+    rng = np.random.default_rng(5)
+    m = 70  # crosses the 64-window group boundary
+    polys, names = [], []
+    for k in range(m):
+        x0 = rng.uniform(-9, 7)
+        y0 = rng.uniform(-9, 7)
+        polys.append(sql.st_makeBBOX(x0, y0, x0 + 2, y0 + 2))
+        names.append(f"z{k}")
+    zones.write(
+        "z", {"zone": names, "geom": np.array(polys, dtype=object)},
+        fids=np.arange(m),
+    )
+    zones.flush("z")
+    zf = SpatialFrame(zones, "z")
+    di = DeviceIndex(ds, "t")
+
+    def pair_fids(left, right, pairs):
+        return sorted(
+            (str(left.fids[i]), str(right.fids[j])) for i, j in pairs
+        )
+
+    for kwargs in (
+        {"on": "within"},
+        {"on": "intersects"},
+        {"on": "dwithin", "distance": 0.7},
+    ):
+        pts = SpatialFrame(ds, "t")
+        host = pts.spatial_join(zf, **kwargs)
+        dev = pts.spatial_join(zf, device_index=di, **kwargs)
+        assert pair_fids(*host) == pair_fids(*dev), kwargs
+
+    # frame filter fuses into the device coarse pass
+    flt = SpatialFrame(ds, "t").where("val < 50")
+    host = flt.spatial_join(zf, on="within")
+    dev = flt.spatial_join(zf, on="within", device_index=di)
+    assert pair_fids(*host) == pair_fids(*dev)
+    assert len(host[2]) > 0
+    # every joined left row satisfies the filter
+    assert np.all(dev[0].columns["val"][dev[2][:, 0]] < 50)
+
+    # a host-residual filter falls back (still correct)
+    flt2 = SpatialFrame(ds, "t").where("name LIKE 'a%'")
+    host2 = flt2.spatial_join(zf, on="within")
+    dev2 = flt2.spatial_join(zf, on="within", device_index=di)
+    assert pair_fids(*host2) == pair_fids(*dev2)

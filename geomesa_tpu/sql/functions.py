@@ -1335,6 +1335,85 @@ def st_scale(geom, xf: float, yf: float):
     return _scalar_or_col(geom, one)
 
 
+# -- CRS transforms and bearings ---------------------------------------------
+
+_WEB_MERCATOR_R = 6_378_137.0
+_MERC_MAX_LAT = 85.051128779806604  # atan(sinh(pi)) in degrees
+
+
+def _merc_fwd(xy: np.ndarray) -> np.ndarray:
+    lon = np.radians(xy[:, 0])
+    lat = np.radians(np.clip(xy[:, 1], -_MERC_MAX_LAT, _MERC_MAX_LAT))
+    return np.stack(
+        [
+            _WEB_MERCATOR_R * lon,
+            _WEB_MERCATOR_R * np.log(np.tan(np.pi / 4 + lat / 2)),
+        ],
+        axis=1,
+    )
+
+
+def _merc_inv(xy: np.ndarray) -> np.ndarray:
+    lon = np.degrees(xy[:, 0] / _WEB_MERCATOR_R)
+    lat = np.degrees(
+        2 * np.arctan(np.exp(xy[:, 1] / _WEB_MERCATOR_R)) - np.pi / 2
+    )
+    return np.stack([lon, lat], axis=1)
+
+
+def st_transform(geom, from_crs: str, to_crs: str):
+    """Reproject between EPSG:4326 (lon/lat degrees) and EPSG:3857
+    (spherical web mercator meters) — the pair every tiled map client
+    uses. Other CRS pairs raise (this framework indexes in 4326; full
+    PROJ-style pipelines are out of scope). Latitudes clamp to the
+    mercator domain (±85.05113°), matching the tiling convention."""
+
+    def norm(c):
+        c = str(c).upper().replace("EPSG:", "")
+        if c in ("4326", "CRS84"):
+            return "4326"
+        if c in ("3857", "900913", "102100"):
+            return "3857"
+        raise ValueError(f"unsupported CRS {c!r} (4326 <-> 3857 only)")
+
+    f, t = norm(from_crs), norm(to_crs)
+    if f == t:
+        return geom
+    fn = _merc_fwd if (f, t) == ("4326", "3857") else _merc_inv
+    if _is_point_col(geom):
+        return fn(np.asarray(geom, np.float64))
+
+    def one(g):
+        return _map_coords(g, lambda xy: fn(np.atleast_2d(xy)))
+
+    return _scalar_or_col(geom, one)
+
+
+def st_azimuth(a, b):
+    """Bearing from point a to point b in radians clockwise from north,
+    in [0, 2π) — planar on lon/lat (the reference's JTS Angle-based
+    azimuth), NaN for coincident points."""
+
+    def coords(g):
+        if isinstance(g, Point):
+            return np.array([[g.x, g.y]])
+        if _is_point_col(g):
+            return np.asarray(g, np.float64)
+        return np.stack([[p.x, p.y] for p in g])
+
+    ca, cb = coords(a), coords(b)
+    n = max(len(ca), len(cb))
+    ca = np.broadcast_to(ca, (n, 2))
+    cb = np.broadcast_to(cb, (n, 2))
+    dx = cb[:, 0] - ca[:, 0]
+    dy = cb[:, 1] - ca[:, 1]
+    az = np.mod(np.arctan2(dx, dy), 2 * np.pi)
+    az = np.where((dx == 0) & (dy == 0), np.nan, az)
+    if isinstance(a, Point) and isinstance(b, Point):
+        return float(az[0])
+    return az
+
+
 # -- polygon boolean ops (geom/clip.py Greiner-Hormann engine) ---------------
 
 

@@ -68,3 +68,61 @@ def test_combined_and_cap(tmp_path):
 def test_requires_some_layer():
     with pytest.raises(ValueError):
         leaflet_map()
+
+
+# -- st_transform / st_azimuth (live here with the other map-facing bits) ----
+
+
+def test_transform_known_values_and_roundtrip():
+    from geomesa_tpu.geom.base import Point
+    from geomesa_tpu.sql.functions import st_transform
+
+    # known anchor: (lon 0, lat 0) -> (0, 0); lon 180 -> pi*R
+    p = st_transform(Point(180.0, 0.0), "EPSG:4326", "EPSG:3857")
+    assert p.x == pytest.approx(20037508.342789244)
+    assert p.y == pytest.approx(0.0, abs=1e-6)
+    # paris, independently computed web-mercator coordinates
+    paris = st_transform(Point(2.3522, 48.8566), "4326", "3857")
+    assert paris.x == pytest.approx(261848.15, rel=1e-4)
+    assert paris.y == pytest.approx(6250566.72, rel=1e-4)
+    # roundtrip on a column
+    rng = np.random.default_rng(2)
+    col = np.stack(
+        [rng.uniform(-179, 179, 500), rng.uniform(-84, 84, 500)], axis=1
+    )
+    back = st_transform(
+        st_transform(col, "4326", "3857"), "EPSG:3857", "EPSG:4326"
+    )
+    np.testing.assert_allclose(back, col, atol=1e-9)
+    # same-CRS short circuit and unsupported pair
+    assert st_transform(col, "4326", "CRS84") is col
+    with pytest.raises(ValueError, match="unsupported CRS"):
+        st_transform(col, "4326", "32633")
+    # latitude clamps to the mercator domain
+    pole = st_transform(Point(0.0, 90.0), "4326", "3857")
+    assert pole.y == pytest.approx(20037508.34, rel=1e-4)
+
+
+def test_transform_polygon_geometry():
+    from geomesa_tpu.sql.functions import st_area, st_makeBBOX, st_transform
+
+    box = st_makeBBOX(0, 0, 1, 1)
+    merc = st_transform(box, "4326", "3857")
+    # a 1-degree box at the equator is ~111.3km on a side in mercator
+    assert st_area(merc) == pytest.approx((111319.49) ** 2, rel=1e-3)
+
+
+def test_azimuth():
+    from geomesa_tpu.geom.base import Point
+    from geomesa_tpu.sql.functions import st_azimuth
+
+    assert st_azimuth(Point(0, 0), Point(0, 1)) == pytest.approx(0.0)
+    assert st_azimuth(Point(0, 0), Point(1, 0)) == pytest.approx(np.pi / 2)
+    assert st_azimuth(Point(0, 0), Point(0, -1)) == pytest.approx(np.pi)
+    assert st_azimuth(Point(0, 0), Point(-1, 0)) == pytest.approx(
+        3 * np.pi / 2
+    )
+    assert np.isnan(st_azimuth(Point(2, 2), Point(2, 2)))
+    col = np.array([[0.0, 0.0], [1.0, 1.0]])
+    az = st_azimuth(col, Point(1.0, 1.0))
+    assert az[0] == pytest.approx(np.pi / 4) and np.isnan(az[1])

@@ -67,6 +67,25 @@ class _BtRebase(Exception):
     sentinel instead would silently violate the loose-superset contract."""
 
 
+def _thin_transfer(c: np.ndarray):
+    """float64 coord array -> the cheapest LOSSLESS device transfer.
+
+    encode_inputs upcasts coords to float64 for the exact host oracle,
+    but most geometry columns store float32 — in that case every value
+    round-trips f64->f32->f64 exactly, and shipping the f32 halves the
+    staging transfer (the encode upcasts back to f64 on device under the
+    scoped-x64 jit, bit-identically). The O(n) host check costs far less
+    than the bytes it saves; any value that would not round-trip keeps
+    the f64 transfer."""
+    c = np.asarray(c)
+    if c.dtype != np.float64:
+        return c
+    f32 = c.astype(np.float32)
+    if np.array_equal(f32.astype(np.float64), c):
+        return f32
+    return c
+
+
 from geomesa_tpu.curves.zorder import u64_hi_lo as _split_u64
 
 
@@ -363,13 +382,18 @@ class DeviceIndex:
                     if self._dim_encode_jit is None:
 
                         def _enc2(x, y):
+                            # f32-transferred coords upcast HERE (see
+                            # _thin_transfer): bit-identical quantize
+                            x = x.astype(jnp.float64)
+                            y = y.astype(jnp.float64)
                             nx = sfc.lon.normalize_jax(x).astype(jnp.uint32)
                             ny = sfc.lat.normalize_jax(y).astype(jnp.uint32)
                             return nx, ny
 
                         self._dim_encode_jit = jax.jit(_enc2)
                     nx, ny = self._dim_encode_jit(
-                        jnp.asarray(x), jnp.asarray(y)
+                        jnp.asarray(_thin_transfer(x)),
+                        jnp.asarray(_thin_transfer(y)),
                     )
                     ny.block_until_ready()
                 return {Z_NX: nx, Z_NY: ny}
@@ -418,6 +442,11 @@ class DeviceIndex:
                     if self._dim_encode_jit is None:
 
                         def _enc(x, y, off, bins_u32, base):
+                            # f32-transferred coords upcast HERE (see
+                            # _thin_transfer): bit-identical quantize
+                            x = x.astype(jnp.float64)
+                            y = y.astype(jnp.float64)
+                            off = off.astype(jnp.float64)
                             nx = sfc.lon.normalize_jax(x).astype(jnp.uint32)
                             ny = sfc.lat.normalize_jax(y).astype(jnp.uint32)
                             nt = sfc.time.normalize_jax(off).astype(
@@ -429,9 +458,9 @@ class DeviceIndex:
 
                         self._dim_encode_jit = jax.jit(_enc)
                     nx, ny, bt = self._dim_encode_jit(
-                        jnp.asarray(x),
-                        jnp.asarray(y),
-                        jnp.asarray(off),
+                        jnp.asarray(_thin_transfer(x)),
+                        jnp.asarray(_thin_transfer(y)),
+                        jnp.asarray(_thin_transfer(off)),
                         jnp.asarray(np.asarray(bins).astype(np.uint32)),
                         jnp.uint32(self._bt_base),
                     )
@@ -498,8 +527,18 @@ class DeviceIndex:
                 # everywhere else)
                 with jax.enable_x64():
                     if self._z_encode_jit is None:
-                        self._z_encode_jit = jax.jit(sfc.index_jax_hi_lo)
-                    hi, lo = self._z_encode_jit(*map(jnp.asarray, coords))
+
+                        def _enc_hl(*cs):
+                            # f32-transferred coords upcast HERE (see
+                            # _thin_transfer): bit-identical quantize
+                            return sfc.index_jax_hi_lo(
+                                *[c.astype(jnp.float64) for c in cs]
+                            )
+
+                        self._z_encode_jit = jax.jit(_enc_hl)
+                    hi, lo = self._z_encode_jit(
+                        *[jnp.asarray(_thin_transfer(c)) for c in coords]
+                    )
                     hi.block_until_ready()
             except Exception as e:  # pragma: no cover - platform (no f64)
                 import warnings

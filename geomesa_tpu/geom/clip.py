@@ -14,11 +14,13 @@ another component's hole.
 
 Degeneracies (a vertex exactly on the other polygon's edge, collinear
 overlapping edges) are handled the standard practical way: the clip
-polygon is retried with a deterministic sub-nanometer perturbation
-(~1e-9 of the bbox scale) until the configuration is generic. The
-perturbation is far below any geographic coordinate's meaningful
-precision; the test suite validates results against a Monte-Carlo
-point-membership oracle built on points_in_polygon.
+polygon is retried with a deterministic perturbation that starts at
+1e-8 of the bbox scale and escalates to 1e-7 on the second retry,
+CAPPED there (further retries re-roll at the cap with a new seed).
+For geographic data 1e-7 of a bbox span is at most ~cm-scale —
+still below meaningful coordinate precision; the test suite validates
+results against a Monte-Carlo point-membership oracle built on
+points_in_polygon.
 """
 
 from __future__ import annotations
@@ -287,8 +289,10 @@ def _perturb(ring: np.ndarray, k: int, scale: float) -> np.ndarray:
 
 def clip_rings(ra: np.ndarray, rb: np.ndarray, op: str) -> list:
     """Boolean op over two simple open rings -> list of closed rings.
-    Retries with a deterministic sub-nanometer perturbation of the clip
-    ring on degenerate (vertex-on-edge / collinear-overlap) inputs."""
+    Retries with a deterministic perturbation of the clip ring on
+    degenerate (vertex-on-edge / collinear-overlap) inputs, escalating
+    1e-8 -> 1e-7 of the bbox span (capped; later retries re-roll at the
+    cap with a fresh seed)."""
     span = max(
         float(np.ptp(ra[:, 0])), float(np.ptp(ra[:, 1])),
         float(np.ptp(rb[:, 0])), float(np.ptp(rb[:, 1])), 1e-9,
@@ -296,7 +300,7 @@ def clip_rings(ra: np.ndarray, rb: np.ndarray, op: str) -> list:
     for k in range(6):
         try:
             return _clip_once(ra, rb if k == 0 else _perturb(
-                rb, k, span * 1e-9 * (10 ** k)
+                rb, k, span * 1e-9 * (10 ** min(k, 2))
             ), op)
         except _Degenerate:
             continue

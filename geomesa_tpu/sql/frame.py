@@ -204,9 +204,12 @@ class SpatialFrame:
         filter fused on device; the exact predicate then refines each
         window's few candidates — O(candidates) instead of O(|R| x |L|).
         Falls back to the default path when the planes or the frame's
-        filter are not device-resident. NOTE: on the device path ``left``
-        is the resident mirror (all staged rows), so join indices address
-        it directly.
+        filter are not device-resident. On the device path ``left`` is
+        compacted to exactly the rows referenced by ``pairs`` (indices
+        remapped accordingly); on the default path it is the
+        bbox-pushed, filter-applied scan result, which may include rows
+        no pair references. Address left rows through ``pairs`` for
+        path-independent results.
         """
         from geomesa_tpu.sql import functions as F
 
@@ -300,6 +303,16 @@ class SpatialFrame:
             if out_l
             else np.empty((0, 2), np.int64)
         )
+        # Compact the returned left batch to the rows the pairs actually
+        # reference (remapping pair indices) so callers that consume
+        # ``left`` directly never see the full resident mirror — the
+        # default path's left is also a filtered subset, not all rows.
+        if len(pairs):
+            uniq, inv = np.unique(pairs[:, 0], return_inverse=True)
+            left = left.take(uniq)
+            pairs = np.stack([inv.astype(np.int64), pairs[:, 1]], axis=1)
+        else:
+            left = left.take(np.empty(0, np.int64))
         return left, right, pairs
 
 

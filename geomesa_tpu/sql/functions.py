@@ -1228,7 +1228,9 @@ def _vincenty_m(lon1, lat1, lon2, lat2) -> np.ndarray:
     sinU1, cosU1 = np.sin(U1), np.cos(U1)
     sinU2, cosU2 = np.sin(U2), np.cos(U2)
     sin_sig = cos_sig = sig = cos_sq_al = cos2sm = np.zeros_like(L)
+    lam_prev = lam
     for _ in range(24):
+        lam_prev = lam
         sin_lam, cos_lam = np.sin(lam), np.cos(lam)
         sin_sig = np.sqrt(
             (cosU2 * sin_lam) ** 2
@@ -1269,6 +1271,21 @@ def _vincenty_m(lon1, lat1, lon2, lat2) -> np.ndarray:
         )
     )
     out = _WGS84_B * A * (sig - d_sig)
+    # Vincenty's lambda iteration fails to converge for near-antipodal
+    # pairs (it oscillates); substitute the haversine value on the WGS84
+    # mean-radius sphere there, as the docstring promises. 1e-12 rad of
+    # lambda movement ~ 6 um on the equator.
+    converged = np.abs(lam - lam_prev) < 1e-12
+    if not np.all(converged):
+        r_mean = (2 * _WGS84_A + _WGS84_B) / 3
+        p1, p2 = np.radians(lat1), np.radians(lat2)
+        dp, dl = p2 - p1, np.radians(lon2 - lon1)
+        h = (
+            np.sin(dp / 2) ** 2
+            + np.cos(p1) * np.cos(p2) * np.sin(dl / 2) ** 2
+        )
+        hav = 2 * r_mean * np.arcsin(np.minimum(1.0, np.sqrt(h)))
+        out = np.where(converged, out, hav)
     # coincident points: exactly zero (the iteration above is stable there)
     return np.where((lon1 == lon2) & (lat1 == lat2), 0.0, out)
 

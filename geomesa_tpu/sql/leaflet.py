@@ -17,9 +17,27 @@ on disk, or an HTTP response — no server round trips after load.
 
 from __future__ import annotations
 
+import html as _html
 import json
 
 import numpy as np
+
+
+def _embed_json(obj) -> str:
+    """``json.dumps`` hardened for embedding inside a ``<script>`` block.
+
+    A property value containing ``</script>`` would otherwise terminate
+    the script element early (stored XSS via ingested attributes); the
+    HTML parser tokenizes ``</`` inside scripts, so escaping just that
+    sequence (and ``<!--`` per the WHATWG script-data rules) is
+    sufficient and keeps the payload valid JSON/JS (``\\/`` and
+    ``\\u003c`` are both legal JSON escapes).
+    """
+    return (
+        json.dumps(obj)
+        .replace("</", "<\\/")
+        .replace("<!--", "\\u003c!--")
+    )
 
 _PAGE = """<!DOCTYPE html>
 <html><head><meta charset="utf-8"/>
@@ -72,8 +90,15 @@ L.geoJSON(fc, {{
   }},
   onEachFeature: function (f, layer) {{
     if (f.properties) {{
+      // Popup content is interpreted as HTML by Leaflet: escape the
+      // untrusted property keys/values so ingested data can't inject
+      // markup into the map page.
+      var esc = function (s) {{
+        return String(s).replace(/&/g, '&amp;').replace(/</g, '&lt;')
+          .replace(/>/g, '&gt;').replace(/"/g, '&quot;');
+      }};
       var rows = Object.entries(f.properties).map(
-        function (kv) {{ return kv[0] + ': ' + kv[1]; }});
+        function (kv) {{ return esc(kv[0]) + ': ' + esc(kv[1]); }});
       layer.bindPopup(rows.join('<br/>'));
     }}
   }}
@@ -118,7 +143,7 @@ def leaflet_map(
             if len(batch) > max_features:
                 batch = batch.take(np.arange(max_features))
             fc = feature_collection(batch)
-        features_js = _FEATURES_JS.format(geojson=json.dumps(fc))
+        features_js = _FEATURES_JS.format(geojson=_embed_json(fc))
 
     density_js = ""
     denv = None
@@ -127,7 +152,7 @@ def leaflet_map(
         grid = np.asarray(grid, np.float64)
         denv = _env_tuple(env)
         density_js = _DENSITY_JS.format(
-            grid_json=json.dumps(
+            grid_json=_embed_json(
                 [[round(float(v), 4) for v in row] for row in grid]
             ),
             xmin=denv[0], ymin=denv[1], xmax=denv[2], ymax=denv[3],
@@ -149,10 +174,10 @@ def leaflet_map(
         else:
             center = (0, 0)
     return _PAGE.format(
-        title=title,
-        lat=center[0],
-        lon=center[1],
-        zoom=zoom if zoom is not None else 4,
+        title=_html.escape(title),
+        lat=float(center[0]),
+        lon=float(center[1]),
+        zoom=int(zoom) if zoom is not None else 4,
         density_js=density_js,
         features_js=features_js,
     )

@@ -126,3 +126,46 @@ def test_azimuth():
     col = np.array([[0.0, 0.0], [1.0, 1.0]])
     az = st_azimuth(col, Point(1.0, 1.0))
     assert az[0] == pytest.approx(np.pi / 4) and np.isnan(az[1])
+
+
+# -- script-injection hardening ----------------------------------------------
+
+
+def test_embedded_json_escapes_script_close():
+    """A '</script>' inside an attribute value must not terminate the
+    script element of the generated page (stored XSS)."""
+    evil = "</script><script>alert(1)</script>"
+    b = FeatureBatch.from_columns(SFT, {
+        "name": [evil],
+        "geom": np.array([[1.0, 2.0]]),
+    }, fids=np.arange(1))
+    html = leaflet_map(features=b, title="t </script><svg onload=x>")
+    # the raw close-tag never appears inside the generated page except
+    # as the legitimate final closers
+    body = html[html.index("<script>"):]
+    assert "alert(1)" in body  # data is preserved...
+    assert "</script><script>" not in html  # ...but cannot close the block
+    assert "<svg onload" not in html  # title is HTML-escaped
+    # and the embedded payload still parses as JSON ('\/' is valid JSON)
+    start = html.index("var fc = ") + len("var fc = ")
+    fc = json.loads(html[start: html.index(";\n", start)])
+    assert fc["features"][0]["properties"]["name"] == evil
+
+
+def test_embedded_json_escapes_comment_open_as_valid_json():
+    """'<!--' must be neutralized with a VALID JSON escape (\\u003c), so
+    strict consumers of the embedded payload still parse it."""
+    b = FeatureBatch.from_columns(SFT, {
+        "name": ["x<!--y"],
+        "geom": np.array([[1.0, 2.0]]),
+    }, fids=np.arange(1))
+    html = leaflet_map(features=b)
+    assert "<!--" not in html
+    start = html.index("var fc = ") + len("var fc = ")
+    fc = json.loads(html[start: html.index(";\n", start)])
+    assert fc["features"][0]["properties"]["name"] == "x<!--y"
+
+
+def test_popup_rows_escaped_in_js():
+    html = leaflet_map(features=_batch(1))
+    assert "var esc = function" in html  # popup values routed through esc()

@@ -389,3 +389,35 @@ def test_device_spatial_join_matches_host(tmp_path):
     host2 = flt2.spatial_join(zf, on="within")
     dev2 = flt2.spatial_join(zf, on="within", device_index=di)
     assert pair_fids(*host2) == pair_fids(*dev2)
+
+
+# -- spheroid measures (WGS84 Vincenty + antipodal fallback) -----------------
+
+
+def test_distance_spheroid_known_values():
+    # one degree of latitude at the equator on WGS84: 110,574.3 m
+    d = sql.st_distanceSpheroid(sql.st_point(0, 0), sql.st_point(0, 1))
+    assert abs(d - 110_574.3) < 5.0
+    # one degree of longitude on the equator: 111,319.49 m
+    d = sql.st_distanceSpheroid(sql.st_point(0, 0), sql.st_point(1, 0))
+    assert abs(d - 111_319.49) < 5.0
+    # coincident points are exactly zero
+    assert sql.st_distanceSpheroid(sql.st_point(5, 5), sql.st_point(5, 5)) == 0.0
+
+
+def test_distance_spheroid_antipodal_fallback():
+    # Vincenty's lambda iteration oscillates for (near-)antipodal pairs;
+    # the documented haversine fallback must kick in with a finite,
+    # sane value (half the mean circumference ~ 20,015 km).
+    for lon2, lat2 in ((180.0, 0.0), (179.7, 0.3), (-179.9, 0.05)):
+        d = sql.st_distanceSpheroid(sql.st_point(0, 0), sql.st_point(lon2, lat2))
+        assert np.isfinite(d)
+        assert 19_800_000 < d < 20_100_000, (lon2, lat2, d)
+
+
+def test_length_spheroid_matches_segment_sum():
+    line = sql.st_makeLine([sql.st_point(0, 0), sql.st_point(0, 1), sql.st_point(1, 1)])
+    total = sql.st_lengthSpheroid(line)
+    d1 = sql.st_distanceSpheroid(sql.st_point(0, 0), sql.st_point(0, 1))
+    d2 = sql.st_distanceSpheroid(sql.st_point(0, 1), sql.st_point(1, 1))
+    assert abs(total - (d1 + d2)) < 1e-6

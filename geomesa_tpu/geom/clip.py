@@ -292,15 +292,22 @@ def clip_rings(ra: np.ndarray, rb: np.ndarray, op: str) -> list:
     Retries with a deterministic perturbation of the clip ring on
     degenerate (vertex-on-edge / collinear-overlap) inputs, escalating
     1e-8 -> 1e-7 of the bbox span (capped; later retries re-roll at the
-    cap with a fresh seed)."""
+    cap with a fresh seed). The scale is floored at a few ULP of the
+    coordinate MAGNITUDE — a small polygon far from the origin (e.g.
+    EPSG:3857 metres) would otherwise round the perturbation away
+    entirely and retry the identical degenerate input."""
     span = max(
         float(np.ptp(ra[:, 0])), float(np.ptp(ra[:, 1])),
         float(np.ptp(rb[:, 0])), float(np.ptp(rb[:, 1])), 1e-9,
     )
+    mag = max(
+        float(np.abs(ra).max()), float(np.abs(rb).max()), 1.0
+    )
+    base = max(span * 1e-9, float(np.spacing(mag)) * 4)
     for k in range(6):
         try:
             return _clip_once(ra, rb if k == 0 else _perturb(
-                rb, k, span * 1e-9 * (10 ** min(k, 2))
+                rb, k, base * (10 ** min(k, 2))
             ), op)
         except _Degenerate:
             continue

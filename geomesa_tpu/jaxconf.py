@@ -14,6 +14,49 @@ this.
 from __future__ import annotations
 
 _enabled = False
+_cache_dir: "str | None" = None
+
+
+def enable_compilation_cache(path: "str | None" = None) -> "str | None":
+    """Point jax at a persistent on-disk compilation cache (idempotent).
+
+    A process restart otherwise re-pays every XLA compile: ~14s for the
+    fused kNN top_k alone, ~2min of warmup for the full serving set
+    (BENCH_r04 ``knn_cold_ms``/``pipeline_warmup_s``). With the cache a
+    second process loads each kernel from disk in well under a second
+    (measured 3.5s -> 0.5s for a sort+matmul probe through the TPU
+    tunnel). Called automatically by the compile-heavy entry points
+    (DeviceIndex, the HTTP server, bench.py); safe after backend init.
+
+    ``GEOMESA_TPU_COMPILE_CACHE`` overrides the location, or disables
+    the cache entirely when set to ``off``/``0``. Default:
+    ``~/.cache/geomesa_tpu/xla``. Returns the directory in use (None
+    when disabled)."""
+    global _cache_dir
+    if _cache_dir is not None:
+        return _cache_dir
+    import os
+
+    env = os.environ.get("GEOMESA_TPU_COMPILE_CACHE", "")
+    if env.lower() in ("off", "0", "none", "disabled"):
+        return None
+    path = path or env or os.path.expanduser("~/.cache/geomesa_tpu/xla")
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        return None  # read-only home: run without persistence
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # persist anything that took >=0.5s to compile (the default 1s
+    # threshold skips mid-size kernels that still dominate warm restarts)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass  # older jax: size gate not configurable
+    _cache_dir = path
+    return path
 
 
 def require_x64() -> None:

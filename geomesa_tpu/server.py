@@ -441,14 +441,22 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_server(
-    store, host: str = "127.0.0.1", port: int = 0, resident: bool = False
+    store, host: str = "127.0.0.1", port: int = 0, resident: bool = False,
+    warm: bool = False,
 ):
     """Build a ThreadingHTTPServer bound to (host, port); port 0 picks an
     ephemeral port (see ``server.server_address``). ``resident=True``
     serves count/features/stats from device-pinned DeviceIndex caches
-    (built lazily per type on first access)."""
+    (built lazily per type on first access). ``warm=True`` (resident
+    only) stages every type and pre-compiles its serving kernels BEFORE
+    the server accepts traffic (DeviceIndex.warmup), so no request pays
+    a first-touch staging or XLA compile; with the persistent
+    compilation cache (on by default, see jaxconf) a restarted server
+    warms from disk in seconds."""
+    from geomesa_tpu.jaxconf import enable_compilation_cache
     from geomesa_tpu.pyarrow_compat import preload_pyarrow
 
+    enable_compilation_cache()
     preload_pyarrow()  # handler threads serve Arrow; see pyarrow_compat
     handler = type(
         "BoundHandler",
@@ -460,15 +468,32 @@ def make_server(
             "_resident_lock": threading.Lock(),
         },
     )
+    if resident and warm:
+        import warnings
+
+        from geomesa_tpu.device_cache import StreamingDeviceIndex
+
+        for tn in store.type_names:
+            # a type that fails to stage (e.g. device OOM) must not keep
+            # the OTHER types from serving — same isolation the lazy
+            # first-touch path gives: that type just isn't resident
+            try:
+                di = StreamingDeviceIndex(store, tn, z_planes=True)
+                di.warmup()
+            except Exception as e:
+                warnings.warn(f"warm staging failed for {tn!r}: {e!r}")
+                continue
+            handler._resident_cache[tn] = di
     return ThreadingHTTPServer((host, port), handler)
 
 
 def serve_background(
-    store, host: str = "127.0.0.1", port: int = 0, resident: bool = False
+    store, host: str = "127.0.0.1", port: int = 0, resident: bool = False,
+    warm: bool = False,
 ):
     """Start serving on a daemon thread; returns (server, thread). Stop
     with ``server.shutdown()``."""
-    server = make_server(store, host, port, resident=resident)
+    server = make_server(store, host, port, resident=resident, warm=warm)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread

@@ -482,7 +482,10 @@ def cmd_serve(args):
     from geomesa_tpu.server import make_server
 
     store = _store(args)
-    server = make_server(store, args.host, args.port, resident=args.resident)
+    server = make_server(
+        store, args.host, args.port, resident=args.resident,
+        warm=getattr(args, "warm", False),
+    )
     host, port = server.server_address[:2]
     mode = " (resident device caches)" if args.resident else ""
     print(f"serving {store.root} on http://{host}:{port}{mode}")
@@ -640,6 +643,13 @@ def main(argv=None) -> None:
         action="store_true",
         help="pin scan columns + index-key planes in device memory and "
         "serve count/features/stats from fused device scans",
+    )
+    sp.add_argument(
+        "--warm",
+        action="store_true",
+        help="with --resident: stage every type and pre-compile its "
+        "serving kernels before accepting traffic (no request pays a "
+        "first-touch staging or XLA compile)",
     )
 
     args = p.parse_args(argv)

@@ -471,3 +471,31 @@ def test_sql_surface():
     assert len(outs) == 3
     agg = F.st_aggregateUnion([SQUARE, OFFSET_SQUARE, DISJOINT])
     assert F.st_area(agg) == pytest.approx(16 + 16 - 4 + 4)
+
+
+def test_degenerate_far_from_origin():
+    """A small polygon at large coordinate magnitude (EPSG:3857-like)
+    with a vertex-on-edge degeneracy: the perturbation scale must stay
+    above the coordinate ULP or every retry re-tests the same input."""
+    base = 1.2e7  # metres — Web-Mercator range, ULP ~ 2e-9
+    a = Polygon(np.array([
+        [base, base], [base + 1e-3, base], [base + 1e-3, base + 1e-3],
+        [base, base + 1e-3],
+    ]))
+    # b shares a full edge segment with a (collinear overlap)
+    b = Polygon(np.array([
+        [base + 2e-4, base], [base + 8e-4, base],
+        [base + 8e-4, base + 5e-4], [base + 2e-4, base + 5e-4],
+    ]))
+    got = polygon_intersection(a, b)
+
+    def shoelace(g):
+        if isinstance(g, MultiPolygon):
+            return sum(shoelace(q) for q in g.polygons)
+        r = np.asarray(g.rings()[0])
+        r = r - r.mean(axis=0)  # center: avoid shoelace cancellation
+        x, y = r[:, 0], r[:, 1]
+        return 0.5 * abs(float(np.dot(x, np.roll(y, -1))
+                                - np.dot(y, np.roll(x, -1))))
+
+    assert shoelace(got) == pytest.approx(6e-4 * 5e-4, rel=0.05)

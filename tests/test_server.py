@@ -474,3 +474,89 @@ def test_process_endpoints_resident_mode():
         assert s2 == 200 and len(json.loads(b2)["features"]) > 0
     finally:
         server.shutdown()
+
+
+def test_warm_server_precompiles_and_serves():
+    """make_server(warm=True) stages every type and pre-compiles the
+    serving kernels before accepting traffic; requests then serve with
+    no first-touch build."""
+    from geomesa_tpu.server import make_server
+    import threading
+
+    ds = MemoryDataStore()
+    ds.create_schema("gdelt", SPEC)
+    n = 500
+    rng = np.random.default_rng(23)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write("gdelt", {
+        "name": rng.choice(["a", "b"], n),
+        "dtg": t0 + rng.integers(0, 10**8, n),
+        "geom": np.stack(
+            [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+    server = make_server(ds, resident=True, warm=True)
+    # the resident cache is populated BEFORE the first request
+    assert "gdelt" in server.RequestHandlerClass._resident_cache
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        host, port = server.server_address[:2]
+        status, _, body = _get(
+            f"http://{host}:{port}/count/gdelt?cql=INCLUDE"
+        )
+        assert status == 200 and json.loads(body)["count"] == n
+    finally:
+        server.shutdown()
+
+
+def test_device_index_warmup_legs():
+    """warmup() compiles every serving kernel family and reports a
+    per-leg duration (None only for legs the schema can't serve)."""
+    from geomesa_tpu.device_cache import DeviceIndex
+
+    ds = MemoryDataStore()
+    ds.create_schema("t", SPEC)
+    n = 300
+    rng = np.random.default_rng(5)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write("t", {
+        "name": rng.choice(["a", "b"], n),
+        "dtg": t0 + rng.integers(0, 10**8, n),
+        "geom": np.stack(
+            [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+    di = DeviceIndex(ds, "t", z_planes=True)
+    out = di.warmup()
+    assert {"knn", "density", "stats", "mask", "window_union"} <= set(out)
+    assert all(v is not None for v in out.values()), out
+    # warmed: a real request compiles nothing (sub-50ms on the CPU mesh)
+    import time as _t
+    t = _t.perf_counter()
+    di.knn(0.0, 0.0, 5)
+    assert (_t.perf_counter() - t) < 0.5
+
+
+def test_device_index_warmup_non_point_schema():
+    """Non-point schemas warm their envelope-plane kernels; only the
+    point-only legs (kNN, density) report unavailable."""
+    from geomesa_tpu.device_cache import DeviceIndex
+    from geomesa_tpu.sql.functions import st_makeBBOX
+
+    ds = MemoryDataStore()
+    ds.create_schema("zones", "name:String,dtg:Date,*geom:Polygon:srid=4326")
+    t0 = parse_instant("2020-01-01T00:00:00")
+    polys = np.array(
+        [st_makeBBOX(i, i, i + 1, i + 1) for i in range(40)], dtype=object
+    )
+    ds.write("zones", {
+        "name": [f"z{i}" for i in range(40)],
+        "dtg": t0 + np.arange(40) * 10**6,
+        "geom": polys,
+    }, fids=np.arange(40))
+    di = DeviceIndex(ds, "zones", z_planes=True)
+    out = di.warmup()
+    assert out["knn"] is None and out["density"] is None
+    others = {k: v for k, v in out.items() if k not in ("knn", "density")}
+    assert others and all(v is not None for v in others.values()), out

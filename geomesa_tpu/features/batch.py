@@ -116,8 +116,29 @@ class FeatureBatch:
     def column(self, name: str) -> np.ndarray:
         return self.columns[name]
 
-    def take(self, indices) -> "FeatureBatch":
+    def take(self, indices, allow_alias: bool = False) -> "FeatureBatch":
+        """Row gather -> new batch. ``take`` COPIES by default — callers
+        (e.g. stream/live snapshots) rely on it as a defensive copy
+        against in-place writers. ``allow_alias=True`` lets an identity
+        index set return ``self`` un-copied: ONLY for internal read-only
+        pipelines whose downstream merge copies anyway (the fs store's
+        per-partition scans), where the full-table gather is pure
+        waste."""
         idx = np.asarray(indices)
+        n = len(self)
+        if (
+            allow_alias
+            and len(idx) == n
+            and n
+            and idx.dtype.kind in "iu"
+            and idx[0] == 0
+            and idx[-1] == n - 1
+            and bool(np.all(idx[1:] > idx[:-1]))
+        ):
+            # n strictly-increasing ints starting at 0 ending at n-1 ARE
+            # the identity: skip the full-copy gather (a full-table scan
+            # otherwise pays it per partition)
+            return self
         return FeatureBatch(
             self.sft,
             self.fids[idx],
@@ -179,7 +200,12 @@ class FeatureBatch:
 
         from geomesa_tpu.security import VIS_COLUMN
 
-        arrays = {"__fid__": pa.array(self.fids.tolist())}
+        fids = self.fids
+        arrays = {
+            "__fid__": pa.array(
+                fids if fids.dtype != object else fids.tolist()
+            )
+        }
         if VIS_COLUMN in self.columns:
             arrays[VIS_COLUMN] = pa.array(
                 [str(v) for v in self.columns[VIS_COLUMN]], pa.string()
@@ -188,12 +214,18 @@ class FeatureBatch:
             col = self.columns[attr.name]
             if attr.is_geometry:
                 if col.dtype != object:
-                    arrays[f"{attr.name}_x"] = pa.array(col[:, 0])
-                    arrays[f"{attr.name}_y"] = pa.array(col[:, 1])
+                    arrays[f"{attr.name}_x"] = pa.array(
+                        np.ascontiguousarray(col[:, 0])
+                    )
+                    arrays[f"{attr.name}_y"] = pa.array(
+                        np.ascontiguousarray(col[:, 1])
+                    )
                 else:
                     arrays[attr.name] = pa.array([to_wkt(g) for g in col])
             elif attr.type_name == "Date":
                 arrays[attr.name] = pa.array(col, type=pa.timestamp("ms"))
+            elif col.dtype.kind in "iufb":
+                arrays[attr.name] = pa.array(col)  # zero-conversion path
             else:
                 arrays[attr.name] = pa.array(col.tolist())
         return pa.table(arrays)

@@ -122,6 +122,15 @@ def get_lib():
             lib._has_xz = True
         except AttributeError:  # stale prebuilt .so without the symbol
             lib._has_xz = False
+        try:
+            _u32p2 = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+            _i64p3 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            lib.gm_radix_argsort.argtypes = [
+                ctypes.c_int64, ctypes.c_int32, _u32p2, _i64p3,
+            ]
+            lib._has_sort = True
+        except AttributeError:  # stale prebuilt .so without the symbol
+            lib._has_sort = False
         _lib = lib
         return _lib
 
@@ -181,6 +190,60 @@ def xz_index(mins: np.ndarray, maxs: np.ndarray, g: int, dims: int) -> "np.ndarr
     n = mins.shape[1]
     out = np.empty(n, dtype=np.int64)
     lib.gm_xz_index(n, np.int32(dims), np.int32(g), mins, maxs, out)
+    return out
+
+
+def _order_preserving_u32_lanes(col: np.ndarray) -> "list[np.ndarray] | None":
+    """Map a key column to uint32 lanes whose lexicographic order equals
+    the column's natural order (most-significant lane first), or None when
+    the dtype has no such mapping (the caller falls back to lexsort).
+    Signed ints bias by the sign bit; 64-bit types split into hi/lo."""
+    dt = col.dtype
+    if dt == np.uint32:
+        return [col]
+    if dt == np.int32:
+        return [(col.view(np.uint32) ^ np.uint32(0x80000000))]
+    if dt == np.uint64:
+        return [
+            (col >> np.uint64(32)).astype(np.uint32),
+            (col & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+        ]
+    if dt == np.int64:
+        u = col.view(np.uint64) ^ np.uint64(1 << 63)
+        return [
+            (u >> np.uint64(32)).astype(np.uint32),
+            (u & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+        ]
+    if dt in (np.int16, np.uint16, np.int8, np.uint8):
+        wide = col.astype(np.int64)
+        return _order_preserving_u32_lanes(wide)
+    return None
+
+
+def radix_argsort(cols: list) -> "np.ndarray | None":
+    """Stable lexicographic argsort of integer key columns (first column
+    most significant) via the native digit-wise LSD radix kernel; None
+    when the library is unavailable or a dtype has no order-preserving
+    uint32 mapping. Bit-identical to np.lexsort (the oracle)."""
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_has_sort", False):
+        return None
+    lanes: list = []
+    for col in cols:
+        got = _order_preserving_u32_lanes(np.asarray(col))
+        if got is None:
+            return None
+        lanes.extend(got)
+    n = len(lanes[0]) if lanes else 0
+    if n == 0:
+        return np.empty(0, dtype=np.int64)
+    # write each lane straight into the lane-major matrix (a stack() of
+    # the mapped lanes would pay one more full copy of the key data)
+    mat = np.empty((len(lanes), n), dtype=np.uint32)
+    for i, lane in enumerate(lanes):
+        mat[i, :] = lane
+    out = np.empty(n, dtype=np.int64)
+    lib.gm_radix_argsort(n, np.int32(len(lanes)), mat, out)
     return out
 
 

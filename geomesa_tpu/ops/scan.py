@@ -20,18 +20,18 @@ def _default_platform() -> str:
     return jax.devices()[0].platform
 
 
-def stage_columns(
+def stage_columns_host(
     batch: FeatureBatch,
     names: "list[str]",
     start: int = 0,
     stop: "int | None" = None,
     dtype=None,
 ):
-    """Slice + upload the named device columns ("attr" scalar columns,
-    "attr__x"/"attr__y" point coordinates, "attr__hi"/"attr__lo" two-word
-    planes of int64 columns -- ops/int64lanes.py) as jax arrays."""
-    import jax.numpy as jnp
-
+    """Host-side half of :func:`stage_columns`: the named planes as
+    contiguous numpy arrays in their DEVICE storage dtypes, ready for
+    upload. Split out so the resident cache can batch every 4-byte plane
+    into one packed transfer (device_cache._stage_packed) instead of one
+    round trip per plane."""
     from geomesa_tpu.ops.int64lanes import split_array_np
 
     stop = len(batch) if stop is None else stop
@@ -68,5 +68,25 @@ def stage_columns(
             from geomesa_tpu.jaxconf import require_x64
 
             require_x64()
-        out[name] = jnp.asarray(np.ascontiguousarray(arr))
+        out[name] = np.ascontiguousarray(arr)
     return out
+
+
+def stage_columns(
+    batch: FeatureBatch,
+    names: "list[str]",
+    start: int = 0,
+    stop: "int | None" = None,
+    dtype=None,
+):
+    """Slice + upload the named device columns ("attr" scalar columns,
+    "attr__x"/"attr__y" point coordinates, "attr__hi"/"attr__lo" two-word
+    planes of int64 columns -- ops/int64lanes.py) as jax arrays."""
+    import jax.numpy as jnp
+
+    return {
+        k: jnp.asarray(v)
+        for k, v in stage_columns_host(
+            batch, names, start=start, stop=stop, dtype=dtype
+        ).items()
+    }

@@ -93,7 +93,12 @@ def _run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
     else:
         rows = np.array([], dtype=np.int64)
 
-    result = built.batch.take(rows)
+    # internal per-partition scans (fs store) feed a merge that copies;
+    # let a full-match scan skip the identity gather there. User-facing
+    # results always copy (a caller mutating its result must never tear
+    # the store's partition cache).
+    internal = bool(plan.query.hints.get("internal_scan"))
+    result = built.batch.take(rows, allow_alias=internal)
     result = _post_process(result, plan)
     return QueryResult(result, plan, n_scanned, built.n)
 

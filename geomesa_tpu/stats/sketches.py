@@ -361,10 +361,28 @@ class Z3HistogramStat(Stat):
         sfc = Z3SFC(TimePeriod.parse(self.period))
         b, off = to_binned_time(np.asarray(t_ms), self.period)
         z = sfc.index(x, y, off)
+        self.observe_binned(b, z)
+
+    def observe_binned(self, b, z):
+        """Observe pre-encoded (bin, z) keys — the flush path already
+        computed them for the sorted-index build; re-encoding 4M rows
+        just for the histogram doubled the encode cost."""
         key = (np.asarray(b).astype(np.int64) << np.int64(self.prefix_bits)) | (
-            z >> np.uint64(63 - self.prefix_bits)
+            np.asarray(z) >> np.uint64(63 - self.prefix_bits)
         ).astype(np.int64)
-        vals, cnts = np.unique(key, return_counts=True)
+        if len(key) == 0:
+            return
+        # occupancy keys are COARSE (a few bins x 2^prefix_bits cells):
+        # when the key span is small, bincount over the shifted range is
+        # a single linear pass — np.unique sorts all n keys (~4s at 2^25)
+        kmin = int(key.min())
+        span = int(key.max()) - kmin + 1
+        if span <= max(1 << 24, 4 * len(key)):
+            cnts = np.bincount(key - kmin, minlength=span)
+            nz = np.nonzero(cnts)[0]
+            vals, cnts = nz + kmin, cnts[nz]
+        else:  # pathological spread: fall back to sort-based unique
+            vals, cnts = np.unique(key, return_counts=True)
         for k, c in zip(vals.tolist(), cnts.tolist()):
             self.counts[k] = self.counts.get(k, 0) + c
 
@@ -465,8 +483,11 @@ class Z3HistogramStat(Stat):
             "nonzero": len(self.counts),
             "total": sum(self.counts.values()),
             # full occupancy map: needed for the round-trip that feeds
-            # reopened stores' stat-based planning
-            "cells": {str(k): int(v) for k, v in self.counts.items()},
+            # reopened stores' stat-based planning. Parallel key/count
+            # lists, not a dict -- a 100k-entry dict dominated the whole
+            # manifest dump (json encodes dict items one at a time)
+            "cell_keys": list(self.counts.keys()),
+            "cell_counts": list(self.counts.values()),
         }
 
 
@@ -508,7 +529,12 @@ def stat_from_json(d: dict):
             d.get("period", "week"),
             int(d.get("prefix_bits", 12)),
         )
-        s.counts = {int(k): int(v) for k, v in d.get("cells", {}).items()}
+        if "cell_keys" in d:
+            s.counts = dict(
+                zip(map(int, d["cell_keys"]), map(int, d["cell_counts"]))
+            )
+        else:  # manifests written before the parallel-list format
+            s.counts = {int(k): int(v) for k, v in d.get("cells", {}).items()}
         return s
     raise ValueError(f"unknown stat json type {t!r}")
 

@@ -72,9 +72,26 @@ def _write_table(table, path: str, encoding: str) -> None:
 
         orc.write_table(table, path)
     else:
+        import pyarrow as pa
         import pyarrow.parquet as pq
 
-        pq.write_table(table, path)
+        # dictionary-encode ONLY string-ish columns (fids, vis labels,
+        # WKT): dictionary pages on float/int data cost ~2.7x the write
+        # time for zero size win, and parquet column statistics duplicate
+        # what the partition manifest already records (key ranges, bbox,
+        # time range)
+        dict_cols = [
+            f.name
+            for f in table.schema
+            if pa.types.is_string(f.type)
+            or pa.types.is_large_string(f.type)
+            or pa.types.is_binary(f.type)
+        ]
+        pq.write_table(
+            table, path,
+            use_dictionary=dict_cols or False,
+            write_statistics=False,
+        )
 
 
 def _read_table(path: str, encoding: str):
@@ -415,54 +432,88 @@ class FileSystemDataStore:
             raise
 
     def _write_sorted(self, type_name, st, ks, data) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from geomesa_tpu.pyarrow_compat import preload_pyarrow
+
+        # the writer threads import pyarrow.parquet/orc: the FIRST pyarrow
+        # import must happen on this (spawning) thread or a later
+        # main-thread read segfaults (pyarrow_compat contract)
+        preload_pyarrow()
         # drop old files, write new
         d = self._dir(type_name)
         for dirpath, _, files in os.walk(d):
             for f in files:
                 if f.startswith("part-"):
                     os.unlink(os.path.join(dirpath, f))
-        if st.scheme is not None and len(data):
-            # group rows by directory leaf; each leaf is sorted + manifested
-            # independently (the partition-scheme layout)
-            leaves = st.scheme.leaves(data)
-            all_parts: list = []
-            pid = 0
-            import dataclasses
+        # partition files stream out on a writer thread (pyarrow releases
+        # the GIL; at GB scale the writes are disk-writeback-bound) while
+        # the main thread computes stats/manifest — joined BEFORE the
+        # manifest publishes, so readers never see it ahead of the files
+        writes: list = []
+        ex = ThreadPoolExecutor(max_workers=2)
+        try:
+            if st.scheme is not None and len(data):
+                # group rows by directory leaf; each leaf is sorted +
+                # manifested independently (the partition-scheme layout)
+                leaves = st.scheme.leaves(data)
+                all_parts: list = []
+                pid = 0
+                import dataclasses
 
-            for leaf in sorted(set(leaves)):
-                sub = data.take(np.nonzero(leaves == leaf)[0])
-                built = self._build(ks, sub)
-                leaf_dir = os.path.join(d, leaf)
-                os.makedirs(leaf_dir, exist_ok=True)
+                for leaf in sorted(set(leaves)):
+                    sub = data.take(np.nonzero(leaves == leaf)[0])
+                    built = self._build(ks, sub)
+                    leaf_dir = os.path.join(d, leaf)
+                    os.makedirs(leaf_dir, exist_ok=True)
+                    # ONE arrow conversion per leaf; partition files are
+                    # zero-copy slices (a per-partition take + to_arrow
+                    # paid a full column conversion for every file)
+                    table = built.batch.to_arrow()
+                    for p in built.partitions:
+                        part = dataclasses.replace(p, pid=pid, leaf=leaf)
+                        writes.append(ex.submit(
+                            _write_table,
+                            table.slice(p.start, p.stop - p.start),
+                            self._part_path(type_name, part),
+                            st.encoding,
+                        ))
+                        all_parts.append(part)
+                        pid += 1
+                st.partitions = all_parts
+                full = data
+                z3_keys = None
+            else:
+                built = self._build(ks, data)
+                table = built.batch.to_arrow()
                 for p in built.partitions:
-                    part = dataclasses.replace(p, pid=pid, leaf=leaf)
-                    chunk = built.batch.take(np.arange(p.start, p.stop))
-                    _write_table(
-                        chunk.to_arrow(),
-                        self._part_path(type_name, part),
+                    writes.append(ex.submit(
+                        _write_table,
+                        table.slice(p.start, p.stop - p.start),
+                        self._part_path(type_name, p),
                         st.encoding,
-                    )
-                    all_parts.append(part)
-                    pid += 1
-            st.partitions = all_parts
-            full = data
-        else:
-            built = self._build(ks, data)
-            for p in built.partitions:
-                sub = built.batch.take(np.arange(p.start, p.stop))
-                _write_table(
-                    sub.to_arrow(), self._part_path(type_name, p), st.encoding
+                    ))
+                st.partitions = built.partitions
+                full = built.batch
+                # the build already encoded every row's (bin, z): reuse
+                # for the Z3 histogram instead of a second full encode
+                z3_keys = (
+                    (built.keys["bin"], built.keys["z"])
+                    if getattr(ks, "name", None) == "z3"
+                    else None
                 )
-            st.partitions = built.partitions
-            full = built.batch
-        st.cache = {}
-        dtg = st.sft.dtg_field
-        if dtg is not None and len(full):
-            col = full.column(dtg)
-            st.data_interval = (int(col.min()), int(col.max()))
-        from geomesa_tpu.store.memory import build_default_stats
+            st.cache = {}
+            dtg = st.sft.dtg_field
+            if dtg is not None and len(full):
+                col = full.column(dtg)
+                st.data_interval = (int(col.min()), int(col.max()))
+            from geomesa_tpu.store.memory import build_default_stats
 
-        st.stats = build_default_stats(st.sft, full)
+            st.stats = build_default_stats(st.sft, full, z3_keys=z3_keys)
+            for w in writes:
+                w.result()  # a failed write must fail the flush, loudly
+        finally:
+            ex.shutdown(wait=True)
         st.dirty = False  # a successful rewrite lifts the quarantine
         st.quarantine_owner = False
         self._save_meta(type_name)
@@ -482,9 +533,15 @@ class FileSystemDataStore:
 
         if (
             self.mesh is not None
+            and self.mesh.size > 1
             and getattr(ks, "name", None) in DEVICE_BUILD_KINDS
             and len(data) >= self.MESH_BUILD_MIN_ROWS
         ):
+            # the mesh path earns its keep by PARALLELISM (the exchange
+            # sort scales across shards); a single-device mesh pays the
+            # host->device->host round trip of every lane for none, and
+            # through a remote-tunnel chip that round trip alone is ~10x
+            # the host build. Bit-identical either way (parity suite).
             return build_index(ks, data, self.partition_size, mesh=self.mesh)
         return build_index(ks, data, self.partition_size)
 
@@ -753,7 +810,15 @@ class FileSystemDataStore:
                 chunks.append(sub.batch)
         total = sum(p.count for p in st.partitions)
         if chunks:
-            out = chunks[0] if len(chunks) == 1 else FeatureBatch.concat(chunks)
+            if len(chunks) == 1:
+                out = chunks[0]
+                if any(out is c for c in st.cache.values()):
+                    # the aliasing fast path above only holds WITHIN this
+                    # scan: a single-chunk full match would hand the
+                    # partition cache's own batch to the caller — copy
+                    out = out.take(np.arange(len(out)))
+            else:
+                out = FeatureBatch.concat(chunks)
         else:
             empty = self._read_partition(type_name, st.partitions[0]).take(
                 np.array([], dtype=np.int64)

@@ -206,10 +206,19 @@ class MemoryDataStore:
         return len(self.query(type_name, query))
 
 
-def build_default_stats(sft: SimpleFeatureType, data: "FeatureBatch | None"):
+def build_default_stats(
+    sft: SimpleFeatureType,
+    data: "FeatureBatch | None",
+    z3_keys: "tuple | None" = None,
+):
     """Write-time stats (ref MetadataBackedStats/StatUpdater): count,
     MinMax per numeric/date attribute, Z3Histogram for point+time
-    schemas. Used by the stats API/CLI and selectivity estimates."""
+    schemas. Used by the stats API/CLI and selectivity estimates.
+
+    ``z3_keys=(bin, z)`` feeds pre-encoded keys to the Z3 histogram — the
+    FS flush already encoded every row for the sorted-index build, and
+    re-encoding for the histogram doubled the flush's encode cost. Only
+    valid when the keys were computed with the schema's own interval."""
     from geomesa_tpu.stats import SeqStat
     from geomesa_tpu.stats.sketches import (
         Cardinality,
@@ -225,12 +234,20 @@ def build_default_stats(sft: SimpleFeatureType, data: "FeatureBatch | None"):
         if a.indexed and not a.is_geometry:
             # equality-selectivity input for the stat-based planner
             stats.append(Cardinality(a.name))
+    z3_hist = None
     geom, dtg = sft.geom_field, sft.dtg_field
     if geom and dtg and sft.descriptor(geom).is_point:
-        stats.append(Z3HistogramStat(geom, dtg, sft.z3_interval))
+        z3_hist = Z3HistogramStat(geom, dtg, sft.z3_interval)
+        stats.append(z3_hist)
     seq = SeqStat(stats)
     if data is not None and len(data):
-        seq.observe_batch(data)
+        if z3_hist is not None and z3_keys is not None:
+            seq = SeqStat([s for s in seq.stats if s is not z3_hist])
+            seq.observe_batch(data)
+            z3_hist.observe_binned(*z3_keys)
+            seq = SeqStat(seq.stats + [z3_hist])
+        else:
+            seq.observe_batch(data)
     return seq
 
 

@@ -352,7 +352,10 @@ def _stat_json(stat) -> dict:
             "estimate": round(float(stat.estimate), 1),
         }
     j.pop("table", None)  # count-min table: thousands of ints
-    j.pop("cells", None)  # z3 histogram occupancy map
+    # z3 histogram occupancy map (old dict form + parallel-list form)
+    j.pop("cells", None)
+    j.pop("cell_keys", None)
+    j.pop("cell_counts", None)
     return j
 
 

@@ -817,6 +817,123 @@ def bench_xz_build(args) -> dict:
     }
 
 
+def bench_oocscan(args) -> dict:
+    """Out-of-core streamed scan (VERDICT r4 next-2): a multi-GB dataset
+    streamed through the double-buffered device slab pump
+    (store/oocscan.SlabStream) with the flagship compiled filter fused
+    per slab — the path that serves datasets LARGER than HBM (device
+    memory holds two slabs, dataset size is bounded by disk). Chunks
+    are deterministic per-chunk PRNG (modeling partition reads; the
+    real store integration is parity-proven in tests/test_oocscan.py).
+
+    Measurement honesty: the axon tunnel PROGRESSIVELY throttles a
+    process's bulk H2D traffic — a pure device_put loop of 256MB
+    buffers measured 1.4GB/s for its first ~2GB, then collapsed to
+    20-90MB/s for the remainder of the process's life (no recovery
+    after 30s idle; a fresh process starts fast again; kernels, fetches
+    and buffer content made no difference; the onset point varies
+    run to run). The leg records BOTH phases — ``oocscan_burst_mbps``
+    over its first ~1GB and the sustained whole-stream figure — and
+    runs LAST in all-mode so the throttle can't contaminate other
+    legs' staging. On real hardware the pump is bounded by PCIe/DMA
+    instead; nothing in the framework caps it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from geomesa_tpu.features.sft import SimpleFeatureType
+    from geomesa_tpu.filter.compile import compile_filter
+    from geomesa_tpu.filter.ecql import parse_ecql, parse_instant
+    from geomesa_tpu.store.oocscan import SlabStream
+
+    platform = jax.devices()[0].platform
+    # default 2^27 (2.1GiB through a 0.27GiB slab window): demonstrates
+    # the mechanism at 8x slab capacity while keeping the leg's wall
+    # time bounded when the tunnel throttle (above) is in effect
+    n_total = args.n or ((1 << 27) if platform == "tpu" else (1 << 22))
+    slab = (1 << 24) if platform == "tpu" else (1 << 18)
+    slab = min(slab, n_total)
+    n_slabs = (n_total + slab - 1) // slab
+    log(f"platform={platform} n={n_total:,} slab={slab:,} x {n_slabs} "
+        "(oocscan mode)")
+    sft = SimpleFeatureType.create(
+        "gdelt", "count:Int,dtg:Date,*geom:Point:srid=4326"
+    )
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    ecql = (
+        "BBOX(geom, -10, 35, 30, 60) AND "
+        "dtg DURING 2020-01-10T00:00:00Z/2020-01-15T00:00:00Z"
+    )
+    compiled = compile_filter(parse_ecql(ecql), sft)
+    assert compiled.fully_on_device
+
+    def chunk(i: int, with_ms: bool = False):
+        rng = np.random.default_rng(9000 + i)
+        rows = min(slab, n_total - i * slab)
+        ms = rng.integers(t0, t1, rows)
+        cols = {
+            "geom__x": rng.uniform(-180, 180, rows).astype(np.float32),
+            "geom__y": rng.uniform(-90, 90, rows).astype(np.float32),
+            "dtg__hi": (ms >> 32).astype(np.int32),
+            "dtg__lo": (ms & 0xFFFFFFFF).astype(np.uint32),
+        }
+        return (cols, ms) if with_ms else cols
+
+    def agg(cols, valid):
+        return jnp.sum(compiled.device_fn(cols) & valid, dtype=jnp.int32)
+
+    # burst phase: the first ~1GB (compile excluded by streaming slab 0
+    # twice: its first pass carries the compile)
+    stream = SlabStream(agg)
+    burst_slabs = max(1, (1 << 30) // (slab * 17))  # ~1GB at 17B/row
+    pre = [chunk(i) for i in range(min(burst_slabs + 1, n_slabs))]
+    stream.run(pre[:1])  # compile (no host prep concurrent with it)
+    b0 = stream.bytes_streamed
+    t = time.perf_counter()
+    outs_burst = stream.run(iter(pre))
+    burst_s = time.perf_counter() - t
+    burst_bytes = stream.bytes_streamed - b0
+    burst_mbps = burst_bytes / 2**20 / burst_s
+    # full stream (sustained: includes the tunnel's bulk-H2D throttle)
+    outs = list(outs_burst)
+    t_wall = time.perf_counter()
+    outs += stream.run(chunk(i) for i in range(len(pre), n_slabs))
+    wall = burst_s + (time.perf_counter() - t_wall)
+    total = int(sum(int(o) for o in outs))
+    bytes_streamed = stream.bytes_streamed - b0
+    if args.check:
+        want = 0
+        for i in range(min(n_slabs, 4)):  # spot-check slabs
+            cols, ms = chunk(i, with_ms=True)
+            m = (
+                (cols["geom__x"] >= -10) & (cols["geom__x"] <= 30)
+                & (cols["geom__y"] >= 35) & (cols["geom__y"] <= 60)
+                & (ms >= parse_instant("2020-01-10T00:00:00"))
+                & (ms <= parse_instant("2020-01-15T00:00:00"))
+            )
+            want += int(m.sum())
+            assert int(outs[i]) == int(m.sum()), (i, int(outs[i]), int(m.sum()))
+        log(f"oocscan per-slab parity verified on {min(n_slabs, 4)} slabs")
+    rate = n_total / wall
+    log(
+        f"oocscan: {n_total:,} rows ({bytes_streamed/2**30:.1f}GiB) in "
+        f"{wall:.1f}s -> {rate/1e6:.1f}M rows/s sustained; burst "
+        f"{burst_mbps:.0f}MB/s over first {burst_bytes/2**30:.1f}GiB"
+    )
+    return {
+        "oocscan_rows_per_sec": round(rate, 1),
+        "oocscan_n": n_total,
+        "oocscan_slab_rows": slab,
+        "oocscan_slabs": n_slabs,
+        "oocscan_gib_streamed": round(bytes_streamed / 2**30, 2),
+        "oocscan_wall_s": round(wall, 1),
+        "oocscan_burst_mbps": round(burst_mbps, 0),
+        "oocscan_sustained_mbps": round(bytes_streamed / 2**20 / wall, 0),
+        "oocscan_hits": total,
+    }
+
+
 def bench_pipeline(args) -> dict:
     """BASELINE config #1 is "GDELT bbox+during VIA PARQUET" — this leg
     measures the real path the kernel benchmarks hide (VERDICT round-3
@@ -1082,7 +1199,7 @@ def main() -> None:
         "--mode",
         choices=(
             "all", "filter", "zscan", "build", "polygon", "density", "sweep",
-            "xzbuild", "meshbuild", "pipeline",
+            "xzbuild", "meshbuild", "pipeline", "oocscan",
         ),
         default="all",
         help="all: every benchmark, one JSON line with everything (what "
@@ -1111,6 +1228,8 @@ def main() -> None:
         out = bench_meshbuild(args)
     elif args.mode == "pipeline":
         out = bench_pipeline(args)
+    elif args.mode == "oocscan":
+        out = bench_oocscan(args)
     else:
         # zscan FIRST: its DeviceIndex staging is a long sequence of
         # host->device transfers that measures 20-30x slower when another
@@ -1187,6 +1306,10 @@ def main() -> None:
                 f"pipeline25_{k.removeprefix('pipeline_')}": v
                 for k, v in bench_pipeline(a25).items()
             })
+        # the larger-than-HBM streamed scan runs LAST: it deliberately
+        # exhausts the tunnel's fast bulk-H2D budget (see bench_oocscan)
+        gc.collect()
+        out.update(bench_oocscan(args))
     # cold-cost numbers (knn_cold_ms, pipeline_warmup_s) depend on
     # whether the persistent compile cache had entries: record it
     out["compile_cache"] = compile_cache_dir is not None

@@ -1,0 +1,265 @@
+"""Out-of-core streamed device scan: datasets larger than HBM.
+
+Ref role: the reference's scans are inherently streaming — Accumulo
+iterators stream tablets through the scan servers and nothing ever
+requires the dataset to fit anywhere (BatchScanPlan, SURVEY section 3.1
+[UNVERIFIED - empty reference mount]). The resident ``DeviceIndex`` is
+the opposite trade: every scanned column pinned in HBM. This module
+fills the gap between them: partitions stream through a DOUBLE-BUFFERED
+device slab, the H2D upload of slab i+1 overlapping the fused scan
+kernel on slab i (jax dispatch is async; the one sync point is the final
+fetch), with the planner's zrange partition pruning deciding what
+streams at all. Peak device memory is a couple of slabs — dataset size
+is bounded by disk, not HBM.
+
+Two layers:
+
+- :class:`SlabStream` — the pump. Feed it host column chunks and a
+  per-slab aggregation; it keeps a bounded number of slabs in flight
+  and returns the per-slab results. Slab shapes pad to power-of-two
+  row buckets so the jit executable set stays bounded; every 4-byte
+  plane of a slab rides ONE packed uint32 upload (the staging transfer
+  discipline from device_cache — per-plane uploads pay per-transfer
+  latency for nothing).
+- :class:`StreamedDeviceScan` — the store integration. Plans a query,
+  prunes partitions by the manifest, streams the survivors from the
+  store's partition files, and counts (or collects) with the SAME
+  compiled fused mask the resident path uses.
+
+    scan = StreamedDeviceScan(store, "gdelt")
+    n = scan.count("BBOX(geom, -10, 35, 30, 60) AND dtg DURING ...")
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["SlabStream", "StreamedDeviceScan"]
+
+
+def _bucket(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+class SlabStream:
+    """Double-buffered device slab pump.
+
+    ``agg_fn(cols, valid) -> pytree of device values`` runs jitted once
+    per slab; :meth:`run` feeds it host chunks and returns the per-slab
+    outputs (fetched at the end — dispatches pipeline freely, so the
+    upload of slab i+1 overlaps the kernel on slab i). At most
+    ``in_flight`` slabs are unfinished at any moment, bounding device
+    memory at ``in_flight`` packed slabs. Counters (``slabs``, ``rows``,
+    ``bytes_streamed``) accumulate across runs; they are diagnostics,
+    not results.
+    """
+
+    def __init__(self, agg_fn, in_flight: int = 2):
+        import jax
+
+        if in_flight < 1:
+            raise ValueError("in_flight must be >= 1")
+        self._agg = agg_fn
+        self._in_flight = in_flight
+        self._jit = jax.jit(self._slab, static_argnums=1)
+        self.slabs = 0
+        self.rows = 0
+        self.bytes_streamed = 0
+
+    def _slab(self, mat, dtypes_names, rest, valid):
+        import jax
+
+        cols = dict(rest)
+        for i, (dt, name) in enumerate(dtypes_names):
+            cols[name] = jax.lax.bitcast_convert_type(mat[i], np.dtype(dt))
+        return self._agg(cols, valid)
+
+    def run(self, chunks) -> list:
+        """Stream ``chunks`` (iterable of host-column dicts of
+        equal-length arrays) through the device; returns the per-slab
+        agg outputs as host values, in chunk order (empty chunks are
+        skipped and produce no output)."""
+        return [out for out, _ in self.stream((c, None) for c in chunks)]
+
+    def stream(self, pairs):
+        """Generator form of :meth:`run`: consume ``(host_cols, aux)``
+        pairs, yield ``(agg_output_host, aux)`` lazily as slabs retire —
+        the caller holds at most ``in_flight`` auxes alive, never the
+        whole stream (the larger-than-memory query path rides this).
+        Empty chunks are skipped WITH their aux (outputs never
+        misalign)."""
+        import jax
+        import jax.numpy as jnp
+
+        pending: list = []  # (device out, aux)
+        for host, aux in pairs:
+            if not host:
+                continue
+            n = len(next(iter(host.values())))
+            if n == 0:
+                continue
+            cap = _bucket(n)
+            four = sorted(
+                k for k, v in host.items()
+                if v.ndim == 1 and v.dtype.itemsize == 4
+            )
+            rest_names = sorted(set(host) - set(four))
+            mat = np.empty((max(len(four), 1), cap), np.uint32)
+            mat[:, n:] = 0
+            for i, k in enumerate(four):
+                mat[i, :n] = np.ascontiguousarray(host[k]).view(np.uint32)
+            rest = {}
+            for k in rest_names:
+                buf = np.empty((cap,) + host[k].shape[1:], host[k].dtype)
+                buf[:n] = host[k]
+                buf[n:] = 0
+                rest[k] = jnp.asarray(buf)
+            valid = np.zeros(cap, bool)
+            valid[:n] = True
+            # dtype/name pairs are a STATIC argument: one executable per
+            # (schema, bucket) pair, regardless of chunk count
+            out = self._jit(
+                jnp.asarray(mat),
+                tuple((str(host[k].dtype), k) for k in four),
+                rest,
+                jnp.asarray(valid),
+            )
+            self.slabs += 1
+            self.rows += n
+            self.bytes_streamed += mat.nbytes + cap + sum(
+                int(v.nbytes) for v in rest.values()
+            )
+            pending.append((out, aux))
+            if len(pending) >= self._in_flight:
+                # bound in-flight slabs (and so device memory): retire
+                # the oldest before dispatching more
+                o, a = pending.pop(0)
+                yield jax.device_get(o), a
+        for o, a in pending:
+            yield jax.device_get(o), a
+
+
+class StreamedDeviceScan:
+    """Partition-streaming device scan over a partitioned store type.
+
+    Serves the same fused-mask counts/queries the resident DeviceIndex
+    does, but for datasets that exceed HBM: manifest pruning picks the
+    partitions a query can touch, and only those stream through the
+    slab pump. Parity contract: ``count``/``query`` match the store's
+    host path exactly (tests/test_oocscan.py). Per-filter slab kernels
+    are cached, so repeated queries recompile nothing."""
+
+    def __init__(self, store, type_name: str, slab_rows: "int | None" = None):
+        self.store = store
+        self.type_name = type_name
+        self.sft = store.get_schema(type_name)
+        #: target rows per slab; partitions group into slabs up to this
+        self.slab_rows = slab_rows or (1 << 22)
+        self._streams: dict = {}  # (filter repr, kind) -> SlabStream
+
+    # -- internals ---------------------------------------------------------
+
+    def _parts(self, query):
+        plan = self.store.plan(self.type_name, query)
+        return plan, self.store._pruned_parts(self.type_name, plan)
+
+    def _chunks(self, parts, names, groups_sink: "list | None" = None):
+        """Yield host column dicts, grouping small partitions into
+        slab_rows-sized chunks (fewer, larger uploads). When
+        ``groups_sink`` is given, the source batches of each chunk are
+        appended to it (the query path gathers hits from them)."""
+        group: list = []
+        rows = 0
+        for p in parts:
+            batch = self.store._read_partition(self.type_name, p)
+            group.append(batch)
+            rows += len(batch)
+            if rows >= self.slab_rows:
+                yield self._group_cols(group, names, groups_sink)
+                group, rows = [], 0
+        if group:
+            yield self._group_cols(group, names, groups_sink)
+
+    @staticmethod
+    def _group_cols(group, names, groups_sink):
+        from geomesa_tpu.features.batch import FeatureBatch
+        from geomesa_tpu.ops.scan import stage_columns_host
+
+        batch = group[0] if len(group) == 1 else FeatureBatch.concat(group)
+        if groups_sink is not None:
+            groups_sink.append(batch)
+        return stage_columns_host(batch, names)
+
+    def _stream(self, plan, kind: str) -> SlabStream:
+        import jax.numpy as jnp
+
+        compiled = plan.compiled
+        key = (repr(plan.filter), kind)
+        stream = self._streams.get(key)
+        if stream is None:
+            if kind == "count":
+                # int32 per-slab is safe (a slab never exceeds 2^31
+                # rows); totals accumulate in python ints
+                def agg(cols, valid):
+                    return jnp.sum(
+                        compiled.device_fn(cols) & valid, dtype=jnp.int32
+                    )
+
+            else:  # mask
+
+                def agg(cols, valid):
+                    return compiled.device_fn(cols) & valid
+
+            stream = SlabStream(agg)
+            self._streams[key] = stream
+        return stream
+
+    # -- public surface ----------------------------------------------------
+
+    def count(self, query) -> int:
+        """Streamed fused count. Filters with host-only predicates fall
+        back to the store's own (streaming, host) scan."""
+        plan, parts = self._parts(query)
+        compiled = plan.compiled
+        if not compiled.device_cols or not compiled.fully_on_device:
+            return len(self.store.query(self.type_name, query).batch)
+        outs = self._stream(plan, "count").run(
+            self._chunks(parts, compiled.device_cols)
+        )
+        return int(sum(int(o) for o in outs))
+
+    def query(self, query):
+        """Streamed fused scan returning the hit FeatureBatch: device
+        masks per slab, hits gathered host-side AS SLABS RETIRE (via
+        SlabStream.stream) — host memory holds the hits plus the
+        in-flight slabs' source batches, never the dataset."""
+        from geomesa_tpu.features.batch import FeatureBatch
+        from geomesa_tpu.query.runner import _post_process
+
+        plan, parts = self._parts(query)
+        compiled = plan.compiled
+        if not compiled.device_cols:
+            return self.store.query(self.type_name, query).batch
+        groups: list = []
+        pairs = (
+            (cols, groups.pop(0))
+            for cols in self._chunks(
+                parts, compiled.device_cols, groups_sink=groups
+            )
+        )
+        hits: list = []
+        for mask, batch in self._stream(plan, "mask").stream(pairs):
+            m = np.asarray(mask)[: len(batch)]
+            idx = np.nonzero(m)[0]
+            if len(idx) and not compiled.fully_on_device:
+                keep = compiled.residual_mask(batch.take(idx))
+                idx = idx[keep]
+            if len(idx):
+                hits.append(batch.take(idx))
+        if not hits:
+            out = FeatureBatch.from_columns(
+                self.sft, {a.name: [] for a in self.sft.attributes}
+            )
+        else:
+            out = hits[0] if len(hits) == 1 else FeatureBatch.concat(hits)
+        return _post_process(out, plan)

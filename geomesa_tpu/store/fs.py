@@ -648,13 +648,23 @@ class FileSystemDataStore:
             st.scheme = scheme
             self._rebuild_locked(type_name)
 
-    def _read_partition(self, type_name: str, p: PartitionMeta) -> FeatureBatch:
+    def _read_partition(
+        self, type_name: str, p: PartitionMeta, cache: bool = True
+    ) -> FeatureBatch:
+        """``cache=False`` reads without pinning the batch in the
+        per-type partition cache — the out-of-core streaming scan reads
+        every partition exactly once, and pinning them would accumulate
+        the whole dataset in host RAM (the thing that scan exists to
+        avoid)."""
         st = self._types[type_name]
-        if p.pid not in st.cache:
-            with self._shared():  # never read a half-rewritten directory
-                t = _read_table(self._part_path(type_name, p), st.encoding)
-            st.cache[p.pid] = FeatureBatch.from_arrow(t, st.sft)
-        return st.cache[p.pid]
+        if p.pid in st.cache:
+            return st.cache[p.pid]
+        with self._shared():  # never read a half-rewritten directory
+            t = _read_table(self._part_path(type_name, p), st.encoding)
+        batch = FeatureBatch.from_arrow(t, st.sft)
+        if cache:
+            st.cache[p.pid] = batch
+        return batch
 
     def _read_all(self, type_name: str) -> FeatureBatch:
         st = self._types[type_name]

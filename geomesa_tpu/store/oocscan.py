@@ -104,7 +104,9 @@ class SlabStream:
                 if v.ndim == 1 and v.dtype.itemsize == 4
             )
             rest_names = sorted(set(host) - set(four))
-            mat = np.empty((max(len(four), 1), cap), np.uint32)
+            # zero rows when no 4-byte planes ride: never ship (or count
+            # in bytes_streamed) an uninitialized placeholder row
+            mat = np.empty((len(four), cap), np.uint32)
             mat[:, n:] = 0
             for i, k in enumerate(four):
                 mat[i, :n] = np.ascontiguousarray(host[k]).view(np.uint32)
@@ -171,7 +173,11 @@ class StreamedDeviceScan:
         group: list = []
         rows = 0
         for p in parts:
-            batch = self.store._read_partition(self.type_name, p)
+            # cache=False: pinning every streamed partition in the
+            # store's cache would accumulate the dataset in host RAM
+            batch = self.store._read_partition(
+                self.type_name, p, cache=False
+            )
             group.append(batch)
             rows += len(batch)
             if rows >= self.slab_rows:

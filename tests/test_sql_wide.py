@@ -421,3 +421,36 @@ def test_length_spheroid_matches_segment_sum():
     d1 = sql.st_distanceSpheroid(sql.st_point(0, 0), sql.st_point(0, 1))
     d2 = sql.st_distanceSpheroid(sql.st_point(0, 1), sql.st_point(1, 1))
     assert abs(total - (d1 + d2)) < 1e-6
+
+
+def test_window_pairs_compaction_overflow_fallback():
+    """A dense window whose candidates exceed the device-compaction cap
+    C must fall back to the full bit-plane fetch and still return every
+    pair (the only correctness-critical branch of the compaction)."""
+    from geomesa_tpu.device_cache import DeviceIndex
+    from geomesa_tpu.store.memory import MemoryDataStore
+
+    n = 1 << 18  # plane_n 262144 -> C = 8192 << n: overflow reachable
+    rng = np.random.default_rng(9)
+    ds = MemoryDataStore()
+    ds.create_schema("t", "dtg:Date,*geom:Point:srid=4326")
+    ds.write("t", {
+        "dtg": rng.integers(1_577_836_800_000, 1_583_020_800_000, n),
+        "geom": np.stack(
+            [rng.uniform(-60, 60, n), rng.uniform(-50, 50, n)], axis=1
+        ),
+    })
+    di = DeviceIndex(ds, "t")
+    # window 0 covers everything (cnt == n > C); window 1 is tiny
+    envs = np.array([
+        [-180.0, -90.0, 180.0, 90.0],
+        [0.0, 0.0, 0.5, 0.5],
+    ])
+    rows, wins = di.window_pairs_query(envs)
+    assert int((wins == 0).sum()) == n  # dense window: every row
+    g = np.asarray(ds.query("t", "INCLUDE").batch.columns["geom"])
+    want1 = np.nonzero(
+        (g[:, 0] >= 0) & (g[:, 0] <= 0.5) & (g[:, 1] >= 0) & (g[:, 1] <= 0.5)
+    )[0]
+    got1 = np.sort(rows[wins == 1])
+    assert set(want1.tolist()) <= set(got1.tolist())

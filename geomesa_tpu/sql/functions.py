@@ -1378,12 +1378,125 @@ def _merc_inv(xy: np.ndarray) -> np.ndarray:
     return np.stack([lon, lat], axis=1)
 
 
+# -- WGS84 UTM (transverse Mercator, Krueger series; ref GeoTools reaches
+# these through PROJ — here they are the exact flattening-series forms
+# (Karney 2011), accurate to sub-mm inside a zone) ---------------------------
+
+_UTM_K0 = 0.9996
+_UTM_FE = 500_000.0
+_UTM_FN_SOUTH = 10_000_000.0
+_TM_N = _WGS84_F / (2.0 - _WGS84_F)
+
+
+def _tm_consts():
+    n = _TM_N
+    n2, n3, n4, n5, n6 = n**2, n**3, n**4, n**5, n**6
+    A = _WGS84_A / (1 + n) * (1 + n2 / 4 + n4 / 64 + n6 / 256)
+    alpha = (
+        n / 2 - 2 * n2 / 3 + 5 * n3 / 16 + 41 * n4 / 180
+        - 127 * n5 / 288 + 7891 * n6 / 37800,
+        13 * n2 / 48 - 3 * n3 / 5 + 557 * n4 / 1440 + 281 * n5 / 630
+        - 1983433 * n6 / 1935360,
+        61 * n3 / 240 - 103 * n4 / 140 + 15061 * n5 / 26880
+        + 167603 * n6 / 181440,
+        49561 * n4 / 161280 - 179 * n5 / 168 + 6601661 * n6 / 7257600,
+        34729 * n5 / 80640 - 3418889 * n6 / 1995840,
+        212378941 * n6 / 319334400,
+    )
+    beta = (
+        n / 2 - 2 * n2 / 3 + 37 * n3 / 96 - n4 / 360 - 81 * n5 / 512
+        + 96199 * n6 / 604800,
+        n2 / 48 + n3 / 15 - 437 * n4 / 1440 + 46 * n5 / 105
+        - 1118711 * n6 / 3870720,
+        17 * n3 / 480 - 37 * n4 / 840 - 209 * n5 / 4480
+        + 5569 * n6 / 90720,
+        4397 * n4 / 161280 - 11 * n5 / 504 - 830251 * n6 / 7257600,
+        4583 * n5 / 161280 - 108847 * n6 / 3991680,
+        20648693 * n6 / 638668800,
+    )
+    return A, alpha, beta
+
+
+_TM_A, _TM_ALPHA, _TM_BETA = _tm_consts()
+_TM_E = np.sqrt(_WGS84_F * (2.0 - _WGS84_F))  # first eccentricity
+
+
+def _utm_fwd(xy: np.ndarray, zone: int, south: bool) -> np.ndarray:
+    lon0 = np.radians(zone * 6.0 - 183.0)
+    lam = np.radians(xy[:, 0]) - lon0
+    # wrap into (-pi, pi] so e.g. lon 179 vs zone 60 (177E) is a small
+    # negative offset, then enforce the series' validity domain: beyond
+    # ~+-45 deg from the central meridian the Krueger series diverges
+    # (arctanh blows up at 90 deg) — raise, never misproject silently
+    lam = np.mod(lam + np.pi, 2 * np.pi) - np.pi
+    if len(lam) and float(np.abs(lam).max()) > np.radians(45.0):
+        raise ValueError(
+            f"point(s) more than 45 deg of longitude from UTM zone "
+            f"{zone}'s central meridian: outside the projection's "
+            "validity domain"
+        )
+    phi = np.radians(xy[:, 1])
+    e = _TM_E
+    s = np.sin(phi)
+    t = np.sinh(np.arctanh(s) - e * np.arctanh(e * s))
+    xi = np.arctan2(t, np.cos(lam))
+    eta = np.arctanh(np.sin(lam) / np.sqrt(1 + t * t))
+    x, y = eta.copy(), xi.copy()
+    for j, a in enumerate(_TM_ALPHA, start=1):
+        y += a * np.sin(2 * j * xi) * np.cosh(2 * j * eta)
+        x += a * np.cos(2 * j * xi) * np.sinh(2 * j * eta)
+    E = _UTM_FE + _UTM_K0 * _TM_A * x
+    N = (_UTM_FN_SOUTH if south else 0.0) + _UTM_K0 * _TM_A * y
+    return np.stack([E, N], axis=1)
+
+
+def _utm_inv(xy: np.ndarray, zone: int, south: bool) -> np.ndarray:
+    lon0 = np.radians(zone * 6.0 - 183.0)
+    xi = (xy[:, 1] - (_UTM_FN_SOUTH if south else 0.0)) / (
+        _UTM_K0 * _TM_A
+    )
+    eta = (xy[:, 0] - _UTM_FE) / (_UTM_K0 * _TM_A)
+    xi_p, eta_p = xi.copy(), eta.copy()
+    for j, b in enumerate(_TM_BETA, start=1):
+        xi_p -= b * np.sin(2 * j * xi) * np.cosh(2 * j * eta)
+        eta_p -= b * np.cos(2 * j * xi) * np.sinh(2 * j * eta)
+    sh, c = np.sinh(eta_p), np.cos(xi_p)
+    lam = np.arctan2(sh, c)
+    tau_p = np.sin(xi_p) / np.sqrt(sh * sh + c * c)
+    # invert the conformal-latitude relation by Newton on tau = tan(phi)
+    # (Karney's method; 3 iterations reach float64 round-off)
+    e = _TM_E
+    tau = tau_p / (1.0 - e * e)
+    for _ in range(3):
+        sig = np.sinh(
+            e * np.arctanh(e * tau / np.sqrt(1 + tau * tau))
+        )
+        f_tau = (
+            tau * np.sqrt(1 + sig * sig)
+            - sig * np.sqrt(1 + tau * tau)
+            - tau_p
+        )
+        d_tau = (
+            np.sqrt((1 + sig * sig) * (1 + tau * tau))
+            - sig * tau
+        ) * (1 - e * e) / (1 + (1 - e * e) * tau * tau) * np.sqrt(
+            1 + tau * tau
+        )
+        tau = tau - f_tau / d_tau
+    phi = np.arctan(tau)
+    return np.stack(
+        [np.degrees(lam + lon0), np.degrees(phi)], axis=1
+    )
+
+
 def st_transform(geom, from_crs: str, to_crs: str):
-    """Reproject between EPSG:4326 (lon/lat degrees) and EPSG:3857
-    (spherical web mercator meters) — the pair every tiled map client
-    uses. Other CRS pairs raise (this framework indexes in 4326; full
-    PROJ-style pipelines are out of scope). Latitudes clamp to the
-    mercator domain (±85.05113°), matching the tiling convention."""
+    """Reproject between EPSG:4326 (lon/lat degrees), EPSG:3857
+    (spherical web mercator meters — every tiled map client), and the
+    WGS84 UTM zones (EPSG:326xx north / 327xx south, exact Krueger
+    flattening series). Other CRS raise loudly (this framework indexes
+    in 4326; full PROJ-style pipelines are out of scope). Mercator
+    latitudes clamp to the tiling domain (±85.05113°); pairs that
+    involve both 3857 and UTM compose through 4326."""
 
     def norm(c):
         c = str(c).upper().replace("EPSG:", "")
@@ -1391,12 +1504,38 @@ def st_transform(geom, from_crs: str, to_crs: str):
             return "4326"
         if c in ("3857", "900913", "102100"):
             return "3857"
-        raise ValueError(f"unsupported CRS {c!r} (4326 <-> 3857 only)")
+        if len(c) == 5 and c[:3] in ("326", "327") and c[3:].isdigit():
+            zone = int(c[3:])
+            if 1 <= zone <= 60:
+                return c
+        raise ValueError(
+            f"unsupported CRS {c!r} (4326, 3857, UTM 326xx/327xx only)"
+        )
 
     f, t = norm(from_crs), norm(to_crs)
     if f == t:
         return geom
-    fn = _merc_fwd if (f, t) == ("4326", "3857") else _merc_inv
+
+    def step(code, forward):
+        """4326 -> code when forward else code -> 4326."""
+        if code == "3857":
+            return _merc_fwd if forward else _merc_inv
+        zone, south = int(code[3:]), code[:3] == "327"
+        if forward:
+            return lambda xy: _utm_fwd(xy, zone, south)
+        return lambda xy: _utm_inv(xy, zone, south)
+
+    chain = []
+    if f != "4326":
+        chain.append(step(f, forward=False))
+    if t != "4326":
+        chain.append(step(t, forward=True))
+
+    def fn(xy):
+        for s in chain:
+            xy = s(xy)
+        return xy
+
     if _is_point_col(geom):
         return fn(np.asarray(geom, np.float64))
 

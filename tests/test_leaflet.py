@@ -94,10 +94,11 @@ def test_transform_known_values_and_roundtrip():
         st_transform(col, "4326", "3857"), "EPSG:3857", "EPSG:4326"
     )
     np.testing.assert_allclose(back, col, atol=1e-9)
-    # same-CRS short circuit and unsupported pair
+    # same-CRS short circuit and unsupported pair (UTM 32633 is
+    # supported since round 5; Lambert-93 is not)
     assert st_transform(col, "4326", "CRS84") is col
     with pytest.raises(ValueError, match="unsupported CRS"):
-        st_transform(col, "4326", "32633")
+        st_transform(col, "4326", "2154")
     # latitude clamps to the mercator domain
     pole = st_transform(Point(0.0, 90.0), "4326", "3857")
     assert pole.y == pytest.approx(20037508.34, rel=1e-4)
@@ -169,3 +170,59 @@ def test_embedded_json_escapes_comment_open_as_valid_json():
 def test_popup_rows_escaped_in_js():
     html = leaflet_map(features=_batch(1))
     assert "var esc = function" in html  # popup values routed through esc()
+
+
+# -- UTM transforms (Krueger series; live with the other CRS tests) ----------
+
+
+def test_utm_central_meridian_and_zone_edge():
+    from geomesa_tpu.sql.functions import st_transform
+
+    # a point ON zone 31N's central meridian (3E) at the equator maps to
+    # the false easting exactly, northing 0
+    p = st_transform(np.array([[3.0, 0.0]]), "EPSG:4326", "EPSG:32631")
+    assert abs(p[0, 0] - 500_000.0) < 1e-6 and abs(p[0, 1]) < 1e-6
+    # the classic zone-31N example: (0E, 0N) -> E 166021.443 (published)
+    p = st_transform(np.array([[0.0, 0.0]]), "4326", "32631")
+    assert p[0, 0] == pytest.approx(166_021.443, abs=0.01)
+    assert abs(p[0, 1]) < 1e-6
+    # meridian arc scale: 1 deg of latitude on the central meridian is
+    # the WGS84 arc (110574.4m) times k0
+    b = st_transform(np.array([[3.0, 1.0]]), "4326", "32631")
+    assert b[0, 1] == pytest.approx(110_574.4 * 0.9996, abs=5.0)
+    # far outside the zone: raise, never silently misproject
+    with pytest.raises(ValueError, match="validity domain"):
+        st_transform(np.array([[93.0, 0.0]]), "4326", "32631")
+
+
+def test_utm_roundtrip_and_south():
+    from geomesa_tpu.sql.functions import st_transform
+
+    rng = np.random.default_rng(0)
+    for zone, south in ((31, False), (15, False), (34, True), (60, True)):
+        lon0 = zone * 6 - 183
+        lat = (
+            rng.uniform(-79, -1, 500) if south else rng.uniform(1, 83, 500)
+        )
+        pts = np.stack(
+            [rng.uniform(lon0 - 2.9, lon0 + 2.9, 500), lat], axis=1
+        )
+        code = f"{'327' if south else '326'}{zone:02d}"
+        out = st_transform(pts, "4326", code)
+        if south:
+            assert np.all(out[:, 1] < 10_000_000) and np.all(out[:, 1] > 0)
+        back = st_transform(out, code, "4326")
+        assert np.abs(back - pts).max() < 1e-9
+
+
+def test_utm_composes_with_web_mercator_and_rejects_unknown():
+    from geomesa_tpu.sql.functions import st_transform
+
+    # 3 degrees of longitude in 3857 metres at the equator
+    x3857 = 6_378_137.0 * np.radians(3.0)
+    p = st_transform(np.array([[x3857, 0.0]]), "3857", "32631")
+    assert p[0, 0] == pytest.approx(500_000.0, abs=0.01)
+    with pytest.raises(ValueError):
+        st_transform(np.array([[0.0, 0.0]]), "4326", "2154")  # Lambert-93
+    with pytest.raises(ValueError):
+        st_transform(np.array([[0.0, 0.0]]), "4326", "32661")  # UPS: no

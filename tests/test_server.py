@@ -531,11 +531,13 @@ def test_device_index_warmup_legs():
     out = di.warmup()
     assert {"knn", "density", "stats", "mask", "window_union"} <= set(out)
     assert all(v is not None for v in out.values()), out
-    # warmed: a real request compiles nothing (sub-50ms on the CPU mesh)
+    # warmed: a real request compiles nothing. The bound distinguishes
+    # "no compile" (~10ms on the CPU mesh) from "compiled here"
+    # (seconds) with slack for a loaded CI box — NOT a latency SLO.
     import time as _t
     t = _t.perf_counter()
     di.knn(0.0, 0.0, 5)
-    assert (_t.perf_counter() - t) < 0.5
+    assert (_t.perf_counter() - t) < 2.0
 
 
 def test_device_index_warmup_non_point_schema():

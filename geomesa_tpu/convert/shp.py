@@ -141,6 +141,225 @@ def read_dbf(data: bytes) -> "tuple[list, list[list]]":
     return [f[0] for f in fields], rows
 
 
+# -- writer (export side; ref geomesa-tools ExportCommand's shp format) ------
+
+
+def _close_ring(r: np.ndarray) -> np.ndarray:
+    r = np.asarray(r, np.float64)
+    if not np.array_equal(r[0], r[-1]):
+        r = np.concatenate([r, r[:1]])
+    return r
+
+
+def _oriented(r: np.ndarray, cw: bool) -> np.ndarray:
+    return r if _ring_is_cw(r) == cw else r[::-1]
+
+
+def _poly_record(shape_type: int, rings: list) -> bytes:
+    pts = np.concatenate(rings)
+    parts = np.cumsum([0] + [len(r) for r in rings[:-1]]).astype("<i4")
+    head = struct.pack(
+        "<i4dii",
+        shape_type,
+        float(pts[:, 0].min()), float(pts[:, 1].min()),
+        float(pts[:, 0].max()), float(pts[:, 1].max()),
+        len(rings), len(pts),
+    )
+    return head + parts.tobytes() + pts.astype("<f8").tobytes()
+
+
+def _geom_record(g) -> bytes:
+    if g is None:
+        return struct.pack("<i", 0)
+    if isinstance(g, Point):
+        return struct.pack("<idd", 1, g.x, g.y)
+    if isinstance(g, MultiPoint):
+        pts = np.array([[p.x, p.y] for p in g.points], np.float64)
+        return struct.pack(
+            "<i4di",
+            8,
+            float(pts[:, 0].min()), float(pts[:, 1].min()),
+            float(pts[:, 0].max()), float(pts[:, 1].max()),
+            len(pts),
+        ) + pts.astype("<f8").tobytes()
+    if isinstance(g, LineString):
+        return _poly_record(3, [np.asarray(g.coords, np.float64)])
+    if isinstance(g, MultiLineString):
+        return _poly_record(
+            3, [np.asarray(l.coords, np.float64) for l in g.lines]
+        )
+    if isinstance(g, (Polygon, MultiPolygon)):
+        polys = g.polygons if isinstance(g, MultiPolygon) else (g,)
+        rings = []
+        for p in polys:
+            # shapefile convention: shells CLOCKWISE, holes CCW
+            rings.append(_oriented(_close_ring(p.shell), cw=True))
+            for h in p.holes:
+                rings.append(_oriented(_close_ring(h), cw=False))
+        return _poly_record(5, rings)
+    raise ValueError(f"cannot write {type(g).__name__} to a shapefile")
+
+
+def _dbf_fields(sft):
+    """[(name10, type, length, decimals, attr)] for the non-geometry
+    attributes (dbf field names cap at 10 chars; collisions raise)."""
+    out = []
+    seen = set()
+    for a in sft.attributes:
+        if a.is_geometry:
+            continue
+        name = a.name[:10]
+        if name in seen:
+            raise ValueError(
+                f"dbf field name collision after 10-char truncation: {name!r}"
+            )
+        seen.add(name)
+        if a.type_name == "Date":
+            out.append((name, "D", 8, 0, a.name))
+        elif a.type_name in ("Integer", "Int", "Long"):
+            out.append((name, "N", 18, 0, a.name))
+        elif a.type_name in ("Float", "Double"):
+            out.append((name, "N", 18, 6, a.name))
+        elif a.type_name == "Boolean":
+            out.append((name, "L", 1, 0, a.name))
+        else:
+            out.append((name, "C", 254, 0, a.name))
+    return out
+
+
+def write_shp(batch) -> "tuple[bytes, bytes, bytes]":
+    """FeatureBatch -> (.shp, .shx, .dbf) bytes — the write side of this
+    converter (the reference exports shapefiles through GeoTools; here
+    the three sibling files are emitted directly and round-trip through
+    :func:`read_shp` / :func:`read_dbf`)."""
+    geom = batch.sft.geom_field
+    col = batch.columns[geom] if geom else None
+    records = []
+    shape_type = None  # resolved from the first non-null geometry
+    for i in range(len(batch)):
+        if col is None:
+            g = None
+        elif col.dtype != object:
+            g = Point(float(col[i, 0]), float(col[i, 1]))
+        else:
+            g = col[i]
+        rec = _geom_record(g)
+        st = struct.unpack_from("<i", rec, 0)[0]
+        if st:
+            if shape_type is not None and shape_type != st:
+                raise ValueError(
+                    "a shapefile holds ONE shape type; batch mixes "
+                    f"types {shape_type} and {st}"
+                )
+            shape_type = st
+        records.append(rec)
+    if shape_type is None:
+        shape_type = 1  # all-null batch: header still needs a type
+
+    # .shp + .shx (chunk lists + join: bytes += is quadratic in records)
+    body_parts: list = []
+    shx_parts: list = []
+    offset_words = 50  # header = 100 bytes
+    for idx, rec in enumerate(records, start=1):
+        clen = len(rec) // 2
+        body_parts.append(struct.pack(">ii", idx, clen))
+        body_parts.append(rec)
+        shx_parts.append(struct.pack(">ii", offset_words, clen))
+        offset_words += 4 + clen
+    body = b"".join(body_parts)
+    shx_body = b"".join(shx_parts)
+
+    bbox = (0.0, 0.0, 0.0, 0.0)
+    if col is not None and len(batch):
+        if col.dtype != object:
+            xs, ys = col[:, 0], col[:, 1]
+            bbox = (
+                float(xs.min()), float(ys.min()),
+                float(xs.max()), float(ys.max()),
+            )
+        else:
+            # per-geometry envelopes, skipping null shapes (which the
+            # record loop above writes as type-0 records)
+            envs = [g.envelope for g in col if g is not None]
+            if envs:
+                bbox = (
+                    min(e.xmin for e in envs), min(e.ymin for e in envs),
+                    max(e.xmax for e in envs), max(e.ymax for e in envs),
+                )
+
+    def header(total_bytes: int) -> bytes:
+        return (
+            struct.pack(">i5i", 9994, 0, 0, 0, 0, 0)
+            + struct.pack(">i", total_bytes // 2)
+            + struct.pack("<ii", 1000, shape_type)
+            + struct.pack("<4d", *bbox)
+            + struct.pack("<4d", 0.0, 0.0, 0.0, 0.0)  # z/m ranges
+        )
+
+    shp = header(100 + len(body)) + body
+    shx = header(100 + len(shx_body)) + shx_body
+
+    # .dbf
+    fields = _dbf_fields(batch.sft)
+    record_size = 1 + sum(f[2] for f in fields)
+    header_size = 32 + 32 * len(fields) + 1
+    dbf = bytearray()
+    dbf += struct.pack(
+        "<4BiHH20x", 0x03, 26, 7, 1, len(batch), header_size, record_size
+    )
+    for name, ftype, length, decimals, _ in fields:
+        dbf += struct.pack(
+            "<11sc4xBB14x", name.encode("ascii"), ftype.encode("ascii"),
+            length, decimals,
+        )
+    dbf += b"\x0d"
+    for i in range(len(batch)):
+        dbf += b" "
+        for name, ftype, length, decimals, attr in fields:
+            v = batch.columns[attr][i]
+            v = v.item() if hasattr(v, "item") else v
+            if ftype == "D":
+                s = (
+                    str(np.datetime64(int(v), "ms").astype("datetime64[D]"))
+                    .replace("-", "")
+                    if v is not None
+                    else ""
+                )
+            elif ftype == "N":
+                if v is None or (isinstance(v, float) and np.isnan(v)):
+                    s = ""
+                elif decimals:
+                    s = f"{float(v):.{decimals}f}"
+                else:
+                    s = str(int(v))
+                if len(s) > length:
+                    # right-truncation would silently drop trailing
+                    # DIGITS (1e18 -> 1e17): refuse instead
+                    raise ValueError(
+                        f"value {v!r} of field {name!r} does not fit the "
+                        f"dbf numeric width ({length} chars)"
+                    )
+                s = s.rjust(length)
+            elif ftype == "L":
+                s = "T" if v else "F"
+            else:
+                s = "" if v is None else str(v)
+            raw = s.encode("latin-1", "replace")[:length].ljust(length)
+            dbf += raw
+    dbf += b"\x1a"
+    return shp, shx, bytes(dbf)
+
+
+def write_shapefile(batch, path: str) -> None:
+    """Write ``batch`` as the shapefile triplet next to ``path`` (given
+    ``x.shp``, also writes ``x.shx`` and ``x.dbf``)."""
+    base = os.path.splitext(os.fspath(path))[0]
+    shp, shx, dbf = write_shp(batch)
+    for ext, data in ((".shp", shp), (".shx", shx), (".dbf", dbf)):
+        with open(base + ext, "wb") as fh:
+            fh.write(data)
+
+
 class ShapefileConverter:
     binary = True  # CLI opens input files in 'rb' mode
 

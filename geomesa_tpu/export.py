@@ -5,7 +5,7 @@ place."""
 
 from __future__ import annotations
 
-BINARY_FORMATS = ("arrow", "parquet", "orc", "avro", "bin")
+BINARY_FORMATS = ("arrow", "parquet", "orc", "avro", "bin", "shp")
 
 
 def feature_collection(batch) -> dict:
@@ -79,6 +79,10 @@ def write_batch(batch, path: str, fmt: str, track_attr: "str | None" = None):
             raise ValueError("bin export requires a track attribute")
         with open(path, "wb") as fh:
             fh.write(encode_bin(batch, track_attr, sort=True))
+    elif fmt == "shp":
+        from geomesa_tpu.convert.shp import write_shapefile
+
+        write_shapefile(batch, path)  # writes the .shp/.shx/.dbf triplet
     else:
         raise ValueError(f"unknown export format {fmt!r}")
 

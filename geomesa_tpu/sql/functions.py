@@ -1484,9 +1484,11 @@ def _utm_inv(xy: np.ndarray, zone: int, south: bool) -> np.ndarray:
         )
         tau = tau - f_tau / d_tau
     phi = np.arctan(tau)
-    return np.stack(
-        [np.degrees(lam + lon0), np.degrees(phi)], axis=1
-    )
+    # wrap into (-180, 180]: a zone near the antimeridian otherwise
+    # returns e.g. lon 185 and breaks the 4326 roundtrip
+    lon = np.degrees(lam + lon0)
+    lon = np.mod(lon + 180.0, 360.0) - 180.0
+    return np.stack([lon, np.degrees(phi)], axis=1)
 
 
 def st_transform(geom, from_crs: str, to_crs: str):

@@ -548,7 +548,7 @@ def main(argv=None) -> None:
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-q", "--cql")
     sp.add_argument("-F", "--format", default="csv",
-                    choices=["csv", "json", "arrow", "parquet", "orc", "bin", "avro", "leaflet"])
+                    choices=["csv", "json", "arrow", "parquet", "orc", "bin", "avro", "shp", "leaflet"])
     sp.add_argument("-o", "--output", default="-")
     sp.add_argument("-m", "--max-features", type=int)
     sp.add_argument("-a", "--attributes", help="comma-separated projection")

@@ -1,0 +1,138 @@
+"""Shapefile WRITER (convert/shp.py write_shp): roundtrip through the
+reader, dbf typing, ring orientation, export dispatch."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.convert.shp import (
+    ShapefileConverter,
+    read_dbf,
+    read_shp,
+    write_shapefile,
+    write_shp,
+)
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.sft import SimpleFeatureType
+from geomesa_tpu.geom import MultiPolygon, Point, Polygon
+
+
+def _point_batch(n=25):
+    sft = SimpleFeatureType.create(
+        "pts", "name:String,val:Int,score:Double,flag:Boolean,"
+        "dtg:Date,*geom:Point:srid=4326"
+    )
+    rng = np.random.default_rng(4)
+    return FeatureBatch.from_columns(sft, {
+        "name": [f"n{i}" for i in range(n)],
+        "val": rng.integers(-50, 50, n),
+        "score": rng.uniform(-5, 5, n),
+        "flag": rng.integers(0, 2, n).astype(bool),
+        "dtg": np.full(n, 1_577_836_800_000 + 86_400_000),
+        "geom": np.stack(
+            [rng.uniform(-170, 170, n), rng.uniform(-80, 80, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+
+
+def test_point_roundtrip_with_attributes():
+    b = _point_batch()
+    shp, shx, dbf = write_shp(b)
+    geoms = read_shp(shp)
+    assert len(geoms) == len(b)
+    for i, g in enumerate(geoms):
+        assert isinstance(g, Point)
+        assert g.x == pytest.approx(float(b.columns["geom"][i, 0]))
+        assert g.y == pytest.approx(float(b.columns["geom"][i, 1]))
+    names, rows = read_dbf(dbf)
+    assert names == ["name", "val", "score", "flag", "dtg"]
+    for i, row in enumerate(rows):
+        assert row[0] == f"n{i}"
+        assert row[1] == int(b.columns["val"][i])
+        assert row[2] == pytest.approx(float(b.columns["score"][i]), abs=1e-6)
+        assert row[3] == bool(b.columns["flag"][i])
+        assert row[4] == 1_577_836_800_000 + 86_400_000  # date roundtrip (day)
+    # .shx: one 8-byte entry per record after the 100-byte header
+    assert len(shx) == 100 + 8 * len(b)
+
+
+def test_polygon_with_holes_roundtrip(tmp_path):
+    sft = SimpleFeatureType.create("z", "name:String,*geom:Polygon:srid=4326")
+    outer = np.array(
+        [[0.0, 0.0], [10.0, 0.0], [10.0, 10.0], [0.0, 10.0], [0.0, 0.0]]
+    )
+    hole = np.array(
+        [[4.0, 4.0], [6.0, 4.0], [6.0, 6.0], [4.0, 6.0], [4.0, 4.0]]
+    )
+    mp = MultiPolygon((
+        Polygon(outer, (hole,)),
+        Polygon(outer + 20.0),
+    ))
+    b = FeatureBatch.from_columns(sft, {
+        "name": ["a", "b"],
+        "geom": np.array([Polygon(outer, (hole,)), mp], dtype=object),
+    }, fids=np.arange(2))
+    write_shapefile(b, str(tmp_path / "zones.shp"))
+    conv = ShapefileConverter({}, sft)
+    back = conv.process(str(tmp_path / "zones.shp")).batch
+    g0 = back.columns["geom"][0]
+    assert isinstance(g0, Polygon) and len(g0.holes) == 1
+    g1 = back.columns["geom"][1]
+    assert isinstance(g1, MultiPolygon) and len(g1.polygons) == 2
+    # area is orientation-independent: hole subtracts
+    from geomesa_tpu.sql.functions import st_area
+
+    assert st_area(g0) == pytest.approx(100.0 - 4.0)
+    assert st_area(g1) == pytest.approx(100.0 - 4.0 + 100.0)
+
+
+def test_export_dispatch_and_cli_choice(tmp_path):
+    from geomesa_tpu.export import write_batch
+
+    b = _point_batch(5)
+    write_batch(b, str(tmp_path / "out.shp"), "shp")
+    for ext in (".shp", ".shx", ".dbf"):
+        assert (tmp_path / f"out{ext}").exists()
+
+
+def test_mixed_shape_types_refused():
+    sft = SimpleFeatureType.create("m", "*geom:Geometry:srid=4326")
+    b = FeatureBatch.from_columns(sft, {
+        "geom": np.array([
+            Point(0.0, 0.0),
+            Polygon(np.array(
+                [[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 0.0]]
+            )),
+        ], dtype=object),
+    }, fids=np.arange(2))
+    with pytest.raises(ValueError, match="ONE shape type"):
+        write_shp(b)
+
+
+def test_null_geometry_and_numeric_overflow():
+    sft = SimpleFeatureType.create("n", "big:Long,*geom:Polygon:srid=4326")
+    tri = Polygon(np.array([[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 0.0]]))
+    b = FeatureBatch.from_columns(sft, {
+        "big": np.array([1, 2], np.int64),
+        "geom": np.array([tri, None], dtype=object),
+    }, fids=np.arange(2))
+    shp, _, _ = write_shp(b)  # null shape writes, bbox skips it
+    geoms = read_shp(shp)
+    assert isinstance(geoms[0], Polygon) and geoms[1] is None
+    # a Long too wide for dbf N(18) refuses instead of silently
+    # truncating trailing digits
+    b2 = FeatureBatch.from_columns(sft, {
+        "big": np.array([10**18, 1], np.int64),
+        "geom": np.array([tri, tri], dtype=object),
+    }, fids=np.arange(2))
+    with pytest.raises(ValueError, match="does not fit"):
+        write_shp(b2)
+
+
+def test_utm_antimeridian_roundtrip():
+    from geomesa_tpu.sql.functions import st_transform
+
+    pts = np.array([[-175.0, 10.0], [179.9, -20.0]])
+    out = st_transform(pts, "4326", "32660")  # zone 60: CM 177E
+    back = st_transform(out, "32660", "4326")
+    np.testing.assert_allclose(back, pts, atol=1e-9)
+    assert np.all(back[:, 0] <= 180) and np.all(back[:, 0] > -180)

@@ -1051,10 +1051,13 @@ def bench_oocscan(args) -> dict:
     from geomesa_tpu.store.oocscan import SlabStream
 
     platform = jax.devices()[0].platform
-    # default 2^27 (2.1GiB through a 0.27GiB slab window): demonstrates
-    # the mechanism at 8x slab capacity while keeping the leg's wall
-    # time bounded when the tunnel throttle (above) is in effect
-    n_total = args.n or ((1 << 27) if platform == "tpu" else (1 << 22))
+    # default 2^26 (1.1GiB through a 0.27GiB slab window): demonstrates
+    # the mechanism at 4x slab capacity while keeping the leg's wall
+    # time bounded when the tunnel throttle (above) is in effect — a
+    # full all-mode run measured the throttle at 3-22MB/s even in a
+    # fresh subprocess, so GiBs here cost many minutes for no extra
+    # information
+    n_total = args.n or ((1 << 26) if platform == "tpu" else (1 << 22))
     slab = (1 << 24) if platform == "tpu" else (1 << 18)
     slab = min(slab, n_total)
     n_slabs = (n_total + slab - 1) // slab

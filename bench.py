@@ -1265,16 +1265,25 @@ def bench_pipeline(args) -> dict:
         # kernel family — what `serve --resident --warm` runs before
         # accepting traffic; through the tunnel the first EXECUTION of a
         # kernel pays the server-side Mosaic/XLA compile regardless of
-        # the client's persistent cache, so a serving system must warm)
-        t = time.perf_counter()
-        di.warmup()
-        out["pipeline_kernel_warmup_s"] = round(time.perf_counter() - t, 2)
-        # ...then the first REAL request on the warmed server...
-        t = time.perf_counter()
-        hits = di.count(ecql, loose=True)
-        out["pipeline_first_query_ms"] = round(
-            (time.perf_counter() - t) * 1e3, 1
-        )
+        # the client's persistent cache, so a serving system must warm).
+        # The cold-start story (kernel_warmup + first_query) is told at
+        # the standard 2^22 size only: per-SHAPE server compiles are
+        # n-independent theater (~10min for the full family at 2^25),
+        # so scaled legs warm their one query kernel untimed and report
+        # the serving rates.
+        if n <= (1 << 22):
+            t = time.perf_counter()
+            di.warmup()
+            out["pipeline_kernel_warmup_s"] = round(
+                time.perf_counter() - t, 2
+            )
+            t = time.perf_counter()
+            hits = di.count(ecql, loose=True)
+            out["pipeline_first_query_ms"] = round(
+                (time.perf_counter() - t) * 1e3, 1
+            )
+        else:
+            hits = di.count(ecql, loose=True)  # untimed shape warm
         # ...and the served repeated query (median of 5)
         reps = []
         for _ in range(5):
@@ -1297,7 +1306,8 @@ def bench_pipeline(args) -> dict:
             "first=%.0fms repeat=%.0fms"
             % (out["pipeline_gen_s"], out["pipeline_ingest_s"],
                out["pipeline_flush_s"], out["pipeline_stage_s"],
-               out["pipeline_first_query_ms"], out["pipeline_query_ms"])
+               out.get("pipeline_first_query_ms", float("nan")),
+               out["pipeline_query_ms"])
         )
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

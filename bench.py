@@ -1541,6 +1541,8 @@ def main() -> None:
         out["build_pts_per_sec"] = build["value"]
         out["build_chain"] = build["build_chain"]
         out["build_n"] = build["build_n"]
+        if "build_breakdown" in build:
+            out["build_breakdown"] = build["build_breakdown"]
         # BASELINE config #5: non-point (XZ3) build on device
         xzb = bench_xz_build(args)
         out["xz_build_envelopes_per_sec"] = xzb["value"]

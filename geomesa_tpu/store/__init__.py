@@ -8,16 +8,22 @@
               pluggable sorted-KV engines (ref: geomesa-accumulo /
               geomesa-hbase / geomesa-redis / geomesa-cassandra /
               geomesa-bigtable adapters)
+- ``oocscan``: out-of-core streamed device scan over a partitioned
+              store (datasets larger than HBM; ref: Accumulo iterators
+              stream tablets)
 """
 
 from geomesa_tpu.store.fs import FileSystemDataStore
 from geomesa_tpu.store.kv import KVDataStore, MemoryKV, SqliteKV
 from geomesa_tpu.store.memory import MemoryDataStore
+from geomesa_tpu.store.oocscan import SlabStream, StreamedDeviceScan
 
 __all__ = [
     "FileSystemDataStore",
     "KVDataStore",
     "MemoryKV",
     "MemoryDataStore",
+    "SlabStream",
     "SqliteKV",
+    "StreamedDeviceScan",
 ]

@@ -1314,6 +1314,150 @@ def bench_pipeline(args) -> dict:
     return out
 
 
+def bench_serving(args) -> dict:
+    """Concurrent-serving leg (the device query scheduler): M client
+    threads fire loose bbox counts at ``serve_background(resident=True,
+    sched=...)`` with ONE in-flight device worker, so compatible queries
+    pile into the admission queue and the micro-batcher executes them as
+    shared stacked launches. Records throughput, p50/p99 latency and the
+    fusion factor (queries per device launch > 1 is the win; 1.0 means
+    the scheduler degraded to serial) — the scheduler regression signal
+    in the BENCH_* trajectory. Every response is checked against the
+    warmup (serially-executed) count for the same window, and --check
+    additionally compares against the unscheduled DeviceIndex oracle."""
+    import threading
+    import urllib.request
+    from urllib.parse import quote
+
+    import jax
+    import numpy as np
+
+    from geomesa_tpu.filter.ecql import parse_instant
+    from geomesa_tpu.sched import SchedConfig
+    from geomesa_tpu.server import serve_background
+    from geomesa_tpu.store.memory import MemoryDataStore
+
+    platform = jax.devices()[0].platform
+    n = args.n or ((1 << 22) if platform == "tpu" else (1 << 16))
+    n_threads, reqs_per = 8, 24
+    log(f"platform={platform} n={n:,} serving: {n_threads} threads x "
+        f"{reqs_per} loose bbox counts, 1 device worker")
+    ds = MemoryDataStore()
+    ds.create_schema("gdelt", "name:String,dtg:Date,*geom:Point:srid=4326")
+    rng = np.random.default_rng(7)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write("gdelt", {
+        "name": rng.choice(["a", "b"], n),
+        "dtg": t0 + rng.integers(0, 10**8, n),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+    server, _ = serve_background(
+        ds, resident=True,
+        sched=SchedConfig(
+            max_inflight=1, fusion_window_ms=5.0, max_queue=1024,
+            default_deadline_ms=None,  # slow platforms must not 504
+        ),
+    )
+    host, port = server.server_address[:2]
+    # four distinct city/continent windows, all bbox-only (same full-
+    # range time decomposition => one fused R bucket)
+    windows = [
+        (-10.0, 35.0, 30.0, 60.0),
+        (-75.0, 38.0, -72.0, 42.0),
+        (100.0, -10.0, 140.0, 25.0),
+        (-60.0, -35.0, -40.0, -10.0),
+    ]
+    urls = [
+        f"http://{host}:{port}/count/gdelt"
+        f"?cql={quote(f'BBOX(geom, {w[0]}, {w[1]}, {w[2]}, {w[3]})')}"
+        "&loose=1"
+        for w in windows
+    ]
+
+    def get_count(u):
+        with urllib.request.urlopen(u, timeout=600) as r:
+            return json.loads(r.read())["count"]
+
+    # warmup: stage + compile, and capture the serially-executed counts
+    # (single requests fuse nothing) as the per-window parity oracle
+    expect = [get_count(u) for u in urls]
+    if args.check:
+        di = server.RequestHandlerClass._resident_cache["gdelt"]
+        for w, e in zip(windows, expect):
+            cql = f"BBOX(geom, {w[0]}, {w[1]}, {w[2]}, {w[3]})"
+            assert di.count(cql, loose=True) == e, (w, e)
+        log("serving counts verified against the unscheduled oracle")
+    s0 = server.scheduler.snapshot()
+    lats: list = []
+    bad: list = []
+    lock = threading.Lock()
+
+    import urllib.error
+
+    def worker(tid: int):
+        for i in range(reqs_per):
+            j = (tid + i) % len(urls)
+            t = time.perf_counter()
+            try:
+                c = get_count(urls[j])
+            except urllib.error.HTTPError as e:
+                with lock:  # shed/expired requests must not kill the thread
+                    bad.append((j, f"HTTP {e.code}", expect[j]))
+                continue
+            dt = time.perf_counter() - t
+            with lock:
+                lats.append(dt)
+                if c != expect[j]:
+                    bad.append((j, c, expect[j]))
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(n_threads)
+    ]
+    t = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t
+    s1 = server.scheduler.snapshot()
+    server.shutdown()
+    # stop the worker threads too: their cv poll would perturb the
+    # timing-sensitive legs that follow in all-mode
+    server.scheduler.shutdown(timeout=2.0)
+    assert not bad, f"fused counts diverged from serial: {bad[:5]}"
+    assert lats, "every serving request failed"
+    queries = s1["queries"] - s0["queries"]
+    launches = s1["launches"] - s0["launches"]
+    lats.sort()
+    out = {
+        "serve_n": n,
+        "serve_threads": n_threads,
+        "serve_requests": len(lats),
+        "serve_qps": round(len(lats) / wall, 1),
+        "serve_p50_ms": round(lats[len(lats) // 2] * 1e3, 2),
+        "serve_p99_ms": round(
+            lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 2
+        ),
+        "serve_queries": queries,
+        "serve_launches": launches,
+        "serve_fusion_factor": (
+            round(queries / launches, 2) if launches else None
+        ),
+        "serve_rejected": s1["rejected"] - s0["rejected"],
+        "serve_expired": s1["expired"] - s0["expired"],
+    }
+    log(
+        "serving: %.0f req/s p50=%.1fms p99=%.1fms fusion=%.2f "
+        "(%d queries / %d launches)"
+        % (out["serve_qps"], out["serve_p50_ms"], out["serve_p99_ms"],
+           out["serve_fusion_factor"] or 1.0, queries, launches)
+    )
+    return out
+
+
 _MESHBUILD_SNIPPET = r"""
 from geomesa_tpu.jaxconf import force_cpu_devices
 force_cpu_devices(8)
@@ -1446,7 +1590,7 @@ def main() -> None:
         "--mode",
         choices=(
             "all", "filter", "zscan", "build", "polygon", "density", "sweep",
-            "xzbuild", "meshbuild", "pipeline", "oocscan", "join",
+            "xzbuild", "meshbuild", "pipeline", "oocscan", "join", "serve",
         ),
         default="all",
         help="all: every benchmark, one JSON line with everything (what "
@@ -1479,6 +1623,8 @@ def main() -> None:
         out = bench_oocscan(args)
     elif args.mode == "join":
         out = bench_join(args)
+    elif args.mode == "serve":
+        out = bench_serving(args)
     else:
         # zscan FIRST: its DeviceIndex staging is a long sequence of
         # host->device transfers that measures 20-30x slower when another
@@ -1552,6 +1698,10 @@ def main() -> None:
         out.update(bench_meshbuild(args))
         # spatial-join coarse pass (chained + device-compacted)
         out.update(bench_join(args))
+        # concurrent serving through the device query scheduler: the
+        # fusion factor (queries per launch) and tail latency under an
+        # 8-thread client load against one device worker
+        out.update(bench_serving(args))
         # BASELINE config #1 "via Parquet": the full ingest->query path.
         # Fresh subprocess: isolates the per-process tunnel throttle the
         # preceding legs' staging accumulated (_run_mode_subprocess)

@@ -23,6 +23,7 @@ is discovered automatically) or raw bytes for both.
 
 from __future__ import annotations
 
+import datetime
 import os
 import struct
 
@@ -304,8 +305,13 @@ def write_shp(batch) -> "tuple[bytes, bytes, bytes]":
     record_size = 1 + sum(f[2] for f in fields)
     header_size = 32 + 32 * len(fields) + 1
     dbf = bytearray()
+    # last-update date: dBASE packs the year as years-since-1900 (so a
+    # raw 26 would decode as 1926); derive YY/MM/DD from today, clamped
+    # to the byte range for dates past 2155
+    today = datetime.date.today()
     dbf += struct.pack(
-        "<4BiHH20x", 0x03, 26, 7, 1, len(batch), header_size, record_size
+        "<4BiHH20x", 0x03, min(today.year - 1900, 255), today.month,
+        today.day, len(batch), header_size, record_size,
     )
     for name, ftype, length, decimals, _ in fields:
         dbf += struct.pack(

@@ -77,7 +77,10 @@ def _floordiv_i64(a: np.ndarray, d: int) -> np.ndarray:
     outside that range (not epoch millis) take the exact slow path."""
     if a.ndim == 0 or len(a) < (1 << 16):
         return a // d  # small inputs: not worth the extra passes
-    if np.max(np.abs(a)) >= (1 << 52):
+    # signed bounds, NOT np.abs: INT64_MIN (the datetime64 NaT sentinel)
+    # overflows np.abs back to a negative value and would defeat the
+    # exactness guard, sending NaT-bearing arrays down the float path
+    if np.min(a) <= -(1 << 52) or np.max(a) >= (1 << 52):
         return a // d
     q = np.floor(a * (1.0 / d)).astype(np.int64)
     r = a - q * d

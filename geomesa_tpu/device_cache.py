@@ -261,6 +261,7 @@ class DeviceIndex:
         self._dim_encode_jit = None
         self._z_encode_failed = False
         self._loose_cache: dict = {}  # (repr(f), bin_range) -> bounds
+        self._fused_jits: dict = {}  # fusion-shape key -> jitted launch
         self._vis_vocab: "dict | None" = None  # label expr -> id
         self._vis_disabled = False  # vocabulary overflowed: public-only
         self._auth_tables: dict = {}  # sorted-auths tuple -> device table
@@ -1088,6 +1089,181 @@ class DeviceIndex:
             self._cols[Z_HI], self._cols[Z_LO], self._cols[Z_BIN],
             bounds, ids,
         )
+
+    # -- micro-batch scan fusion (device query scheduler) ------------------
+
+    def fused_loose_counts(self, queries, loose: "bool | None" = None):
+        """Answer Q compatible loose queries in ONE batched device
+        launch: each query's z-range set stacks along a leading query
+        axis (padded to power-of-two Q/B/R buckets so jit shapes stay
+        bounded) and a single vmapped zscan dispatch returns every count.
+        Results equal ``[count(q, loose=True) for q in queries]``
+        exactly. Returns None when the group cannot fuse — mixed scan
+        engines or R buckets, labeled rows staged (per-request auth
+        tables are per-query state), a filter the key planes cannot
+        answer, or loose mode off — and the caller falls back to serial
+        execution."""
+        out = self._fused_loose(queries, loose, want="count")
+        if out is None:
+            return None
+        return [int(v) for v in np.asarray(out)]
+
+    def fused_loose_query(self, queries, loose: "bool | None" = None):
+        """Batched sibling of :meth:`query`: one device launch computes
+        the (Q, n) hit matrix, then per-query host takes demux the rows.
+        Returns a list of FeatureBatch aligned with ``queries``, or None
+        when the group cannot fuse (see :meth:`fused_loose_counts`)."""
+        m = self._fused_loose(queries, loose, want="mask")
+        if m is None:
+            return None
+        m = np.asarray(m)[:, : self._staged_len()]
+        hv = self._host_valid()
+        if hv is not None:
+            m = m & hv[None, : m.shape[1]]
+        rows = self._host_rows()
+        return [rows.take(np.nonzero(r)[0]) for r in m]
+
+    def _fused_loose(self, queries, loose, want: str):
+        """(Q,) counts or (qcap, n) mask matrix for a fusable group, or
+        None. The count variant ANDs the device validity plane in-launch
+        (mirroring the serial count path); the mask variant leaves
+        validity to the host-side AND in fused_loose_query (mirroring
+        _loose_mask)."""
+        if not queries:
+            return None
+        if VIS_ID in (self._cols or {}):
+            return None
+        if not self._resolve_loose(loose) or self._staged_len() == 0:
+            return None
+        lbs = []
+        for q in queries:
+            lb = self._loose_bounds(self._parse(q))
+            if lb is None:
+                return None
+            lbs.append(lb)
+        n_dim = sum(1 for lb in lbs if len(lb) == 3 and lb[0] == "dim")
+        if n_dim and n_dim != len(lbs):
+            return None  # mixed engines: serial fallback
+        qcap = _next_pow2(len(lbs))
+        if n_dim:
+            return self._fused_dim(lbs, qcap, want)
+        return self._fused_compare(lbs, qcap, want)
+
+    def _fused_dim(self, lbs, qcap, want: str):
+        """Stacked dim-plane launch: per-query qarr vectors pad to the
+        group's largest R bucket with never-matching bt ranges (the
+        z3_dim_plane_qarr padding convention), queries pad to qcap with
+        fully inverted vectors."""
+        import jax
+        import jax.numpy as jnp
+
+        from geomesa_tpu.ops import zscan
+
+        rs = [lb[2] for lb in lbs]
+        r = max(rs)
+        if r and 0 in rs:
+            return None  # a z2 (no bt plane) query cannot join a z3 group
+        qmat = np.empty((qcap, 4 + 2 * r), np.uint32)
+        qmat[:] = np.array(
+            [1, 0, 1, 0] + [0xFFFFFFFF, 0] * r, np.uint32
+        )  # inverted: matches nothing
+        for i, lb in enumerate(lbs):
+            qa = np.asarray(lb[1])
+            qmat[i, : len(qa)] = qa
+        key = ("fdim", r, qcap, want)
+        fn = self._fused_jits.get(key)
+        if fn is None:
+            bm = zscan.batched_dim_mask_rt(r)
+
+            def _run(planes, qmat, valid, _bm=bm, _want=want):
+                m = _bm(*planes, qmat)
+                if _want == "count":
+                    if valid is not None:
+                        m = m & valid[None, :]
+                    return jnp.sum(m, axis=1, dtype=jnp.int32)
+                return m
+
+            fn = jax.jit(_run)
+            self._fused_jits[key] = fn
+        planes = (
+            (self._cols[Z_NX], self._cols[Z_NY])
+            if r == 0
+            else (self._cols[Z_NX], self._cols[Z_NY], self._cols[Z_BT])
+        )
+        out = fn(
+            planes,
+            jnp.asarray(qmat),
+            self._device_valid() if want == "count" else None,
+        )
+        return out[: len(lbs)]
+
+    def _fused_compare(self, lbs, qcap, want: str):
+        """Stacked masked-compare / range-list launch: per-query bounds
+        pad to the group's bin/range maxima (ids -1 and inverted ranges
+        match nothing), queries pad to qcap the same way."""
+        import jax
+        import jax.numpy as jnp
+
+        from geomesa_tpu.ops import zscan
+
+        kind = self._z_kind
+        binned = kind in ("z3", "xz3")
+        if binned:
+            bs = [np.asarray(lb[0]) for lb in lbs]
+            ids = [np.asarray(lb[1]) for lb in lbs]
+            bmax = max(len(i) for i in ids)  # pow2 already (pad_bins)
+            if kind == "xz3":
+                rmax = max(b.shape[1] for b in bs)
+                bs = [zscan.pad_ranges(b, min_r=rmax) for b in bs]
+                tail = (rmax, 4)
+            else:
+                tail = (3, 6)
+            bounds = np.zeros((qcap, bmax) + tail, np.uint32)
+            idm = np.full((qcap, bmax), -1, np.int32)
+            for i, (b, bi) in enumerate(zip(bs, ids)):
+                bounds[i, : len(bi)] = b
+                idm[i, : len(bi)] = bi
+        else:
+            bs = [np.asarray(lb[0]) for lb in lbs]
+            if kind == "xz2":
+                rmax = max(b.shape[0] for b in bs)
+                bs = [zscan.pad_ranges(b, min_r=rmax) for b in bs]
+                never = np.broadcast_to(zscan._NEVER_RANGE, (rmax, 4))
+            else:  # z2 masked-compare: (2, 6) rows, lo_lo=1 > hi=0
+                never = np.zeros((2, 6), np.uint32)
+                never[:, 3] = 1
+            bounds = np.empty((qcap,) + never.shape, np.uint32)
+            bounds[:] = never
+            for i, b in enumerate(bs):
+                bounds[i] = b
+            idm = None
+        key = ("fcmp", kind, bounds.shape, want)
+        fn = self._fused_jits.get(key)
+        if fn is None:
+            bm = zscan.batched_kind_mask(kind)
+
+            def _run(hi, lo, bins, bounds, ids, valid, _bm=bm, _want=want):
+                if ids is None:
+                    m = _bm(hi, lo, bounds)
+                else:
+                    m = _bm(hi, lo, bins, bounds, ids)
+                if _want == "count":
+                    if valid is not None:
+                        m = m & valid[None, :]
+                    return jnp.sum(m, axis=1, dtype=jnp.int32)
+                return m
+
+            fn = jax.jit(_run)
+            self._fused_jits[key] = fn
+        out = fn(
+            self._cols[Z_HI],
+            self._cols[Z_LO],
+            self._cols.get(Z_BIN) if binned else None,
+            jnp.asarray(bounds),
+            jnp.asarray(idm) if idm is not None else None,
+            self._device_valid() if want == "count" else None,
+        )
+        return out[: len(lbs)]
 
     def mask(
         self, query, loose: "bool | None" = None, auths=None
@@ -2374,6 +2550,16 @@ class StreamingDeviceIndex(DeviceIndex):
     def window_pairs_query(self, envs, auths=None, base=None):
         with self._lock:
             return super().window_pairs_query(envs, auths=auths, base=base)
+
+    def fused_loose_counts(self, queries, loose: "bool | None" = None):
+        with self._lock:
+            return super().fused_loose_counts(queries, loose=loose)
+
+    def fused_loose_query(self, queries, loose: "bool | None" = None):
+        # one lock span across launch + host takes: the demuxed rows must
+        # come from the same snapshot the device mask was computed on
+        with self._lock:
+            return super().fused_loose_query(queries, loose=loose)
 
     def __len__(self) -> int:
         return self._n - self._n_dead

@@ -11,6 +11,8 @@ for the heavy parts), with the store APIs doing the per-chunk work:
 - ``parallel_export``     -- one output file per storage partition
 - ``backpopulate_index``  -- KV add-index + back-population wrapper
 - ``reindex``             -- FS primary-index rewrite wrapper
+- ``scheduled_queries``   -- bulk resident queries through the device
+  query scheduler's batch lane (micro-batch fusion + backpressure)
 """
 
 from __future__ import annotations
@@ -113,6 +115,53 @@ def parallel_export(
     preload_pyarrow()
     with ThreadPoolExecutor(max_workers=workers) as pool:
         return list(pool.map(write_one, jobs))
+
+
+def scheduled_queries(
+    device_index,
+    queries,
+    scheduler=None,
+    op: str = "count",
+    loose=None,
+    auths=None,
+    tenant: str = "jobs",
+    deadline_ms=None,
+):
+    """Run many resident queries as a BULK batch-lane producer: every
+    query is submitted before any is awaited, so the scheduler's
+    micro-batcher can fold compatible ones into shared device launches,
+    and interactive requests keep priority over the whole sweep. Results
+    align with ``queries`` and equal the serial per-query execution
+    exactly. Without a scheduler the queries run serially in-line.
+
+    Bulk work carries NO deadline by default (a sweep queued behind
+    sustained interactive traffic must finish, not expire); pass
+    ``deadline_ms`` to opt in — expiry then raises DeadlineExpired from
+    the first expired request. Queue-full rejections are retried with a
+    short in-process poll (the HTTP Retry-After hint is sized for remote
+    clients; here the producer can watch the queue drain directly)."""
+    import time
+
+    from geomesa_tpu.sched import LANE_BATCH, FusableQuery, RejectedError
+
+    specs = [
+        FusableQuery(device_index, q, op, loose=loose, auths=auths)
+        for q in queries
+    ]
+    if scheduler is None:
+        return [s.run_serial() for s in specs]
+    reqs = []
+    for s in specs:
+        while True:
+            try:
+                reqs.append(scheduler.submit(
+                    fuse=s, lane=LANE_BATCH, tenant=tenant,
+                    deadline_ms=deadline_ms,
+                ))
+                break
+            except RejectedError:
+                time.sleep(0.005)  # backpressure: let the queue drain
+    return [scheduler.wait(r) for r in reqs]
 
 
 def backpopulate_index(store, type_name: str, index: str) -> int:

@@ -178,3 +178,30 @@ queries_run = REGISTRY.counter(
 query_seconds = REGISTRY.histogram(
     "geomesa_query_duration_seconds", "end-to-end query latency"
 )
+
+# device query scheduler (geomesa_tpu/sched): the serving path's
+# admission/fusion observability — queue pressure, wait time, fusion
+# factor (sched_queries_total / sched_launches_total), and shed load
+sched_queue_depth = REGISTRY.gauge(
+    "geomesa_sched_queue_depth", "requests waiting in the scheduler queue"
+)
+sched_queries = REGISTRY.counter(
+    "geomesa_sched_queries_total", "requests executed by the scheduler"
+)
+sched_launches = REGISTRY.counter(
+    "geomesa_sched_launches_total", "device scan launches dispatched"
+)
+sched_fused = REGISTRY.counter(
+    "geomesa_sched_fused_queries_total",
+    "queries answered by a shared (fused) device launch",
+)
+sched_rejected = REGISTRY.counter(
+    "geomesa_sched_rejections_total", "requests rejected at admission"
+)
+sched_expired = REGISTRY.counter(
+    "geomesa_sched_deadline_expired_total",
+    "requests that expired before or during execution",
+)
+sched_wait_seconds = REGISTRY.histogram(
+    "geomesa_sched_wait_seconds", "queue wait before execution"
+)

@@ -801,6 +801,38 @@ def kind_mask_fn(kind: str):
     }[kind]
 
 
+def batched_kind_mask(kind: str):
+    """Q-stacked variant of :func:`kind_mask_fn` for micro-batch scan
+    fusion (the device query scheduler): the query bounds/ids gain a
+    leading query axis and the key planes broadcast, so Q compatible
+    queries resolve in ONE device launch returning a (Q, n) hit matrix.
+    Binned kinds take (hi, lo, bins, bounds[Q,...], ids[Q, B]); unbinned
+    (hi, lo, bounds[Q, ...])."""
+    import jax
+
+    mf = kind_mask_fn(kind)
+    if kind in ("z3", "xz3"):
+        return jax.vmap(mf, in_axes=(None, None, None, 0, 0))
+    return jax.vmap(mf, in_axes=(None, None, 0))
+
+
+def batched_dim_mask_rt(n_ranges: int):
+    """Q-stacked dim-plane mask with runtime bounds: ``qmat`` is the
+    (Q, 4 + 2R) stack of :func:`z3_dim_plane_qarr` vectors (or (Q, 4)
+    :func:`z2_dim_plane_qarr` vectors when ``n_ranges == 0``) and the
+    result is (Q, n). The scheduler's fusion path uses the XLA engine —
+    the per-query Pallas SMEM prefetch does not batch — which is
+    cross-checked against the Pallas count champion elsewhere."""
+    import jax
+
+    if n_ranges == 0:
+        return jax.vmap(z2_dimscan_mask_rt, in_axes=(None, None, 0))
+    return jax.vmap(
+        lambda nx, ny, bt, q: z3_dimscan_mask_rt(nx, ny, bt, q, n_ranges),
+        in_axes=(None, None, None, 0),
+    )
+
+
 def build_z3_pallas_scan(
     bounds: np.ndarray,
     bin_ids: np.ndarray,

@@ -1,0 +1,30 @@
+"""Device query scheduler: admission control, micro-batch scan fusion,
+and backpressure for the serving path.
+
+Ref role: the tablet server's scan-executor pool (the reference bounds
+concurrent scans per server and queues the rest) — re-designed for
+batch-oriented hardware, where N compatible small queries are cheaper as
+ONE stacked device launch than as N independent ones. See
+:mod:`geomesa_tpu.sched.scheduler` for the architecture.
+"""
+
+from geomesa_tpu.sched.fusion import FusableQuery, execute_group
+from geomesa_tpu.sched.scheduler import (
+    LANE_BATCH,
+    LANE_INTERACTIVE,
+    DeadlineExpired,
+    QueryScheduler,
+    RejectedError,
+    SchedConfig,
+)
+
+__all__ = [
+    "DeadlineExpired",
+    "FusableQuery",
+    "LANE_BATCH",
+    "LANE_INTERACTIVE",
+    "QueryScheduler",
+    "RejectedError",
+    "SchedConfig",
+    "execute_group",
+]

@@ -1,0 +1,61 @@
+"""Fusable-query descriptors for the device query scheduler.
+
+A FusableQuery names ONE resident-index query (count or query/features)
+that the micro-batcher may execute as part of a shared device launch.
+Compatibility is decided in two stages: the cheap queue-level key (same
+index object, same operation, same loose/auths signature) groups
+candidates, and the DeviceIndex fused launch itself (``fused_loose_*``)
+makes the final call — it returns None for groups whose z-range sets
+cannot share a kernel (mixed engines, a filter the key planes cannot
+answer), and the scheduler falls back to per-query serial execution,
+which is always available and always exact.
+"""
+
+from __future__ import annotations
+
+
+class FusableQuery:
+    """One scheduler-visible resident query.
+
+    ``op`` is "count" (fused result: int) or "query" (fused result:
+    FeatureBatch). ``fusable`` is False when the loose key-plane engine
+    cannot possibly answer (loose off for the request, or the index has
+    no key planes) — the scheduler then skips the fusion window and runs
+    the serial callable directly under admission control only.
+    """
+
+    __slots__ = ("di", "query", "op", "loose", "auths", "fusable")
+
+    def __init__(self, di, query, op: str, loose=None, auths=None):
+        if op not in ("count", "query"):
+            raise ValueError(f"unknown fusable op {op!r}")
+        self.di = di
+        self.query = query
+        self.op = op
+        self.loose = loose
+        self.auths = tuple(sorted(str(a) for a in (auths or ())))
+        self.fusable = bool(di is not None and di._resolve_loose(loose))
+
+    @property
+    def key(self):
+        """Queue-level compatibility: requests sharing a key MAY ride one
+        device launch (the index makes the final call)."""
+        return (id(self.di), self.op, bool(self.loose), self.auths)
+
+    def run_serial(self):
+        """The unfused (exact-parity) execution of this one query."""
+        if self.op == "count":
+            return self.di.count(self.query, loose=self.loose,
+                                 auths=self.auths)
+        return self.di.query(self.query, loose=self.loose, auths=self.auths)
+
+
+def execute_group(specs: "list[FusableQuery]"):
+    """Run a compatible group as ONE batched device launch. Returns the
+    per-query results aligned with ``specs``, or None when the index
+    declines to fuse (caller falls back to serial)."""
+    di = specs[0].di
+    queries = [s.query for s in specs]
+    if specs[0].op == "count":
+        return di.fused_loose_counts(queries, loose=specs[0].loose)
+    return di.fused_loose_query(queries, loose=specs[0].loose)

@@ -1,0 +1,454 @@
+"""Admission control + micro-batch fusion for the device serving path.
+
+Architecture (the tablet-server scan-executor pool, re-shaped for an
+accelerator):
+
+- **Admission controller.** Requests enter a bounded queue; when it is
+  full they are rejected immediately (the server maps this to HTTP 429 +
+  ``Retry-After``) instead of piling up one thread per request. A fixed
+  pool of ``max_inflight`` workers is the device concurrency cap — the
+  accelerator serializes launches anyway, so more concurrent launchers
+  only add queueing in the runtime where nothing can observe it.
+
+- **Micro-batcher.** When a worker dequeues a fusable request (a
+  resident loose count/features query) it drains every queued compatible
+  request and holds a short fusion window for late arrivals, then
+  executes the whole group as ONE stacked device launch
+  (``DeviceIndex.fused_loose_*``: per-query z-range sets stack along a
+  leading query axis and a single vmapped zscan dispatch answers all of
+  them). Batch hardware rewards exactly this shape: K compatible queries
+  cost one kernel's bandwidth pass, not K.
+
+- **Priority lanes + tenant fairness.** Two lanes (interactive before
+  batch); within a lane, tenants are drained round-robin so one noisy
+  client cannot starve the rest. Fusion groups may span tenants — a
+  shared launch makes everyone in it faster.
+
+- **Deadlines.** Every request carries an absolute deadline; requests
+  that expire while queued are completed with :class:`DeadlineExpired`
+  (never executed), and submitters stop waiting at their deadline. A
+  request already executing runs to completion — device launches are
+  not cancellable mid-flight.
+
+Observability: queue depth, wait time, launches, fusion factor
+(queries / launches), rejections and expirations — exported through
+:mod:`geomesa_tpu.metrics` and the server's ``/stats/sched`` endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+
+LANE_INTERACTIVE = "interactive"
+LANE_BATCH = "batch"
+_LANES = (LANE_INTERACTIVE, LANE_BATCH)
+
+
+class RejectedError(RuntimeError):
+    """Admission queue full: shed the request now (HTTP 429)."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"scheduler queue full; retry after {retry_after_s:g}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExpired(RuntimeError):
+    """The request's deadline passed before it could execute."""
+
+
+@dataclass
+class SchedConfig:
+    """Tuning knobs for :class:`QueryScheduler`.
+
+    ``max_queue`` bounds admitted-but-waiting requests (the backpressure
+    point); ``max_inflight`` is the worker count (device concurrency
+    cap); ``fusion_window_ms`` is how long a worker holds a fusable
+    request for compatible late arrivals (0 fuses only already-queued
+    requests); ``max_fusion`` caps queries per device launch;
+    ``default_deadline_ms`` applies when a request carries none (None =
+    unbounded); ``retry_after_s`` rides the 429 Retry-After header."""
+
+    max_queue: int = 128
+    max_inflight: int = 2
+    fusion_window_ms: float = 2.0
+    max_fusion: int = 64
+    default_deadline_ms: "float | None" = 30_000.0
+    retry_after_s: float = 1.0
+
+
+_USE_DEFAULT = object()  # submit(): "no deadline_ms given, apply config"
+
+
+class _Request:
+    __slots__ = (
+        "fn", "fuse", "lane", "tenant", "deadline", "enqueued",
+        "event", "result", "error", "state",
+    )
+
+    def __init__(self, fn, fuse, lane, tenant, deadline):
+        self.fn = fn
+        self.fuse = fuse
+        self.lane = lane
+        self.tenant = tenant
+        self.deadline = deadline
+        self.enqueued = time.monotonic()
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.state = "queued"  # -> running -> done
+
+
+class QueryScheduler:
+    """Bounded-queue device query scheduler (see module docstring).
+
+    >>> sched = QueryScheduler(SchedConfig(max_inflight=1))
+    >>> sched.run(fn=lambda: 42)
+    42
+    >>> sched.run(fuse=FusableQuery(di, cql, "count", loose=True))
+    """
+
+    def __init__(self, config: "SchedConfig | None" = None):
+        self.config = config or SchedConfig()
+        self._cv = threading.Condition()
+        # lane -> tenant -> deque of queued requests (RR over tenants)
+        self._queues: dict = {lane: OrderedDict() for lane in _LANES}
+        self._queued = 0
+        self._stop = False
+        # counters for snapshot(); the process-global metrics mirror them
+        self.queries = 0
+        self.launches = 0
+        self.fused_queries = 0
+        self.rejected = 0
+        self.expired = 0
+        self._wait_sum = 0.0
+        self._workers = [
+            threading.Thread(
+                target=self._worker, daemon=True, name=f"sched-worker-{i}"
+            )
+            for i in range(max(1, self.config.max_inflight))
+        ]
+        for w in self._workers:
+            w.start()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(
+        self,
+        fn=None,
+        fuse=None,
+        lane: str = LANE_INTERACTIVE,
+        tenant: str = "",
+        deadline_ms=_USE_DEFAULT,
+    ) -> _Request:
+        """Admit one request (non-blocking). ``fn`` is the zero-arg
+        serial execution; ``fuse`` an optional FusableQuery the
+        micro-batcher may fold into a shared launch (``fn`` defaults to
+        its serial form). ``deadline_ms`` unset applies the config
+        default; an explicit None means no deadline (bulk producers).
+        Raises :class:`RejectedError` when the queue is full. Wait for
+        the result with :meth:`wait`."""
+        if fuse is not None and not fuse.fusable:
+            if fn is None:
+                fn = fuse.run_serial
+            fuse = None
+        if fn is None:
+            if fuse is None:
+                raise ValueError("submit needs fn or fuse")
+            fn = fuse.run_serial
+        if lane not in _LANES:
+            raise ValueError(f"unknown lane {lane!r}")
+        if deadline_ms is _USE_DEFAULT:
+            deadline_ms = self.config.default_deadline_ms
+        deadline = (
+            time.monotonic() + deadline_ms / 1e3
+            if deadline_ms is not None
+            else None
+        )
+        req = _Request(fn, fuse, lane, str(tenant or ""), deadline)
+        from geomesa_tpu import metrics
+
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("scheduler is shut down")
+            if self._queued >= self.config.max_queue:
+                self.rejected += 1
+                metrics.sched_rejected.inc()
+                raise RejectedError(self.config.retry_after_s)
+            self._queues[req.lane].setdefault(
+                req.tenant, deque()
+            ).append(req)
+            self._queued += 1
+            metrics.sched_queue_depth.set(self._queued)
+            # notify_all: a single notify can land on a worker holding a
+            # fusion window (which re-waits on this cv) while an idle
+            # worker sleeps its poll out — a needless latency spike
+            self._cv.notify_all()
+        return req
+
+    def wait(self, req: _Request):
+        """Block until ``req`` completes; raises its error (including
+        :class:`DeadlineExpired` when it expired waiting). A request
+        already executing at its deadline runs to completion — device
+        launches are not cancellable mid-flight."""
+        if req.deadline is not None and not req.event.wait(
+            timeout=max(req.deadline - time.monotonic(), 0.0)
+        ):
+            with self._cv:
+                if req.state == "queued":  # expired without being claimed
+                    from geomesa_tpu import metrics
+
+                    req.state = "done"
+                    req.error = DeadlineExpired(
+                        "request expired in the scheduler queue"
+                    )
+                    self._queued -= 1
+                    metrics.sched_queue_depth.set(self._queued)
+                    self.expired += 1
+                    self._observe_expired()
+                    req.event.set()
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def run(
+        self,
+        fn=None,
+        fuse=None,
+        lane: str = LANE_INTERACTIVE,
+        tenant: str = "",
+        deadline_ms=_USE_DEFAULT,
+    ):
+        """submit() + wait() in one call — the serving entry point."""
+        return self.wait(
+            self.submit(
+                fn=fn, fuse=fuse, lane=lane, tenant=tenant,
+                deadline_ms=deadline_ms,
+            )
+        )
+
+    # -- queue internals (call under self._cv) -----------------------------
+
+    def _pop_locked(self) -> "_Request | None":
+        """Next request: interactive lane first, round-robin across
+        tenants within a lane. Claims the request (state -> running)."""
+        from geomesa_tpu import metrics
+
+        for lane in _LANES:
+            tenants = self._queues[lane]
+            for tenant in list(tenants):
+                dq = tenants[tenant]
+                req = None
+                while dq:
+                    r = dq.popleft()
+                    if r.state == "queued":
+                        req = r
+                        break
+                    # cancelled while queued: already accounted for
+                if dq:
+                    tenants.move_to_end(tenant)  # fairness rotation
+                else:
+                    del tenants[tenant]
+                if req is not None:
+                    req.state = "running"
+                    self._queued -= 1
+                    metrics.sched_queue_depth.set(self._queued)
+                    return req
+        return None
+
+    def _drain_locked(self, key, limit: int) -> "list[_Request]":
+        """Claim up to ``limit`` queued requests whose fuse key matches
+        (any lane, any tenant — a shared launch helps everyone in it)."""
+        from geomesa_tpu import metrics
+
+        got: list = []
+        if limit <= 0:
+            return got
+        for lane in _LANES:
+            tenants = self._queues[lane]
+            for tenant in list(tenants):
+                dq = tenants[tenant]
+                keep: deque = deque()
+                while dq:
+                    r = dq.popleft()
+                    if (
+                        len(got) < limit
+                        and r.state == "queued"
+                        and r.fuse is not None
+                        and r.fuse.key == key
+                    ):
+                        r.state = "running"
+                        got.append(r)
+                    elif r.state == "queued":
+                        keep.append(r)
+                if keep:
+                    tenants[tenant] = keep
+                else:
+                    del tenants[tenant]
+        if got:
+            self._queued -= len(got)
+            metrics.sched_queue_depth.set(self._queued)
+        return got
+
+    # -- execution ---------------------------------------------------------
+
+    def _worker(self) -> None:
+        cfg = self.config
+        while True:
+            with self._cv:
+                req = self._pop_locked()
+                while req is None and not self._stop:
+                    self._cv.wait(timeout=0.25)
+                    req = self._pop_locked()
+                if req is None:
+                    return  # shut down
+                group = [req]
+                if req.fuse is not None:
+                    group += self._drain_locked(
+                        req.fuse.key, cfg.max_fusion - len(group)
+                    )
+            if (
+                req.fuse is not None
+                and cfg.fusion_window_ms > 0
+                and len(group) < cfg.max_fusion
+            ):
+                # hold the fusion window for compatible late arrivals
+                stop_at = time.monotonic() + cfg.fusion_window_ms / 1e3
+                while len(group) < cfg.max_fusion:
+                    rem = stop_at - time.monotonic()
+                    if rem <= 0:
+                        break
+                    with self._cv:
+                        more = self._drain_locked(
+                            req.fuse.key, cfg.max_fusion - len(group)
+                        )
+                        if not more:
+                            self._cv.wait(timeout=rem)
+                            more = self._drain_locked(
+                                req.fuse.key, cfg.max_fusion - len(group)
+                            )
+                        group += more
+            self._execute(group)
+
+    def _execute(self, group: "list[_Request]") -> None:
+        from geomesa_tpu import metrics
+        from geomesa_tpu.sched.fusion import execute_group
+
+        now = time.monotonic()
+        live: list = []
+        dead: list = []
+        with self._cv:  # counters race sibling workers otherwise
+            for r in group:
+                if r.deadline is not None and now > r.deadline:
+                    self.expired += 1
+                    dead.append(r)
+                else:
+                    self._wait_sum += now - r.enqueued
+                    live.append(r)
+        for r in dead:
+            self._observe_expired()
+            self._finish(r, error=DeadlineExpired(
+                "request expired before execution"
+            ))
+        for r in live:
+            metrics.sched_wait_seconds.observe(now - r.enqueued)
+        if not live:
+            return
+        fused = None
+        if len(live) > 1 and live[0].fuse is not None:
+            try:
+                fused = execute_group([r.fuse for r in live])
+            except Exception:
+                fused = None  # any fusion failure: serial is always exact
+        with self._cv:
+            if fused is not None:
+                self.launches += 1
+                self.queries += len(live)
+                self.fused_queries += len(live)
+            else:
+                self.launches += len(live)
+                self.queries += len(live)
+        if fused is not None:
+            metrics.sched_launches.inc()
+            metrics.sched_queries.inc(len(live))
+            metrics.sched_fused.inc(len(live))
+            for r, v in zip(live, fused):
+                self._finish(r, result=v)
+            return
+        metrics.sched_launches.inc(len(live))
+        metrics.sched_queries.inc(len(live))
+        for r in live:
+            try:
+                res = r.fn()
+            except Exception as e:  # the submitter re-raises it
+                self._finish(r, error=e)
+                continue
+            self._finish(r, result=res)
+
+    def _finish(self, req: _Request, result=None, error=None) -> None:
+        req.result = result
+        req.error = error
+        req.state = "done"
+        req.event.set()
+
+    def _observe_expired(self) -> None:
+        from geomesa_tpu import metrics
+
+        metrics.sched_expired.inc()
+
+    # -- observability / lifecycle -----------------------------------------
+
+    def snapshot(self) -> dict:
+        """The ``/stats/sched`` document: queue pressure, execution
+        counters and the fusion factor (queries per device launch)."""
+        with self._cv:
+            queries, launches = self.queries, self.launches
+            return {
+                "queue_depth": self._queued,
+                "max_queue": self.config.max_queue,
+                "inflight_cap": self.config.max_inflight,
+                "fusion_window_ms": self.config.fusion_window_ms,
+                "max_fusion": self.config.max_fusion,
+                "queries": queries,
+                "launches": launches,
+                "fused_queries": self.fused_queries,
+                "fusion_factor": (
+                    round(queries / launches, 3) if launches else None
+                ),
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "avg_wait_ms": (
+                    round(self._wait_sum / queries * 1e3, 3)
+                    if queries
+                    else None
+                ),
+            }
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Stop the workers; queued requests complete with errors."""
+        with self._cv:
+            self._stop = True
+            pending: list = []
+            for lane in _LANES:
+                for dq in self._queues[lane].values():
+                    pending += [r for r in dq if r.state == "queued"]
+                self._queues[lane].clear()
+            self._queued = 0
+            self._cv.notify_all()
+        for r in pending:
+            self._finish(
+                r, error=RuntimeError("scheduler shut down")
+            )
+        for w in self._workers:
+            w.join(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

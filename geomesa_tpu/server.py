@@ -26,7 +26,18 @@ Endpoints (all GET):
 - ``/proximity/<type>?points=x,y;...&distance=&cql=`` -- features near
   any input point, with distances (ProximitySearchProcess analog)
 - ``/metrics``                      -- Prometheus exposition text
+- ``/stats/sched``                  -- device query scheduler counters
+  (sched mode: queue depth, wait time, fusion factor, rejections)
 - ``/refresh/<type>``               -- restage a resident type after writes
+
+Scheduler mode (``make_server(store, sched=True)`` or a SchedConfig, CLI
+``serve --sched``) routes query/count/density/knn/stats work through the
+device query scheduler (:mod:`geomesa_tpu.sched`): bounded admission
+(queue-full -> 429 + Retry-After), per-request deadlines (``deadlineMs=``
+-> 504 on expiry), priority lanes (``lane=interactive|batch``),
+per-tenant fairness (``tenant=``, defaulting to the client address), and
+micro-batch fusion — compatible concurrent resident bbox queries execute
+as ONE stacked device launch instead of N.
 
 Resident mode (``make_server(store, resident=True)``, CLI ``serve
 --resident``) pins each type's scan columns AND index-key planes in
@@ -53,6 +64,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 class _Handler(BaseHTTPRequestHandler):
     store = None  # injected by make_server
     resident = False  # serve from device-pinned DeviceIndex caches
+    scheduler = None  # QueryScheduler (admission + micro-batch fusion)
     _resident_cache: dict = {}  # per-server-class: type -> DeviceIndex
     _resident_lock = None  # per-server-class construction lock
 
@@ -132,15 +144,44 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: D102
         pass
 
-    def _send(self, code: int, body: bytes, ctype: str) -> None:
+    def _send(self, code: int, body: bytes, ctype: str, headers=()) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in headers:
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
     def _json(self, code: int, doc) -> None:
         self._send(code, json.dumps(doc).encode("utf-8"), "application/json")
+
+    def _sched_run(self, q: dict, fn=None, fuse=None):
+        """Route one unit of query work through the device query
+        scheduler when one is configured (admission control, deadlines,
+        micro-batch fusion for compatible resident queries); direct
+        execution otherwise. Request knobs: ``lane=interactive|batch``,
+        ``tenant=`` (defaults to the client address, the per-tenant
+        fairness key), ``deadlineMs=``."""
+        sched = self.scheduler
+        if sched is None:
+            if fn is not None:
+                return fn()
+            return fuse.run_serial()
+        dl = q.get("deadlineMs")
+        tenant = q.get("tenant")
+        if not tenant and self.client_address:
+            tenant = str(self.client_address[0])
+        kw = {}
+        if dl:  # absent: the scheduler's configured default applies
+            kw["deadline_ms"] = float(dl)
+        return sched.run(
+            fn=fn,
+            fuse=fuse,
+            lane=q.get("lane", "interactive"),
+            tenant=tenant or "",
+            **kw,
+        )
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib API)
         try:
@@ -157,6 +198,8 @@ class _Handler(BaseHTTPRequestHandler):
                     REGISTRY.prometheus_text().encode("utf-8"),
                     "text/plain; version=0.0.4",
                 )
+            if parts == ["stats", "sched"] and self.scheduler is not None:
+                return self._json(200, self.scheduler.snapshot())
             if len(parts) == 2 and parts[0] in (
                 "features", "count", "explain", "density", "stats",
                 "refresh", "knn", "tube", "proximity",
@@ -170,7 +213,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(400, {"error": str(e)})
         except BrokenPipeError:
             pass
-        except Exception as e:  # pragma: no cover - defensive
+        except Exception as e:
+            from geomesa_tpu.sched import DeadlineExpired, RejectedError
+
+            if isinstance(e, RejectedError):
+                # backpressure: shed load explicitly instead of queueing
+                # unboundedly; clients should honor Retry-After
+                return self._send(
+                    429,
+                    json.dumps({"error": str(e)}).encode("utf-8"),
+                    "application/json",
+                    headers=(("Retry-After", f"{e.retry_after_s:g}"),),
+                )
+            if isinstance(e, DeadlineExpired):
+                return self._json(504, {"error": str(e)})
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
     # -- endpoints ---------------------------------------------------------
@@ -212,9 +268,17 @@ class _Handler(BaseHTTPRequestHandler):
 
             import numpy as np
 
+            from geomesa_tpu.sched import FusableQuery
+
             t0 = _time.perf_counter()
             cql = q.get("cql", "INCLUDE")
-            batch = di.query(cql, loose=self._loose(q), auths=self._auths(q))
+            batch = self._sched_run(
+                q,
+                fuse=FusableQuery(
+                    di, cql, "query",
+                    loose=self._loose(q), auths=self._auths(q),
+                ),
+            )
             cap = self._cap(q)
             if cap is not None and len(batch) > cap:
                 batch = batch.take(np.arange(cap))
@@ -222,7 +286,9 @@ class _Handler(BaseHTTPRequestHandler):
                 type_name, cql, t0, _time.perf_counter(), len(batch)
             )
         else:
-            batch = self._query(type_name, q).batch
+            batch = self._sched_run(
+                q, fn=lambda: self._query(type_name, q).batch
+            )
         fmt = q.get("f", "geojson")
         if fmt == "arrow":
             from geomesa_tpu.arrow_io import write_delta_stream
@@ -268,12 +334,15 @@ class _Handler(BaseHTTPRequestHandler):
         kwargs = {}
         if q.get("maxRadius"):
             kwargs["max_radius_deg"] = float(q["maxRadius"])
-        batch, dists = knn(
-            self.store, type_name, px, py, k,
-            base_filter=q.get("cql"),
-            device_index=self._di(type_name),
-            auths=self._auths(q),
-            **kwargs,
+        batch, dists = self._sched_run(
+            q,
+            fn=lambda: knn(
+                self.store, type_name, px, py, k,
+                base_filter=q.get("cql"),
+                device_index=self._di(type_name),
+                auths=self._auths(q),
+                **kwargs,
+            ),
         )
         self._emit_features(
             batch, q, extra={"knn_distance_deg": [float(d) for d in dists]}
@@ -331,15 +400,23 @@ class _Handler(BaseHTTPRequestHandler):
         if di is not None:
             import time as _time
 
+            from geomesa_tpu.sched import FusableQuery
+
             t0 = _time.perf_counter()
             cql = q.get("cql", "INCLUDE")
-            n = di.count(cql, loose=self._loose(q), auths=self._auths(q))
+            n = self._sched_run(
+                q,
+                fuse=FusableQuery(
+                    di, cql, "count",
+                    loose=self._loose(q), auths=self._auths(q),
+                ),
+            )
             cap = self._cap(q)
             if cap is not None:
                 n = min(n, cap)  # the plain path counts the capped result
             self._observe_resident(type_name, cql, t0, _time.perf_counter(), n)
             return self._json(200, {"count": n})
-        res = self._query(type_name, q)
+        res = self._sched_run(q, fn=lambda: self._query(type_name, q))
         self._json(200, {"count": len(res)})
 
     def _refresh(self, type_name: str, q: dict) -> None:
@@ -362,23 +439,24 @@ class _Handler(BaseHTTPRequestHandler):
         spec = q.get("stats")
         if not spec:
             raise ValueError("stats endpoint needs stats=<Stat-DSL spec>")
-        di = self._di(type_name)
-        if di is not None:
-            import time as _time
+        def work():
+            di = self._di(type_name)
+            if di is not None:
+                import time as _time
 
-            t0 = _time.perf_counter()
-            cql = q.get("cql", "INCLUDE")
-            seq = di.stats(
-                cql, spec, loose=self._loose(q), auths=self._auths(q)
-            )
-            self._observe_resident(
-                type_name, cql, t0, _time.perf_counter(), 0
-            )
-        else:
+                t0 = _time.perf_counter()
+                cql = q.get("cql", "INCLUDE")
+                seq = di.stats(
+                    cql, spec, loose=self._loose(q), auths=self._auths(q)
+                )
+                self._observe_resident(
+                    type_name, cql, t0, _time.perf_counter(), 0
+                )
+                return seq
             from geomesa_tpu.process import run_stats
             from geomesa_tpu.query.plan import Query
 
-            seq = run_stats(
+            return run_stats(
                 self.store,
                 type_name,
                 Query(
@@ -387,6 +465,8 @@ class _Handler(BaseHTTPRequestHandler):
                 ),
                 spec,
             )
+
+        seq = self._sched_run(q, fn=work)
         self._json(200, seq.to_json())
 
     def _explain(self, type_name: str, q: dict) -> None:
@@ -407,28 +487,34 @@ class _Handler(BaseHTTPRequestHandler):
 
         cql = q.get("cql", "INCLUDE")
         env = Envelope(*bbox)
-        di = self._di(type_name)
-        grid = None
-        if di is not None:
-            import time as _time
 
-            t0 = _time.perf_counter()
-            grid = di.density(cql, env, width, height,
-                              loose=self._loose(q), auths=self._auths(q))
-            if grid is not None:
-                # unweighted: the grid mass IS the in-window hit count
-                self._observe_resident(
-                    type_name, cql, t0, _time.perf_counter(),
-                    int(round(float(grid.sum()))),
+        def work():
+            di = self._di(type_name)
+            grid = None
+            if di is not None:
+                import time as _time
+
+                t0 = _time.perf_counter()
+                grid = di.density(cql, env, width, height,
+                                  loose=self._loose(q), auths=self._auths(q))
+                if grid is not None:
+                    # unweighted: the grid mass IS the in-window hit count
+                    self._observe_resident(
+                        type_name, cql, t0, _time.perf_counter(),
+                        int(round(float(grid.sum()))),
+                    )
+            if grid is None:
+                # no resident index, or filter/planes not device-
+                # expressible: the store path records its own metrics
+                # (observe_query) and honors the SAME auths the resident
+                # path would have
+                grid = density(
+                    self.store, type_name, cql, env, width, height,
+                    auths=self._auths(q),
                 )
-        if grid is None:
-            # no resident index, or filter/planes not device-expressible:
-            # the store path records its own metrics (observe_query) and
-            # honors the SAME auths the resident path would have
-            grid = density(
-                self.store, type_name, cql, env, width, height,
-                auths=self._auths(q),
-            )
+            return grid
+
+        grid = self._sched_run(q, fn=work)
         self._json(
             200,
             {
@@ -442,7 +528,7 @@ class _Handler(BaseHTTPRequestHandler):
 
 def make_server(
     store, host: str = "127.0.0.1", port: int = 0, resident: bool = False,
-    warm: bool = False,
+    warm: bool = False, sched=None,
 ):
     """Build a ThreadingHTTPServer bound to (host, port); port 0 picks an
     ephemeral port (see ``server.server_address``). ``resident=True``
@@ -452,18 +538,34 @@ def make_server(
     the server accepts traffic (DeviceIndex.warmup), so no request pays
     a first-touch staging or XLA compile; with the persistent
     compilation cache (on by default, see jaxconf) a restarted server
-    warms from disk in seconds."""
+    warms from disk in seconds.
+
+    ``sched`` enables the device query scheduler (admission control +
+    micro-batch scan fusion + per-tenant fairness, see
+    :mod:`geomesa_tpu.sched`): pass ``True`` for the default
+    :class:`~geomesa_tpu.sched.SchedConfig` or a config instance.
+    Queue-full requests get HTTP 429 + ``Retry-After``; expired
+    deadlines (``deadlineMs=``) get 504; ``/stats/sched`` reports queue
+    depth, wait time and the fusion factor."""
     from geomesa_tpu.jaxconf import enable_compilation_cache
     from geomesa_tpu.pyarrow_compat import preload_pyarrow
 
     enable_compilation_cache()
     preload_pyarrow()  # handler threads serve Arrow; see pyarrow_compat
+    scheduler = None
+    if sched:
+        from geomesa_tpu.sched import QueryScheduler, SchedConfig
+
+        scheduler = QueryScheduler(
+            sched if isinstance(sched, SchedConfig) else SchedConfig()
+        )
     handler = type(
         "BoundHandler",
         (_Handler,),
         {
             "store": store,
             "resident": resident,
+            "scheduler": scheduler,
             "_resident_cache": {},
             "_resident_lock": threading.Lock(),
         },
@@ -484,16 +586,20 @@ def make_server(
                 warnings.warn(f"warm staging failed for {tn!r}: {e!r}")
                 continue
             handler._resident_cache[tn] = di
-    return ThreadingHTTPServer((host, port), handler)
+    server = ThreadingHTTPServer((host, port), handler)
+    server.scheduler = scheduler  # callers may inspect / shut down
+    return server
 
 
 def serve_background(
     store, host: str = "127.0.0.1", port: int = 0, resident: bool = False,
-    warm: bool = False,
+    warm: bool = False, sched=None,
 ):
     """Start serving on a daemon thread; returns (server, thread). Stop
     with ``server.shutdown()``."""
-    server = make_server(store, host, port, resident=resident, warm=warm)
+    server = make_server(
+        store, host, port, resident=resident, warm=warm, sched=sched
+    )
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread

@@ -759,6 +759,12 @@ class FileSystemDataStore:
             if len(sub.batch):
                 out = _post_process(sub.batch, outer_plan)
                 if len(out):
+                    if any(out is c for c in st.cache.values()):
+                        # the internal_scan alias fast path can surface
+                        # the partition cache's own batch when the outer
+                        # post-process is a no-op — copy before yielding
+                        # (same guard as _query_locked)
+                        out = out.take(np.arange(len(out)))
                     yield out
 
     def query(self, type_name: str, query: "Query | str | ast.Filter" = ast.Include) -> QueryResult:

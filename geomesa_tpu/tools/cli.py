@@ -21,6 +21,10 @@ Runner/IngestCommand/ExportCommand/ExplainCommand/StatsCommand):
     geomesa-tpu reindex        --root DIR -f NAME --index z2
     geomesa-tpu repartition    --root DIR -f NAME [--scheme daily,z2-2bit]
     geomesa-tpu compact        --root DIR -f NAME
+    geomesa-tpu serve          --root DIR [--resident] [--warm] [--sched]
+    geomesa-tpu load-driver    --root DIR -f NAME [-q CQL] [--threads M]
+                               [--requests N] [--loose] (concurrent-serving
+                               load: throughput, p50/p99, fusion factor)
     geomesa-tpu env | version
 
 The store root is a FileSystemDataStore directory (Parquet partitions +
@@ -480,6 +484,34 @@ def cmd_stats_analyze(args):
 
 
 
+def _sched_config(args):
+    """SchedConfig from the --sched* flags, or None when --sched is off."""
+    if not getattr(args, "sched", False):
+        return None
+    from geomesa_tpu.sched import SchedConfig
+
+    return SchedConfig(
+        max_queue=args.sched_queue,
+        max_inflight=args.sched_workers,
+        fusion_window_ms=args.sched_fusion_ms,
+    )
+
+
+def _add_sched_flags(sp):
+    sp.add_argument(
+        "--sched", action="store_true",
+        help="route queries through the device query scheduler "
+        "(bounded admission -> 429 on overload, deadlines, priority "
+        "lanes, micro-batch scan fusion; see /stats/sched)",
+    )
+    sp.add_argument("--sched-queue", type=int, default=128,
+                    help="admission queue bound (backpressure point)")
+    sp.add_argument("--sched-workers", type=int, default=2,
+                    help="in-flight concurrency cap (worker threads)")
+    sp.add_argument("--sched-fusion-ms", type=float, default=2.0,
+                    help="micro-batch fusion window in milliseconds")
+
+
 def cmd_serve(args):
     """Serve the store over HTTP (GeoServer-bridge analog)."""
     from geomesa_tpu.server import make_server
@@ -487,15 +519,113 @@ def cmd_serve(args):
     store = _store(args)
     server = make_server(
         store, args.host, args.port, resident=args.resident,
-        warm=getattr(args, "warm", False),
+        warm=getattr(args, "warm", False), sched=_sched_config(args),
     )
     host, port = server.server_address[:2]
     mode = " (resident device caches)" if args.resident else ""
+    if getattr(args, "sched", False):
+        mode += " (query scheduler)"
     print(f"serving {store.root} on http://{host}:{port}{mode}")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         server.shutdown()
+
+
+def cmd_load_driver(args):
+    """Concurrent load driver: M threads x N requests against a serving
+    endpoint (an already-running --url, or a self-served resident store),
+    reporting throughput, latency percentiles, shed load (429s) and the
+    scheduler's fusion counters from /stats/sched."""
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+    from urllib.parse import quote
+
+    url, server = args.url, None
+    if url is None:
+        from geomesa_tpu.sched import SchedConfig
+        from geomesa_tpu.server import serve_background
+
+        store = _store(args)
+        server, _ = serve_background(
+            store, resident=args.resident,
+            sched=SchedConfig(  # self-serve always schedules
+                max_queue=args.sched_queue,
+                max_inflight=args.sched_workers,
+                fusion_window_ms=args.sched_fusion_ms,
+            ),
+        )
+        host, port = server.server_address[:2]
+        url = f"http://{host}:{port}"
+    target = (
+        f"{url}/{args.endpoint}/{args.feature_name}"
+        f"?cql={quote(args.cql or 'INCLUDE')}"
+    )
+    if args.loose:
+        target += "&loose=1"
+    if args.lane:
+        target += f"&lane={args.lane}"
+    # warm one request: first-touch staging/XLA compile is not load
+    try:
+        with urllib.request.urlopen(target, timeout=300) as r:
+            r.read()
+    except urllib.error.HTTPError as e:
+        sys.exit(f"error: warmup request failed with HTTP {e.code} "
+                 f"({e.read().decode(errors='replace')[:200]})")
+    lats: list = []
+    shed = [0, 0]  # 429s, other errors
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(args.requests):
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(target, timeout=120) as r:
+                    r.read()
+            except urllib.error.HTTPError as e:
+                with lock:
+                    shed[0 if e.code == 429 else 1] += 1
+                continue
+            with lock:
+                lats.append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=worker) for _ in range(args.threads)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lats.sort()
+    rep = {
+        "url": target,
+        "threads": args.threads,
+        "requests": args.threads * args.requests,
+        "ok": len(lats),
+        "rejected_429": shed[0],
+        "errors": shed[1],
+        "wall_s": round(wall, 3),
+        "qps": round(len(lats) / wall, 1) if wall > 0 else None,
+        "p50_ms": round(lats[len(lats) // 2] * 1e3, 2) if lats else None,
+        "p99_ms": (
+            round(lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 2)
+            if lats
+            else None
+        ),
+    }
+    try:
+        with urllib.request.urlopen(f"{url}/stats/sched", timeout=10) as r:
+            rep["sched"] = json.loads(r.read())
+    except Exception:
+        pass  # no scheduler on the target: latency numbers still stand
+    print(json.dumps(rep, indent=2))
+    if server is not None:
+        server.shutdown()
+        server.scheduler.shutdown(timeout=2.0)
 
 
 def cmd_count(args):
@@ -654,6 +784,26 @@ def main(argv=None) -> None:
         "serving kernels before accepting traffic (no request pays a "
         "first-touch staging or XLA compile)",
     )
+    _add_sched_flags(sp)
+
+    sp = add("load-driver", cmd_load_driver)
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-q", "--cql")
+    sp.add_argument("--url", help="existing server base URL; omit to "
+                    "self-serve --root with a resident scheduler")
+    sp.add_argument("--endpoint", default="count",
+                    choices=["count", "features", "density", "knn"])
+    sp.add_argument("--threads", type=int, default=8)
+    sp.add_argument("--requests", type=int, default=25,
+                    help="requests per thread")
+    sp.add_argument("--loose", action="store_true",
+                    help="key-only (fusable) scans: loose=1")
+    sp.add_argument("--lane", choices=["interactive", "batch"])
+    sp.add_argument("--resident", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="self-serve in resident mode (--no-resident "
+                    "load-tests the store path instead)")
+    _add_sched_flags(sp)
 
     args = p.parse_args(argv)
     try:

@@ -1,0 +1,326 @@
+"""Device query scheduler: micro-batch scan fusion (fewer launches than
+queries, serial-exact results), backpressure (429, never deadlocks),
+deadline expiry, priority lanes, tenant fairness — plus regression tests
+for the partition-cache aliasing, NaT floordiv, and DBF-date fixes that
+ride this PR."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from urllib.parse import quote
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter.ecql import parse_instant
+from geomesa_tpu.sched import (
+    LANE_BATCH,
+    DeadlineExpired,
+    FusableQuery,
+    QueryScheduler,
+    RejectedError,
+    SchedConfig,
+)
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+
+
+def _fill(ds, type_name="gdelt", n=3000, seed=5):
+    ds.create_schema(type_name, SPEC)
+    rng = np.random.default_rng(seed)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write(type_name, {
+        "name": rng.choice(["a", "b"], n),
+        "dtg": t0 + rng.integers(0, 10**8, n),
+        "geom": np.stack(
+            [rng.uniform(-60, 60, n), rng.uniform(-40, 40, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+
+
+@pytest.fixture(scope="module")
+def resident_di():
+    from geomesa_tpu.device_cache import StreamingDeviceIndex
+
+    ds = MemoryDataStore()
+    _fill(ds)
+    return ds, StreamingDeviceIndex(ds, "gdelt", z_planes=True)
+
+
+QUERIES = [
+    f"BBOX(geom, {x0}, {y0}, {x0 + 18}, {y0 + 15})"
+    for x0, y0 in [(-50, -30), (-20, -10), (0, 0), (15, 5),
+                   (-40, 10), (5, -25), (-10, -5), (25, 15)]
+]
+
+
+def _gate_scheduler(**cfg):
+    """Scheduler with one worker parked on a gate, so later submissions
+    pile into the queue deterministically."""
+    sched = QueryScheduler(SchedConfig(
+        max_inflight=1, default_deadline_ms=None, **cfg
+    ))
+    gate = threading.Event()
+    started = threading.Event()
+    sched.submit(fn=lambda: (started.set(), gate.wait(10)) and None)
+    assert started.wait(5), "worker never claimed the blocker"
+    return sched, gate
+
+
+# -- micro-batch fusion ------------------------------------------------------
+
+
+def test_fused_device_results_match_serial(resident_di):
+    """The batched launch (counts AND demuxed feature sets) is exactly
+    the serial loose execution, query by query."""
+    _, di = resident_di
+    serial = [di.count(q, loose=True) for q in QUERIES]
+    assert sum(serial) > 0  # the windows actually hit data
+    fused = di.fused_loose_counts(QUERIES, loose=True)
+    assert fused == serial
+    batches = di.fused_loose_query(QUERIES, loose=True)
+    assert batches is not None
+    for q, got in zip(QUERIES, batches):
+        want = di.query(q, loose=True)
+        np.testing.assert_array_equal(got.fids, want.fids)
+
+
+def test_fused_declines_unanswerable_groups(resident_di):
+    """A filter the key planes cannot answer poisons the whole group:
+    fusion declines (None) and callers run serial — never wrong."""
+    _, di = resident_di
+    assert di.fused_loose_counts(
+        [QUERIES[0], "name = 'a'"], loose=True
+    ) is None
+    assert di.fused_loose_counts(QUERIES[:2], loose=False) is None
+
+
+def test_scheduler_fuses_concurrent_queries(resident_di):
+    """K compatible queued queries execute in strictly fewer device
+    launches than K, with per-query results identical to serial."""
+    _, di = resident_di
+    serial = [di.count(q, loose=True) for q in QUERIES]
+    sched, gate = _gate_scheduler(fusion_window_ms=25.0)
+    try:
+        reqs = [
+            sched.submit(fuse=FusableQuery(di, q, "count", loose=True))
+            for q in QUERIES
+        ]
+        gate.set()
+        got = [sched.wait(r) for r in reqs]
+        assert got == serial
+        assert sched.fused_queries >= len(QUERIES)
+        # 1 launch for the gate blocker + the fused group(s): strictly
+        # fewer than one launch per query
+        assert sched.launches < 1 + len(QUERIES)
+        snap = sched.snapshot()
+        assert snap["fusion_factor"] is not None
+        assert snap["fusion_factor"] > 1.0
+    finally:
+        gate.set()
+        sched.shutdown()
+
+
+# -- admission control / backpressure ----------------------------------------
+
+
+def test_backpressure_rejects_and_never_deadlocks():
+    sched, gate = _gate_scheduler(max_queue=2, fusion_window_ms=0)
+    try:
+        r1 = sched.submit(fn=lambda: 1)
+        r2 = sched.submit(fn=lambda: 2)
+        with pytest.raises(RejectedError) as ei:
+            sched.submit(fn=lambda: 3)
+        assert ei.value.retry_after_s > 0
+        gate.set()
+        assert sched.wait(r1) == 1
+        assert sched.wait(r2) == 2
+        assert sched.rejected == 1
+        # queue drained: admission opens again
+        assert sched.run(fn=lambda: 4) == 4
+    finally:
+        gate.set()
+        sched.shutdown()
+
+
+def test_deadline_expires_in_queue():
+    sched, gate = _gate_scheduler(fusion_window_ms=0)
+    try:
+        req = sched.submit(fn=lambda: 1, deadline_ms=30.0)
+        with pytest.raises(DeadlineExpired):
+            sched.wait(req)
+        assert sched.expired >= 1
+        gate.set()
+        # the expired request is never executed, the queue keeps moving
+        assert sched.run(fn=lambda: 2) == 2
+    finally:
+        gate.set()
+        sched.shutdown()
+
+
+def test_priority_and_tenant_fairness():
+    sched, gate = _gate_scheduler(fusion_window_ms=0)
+    try:
+        order: list = []
+        rs = []
+        # batch lane first in, interactive still served first
+        rs.append(sched.submit(
+            fn=lambda: order.append("batch"), lane=LANE_BATCH
+        ))
+        # noisy tenant A enqueues 3 before quiet tenant B's one; round-
+        # robin serves B after A's first, not after A's third
+        for i in range(3):
+            rs.append(sched.submit(
+                fn=lambda i=i: order.append(f"A{i}"), tenant="A"
+            ))
+        rs.append(sched.submit(fn=lambda: order.append("B0"), tenant="B"))
+        gate.set()
+        for r in rs:
+            sched.wait(r)
+        assert order[-1] == "batch"  # interactive lane drains first
+        assert order.index("B0") < order.index("A2")  # fairness rotation
+    finally:
+        gate.set()
+        sched.shutdown()
+
+
+# -- server integration ------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def test_server_queue_full_returns_429():
+    from geomesa_tpu.server import serve_background
+
+    ds = MemoryDataStore()
+    _fill(ds, n=50)
+    server, _ = serve_background(
+        ds, sched=SchedConfig(max_queue=0, max_inflight=1)
+    )
+    host, port = server.server_address[:2]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"http://{host}:{port}/count/gdelt?cql=INCLUDE")
+        assert ei.value.code == 429
+        assert float(ei.value.headers["Retry-After"]) > 0
+    finally:
+        server.shutdown()
+
+
+def test_server_concurrent_fusion_and_stats_endpoint():
+    """End to end: concurrent loose bbox counts against a resident
+    scheduled server return serial-exact answers, /stats/sched reports a
+    fusion factor above 1 (fewer launches than queries)."""
+    from geomesa_tpu.server import serve_background
+
+    ds = MemoryDataStore()
+    _fill(ds, n=2000, seed=11)
+    server, _ = serve_background(
+        ds, resident=True,
+        sched=SchedConfig(
+            max_inflight=1, fusion_window_ms=25.0, max_queue=512
+        ),
+    )
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+    urls = [
+        f"{base}/count/gdelt?cql={quote(q)}&loose=1" for q in QUERIES[:4]
+    ]
+    try:
+        # warmup doubles as the serially-executed oracle (a lone request
+        # is a group of one: plain serial execution)
+        expect = [json.loads(_get(u)[2])["count"] for u in urls]
+        di = server.RequestHandlerClass._resident_cache["gdelt"]
+        assert expect == [
+            di.count(q, loose=True) for q in QUERIES[:4]
+        ]
+        bad: list = []
+        lock = threading.Lock()
+
+        def worker(tid):
+            for i in range(6):
+                j = (tid + i) % len(urls)
+                got = json.loads(_get(urls[j])[2])["count"]
+                if got != expect[j]:
+                    with lock:
+                        bad.append((j, got, expect[j]))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not bad, bad
+        status, _, body = _get(f"{base}/stats/sched")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["queries"] >= 52  # 4 warm + 48 concurrent
+        assert doc["launches"] < doc["queries"]
+        assert doc["fusion_factor"] > 1.0
+        assert doc["rejected"] == 0
+        # the scheduler counters also reach the Prometheus registry
+        _, _, metrics_body = _get(f"{base}/metrics")
+        assert b"geomesa_sched_launches_total" in metrics_body
+    finally:
+        server.shutdown()
+
+
+# -- satellite regressions ---------------------------------------------------
+
+
+def test_query_partitions_does_not_alias_partition_cache(tmp_path):
+    """A full-match query_partitions yield must be a copy: mutating it
+    cannot tear the FS store's partition cache (ADVICE round 5)."""
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    ds = FileSystemDataStore(str(tmp_path), partition_size=4096)
+    _fill(ds, n=500)
+    ds.flush("gdelt")
+    before = ds.query("gdelt", "INCLUDE").batch.columns["name"].copy()
+    parts = list(ds.query_partitions("gdelt"))
+    assert parts
+    for b in parts:
+        b.columns["name"][:] = "corrupted"
+    after = ds.query("gdelt", "INCLUDE").batch.columns["name"]
+    np.testing.assert_array_equal(after, before)
+
+
+def test_floordiv_exact_with_nat_sentinel():
+    """INT64_MIN (datetime64 NaT) must route to the exact // path: the
+    old np.abs guard overflowed it back negative and took the float
+    reciprocal path (ADVICE round 5)."""
+    from geomesa_tpu.curves.binnedtime import WEEK_MS, _floordiv_i64
+
+    rng = np.random.default_rng(3)
+    a = rng.integers(0, 10**12, 1 << 16).astype(np.int64)
+    a[0] = np.iinfo(np.int64).min  # NaT sentinel
+    a[1] = np.iinfo(np.int64).min + 1
+    np.testing.assert_array_equal(_floordiv_i64(a, WEEK_MS), a // WEEK_MS)
+    np.testing.assert_array_equal(_floordiv_i64(a, 1000), a // 1000)
+
+
+def test_dbf_header_last_update_date_is_current():
+    """The DBF header packs years-since-1900: a hardcoded 26 decoded as
+    1926. It now derives from today (ADVICE round 5)."""
+    import datetime
+
+    from geomesa_tpu.convert.shp import write_shp
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.features.sft import SimpleFeatureType
+
+    sft = SimpleFeatureType.create("t", "name:String,*geom:Point")
+    batch = FeatureBatch.from_columns(
+        sft, {"name": ["x"], "geom": np.array([[1.0, 2.0]])}, fids=[0]
+    )
+    _, _, dbf = write_shp(batch)
+    today = datetime.date.today()
+    assert dbf[1] == min(today.year - 1900, 255)
+    assert dbf[2] == today.month
+    assert dbf[3] == today.day

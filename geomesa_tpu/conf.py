@@ -17,6 +17,12 @@ Properties:
                                 (ref geomesa.scan.block.full.table)
 - ``query.max.features``        global cap on returned features; 0 = off
 - ``scan.chunk``                KV scan deserialization chunk size
+- ``io.workers``                host-I/O pipeline decode threads (0 =
+                                serial; store/prefetch.py)
+- ``io.readahead``              partition chunks in flight ahead of the
+                                consumer (0 = auto: 2 x workers)
+- ``io.queue.bytes``            byte budget for decoded chunks waiting
+                                in the prefetch queue (0 = unbounded)
 """
 
 from __future__ import annotations
@@ -40,6 +46,11 @@ _DEFS = {
     "query.loose.bbox": (False, _parse_bool),
     "query.max.features": (0, int),  # 0 = unlimited
     "scan.chunk": (8192, int),  # KV scan deserialization chunk rows
+    # host-I/O prefetch pipeline (store/prefetch.py): partition reads,
+    # Arrow decode and column staging overlap the consumer on threads
+    "io.workers": (4, int),  # 0 = serial host I/O (no pipeline threads)
+    "io.readahead": (0, int),  # chunks in flight; 0 = auto (2 x workers)
+    "io.queue.bytes": (256 << 20, int),  # decoded-queue byte budget; 0 = off
 }
 
 _overrides: dict = {}

@@ -4,8 +4,9 @@ attribute re-index; and geomesa-tools LocalConverterIngest's thread pool
 [UNVERIFIED - empty reference mount]).
 
 The reference distributes these over MapReduce; here the same jobs run on
-host thread pools over files/partitions (numpy + pyarrow release the GIL
-for the heavy parts), with the store APIs doing the per-chunk work:
+the shared host-I/O pipeline (store/prefetch.py) over files/partitions
+(numpy + pyarrow release the GIL for the heavy parts), with the store
+APIs doing the per-chunk work:
 
 - ``parallel_ingest``     -- converter thread pool over input files
 - ``parallel_export``     -- one output file per storage partition
@@ -18,8 +19,6 @@ for the heavy parts), with the store APIs doing the per-chunk work:
 from __future__ import annotations
 
 import os
-import threading
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
 
@@ -37,44 +36,66 @@ def parallel_ingest(
     converter_config: dict,
     files: "list[str]",
     workers: int = 4,
+    readahead: int = 0,
 ) -> IngestReport:
-    """Ingest many files through a converter on a thread pool (ref:
-    LocalConverterIngest / DistributedConverterIngest). Each worker parses
-    independently; writes are serialized into the store under a lock (the
-    store's pending-batch list is not thread-safe)."""
+    """Ingest many files through a converter on the host-I/O pipeline
+    (ref: LocalConverterIngest / DistributedConverterIngest). Workers
+    read + parse with bounded read-ahead (``readahead``; 0 = auto) while
+    this thread writes the decoded batches into the store IN FILE ORDER
+    — writes need no lock (single consumer) and the store's pending list
+    fills deterministically regardless of worker count, so an ingest
+    replay is byte-identical to a serial one. Parse failures are
+    collected per file, never kill the pipeline."""
+    import dataclasses
+
     from geomesa_tpu.convert import converter_for
+    from geomesa_tpu.store.prefetch import (
+        PrefetchConfig,
+        batch_nbytes,
+        prefetch_map,
+    )
 
     sft = store.get_schema(type_name)
     conv_factory = lambda: converter_for(converter_config, sft)  # noqa: E731
     binary = getattr(conv_factory(), "binary", False)
-    lock = threading.Lock()
     success = failed = 0
     errors: list = []
 
-    def one(path: str):
-        nonlocal success, failed
+    def parse(path: str):
         conv = conv_factory()  # converters are cheap; avoid shared state
         try:
             with open(path, "rb" if binary else "r") as fh:
-                res = conv.process(fh.read())
-        except Exception as e:  # collect, don't kill the pool
-            with lock:
-                errors.append((path, str(e)))
-            return
-        with lock:
-            store.write(type_name, res.batch)
-            success += res.success
-            failed += res.failed
+                return path, conv.process(fh.read()), None
+        except Exception as e:  # collect, don't kill the pipeline
+            return path, None, str(e)
 
-    if workers <= 1 or len(files) <= 1:
-        for p in files:
-            one(p)
-    else:
+    n_workers = 0 if len(files) <= 1 else max(int(workers), 0)
+    if n_workers > 0:
         from geomesa_tpu.pyarrow_compat import preload_pyarrow
 
         preload_pyarrow()
-        with ThreadPoolExecutor(max_workers=workers) as pool:
-            list(pool.map(one, files))
+    # workers/readahead are this job's explicit args; the queue byte
+    # budget still honors io.queue.bytes (--io-queue-mb) so parsed
+    # batches waiting for the writer stay bounded
+    cfg = dataclasses.replace(
+        PrefetchConfig.from_props(),
+        workers=n_workers,
+        depth=int(readahead),
+    )
+
+    def parsed_bytes(item) -> int:
+        _, res, _ = item
+        return batch_nbytes(res.batch) if res is not None else 0
+
+    for path, res, err in prefetch_map(
+        parse, files, cfg, size_of=parsed_bytes
+    ):
+        if err is not None:
+            errors.append((path, err))
+            continue
+        store.write(type_name, res.batch)
+        success += res.success
+        failed += res.failed
     if hasattr(store, "flush"):
         store.flush(type_name)
     return IngestReport(len(files), success, failed, errors)
@@ -90,14 +111,20 @@ def parallel_export(
 ) -> "list[str]":
     """Export query results as one file per storage partition (ref:
     distributed export / GeoMesaOutputFormat). Stores without partitioned
-    scans produce a single file. Returns the written paths."""
+    scans produce a single file. Partition scans stream through the
+    host-I/O pipeline: file WRITES run on worker threads with bounded
+    read-ahead while this thread keeps scanning the next partition, and
+    the whole result set is never materialized at once. Returns the
+    written paths in partition order."""
+    from geomesa_tpu.store.prefetch import PrefetchConfig, prefetch_map
+
     os.makedirs(out_dir, exist_ok=True)
     qp = getattr(store, "query_partitions", None)
     if qp is not None:
-        batches = list(qp(type_name, query))
+        batches = qp(type_name, query)
     else:
         b = store.query(type_name, query).batch
-        batches = [b] if len(b) else []
+        batches = iter([b] if len(b) else [])
 
     def write_one(args) -> str:
         i, batch = args
@@ -107,14 +134,14 @@ def parallel_export(
         write_batch(batch, path, fmt)
         return path
 
-    jobs = list(enumerate(batches))
-    if workers <= 1 or len(jobs) <= 1:
-        return [write_one(j) for j in jobs]
-    from geomesa_tpu.pyarrow_compat import preload_pyarrow
+    n_workers = max(int(workers), 0)
+    if n_workers > 0:
+        from geomesa_tpu.pyarrow_compat import preload_pyarrow
 
-    preload_pyarrow()
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        return list(pool.map(write_one, jobs))
+        preload_pyarrow()
+    return list(prefetch_map(
+        write_one, enumerate(batches), PrefetchConfig(workers=n_workers)
+    ))
 
 
 def scheduled_queries(

@@ -48,6 +48,17 @@ class Gauge(_Metric):
         with self._lock:
             self._values[self.labels(**labels)] = float(v)
 
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        """Delta update — the right form when several concurrent actors
+        contribute to one gauge (each adds/removes its own share; a
+        ``set`` from any one of them would clobber the others)."""
+        key = self.labels(**labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + float(amount)
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
     def value(self, **labels) -> float:
         return self._values.get(self.labels(**labels), 0.0)
 
@@ -204,4 +215,32 @@ sched_expired = REGISTRY.counter(
 )
 sched_wait_seconds = REGISTRY.histogram(
     "geomesa_sched_wait_seconds", "queue wait before execution"
+)
+
+# host-I/O prefetch pipeline (store/prefetch.py): where the out-of-core
+# scan / FS staging / bulk ingest host time goes (read vs decode vs
+# stage), how deep the read-ahead runs, and queue occupancy in bytes
+io_read_seconds = REGISTRY.histogram(
+    "geomesa_io_read_seconds", "partition file read time (per file)"
+)
+io_decode_seconds = REGISTRY.histogram(
+    "geomesa_io_decode_seconds",
+    "Arrow-to-FeatureBatch decode time (per file)",
+)
+io_stage_seconds = REGISTRY.histogram(
+    "geomesa_io_stage_seconds",
+    "host column staging time (per slab chunk)",
+)
+io_prefetch_depth = REGISTRY.gauge(
+    "geomesa_io_prefetch_depth", "prefetch chunks in flight"
+)
+io_queue_bytes = REGISTRY.gauge(
+    "geomesa_io_queue_bytes",
+    "decoded chunk bytes waiting in the prefetch queue",
+)
+io_chunks = REGISTRY.counter(
+    "geomesa_io_chunks_total", "chunks delivered by the prefetch pipeline"
+)
+io_bytes_read = REGISTRY.counter(
+    "geomesa_io_bytes_read_total", "partition file bytes read from disk"
 )

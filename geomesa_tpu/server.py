@@ -528,7 +528,7 @@ class _Handler(BaseHTTPRequestHandler):
 
 def make_server(
     store, host: str = "127.0.0.1", port: int = 0, resident: bool = False,
-    warm: bool = False, sched=None,
+    warm: bool = False, sched=None, io=None,
 ):
     """Build a ThreadingHTTPServer bound to (host, port); port 0 picks an
     ephemeral port (see ``server.server_address``). ``resident=True``
@@ -546,12 +546,20 @@ def make_server(
     :class:`~geomesa_tpu.sched.SchedConfig` or a config instance.
     Queue-full requests get HTTP 429 + ``Retry-After``; expired
     deadlines (``deadlineMs=``) get 504; ``/stats/sched`` reports queue
-    depth, wait time and the fusion factor."""
+    depth, wait time and the fusion factor.
+
+    ``io`` overrides the store's host-I/O pipeline for partition scans
+    (a :class:`~geomesa_tpu.store.prefetch.PrefetchConfig` or an int
+    worker count; None keeps the store's own / the ``io.*`` system
+    properties). Prefetch health is visible on ``/metrics`` as the
+    ``geomesa_io_*`` series."""
     from geomesa_tpu.jaxconf import enable_compilation_cache
     from geomesa_tpu.pyarrow_compat import preload_pyarrow
 
     enable_compilation_cache()
     preload_pyarrow()  # handler threads serve Arrow; see pyarrow_compat
+    if io is not None and hasattr(store, "io"):
+        store.io = io
     scheduler = None
     if sched:
         from geomesa_tpu.sched import QueryScheduler, SchedConfig
@@ -593,12 +601,12 @@ def make_server(
 
 def serve_background(
     store, host: str = "127.0.0.1", port: int = 0, resident: bool = False,
-    warm: bool = False, sched=None,
+    warm: bool = False, sched=None, io=None,
 ):
     """Start serving on a daemon thread; returns (server, thread). Stop
     with ``server.shutdown()``."""
     server = make_server(
-        store, host, port, resident=resident, warm=warm, sched=sched
+        store, host, port, resident=resident, warm=warm, sched=sched, io=io
     )
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()

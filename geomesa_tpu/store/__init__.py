@@ -11,19 +11,25 @@
 - ``oocscan``: out-of-core streamed device scan over a partitioned
               store (datasets larger than HBM; ref: Accumulo iterators
               stream tablets)
+- ``prefetch``: the shared host-I/O pipeline feeding it — ordered
+              threaded partition read/decode/stage with bounded
+              read-ahead (ref: Accumulo BatchScanner readahead)
 """
 
 from geomesa_tpu.store.fs import FileSystemDataStore
 from geomesa_tpu.store.kv import KVDataStore, MemoryKV, SqliteKV
 from geomesa_tpu.store.memory import MemoryDataStore
 from geomesa_tpu.store.oocscan import SlabStream, StreamedDeviceScan
+from geomesa_tpu.store.prefetch import PrefetchConfig, prefetch_map
 
 __all__ = [
     "FileSystemDataStore",
     "KVDataStore",
     "MemoryKV",
     "MemoryDataStore",
+    "PrefetchConfig",
     "SlabStream",
     "SqliteKV",
     "StreamedDeviceScan",
+    "prefetch_map",
 ]

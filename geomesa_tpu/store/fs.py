@@ -112,11 +112,18 @@ class FileSystemDataStore:
         audit: bool = False,
         encoding: str = "parquet",
         mesh=None,
+        io=None,
     ):
         """``mesh``: an optional ``jax.sharding.Mesh`` — flushes then build
         their sorted indexes ON the device mesh (device key encode +
         all_to_all exchange sort, bit-identical to the host build; falls
-        back to the host path for key spaces without a device encode)."""
+        back to the host path for key spaces without a device encode).
+
+        ``io``: host-I/O pipeline config for multi-partition reads
+        (queries, flush merges, ``query_partitions`` — see
+        store/prefetch.py): a PrefetchConfig, an int worker count, or
+        None for the ``io.*`` system properties. 0 disables the pipeline
+        (serial reads)."""
         if encoding not in ("parquet", "orc"):
             raise ValueError(f"unsupported encoding {encoding!r}")
         import threading
@@ -124,6 +131,7 @@ class FileSystemDataStore:
         self.root = root
         self.partition_size = partition_size
         self.mesh = mesh
+        self.io = io
         self.encoding = encoding
         self._types: dict[str, _FsTypeState] = {}
         os.makedirs(root, exist_ok=True)
@@ -660,16 +668,119 @@ class FileSystemDataStore:
         if p.pid in st.cache:
             return st.cache[p.pid]
         with self._shared():  # never read a half-rewritten directory
-            t = _read_table(self._part_path(type_name, p), st.encoding)
-        batch = FeatureBatch.from_arrow(t, st.sft)
+            t = self._read_part_table(type_name, p)
+        # decode OUTSIDE the lock: _shared() is thread-exclusive
+        # in-process (_mem_lock), and the Arrow->FeatureBatch conversion
+        # is the heavy half — concurrent readers must overlap it
+        return self._decode_part_table(type_name, p, t, cache)
+
+    def _read_partition_unlocked(
+        self, type_name: str, p: PartitionMeta, cache: bool = False
+    ) -> FeatureBatch:
+        """Read + decode one partition file with NO locking — the caller
+        must already hold the store lock (shared or exclusive) for the
+        read's whole enclosing scan. This is the worker-thread read of
+        the prefetch pipeline under a consumer-held lock (_query_locked,
+        _read_all): workers beneath it must not touch the
+        (thread-serializing) lock themselves, or the pipeline deadlocks
+        against its own consumer."""
+        st = self._types[type_name]
+        if p.pid in st.cache:
+            return st.cache[p.pid]
+        return self._decode_part_table(
+            type_name, p, self._read_part_table(type_name, p), cache
+        )
+
+    def _read_partition_prefetch(
+        self, type_name: str, p: PartitionMeta
+    ) -> FeatureBatch:
+        """Worker-thread partition read for the out-of-core stream.
+        Guards against a mid-rewrite directory with the file lock ALONE:
+        shared flock is concurrent across threads (each acquisition is
+        its own fd, see locking.py), while _mem_lock — whose job is
+        in-memory state, not files — would serialize the workers AND
+        block every other thread's store use for the read's duration.
+        Writers still exclude these reads via the exclusive flock. Never
+        pins the partition cache (the streaming scan reads each
+        partition exactly once)."""
+        from geomesa_tpu.locking import file_lock
+
+        st = self._types[type_name]
+        if p.pid in st.cache:
+            return st.cache[p.pid]
+        # writer fence: touch (acquire+release) _mem_lock BEFORE taking
+        # the shared flock. A same-process writer holds _mem_lock while
+        # it polls for the exclusive flock; without the fence, N workers'
+        # overlapping SH flocks give near-continuous coverage and the
+        # non-blocking EX poll can starve into LockTimeout. With it, new
+        # readers queue behind the writer, in-flight reads drain (each
+        # bounded by one file), and the writer wins within ~one read.
+        # (A writer in ANOTHER process has no such fence — it may wait
+        # out in-flight reads up to its lock timeout, same flock
+        # semantics as any concurrent reader fleet.)
+        with self._mem_lock:
+            pass
+        with file_lock(self._lock_path, shared=True):
+            t = self._read_part_table(type_name, p)
+        return self._decode_part_table(type_name, p, t, cache=False)
+
+    def scan_lock_held(self) -> bool:
+        """True when THIS thread holds the store's exclusive lock —
+        prefetch consumers must then run their reads in-line (a worker
+        thread's SH flock on a fresh fd conflicts with this process's
+        held EX flock, and the worker cannot see the holder's
+        thread-local depth)."""
+        return getattr(self._lock_tl, "depth", 0) > 0
+
+    def _read_part_table(self, type_name: str, p: PartitionMeta):
+        """File -> Arrow table (timed; the prefetch pipeline's 'read'
+        stage). Locking is the CALLER's concern."""
+        from geomesa_tpu import metrics
+
+        st = self._types[type_name]
+        path = self._part_path(type_name, p)
+        with metrics.io_read_seconds.time():
+            t = _read_table(path, st.encoding)
+        try:
+            metrics.io_bytes_read.inc(os.path.getsize(path))
+        except OSError:
+            pass
+        return t
+
+    def _decode_part_table(
+        self, type_name: str, p: PartitionMeta, t, cache: bool
+    ) -> FeatureBatch:
+        """Arrow table -> FeatureBatch (timed; the pipeline's 'decode'
+        stage), optionally pinning the partition cache."""
+        from geomesa_tpu import metrics
+
+        st = self._types[type_name]
+        with metrics.io_decode_seconds.time():
+            batch = FeatureBatch.from_arrow(t, st.sft)
         if cache:
             st.cache[p.pid] = batch
         return batch
 
     def _read_all(self, type_name: str) -> FeatureBatch:
+        """Merge-read every partition through the prefetch pipeline
+        (reads + Arrow decode on worker threads, concat in partition
+        order). Callers hold the exclusive lock (flush/delete/rebuild),
+        so the lock-free worker reads are safe."""
+        from geomesa_tpu.store.prefetch import (
+            batch_nbytes,
+            prefetch_map,
+        )
+
         st = self._types[type_name]
         return FeatureBatch.concat(
-            [self._read_partition(type_name, p) for p in st.partitions]
+            list(
+                prefetch_map(
+                    lambda p: self._read_partition_unlocked(type_name, p),
+                    st.partitions,
+                    self.io,
+                    size_of=batch_nbytes,
+                )
+            )
         )
 
     # -- queries -----------------------------------------------------------
@@ -746,26 +857,47 @@ class FileSystemDataStore:
             ),
         )
         from geomesa_tpu.query.runner import _post_process
+        from geomesa_tpu.store.prefetch import batch_nbytes, prefetch_map
 
-        for p in self._pruned_parts(type_name, plan):
-            batch = self._read_partition(type_name, p)
-            local = BuiltIndex(
-                ks,
-                batch,
-                {},
-                [PartitionMeta(0, 0, len(batch), p.key_lo, p.key_hi, len(batch))],
-            )
-            sub = run_query(local, inner_plan)
-            if len(sub.batch):
-                out = _post_process(sub.batch, outer_plan)
-                if len(out):
-                    if any(out is c for c in st.cache.values()):
-                        # the internal_scan alias fast path can surface
-                        # the partition cache's own batch when the outer
-                        # post-process is a no-op — copy before yielding
-                        # (same guard as _query_locked)
-                        out = out.take(np.arange(len(out)))
-                    yield out
+        parts = self._pruned_parts(type_name, plan)
+        # read-ahead while the CALLER processes each yielded batch. No
+        # lock is held across the yields (callers may write/flush between
+        # partitions), so the workers go through the store's own LOCKED
+        # per-read path — reads serialize briefly on the store lock,
+        # decodes still overlap. If THIS thread holds the exclusive lock
+        # (a maintenance job iterating partitions in-place), workers
+        # would block forever on the consumer-held _mem_lock — degrade
+        # to the in-line serial reads, whose _shared() short-circuits on
+        # the re-entrant thread-local depth.
+        batches = prefetch_map(
+            lambda p: self._read_partition(type_name, p),
+            parts,
+            0 if self.scan_lock_held() else self.io,
+            size_of=batch_nbytes,
+        )
+        try:
+            for p, batch in zip(parts, batches):
+                local = BuiltIndex(
+                    ks,
+                    batch,
+                    {},
+                    [PartitionMeta(0, 0, len(batch), p.key_lo, p.key_hi, len(batch))],
+                )
+                sub = run_query(local, inner_plan)
+                if len(sub.batch):
+                    out = _post_process(sub.batch, outer_plan)
+                    if len(out):
+                        if out is batch:
+                            # the internal_scan alias fast path can surface
+                            # the partition's (cache-pinned) batch itself
+                            # when the outer post-process is a no-op — copy
+                            # before yielding (same guard as _query_locked;
+                            # `is batch` rather than scanning st.cache,
+                            # which prefetch workers mutate concurrently)
+                            out = out.take(np.arange(len(out)))
+                        yield out
+        finally:
+            batches.close()
 
     def query(self, type_name: str, query: "Query | str | ast.Filter" = ast.Include) -> QueryResult:
         """Partition-pruned scan over parquet files. The SHARED lock is
@@ -801,37 +933,59 @@ class FileSystemDataStore:
             query=Query(filter=plan.filter, hints={"internal_scan": True}),
         )
         from geomesa_tpu.conf import QueryTimeout, sys_prop
+        from geomesa_tpu.store.prefetch import batch_nbytes, prefetch_map
 
         timeout_ms = sys_prop("query.timeout")
         deadline = t0 + timeout_ms / 1000.0 if timeout_ms else None
-        for p in parts:
-            if deadline and _time.perf_counter() > deadline:
-                raise QueryTimeout(
-                    f"query on {type_name!r} exceeded {timeout_ms}ms"
-                )
-            batch = self._read_partition(type_name, p)
-            scanned += len(batch)
-            local = BuiltIndex(
-                ks,
-                batch,
-                {},
-                [
-                    PartitionMeta(
-                        0, 0, len(batch), p.key_lo, p.key_hi, len(batch)
+        # partition reads + Arrow decode run ahead on the prefetch
+        # pipeline (this method executes under the held shared lock, so
+        # the workers' lock-free reads are safe) while this thread scans;
+        # cache=True keeps the partition-cache semantics of the serial
+        # path. A deadline abort closes the pipeline (workers drained)
+        # via the generator's finally.
+        batches = prefetch_map(
+            lambda p: self._read_partition_unlocked(
+                type_name, p, cache=True
+            ),
+            parts,
+            self.io,
+            size_of=batch_nbytes,
+        )
+        sources = []  # the read batch behind each chunk (alias guard)
+        try:
+            for p, batch in zip(parts, batches):
+                if deadline and _time.perf_counter() > deadline:
+                    raise QueryTimeout(
+                        f"query on {type_name!r} exceeded {timeout_ms}ms"
                     )
-                ],
-            )
-            sub = run_query(local, inner_plan)
-            if len(sub.batch):
-                chunks.append(sub.batch)
+                scanned += len(batch)
+                local = BuiltIndex(
+                    ks,
+                    batch,
+                    {},
+                    [
+                        PartitionMeta(
+                            0, 0, len(batch), p.key_lo, p.key_hi, len(batch)
+                        )
+                    ],
+                )
+                sub = run_query(local, inner_plan)
+                if len(sub.batch):
+                    chunks.append(sub.batch)
+                    sources.append(batch)
+        finally:
+            batches.close()
         total = sum(p.count for p in st.partitions)
         if chunks:
             if len(chunks) == 1:
                 out = chunks[0]
-                if any(out is c for c in st.cache.values()):
+                if out is sources[0]:
                     # the aliasing fast path above only holds WITHIN this
                     # scan: a single-chunk full match would hand the
-                    # partition cache's own batch to the caller — copy
+                    # (cache-pinned) partition batch to the caller — copy.
+                    # Checked against the scan's OWN source list: another
+                    # thread's prefetch workers mutate st.cache lock-free,
+                    # so iterating st.cache.values() here would race.
                     out = out.take(np.arange(len(out)))
             else:
                 out = FeatureBatch.concat(chunks)

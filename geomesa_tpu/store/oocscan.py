@@ -28,9 +28,21 @@ Two layers:
 
     scan = StreamedDeviceScan(store, "gdelt")
     n = scan.count("BBOX(geom, -10, 35, 30, 60) AND dtg DURING ...")
+
+The HOST side of the stream is pipelined (store/prefetch.py): slab
+chunks are grouped by the manifest's partition row counts, then read +
+Arrow-decoded + column-staged on worker threads with bounded read-ahead,
+delivered as explicit ``(host_cols, source_batch)`` pairs in
+deterministic partition order — host decode of chunk i+k overlaps both
+the disk and the device kernel on slab i. ``io=`` tunes it
+(PrefetchConfig / worker count int / None = the ``io.*`` system
+properties); ``io=0`` is the serial baseline. Peak host memory is the
+in-flight chunks (read-ahead depth, byte-budgeted) — never the dataset.
 """
 
 from __future__ import annotations
+
+from collections import OrderedDict
 
 import numpy as np
 
@@ -148,16 +160,39 @@ class StreamedDeviceScan:
     does, but for datasets that exceed HBM: manifest pruning picks the
     partitions a query can touch, and only those stream through the
     slab pump. Parity contract: ``count``/``query`` match the store's
-    host path exactly (tests/test_oocscan.py). Per-filter slab kernels
-    are cached, so repeated queries recompile nothing."""
+    host path exactly, at every ``io`` worker count
+    (tests/test_oocscan.py, tests/test_prefetch.py). Per-filter slab
+    kernels are cached (bounded LRU), so repeated queries recompile
+    nothing and long-lived servers issuing many distinct filters cannot
+    grow the cache without limit."""
 
-    def __init__(self, store, type_name: str, slab_rows: "int | None" = None):
+    #: compiled-stream LRU bound: (filter, kind) entries kept hot; a
+    #: re-queried evicted filter re-jits its tiny agg wrapper, while XLA's
+    #: own executable cache still spares the actual kernel compile
+    STREAM_CACHE_MAX = 8
+
+    def __init__(
+        self,
+        store,
+        type_name: str,
+        slab_rows: "int | None" = None,
+        io=None,
+    ):
         self.store = store
         self.type_name = type_name
         self.sft = store.get_schema(type_name)
         #: target rows per slab; partitions group into slabs up to this
         self.slab_rows = slab_rows or (1 << 22)
-        self._streams: dict = {}  # (filter repr, kind) -> SlabStream
+        import threading
+
+        #: host-I/O pipeline: PrefetchConfig, an int worker count, or
+        #: None (= the ``io.*`` system properties, resolved per scan)
+        self.io = io
+        self._streams: OrderedDict = OrderedDict()
+        # the LRU's get+move_to_end / insert+evict must be atomic: server
+        # threads share one scan object, and a move_to_end racing an
+        # eviction raises KeyError on an OrderedDict
+        self._streams_lock = threading.Lock()
 
     # -- internals ---------------------------------------------------------
 
@@ -165,59 +200,128 @@ class StreamedDeviceScan:
         plan = self.store.plan(self.type_name, query)
         return plan, self.store._pruned_parts(self.type_name, plan)
 
-    def _chunks(self, parts, names, groups_sink: "list | None" = None):
-        """Yield host column dicts, grouping small partitions into
-        slab_rows-sized chunks (fewer, larger uploads). When
-        ``groups_sink`` is given, the source batches of each chunk are
-        appended to it (the query path gathers hits from them)."""
+    def _slab_groups(self, parts):
+        """Group partitions into slab_rows-sized chunks (fewer, larger
+        uploads) by the MANIFEST row counts — no reads needed, so the
+        chunk plan exists before the pipeline starts and grouping is
+        identical at every worker count (count == file rows by the
+        manifest contract)."""
         group: list = []
         rows = 0
         for p in parts:
-            # cache=False: pinning every streamed partition in the
-            # store's cache would accumulate the dataset in host RAM
-            batch = self.store._read_partition(
-                self.type_name, p, cache=False
-            )
-            group.append(batch)
-            rows += len(batch)
+            group.append(p)
+            rows += int(p.count)
             if rows >= self.slab_rows:
-                yield self._group_cols(group, names, groups_sink)
+                yield group
                 group, rows = [], 0
         if group:
-            yield self._group_cols(group, names, groups_sink)
+            yield group
 
-    @staticmethod
-    def _group_cols(group, names, groups_sink):
+    def _load_group(self, group, read, names, want_batch: bool):
+        """One pipeline work item: read + decode the group's partition
+        files, concat, stage the device planes host-side. Returns the
+        explicit ``(host_cols, source_batch)`` pair — chunk and batch
+        travel together, so the query path's hit gather can never pair a
+        mask with the wrong rows. The count path sets
+        ``want_batch=False`` and gets ``(host_cols, None)``: holding the
+        decoded rows in the queue when only the staged planes are
+        consumed would double the chunk's memory (and budget charge) for
+        nothing."""
+        from geomesa_tpu import metrics
         from geomesa_tpu.features.batch import FeatureBatch
         from geomesa_tpu.ops.scan import stage_columns_host
 
-        batch = group[0] if len(group) == 1 else FeatureBatch.concat(group)
-        if groups_sink is not None:
-            groups_sink.append(batch)
-        return stage_columns_host(batch, names)
+        batches = [read(p) for p in group]
+        batch = (
+            batches[0] if len(batches) == 1 else FeatureBatch.concat(batches)
+        )
+        with metrics.io_stage_seconds.time():
+            cols = stage_columns_host(batch, names)
+        return cols, (batch if want_batch else None)
+
+    def _pairs(self, parts, names, want_batch: bool = True):
+        """Yield ``(host_cols, source_batch)`` in deterministic partition
+        order through the prefetch pipeline. Workers use PER-READ
+        locking (same consistency window as the serial scan), so a
+        multi-minute streamed scan never pins the store lock and other
+        threads' queries interleave between partition reads; against an
+        FS store the per-read guard is the shared flock alone
+        (_read_partition_prefetch), which is concurrent across threads —
+        reads, decode and staging all overlap. Streamed partitions are
+        never pinned in the store cache — accumulating the dataset in
+        host RAM is the thing this scan exists to avoid. The queue byte
+        budget charges BOTH halves of a pair (staged planes and source
+        batch): that is what a queued chunk actually holds alive."""
+        from geomesa_tpu.store.prefetch import (
+            PrefetchConfig,
+            batch_nbytes,
+            prefetch_map,
+        )
+
+        cfg = PrefetchConfig.coerce(self.io)
+        held = getattr(self.store, "scan_lock_held", None)
+        if held is not None and held():
+            # the CALLING thread holds the store's exclusive lock (a
+            # maintenance job scanning in-place): worker threads can
+            # neither see its thread-local lock depth nor take a shared
+            # flock against our own exclusive one — degrade to in-line
+            # serial reads through the depth-aware locked reader
+            cfg = PrefetchConfig(
+                workers=0, depth=cfg.depth, byte_budget=cfg.byte_budget
+            )
+            prefetch_read = None
+        else:
+            prefetch_read = getattr(
+                self.store, "_read_partition_prefetch", None
+            )
+        if cfg.workers > 0 and prefetch_read is not None:
+            read = lambda p: prefetch_read(self.type_name, p)  # noqa: E731
+        else:
+            read = lambda p: self.store._read_partition(  # noqa: E731
+                self.type_name, p, cache=False
+            )
+        size_of = lambda pair: (  # noqa: E731
+            sum(int(v.nbytes) for v in pair[0].values())
+            + (batch_nbytes(pair[1]) if pair[1] is not None else 0)
+        )
+        yield from prefetch_map(
+            lambda g: self._load_group(g, read, names, want_batch),
+            self._slab_groups(parts),
+            cfg,
+            size_of=size_of,
+        )
 
     def _stream(self, plan, kind: str) -> SlabStream:
         import jax.numpy as jnp
 
         compiled = plan.compiled
         key = (repr(plan.filter), kind)
-        stream = self._streams.get(key)
-        if stream is None:
-            if kind == "count":
-                # int32 per-slab is safe (a slab never exceeds 2^31
-                # rows); totals accumulate in python ints
-                def agg(cols, valid):
-                    return jnp.sum(
-                        compiled.device_fn(cols) & valid, dtype=jnp.int32
-                    )
+        with self._streams_lock:
+            stream = self._streams.get(key)
+            if stream is not None:
+                self._streams.move_to_end(key)  # LRU touch
+                return stream
+        if kind == "count":
+            # int32 per-slab is safe (a slab never exceeds 2^31
+            # rows); totals accumulate in python ints
+            def agg(cols, valid):
+                return jnp.sum(
+                    compiled.device_fn(cols) & valid, dtype=jnp.int32
+                )
 
-            else:  # mask
+        else:  # mask
 
-                def agg(cols, valid):
-                    return compiled.device_fn(cols) & valid
+            def agg(cols, valid):
+                return compiled.device_fn(cols) & valid
 
-            stream = SlabStream(agg)
-            self._streams[key] = stream
+        stream = SlabStream(agg)
+        with self._streams_lock:
+            # a racing thread may have built the same stream: keep the
+            # first-installed one so both callers share its counters
+            stream = self._streams.setdefault(key, stream)
+            self._streams.move_to_end(key)
+            while len(self._streams) > self.STREAM_CACHE_MAX:
+                self._streams.popitem(last=False)  # evict least-recent
         return stream
 
     # -- public surface ----------------------------------------------------
@@ -229,16 +333,19 @@ class StreamedDeviceScan:
         compiled = plan.compiled
         if not compiled.device_cols or not compiled.fully_on_device:
             return len(self.store.query(self.type_name, query).batch)
-        outs = self._stream(plan, "count").run(
-            self._chunks(parts, compiled.device_cols)
+        outs = self._stream(plan, "count").stream(
+            self._pairs(parts, compiled.device_cols, want_batch=False)
         )
-        return int(sum(int(o) for o in outs))
+        return int(sum(int(o) for o, _ in outs))
 
     def query(self, query):
         """Streamed fused scan returning the hit FeatureBatch: device
         masks per slab, hits gathered host-side AS SLABS RETIRE (via
         SlabStream.stream) — host memory holds the hits plus the
-        in-flight slabs' source batches, never the dataset."""
+        in-flight slabs' source batches, never the dataset. The pipeline
+        delivers each chunk WITH its source batch as one tuple, so mask
+        and rows cannot skew even when the prefetcher runs chunks ahead.
+        """
         from geomesa_tpu.features.batch import FeatureBatch
         from geomesa_tpu.query.runner import _post_process
 
@@ -246,13 +353,7 @@ class StreamedDeviceScan:
         compiled = plan.compiled
         if not compiled.device_cols:
             return self.store.query(self.type_name, query).batch
-        groups: list = []
-        pairs = (
-            (cols, groups.pop(0))
-            for cols in self._chunks(
-                parts, compiled.device_cols, groups_sink=groups
-            )
-        )
+        pairs = self._pairs(parts, compiled.device_cols)
         hits: list = []
         for mask, batch in self._stream(plan, "mask").stream(pairs):
             m = np.asarray(mask)[: len(batch)]

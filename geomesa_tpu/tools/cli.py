@@ -91,6 +91,7 @@ def cmd_remove_schema(args):
 
 
 def cmd_ingest(args):
+    _apply_io_flags(args)
     store = _store(args)
     with open(args.converter) as fh:
         config = json.load(fh)
@@ -99,6 +100,7 @@ def cmd_ingest(args):
     rep = parallel_ingest(
         store, args.feature_name, config, args.files,
         workers=args.workers,
+        readahead=getattr(args, "io_readahead", None) or 0,
     )
     for path, err in rep.errors:
         print(f"  {path}: ERROR {err}", file=sys.stderr)
@@ -484,6 +486,38 @@ def cmd_stats_analyze(args):
 
 
 
+def _add_io_flags(sp):
+    sp.add_argument(
+        "--io-workers", type=int, default=None,
+        help="host-I/O pipeline decode threads for partition reads "
+        "(0 = serial; default: the io.workers system property)",
+    )
+    sp.add_argument(
+        "--io-readahead", type=int, default=None,
+        help="partition chunks in flight ahead of the consumer "
+        "(0 = auto: 2 x workers)",
+    )
+    sp.add_argument(
+        "--io-queue-mb", type=int, default=None,
+        help="byte budget (MiB) for decoded chunks waiting in the "
+        "prefetch queue (0 = unbounded)",
+    )
+
+
+def _apply_io_flags(args):
+    """Route --io-* flags into the io.* system properties — the ONE
+    config point every host-I/O path (store partition reads, the
+    out-of-core scan, bulk jobs) resolves its pipeline from."""
+    from geomesa_tpu.conf import set_prop
+
+    if getattr(args, "io_workers", None) is not None:
+        set_prop("io.workers", args.io_workers)
+    if getattr(args, "io_readahead", None) is not None:
+        set_prop("io.readahead", args.io_readahead)
+    if getattr(args, "io_queue_mb", None) is not None:
+        set_prop("io.queue.bytes", args.io_queue_mb << 20)
+
+
 def _sched_config(args):
     """SchedConfig from the --sched* flags, or None when --sched is off."""
     if not getattr(args, "sched", False):
@@ -516,6 +550,7 @@ def cmd_serve(args):
     """Serve the store over HTTP (GeoServer-bridge analog)."""
     from geomesa_tpu.server import make_server
 
+    _apply_io_flags(args)
     store = _store(args)
     server = make_server(
         store, args.host, args.port, resident=args.resident,
@@ -672,6 +707,7 @@ def main(argv=None) -> None:
     sp.add_argument("-C", "--converter", required=True, help="converter config json")
     sp.add_argument("-t", "--workers", type=int, default=4,
                     help="parser thread pool size (ref LocalConverterIngest)")
+    _add_io_flags(sp)
     sp.add_argument("files", nargs="+")
 
     sp = add("export", cmd_export)
@@ -785,6 +821,7 @@ def main(argv=None) -> None:
         "first-touch staging or XLA compile)",
     )
     _add_sched_flags(sp)
+    _add_io_flags(sp)
 
     sp = add("load-driver", cmd_load_driver)
     sp.add_argument("-f", "--feature-name", required=True)

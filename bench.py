@@ -1498,6 +1498,95 @@ def bench_pipeline(args) -> dict:
     return out
 
 
+def bench_flush(args) -> dict:
+    """Durable-flush overhead guard (ISSUE 3). The crash-consistent
+    flush writes generation-scoped files + per-partition checksums and
+    fsyncs file contents, directories and the manifest before GC'ing
+    the old generation; this leg measures that path against the same
+    flush with ``store.fsync=off`` (the seed's fire-and-forget write
+    behavior — checksums, being O(bytes) crc32 at memory speed, stay on
+    in both and are charged to the durable side's budget). ``--smoke``
+    (and ``--check``) assert the durable flush costs < 15% extra on the
+    flush leg; the full leg runs the 1M-row (2^20) size, smoke a 2^18
+    CI-sized one. Medians over fresh-store flushes (5 reps at smoke
+    size, 3 at full)."""
+    import os
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from geomesa_tpu.conf import prop_override
+    from geomesa_tpu.filter.ecql import parse_instant
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    # smoke stays big enough that the per-FILE fsync cost (fixed: ~4
+    # partition files either way) is amortized the way the 1M-row leg
+    # amortizes it — smaller sizes measure fsync latency, not the flush
+    n = args.n or ((1 << 18) if args.smoke else (1 << 20))
+    log(f"n={n:,} (flush mode: durable vs store.fsync=off)")
+    rng = np.random.default_rng(99)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    cols = {
+        "name": rng.choice(["alpha", "beta", "gamma"], n),
+        "dtg": rng.integers(t0, t1, n),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+        ),
+    }
+    fids = np.arange(n)
+
+    def one_flush(fsync: bool) -> float:
+        tmp = tempfile.mkdtemp(prefix="geomesa_flush_")
+        try:
+            with prop_override("store.fsync", fsync):
+                ds = FileSystemDataStore(
+                    os.path.join(tmp, "s"), partition_size=1 << 15
+                )
+                ds.create_schema(
+                    "gdelt", "name:String,dtg:Date,*geom:Point:srid=4326"
+                )
+                ds.write("gdelt", cols, fids=fids)
+                t = time.perf_counter()
+                ds.flush("gdelt")
+                return time.perf_counter() - t
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    # more reps at smoke size: an 80ms flush needs a sturdier median
+    # against scheduler noise than the multi-second 1M-row leg
+    reps = 5 if args.smoke else 3
+    # interleave so drifting page-cache state cannot bias one side
+    durable_s, base_s = [], []
+    for _ in range(reps):
+        base_s.append(one_flush(False))
+        durable_s.append(one_flush(True))
+    durable = sorted(durable_s)[reps // 2]
+    base = sorted(base_s)[reps // 2]
+    overhead = durable / base - 1.0
+    out = {
+        "flush_n": n,
+        "flush_durable_s": round(durable, 3),
+        "flush_nofsync_s": round(base, 3),
+        "flush_durable_rows_per_sec": round(n / durable, 1),
+        "flush_overhead_pct": round(overhead * 100, 1),
+        "flush_durable_spread_s": [round(v, 3) for v in sorted(durable_s)],
+        "flush_nofsync_spread_s": [round(v, 3) for v in sorted(base_s)],
+    }
+    log(
+        f"flush: durable {durable:.2f}s vs no-fsync {base:.2f}s "
+        f"({overhead:+.1%} overhead) at {n:,} rows"
+    )
+    if args.smoke or args.check:
+        assert overhead < 0.15, (
+            f"durable flush overhead {overhead:.1%} >= 15% "
+            f"({durable:.2f}s vs {base:.2f}s at {n:,} rows)"
+        )
+        log("flush smoke guard passed (< 15% overhead)")
+    return out
+
+
 def bench_serving(args) -> dict:
     """Concurrent-serving leg (the device query scheduler): M client
     threads fire loose bbox counts at ``serve_background(resident=True,
@@ -1786,6 +1875,7 @@ def main() -> None:
         choices=(
             "all", "filter", "zscan", "build", "polygon", "density", "sweep",
             "xzbuild", "meshbuild", "pipeline", "oocscan", "join", "serve",
+            "flush",
         ),
         default="all",
         help="all: every benchmark, one JSON line with everything (what "
@@ -1820,6 +1910,8 @@ def main() -> None:
         out = bench_join(args)
     elif args.mode == "serve":
         out = bench_serving(args)
+    elif args.mode == "flush":
+        out = bench_flush(args)
     else:
         # zscan FIRST: its DeviceIndex staging is a long sequence of
         # host->device transfers that measures 20-30x slower when another

@@ -23,6 +23,17 @@ Properties:
                                 consumer (0 = auto: 2 x workers)
 - ``io.queue.bytes``            byte budget for decoded chunks waiting
                                 in the prefetch queue (0 = unbounded)
+- ``io.retries``                transient-read retries per partition read
+                                beyond the first attempt (0 = no retry)
+- ``io.backoff.ms``             base backoff before a read retry, doubling
+                                per attempt (bounded exponential)
+- ``store.verify``              partition checksum verification: ``off``,
+                                ``open`` (verify every file at store
+                                open), ``always`` (verify on every read)
+- ``store.fsync``               fsync partition files, directories and
+                                manifests on flush (crash durability;
+                                ``off`` trades it for speed, e.g. tmpfs
+                                or throwaway benchmark stores)
 """
 
 from __future__ import annotations
@@ -33,6 +44,15 @@ from contextlib import contextmanager
 
 def _parse_bool(v) -> bool:
     return str(v).strip().lower() in ("true", "1", "t", "yes", "on")
+
+
+def _parse_verify(v) -> str:
+    s = str(v).strip().lower()
+    if s not in ("off", "open", "always"):
+        raise ValueError(
+            f"store.verify must be off, open or always, not {v!r}"
+        )
+    return s
 
 
 from geomesa_tpu.curves.zranges import DEFAULT_MAX_RANGES
@@ -51,6 +71,14 @@ _DEFS = {
     "io.workers": (4, int),  # 0 = serial host I/O (no pipeline threads)
     "io.readahead": (0, int),  # chunks in flight; 0 = auto (2 x workers)
     "io.queue.bytes": (256 << 20, int),  # decoded-queue byte budget; 0 = off
+    # transient-read resilience (prefetch workers): retries beyond the
+    # first attempt, with io.backoff.ms * 2^attempt sleeps between them
+    "io.retries": (2, int),
+    "io.backoff.ms": (25.0, float),
+    # crash-consistency knobs (store/fs.py): read-side checksum
+    # verification scope, and whether flushes fsync what they publish
+    "store.verify": ("off", _parse_verify),
+    "store.fsync": (True, _parse_bool),
 }
 
 _overrides: dict = {}

@@ -1,0 +1,162 @@
+"""Named fault-injection points for crash-consistency testing.
+
+Ref role: the failpoint harnesses durable stores grow once crash
+consistency becomes a contract (Accumulo's fate-sharing kill tests;
+LevelDB/RocksDB ``SyncPoint``/fault-injection env [UNVERIFIED - empty
+reference mount]). A failpoint is a named hook compiled into the hot
+path as a cheap dictionary probe; armed, it kills the process, raises,
+or raises-N-times-then-passes, letting the chaos suite SIGKILL a
+flushing subprocess at every interesting instant and letting unit tests
+inject transient read errors without touching the filesystem.
+
+Points honored by the store layer (fs.py / prefetch.py):
+
+- ``fail.flush.after_write``    -- new-generation partition files written
+                                   (+checksummed), nothing published
+- ``fail.flush.before_publish`` -- manifest about to atomically publish
+- ``fail.flush.after_publish``  -- manifest published, old generation
+                                   not yet garbage-collected
+- ``fail.read.io``              -- partition file about to be read
+                                   (transient: the prefetch retry path)
+- ``fail.read.corrupt``         -- partition read reports a checksum
+                                   mismatch (exercises quarantine)
+
+Activation: programmatic (``set_failpoint``/``failpoint_override``) or
+the ``GEOMESA_TPU_FAILPOINTS`` environment variable, a comma-separated
+``name=action`` list — the env form is how a chaos test arms a point in
+a subprocess it is about to kill. Actions:
+
+- ``kill``     -- SIGKILL this process (the crash simulator)
+- ``exit[:N]`` -- ``os._exit(N)`` (default 1)
+- ``raise``    -- raise :class:`FailpointError` every evaluation
+- ``raise:N``  -- raise for the first N evaluations, then pass
+                  (transient-error injection for retry paths)
+- ``off``      -- disarmed (same as absent)
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from contextlib import contextmanager
+
+__all__ = [
+    "FailpointError",
+    "POINTS",
+    "clear_failpoint",
+    "fail_hit",
+    "fail_point",
+    "failpoint_override",
+    "set_failpoint",
+]
+
+ENV_VAR = "GEOMESA_TPU_FAILPOINTS"
+
+#: the named points the store layer evaluates (documentation/validation
+#: aid -- arbitrary names are accepted so subsystems can add their own)
+POINTS = (
+    "fail.flush.after_write",
+    "fail.flush.before_publish",
+    "fail.flush.after_publish",
+    "fail.read.io",
+    "fail.read.corrupt",
+)
+
+
+class FailpointError(OSError):
+    """Raised by a ``raise`` action. An OSError so injected transient
+    read failures ride the same retry handler as real I/O errors."""
+
+
+_lock = threading.Lock()
+_overrides: "dict[str, str]" = {}
+_counts: "dict[str, int]" = {}
+# (raw env string, parsed) -- re-parsed only when the env value changes,
+# so per-evaluation cost with no failpoints armed is two dict probes
+_env_cache: "tuple[str | None, dict]" = (None, {})
+
+
+def _parse(spec: str) -> dict:
+    out: dict = {}
+    for pair in spec.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        name, _, action = pair.partition("=")
+        out[name.strip()] = (action or "raise").strip()
+    return out
+
+
+def _env_actions() -> dict:
+    global _env_cache
+    raw = os.environ.get(ENV_VAR)
+    if raw == _env_cache[0]:
+        return _env_cache[1]
+    parsed = _parse(raw) if raw else {}
+    _env_cache = (raw, parsed)
+    return parsed
+
+
+def action_for(name: str) -> "str | None":
+    """The armed action for ``name`` (programmatic override wins over
+    the environment), or None when disarmed."""
+    if name in _overrides:
+        return _overrides[name]
+    return _env_actions().get(name)
+
+
+def set_failpoint(name: str, action: str) -> None:
+    with _lock:
+        _overrides[name] = action
+        _counts.pop(name, None)  # fresh raise:N budget
+
+
+def clear_failpoint(name: str) -> None:
+    with _lock:
+        _overrides.pop(name, None)
+        _counts.pop(name, None)
+
+
+@contextmanager
+def failpoint_override(name: str, action: str):
+    """Arm ``name`` for the with-body, restoring the previous state."""
+    prev = _overrides.get(name)
+    set_failpoint(name, action)
+    try:
+        yield
+    finally:
+        if prev is None:
+            clear_failpoint(name)
+        else:
+            set_failpoint(name, prev)
+
+
+def fail_hit(name: str) -> bool:
+    """Evaluate a failpoint, RETURNING True instead of raising for
+    ``raise`` actions — for sites that inject their own domain failure
+    (e.g. a simulated checksum mismatch). ``kill``/``exit`` still
+    terminate the process."""
+    action = action_for(name)
+    if not action or action == "off":
+        return False
+    base, _, arg = action.partition(":")
+    if base == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    if base == "exit":
+        os._exit(int(arg or 1))
+    if base == "raise":
+        if arg:  # raise:N -- only the first N evaluations fire
+            with _lock:
+                seen = _counts.get(name, 0)
+                if seen >= int(arg):
+                    return False
+                _counts[name] = seen + 1
+        return True
+    raise ValueError(f"unknown failpoint action {action!r} for {name!r}")
+
+
+def fail_point(name: str) -> None:
+    """Evaluate a failpoint at a named site; no-op unless armed."""
+    if fail_hit(name):
+        raise FailpointError(f"failpoint {name} triggered")

@@ -68,6 +68,10 @@ class PartitionMeta:
     bbox: "tuple[float, float, float, float] | None" = None
     time_range: "tuple[int, int] | None" = None
     leaf: "str | None" = None  # fs partition-scheme directory leaf
+    #: content integrity record for the partition FILE (fs stores only):
+    #: {"algo": "crc32"|"crc32c", "value": int, "length": bytes} --
+    #: written at flush, verified on read per the store.verify knob
+    checksum: "dict | None" = None
 
     def overlaps(self, r: KeyRange) -> bool:
         return not (r.hi < self.key_lo or r.lo > self.key_hi)

@@ -34,7 +34,14 @@ def file_lock(
     poll_s: float = 0.02,
 ):
     """Hold ``path`` flock'd (exclusive by default) for the with-body.
-    Raises LockTimeout if another holder keeps it past ``timeout_s``."""
+    Raises LockTimeout if another holder keeps it past ``timeout_s``.
+
+    Exclusive holders record their pid in the sentinel file so a
+    timeout can name the (last) writer holding things up; the poll
+    sleeps with jitter so a fleet of starved waiters does not resync
+    into lockstep probes against the holder's release window."""
+    import random
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
     flags = (fcntl.LOCK_SH if shared else fcntl.LOCK_EX) | fcntl.LOCK_NB
@@ -46,10 +53,31 @@ def file_lock(
                 break
             except (BlockingIOError, InterruptedError):
                 if time.monotonic() >= deadline:
+                    holder = ""
+                    try:
+                        with open(path) as fh:
+                            holder = fh.read(64).strip()
+                    except OSError:
+                        pass
+                    held = (
+                        f" (last exclusive holder: pid {holder})"
+                        if holder
+                        else ""
+                    )
                     raise LockTimeout(
-                        f"lock {path!r} not acquired within {timeout_s}s"
+                        f"lock {path!r} not acquired within "
+                        f"{timeout_s}s{held}"
                     ) from None
-                time.sleep(poll_s)
+                time.sleep(poll_s * (1.0 + random.random()))
+        if not shared:
+            # debuggability only (concurrent SH holders would race a
+            # write, and the pid intentionally persists after release
+            # as "last holder"): never let it fail an acquisition
+            try:
+                os.ftruncate(fd, 0)
+                os.pwrite(fd, str(os.getpid()).encode(), 0)
+            except OSError:
+                pass
         yield
     finally:
         try:

@@ -244,3 +244,34 @@ io_chunks = REGISTRY.counter(
 io_bytes_read = REGISTRY.counter(
     "geomesa_io_bytes_read_total", "partition file bytes read from disk"
 )
+
+# crash-consistent FS store (store/fs.py): generation publishes, what
+# the recovery sweep reclaimed from interrupted flushes, checksum
+# verification failures (and the partitions they quarantined), and
+# transient-read retries spent by the prefetch workers
+store_generations = REGISTRY.counter(
+    "geomesa_store_generations_published_total",
+    "partition-file generations atomically published by flushes",
+)
+store_orphan_files = REGISTRY.counter(
+    "geomesa_store_orphan_files_reclaimed_total",
+    "orphaned partition/tmp files reclaimed by the recovery sweep",
+)
+store_orphan_bytes = REGISTRY.counter(
+    "geomesa_store_orphan_bytes_reclaimed_total",
+    "bytes reclaimed by the recovery sweep",
+)
+store_checksum_failures = REGISTRY.counter(
+    "geomesa_store_checksum_failures_total",
+    "partition files that failed checksum verification",
+)
+store_quarantined = REGISTRY.gauge(
+    "geomesa_store_partitions_quarantined",
+    "partitions currently quarantined by checksum failures (best-effort:"
+    " summed over store instances; /stats/store has the exact per-type"
+    " sets)",
+)
+store_read_retries = REGISTRY.counter(
+    "geomesa_store_read_retries_total",
+    "transient partition-read retries by the prefetch workers",
+)

@@ -28,6 +28,8 @@ Endpoints (all GET):
 - ``/metrics``                      -- Prometheus exposition text
 - ``/stats/sched``                  -- device query scheduler counters
   (sched mode: queue depth, wait time, fusion factor, rejections)
+- ``/stats/store``                  -- store durability/integrity snapshot
+  (FS stores: generations, quarantined partitions, recovery counters)
 - ``/refresh/<type>``               -- restage a resident type after writes
 
 Scheduler mode (``make_server(store, sched=True)`` or a SchedConfig, CLI
@@ -200,6 +202,10 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             if parts == ["stats", "sched"] and self.scheduler is not None:
                 return self._json(200, self.scheduler.snapshot())
+            if parts == ["stats", "store"] and hasattr(
+                self.store, "store_stats"
+            ):
+                return self._json(200, self.store.store_stats())
             if len(parts) == 2 and parts[0] in (
                 "features", "count", "explain", "density", "stats",
                 "refresh", "knn", "tube", "proximity",

@@ -16,7 +16,7 @@
               read-ahead (ref: Accumulo BatchScanner readahead)
 """
 
-from geomesa_tpu.store.fs import FileSystemDataStore
+from geomesa_tpu.store.fs import FileSystemDataStore, PartitionCorruptError
 from geomesa_tpu.store.kv import KVDataStore, MemoryKV, SqliteKV
 from geomesa_tpu.store.memory import MemoryDataStore
 from geomesa_tpu.store.oocscan import SlabStream, StreamedDeviceScan
@@ -27,6 +27,7 @@ __all__ = [
     "KVDataStore",
     "MemoryKV",
     "MemoryDataStore",
+    "PartitionCorruptError",
     "PrefetchConfig",
     "SlabStream",
     "SqliteKV",

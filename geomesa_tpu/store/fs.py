@@ -11,11 +11,29 @@ files.
 Layout under ``root/<type_name>/``:
 
 - ``schema.json``   -- SFT spec + primary index + partition metadata
-- ``part-NNNNN.parquet`` (or ``.orc``) -- sorted partition files
+- ``schema.json.gen`` -- tiny staleness sidecar (the manifest generation)
+- ``part-<gen>-NNNNN.parquet`` (or ``.orc``) -- sorted partition files,
+  generation-scoped (legacy ``part-NNNNN.*`` names still read)
 
 Durable state is exactly this directory (the reference's "source of truth
 stays on the object store" elasticity model, SURVEY.md section 5): a store
 can be reopened from disk alone, and device/host memory is a cache.
+
+Crash consistency (write-new-then-publish, the immutable-file discipline
+of spatial-Parquet lakes / chunked Zarr stores): every flush writes a
+NEW generation of partition files next to the old one, fsyncs file
+contents and directories, atomically publishes the manifest (itself
+fsynced), and only then garbage-collects the previous generation — a
+``kill -9`` at any instant leaves a store that reopens to exactly the
+old or the new state. Interrupted-flush leftovers are reclaimed by the
+recovery sweep at open (:meth:`FileSystemDataStore.recover`, the CLI
+``fsck``). Each partition file carries a checksum + byte length in the
+manifest, verified per the ``store.verify`` knob (``off``/``open``/
+``always``); a corrupt file quarantines ONLY that partition
+(:class:`PartitionCorruptError`) while the rest keep serving. The
+``fail.flush.*``/``fail.read.*`` failpoints (:mod:`geomesa_tpu.failpoints`)
+are evaluated at every step so the chaos suite can kill a flushing
+process at each instant.
 """
 
 from __future__ import annotations
@@ -55,15 +73,31 @@ class _FsTypeState:
     scheme: "object | None" = None  # PartitionScheme, from SFT user data
     stats: "object | None" = None  # SeqStat rebuilt at flush, persisted
     generation: "str | None" = None  # manifest token last read/written
-    # a failed flush already unlinked the old files; the only copy of the
-    # data lives in the writer's in-memory `pending`. The manifest is
-    # published with this flag so OTHER processes fail loudly instead of
-    # reading an empty-but-valid dataset
+    #: generation token embedded in the partition FILE names
+    #: (``part-<file_gen>-NNNNN.*``); None = legacy un-scoped names
+    file_gen: "str | None" = None
+    # legacy manifests only: a pre-generation-era flush failed AFTER
+    # unlinking its files, so the rows exist only in that writer's
+    # memory. Readers of such a manifest fail loudly instead of seeing
+    # an empty-but-valid dataset. New flushes never set this (the old
+    # generation stays published until the new one lands).
     dirty: bool = False
     # process-local (never persisted/refreshed): True only in the process
     # whose failed flush raised the quarantine -- the one holding the data
     # in `pending`. Only that process may flush (and thereby lift) it.
     quarantine_owner: bool = False
+    #: process-local per-PARTITION quarantine: pid -> checksum error.
+    #: Reads of a quarantined partition raise PartitionCorruptError;
+    #: sibling partitions keep serving. Cleared when a new generation
+    #: is read or published.
+    quarantined: "dict[int, str]" = field(default_factory=dict)
+
+
+class PartitionCorruptError(RuntimeError):
+    """A partition file failed checksum verification (or was already
+    quarantined by an earlier failure). Scoped to ONE partition: queries
+    pruned away from it keep serving; queries touching it fail loudly
+    instead of silently dropping rows."""
 
 
 def _write_table(table, path: str, encoding: str) -> None:
@@ -102,6 +136,134 @@ def _read_table(path: str, encoding: str):
     import pyarrow.parquet as pq
 
     return pq.read_table(path)
+
+
+def _encode_table(table, encoding: str) -> bytes:
+    """Arrow table -> parquet/orc bytes in memory: the durable write
+    path checksums (and fsyncs) the exact bytes that land on disk."""
+    import pyarrow as pa
+
+    sink = pa.BufferOutputStream()
+    if encoding == "orc":
+        import pyarrow.orc as orc
+
+        orc.write_table(table, sink)
+    else:
+        import pyarrow.parquet as pq
+
+        # same dictionary policy as _write_table (see above)
+        dict_cols = [
+            f.name
+            for f in table.schema
+            if pa.types.is_string(f.type)
+            or pa.types.is_large_string(f.type)
+            or pa.types.is_binary(f.type)
+        ]
+        pq.write_table(
+            table, sink,
+            use_dictionary=dict_cols or False,
+            write_statistics=False,
+        )
+    return sink.getvalue().to_pybytes()
+
+
+def _parse_table(data: bytes, encoding: str):
+    """Verified-read counterpart of :func:`_read_table`: parse a table
+    from bytes already checksummed in memory."""
+    import pyarrow as pa
+
+    buf = pa.BufferReader(pa.py_buffer(data))
+    if encoding == "orc":
+        import pyarrow.orc as orc
+
+        return orc.read_table(buf)
+    import pyarrow.parquet as pq
+
+    return pq.read_table(buf)
+
+
+# resolved ONCE: a failed import is not cached by Python, and paying a
+# sys.path scan per partition write/verified read would add up fast
+try:
+    from crc32c import crc32c as _crc32c  # optional accelerator
+except ImportError:
+    _crc32c = None
+
+
+def checksum_bytes(data: bytes) -> "tuple[str, int]":
+    """``(algo, value)`` content checksum. Prefers hardware crc32c when
+    the optional module is present, zlib crc32 (always available)
+    otherwise; the algo name persists in the manifest so verification
+    works in an environment with a different preferred algo."""
+    if _crc32c is not None:
+        return "crc32c", int(_crc32c(data))
+    import zlib
+
+    return "crc32", int(zlib.crc32(data) & 0xFFFFFFFF)
+
+
+def verify_bytes(data: bytes, checksum: dict) -> "str | None":
+    """None when ``data`` matches the manifest checksum record, an
+    error description otherwise. Unknown/unavailable algos fall back to
+    the (always-checked) byte length rather than failing the read."""
+    length = checksum.get("length")
+    if length is not None and len(data) != int(length):
+        return f"length {len(data)} != manifest {int(length)}"
+    algo = checksum.get("algo")
+    if algo == "crc32":
+        import zlib
+
+        got = int(zlib.crc32(data) & 0xFFFFFFFF)
+    elif algo == "crc32c":
+        if _crc32c is None:
+            return None  # length already checked above
+        got = int(_crc32c(data))
+    else:
+        return None
+    want = int(checksum.get("value", -1))
+    if got != want:
+        return f"{algo} {got:#010x} != manifest {want:#010x}"
+    return None
+
+
+def _write_file(path: str, data: bytes, fsync: bool) -> None:
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        # os.write may land fewer bytes than asked (signals; Linux caps a
+        # single write at ~2GB): loop, or a giant partition file would
+        # silently truncate while its manifest checksum covers the whole
+        view = memoryview(data)
+        while view:
+            view = view[os.write(fd, view):]
+        if fsync:
+            os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(d: str) -> None:
+    """Durably record a directory's entries (new/renamed files). Best
+    effort: some filesystems refuse directory fsync; the file-content
+    fsyncs still stand."""
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _write_part_file(table, path: str, encoding: str, fsync: bool) -> dict:
+    """Write one partition file durably — encode to bytes, checksum,
+    single write (+fsync) — and return its manifest checksum record."""
+    data = _encode_table(table, encoding)
+    algo, value = checksum_bytes(data)
+    _write_file(path, data, fsync)
+    return {"algo": algo, "value": value, "length": len(data)}
 
 
 class FileSystemDataStore:
@@ -146,6 +308,10 @@ class FileSystemDataStore:
         # and _refresh_from_disk mutates shared state in place)
         self._mem_lock = threading.RLock()
         self.audit_writer = None
+        #: what the open-time recovery sweep reclaimed, per type — folded
+        #: into the next explicit recover() so fsck reports the crash
+        #: cleanup its own store open already performed
+        self._open_recovery: dict = {}
         if audit:  # the <catalog>_queries table analog
             from geomesa_tpu.audit import FileAuditWriter
 
@@ -156,6 +322,35 @@ class FileSystemDataStore:
             meta_path = os.path.join(root, name, "schema.json")
             if os.path.exists(meta_path):
                 self._load_type(name)
+        self._recover_on_open()
+
+    def _recover_on_open(self) -> None:
+        """Crash recovery at open: under the exclusive lock (no flush can
+        be mid-write), reclaim interrupted-flush leftovers and repair a
+        lagging generation sidecar; ``store.verify=open`` additionally
+        checksums every partition file, quarantining failures. A held
+        lock elsewhere must not brick opening — the sweep is skipped
+        with a warning and runs on the next open/fsck instead."""
+        if not self._types:
+            return
+        import logging
+
+        from geomesa_tpu.conf import sys_prop
+        from geomesa_tpu.locking import LockTimeout
+
+        verify_open = sys_prop("store.verify") == "open"
+        for name in list(self._types):
+            try:
+                with self._exclusive():
+                    self._refresh_from_disk(name)
+                    self._open_recovery[name] = self._recover_locked(name)
+                    if verify_open:
+                        self._verify_type(name)
+            except LockTimeout as e:
+                logging.getLogger(__name__).warning(
+                    "dataset %r: recovery sweep skipped at open (%s)",
+                    name, e,
+                )
 
     # -- inter-process locking ---------------------------------------------
 
@@ -216,6 +411,7 @@ class FileSystemDataStore:
                 bbox=tuple(p["bbox"]) if p.get("bbox") else None,
                 time_range=tuple(p["time_range"]) if p.get("time_range") else None,
                 leaf=p.get("leaf"),
+                checksum=p.get("checksum"),
             )
             for p in meta["partitions"]
         ]
@@ -230,6 +426,7 @@ class FileSystemDataStore:
             scheme=self._scheme_of(sft, strict=False),
             stats=self._load_stats(meta.get("stats")),
             generation=meta.get("generation"),
+            file_gen=meta.get("file_gen"),
             dirty=bool(meta.get("dirty", False)),
         )
 
@@ -277,6 +474,7 @@ class FileSystemDataStore:
         st.generation = uuid.uuid4().hex  # new manifest token
         meta = {
             "generation": st.generation,
+            "file_gen": st.file_gen,
             "dirty": st.dirty,
             "spec": st.sft.spec,
             "primary": st.primary,
@@ -294,23 +492,39 @@ class FileSystemDataStore:
                     "bbox": list(p.bbox) if p.bbox else None,
                     "time_range": list(p.time_range) if p.time_range else None,
                     "leaf": p.leaf,
+                    "checksum": p.checksum,
                 }
                 for p in st.partitions
             ],
         }
-        # atomic: a concurrent opener must see either the old or the new
-        # manifest, never a truncated one
-        path = os.path.join(self._dir(name), "schema.json")
+        self._publish_manifest(
+            os.path.join(self._dir(name), "schema.json"),
+            json.dumps(meta),
+            st.generation,
+        )
+
+    @staticmethod
+    def _publish_manifest(path: str, body: str, generation: str) -> None:
+        """Atomically publish ``schema.json`` AND its ``.gen`` staleness
+        sidecar, fsyncing file contents and the directory: a crash at
+        any instant leaves either the old or the new manifest, never a
+        truncated one. The sidecar derives FROM this manifest write (one
+        source of truth); a crash between the two replaces leaves it
+        lagging by exactly one generation, which the recovery sweep
+        repairs from the manifest on the next open."""
+        from geomesa_tpu.conf import sys_prop
+
+        fsync = bool(sys_prop("store.fsync"))
         tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(meta, fh)
+        _write_file(tmp, body.encode("utf-8"), fsync)
         os.replace(tmp, path)
         # tiny sidecar: staleness checks read ONLY this, not the whole
         # manifest (which carries the full partition list)
         gen_tmp = path + ".gen.tmp"
-        with open(gen_tmp, "w") as fh:
-            fh.write(st.generation)
+        _write_file(gen_tmp, generation.encode("utf-8"), fsync)
         os.replace(gen_tmp, path + ".gen")
+        if fsync:
+            _fsync_dir(os.path.dirname(path))
 
     def create_schema(self, sft: "SimpleFeatureType | str", spec: "str | None" = None):
         if isinstance(sft, str):
@@ -395,16 +609,26 @@ class FileSystemDataStore:
         st.scheme = new.scheme
         st.stats = new.stats
         st.generation = new.generation
+        st.file_gen = new.file_gen
         st.dirty = new.dirty
         st.cache = {}
+        # a new generation means new files: stale per-partition
+        # quarantines must not outlive the files they indicted
+        self._clear_quarantine(st)
+        if getattr(self._lock_tl, "depth", 0) > 0:
+            # already under the exclusive lock (a maintenance op noticed
+            # another process's rewrite): reclaim anything a crashed
+            # writer left behind while it is safe to do so
+            return self._recover_locked(type_name)
 
     def _flush_locked(self, type_name: str) -> None:
         st = self._types[type_name]
         if st.dirty and not st.quarantine_owner:
-            # another process's failed flush quarantined this dataset and
-            # that process alone holds the lost rows in memory. Flushing
-            # our own pending here would publish a clean manifest with only
-            # OUR rows -- turning the loud failure back into silent loss.
+            # a LEGACY (pre-generation) manifest recording a flush that
+            # failed after unlinking its files: that process alone holds
+            # the lost rows in memory. Flushing our own pending here
+            # would publish a clean manifest with only OUR rows --
+            # turning the loud failure back into silent loss.
             raise RuntimeError(
                 f"dataset {type_name!r} is quarantined: a flush failed "
                 "mid-rewrite in another process; retry there or restore "
@@ -412,7 +636,8 @@ class FileSystemDataStore:
             )
         if not st.pending:
             return
-        batches = list(st.pending)
+        orig_pending = list(st.pending)
+        batches = orig_pending
         if st.partitions:
             batches = [self._read_all(type_name)] + batches
         data = batches[0] if len(batches) == 1 else FeatureBatch.concat(batches)
@@ -420,59 +645,73 @@ class FileSystemDataStore:
         # not drop the buffered writes
         ks = keyspace_for(st.sft, st.primary)
         st.pending = []
+        gen0 = st.generation
         try:
             self._write_sorted(type_name, st, ks, data)
-        except Exception:
-            # old files may already be gone -- keep the full dataset in
-            # memory as pending so a corrected retry loses nothing, and
-            # reconcile the on-disk manifest (best effort): other
-            # processes must not keep reading a partition list whose
-            # files were already unlinked
-            st.pending = [data]
-            st.partitions = []
-            st.cache = {}
-            st.dirty = True  # quarantine: readers must not see "empty"
-            st.quarantine_owner = True
-            try:
-                self._save_meta(type_name)
-            except Exception:
-                pass  # the original error matters more
+        except BaseException:
+            # write-new-then-publish: the PREVIOUS generation is still
+            # published and intact, so readers (this process and others)
+            # lose nothing; _write_sorted already restored the manifest
+            # view and swept its partial files. Restore the buffered
+            # batches so a corrected retry merges exactly the same rows
+            # -- unless the manifest actually advanced (a post-publish
+            # failpoint/GC error), where a restore would duplicate them.
+            # Prepended, not assigned: concurrent write() calls may have
+            # buffered new batches while the flush ran.
+            if st.generation == gen0:
+                st.pending = orig_pending + st.pending
             raise
 
     def _write_sorted(self, type_name, st, ks, data) -> None:
+        """Crash-consistent rewrite (write-new-then-publish): the new
+        generation's ``part-<gen>-*`` files land NEXT TO the previous
+        generation, are fsynced (contents, then directories), and only
+        then does the manifest atomically flip — after which the old
+        generation is garbage-collected. A crash at ANY instant leaves a
+        store that reopens to exactly the previous or the new state;
+        leftovers of an interrupted flush are unpublished and reclaimed
+        by the recovery sweep. The ``fail.flush.*`` failpoints bracket
+        each step for the chaos suite."""
+        import dataclasses
+        import uuid
         from concurrent.futures import ThreadPoolExecutor
 
+        from geomesa_tpu.conf import sys_prop
+        from geomesa_tpu.failpoints import fail_point
         from geomesa_tpu.pyarrow_compat import preload_pyarrow
 
         # the writer threads import pyarrow.parquet/orc: the FIRST pyarrow
         # import must happen on this (spawning) thread or a later
         # main-thread read segfaults (pyarrow_compat contract)
         preload_pyarrow()
-        # drop old files, write new
         d = self._dir(type_name)
-        for dirpath, _, files in os.walk(d):
-            for f in files:
-                if f.startswith("part-"):
-                    os.unlink(os.path.join(dirpath, f))
-        # partition files stream out on a writer thread (pyarrow releases
+        fsync = bool(sys_prop("store.fsync"))
+        new_gen = uuid.uuid4().hex[:8]
+        prev = (
+            st.partitions, st.file_gen, st.stats, st.data_interval,
+            st.generation, st.dirty, st.quarantine_owner,
+        )
+        # partition files stream out on writer threads (pyarrow releases
         # the GIL; at GB scale the writes are disk-writeback-bound) while
         # the main thread computes stats/manifest — joined BEFORE the
         # manifest publishes, so readers never see it ahead of the files
-        writes: list = []
+        writes: "list[tuple]" = []  # (PartitionMeta, Future[checksum])
+        dirs = {d}  # every directory holding a new file gets fsynced
+        publishing = False
         ex = ThreadPoolExecutor(max_workers=2)
         try:
             if st.scheme is not None and len(data):
                 # group rows by directory leaf; each leaf is sorted +
                 # manifested independently (the partition-scheme layout)
                 leaves = st.scheme.leaves(data)
-                all_parts: list = []
                 pid = 0
-                import dataclasses
-
                 for leaf in sorted(set(leaves)):
                     sub = data.take(np.nonzero(leaves == leaf)[0])
                     built = self._build(ks, sub)
-                    leaf_dir = os.path.join(d, leaf)
+                    leaf_dir = d
+                    for seg in leaf.split("/"):
+                        leaf_dir = os.path.join(leaf_dir, seg)
+                        dirs.add(leaf_dir)
                     os.makedirs(leaf_dir, exist_ok=True)
                     # ONE arrow conversion per leaf; partition files are
                     # zero-copy slices (a per-partition take + to_arrow
@@ -480,28 +719,27 @@ class FileSystemDataStore:
                     table = built.batch.to_arrow()
                     for p in built.partitions:
                         part = dataclasses.replace(p, pid=pid, leaf=leaf)
-                        writes.append(ex.submit(
-                            _write_table,
+                        writes.append((part, ex.submit(
+                            _write_part_file,
                             table.slice(p.start, p.stop - p.start),
-                            self._part_path(type_name, part),
+                            self._part_path(type_name, part, gen=new_gen),
                             st.encoding,
-                        ))
-                        all_parts.append(part)
+                            fsync,
+                        )))
                         pid += 1
-                st.partitions = all_parts
                 full = data
                 z3_keys = None
             else:
                 built = self._build(ks, data)
                 table = built.batch.to_arrow()
                 for p in built.partitions:
-                    writes.append(ex.submit(
-                        _write_table,
+                    writes.append((p, ex.submit(
+                        _write_part_file,
                         table.slice(p.start, p.stop - p.start),
-                        self._part_path(type_name, p),
+                        self._part_path(type_name, p, gen=new_gen),
                         st.encoding,
-                    ))
-                st.partitions = built.partitions
+                        fsync,
+                    )))
                 full = built.batch
                 # the build already encoded every row's (bin, z): reuse
                 # for the Z3 histogram instead of a second full encode
@@ -510,21 +748,93 @@ class FileSystemDataStore:
                     if getattr(ks, "name", None) == "z3"
                     else None
                 )
-            st.cache = {}
             dtg = st.sft.dtg_field
+            interval = st.data_interval
             if dtg is not None and len(full):
                 col = full.column(dtg)
-                st.data_interval = (int(col.min()), int(col.max()))
+                interval = (int(col.min()), int(col.max()))
             from geomesa_tpu.store.memory import build_default_stats
 
-            st.stats = build_default_stats(st.sft, full, z3_keys=z3_keys)
-            for w in writes:
-                w.result()  # a failed write must fail the flush, loudly
+            stats = build_default_stats(st.sft, full, z3_keys=z3_keys)
+            # join: a failed write must fail the flush loudly, BEFORE
+            # anything publishes; the checksums ride back with the joins
+            parts = [
+                dataclasses.replace(p, checksum=w.result())
+                for p, w in writes
+            ]
+            fail_point("fail.flush.after_write")
+            if fsync:
+                for dd in sorted(dirs):
+                    _fsync_dir(dd)
+            st.partitions = parts
+            st.file_gen = new_gen
+            st.data_interval = interval
+            st.stats = stats
+            st.cache = {}
+            self._clear_quarantine(st)
+            st.dirty = False
+            st.quarantine_owner = False
+            fail_point("fail.flush.before_publish")
+            publishing = True
+            self._save_meta(type_name)
+        except BaseException:
+            # abort: the previous generation is still the published one.
+            # Restore the in-memory view to it and remove our partial
+            # files — unless the manifest write itself was interrupted
+            # (it may or may not have flipped); then the files stay and
+            # the recovery sweep reconciles against the REAL manifest.
+            # Queued writes are cancelled (their slices would only be
+            # unlinked below); in-flight ones must land before unlinking.
+            ex.shutdown(wait=True, cancel_futures=True)
+            published_gen = st.generation if publishing else None
+            (st.partitions, st.file_gen, st.stats, st.data_interval,
+             st.generation, st.dirty, st.quarantine_owner) = prev
+            st.cache = {}
+            if publishing:
+                # the manifest replace may have landed before the
+                # failure (e.g. the SIDECAR write raised): the disk
+                # decides which generation this process is on now. If
+                # it flipped, adopt the new state — restoring the old
+                # view would defeat _flush_locked's duplicate guard and
+                # re-queue rows the manifest already owns. The lagging
+                # sidecar is repaired by the next sweep/open.
+                try:
+                    with open(os.path.join(d, "schema.json")) as fh:
+                        disk_gen = json.load(fh).get("generation")
+                except (OSError, json.JSONDecodeError):
+                    disk_gen = None
+                if disk_gen == published_gen:
+                    st.partitions, st.file_gen = parts, new_gen
+                    st.data_interval, st.stats = interval, stats
+                    st.generation = published_gen
+                    st.dirty = False
+                    st.quarantine_owner = False
+            else:
+                import logging
+
+                for p, _ in writes:
+                    path = self._part_path(type_name, p, gen=new_gen)
+                    try:
+                        os.unlink(path)
+                    except FileNotFoundError:
+                        pass
+                    except OSError as e:
+                        # the file is merely an unpublished orphan now --
+                        # but operators should know the sweep owes work
+                        logging.getLogger(__name__).warning(
+                            "dataset %r: could not remove aborted flush "
+                            "file %r: %s", type_name, path, e,
+                        )
+            raise
         finally:
             ex.shutdown(wait=True)
-        st.dirty = False  # a successful rewrite lifts the quarantine
-        st.quarantine_owner = False
-        self._save_meta(type_name)
+        from geomesa_tpu import metrics
+
+        metrics.store_generations.inc()
+        fail_point("fail.flush.after_publish")
+        # the new generation is durable and published: the old one is
+        # garbage — GC failures leave harmless orphans for the sweep
+        self._gc_stale_parts(type_name)
 
     #: below this row count a mesh build is routed to the host lexsort
     #: anyway: per-shape jit traces + host->device transfer of tiny (e.g.
@@ -553,12 +863,219 @@ class FileSystemDataStore:
             return build_index(ks, data, self.partition_size, mesh=self.mesh)
         return build_index(ks, data, self.partition_size)
 
-    def _part_path(self, type_name: str, p: PartitionMeta) -> str:
+    #: sentinel: "use the type's published file generation"
+    _GEN_CURRENT = object()
+
+    def _part_path(
+        self, type_name: str, p: PartitionMeta, gen=_GEN_CURRENT
+    ) -> str:
+        """Path of a partition file. ``gen`` defaults to the type's
+        published file generation (None = legacy un-scoped names); a
+        flush mid-rewrite passes its NEW generation explicitly."""
+        from geomesa_tpu.store.partitions import part_file_name
+
         st = self._types[type_name]
         d = self._dir(type_name)
         if p.leaf:
             d = os.path.join(d, p.leaf)
-        return os.path.join(d, f"part-{p.pid:05d}.{st.encoding}")
+        if gen is self._GEN_CURRENT:
+            gen = st.file_gen
+        return os.path.join(d, part_file_name(p.pid, st.encoding, gen))
+
+    # -- crash recovery / integrity ----------------------------------------
+
+    @staticmethod
+    def _clear_quarantine(st: "_FsTypeState") -> None:
+        if st.quarantined:
+            from geomesa_tpu import metrics
+
+            metrics.store_quarantined.dec(len(st.quarantined))
+            st.quarantined = {}
+
+    def _quarantine(self, type_name: str, st, p, path: str, err: str) -> None:
+        """Quarantine ONE partition after a checksum failure: loud
+        per-partition error, the rest of the dataset keeps serving."""
+        import logging
+
+        from geomesa_tpu import metrics
+
+        if p.pid not in st.quarantined:
+            st.quarantined[p.pid] = err
+            metrics.store_checksum_failures.inc()
+            metrics.store_quarantined.inc()
+            logging.getLogger(__name__).error(
+                "dataset %r partition %d (%s): checksum verification "
+                "failed (%s) -- partition quarantined; queries not "
+                "touching it keep serving",
+                type_name, p.pid, path, err,
+            )
+
+    def recover(self, type_name: str) -> dict:
+        """Recovery sweep: under the exclusive lock (no flush can be
+        mid-write), re-sync with the on-disk manifest, repair a lagging
+        ``.gen`` sidecar, and reclaim files left by interrupted flushes
+        (unpublished generations, ``*.tmp``). Idempotent; runs
+        automatically at store open and from the CLI ``fsck``. Returns
+        ``{"files": n, "bytes": b, "gen_repaired": bool}``."""
+        with self._exclusive():
+            # the refresh itself sweeps when it notices a newer on-disk
+            # generation: fold that report in rather than dropping it
+            pre = self._refresh_from_disk(type_name)
+            rep = self._recover_locked(type_name)
+            # fold in sweeps this call didn't run itself but whose work
+            # would otherwise go unreported: the open-time sweep (fsck
+            # opens the store, which already reclaimed the orphans) and
+            # a refresh-triggered one
+            for extra in (pre, self._open_recovery.pop(type_name, None)):
+                if extra:
+                    rep = {
+                        "files": rep["files"] + extra["files"],
+                        "bytes": rep["bytes"] + extra["bytes"],
+                        "gen_repaired": rep["gen_repaired"]
+                        or extra["gen_repaired"],
+                    }
+            return rep
+
+    def _recover_locked(self, type_name: str) -> dict:
+        import logging
+
+        from geomesa_tpu import metrics
+
+        repaired = self._repair_gen_sidecar(type_name)
+        files, nbytes = self._gc_stale_parts(type_name)
+        if files:
+            metrics.store_orphan_files.inc(files)
+            metrics.store_orphan_bytes.inc(nbytes)
+            logging.getLogger(__name__).warning(
+                "dataset %r: recovery sweep reclaimed %d orphan file(s), "
+                "%d bytes, from an interrupted flush",
+                type_name, files, nbytes,
+            )
+        return {"files": files, "bytes": nbytes, "gen_repaired": repaired}
+
+    def _repair_gen_sidecar(self, type_name: str) -> bool:
+        """A crash between the manifest replace and the sidecar replace
+        leaves ``.gen`` one generation behind ``schema.json`` (whose
+        value is the truth): republish the sidecar from the manifest."""
+        st = self._types[type_name]
+        if not st.generation:
+            return False
+        from geomesa_tpu.conf import sys_prop
+
+        gen_path = os.path.join(self._dir(type_name), "schema.json.gen")
+        disk = None
+        try:
+            with open(gen_path) as fh:
+                disk = fh.read().strip() or None
+        except OSError:
+            pass
+        if disk == st.generation:
+            return False
+        _write_file(
+            gen_path + ".tmp",
+            st.generation.encode("utf-8"),
+            bool(sys_prop("store.fsync")),
+        )
+        os.replace(gen_path + ".tmp", gen_path)
+        return True
+
+    def _gc_stale_parts(self, type_name: str) -> "tuple[int, int]":
+        """Remove part/tmp files not referenced by the current manifest
+        (the previous generation right after a publish; interrupted-flush
+        leftovers during a recovery sweep). Caller holds the exclusive
+        lock. Returns (files, bytes) removed."""
+        import logging
+
+        st = self._types[type_name]
+        expected = {
+            os.path.abspath(self._part_path(type_name, p))
+            for p in st.partitions
+        }
+        files = nbytes = 0
+        for dirpath, _, names in os.walk(self._dir(type_name)):
+            for f in names:
+                if not (f.startswith("part-") or f.endswith(".tmp")):
+                    continue
+                path = os.path.join(dirpath, f)
+                if os.path.abspath(path) in expected:
+                    continue
+                try:
+                    sz = os.path.getsize(path)
+                    os.unlink(path)
+                except FileNotFoundError:
+                    continue
+                except OSError as e:
+                    logging.getLogger(__name__).warning(
+                        "dataset %r: could not reclaim %r: %s",
+                        type_name, path, e,
+                    )
+                    continue
+                files += 1
+                nbytes += sz
+        return files, nbytes
+
+    def verify_partitions(self, type_name: str) -> "list[tuple]":
+        """Full checksum verification of every partition file (the
+        ``fsck`` pass, and what ``store.verify=open`` runs at store
+        open): returns ``[(pid, path, error)]`` for the failures, each
+        of which is quarantined."""
+        with self._shared():
+            self._refresh_from_disk(type_name)
+            return self._verify_type(type_name)
+
+    def _verify_type(self, type_name: str) -> "list[tuple]":
+        st = self._types[type_name]
+        errors = []
+        for p in st.partitions:
+            path = self._part_path(type_name, p)
+            err = None
+            try:
+                with open(path, "rb") as fh:
+                    data = fh.read()
+            except OSError as e:
+                err = f"unreadable: {e}"
+            else:
+                if p.checksum is not None:
+                    err = verify_bytes(data, p.checksum)
+            if err:
+                self._quarantine(type_name, st, p, path, err)
+                errors.append((p.pid, path, err))
+        return errors
+
+    def store_stats(self) -> dict:
+        """Durability/integrity snapshot (the ``/stats/store`` endpoint
+        and the ``fsck`` report): per-type generations, partition and
+        quarantine state, plus the process-wide ``geomesa_store_*``
+        counters."""
+        from geomesa_tpu import metrics
+        from geomesa_tpu.conf import sys_prop
+
+        types = {}
+        for name, st in self._types.items():
+            types[name] = {
+                "generation": st.generation,
+                "file_generation": st.file_gen,
+                "encoding": st.encoding,
+                "partitions": len(st.partitions),
+                "rows": int(sum(p.count for p in st.partitions)),
+                "dirty": bool(st.dirty),
+                "quarantined": {
+                    int(pid): err for pid, err in st.quarantined.items()
+                },
+            }
+        return {
+            "root": self.root,
+            "verify": sys_prop("store.verify"),
+            "types": types,
+            "counters": {
+                "generations_published": metrics.store_generations.value(),
+                "orphan_files_reclaimed": metrics.store_orphan_files.value(),
+                "orphan_bytes_reclaimed": metrics.store_orphan_bytes.value(),
+                "checksum_failures": metrics.store_checksum_failures.value(),
+                "partitions_quarantined": metrics.store_quarantined.value(),
+                "read_retries": metrics.store_read_retries.value(),
+            },
+        }
 
     def delete(self, type_name: str, fids) -> int:
         """Drop features by id and compact the partition files. One
@@ -734,13 +1251,45 @@ class FileSystemDataStore:
 
     def _read_part_table(self, type_name: str, p: PartitionMeta):
         """File -> Arrow table (timed; the prefetch pipeline's 'read'
-        stage). Locking is the CALLER's concern."""
+        stage). Locking is the CALLER's concern. Honors the
+        ``fail.read.*`` failpoints; under ``store.verify=always`` the
+        raw bytes are checksummed against the manifest BEFORE parsing,
+        and a mismatch quarantines this one partition and raises a
+        loud :class:`PartitionCorruptError` (siblings keep serving)."""
         from geomesa_tpu import metrics
+        from geomesa_tpu.conf import sys_prop
+        from geomesa_tpu.failpoints import fail_hit, fail_point
 
         st = self._types[type_name]
+        if p.pid in st.quarantined:
+            raise PartitionCorruptError(
+                f"dataset {type_name!r} partition {p.pid} is quarantined: "
+                f"{st.quarantined[p.pid]}"
+            )
         path = self._part_path(type_name, p)
+        fail_point("fail.read.io")  # transient: the prefetch retry path
+        injected = fail_hit("fail.read.corrupt")
+        verify = injected or sys_prop("store.verify") == "always"
         with metrics.io_read_seconds.time():
-            t = _read_table(path, st.encoding)
+            if not verify:
+                t = _read_table(path, st.encoding)
+            else:
+                with open(path, "rb") as fh:
+                    data = fh.read()
+                err = (
+                    "injected corruption (failpoint fail.read.corrupt)"
+                    if injected
+                    else verify_bytes(data, p.checksum)
+                    if p.checksum is not None
+                    else None
+                )
+                if err:
+                    self._quarantine(type_name, st, p, path, err)
+                    raise PartitionCorruptError(
+                        f"dataset {type_name!r} partition {p.pid} "
+                        f"({path}): {err}"
+                    )
+                t = _parse_table(data, st.encoding)
         try:
             metrics.io_bytes_read.inc(os.path.getsize(path))
         except OSError:

@@ -38,6 +38,14 @@ the disk and the device kernel on slab i. ``io=`` tunes it
 (PrefetchConfig / worker count int / None = the ``io.*`` system
 properties); ``io=0`` is the serial baseline. Peak host memory is the
 in-flight chunks (read-ahead depth, byte-budgeted) — never the dataset.
+
+Durability interplay (ISSUE 3): the partition reads beneath a streamed
+scan ride the store's crash-consistent read path — transient I/O errors
+retry on the workers with bounded backoff (``io.retries`` x
+``io.backoff.ms``), ``store.verify=always`` checksums every file before
+decode, and a corrupt partition raises a loud per-partition
+PartitionCorruptError out of the scan instead of streaming silent
+garbage through the slab pump (scans pruned away from it still serve).
 """
 
 from __future__ import annotations

@@ -33,6 +33,24 @@ from geomesa_tpu.geom import Envelope
 
 USER_DATA_KEY = "geomesa.fs.partition-scheme"
 
+# -- partition file naming ---------------------------------------------------
+#
+# Crash-consistent flushes write each rewrite as a fresh GENERATION of
+# files next to the previous one (`part-<gen>-NNNNN.<enc>`), publish the
+# manifest atomically, then GC the old generation; the legacy un-scoped
+# form (`part-NNNNN.<enc>`) is still read from pre-generation stores.
+# Names are only ever PRODUCED (here) and matched by prefix in the
+# recovery sweep — the sweep deliberately reclaims anything `part-`ish
+# that the manifest does not reference, well-formed or not.
+
+
+def part_file_name(pid: int, encoding: str, gen: "str | None" = None) -> str:
+    """Partition file name: generation-scoped when ``gen`` is set, the
+    legacy un-scoped form otherwise."""
+    if gen:
+        return f"part-{gen}-{pid:05d}.{encoding}"
+    return f"part-{pid:05d}.{encoding}"
+
 
 class PartitionScheme:
     """Base: subclasses define spec, depth (leaf path segments), leaves()

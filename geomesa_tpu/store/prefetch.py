@@ -30,7 +30,12 @@ item's position in the stream; the executor is then drained and shut
 down (queued items cancelled, running ones finish and are discarded), so
 a decode error mid-stream can neither deadlock the queue nor leak
 threads. Closing the generator early (consumer abandons the scan — e.g.
-a query deadline expired) runs the same cleanup.
+a query deadline expired) runs the same cleanup. TRANSIENT read errors
+(OSError — flaky NFS, the ``fail.read.io`` failpoint) are retried on the
+worker with bounded exponential backoff BEFORE surfacing (``io.retries``
+x ``io.backoff.ms``, doubling; ``geomesa_store_read_retries_total``
+counts them); FileNotFoundError and domain failures (e.g. a checksum
+quarantine) stay immediate and loud.
 
 Knobs resolve from the ``io.*`` system properties (``io.workers``,
 ``io.readahead``, ``io.queue.bytes`` — see :mod:`geomesa_tpu.conf`) when
@@ -111,16 +116,53 @@ def batch_nbytes(batch) -> int:
         return 0
 
 
+def _with_retries(fn):
+    """Transient-read resilience for the pipeline workers: retry ``fn``
+    on OSError with bounded exponential backoff (``io.retries`` extra
+    attempts, ``io.backoff.ms`` base doubling per attempt). Reads are
+    idempotent, so re-running the whole work item is safe. NOT retried:
+    FileNotFoundError (a real state — e.g. another writer GC'd the
+    generation mid-scan, which a refresh must resolve, not a sleep) and
+    non-OSError domain failures (checksum quarantines stay loud)."""
+    from geomesa_tpu.conf import sys_prop
+
+    retries = int(sys_prop("io.retries"))
+    if retries <= 0:
+        return fn
+    backoff_s = max(float(sys_prop("io.backoff.ms")), 0.0) / 1e3
+
+    def call(item):
+        import time as _time
+
+        from geomesa_tpu import metrics
+
+        for attempt in range(retries):
+            try:
+                return fn(item)
+            except FileNotFoundError:
+                raise
+            except OSError:
+                metrics.store_read_retries.inc()
+                _time.sleep(backoff_s * (1 << attempt))
+        return fn(item)  # the last attempt's error propagates
+
+    return call
+
+
 def prefetch_map(fn, items, config=None, size_of=None):
     """Ordered pipelined map: ``fn(item)`` runs on worker threads with
     bounded read-ahead; results yield in input order (see the module
-    docstring for the memory bound and failure discipline).
+    docstring for the memory bound and failure discipline). Transient
+    OSErrors from ``fn`` are retried per the ``io.retries`` /
+    ``io.backoff.ms`` properties (see :func:`_with_retries`).
 
     ``items`` is only ever advanced on the consumer thread, so plain
     generators are fine as input. ``size_of(result)`` opts results into
     the byte budget. With ``workers <= 0`` this is exactly
-    ``map(fn, items)`` — no threads, the serial baseline."""
+    ``map(fn, items)`` — no threads, the serial baseline (retries still
+    apply)."""
     cfg = PrefetchConfig.coerce(config)
+    fn = _with_retries(fn)
     if cfg.workers <= 0:
         for item in items:
             yield fn(item)

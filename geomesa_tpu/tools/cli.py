@@ -21,6 +21,8 @@ Runner/IngestCommand/ExportCommand/ExplainCommand/StatsCommand):
     geomesa-tpu reindex        --root DIR -f NAME --index z2
     geomesa-tpu repartition    --root DIR -f NAME [--scheme daily,z2-2bit]
     geomesa-tpu compact        --root DIR -f NAME
+    geomesa-tpu fsck           --root DIR [-f NAME] [--no-verify]
+                               (recovery sweep + checksum verify)
     geomesa-tpu serve          --root DIR [--resident] [--warm] [--sched]
     geomesa-tpu load-driver    --root DIR -f NAME [-q CQL] [--threads M]
                                [--requests N] [--loose] (concurrent-serving
@@ -345,6 +347,39 @@ def cmd_compact(args):
     store = _store(args)
     store.compact(args.feature_name)
     print(f"compacted {args.feature_name!r}")
+
+
+def cmd_fsck(args):
+    """Recovery sweep + full checksum verification (the offline face of
+    the store's crash-recovery machinery, ISSUE 3): reclaims files from
+    interrupted flushes, repairs a lagging generation sidecar, verifies
+    every partition file against its manifest checksum, and reports the
+    quarantine state operators would otherwise discover query-by-query.
+    Exits non-zero when corruption was found."""
+    store = _store(args)
+    names = (
+        [args.feature_name] if args.feature_name else store.type_names
+    )
+    corrupt = 0
+    for name in names:
+        rep = store.recover(name)
+        line = (
+            f"{name}: swept {rep['files']} orphan file(s), "
+            f"{rep['bytes']} bytes"
+        )
+        if rep["gen_repaired"]:
+            line += "; repaired generation sidecar"
+        print(line)
+        if args.no_verify:
+            continue
+        errors = store.verify_partitions(name)
+        total = len(store._types[name].partitions)
+        for pid, path, err in errors:
+            print(f"  partition {pid} CORRUPT ({path}): {err}")
+        print(f"  verified {total - len(errors)}/{total} partition file(s) ok")
+        corrupt += len(errors)
+    if corrupt:
+        sys.exit(f"error: {corrupt} corrupt partition file(s)")
 
 
 
@@ -771,6 +806,12 @@ def main(argv=None) -> None:
 
     sp = add("compact", cmd_compact)
     sp.add_argument("-f", "--feature-name", required=True)
+
+    sp = add("fsck", cmd_fsck)
+    sp.add_argument("-f", "--feature-name",
+                    help="one schema; omit for every schema in the root")
+    sp.add_argument("--no-verify", action="store_true",
+                    help="recovery sweep only, skip checksum verification")
 
     sp = add("stats-count", cmd_stats_count)
     sp.add_argument("--resident", action="store_true", help="fuse stats into the device scan via a resident index")

@@ -156,7 +156,7 @@ def test_fs_flush_failure_preserves_data(tmp_path, monkeypatch):
     def boom(*a, **k):
         raise RuntimeError("disk full")
 
-    monkeypatch.setattr(fsmod, "_write_table", boom)
+    monkeypatch.setattr(fsmod, "_write_part_file", boom)
     with pytest.raises(RuntimeError):
         fs.reindex("t", "z2")
     monkeypatch.undo()

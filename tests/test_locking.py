@@ -60,6 +60,16 @@ class TestFileLock:
                 with file_lock(path, timeout_s=0.2):
                     pass
 
+    def test_timeout_reports_holder_pid(self, tmp_path):
+        # exclusive holders write their pid into the sentinel; a timeout
+        # names the (last) writer so operators know whom to chase
+        path = str(tmp_path / "l")
+        with file_lock(path):
+            with pytest.raises(LockTimeout) as ei:
+                with file_lock(path, timeout_s=0.2):
+                    pass
+            assert str(os.getpid()) in str(ei.value)
+
 
 def _writer_proc(root, wid, n_rounds, n_rows):
     import numpy as np

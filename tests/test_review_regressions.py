@@ -232,10 +232,12 @@ def test_lambda_str_filter_fails_closed_on_visibility():
     assert sorted(got.column("count").tolist()) == [1, 2]
 
 
-def test_fs_failed_flush_quarantines_readers(tmp_path, monkeypatch):
-    """A failed flush must not publish an empty-but-valid manifest: other
-    processes fail loudly instead of reading a silently-empty dataset,
-    and a successful retry lifts the quarantine."""
+def test_fs_failed_flush_preserves_previous_generation(tmp_path, monkeypatch):
+    """Write-new-then-publish (ISSUE 3): a flush that fails mid-write
+    leaves the PREVIOUS on-disk generation fully published and readable
+    — concurrent readers keep serving the old rows, the writer retries
+    from its buffered pending, and the retry publishes everything."""
+    import geomesa_tpu.store.fs as fsmod
     from geomesa_tpu.store.fs import FileSystemDataStore
 
     root = str(tmp_path / "cat")
@@ -243,29 +245,54 @@ def test_fs_failed_flush_quarantines_readers(tmp_path, monkeypatch):
     ds = FileSystemDataStore(root)
     ds.create_schema(sft)
     ds.write("q", {"count": [1, 2], "geom": np.zeros((2, 2))})
+    ds.flush("q")  # generation 1 published
+
+    ds.write("q", {"count": [3], "geom": np.zeros((1, 2))})
     boom = RuntimeError("disk full")
 
     def bad_write(*a, **k):
         raise boom
 
-    monkeypatch.setattr(ds, "_write_sorted", bad_write)
+    monkeypatch.setattr(fsmod, "_write_part_file", bad_write)
     with pytest.raises(RuntimeError, match="disk full"):
         ds.flush("q")
-    # a second process opening the store must not see "empty and fine"
+    # a second process keeps reading generation 1 — no loss, no raise
+    ds2 = FileSystemDataStore(root)
+    assert sorted(ds2.query("q").batch.column("count").tolist()) == [1, 2]
+    # the writer still holds the new row in pending; its retry (via the
+    # query's eager flush) publishes old + new
+    monkeypatch.undo()
+    assert sorted(ds.query("q").batch.column("count").tolist()) == [1, 2, 3]
+    ds3 = FileSystemDataStore(root)
+    assert sorted(ds3.query("q").batch.column("count").tolist()) == [1, 2, 3]
+
+
+def test_fs_legacy_dirty_manifest_still_quarantines(tmp_path):
+    """Pre-generation manifests could record a flush that failed AFTER
+    unlinking its files (`dirty: true`); readers of such a manifest must
+    still fail loudly instead of seeing an empty-but-valid dataset."""
+    import json
+
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    root = str(tmp_path / "cat")
+    sft = SimpleFeatureType.create("q", "count:Int,*geom:Point:srid=4326")
+    ds = FileSystemDataStore(root)
+    ds.create_schema(sft)
+    ds.write("q", {"count": [1, 2], "geom": np.zeros((2, 2))})
+    ds.flush("q")
+    meta_path = f"{root}/q/schema.json"
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    meta["dirty"] = True
+    with open(meta_path, "w") as fh:
+        json.dump(meta, fh)
     ds2 = FileSystemDataStore(root)
     with pytest.raises(RuntimeError, match="quarantined"):
         ds2.query("q")
-    # ... nor may it flush its own writes: that would publish a clean
-    # manifest holding only ITS rows, silently dropping the lost ones
     ds2.write("q", {"count": [99], "geom": np.zeros((1, 2))})
     with pytest.raises(RuntimeError, match="quarantined"):
         ds2.flush("q")
-    # the writer itself still holds the data in pending and can serve it
-    monkeypatch.undo()
-    assert sorted(ds.query("q").batch.column("count").tolist()) == [1, 2]
-    # ... and that query's flush retry lifted the quarantine for everyone
-    ds3 = FileSystemDataStore(root)
-    assert sorted(ds3.query("q").batch.column("count").tolist()) == [1, 2]
 
 
 def test_knn_confidence_pass_respects_max_radius():

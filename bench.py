@@ -1696,6 +1696,10 @@ def bench_serving(args) -> dict:
         th.join()
     wall = time.perf_counter() - t
     s1 = server.scheduler.snapshot()
+    # metrics + trace snapshot: the bench JSON carries what /metrics and
+    # /debug/traces saw for this leg, so a regression in the BENCH_*
+    # trajectory comes with its own attribution data
+    snapshot = _serve_observability_snapshot(f"http://{host}:{port}")
     server.shutdown()
     # stop the worker threads too: their cv poll would perturb the
     # timing-sensitive legs that follow in all-mode
@@ -1722,11 +1726,86 @@ def bench_serving(args) -> dict:
         "serve_rejected": s1["rejected"] - s0["rejected"],
         "serve_expired": s1["expired"] - s0["expired"],
     }
+    out.update(snapshot)
     log(
         "serving: %.0f req/s p50=%.1fms p99=%.1fms fusion=%.2f "
         "(%d queries / %d launches)"
         % (out["serve_qps"], out["serve_p50_ms"], out["serve_p99_ms"],
            out["serve_fusion_factor"] or 1.0, queries, launches)
+    )
+    return out
+
+
+def _serve_observability_snapshot(base: str) -> dict:
+    """Scrape /metrics (the geomesa_* scalar series) and the newest
+    /debug/traces entry from the serving leg's own server, for embedding
+    in the bench JSON. Best-effort: an empty dict never fails the leg."""
+    import urllib.request
+
+    out: dict = {}
+    try:
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        wanted = (
+            "geomesa_sched_", "geomesa_traces_", "geomesa_slow_",
+            "geomesa_queries_total",
+        )
+        met: dict = {}
+        for line in text.splitlines():
+            if line.startswith("#") or " " not in line:
+                continue
+            series, val = line.rsplit(" ", 1)
+            if series.split("{")[0].startswith(wanted):
+                try:
+                    met[series] = float(val)
+                except ValueError:
+                    pass
+        out["serve_metrics"] = met
+        with urllib.request.urlopen(
+            f"{base}/debug/traces?limit=1", timeout=30
+        ) as r:
+            traces = json.loads(r.read()).get("traces", [])
+        if traces:
+            with urllib.request.urlopen(
+                f"{base}/debug/traces/{traces[0]['trace_id']}", timeout=30
+            ) as r:
+                out["serve_trace"] = json.loads(r.read())
+    except Exception as e:
+        log(f"observability snapshot failed (non-fatal): {e!r}")
+    return out
+
+
+def bench_trace_overhead(args) -> dict:
+    """The --trace-overhead check: the serving leg with tracing at its
+    DEFAULT sampling (trace.sample=1, slow capture on) must stay within
+    3% of the leg with recording fully off (trace.sample=0 +
+    trace.slow_ms=0 — spans become no-ops). Two runs per config, best
+    qps of each, to damp scheduler-timing noise."""
+    from geomesa_tpu.conf import prop_override
+
+    def best_qps(sample: float, slow_ms: float) -> float:
+        qps = []
+        for _ in range(2):
+            with prop_override("trace.sample", sample), \
+                    prop_override("trace.slow_ms", slow_ms):
+                qps.append(bench_serving(args)["serve_qps"])
+        return max(qps)
+
+    off = best_qps(0.0, 0.0)
+    on = best_qps(1.0, 500.0)
+    pct = (off - on) / off * 100.0 if off else 0.0
+    out = {
+        "trace_overhead_off_qps": off,
+        "trace_overhead_on_qps": on,
+        "trace_overhead_pct": round(pct, 2),
+    }
+    log(
+        "trace overhead: %.0f qps (tracing off) vs %.0f qps (default "
+        "sampling) = %.2f%%" % (off, on, pct)
+    )
+    assert pct < 3.0, (
+        f"tracing at default sampling costs {pct:.2f}% on the serve leg "
+        "(budget: <3%)"
     )
     return out
 
@@ -1865,6 +1944,12 @@ def main() -> None:
         "(0 = default 4)",
     )
     ap.add_argument(
+        "--trace-overhead", action="store_true",
+        help="serve mode: additionally compare the serving leg with "
+        "tracing at default sampling vs recording off, asserting the "
+        "overhead stays under 3%%",
+    )
+    ap.add_argument(
         "--engine",
         choices=("pallas", "xla"),
         default="pallas",
@@ -1910,6 +1995,8 @@ def main() -> None:
         out = bench_join(args)
     elif args.mode == "serve":
         out = bench_serving(args)
+        if args.trace_overhead:
+            out.update(bench_trace_overhead(args))
     elif args.mode == "flush":
         out = bench_flush(args)
     else:

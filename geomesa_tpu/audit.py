@@ -11,6 +11,7 @@ in memory for in-memory stores.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import queue
@@ -28,6 +29,7 @@ class AuditedEvent:
     planning_ms: float = 0.0
     scanning_ms: float = 0.0
     hits: int = 0
+    trace_id: str = ""  # cross-links the event to /debug/traces/<id>
     ts: float = field(default_factory=time.time)
 
     def to_json(self) -> str:
@@ -35,20 +37,40 @@ class AuditedEvent:
 
 
 class AuditWriter:
-    """Async audit sink. Subclasses implement _write(event)."""
+    """Async audit sink. Subclasses implement _write(event).
+
+    Lifecycle: the drain thread is a daemon (it must never keep a
+    process alive), which means a short-lived CLI process could exit
+    with events still queued — :meth:`close` drains and stops the
+    thread, and is registered via ``atexit`` when the thread first
+    starts so every normal interpreter exit flushes implicitly."""
+
+    _STOP = object()  # drain-thread shutdown sentinel
 
     def __init__(self):
         self._q: "queue.Queue" = queue.Queue()
         self._thread = threading.Thread(target=self._drain, daemon=True)
         self._started = False
+        self._closed = False
         self._lock = threading.Lock()
 
     def write(self, event: AuditedEvent) -> None:
         with self._lock:
+            if self._closed:
+                # post-close stragglers write synchronously: losing them
+                # silently would defeat close()'s whole purpose
+                try:
+                    self._write(event)
+                except Exception:
+                    pass
+                return
             if not self._started:
                 self._thread.start()
                 self._started = True
-        self._q.put(event)
+                atexit.register(self.close)
+            # enqueue UNDER the lock: a put after close() drained the
+            # queue would be silently lost (the race close exists to fix)
+            self._q.put(event)
 
     def flush(self, timeout: float = 5.0) -> None:
         if self._started:
@@ -58,10 +80,26 @@ class AuditWriter:
             while self._q.unfinished_tasks and time.time() < deadline:
                 time.sleep(0.005)
 
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain every queued event and stop the writer thread. Safe to
+        call repeatedly; subsequent writes fall back to synchronous."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            started = self._started
+        if not started:
+            return
+        self.flush(timeout)
+        self._q.put(self._STOP)
+        self._thread.join(timeout=timeout)
+
     def _drain(self) -> None:
         while True:
             ev = self._q.get()
             try:
+                if ev is self._STOP:
+                    return
                 self._write(ev)
             except Exception:
                 pass  # audit must never take down the query path
@@ -107,6 +145,7 @@ def observe_query(store, type_name, plan, t0, t1, t2, result, audit_writer):
     guaranteed never to throw into the query path."""
     try:
         from geomesa_tpu.metrics import queries_run, query_seconds
+        from geomesa_tpu.tracing import current_trace_id
 
         queries_run.inc(store=store, type=type_name)
         query_seconds.observe(t2 - t0)
@@ -119,6 +158,7 @@ def observe_query(store, type_name, plan, t0, t1, t2, result, audit_writer):
                     planning_ms=(t1 - t0) * 1e3,
                     scanning_ms=(t2 - t1) * 1e3,
                     hits=len(result),
+                    trace_id=current_trace_id(),
                 )
             )
     except Exception:  # pragma: no cover - observability must not break reads

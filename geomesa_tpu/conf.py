@@ -34,6 +34,20 @@ Properties:
                                 manifests on flush (crash durability;
                                 ``off`` trades it for speed, e.g. tmpfs
                                 or throwaway benchmark stores)
+- ``trace.sample``              head-sampling probability for request
+                                traces (0..1; tracing.py). Sampled
+                                traces are retained in the recent-trace
+                                ring regardless of duration
+- ``trace.slow_ms``             always-capture threshold: any request
+                                slower than this is retained AND
+                                appended to the slow-query log, sampled
+                                or not (0 disables slow capture; with
+                                ``trace.sample=0`` that turns span
+                                recording off entirely)
+- ``trace.device.dir``          when set, sampled queries wrap their
+                                device launch in a ``jax.profiler``
+                                trace dumped to this directory
+                                (profiling.device_trace); "" = off
 """
 
 from __future__ import annotations
@@ -79,6 +93,12 @@ _DEFS = {
     # verification scope, and whether flushes fsync what they publish
     "store.verify": ("off", _parse_verify),
     "store.fsync": (True, _parse_bool),
+    # per-request tracing (tracing.py): head-sampling probability, the
+    # slow-query always-capture threshold, and the optional jax.profiler
+    # device-trace dump directory for sampled launches
+    "trace.sample": (1.0, float),
+    "trace.slow_ms": (500.0, float),
+    "trace.device.dir": ("", str),
 }
 
 _overrides: dict = {}

@@ -133,28 +133,41 @@ class MetricsRegistry:
             return m
 
     def prometheus_text(self) -> str:
-        """Prometheus exposition format (text/plain; version 0.0.4)."""
+        """Prometheus exposition format (text/plain; version 0.0.4).
+
+        Every mutable structure is SNAPSHOTTED under its metric's lock
+        before formatting: writers mutate ``_values`` (and histogram
+        ``counts`` lists) concurrently on serving threads, and iterating
+        them live can raise mid-scrape or emit a histogram whose buckets
+        disagree with its count."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
         lines: list = []
-        for name, m in sorted(self._metrics.items()):
+        for name, m in metrics:
             if m.help:
                 lines.append(f"# HELP {name} {m.help}")
             lines.append(f"# TYPE {name} {m.kind}")
             if isinstance(m, (Counter, Gauge)):
-                for key, v in sorted(m._values.items()):
+                with m._lock:
+                    values = sorted(m._values.items())
+                for key, v in values:
                     lines.append(f"{name}{_fmt_labels(key)} {_fmt_val(v)}")
             else:
-                for key, st in sorted(m._values.items()):
+                with m._lock:
+                    stats = sorted(
+                        (key, list(st["counts"]), st["sum"], st["n"])
+                        for key, st in m._values.items()
+                    )
+                for key, counts, total, n in stats:
                     cum = 0
-                    for b, c in zip(
-                        m.buckets + (float("inf"),), st["counts"]
-                    ):
+                    for b, c in zip(m.buckets + (float("inf"),), counts):
                         cum += c
                         lb = "+Inf" if b == float("inf") else _fmt_val(b)
                         lines.append(
                             f"{name}_bucket{_fmt_labels(key + (('le', lb),))} {cum}"
                         )
-                    lines.append(f"{name}_sum{_fmt_labels(key)} {_fmt_val(st['sum'])}")
-                    lines.append(f"{name}_count{_fmt_labels(key)} {st['n']}")
+                    lines.append(f"{name}_sum{_fmt_labels(key)} {_fmt_val(total)}")
+                    lines.append(f"{name}_count{_fmt_labels(key)} {n}")
         return "\n".join(lines) + "\n"
 
     def clear(self) -> None:
@@ -162,10 +175,22 @@ class MetricsRegistry:
             self._metrics.clear()
 
 
+def _esc_label(v) -> str:
+    """Prometheus-spec label value escaping (backslash, double quote,
+    newline) — a filter string carried in a label must not be able to
+    break out of its quotes or split the exposition line."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt_labels(key) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_esc_label(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 
@@ -274,4 +299,16 @@ store_quarantined = REGISTRY.gauge(
 store_read_retries = REGISTRY.counter(
     "geomesa_store_read_retries_total",
     "transient partition-read retries by the prefetch workers",
+)
+
+# per-request tracing (tracing.py): how many traces the ring retained
+# (head-sampled or slow-captured) and how many crossed the slow-query
+# threshold (trace.slow_ms) — the rate the slow-query log grows at
+traces_captured = REGISTRY.counter(
+    "geomesa_traces_captured_total",
+    "request traces retained in the recent-trace ring",
+)
+slow_queries = REGISTRY.counter(
+    "geomesa_slow_queries_total",
+    "requests slower than trace.slow_ms (always-captured + slow-logged)",
 )

@@ -97,6 +97,17 @@ def plan_query(
     query before planning and can veto the finished plan; ``max_ranges``
     defaults to the three-tier config resolution (SFT user-data
     ``geomesa.scan.ranges.target``, then the system property)."""
+    from geomesa_tpu.tracing import span as trace_span
+
+    with trace_span("query.plan", type=sft.type_name) as _tsp:
+        return _plan_query(
+            sft, indices, query, max_ranges, data_interval, stats, _tsp
+        )
+
+
+def _plan_query(
+    sft, indices, query, max_ranges, data_interval, stats, _tsp
+) -> QueryPlan:
     from geomesa_tpu.conf import sys_prop
     from geomesa_tpu.query.interceptor import (
         apply_interceptors,
@@ -186,6 +197,10 @@ def plan_query(
         candidates=candidates,
     )
     guard_plan(chain, plan)
+    _tsp.set(
+        index=index_name,
+        ranges=len(ranges) if ranges is not None else "full-scan",
+    )
     return plan
 
 

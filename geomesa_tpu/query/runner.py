@@ -55,13 +55,39 @@ def _contiguous_runs(parts) -> "list[tuple[int, int]]":
 
 def run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
     from geomesa_tpu.profiling import profile
+    from geomesa_tpu.tracing import span
 
-    with profile("query.scan"):
-        return _run_query(built, plan)
+    with profile("query.scan"), span("query.scan") as sp:
+        res = _run_query(built, plan)
+        sp.set(scanned=res.scanned, hits=len(res))
+        return res
+
+
+def _device_trace_ctx():
+    """The ``trace.device.dir`` hook: a SAMPLED request's device launch
+    is additionally wrapped in a ``jax.profiler`` dump (kernel timings,
+    HBM traffic) when the knob names a directory — the host-side trace
+    says WHICH launch was slow, the profiler dump says why."""
+    from contextlib import nullcontext
+
+    from geomesa_tpu.conf import sys_prop
+    from geomesa_tpu.tracing import current_trace
+
+    log_dir = str(sys_prop("trace.device.dir") or "")
+    if not log_dir:
+        return nullcontext()
+    t = current_trace()
+    if t is None or not t.sampled:
+        return nullcontext()
+    from geomesa_tpu.profiling import device_trace
+
+    return device_trace(log_dir)
 
 
 def _run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
     import jax
+
+    from geomesa_tpu.tracing import span
 
     parts = built.prune(plan.ranges)
     compiled = plan.compiled
@@ -75,10 +101,15 @@ def _run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
             _, jitted = compiled.jitted_scan()
         for start, stop in _contiguous_runs(parts):
             if use_device:
-                cols = stage_columns(
-                    built.batch, compiled.device_cols, start, stop
-                )
-                mask = np.asarray(jitted(cols))
+                # one span per kernel launch: stage + dispatch + the
+                # mask fetch (np.asarray is the sync point)
+                with span(
+                    "device.launch", rows=int(stop - start)
+                ), _device_trace_ctx():
+                    cols = stage_columns(
+                        built.batch, compiled.device_cols, start, stop
+                    )
+                    mask = np.asarray(jitted(cols))
             else:
                 mask = np.ones(stop - start, dtype=bool)
             idx = np.nonzero(mask)[0]

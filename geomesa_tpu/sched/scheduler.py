@@ -87,10 +87,12 @@ _USE_DEFAULT = object()  # submit(): "no deadline_ms given, apply config"
 class _Request:
     __slots__ = (
         "fn", "fuse", "lane", "tenant", "deadline", "enqueued",
-        "event", "result", "error", "state",
+        "event", "result", "error", "state", "ctx", "t0_perf",
     )
 
     def __init__(self, fn, fuse, lane, tenant, deadline):
+        from geomesa_tpu import tracing
+
         self.fn = fn
         self.fuse = fuse
         self.lane = lane
@@ -101,6 +103,12 @@ class _Request:
         self.result = None
         self.error = None
         self.state = "queued"  # -> running -> done
+        # the submitter's span, captured EXPLICITLY: the worker that
+        # executes this request attaches it so plan/launch/store spans
+        # land in the submitting request's trace, and the queue-wait +
+        # execute spans fan out to every rider of a fused launch
+        self.ctx = tracing.capture()
+        self.t0_perf = time.perf_counter()
 
 
 class QueryScheduler:
@@ -126,6 +134,7 @@ class QueryScheduler:
         self.rejected = 0
         self.expired = 0
         self._wait_sum = 0.0
+        self._launch_seq = 0  # device-launch ids for trace tagging
         self._workers = [
             threading.Thread(
                 target=self._worker, daemon=True, name=f"sched-worker-{i}"
@@ -336,10 +345,11 @@ class QueryScheduler:
             self._execute(group)
 
     def _execute(self, group: "list[_Request]") -> None:
-        from geomesa_tpu import metrics
+        from geomesa_tpu import metrics, tracing
         from geomesa_tpu.sched.fusion import execute_group
 
         now = time.monotonic()
+        now_perf = time.perf_counter()
         live: list = []
         dead: list = []
         with self._cv:  # counters race sibling workers otherwise
@@ -357,16 +367,29 @@ class QueryScheduler:
             ))
         for r in live:
             metrics.sched_wait_seconds.observe(now - r.enqueued)
+            # queue wait (admission -> claimed, incl. the fusion window),
+            # timed here and attached retroactively to the rider's trace
+            tracing.record_span(
+                r.ctx, "sched.wait", r.t0_perf, now_perf - r.t0_perf,
+                lane=r.lane, tenant=r.tenant,
+            )
         if not live:
             return
         fused = None
         if len(live) > 1 and live[0].fuse is not None:
             try:
-                fused = execute_group([r.fuse for r in live])
+                # detail spans from inside the shared launch can only
+                # belong to one trace: the head rider's. Every rider
+                # still gets the flat sched.execute span below, tagged
+                # with the shared launch id.
+                with tracing.attach(live[0].ctx):
+                    fused = execute_group([r.fuse for r in live])
             except Exception:
                 fused = None  # any fusion failure: serial is always exact
         with self._cv:
             if fused is not None:
+                self._launch_seq += 1
+                launch_id = self._launch_seq
                 self.launches += 1
                 self.queries += len(live)
                 self.fused_queries += len(live)
@@ -377,14 +400,28 @@ class QueryScheduler:
             metrics.sched_launches.inc()
             metrics.sched_queries.inc(len(live))
             metrics.sched_fused.inc(len(live))
+            dur = time.perf_counter() - now_perf
             for r, v in zip(live, fused):
+                tracing.record_span(
+                    r.ctx, "sched.execute", now_perf, dur,
+                    launch=launch_id, fused=len(live), lane=r.lane,
+                )
                 self._finish(r, result=v)
             return
         metrics.sched_launches.inc(len(live))
         metrics.sched_queries.inc(len(live))
         for r in live:
+            with self._cv:
+                self._launch_seq += 1
+                launch_id = self._launch_seq
             try:
-                res = r.fn()
+                # attach the rider's context so the work's own spans
+                # (plan / device.launch / store reads) nest in its trace
+                with tracing.attach(r.ctx), tracing.span(
+                    "sched.execute", launch=launch_id, fused=1,
+                    lane=r.lane,
+                ):
+                    res = r.fn()
             except Exception as e:  # the submitter re-raises it
                 self._finish(r, error=e)
                 continue

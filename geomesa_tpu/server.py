@@ -30,7 +30,18 @@ Endpoints (all GET):
   (sched mode: queue depth, wait time, fusion factor, rejections)
 - ``/stats/store``                  -- store durability/integrity snapshot
   (FS stores: generations, quarantined partitions, recovery counters)
+- ``/debug/traces``                 -- recent request traces (summaries;
+  ``?limit=``)
+- ``/debug/traces/<id>``            -- one trace's full span tree;
+  ``?format=perfetto`` emits Chrome-trace/Perfetto JSON
 - ``/refresh/<type>``               -- restage a resident type after writes
+
+Tracing: every non-debug request runs under a root span (tracing.py) —
+an inbound ``X-Request-Id`` header becomes the trace id (echoed on the
+response; generated when absent), spans from the scheduler, planner,
+device launches and store reads nest beneath it, and retention follows
+``trace.sample`` / ``trace.slow_ms`` (slow requests also append to the
+store's ``_slow_queries.jsonl``, full trace embedded).
 
 Scheduler mode (``make_server(store, sched=True)`` or a SchedConfig, CLI
 ``serve --sched``) routes query/count/density/knn/stats work through the
@@ -130,6 +141,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             from geomesa_tpu.audit import AuditedEvent
             from geomesa_tpu.metrics import queries_run, query_seconds
+            from geomesa_tpu.tracing import current_trace_id
 
             queries_run.inc(store="resident", type=type_name)
             query_seconds.observe(t1 - t0)
@@ -138,6 +150,7 @@ class _Handler(BaseHTTPRequestHandler):
                 aw.write(AuditedEvent(
                     store="resident", type_name=type_name, filter=cql,
                     planning_ms=0.0, scanning_ms=(t1 - t0) * 1e3, hits=hits,
+                    trace_id=current_trace_id(),
                 ))
         except Exception:  # pragma: no cover - observability must not break
             pass
@@ -150,6 +163,12 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        tr = getattr(self, "_trace", None)
+        if tr is not None:
+            # the trace id rides the response whether or not the trace
+            # was retained — clients correlate logs by it either way
+            self.send_header("X-Request-Id", tr.trace_id)
+            tr.root.set(status=code)
         for name, value in headers:
             self.send_header(name, value)
         self.end_headers()
@@ -190,29 +209,41 @@ class _Handler(BaseHTTPRequestHandler):
             url = urlparse(self.path)
             parts = [p for p in url.path.split("/") if p]
             q = {k: v[0] for k, v in parse_qs(url.query).items()}
-            if parts == ["capabilities"]:
-                return self._capabilities()
-            if parts == ["metrics"]:
-                from geomesa_tpu.metrics import REGISTRY
+        except Exception as e:
+            self._trace = None
+            return self._json(400, {"error": str(e)})
+        # observability endpoints are not themselves traced — scrapes,
+        # trace reads and the stats snapshots must not churn the trace
+        # ring (a monitoring poll would evict real query traces).
+        # /stats/<type> with a real type name IS a query and stays
+        # traced; the same disambiguation _dispatch routes by.
+        untraced = (
+            parts and parts[0] in ("metrics", "debug")
+        ) or (
+            parts == ["stats", "sched"] and self.scheduler is not None
+        ) or (
+            parts == ["stats", "store"]
+            and hasattr(self.store, "store_stats")
+        )
+        if untraced:
+            self._trace = None
+            return self._dispatch_safe(url, parts, q)
+        from geomesa_tpu.tracing import TRACER
 
-                return self._send(
-                    200,
-                    REGISTRY.prometheus_text().encode("utf-8"),
-                    "text/plain; version=0.0.4",
-                )
-            if parts == ["stats", "sched"] and self.scheduler is not None:
-                return self._json(200, self.scheduler.snapshot())
-            if parts == ["stats", "store"] and hasattr(
-                self.store, "store_stats"
-            ):
-                return self._json(200, self.store.store_stats())
-            if len(parts) == 2 and parts[0] in (
-                "features", "count", "explain", "density", "stats",
-                "refresh", "knn", "tube", "proximity",
-            ):
-                handler = getattr(self, f"_{parts[0]}")
-                return handler(unquote(parts[1]), q)
-            self._json(404, {"error": f"no such endpoint {url.path!r}"})
+        # error handling lives INSIDE the trace: the error response is
+        # sent (status attr stamped, its time counted) before the trace
+        # finishes and retention / the slow-query log fire
+        with TRACER.trace(
+            f"GET {url.path}",
+            trace_id=self.headers.get("X-Request-Id"),
+            attrs={"path": url.path, "query": url.query[:512]},
+        ) as tr:
+            self._trace = tr
+            return self._dispatch_safe(url, parts, q)
+
+    def _dispatch_safe(self, url, parts: list, q: dict) -> None:
+        try:
+            return self._dispatch(url, parts, q)
         except KeyError as e:
             self._json(404, {"error": f"unknown schema or attribute {e}"})
         except ValueError as e:
@@ -234,6 +265,55 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(e, DeadlineExpired):
                 return self._json(504, {"error": str(e)})
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _dispatch(self, url, parts: list, q: dict) -> None:
+        if parts == ["capabilities"]:
+            return self._capabilities()
+        if parts == ["metrics"]:
+            from geomesa_tpu.metrics import REGISTRY
+
+            return self._send(
+                200,
+                REGISTRY.prometheus_text().encode("utf-8"),
+                "text/plain; version=0.0.4",
+            )
+        if parts[:2] == ["debug", "traces"]:
+            return self._debug_traces(parts, q)
+        if parts == ["stats", "sched"] and self.scheduler is not None:
+            return self._json(200, self.scheduler.snapshot())
+        if parts == ["stats", "store"] and hasattr(
+            self.store, "store_stats"
+        ):
+            return self._json(200, self.store.store_stats())
+        if len(parts) == 2 and parts[0] in (
+            "features", "count", "explain", "density", "stats",
+            "refresh", "knn", "tube", "proximity",
+        ):
+            handler = getattr(self, f"_{parts[0]}")
+            return handler(unquote(parts[1]), q)
+        self._json(404, {"error": f"no such endpoint {url.path!r}"})
+
+    def _debug_traces(self, parts: list, q: dict) -> None:
+        """``/debug/traces`` (recent summaries) and
+        ``/debug/traces/<id>`` (full span tree; ``?format=perfetto``)."""
+        from geomesa_tpu.tracing import TRACER
+
+        if len(parts) == 2:
+            limit = int(q.get("limit", 50))
+            return self._json(200, {"traces": TRACER.recent(limit)})
+        if len(parts) != 3:
+            return self._json(404, {"error": "use /debug/traces[/<id>]"})
+        t = TRACER.get(unquote(parts[2]))
+        if t is None:
+            return self._json(
+                404,
+                {"error": f"no trace {parts[2]!r} (evicted, or neither "
+                          "sampled nor slow — see trace.sample / "
+                          "trace.slow_ms)"},
+            )
+        if q.get("format") == "perfetto":
+            return self._json(200, t.to_perfetto())
+        return self._json(200, t.to_dict())
 
     # -- endpoints ---------------------------------------------------------
 
@@ -296,22 +376,29 @@ class _Handler(BaseHTTPRequestHandler):
                 q, fn=lambda: self._query(type_name, q).batch
             )
         fmt = q.get("f", "geojson")
+        from geomesa_tpu.tracing import span
+
         if fmt == "arrow":
             from geomesa_tpu.arrow_io import write_delta_stream
 
             sink = io.BytesIO()
             # dictionary-delta batches: clients consume incrementally and
-            # dictionaries never retransmit (ref DeltaWriter protocol)
-            write_delta_stream(
-                sink, [batch], sft=batch.sft, chunk_size=1 << 14
-            )
-            self._send(
-                200, sink.getvalue(), "application/vnd.apache.arrow.stream"
-            )
+            # dictionaries never retransmit (ref DeltaWriter protocol).
+            # The encode span covers serialization AND the socket write —
+            # for large results that is real, attributable request time
+            with span("http.encode", fmt="arrow", rows=len(batch)):
+                write_delta_stream(
+                    sink, [batch], sft=batch.sft, chunk_size=1 << 14
+                )
+                self._send(
+                    200, sink.getvalue(),
+                    "application/vnd.apache.arrow.stream",
+                )
         elif fmt == "geojson":
             from geomesa_tpu.export import feature_collection
 
-            self._json(200, feature_collection(batch))
+            with span("http.encode", fmt="geojson", rows=len(batch)):
+                self._json(200, feature_collection(batch))
         else:
             self._json(400, {"error": f"unknown format {fmt!r}"})
 
@@ -319,13 +406,15 @@ class _Handler(BaseHTTPRequestHandler):
         """GeoJSON feature collection (optionally with extra per-feature
         fields merged into properties, e.g. kNN distances)."""
         from geomesa_tpu.export import feature_collection
+        from geomesa_tpu.tracing import span
 
-        doc = feature_collection(batch)
-        if extra:
-            for name, vals in extra.items():
-                for f, v in zip(doc["features"], vals):
-                    f["properties"][name] = v
-        self._json(200, doc)
+        with span("http.encode", fmt="geojson", rows=len(batch)):
+            doc = feature_collection(batch)
+            if extra:
+                for name, vals in extra.items():
+                    for f, v in zip(doc["features"], vals):
+                        f["properties"][name] = v
+            self._json(200, doc)
 
     # -- WPS process endpoints (knn / tube select / proximity search) ------
 
@@ -559,13 +648,23 @@ def make_server(
     worker count; None keeps the store's own / the ``io.*`` system
     properties). Prefetch health is visible on ``/metrics`` as the
     ``geomesa_io_*`` series."""
+    import os as _os
+
     from geomesa_tpu.jaxconf import enable_compilation_cache
     from geomesa_tpu.pyarrow_compat import preload_pyarrow
+    from geomesa_tpu.tracing import TRACER
 
     enable_compilation_cache()
     preload_pyarrow()  # handler threads serve Arrow; see pyarrow_compat
     if io is not None and hasattr(store, "io"):
         store.io = io
+    # the slow-query log lives next to the store's audit log
+    # (<root>/_slow_queries.jsonl); memory stores keep traces ring-only
+    root_dir = getattr(store, "root", None)
+    if root_dir:
+        TRACER.slow_log_path = _os.path.join(
+            str(root_dir), "_slow_queries.jsonl"
+        )
     scheduler = None
     if sched:
         from geomesa_tpu.sched import QueryScheduler, SchedConfig

@@ -1270,7 +1270,10 @@ class FileSystemDataStore:
         fail_point("fail.read.io")  # transient: the prefetch retry path
         injected = fail_hit("fail.read.corrupt")
         verify = injected or sys_prop("store.verify") == "always"
-        with metrics.io_read_seconds.time():
+        from geomesa_tpu.tracing import span
+
+        with span("store.read", pid=p.pid, rows=int(p.count)) as sp, \
+                metrics.io_read_seconds.time():
             if not verify:
                 t = _read_table(path, st.encoding)
             else:
@@ -1291,7 +1294,9 @@ class FileSystemDataStore:
                     )
                 t = _parse_table(data, st.encoding)
         try:
-            metrics.io_bytes_read.inc(os.path.getsize(path))
+            size = os.path.getsize(path)
+            metrics.io_bytes_read.inc(size)
+            sp.set(bytes=int(size))
         except OSError:
             pass
         return t
@@ -1303,9 +1308,13 @@ class FileSystemDataStore:
         stage), optionally pinning the partition cache."""
         from geomesa_tpu import metrics
 
+        from geomesa_tpu.tracing import span
+
         st = self._types[type_name]
-        with metrics.io_decode_seconds.time():
+        with span("store.decode", pid=p.pid) as sp, \
+                metrics.io_decode_seconds.time():
             batch = FeatureBatch.from_arrow(t, st.sft)
+        sp.set(rows=len(batch))
         if cache:
             st.cache[p.pid] = batch
         return batch
@@ -1455,10 +1464,16 @@ class FileSystemDataStore:
         from two manifest generations into one result."""
         import time as _time
 
+        from geomesa_tpu.tracing import span
+
         t0 = _time.perf_counter()
-        self.flush(type_name)  # exclusive if pending; BEFORE the shared lock
-        with self._shared():
-            return self._query_locked(type_name, query, t0)
+        with span("store.query", store="fs", type=type_name) as sp:
+            # flush BEFORE the shared lock: exclusive if pending
+            self.flush(type_name)
+            with self._shared():
+                res = self._query_locked(type_name, query, t0)
+            sp.set(hits=len(res), scanned=res.scanned)
+            return res
 
     def _query_locked(self, type_name: str, query, t0) -> QueryResult:
         import time as _time

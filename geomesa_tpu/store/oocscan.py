@@ -238,12 +238,14 @@ class StreamedDeviceScan:
         from geomesa_tpu import metrics
         from geomesa_tpu.features.batch import FeatureBatch
         from geomesa_tpu.ops.scan import stage_columns_host
+        from geomesa_tpu.tracing import span
 
         batches = [read(p) for p in group]
         batch = (
             batches[0] if len(batches) == 1 else FeatureBatch.concat(batches)
         )
-        with metrics.io_stage_seconds.time():
+        with span("store.stage", rows=len(batch), parts=len(group)), \
+                metrics.io_stage_seconds.time():
             cols = stage_columns_host(batch, names)
         return cols, (batch if want_batch else None)
 
@@ -337,14 +339,19 @@ class StreamedDeviceScan:
     def count(self, query) -> int:
         """Streamed fused count. Filters with host-only predicates fall
         back to the store's own (streaming, host) scan."""
+        from geomesa_tpu.tracing import span
+
         plan, parts = self._parts(query)
         compiled = plan.compiled
         if not compiled.device_cols or not compiled.fully_on_device:
             return len(self.store.query(self.type_name, query).batch)
-        outs = self._stream(plan, "count").stream(
-            self._pairs(parts, compiled.device_cols, want_batch=False)
-        )
-        return int(sum(int(o) for o, _ in outs))
+        with span(
+            "oocscan.count", type=self.type_name, parts=len(parts)
+        ):
+            outs = self._stream(plan, "count").stream(
+                self._pairs(parts, compiled.device_cols, want_batch=False)
+            )
+            return int(sum(int(o) for o, _ in outs))
 
     def query(self, query):
         """Streamed fused scan returning the hit FeatureBatch: device
@@ -354,13 +361,20 @@ class StreamedDeviceScan:
         delivers each chunk WITH its source batch as one tuple, so mask
         and rows cannot skew even when the prefetcher runs chunks ahead.
         """
-        from geomesa_tpu.features.batch import FeatureBatch
-        from geomesa_tpu.query.runner import _post_process
+        from geomesa_tpu.tracing import span
 
         plan, parts = self._parts(query)
         compiled = plan.compiled
         if not compiled.device_cols:
             return self.store.query(self.type_name, query).batch
+        with span("oocscan.query", type=self.type_name, parts=len(parts)):
+            return self._query_streamed(plan, parts)
+
+    def _query_streamed(self, plan, parts):
+        from geomesa_tpu.features.batch import FeatureBatch
+        from geomesa_tpu.query.runner import _post_process
+
+        compiled = plan.compiled
         pairs = self._pairs(parts, compiled.device_cols)
         hits: list = []
         for mask, batch in self._stream(plan, "mask").stream(pairs):

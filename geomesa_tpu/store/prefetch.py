@@ -173,16 +173,23 @@ def prefetch_map(fn, items, config=None, size_of=None):
 def _prefetch_threads(fn, items, cfg: PrefetchConfig, size_of):
     from concurrent.futures import ThreadPoolExecutor
 
-    from geomesa_tpu import metrics
+    from geomesa_tpu import metrics, tracing
 
     it = iter(items)
     depth = cfg.effective_depth
     budget = cfg.byte_budget
     lock = threading.Lock()
     queued = {"bytes": 0}  # completed-but-unconsumed result bytes
+    # span context crosses the pool EXPLICITLY: contextvars are
+    # per-thread, so without this capture/attach pair the workers' read/
+    # decode/stage spans would silently vanish from the request's trace
+    # (tracing.py module docstring). Captured HERE — the consumer thread
+    # at generator start — and attached around each work item.
+    trace_ctx = tracing.capture()
 
     def run(item):
-        out = fn(item)
+        with tracing.attach(trace_ctx):
+            out = fn(item)
         b = 0
         if size_of is not None and budget:
             try:

@@ -24,6 +24,9 @@ Runner/IngestCommand/ExportCommand/ExplainCommand/StatsCommand):
     geomesa-tpu fsck           --root DIR [-f NAME] [--no-verify]
                                (recovery sweep + checksum verify)
     geomesa-tpu serve          --root DIR [--resident] [--warm] [--sched]
+    geomesa-tpu trace          --url http://host:port [TRACE_ID]
+                               [--perfetto -o out.json] (request traces
+                               from /debug/traces, pretty span tree)
     geomesa-tpu load-driver    --root DIR -f NAME [-q CQL] [--threads M]
                                [--requests N] [--loose] (concurrent-serving
                                load: throughput, p50/p99, fusion factor)
@@ -698,6 +701,57 @@ def cmd_load_driver(args):
         server.scheduler.shutdown(timeout=2.0)
 
 
+def cmd_trace(args):
+    """Fetch request traces from a running server's ``/debug/traces``
+    and pretty-print the span tree (or dump Perfetto JSON): the
+    operator's view of where one slow query's time went."""
+    import urllib.error
+    import urllib.request
+
+    from geomesa_tpu.tracing import coverage, format_trace
+
+    base = args.url.rstrip("/")
+    if not args.trace_id:
+        with urllib.request.urlopen(
+            f"{base}/debug/traces?limit={args.limit}", timeout=30
+        ) as r:
+            doc = json.loads(r.read())
+        traces = doc.get("traces", [])
+        if not traces:
+            print("(no retained traces — see trace.sample / trace.slow_ms)")
+            return
+        for t in traces:
+            flags = " [slow]" if t.get("slow") else ""
+            print(
+                f"{t['trace_id']}  {t['duration_ms']:>10.2f}ms  "
+                f"{t['name']}{flags}"
+            )
+        return
+    url = f"{base}/debug/traces/{args.trace_id}"
+    if args.perfetto:
+        url += "?format=perfetto"
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            doc = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        sys.exit(
+            f"error: HTTP {e.code} "
+            f"({e.read().decode(errors='replace')[:200]})"
+        )
+    if args.perfetto:
+        text = json.dumps(doc)
+        if args.output and args.output != "-":
+            with open(args.output, "w") as fh:
+                fh.write(text)
+            print(f"wrote Perfetto trace to {args.output} "
+                  "(open in https://ui.perfetto.dev)")
+        else:
+            print(text)
+        return
+    print(format_trace(doc))
+    print(f"span coverage of request wall time: {coverage(doc) * 100:.1f}%")
+
+
 def cmd_count(args):
     store = _store(args)
     print(store.count(args.feature_name, args.cql or "INCLUDE"))
@@ -863,6 +917,20 @@ def main(argv=None) -> None:
     )
     _add_sched_flags(sp)
     _add_io_flags(sp)
+
+    sp = add("trace", cmd_trace)
+    sp.add_argument("--url", required=True,
+                    help="running server base URL (e.g. http://host:port)")
+    sp.add_argument("trace_id", nargs="?",
+                    help="trace id (the X-Request-Id); omit to list "
+                    "recent traces")
+    sp.add_argument("--perfetto", action="store_true",
+                    help="emit Chrome-trace/Perfetto JSON instead of the "
+                    "pretty tree")
+    sp.add_argument("-o", "--output", default="-",
+                    help="with --perfetto: write the JSON here")
+    sp.add_argument("--limit", type=int, default=50,
+                    help="max traces to list (no trace_id)")
 
     sp = add("load-driver", cmd_load_driver)
     sp.add_argument("-f", "--feature-name", required=True)

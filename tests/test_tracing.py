@@ -1,0 +1,555 @@
+"""End-to-end query tracing (tracing.py): span nesting/ordering across
+the prefetch worker pool, sampling + slow-query always-capture, Perfetto
+export schema, the scheduler's trace spans, the /debug/traces endpoints
+through a real server, and the metrics/audit satellite regressions."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.conf import prop_override
+from geomesa_tpu.tracing import (
+    Tracer,
+    attach,
+    capture,
+    coverage,
+    current_trace_id,
+    format_trace,
+    record_span,
+    span,
+)
+
+SPEC = "name:String,count:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def _poll(fn, timeout=5.0):
+    """Retry ``fn`` until it returns truthy: trace retention (and the
+    slow-query log append) happen on the handler thread AFTER the
+    response bytes go out, so an immediate read can race them."""
+    deadline = time.time() + timeout
+    while True:
+        out = fn()
+        if out or time.time() > deadline:
+            return out
+        time.sleep(0.01)
+
+
+def _fill(store, n=6000, seed=11):
+    from geomesa_tpu.filter.ecql import parse_instant
+
+    store.create_schema("gdelt", SPEC)
+    rng = np.random.default_rng(seed)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    store.write("gdelt", {
+        "name": rng.choice(["alpha", "beta"], n),
+        "count": rng.integers(0, 100, n),
+        "dtg": rng.integers(t0, t1, n),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+    store.flush("gdelt")
+
+
+class TestSpans:
+    def test_nesting_and_ordering(self):
+        tr = Tracer()
+        with prop_override("trace.sample", 1.0):
+            with tr.trace("req") as t:
+                with span("a"):
+                    with span("a1"):
+                        pass
+                with span("b") as sp:
+                    sp.set(rows=7)
+        doc = tr.get(t.trace_id).to_dict()
+        root = doc["spans"]
+        assert root["name"] == "req"
+        assert [c["name"] for c in root["children"]] == ["a", "b"]
+        a, b = root["children"]
+        assert a["children"][0]["name"] == "a1"
+        assert b["attrs"]["rows"] == 7
+        # start offsets are trace-relative and ordered; durations filled
+        assert 0.0 <= a["start_ms"] <= b["start_ms"]
+        for sp_ in (root, a, b, a["children"][0]):
+            assert sp_["dur_ms"] is not None and sp_["dur_ms"] >= 0.0
+        assert doc["duration_ms"] >= a["dur_ms"]
+
+    def test_no_active_trace_is_noop(self):
+        # span() outside any trace yields the shared no-op — set() works,
+        # nothing records, and nothing leaks into later traces
+        with span("orphan") as sp:
+            sp.set(x=1)
+        assert capture() is None
+        assert current_trace_id() == ""
+
+    def test_spans_cross_prefetch_worker_threads(self):
+        from geomesa_tpu.store.prefetch import (
+            WORKER_PREFIX,
+            PrefetchConfig,
+            prefetch_map,
+        )
+
+        tr = Tracer()
+
+        def work(i):
+            with span("work", i=i):
+                time.sleep(0.002)
+            return i
+
+        with prop_override("trace.sample", 1.0):
+            with tr.trace("req") as t:
+                out = list(
+                    prefetch_map(work, range(8), PrefetchConfig(workers=4))
+                )
+        assert out == list(range(8))
+        root = tr.get(t.trace_id).to_dict()["spans"]
+        works = [c for c in root["children"] if c["name"] == "work"]
+        # every item's span landed in THIS trace despite running on the
+        # pool (capture/attach in prefetch_map), and at least one really
+        # ran on a worker thread
+        assert sorted(c["attrs"]["i"] for c in works) == list(range(8))
+        assert any(c["thread"].startswith(WORKER_PREFIX) for c in works)
+
+    def test_explicit_parent_and_record_span(self):
+        tr = Tracer()
+        with prop_override("trace.sample", 1.0):
+            with tr.trace("req") as t:
+                ctx = capture()
+                done = threading.Event()
+
+                def worker():
+                    # no attach -> no current span on this thread
+                    assert capture() is None
+                    with attach(ctx):
+                        with span("threaded"):
+                            pass
+                    t0 = time.perf_counter()
+                    record_span(ctx, "retro", t0, 0.005, k="v")
+                    done.set()
+
+                th = threading.Thread(target=worker)
+                th.start()
+                th.join()
+                assert done.is_set()
+        root = tr.get(t.trace_id).to_dict()["spans"]
+        names = {c["name"] for c in root["children"]}
+        assert {"threaded", "retro"} <= names
+        retro = next(c for c in root["children"] if c["name"] == "retro")
+        assert retro["dur_ms"] == 5.0 and retro["attrs"]["k"] == "v"
+
+
+class TestSamplingAndSlowCapture:
+    def test_unsampled_fast_trace_not_retained(self, tmp_path):
+        tr = Tracer()
+        tr.slow_log_path = str(tmp_path / "_slow_queries.jsonl")
+        with prop_override("trace.sample", 0.0), \
+                prop_override("trace.slow_ms", 60_000.0):
+            with tr.trace("fast") as t:
+                with span("x"):
+                    pass
+        assert t.recording  # slow capture armed -> spans were recorded
+        assert tr.get(t.trace_id) is None  # ...but fast + unsampled drops
+        assert not (tmp_path / "_slow_queries.jsonl").exists()
+
+    def test_slow_always_captured_and_logged(self, tmp_path):
+        tr = Tracer()
+        tr.slow_log_path = str(tmp_path / "_slow_queries.jsonl")
+        with prop_override("trace.sample", 0.0), \
+                prop_override("trace.slow_ms", 1.0):
+            with tr.trace("slow") as t:
+                with span("x"):
+                    time.sleep(0.01)
+        got = tr.get(t.trace_id)
+        assert got is not None and got.slow and not got.sampled
+        lines = [
+            json.loads(line)
+            for line in open(tmp_path / "_slow_queries.jsonl")
+        ]
+        assert lines[-1]["trace_id"] == t.trace_id
+        assert lines[-1]["slow"] is True
+        assert lines[-1]["spans"]["children"][0]["name"] == "x"
+
+    def test_recording_fully_off(self):
+        tr = Tracer()
+        with prop_override("trace.sample", 0.0), \
+                prop_override("trace.slow_ms", 0.0):
+            with tr.trace("off") as t:
+                with span("x") as sp:
+                    sp.set(a=1)  # no-op, must not raise
+        assert not t.recording
+        assert t.trace_id  # the X-Request-Id echo still works
+        assert tr.get(t.trace_id) is None
+
+    def test_ring_is_bounded(self):
+        tr = Tracer(capacity=4)
+        ids = []
+        with prop_override("trace.sample", 1.0):
+            for i in range(10):
+                with tr.trace(f"r{i}") as t:
+                    pass
+                ids.append(t.trace_id)
+        assert tr.get(ids[0]) is None  # evicted
+        assert tr.get(ids[-1]) is not None
+        assert len(tr.recent(100)) == 4
+        # newest first
+        assert tr.recent(100)[0]["trace_id"] == ids[-1]
+        # limit=0 means none (not "the whole ring" via a -0 slice)
+        assert tr.recent(0) == [] and tr.recent(-3) == []
+        assert len(tr.recent(2)) == 2
+
+    def test_malformed_trace_env_degrades_not_raises(self, monkeypatch):
+        # a bad GEOMESA_TPU_TRACE_SAMPLE must never drop the request the
+        # trace wraps: fall back to slow-capture-only defaults
+        monkeypatch.setenv("GEOMESA_TPU_TRACE_SAMPLE", "on")
+        tr = Tracer()
+        with tr.trace("req") as t:
+            with span("x"):
+                pass
+        assert t.trace_id and not t.sampled and t.recording
+
+    def test_inbound_trace_id_sanitized(self):
+        tr = Tracer()
+        with prop_override("trace.sample", 1.0):
+            with tr.trace("req", trace_id='abc\n"123/../x') as t:
+                pass
+        assert "\n" not in t.trace_id and '"' not in t.trace_id
+        assert "/" not in t.trace_id
+        assert "abc" in t.trace_id
+
+
+class TestExport:
+    def _one_trace(self):
+        tr = Tracer()
+        with prop_override("trace.sample", 1.0):
+            with tr.trace("req") as t:
+                with span("a", rows=3):
+                    with span("b"):
+                        pass
+        return tr.get(t.trace_id)
+
+    def test_perfetto_schema(self):
+        t = self._one_trace()
+        doc = t.to_perfetto()
+        assert doc["otherData"]["trace_id"] == t.trace_id
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        ms = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert {e["name"] for e in xs} == {"req", "a", "b"}
+        for e in xs:
+            assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+            assert e["ts"] > 0 and e["dur"] >= 0
+        assert ms and all(e["name"] == "thread_name" for e in ms)
+        # nesting holds on the timeline: child events start no earlier
+        by_name = {e["name"]: e for e in xs}
+        assert by_name["req"]["ts"] <= by_name["a"]["ts"]
+        assert by_name["a"]["args"]["rows"] == 3
+
+    def test_format_trace_tree(self):
+        doc = self._one_trace().to_dict()
+        text = format_trace(doc)
+        assert doc["trace_id"] in text
+        for name in ("req", "a", "b"):
+            assert name in text
+
+    def test_coverage(self):
+        doc = self._one_trace().to_dict()
+        # "a" wraps nearly the whole trace -> high coverage; empty
+        # children -> zero
+        assert 0.0 < coverage(doc) <= 1.0
+        assert coverage({"spans": None}) == 0.0
+
+
+class TestSchedulerSpans:
+    def test_serial_execution_spans(self):
+        from geomesa_tpu.sched import QueryScheduler, SchedConfig
+
+        tr = Tracer()
+        with prop_override("trace.sample", 1.0):
+            with QueryScheduler(SchedConfig(max_inflight=1)) as sched:
+                with tr.trace("req") as t:
+                    def work():
+                        with span("inner"):
+                            return 42
+
+                    assert sched.run(fn=work) == 42
+        root = tr.get(t.trace_id).to_dict()["spans"]
+        names = [c["name"] for c in root["children"]]
+        assert "sched.wait" in names and "sched.execute" in names
+        ex = next(c for c in root["children"] if c["name"] == "sched.execute")
+        assert ex["attrs"]["fused"] == 1 and ex["attrs"]["launch"] >= 1
+        # the work's own span nests under the execute span (attach)
+        assert [c["name"] for c in ex["children"]] == ["inner"]
+
+
+class TestServerEndToEnd:
+    @pytest.fixture()
+    def served(self, tmp_path):
+        from geomesa_tpu.server import serve_background
+        from geomesa_tpu.store.fs import FileSystemDataStore
+        from geomesa_tpu.tracing import TRACER
+
+        store = FileSystemDataStore(
+            str(tmp_path), partition_size=2048, audit=True
+        )
+        _fill(store)
+        prev = TRACER.slow_log_path
+        server, _ = serve_background(store)
+        host, port = server.server_address[:2]
+        yield f"http://{host}:{port}", store, tmp_path
+        server.shutdown()
+        TRACER.slow_log_path = prev
+
+    def test_trace_id_flow_and_debug_endpoints(self, served):
+        url, store, root = served
+        cql = urllib.request.quote(
+            "BBOX(geom, -5, 42, 8, 51) AND "
+            "dtg DURING 2020-01-05T00:00:00Z/2020-01-20T00:00:00Z"
+        )
+        rid = "req-e2e-1"
+        # trace.slow_ms tiny: EVERY request is a "slow query" -> always
+        # captured + slow-logged, even at sample=0 (the always-on path)
+        with prop_override("trace.sample", 0.0), \
+                prop_override("trace.slow_ms", 0.001):
+            req = urllib.request.Request(
+                f"{url}/count/gdelt?cql={cql}",
+                headers={"X-Request-Id": rid},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.headers["X-Request-Id"] == rid
+                json.loads(r.read())
+
+        # ring: the summary lists it (poll — retention happens on the
+        # handler thread after the response is written)
+        def _listed():
+            with urllib.request.urlopen(
+                f"{url}/debug/traces", timeout=30
+            ) as r:
+                summaries = json.loads(r.read())["traces"]
+            return rid in [t["trace_id"] for t in summaries]
+
+        assert _poll(_listed)
+
+        # full tree covers every serving level that ran
+        with urllib.request.urlopen(
+            f"{url}/debug/traces/{rid}", timeout=30
+        ) as r:
+            doc = json.loads(r.read())
+        names: set = set()
+
+        def walk(sp):
+            names.add(sp["name"])
+            for c in sp.get("children") or []:
+                walk(c)
+
+        walk(doc["spans"])
+        assert {
+            "store.query", "query.plan", "query.scan",
+            "store.read", "store.decode",
+        } <= names
+        assert doc["spans"]["attrs"]["status"] == 200
+        # the acceptance-criteria number, asserted on a request with
+        # real work (/features: scan + geojson encode — measured 99+%):
+        # child spans must explain >= 95% of the request's wall time.
+        # (A near-instant /count can sit just under the bar: its fixed
+        # few-hundred-us Python gaps don't amortize.)
+        rid2 = "req-e2e-2"
+        with prop_override("trace.sample", 1.0):
+            req2 = urllib.request.Request(
+                f"{url}/features/gdelt",  # full scan + full encode
+                headers={"X-Request-Id": rid2},
+            )
+            with urllib.request.urlopen(req2, timeout=60) as r:
+                r.read()
+
+        def _doc2():
+            try:
+                with urllib.request.urlopen(
+                    f"{url}/debug/traces/{rid2}", timeout=30
+                ) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError:
+                return None
+
+        doc2 = _poll(_doc2)
+        assert doc2 is not None
+        assert coverage(doc2) >= 0.95
+
+        # perfetto export
+        with urllib.request.urlopen(
+            f"{url}/debug/traces/{rid}?format=perfetto", timeout=30
+        ) as r:
+            pf = json.loads(r.read())
+        assert pf["traceEvents"] and any(
+            e["name"] == "store.read" for e in pf["traceEvents"]
+        )
+
+        # the SAME id in the slow-query log and the audit log
+        def _slow_logged():
+            p = root / "_slow_queries.jsonl"
+            if not p.exists():
+                return False
+            slow = [json.loads(line) for line in open(p)]
+            return rid in [e["trace_id"] for e in slow]
+
+        assert _poll(_slow_logged)
+        store.audit_writer.flush()
+        events = store.audit_writer.read_events()
+        assert rid in [e.trace_id for e in events]
+
+    def test_unknown_trace_404(self, served):
+        url, _, _ = served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{url}/debug/traces/nosuchtrace", timeout=30
+            )
+        assert ei.value.code == 404
+
+    def test_error_responses_are_traced_with_status(self, served):
+        # the error handler runs INSIDE the trace: a failed request's
+        # trace carries its HTTP status (and is slow-capturable)
+        url, _, _ = served
+        rid = "req-err-1"
+        with prop_override("trace.sample", 0.0), \
+                prop_override("trace.slow_ms", 0.001):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"{url}/count/nosuchtype",
+                        headers={"X-Request-Id": rid},
+                    ),
+                    timeout=30,
+                )
+            assert ei.value.code == 404
+            assert ei.value.headers["X-Request-Id"] == rid
+
+        def _doc():
+            try:
+                with urllib.request.urlopen(
+                    f"{url}/debug/traces/{rid}", timeout=30
+                ) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError:
+                return None
+
+        doc = _poll(_doc)
+        assert doc is not None
+        assert doc["spans"]["attrs"]["status"] == 404
+
+    def test_monitoring_endpoints_not_traced(self, served):
+        url, _, _ = served
+        from geomesa_tpu.tracing import TRACER
+
+        with prop_override("trace.sample", 1.0):
+            before = {t["trace_id"] for t in TRACER.recent(200)}
+            for ep in ("metrics", "debug/traces", "stats/store"):
+                urllib.request.urlopen(f"{url}/{ep}", timeout=30).read()
+            time.sleep(0.1)
+            after = {t["trace_id"] for t in TRACER.recent(200)}
+        assert after == before  # no trace churn from scrapes/snapshots
+
+    def test_trace_cli(self, served, capsys):
+        url, _, _ = served
+        from geomesa_tpu.tools.cli import main as cli_main
+
+        cql = urllib.request.quote("BBOX(geom, -5, 42, 8, 51)")
+        rid = "req-cli-1"
+        with prop_override("trace.sample", 1.0):
+            req = urllib.request.Request(
+                f"{url}/count/gdelt?cql={cql}",
+                headers={"X-Request-Id": rid},
+            )
+            urllib.request.urlopen(req, timeout=30).read()
+
+        def _retained():
+            try:
+                urllib.request.urlopen(
+                    f"{url}/debug/traces/{rid}", timeout=30
+                ).read()
+                return True
+            except urllib.error.HTTPError:
+                return False
+
+        assert _poll(_retained)
+        cli_main(["trace", "--url", url])
+        assert rid in capsys.readouterr().out
+        cli_main(["trace", "--url", url, rid])
+        out = capsys.readouterr().out
+        assert "store.query" in out and "coverage" in out
+
+
+class TestMetricsRegressions:
+    def test_label_values_escaped(self):
+        from geomesa_tpu.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        c = reg.counter("esc_total", "h")
+        c.inc(filter='name = "a\\b"\nAND x')
+        text = reg.prometheus_text()
+        lines = [
+            line for line in text.splitlines()
+            if line.startswith("esc_total{")
+        ]
+        # ONE physical line: the newline was escaped, quotes/backslashes
+        # can't break out of the label value
+        assert len(lines) == 1
+        assert lines[0] == (
+            'esc_total{filter="name = \\"a\\\\b\\"\\nAND x"} 1'
+        )
+
+    def test_prometheus_text_vs_concurrent_writers(self):
+        from geomesa_tpu.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        h = reg.histogram("h_seconds", "h")
+        c = reg.counter("c_total", "h")
+        errs: list = []
+
+        def writer(wid: int):
+            try:
+                # fresh label keys every iteration: the scrape iterates
+                # while the dicts grow (pre-fix this raised "dictionary
+                # changed size during iteration" in the scrape thread)
+                for i in range(4000):
+                    h.observe(0.001 * (i % 50), tag=f"{wid}-{i}")
+                    c.inc(tag=f"{wid}-{i}")
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(w,)) for w in range(3)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            while any(t.is_alive() for t in threads):
+                text = reg.prometheus_text()
+                assert "h_seconds_bucket" in text
+        finally:
+            for t in threads:
+                t.join()
+        assert not errs
+
+
+class TestAuditClose:
+    def test_close_drains_queue(self, tmp_path):
+        from geomesa_tpu.audit import AuditedEvent, FileAuditWriter
+
+        w = FileAuditWriter(str(tmp_path / "q.jsonl"))
+        for i in range(25):
+            w.write(AuditedEvent(
+                store="s", type_name="t", filter=f"f{i}",
+                trace_id=f"tid{i}",
+            ))
+        w.close()
+        events = w.read_events()
+        assert len(events) == 25
+        assert events[0].trace_id == "tid0"
+        # idempotent; post-close stragglers land synchronously
+        w.close()
+        w.write(AuditedEvent(store="s", type_name="t", filter="late"))
+        assert len(w.read_events()) == 26

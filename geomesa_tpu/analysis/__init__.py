@@ -1,0 +1,24 @@
+"""Project-specific static analysis + runtime concurrency checking.
+
+PRs 1-4 built a concurrent serving stack whose correctness rests on
+disciplines that no compiler enforces in Python: locks never held across
+blocking I/O or device launches, fsync-before-publish in the flush path,
+monotonic clocks for durations, tracer context carried explicitly across
+worker pools, bounded metric label cardinality. Upstream GeoMesa leans
+on scalac/Error Prone-style compile-time checking for exactly this class
+of invariant; a Python rebuild loses that layer entirely, so this
+package encodes the rules the repo itself established and runs them on
+every tier-1 pass:
+
+- :mod:`geomesa_tpu.analysis.lint` -- an AST-based project linter with
+  repo-specific rules GT001-GT008 (see ``geomesa-tpu lint`` and the
+  README rule table). Each rule has a ``# lint: disable=GTnnn(reason)``
+  escape hatch; a reason is mandatory.
+- :mod:`geomesa_tpu.analysis.lockcheck` -- a runtime lock-order checker
+  (the thread-sanitizer analog): every lock built through
+  ``locking.checked_lock()`` records its acquisition graph, ABBA
+  lock-order cycles and lock-held-across-blocking-call events are
+  reported, and the whole test suite runs under it via the conftest
+  fixture (env ``GEOMESA_TPU_LOCKCHECK``). Off by default in
+  production with near-zero overhead.
+"""

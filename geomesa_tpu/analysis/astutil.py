@@ -1,0 +1,51 @@
+"""Shared AST helpers for the rule modules."""
+
+from __future__ import annotations
+
+import ast
+
+__all__ = ["terminal_name", "receiver_name", "walk_no_defs", "str_arg"]
+
+
+def terminal_name(node) -> "str | None":
+    """The rightmost identifier of a Name/Attribute chain
+    (``self._mem_lock`` -> ``_mem_lock``; ``np.asarray`` -> ``asarray``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def receiver_name(node) -> "str | None":
+    """The identifier the attribute hangs off (``os.replace`` -> ``os``;
+    ``self._q.get`` -> ``_q``). None for non-attribute nodes."""
+    if not isinstance(node, ast.Attribute):
+        return None
+    return terminal_name(node.value)
+
+
+def walk_no_defs(node):
+    """Walk a statement body WITHOUT descending into nested function /
+    lambda definitions -- their bodies execute later, outside whatever
+    lexical context (held lock, loop) is being analyzed."""
+    stack = list(node) if isinstance(node, list) else [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)
+
+
+def str_arg(call: ast.Call, index: int = 0) -> "str | None":
+    """The call's ``index``-th positional arg when it is a string
+    literal, else None."""
+    if len(call.args) > index and isinstance(call.args[index], ast.Constant):
+        v = call.args[index].value
+        if isinstance(v, str):
+            return v
+    return None

@@ -1,0 +1,290 @@
+"""AST-based project linter: the GT001-GT008 invariant rules.
+
+Driver only -- the rules themselves live in
+:mod:`geomesa_tpu.analysis.rules`, one module per rule. Each rule walks
+a parsed module and yields :class:`Finding`s; findings are suppressed by
+a ``# lint: disable=GTnnn(reason)`` comment on the flagged line or the
+line directly above it. The reason is mandatory: a bare
+``disable=GTnnn`` does NOT suppress (an un-justified exemption is
+exactly the silent regression the linter exists to prevent).
+
+Entry points: :func:`lint_paths` (files/directories), :func:`lint_package`
+(the installed ``geomesa_tpu`` tree -- what the self-lint test and the
+``geomesa-tpu lint`` default run), and :func:`main` (CLI body; exit 0
+clean / 1 findings / 2 unreadable input).
+
+The linter is purely static: it parses source text and never imports
+the code under analysis, so it runs without jax and can lint fixture
+trees that would not import at all.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+__all__ = [
+    "Finding",
+    "LintContext",
+    "lint_file",
+    "lint_paths",
+    "lint_package",
+    "format_findings",
+    "main",
+]
+
+_DISABLE_RE = re.compile(
+    r"#\s*lint:\s*disable=((?:GT\d{3})(?:\s*,\s*GT\d{3})*)\s*\(([^)#]*)\)"
+)
+# the lookahead rejects BOTH '(' and ',': rejecting only '(' lets the
+# regex engine backtrack the greedy code-list one element short and
+# "find" a bare directive inside a reasoned multi-code disable
+_BARE_DISABLE_RE = re.compile(
+    r"#\s*lint:\s*disable=((?:GT\d{3})(?:\s*,\s*GT\d{3})*)(?!\s*[,(])"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation: ``rule`` is the GTnnn code, ``line`` 1-based."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class LintContext:
+    """Per-file state handed to every rule: the parsed tree, source
+    lines, the path relative to the lint root (forward slashes -- rules
+    scope themselves by it, e.g. GT007 to ``store/``), and the project
+    registries (declared conf keys, registered failpoint names) parsed
+    STATICALLY from source so linting never imports the linted code."""
+
+    def __init__(self, path, rel, src, tree, conf_keys, failpoints):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = tree
+        self.conf_keys = conf_keys
+        self.failpoints = failpoints
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        return Finding(
+            rule,
+            self.path,
+            getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0) + 1,
+            message,
+        )
+
+
+def _disabled_rules(lines) -> "dict[int, set]":
+    """line (1-based) -> set of GT codes a reasoned disable comment on
+    that line suppresses."""
+    out: dict = {}
+    for i, line in enumerate(lines, start=1):
+        m = _DISABLE_RE.search(line)
+        if m and m.group(2).strip():
+            out[i] = {c.strip() for c in m.group(1).split(",")}
+    return out
+
+
+def _bare_disables(lines) -> "list[tuple[int, str]]":
+    """Reason-less ``disable=GTnnn`` directives: reported as findings of
+    the rule they tried to silence (the exemption needs a justification)."""
+    out: list = []
+    for i, line in enumerate(lines, start=1):
+        m = _BARE_DISABLE_RE.search(line)
+        if m:
+            for code in m.group(1).split(","):
+                out.append((i, code.strip()))
+    return out
+
+
+# -- project registries (parsed, never imported) -----------------------------
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _find_source(root: str, name: str) -> "str | None":
+    """Locate ``name`` (e.g. ``conf.py``) in the linted tree, falling
+    back to this package's own copy -- fixture trees usually carry no
+    registry of their own and lint against the real one."""
+    for cand in (
+        os.path.join(root, name),
+        os.path.join(root, "geomesa_tpu", name),
+    ):
+        if os.path.isfile(cand):
+            return cand
+    own = os.path.join(_package_root(), name)
+    return own if os.path.isfile(own) else None
+
+
+def _assigned_node(tree, target: str):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == target:
+                    return node.value
+    return None
+
+
+def _parse_conf_keys(root: str) -> "frozenset[str]":
+    """The GT008 key registry: string keys of the ``_DEFS`` dict in
+    conf.py (every declared system property)."""
+    path = _find_source(root, "conf.py")
+    if path is None:
+        return frozenset()
+    try:
+        with open(path) as fh:
+            value = _assigned_node(ast.parse(fh.read()), "_DEFS")
+    except (OSError, SyntaxError):
+        return frozenset()
+    if not isinstance(value, ast.Dict):
+        return frozenset()
+    return frozenset(
+        k.value
+        for k in value.keys
+        if isinstance(k, ast.Constant) and isinstance(k.value, str)
+    )
+
+
+def _parse_failpoints(root: str) -> "frozenset[str]":
+    """The GT005 registry: the ``POINTS`` tuple in failpoints.py."""
+    path = _find_source(root, "failpoints.py")
+    if path is None:
+        return frozenset()
+    try:
+        with open(path) as fh:
+            value = _assigned_node(ast.parse(fh.read()), "POINTS")
+    except (OSError, SyntaxError):
+        return frozenset()
+    if not isinstance(value, (ast.Tuple, ast.List)):
+        return frozenset()
+    return frozenset(
+        e.value
+        for e in value.elts
+        if isinstance(e, ast.Constant) and isinstance(e.value, str)
+    )
+
+
+# -- driver ------------------------------------------------------------------
+
+
+def lint_file(
+    path: str,
+    rel: "str | None" = None,
+    root: "str | None" = None,
+    rules=None,
+    _registries=None,
+) -> "list[Finding]":
+    from geomesa_tpu.analysis.rules import ALL_RULES
+
+    root = root or os.path.dirname(os.path.abspath(path))
+    rel = rel if rel is not None else os.path.basename(path)
+    with open(path) as fh:
+        src = fh.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("GT000", path, e.lineno or 1, 1, f"syntax error: {e.msg}")]
+    conf_keys, failpoints = _registries or (
+        _parse_conf_keys(root),
+        _parse_failpoints(root),
+    )
+    ctx = LintContext(path, rel, src, tree, conf_keys, failpoints)
+    disabled = _disabled_rules(ctx.lines)
+    findings: list = []
+    seen = set()  # nested withs/loops walk shared sub-trees: dedupe
+    for rule in rules if rules is not None else ALL_RULES:
+        for f in rule.check(ctx):
+            if f in seen:
+                continue
+            seen.add(f)
+            if f.rule in disabled.get(f.line, ()) or f.rule in disabled.get(
+                f.line - 1, ()
+            ):
+                continue
+            findings.append(f)
+    for line, code in _bare_disables(ctx.lines):
+        findings.append(
+            Finding(
+                code,
+                path,
+                line,
+                1,
+                "disable comment without a reason -- use "
+                f"`# lint: disable={code}(why this site is exempt)`",
+            )
+        )
+    return findings
+
+
+def _iter_py_files(top: str):
+    for dirpath, dirnames, names in os.walk(top):
+        dirnames[:] = [
+            d for d in sorted(dirnames) if d != "__pycache__" and not d.startswith(".")
+        ]
+        for name in sorted(names):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def lint_paths(paths, rules=None) -> "list[Finding]":
+    """Lint files and/or directory trees; findings sorted by location.
+    Relative paths (rule scoping, e.g. GT007's ``store/``) resolve
+    against each given directory (or the file's own directory)."""
+    findings: list = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isdir(p):
+            registries = (_parse_conf_keys(p), _parse_failpoints(p))
+            for f in _iter_py_files(p):
+                findings += lint_file(
+                    f,
+                    rel=os.path.relpath(f, p),
+                    root=p,
+                    rules=rules,
+                    _registries=registries,
+                )
+        elif os.path.isfile(p):
+            findings += lint_file(p, rules=rules)
+        else:
+            raise FileNotFoundError(p)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_package(rules=None) -> "list[Finding]":
+    """Lint the installed ``geomesa_tpu`` tree itself (the self-lint
+    test and the ``geomesa-tpu lint`` default)."""
+    return lint_paths([_package_root()], rules=rules)
+
+
+def format_findings(findings) -> str:
+    return "\n".join(f.format() for f in findings)
+
+
+def main(paths=None, out=print) -> int:
+    """CLI body (``geomesa-tpu lint``): 0 clean, 1 findings, 2 on an
+    unreadable input path."""
+    try:
+        findings = lint_paths(paths) if paths else lint_package()
+    except FileNotFoundError as e:
+        out(f"error: no such file or directory: {e}")
+        return 2
+    if findings:
+        out(format_findings(findings))
+        out(f"{len(findings)} finding(s)")
+        return 1
+    return 0

@@ -1,0 +1,336 @@
+"""Runtime lock-order checker: the thread-sanitizer analog for the
+repo's in-process locks.
+
+Every lock built through ``locking.checked_lock(name)`` /
+``checked_rlock(name)`` is, when ``GEOMESA_TPU_LOCKCHECK`` is set in the
+environment, a drop-in instrumented wrapper that records the process's
+lock acquisition graph:
+
+- **Order edges.** Acquiring B while holding A records the edge
+  ``A -> B`` (by lock NAME, so per-instance locks like per-trace span
+  locks collapse into one bounded node). The first edge that closes a
+  cycle (``A -> B`` and, from another code path, ``B -> A``) is an ABBA
+  deadlock POTENTIAL: the two paths merely have to run concurrently
+  once. Recorded immediately with both paths' thread names -- no actual
+  deadlock required to catch it.
+- **Held-across-blocking events.** :func:`install_probes` wraps a small
+  set of blocking primitives (``open``, ``time.sleep``, ``os.fsync``,
+  ``os.replace``, ``queue.Queue.get``); each probe checks this thread's
+  held-lock stack and records an event for every held lock not created
+  with ``blocking_ok=True`` (locks whose PURPOSE is to order blocking
+  writes -- append logs, first-touch device staging -- opt out at the
+  declaration, where a reviewer can see the justification next to the
+  GT002 disable comment).
+
+Off by default: with the env unset, ``checked_lock`` returns a plain
+``threading.Lock`` -- zero per-acquisition overhead in production. The
+test suite switches it on process-wide via the conftest (which sets the
+env before any package import, so module-level locks instrument too);
+``CHECKER.report()`` is the session's findings, and the
+``geomesa_lockcheck_*`` gauges mirror it for scrapes.
+
+Seeding tests build a private :class:`LockCheck` and pass it to
+:class:`CheckedLock` -- edges and events only ever record into the
+checker of the locks involved, so a deliberately-inverted pair in a test
+cannot pollute the global report.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = [
+    "ENV_VAR",
+    "CHECKER",
+    "CheckedLock",
+    "LockCheck",
+    "enabled",
+    "install_probes",
+]
+
+ENV_VAR = "GEOMESA_TPU_LOCKCHECK"
+
+
+def enabled() -> bool:
+    """True when the environment arms the checker (read dynamically --
+    but locks already built as plain ``threading.Lock`` stay plain, so
+    set the env before the process imports the package)."""
+    return os.environ.get(ENV_VAR, "").strip().lower() in (
+        "1", "true", "t", "yes", "on",
+    )
+
+
+_tls = threading.local()
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+class LockCheck:
+    """One acquisition graph + findings store. The module-level
+    :data:`CHECKER` is the process-wide one every ``checked_lock`` uses;
+    tests build private instances for seeded scenarios."""
+
+    def __init__(self, name: str = "global"):
+        self.name = name
+        # the checker's own mutex must be invisible to itself
+        self._mu = threading.Lock()  # lint: disable=GT001(the checker's internal mutex cannot be a checked lock)
+        self._order: "dict[str, set]" = {}  # name -> names acquired after
+        self._edges: "dict[tuple, dict]" = {}  # (a, b) -> first context
+        self._cycles: list = []
+        self._cycle_keys: set = set()
+        self._blocking: list = []
+        self._blocking_keys: set = set()
+        self._locks: set = set()
+        self.acquisitions = 0
+
+    # -- recording (called by CheckedLock / the probes) --------------------
+
+    def _register(self, lock: "CheckedLock") -> None:
+        with self._mu:
+            self._locks.add(lock.name)
+
+    def _on_acquired(self, lock: "CheckedLock") -> None:
+        held = _held()
+        self.acquisitions += 1
+        if held:
+            thread = threading.current_thread().name
+            with self._mu:
+                for h in held:
+                    if h.checker is not self or h.name == lock.name:
+                        continue  # cross-checker pairs never mix reports
+                    key = (h.name, lock.name)
+                    if key in self._edges:
+                        continue
+                    self._edges[key] = {"thread": thread}
+                    self._order.setdefault(h.name, set()).add(lock.name)
+                    cycle = self._find_path(lock.name, h.name)
+                    if cycle:
+                        self._record_cycle(cycle + [lock.name], thread)
+        held.append(lock)
+
+    def _on_released(self, lock: "CheckedLock") -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def _find_path(self, start: str, target: str) -> "list | None":
+        """A path start ->* target in the order graph (callers hold
+        ``_mu``). Non-None means the new edge target->start... closed a
+        cycle; returns the path for the report."""
+        stack = [(start, [start])]
+        seen = {start}
+        while stack:
+            node, path = stack.pop()
+            if node == target:
+                return path
+            for nxt in self._order.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _record_cycle(self, path: list, thread: str) -> None:
+        key = frozenset(path)
+        if key in self._cycle_keys:
+            return
+        self._cycle_keys.add(key)
+        self._cycles.append(
+            {
+                "locks": list(path),
+                "thread": thread,
+                "edges": {
+                    f"{a}->{b}": self._edges.get((a, b), {}).get("thread")
+                    for a, b in zip(path, path[1:])
+                },
+            }
+        )
+
+    def _record_blocking(self, lock: "CheckedLock", op: str, detail: str) -> None:
+        key = (lock.name, op)
+        with self._mu:
+            if key in self._blocking_keys:
+                return
+            self._blocking_keys.add(key)
+            self._blocking.append(
+                {
+                    "lock": lock.name,
+                    "op": op,
+                    "detail": detail,
+                    "thread": threading.current_thread().name,
+                }
+            )
+
+    # -- read side ---------------------------------------------------------
+
+    def report(self) -> dict:
+        """The findings document: registered locks, order-edge count,
+        lock-order cycles (ABBA potentials) and held-across-blocking
+        events. Also pushes the ``geomesa_lockcheck_*`` gauges."""
+        with self._mu:
+            doc = {
+                "checker": self.name,
+                "acquisitions": int(self.acquisitions),
+                "locks": sorted(self._locks),
+                "edges": sorted(f"{a}->{b}" for a, b in self._edges),
+                "cycles": [dict(c) for c in self._cycles],
+                "blocking": [dict(b) for b in self._blocking],
+            }
+        self._publish(doc)
+        return doc
+
+    def _publish(self, doc: dict) -> None:
+        if self is not CHECKER:
+            return  # private (seeded-test) checkers stay off the metrics
+        try:
+            from geomesa_tpu import metrics
+
+            metrics.lockcheck_locks.set(len(doc["locks"]))
+            metrics.lockcheck_edges.set(len(doc["edges"]))
+            metrics.lockcheck_cycles.set(len(doc["cycles"]))
+            metrics.lockcheck_blocking.set(len(doc["blocking"]))
+        except Exception:  # pragma: no cover - observability must not break
+            pass
+
+    def clear(self) -> None:
+        with self._mu:
+            self._order.clear()
+            self._edges.clear()
+            self._cycles.clear()
+            self._cycle_keys.clear()
+            self._blocking.clear()
+            self._blocking_keys.clear()
+            self.acquisitions = 0
+
+
+CHECKER = LockCheck()
+
+
+class CheckedLock:
+    """Instrumented drop-in for ``threading.Lock`` / ``RLock``
+    (``reentrant=True``). ``blocking_ok`` exempts the lock from
+    held-across-blocking events (NOT from cycle detection) -- for locks
+    whose purpose is to order blocking writes."""
+
+    __slots__ = ("name", "checker", "blocking_ok", "reentrant", "_lock")
+
+    def __init__(
+        self,
+        name: str,
+        checker: "LockCheck | None" = None,
+        reentrant: bool = False,
+        blocking_ok: bool = False,
+    ):
+        self.name = name
+        self.checker = checker if checker is not None else CHECKER
+        self.blocking_ok = blocking_ok
+        self.reentrant = reentrant
+        self._lock = (
+            threading.RLock() if reentrant else threading.Lock()  # lint: disable=GT001(this IS the checked factory's backing lock)
+        )
+        self.checker._register(self)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self.checker._on_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        self.checker._on_released(self)
+        self._lock.release()
+
+    def __enter__(self) -> "CheckedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<CheckedLock {self.name!r} reentrant={self.reentrant}>"
+
+
+# -- blocking-call probes ----------------------------------------------------
+
+_probes_installed = False
+_orig: dict = {}
+
+
+def _note_blocking(op: str, detail: str = "") -> None:
+    held = getattr(_tls, "held", None)
+    if not held:
+        return  # the fast path: virtually every call in the process
+    for lock in held:
+        if not lock.blocking_ok:
+            lock.checker._record_blocking(lock, op, str(detail)[:120])
+
+
+def install_probes() -> None:
+    """Wrap the blocking primitives (idempotent). Each wrapper is a
+    thread-local-read when no checked lock is held, so the patched
+    process stays test-suite fast."""
+    global _probes_installed
+    if _probes_installed:
+        return
+    _probes_installed = True
+    import builtins
+    import queue as _queue
+    import time as _time
+
+    _orig["open"] = builtins.open
+    _orig["sleep"] = _time.sleep
+    _orig["fsync"] = os.fsync
+    _orig["replace"] = os.replace
+    _orig["queue_get"] = _queue.Queue.get
+
+    def open_probe(file, *a, **k):
+        _note_blocking("open", file)
+        return _orig["open"](file, *a, **k)
+
+    def sleep_probe(secs):
+        _note_blocking("time.sleep", secs)
+        return _orig["sleep"](secs)
+
+    def fsync_probe(fd):
+        _note_blocking("os.fsync", fd)
+        return _orig["fsync"](fd)
+
+    def replace_probe(src, dst, *a, **k):
+        _note_blocking("os.replace", dst)
+        return _orig["replace"](src, dst, *a, **k)
+
+    def queue_get_probe(self, block=True, timeout=None):
+        if block:
+            _note_blocking("queue.get")
+        return _orig["queue_get"](self, block, timeout)
+
+    builtins.open = open_probe
+    _time.sleep = sleep_probe
+    os.fsync = fsync_probe
+    os.replace = replace_probe
+    _queue.Queue.get = queue_get_probe
+
+
+def uninstall_probes() -> None:
+    """Restore the wrapped primitives (test hygiene only)."""
+    global _probes_installed
+    if not _probes_installed:
+        return
+    import builtins
+    import queue as _queue
+    import time as _time
+
+    builtins.open = _orig["open"]
+    _time.sleep = _orig["sleep"]
+    os.fsync = _orig["fsync"]
+    os.replace = _orig["replace"]
+    _queue.Queue.get = _orig["queue_get"]
+    _probes_installed = False

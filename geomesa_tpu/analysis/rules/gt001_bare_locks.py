@@ -1,0 +1,52 @@
+"""GT001: bare ``threading.Lock()`` / ``threading.RLock()`` outside the
+checked factory.
+
+Every in-process mutex must be built through
+``locking.checked_lock(name)`` / ``checked_rlock(name)`` so the runtime
+lock-order checker (analysis/lockcheck.py) can see it: a bare lock is
+invisible to cycle detection and held-across-blocking accounting, which
+is how the next ABBA deadlock ships unnoticed. ``locking.py`` itself is
+the factory and exempt; references (``default_factory=threading.Lock``)
+are flagged as well as calls.
+"""
+
+from __future__ import annotations
+
+import ast
+
+CODE = "GT001"
+TITLE = (
+    "bare threading.Lock()/RLock() -- use locking.checked_lock()/"
+    "checked_rlock() so the lock-order checker can see it"
+)
+
+_FACTORY_FILES = ("locking.py",)
+
+
+def check(ctx):
+    if ctx.rel.rsplit("/", 1)[-1] in _FACTORY_FILES:
+        return
+    from_threading = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for alias in node.names:
+                if alias.name in ("Lock", "RLock"):
+                    from_threading.add(alias.asname or alias.name)
+    for node in ast.walk(ctx.tree):
+        bare = None
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in ("Lock", "RLock")
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "threading"
+        ):
+            bare = f"threading.{node.attr}"
+        elif isinstance(node, ast.Name) and node.id in from_threading:
+            bare = node.id
+        if bare is not None:
+            yield ctx.finding(
+                CODE,
+                node,
+                f"bare {bare} -- build locks via locking.checked_lock(name)"
+                " / checked_rlock(name) (runtime lock-order checking)",
+            )

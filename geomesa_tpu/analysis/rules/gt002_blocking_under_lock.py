@@ -1,0 +1,89 @@
+"""GT002: blocking call while an in-process lock is held.
+
+A lock held across file I/O, a queue wait, a socket operation or a
+device sync serializes every other thread on the holder's I/O latency
+-- the exact pathology PR 2 removed from the scan path (lock-free worker
+reads under a consumer-held lock) and PR 1 designed the scheduler
+around. Sites where holding IS the point (an append log whose lock
+exists to order its writes) carry a reasoned disable comment and a
+``blocking_ok=True`` checked-lock annotation for the runtime checker.
+
+Heuristics (static analysis can only see lexical structure): a with-item
+whose terminal identifier looks lock-ish (``...lock``, ``_cv``,
+``...mutex``) opens a held region; direct calls in that region matching
+the blocking table below are flagged. Calls behind helper functions are
+the runtime checker's job.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from geomesa_tpu.analysis.astutil import receiver_name, terminal_name, walk_no_defs
+
+CODE = "GT002"
+TITLE = "blocking call (file/socket I/O, queue.get, sleep, device sync) under a held lock"
+
+_LOCKISH = re.compile(r"(lock|mutex)$|^_?cv$")
+_QUEUEISH = re.compile(r"^_?q$|queue$")
+_FILEISH = re.compile(r"^_?(fh|f|file|sock)$|(fh|file|sock)$")
+
+#: attribute calls that block regardless of receiver
+_BLOCKING_ATTRS = {
+    "fsync", "replace", "rename", "renames", "urlopen", "sleep",
+    "block_until_ready", "accept", "recv", "send", "sendall", "connect",
+}
+#: attribute calls that block for specific receivers
+_SUBPROCESS_ATTRS = {"run", "call", "check_call", "check_output"}
+
+
+def _lockish_item(item: ast.withitem) -> bool:
+    name = terminal_name(item.context_expr)
+    return bool(name and _LOCKISH.search(name.lower()))
+
+
+def _blocking(call: ast.Call) -> "str | None":
+    func = call.func
+    if isinstance(func, ast.Name) and func.id == "open":
+        return "open()"
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr, recv = func.attr, (receiver_name(func) or "")
+    if attr in _BLOCKING_ATTRS:
+        return f"{recv + '.' if recv else ''}{attr}()"
+    if attr in _SUBPROCESS_ATTRS and recv == "subprocess":
+        return f"subprocess.{attr}()"
+    if attr == "flock" and recv == "fcntl":
+        return "fcntl.flock()"
+    if attr == "get" and _QUEUEISH.search(recv.lower()):
+        return f"{recv}.get()"
+    if attr in ("write", "flush", "read", "readline", "readinto") and _FILEISH.search(
+        recv.lower()
+    ):
+        return f"{recv}.{attr}()"
+    return None
+
+
+def check(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):
+            continue
+        held = [
+            terminal_name(i.context_expr)
+            for i in node.items
+            if _lockish_item(i)
+        ]
+        if not held:
+            continue
+        for sub in walk_no_defs(node.body):
+            if isinstance(sub, ast.Call):
+                what = _blocking(sub)
+                if what:
+                    yield ctx.finding(
+                        CODE,
+                        sub,
+                        f"{what} while holding {held[0]!r} -- move the "
+                        "blocking call outside the lock, or disable with "
+                        "a reason AND mark the lock blocking_ok=True",
+                    )

@@ -1,0 +1,46 @@
+"""GT003: ``time.time()`` used where a duration or deadline needs a
+monotonic clock.
+
+Wall clock jumps (NTP step, VM migration, manual reset) extend or
+truncate anything computed as a ``time.time()`` difference -- the
+pre-fix audit drain deadline could stall ``close()`` unboundedly on a
+backwards jump. Durations and deadlines use ``time.monotonic()`` /
+``time.perf_counter()``; the few INTENTIONAL epoch uses (timestamps
+persisted into data or logs, the Perfetto trace anchor) carry a
+reasoned ``# lint: disable=GT003(...)`` -- that comment IS the
+allowlist, kept next to the use it justifies.
+"""
+
+from __future__ import annotations
+
+import ast
+
+CODE = "GT003"
+TITLE = "time.time() for durations/deadlines -- use time.monotonic()"
+
+
+def check(ctx):
+    imported_time_fn = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    imported_time_fn.add(alias.asname or alias.name)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        flagged = (
+            isinstance(func, ast.Attribute)
+            and func.attr == "time"
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("time", "_time")
+        ) or (isinstance(func, ast.Name) and func.id in imported_time_fn)
+        if flagged:
+            yield ctx.finding(
+                CODE,
+                node,
+                "time.time() is wall-clock: durations and deadlines must "
+                "use time.monotonic() (intentional epoch timestamps get a "
+                "reasoned disable comment)",
+            )

@@ -1,0 +1,65 @@
+"""GT004: host synchronization inside loops on the device hot paths.
+
+``np.asarray`` / ``jax.device_get`` / ``.block_until_ready()`` /
+``.item()`` on a JAX array forces a device->host transfer and stalls
+the dispatch pipeline; inside a loop that is one round trip PER
+ITERATION -- the anti-pattern the fused/batched launches of PRs 1-2
+exist to avoid. Scoped to the files where a loop is plausibly iterating
+device work: ``ops/``, ``query/runner.py``, ``sched/fusion.py``.
+Intended sync points (the mask fetch that ends a launch) carry a
+reasoned disable comment.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from geomesa_tpu.analysis.astutil import receiver_name, walk_no_defs
+
+CODE = "GT004"
+TITLE = "host sync (np.asarray/device_get/block_until_ready/.item) in a device hot-path loop"
+
+_HOT_PREFIXES = ("ops/",)
+_HOT_FILES = {"query/runner.py", "sched/fusion.py"}
+
+_NP_SYNCS = {"asarray", "array"}
+_ANY_SYNCS = {"block_until_ready", "item"}
+
+
+def _applies(rel: str) -> bool:
+    rel = rel.removeprefix("geomesa_tpu/")
+    return rel in _HOT_FILES or any(rel.startswith(p) for p in _HOT_PREFIXES)
+
+
+def _sync_call(call: ast.Call) -> "str | None":
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    recv = receiver_name(func) or ""
+    if func.attr in _NP_SYNCS and recv in ("np", "numpy", "onp"):
+        return f"{recv}.{func.attr}()"
+    if func.attr == "device_get" and recv == "jax":
+        return "jax.device_get()"
+    if func.attr in _ANY_SYNCS:
+        return f".{func.attr}()"
+    return None
+
+
+def check(ctx):
+    if not _applies(ctx.rel):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        for sub in walk_no_defs(node.body):
+            if isinstance(sub, ast.Call):
+                what = _sync_call(sub)
+                if what:
+                    yield ctx.finding(
+                        CODE,
+                        sub,
+                        f"{what} inside a loop on a device hot path forces "
+                        "one device->host round trip per iteration -- batch "
+                        "the transfer outside the loop (an intended "
+                        "per-launch sync point gets a reasoned disable)",
+                    )

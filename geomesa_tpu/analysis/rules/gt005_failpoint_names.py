@@ -1,0 +1,47 @@
+"""GT005: failpoint name literals must be registered in
+``failpoints.POINTS``.
+
+A chaos test arming ``fail.flsh.before_publish`` (typo) silently tests
+nothing -- the store evaluates a different name and the kill never
+fires. Registration keeps the set of interesting instants reviewable in
+one place; the registry is parsed statically from failpoints.py so the
+linter never imports the package.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from geomesa_tpu.analysis.astutil import str_arg, terminal_name
+
+CODE = "GT005"
+TITLE = "failpoint name literal not registered in failpoints.POINTS"
+
+_FAIL_FNS = {
+    "fail_point",
+    "fail_hit",
+    "set_failpoint",
+    "clear_failpoint",
+    "failpoint_override",
+}
+
+
+def check(ctx):
+    if not ctx.failpoints:
+        return  # no registry found: nothing to validate against
+    if ctx.rel.rsplit("/", 1)[-1] == "failpoints.py":
+        return  # the registry itself
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if terminal_name(node.func) not in _FAIL_FNS:
+            continue
+        name = str_arg(node)
+        if name is not None and name not in ctx.failpoints:
+            yield ctx.finding(
+                CODE,
+                node,
+                f"failpoint {name!r} is not registered in "
+                "failpoints.POINTS -- a typo here arms nothing; add it to "
+                "the registry (or fix the name)",
+            )

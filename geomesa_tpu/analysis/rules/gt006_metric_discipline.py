@@ -1,0 +1,53 @@
+"""GT006: metric-name / label discipline.
+
+Names: every metric registered on a registry must carry the
+``geomesa_`` prefix (one namespace on shared Prometheus infrastructure)
+and be lower_snake_case. Labels: a label value built from an f-string
+or string concatenation is a cardinality bomb -- each distinct value
+mints a new time series, and an interpolated filter string or id turns
+the registry into an unbounded allocation. Label values must be
+bounded, str-typed enums or names.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from geomesa_tpu.analysis.astutil import receiver_name, str_arg
+
+CODE = "GT006"
+TITLE = "metric name without geomesa_ prefix, or unbounded (interpolated) label value"
+
+_NAME_RE = re.compile(r"^geomesa_[a-z0-9_]+$")
+_REGISTRY_FNS = {"counter", "gauge", "histogram"}
+_LABELED_FNS = {"inc", "dec", "observe"}
+
+
+def check(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Attribute
+        ):
+            continue
+        attr = node.func.attr
+        recv = (receiver_name(node.func) or "").lower()
+        if attr in _REGISTRY_FNS and "registry" in recv:
+            name = str_arg(node)
+            if name is not None and not _NAME_RE.match(name):
+                yield ctx.finding(
+                    CODE,
+                    node,
+                    f"metric name {name!r} must match geomesa_[a-z0-9_]+ "
+                    "(shared-namespace prefix, lower_snake_case)",
+                )
+        if attr in _LABELED_FNS:
+            for kw in node.keywords:
+                if isinstance(kw.value, (ast.JoinedStr, ast.BinOp)):
+                    yield ctx.finding(
+                        CODE,
+                        kw.value,
+                        f"label {kw.arg!r} is built by interpolation -- "
+                        "every distinct value mints a new time series; "
+                        "label values must be bounded str enums/names",
+                    )

@@ -1,0 +1,60 @@
+"""GT007: an ``os.replace`` publish in ``store/`` must be preceded by a
+durable write.
+
+The crash-consistency contract (PR 3) is write-new -> fsync -> publish:
+an ``os.replace`` that flips a manifest/sidecar into place without the
+new content fsynced first can surface a published pointer to data the
+page cache never wrote back -- the exact torn state the generation
+machinery exists to prevent. Within the enclosing function, a durable
+write is a call whose name mentions ``fsync`` or one of the known
+durable helpers (``_write_file``, ``_write_part_file``, ``_fsync_dir``,
+``_publish_manifest``) appearing BEFORE the replace.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from geomesa_tpu.analysis.astutil import receiver_name, terminal_name
+
+CODE = "GT007"
+TITLE = "os.replace publish in store/ without a preceding fsync/durable write"
+
+_DURABLE_HELPERS = {
+    "_write_file",
+    "_write_part_file",
+    "_fsync_dir",
+    "_publish_manifest",
+}
+
+
+def _applies(rel: str) -> bool:
+    rel = rel.removeprefix("geomesa_tpu/")
+    return rel.startswith("store/")
+
+
+def check(ctx):
+    if not _applies(ctx.rel):
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        durable_lines: list = []
+        replaces: list = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func) or ""
+            if name in _DURABLE_HELPERS or "fsync" in name:
+                durable_lines.append(node.lineno)
+            elif name in ("replace", "rename") and receiver_name(node.func) == "os":
+                replaces.append(node)
+        for node in replaces:
+            if not any(line < node.lineno for line in durable_lines):
+                yield ctx.finding(
+                    CODE,
+                    node,
+                    "os.replace publish without a preceding durable write "
+                    "-- fsync the new content (e.g. via _write_file) "
+                    "before flipping it into place",
+                )

@@ -1,0 +1,42 @@
+"""GT008: system-property keys used via ``conf`` must be declared in
+the key registry (``conf._DEFS``).
+
+``sys_prop("io.worker")`` (typo) raises at runtime -- but only on the
+code path that reads it, possibly in production; and an env override
+``GEOMESA_TPU_IO_WORKER`` for an undeclared key is silently ignored.
+Declaring every key in one registry makes both failure modes
+impossible: the linter validates literals against the registry (parsed
+statically from conf.py), and conf warns once per process about unknown
+``GEOMESA_TPU_*`` environment variables.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from geomesa_tpu.analysis.astutil import str_arg, terminal_name
+
+CODE = "GT008"
+TITLE = "conf key literal not declared in the conf._DEFS key registry"
+
+_CONF_FNS = {"sys_prop", "set_prop", "clear_prop", "prop_override"}
+
+
+def check(ctx):
+    if not ctx.conf_keys:
+        return  # no registry found: nothing to validate against
+    if ctx.rel.rsplit("/", 1)[-1] == "conf.py":
+        return  # the registry itself
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if terminal_name(node.func) not in _CONF_FNS:
+            continue
+        key = str_arg(node)
+        if key is not None and key not in ctx.conf_keys:
+            yield ctx.finding(
+                CODE,
+                node,
+                f"system property {key!r} is not declared in conf._DEFS "
+                "-- declare it (default + parser + doc) or fix the key",
+            )

@@ -19,6 +19,8 @@ import threading
 import time
 from dataclasses import asdict, dataclass, field
 
+from geomesa_tpu.locking import checked_lock
+
 
 @dataclass
 class AuditedEvent:
@@ -30,6 +32,7 @@ class AuditedEvent:
     scanning_ms: float = 0.0
     hits: int = 0
     trace_id: str = ""  # cross-links the event to /debug/traces/<id>
+    # event timestamp persisted into the audit log (epoch by design)
     ts: float = field(default_factory=time.time)
 
     def to_json(self) -> str:
@@ -52,32 +55,37 @@ class AuditWriter:
         self._thread = threading.Thread(target=self._drain, daemon=True)
         self._started = False
         self._closed = False
-        self._lock = threading.Lock()
+        self._lock = checked_lock("audit.writer")
 
     def write(self, event: AuditedEvent) -> None:
         with self._lock:
-            if self._closed:
-                # post-close stragglers write synchronously: losing them
-                # silently would defeat close()'s whole purpose
-                try:
-                    self._write(event)
-                except Exception:
-                    pass
+            if not self._closed:
+                if not self._started:
+                    self._thread.start()
+                    self._started = True
+                    atexit.register(self.close)
+                # enqueue UNDER the lock: a put after close() drained the
+                # queue would be silently lost (the race close exists to
+                # fix)
+                self._q.put(event)
                 return
-            if not self._started:
-                self._thread.start()
-                self._started = True
-                atexit.register(self.close)
-            # enqueue UNDER the lock: a put after close() drained the
-            # queue would be silently lost (the race close exists to fix)
-            self._q.put(event)
+        # post-close stragglers write synchronously (losing them silently
+        # would defeat close()'s whole purpose) -- OUTSIDE the state lock:
+        # _write does file I/O, serialized by its own _flock. _closed
+        # never unsets, so the flag read above cannot go stale.
+        try:
+            self._write(event)
+        except Exception:
+            pass
 
     def flush(self, timeout: float = 5.0) -> None:
         if self._started:
             # unfinished_tasks (not empty()) -- the drain thread removes an
-            # event from the queue before _write completes
-            deadline = time.time() + timeout
-            while self._q.unfinished_tasks and time.time() < deadline:
+            # event from the queue before _write completes. Monotonic: a
+            # wall-clock step here would stretch (or cut short) close()'s
+            # drain bound.
+            deadline = time.monotonic() + timeout
+            while self._q.unfinished_tasks and time.monotonic() < deadline:
                 time.sleep(0.005)
 
     def close(self, timeout: float = 5.0) -> None:
@@ -125,12 +133,15 @@ class FileAuditWriter(AuditWriter):
     def __init__(self, path: str):
         super().__init__()
         self.path = path
-        self._flock = threading.Lock()
+        # serializes appends to the JSONL file; holding it across the
+        # write IS its purpose (one un-torn line per event)
+        self._flock = checked_lock("audit.file", blocking_ok=True)
 
     def _write(self, event: AuditedEvent) -> None:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        # lint: disable=GT002(append serialization is this lock's purpose)
         with self._flock, open(self.path, "a") as fh:
-            fh.write(event.to_json() + "\n")
+            fh.write(event.to_json() + "\n")  # lint: disable=GT002(same: ordered append under the append lock)
 
     def read_events(self) -> list:
         if not os.path.exists(self.path):

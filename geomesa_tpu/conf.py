@@ -99,17 +99,78 @@ _DEFS = {
     "trace.sample": (1.0, float),
     "trace.slow_ms": (500.0, float),
     "trace.device.dir": ("", str),
+    # device query scheduler defaults (sched/scheduler.py,
+    # SchedConfig.from_props): admission queue bound, worker/inflight
+    # cap, fusion window + width, default deadline (<= 0 = none) and the
+    # 429 Retry-After hint
+    "sched.max.queue": (128, int),
+    "sched.max.inflight": (2, int),
+    "sched.fusion.window.ms": (2.0, float),
+    "sched.max.fusion": (64, int),
+    "sched.default.deadline.ms": (30_000.0, float),
+    "sched.retry.after.s": (1.0, float),
 }
 
 _overrides: dict = {}
+
+
+def declared_keys() -> "frozenset[str]":
+    """Every declared system-property key -- the GT008 key registry
+    (analysis/rules/gt008_conf_keys.py validates string literals used
+    via this module against it)."""
+    return frozenset(_DEFS)
 
 
 def _env_key(name: str) -> str:
     return "GEOMESA_TPU_" + name.upper().replace(".", "_")
 
 
+#: GEOMESA_TPU_* environment variables that are NOT system-property
+#: overrides (other subsystems' switches) -- exempt from the
+#: unknown-key warning below
+_NON_PROP_ENV = frozenset(
+    {
+        "GEOMESA_TPU_ROOT",  # tools/cli.py default store root
+        "GEOMESA_TPU_FAILPOINTS",  # failpoints.py activation list
+        "GEOMESA_TPU_LOCKCHECK",  # analysis/lockcheck.py switch
+        "GEOMESA_TPU_NO_NATIVE",  # native.py opt-out
+        "GEOMESA_TPU_COMPILE_CACHE",  # jaxconf.py cache dir override
+    }
+)
+
+_env_checked = False
+
+
+def _warn_unknown_env() -> None:
+    """One warning per process for each ``GEOMESA_TPU_*`` environment
+    variable that maps to no declared key: an override for a key that
+    does not exist (typo'd ``GEOMESA_TPU_IO_WORKER``) would otherwise be
+    silently ignored -- the quiet twin of the GT008 lint rule."""
+    global _env_checked
+    if _env_checked:
+        return
+    _env_checked = True
+    known = {_env_key(n) for n in _DEFS}
+    unknown = [
+        k
+        for k in sorted(os.environ)
+        if k.startswith("GEOMESA_TPU_")
+        and k not in known
+        and k not in _NON_PROP_ENV
+    ]
+    if unknown:
+        import logging
+
+        for k in unknown:
+            logging.getLogger(__name__).warning(
+                "environment variable %s matches no declared system "
+                "property (see conf._DEFS) and is ignored", k,
+            )
+
+
 def sys_prop(name: str):
     """Resolve a property: programmatic override > env > default."""
+    _warn_unknown_env()
     if name not in _DEFS:
         raise KeyError(f"unknown system property {name!r}")
     default, parse = _DEFS[name]

@@ -2269,7 +2269,7 @@ class StreamingDeviceIndex(DeviceIndex):
         compact_threshold: float = 0.5,
         z_planes: bool = False,
     ):
-        import threading
+        from geomesa_tpu.locking import checked_rlock
 
         self._capacity_hint = capacity
         self.compact_threshold = compact_threshold
@@ -2280,8 +2280,10 @@ class StreamingDeviceIndex(DeviceIndex):
         # live-store listeners run OUTSIDE the store's lock (stream/live.py
         # invokes callbacks unlocked, possibly from several producer
         # threads), and the delta paths are order-sensitive stateful
-        # mutations of donated buffers -- serialize every mutation and scan
-        self._lock = threading.RLock()
+        # mutations of donated buffers -- serialize every mutation and scan.
+        # blocking_ok: refresh/scan hold it across store reads + device
+        # staging by design (that serialization is the lock's purpose)
+        self._lock = checked_rlock("device_cache.delta", blocking_ok=True)
         super().__init__(store, type_name, columns, z_planes=z_planes)
 
     # -- cache lifecycle ---------------------------------------------------

@@ -38,8 +38,9 @@ from __future__ import annotations
 
 import os
 import signal
-import threading
 from contextlib import contextmanager
+
+from geomesa_tpu.locking import checked_lock
 
 __all__ = [
     "FailpointError",
@@ -69,7 +70,7 @@ class FailpointError(OSError):
     read failures ride the same retry handler as real I/O errors."""
 
 
-_lock = threading.Lock()
+_lock = checked_lock("failpoints")
 _overrides: "dict[str, str]" = {}
 _counts: "dict[str, int]" = {}
 # (raw env string, parsed) -- re-parsed only when the env value changes,

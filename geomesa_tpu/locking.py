@@ -17,12 +17,47 @@ from __future__ import annotations
 
 import fcntl
 import os
+import threading
 import time
 from contextlib import contextmanager
 
 
 class LockTimeout(TimeoutError):
     pass
+
+
+def checked_lock(name: str, *, blocking_ok: bool = False):
+    """The project's IN-PROCESS mutex factory: a plain ``threading.Lock``
+    in production, a lock-order-checked wrapper when the
+    ``GEOMESA_TPU_LOCKCHECK`` environment variable is set (see
+    analysis/lockcheck.py -- ABBA cycle detection + held-across-blocking
+    events; the test suite runs entirely under it). Every lock in the
+    package is built here (lint rule GT001 enforces it); ``name`` is the
+    node in the acquisition graph, so per-instance locks sharing a name
+    collapse into one bounded node.
+
+    ``blocking_ok=True`` declares that holding this lock across blocking
+    calls is the lock's PURPOSE (append-log ordering, first-touch device
+    staging) and exempts it from held-across-blocking events -- pair it
+    with the reasoned ``# lint: disable=GT002(...)`` at the blocking
+    site so both checkers tell the same story."""
+    from geomesa_tpu.analysis import lockcheck
+
+    if not lockcheck.enabled():
+        return threading.Lock()
+    lockcheck.install_probes()
+    return lockcheck.CheckedLock(name, blocking_ok=blocking_ok)
+
+
+def checked_rlock(name: str, *, blocking_ok: bool = False):
+    """Re-entrant flavor of :func:`checked_lock` (``threading.RLock``
+    drop-in; re-acquisitions by the holder record no self-edges)."""
+    from geomesa_tpu.analysis import lockcheck
+
+    if not lockcheck.enabled():
+        return threading.RLock()
+    lockcheck.install_probes()
+    return lockcheck.CheckedLock(name, reentrant=True, blocking_ok=blocking_ok)
 
 
 @contextmanager

@@ -10,9 +10,10 @@ can serve ``prometheus_text()`` from any HTTP endpoint.
 
 from __future__ import annotations
 
-import threading
 import time
 from bisect import bisect_left
+
+from geomesa_tpu.locking import checked_lock
 
 
 class _Metric:
@@ -21,7 +22,7 @@ class _Metric:
         self.help = help_
         self.kind = kind
         self._values: dict = {}
-        self._lock = threading.Lock()
+        self._lock = checked_lock(f"metrics.{name}")
 
     def labels(self, **labels) -> tuple:
         return tuple(sorted(labels.items()))
@@ -110,7 +111,7 @@ class _Timer:
 class MetricsRegistry:
     def __init__(self):
         self._metrics: dict = {}
-        self._lock = threading.Lock()
+        self._lock = checked_lock("metrics.registry")
 
     def counter(self, name: str, help_: str = "") -> Counter:
         return self._get(name, lambda: Counter(name, help_), Counter)
@@ -311,4 +312,23 @@ traces_captured = REGISTRY.counter(
 slow_queries = REGISTRY.counter(
     "geomesa_slow_queries_total",
     "requests slower than trace.slow_ms (always-captured + slow-logged)",
+)
+
+# runtime lock-order checker (analysis/lockcheck.py): the acquisition
+# graph's size and its findings -- nonzero cycles or blocking events in
+# a checked process is a concurrency regression (gauges, set whenever
+# LockCheck.report() runs; zero and flat is the healthy shape)
+lockcheck_locks = REGISTRY.gauge(
+    "geomesa_lockcheck_locks", "checked locks registered this process"
+)
+lockcheck_edges = REGISTRY.gauge(
+    "geomesa_lockcheck_edges", "distinct lock acquisition-order edges"
+)
+lockcheck_cycles = REGISTRY.gauge(
+    "geomesa_lockcheck_cycles",
+    "lock-order cycles detected (ABBA deadlock potentials)",
+)
+lockcheck_blocking = REGISTRY.gauge(
+    "geomesa_lockcheck_blocking_events",
+    "blocking calls observed under a held (non-blocking_ok) lock",
 )

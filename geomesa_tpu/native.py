@@ -17,14 +17,17 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 
 import numpy as np
+
+from geomesa_tpu.locking import checked_lock
 
 _LIB_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
 _LIB_PATH = os.path.join(_LIB_DIR, "build", "libgeomesa_tpu.so")
 
-_lock = threading.Lock()
+# one-time load/build serialization: holding across the (blocking)
+# compile + dlopen is the point -- a second caller must wait, not race
+_lock = checked_lock("native.load", blocking_ok=True)
 _lib = None
 _tried = False
 

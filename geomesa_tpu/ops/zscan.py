@@ -239,16 +239,20 @@ def xz3_query_bounds(
     per_bin: list = []
     ids: list = []
     whole_cache = None
+    # the spatial box is bin-invariant: build its arrays once, outside
+    # the per-bin loop (only the time offsets vary per bin)
+    ax, ay = np.array([xmin]), np.array([ymin])
+    bx, by = np.array([xmax]), np.array([ymax])
     for b, lo_off, hi_off in bins_for_interval(tmin_ms, tmax_ms, sfc.period):
         whole = lo_off == 0 and hi_off == mx
         if whole and whole_cache is not None:
             rs = whole_cache
         else:
             rs = sfc.ranges(
-                np.array([xmin]), np.array([ymin]),
-                np.array([float(lo_off)]),
-                np.array([xmax]), np.array([ymax]),
-                np.array([float(hi_off)]),
+                ax, ay,
+                np.array([float(lo_off)]),  # lint: disable=GT004(host-side scalar range planning; no device arrays in this loop)
+                bx, by,
+                np.array([float(hi_off)]),  # lint: disable=GT004(host-side scalar range planning; no device arrays in this loop)
                 max_ranges=max_ranges,
             )
             if whole:

@@ -14,11 +14,12 @@ section 5 maps these to ``jax.profiler`` traces plus host-side timers.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
 from dataclasses import dataclass, field
+
+from geomesa_tpu.locking import checked_lock
 
 
 @dataclass
@@ -36,7 +37,9 @@ class _Timer:
 @dataclass
 class _Registry:
     timers: dict = field(default_factory=lambda: defaultdict(_Timer))
-    lock: threading.Lock = field(default_factory=threading.Lock)
+    lock: object = field(
+        default_factory=lambda: checked_lock("profiling.registry")
+    )
 
 
 _REG = _Registry()

@@ -80,6 +80,25 @@ class SchedConfig:
     default_deadline_ms: "float | None" = 30_000.0
     retry_after_s: float = 1.0
 
+    @staticmethod
+    def from_props() -> "SchedConfig":
+        """Defaults from the ``sched.*`` system properties (conf.py key
+        registry) -- what ``QueryScheduler()`` with no explicit config
+        uses, so a deployment can tune admission/fusion via environment
+        (``GEOMESA_TPU_SCHED_MAX_QUEUE=...``) without code changes. A
+        non-positive ``sched.default.deadline.ms`` means no deadline."""
+        from geomesa_tpu.conf import sys_prop
+
+        deadline = float(sys_prop("sched.default.deadline.ms"))
+        return SchedConfig(
+            max_queue=int(sys_prop("sched.max.queue")),
+            max_inflight=int(sys_prop("sched.max.inflight")),
+            fusion_window_ms=float(sys_prop("sched.fusion.window.ms")),
+            max_fusion=int(sys_prop("sched.max.fusion")),
+            default_deadline_ms=deadline if deadline > 0 else None,
+            retry_after_s=float(sys_prop("sched.retry.after.s")),
+        )
+
 
 _USE_DEFAULT = object()  # submit(): "no deadline_ms given, apply config"
 
@@ -121,11 +140,12 @@ class QueryScheduler:
     """
 
     def __init__(self, config: "SchedConfig | None" = None):
-        self.config = config or SchedConfig()
+        self.config = config or SchedConfig.from_props()
         self._cv = threading.Condition()
         # lane -> tenant -> deque of queued requests (RR over tenants)
         self._queues: dict = {lane: OrderedDict() for lane in _LANES}
         self._queued = 0
+        self._running = 0  # claimed but not yet finished (close() drains)
         self._stop = False
         # counters for snapshot(); the process-global metrics mirror them
         self.queries = 0
@@ -220,6 +240,7 @@ class QueryScheduler:
                     self.expired += 1
                     self._observe_expired()
                     req.event.set()
+                    self._cv.notify_all()  # close() waits on drain
         req.event.wait()
         if req.error is not None:
             raise req.error
@@ -266,6 +287,7 @@ class QueryScheduler:
                 if req is not None:
                     req.state = "running"
                     self._queued -= 1
+                    self._running += 1
                     metrics.sched_queue_depth.set(self._queued)
                     return req
         return None
@@ -301,6 +323,7 @@ class QueryScheduler:
                     del tenants[tenant]
         if got:
             self._queued -= len(got)
+            self._running += len(got)
             metrics.sched_queue_depth.set(self._queued)
         return got
 
@@ -342,7 +365,14 @@ class QueryScheduler:
                                 req.fuse.key, cfg.max_fusion - len(group)
                             )
                         group += more
-            self._execute(group)
+            try:
+                self._execute(group)
+            finally:
+                # the whole group was claimed (queued -> running) above;
+                # retire it and wake close(), which drains on this count
+                with self._cv:
+                    self._running -= len(group)
+                    self._cv.notify_all()
 
     def _execute(self, group: "list[_Request]") -> None:
         from geomesa_tpu import metrics, tracing
@@ -447,6 +477,7 @@ class QueryScheduler:
             queries, launches = self.queries, self.launches
             return {
                 "queue_depth": self._queued,
+                "running": self._running,
                 "max_queue": self.config.max_queue,
                 "inflight_cap": self.config.max_inflight,
                 "fusion_window_ms": self.config.fusion_window_ms,
@@ -465,6 +496,22 @@ class QueryScheduler:
                     else None
                 ),
             }
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain-then-stop: wait (bounded, monotonic) for every queued
+        AND in-flight request to finish, then stop and JOIN the workers.
+        The graceful sibling of :meth:`shutdown` -- a CLI or test
+        process must not exit mid-device-launch with work half-executed;
+        ``make_server``'s shutdown calls this. Idempotent; requests
+        still unfinished at the timeout are failed by the shutdown."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while (self._queued or self._running) and not self._stop:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    break
+                self._cv.wait(timeout=min(rem, 0.25))
+        self.shutdown(timeout=max(deadline - time.monotonic(), 0.1))
 
     def shutdown(self, timeout: float = 5.0) -> None:
         """Stop the workers; queued requests complete with errors."""

@@ -74,6 +74,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, unquote, urlparse
 
 
+class _GeomesaHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose ``shutdown`` also DRAINS the query
+    scheduler (``QueryScheduler.close``): stopping the accept loop but
+    leaving scheduler workers mid-device-launch lets a CLI/test process
+    exit with work half-executed -- the drain is bounded and joins the
+    worker threads."""
+
+    scheduler = None
+
+    def shutdown(self):
+        super().shutdown()
+        if self.scheduler is not None:
+            self.scheduler.close(timeout=5.0)
+
+
 class _Handler(BaseHTTPRequestHandler):
     store = None  # injected by make_server
     resident = False  # serve from device-pinned DeviceIndex caches
@@ -669,9 +684,14 @@ def make_server(
     if sched:
         from geomesa_tpu.sched import QueryScheduler, SchedConfig
 
+        # sched=True (no explicit config) defers to QueryScheduler's
+        # default -- SchedConfig.from_props(), so the sched.* conf keys
+        # / GEOMESA_TPU_SCHED_* env overrides actually apply here
         scheduler = QueryScheduler(
-            sched if isinstance(sched, SchedConfig) else SchedConfig()
+            sched if isinstance(sched, SchedConfig) else None
         )
+    from geomesa_tpu.locking import checked_lock
+
     handler = type(
         "BoundHandler",
         (_Handler,),
@@ -680,7 +700,12 @@ def make_server(
             "resident": resident,
             "scheduler": scheduler,
             "_resident_cache": {},
-            "_resident_lock": threading.Lock(),
+            # blocking_ok: first-touch resident builds hold it across
+            # store reads + device staging BY DESIGN (a duplicate build
+            # would stage the dataset into device memory twice)
+            "_resident_lock": checked_lock(
+                "server.resident", blocking_ok=True
+            ),
         },
     )
     if resident and warm:
@@ -699,7 +724,7 @@ def make_server(
                 warnings.warn(f"warm staging failed for {tn!r}: {e!r}")
                 continue
             handler._resident_cache[tn] = di
-    server = ThreadingHTTPServer((host, port), handler)
+    server = _GeomesaHTTPServer((host, port), handler)
     server.scheduler = scheduler  # callers may inspect / shut down
     return server
 

@@ -290,6 +290,8 @@ class FileSystemDataStore:
             raise ValueError(f"unsupported encoding {encoding!r}")
         import threading
 
+        from geomesa_tpu.locking import checked_rlock
+
         self.root = root
         self.partition_size = partition_size
         self.mesh = mesh
@@ -305,8 +307,11 @@ class FileSystemDataStore:
         self._lock_tl = threading.local()
         # flock serializes PROCESSES; this RLock serializes THREADS of
         # this process (a ThreadingHTTPServer shares one store object,
-        # and _refresh_from_disk mutates shared state in place)
-        self._mem_lock = threading.RLock()
+        # and _refresh_from_disk mutates shared state in place).
+        # blocking_ok: maintenance holds it across partition file I/O BY
+        # DESIGN (the scan-consistency window); the lock-free worker
+        # reads of PR 2 exist precisely because of that.
+        self._mem_lock = checked_rlock("store.fs.mem", blocking_ok=True)
         self.audit_writer = None
         #: what the open-time recovery sweep reclaimed, per type — folded
         #: into the next explicit recover() so fsck reports the crash

@@ -191,7 +191,7 @@ class StreamedDeviceScan:
         self.sft = store.get_schema(type_name)
         #: target rows per slab; partitions group into slabs up to this
         self.slab_rows = slab_rows or (1 << 22)
-        import threading
+        from geomesa_tpu.locking import checked_lock
 
         #: host-I/O pipeline: PrefetchConfig, an int worker count, or
         #: None (= the ``io.*`` system properties, resolved per scan)
@@ -200,7 +200,7 @@ class StreamedDeviceScan:
         # the LRU's get+move_to_end / insert+evict must be atomic: server
         # threads share one scan object, and a move_to_end racing an
         # eviction raises KeyError on an OrderedDict
-        self._streams_lock = threading.Lock()
+        self._streams_lock = checked_lock("oocscan.streams")
 
     # -- internals ---------------------------------------------------------
 

@@ -49,9 +49,10 @@ chunk counter) ride :mod:`geomesa_tpu.metrics`.
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from dataclasses import dataclass
+
+from geomesa_tpu.locking import checked_lock
 
 __all__ = ["PrefetchConfig", "prefetch_map", "batch_nbytes"]
 
@@ -178,7 +179,7 @@ def _prefetch_threads(fn, items, cfg: PrefetchConfig, size_of):
     it = iter(items)
     depth = cfg.effective_depth
     budget = cfg.byte_budget
-    lock = threading.Lock()
+    lock = checked_lock("prefetch.queued")
     queued = {"bytes": 0}  # completed-but-unconsumed result bytes
     # span context crosses the pool EXPLICITLY: contextvars are
     # per-thread, so without this capture/attach pair the workers' read/

@@ -26,7 +26,7 @@ class LambdaDataStore:
         persistent,
         type_name: str,
         persist_after_ms: int = 60_000,
-        clock: Callable = lambda: int(_time.time() * 1000),
+        clock: Callable = lambda: int(_time.time() * 1000),  # lint: disable=GT003(epoch ms is the persisted feature-age contract; live + persist tiers share this clock)
     ):
         self.persistent = persistent
         self.type_name = type_name

@@ -34,17 +34,19 @@ class LiveFeatureStore:
         sft: SimpleFeatureType,
         log: "FeatureLog | None" = None,
         expiry_ms: "int | None" = None,
-        clock: Callable = lambda: int(_time.time() * 1000),
+        clock: Callable = lambda: int(_time.time() * 1000),  # lint: disable=GT003(epoch ms is the feature-timestamp contract; expiry compares stamps from this same clock)
         standalone: bool = False,
     ):
         import threading
+
+        from geomesa_tpu.locking import checked_rlock
 
         self.sft = sft
         # explicit None check: an empty FeatureLog is falsy (__len__ == 0)
         self.log = log if log is not None else (None if standalone else FeatureLog())
         self.expiry_ms = expiry_ms
         self.clock = clock
-        self._lock = threading.RLock()
+        self._lock = checked_rlock("stream.live")
         self._batch = FeatureBatch.from_columns(
             sft, {a.name: [] for a in sft.attributes}, fids=np.array([], dtype=object)
         )

@@ -30,6 +30,8 @@ Runner/IngestCommand/ExportCommand/ExplainCommand/StatsCommand):
     geomesa-tpu load-driver    --root DIR -f NAME [-q CQL] [--threads M]
                                [--requests N] [--loose] (concurrent-serving
                                load: throughput, p50/p99, fusion factor)
+    geomesa-tpu lint           [PATHS...] [--rules] (invariant linter
+                               GT001-GT008; exit 0 clean / 1 findings)
     geomesa-tpu env | version
 
 The store root is a FileSystemDataStore directory (Parquet partitions +
@@ -557,16 +559,27 @@ def _apply_io_flags(args):
 
 
 def _sched_config(args):
-    """SchedConfig from the --sched* flags, or None when --sched is off."""
+    """SchedConfig from the --sched* flags, or None when --sched is off.
+    Unset flags fall back to the ``sched.*`` conf keys
+    (SchedConfig.from_props) so CLI, conf and GEOMESA_TPU_SCHED_* env
+    overrides share ONE set of defaults; an explicit flag wins."""
     if not getattr(args, "sched", False):
         return None
+    import dataclasses
+
     from geomesa_tpu.sched import SchedConfig
 
-    return SchedConfig(
-        max_queue=args.sched_queue,
-        max_inflight=args.sched_workers,
-        fusion_window_ms=args.sched_fusion_ms,
-    )
+    cfg = SchedConfig.from_props()
+    explicit = {
+        k: v
+        for k, v in (
+            ("max_queue", args.sched_queue),
+            ("max_inflight", args.sched_workers),
+            ("fusion_window_ms", args.sched_fusion_ms),
+        )
+        if v is not None
+    }
+    return dataclasses.replace(cfg, **explicit) if explicit else cfg
 
 
 def _add_sched_flags(sp):
@@ -576,12 +589,16 @@ def _add_sched_flags(sp):
         "(bounded admission -> 429 on overload, deadlines, priority "
         "lanes, micro-batch scan fusion; see /stats/sched)",
     )
-    sp.add_argument("--sched-queue", type=int, default=128,
-                    help="admission queue bound (backpressure point)")
-    sp.add_argument("--sched-workers", type=int, default=2,
-                    help="in-flight concurrency cap (worker threads)")
-    sp.add_argument("--sched-fusion-ms", type=float, default=2.0,
-                    help="micro-batch fusion window in milliseconds")
+    # defaults None = the sched.* conf keys (see _sched_config)
+    sp.add_argument("--sched-queue", type=int, default=None,
+                    help="admission queue bound (backpressure point; "
+                    "default: the sched.max.queue conf key)")
+    sp.add_argument("--sched-workers", type=int, default=None,
+                    help="in-flight concurrency cap (worker threads; "
+                    "default: the sched.max.inflight conf key)")
+    sp.add_argument("--sched-fusion-ms", type=float, default=None,
+                    help="micro-batch fusion window in milliseconds "
+                    "(default: the sched.fusion.window.ms conf key)")
 
 
 def cmd_serve(args):
@@ -618,17 +635,12 @@ def cmd_load_driver(args):
 
     url, server = args.url, None
     if url is None:
-        from geomesa_tpu.sched import SchedConfig
         from geomesa_tpu.server import serve_background
 
         store = _store(args)
+        args.sched = True  # self-serve always schedules
         server, _ = serve_background(
-            store, resident=args.resident,
-            sched=SchedConfig(  # self-serve always schedules
-                max_queue=args.sched_queue,
-                max_inflight=args.sched_workers,
-                fusion_window_ms=args.sched_fusion_ms,
-            ),
+            store, resident=args.resident, sched=_sched_config(args),
         )
         host, port = server.server_address[:2]
         url = f"http://{host}:{port}"
@@ -647,9 +659,11 @@ def cmd_load_driver(args):
     except urllib.error.HTTPError as e:
         sys.exit(f"error: warmup request failed with HTTP {e.code} "
                  f"({e.read().decode(errors='replace')[:200]})")
+    from geomesa_tpu.locking import checked_lock
+
     lats: list = []
     shed = [0, 0]  # 429s, other errors
-    lock = threading.Lock()
+    lock = checked_lock("cli.load_driver")
 
     def worker():
         for _ in range(args.requests):
@@ -697,8 +711,27 @@ def cmd_load_driver(args):
         pass  # no scheduler on the target: latency numbers still stand
     print(json.dumps(rep, indent=2))
     if server is not None:
+        # shutdown drains + joins the scheduler too (make_server wiring)
         server.shutdown()
-        server.scheduler.shutdown(timeout=2.0)
+
+
+def cmd_lint(args):
+    """Project invariant linter (analysis/lint.py): the GT001-GT008
+    rules over the package tree (or explicit paths). Exit 0 clean, 1 on
+    findings, 2 on an unreadable input -- CI gates on it, and the
+    package-self-lint test keeps tier-1 honest between CI runs."""
+    from geomesa_tpu.analysis.lint import main as lint_main
+    from geomesa_tpu.analysis.rules import RULE_TABLE
+
+    if args.rules:
+        for code, title in RULE_TABLE:
+            print(f"{code}  {title}")
+        return
+    rc = lint_main(args.paths or None)
+    if rc == 0 and not args.quiet:
+        print("clean")
+    if rc:
+        sys.exit(rc)
 
 
 def cmd_trace(args):
@@ -917,6 +950,15 @@ def main(argv=None) -> None:
     )
     _add_sched_flags(sp)
     _add_io_flags(sp)
+
+    sp = add("lint", cmd_lint)
+    sp.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                    "installed geomesa_tpu package)")
+    sp.add_argument("--rules", action="store_true",
+                    help="print the GT001-GT008 rule table and exit")
+    sp.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the 'clean' line on success")
 
     sp = add("trace", cmd_trace)
     sp.add_argument("--url", required=True,

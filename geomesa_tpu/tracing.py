@@ -51,6 +51,8 @@ import uuid
 from collections import OrderedDict
 from contextlib import contextmanager
 
+from geomesa_tpu.locking import checked_lock
+
 __all__ = [
     "Span",
     "Trace",
@@ -158,11 +160,13 @@ class Trace:
         self.sampled = sampled
         self.slow_ms = slow_ms
         self.recording = recording
-        self.t0_epoch = time.time()
+        # epoch anchor for summaries + Perfetto timestamps (wall-clock by
+        # design; every duration below uses perf_counter)
+        self.t0_epoch = time.time()  # lint: disable=GT003(epoch anchor for trace export; durations use perf_counter)
         self.t0 = time.perf_counter()
         self.dur_s: "float | None" = None
         self.slow = False
-        self.lock = threading.Lock()
+        self.lock = checked_lock("tracing.trace")
         self.root = (
             Span(name, self, 0.0, None) if recording else _NOOP
         )
@@ -263,12 +267,14 @@ class Tracer:
 
     def __init__(self, capacity: int = 256):
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._lock = checked_lock("tracing.ring")
         self._ring: OrderedDict = OrderedDict()  # trace_id -> Trace
         #: slow-query JSONL path; None = no slow log (set by make_server
         #: next to the store's audit log)
         self.slow_log_path: "str | None" = None
-        self._log_lock = threading.Lock()
+        # serializes slow-log appends; holding across the write is the
+        # lock's whole purpose (one JSONL line per trace, never torn)
+        self._log_lock = checked_lock("tracing.slowlog", blocking_ok=True)
 
     @contextmanager
     def trace(self, name: str, trace_id=None, attrs=None):
@@ -328,8 +334,9 @@ class Tracer:
                 d = os.path.dirname(self.slow_log_path)
                 if d:
                     os.makedirs(d, exist_ok=True)
+                # lint: disable=GT002(appending under the lock is its purpose: one un-torn JSONL line per slow trace)
                 with open(self.slow_log_path, "a") as fh:
-                    fh.write(line + "\n")
+                    fh.write(line + "\n")  # lint: disable=GT002(same un-torn append under the slow-log lock)
         except Exception:  # pragma: no cover - the log must not break serving
             pass
 

@@ -2,7 +2,19 @@
 
 Must run before any jax import (SURVEY.md section 4 rebuild test plan:
 multi-chip tests via host-platform device-count simulation).
+
+The runtime lock-order checker (analysis/lockcheck.py) is switched on
+for the WHOLE suite: the env var must be set before any geomesa_tpu
+module import so module-level locks (metrics, failpoints, native) are
+built instrumented. Subprocesses spawned by the chaos suite inherit it.
+The session-end hook prints the acquisition-graph summary;
+tests/test_lockcheck.py asserts the zero-findings invariant and the
+seeded detections.
 """
+
+import os
+
+os.environ.setdefault("GEOMESA_TPU_LOCKCHECK", "1")
 
 from geomesa_tpu.jaxconf import force_cpu_devices
 
@@ -21,3 +33,36 @@ require_x64()
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_terminal_summary(terminalreporter):
+    """One line of lock-order-checker state at session end; any global
+    finding is spelled out (and fails the session, see below).
+    tests/test_lockcheck.py additionally asserts the invariant mid-run."""
+    from geomesa_tpu.analysis.lockcheck import CHECKER, enabled
+
+    if not enabled():
+        return
+    rep = CHECKER.report()
+    terminalreporter.write_line(
+        f"lockcheck: {len(rep['locks'])} locks, {len(rep['edges'])} order "
+        f"edges, {len(rep['cycles'])} cycles, {len(rep['blocking'])} "
+        "held-across-blocking events"
+    )
+    for c in rep["cycles"]:
+        terminalreporter.write_line(f"lockcheck CYCLE: {c}")
+    for b in rep["blocking"]:
+        terminalreporter.write_line(f"lockcheck BLOCKING: {b}")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The enforcement half: a lock-order cycle or a held-across-
+    blocking event ANYWHERE in the session (including suites that ran
+    after test_lockcheck's in-run assertion) fails the run."""
+    from geomesa_tpu.analysis.lockcheck import CHECKER, enabled
+
+    if not enabled():
+        return
+    rep = CHECKER.report()
+    if (rep["cycles"] or rep["blocking"]) and session.exitstatus == 0:
+        session.exitstatus = 1

@@ -1,0 +1,198 @@
+"""Invariant linter (analysis/lint.py): package self-lint, one seeded
+fixture violation per rule GT001-GT008, the disable-comment escape
+hatch, and the CLI exit codes."""
+
+import os
+
+import pytest
+
+from geomesa_tpu.analysis.lint import (
+    format_findings,
+    lint_package,
+    lint_paths,
+    main as lint_main,
+)
+
+# one seeded violation per rule: (rule, relative path, source)
+FIXTURES = {
+    "GT001": (
+        "locks.py",
+        "import threading\n"
+        "lock = threading.Lock()\n",
+    ),
+    "GT002": (
+        "blocking.py",
+        "import threading\n"
+        "lock = threading.Lock()\n"
+        "def f(path):\n"
+        "    with lock:\n"
+        "        open(path)\n",
+    ),
+    "GT003": (
+        "clocks.py",
+        "import time\n"
+        "def f(timeout):\n"
+        "    return time.time() + timeout\n",
+    ),
+    "GT004": (
+        "ops/loopy.py",
+        "import numpy as np\n"
+        "def f(chunks):\n"
+        "    out = []\n"
+        "    for c in chunks:\n"
+        "        out.append(np.asarray(c))\n"
+        "    return out\n",
+    ),
+    "GT005": (
+        "points.py",
+        "from geomesa_tpu.failpoints import fail_point\n"
+        "def f():\n"
+        "    fail_point('fail.not.registered')\n",
+    ),
+    "GT006": (
+        "badmetric.py",
+        "from geomesa_tpu.metrics import REGISTRY\n"
+        "c = REGISTRY.counter('queries_total')\n",
+    ),
+    "GT007": (
+        "store/publish.py",
+        "import os\n"
+        "def publish(tmp, path):\n"
+        "    os.replace(tmp, path)\n",
+    ),
+    "GT008": (
+        "keys.py",
+        "from geomesa_tpu.conf import sys_prop\n"
+        "def f():\n"
+        "    return sys_prop('no.such.key')\n",
+    ),
+}
+
+
+def _write_tree(root, fixtures):
+    for rule, (rel, src) in fixtures.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+
+
+@pytest.mark.lint
+def test_package_self_lint_is_clean():
+    """The GT001-GT008 rules over the geomesa_tpu tree itself: every
+    baseline violation is fixed or carries a reasoned disable comment.
+    Rides tier-1 so a regression fails the next test run, not the next
+    CI run."""
+    findings = lint_package()
+    assert findings == [], "\n" + format_findings(findings)
+
+
+@pytest.mark.lint
+def test_fixture_tree_seeds_every_rule(tmp_path):
+    _write_tree(tmp_path, FIXTURES)
+    findings = lint_paths([str(tmp_path)])
+    found = {f.rule for f in findings}
+    assert found >= set(FIXTURES), (
+        f"missing rules: {set(FIXTURES) - found}\n" + format_findings(findings)
+    )
+    # each seeded file is flagged by the rule it seeds
+    for rule, (rel, _) in FIXTURES.items():
+        assert any(
+            f.rule == rule and f.path.endswith(rel.replace("/", os.sep))
+            for f in findings
+        ), f"{rule} did not fire on {rel}"
+
+
+@pytest.mark.lint
+def test_disable_comment_with_reason_suppresses(tmp_path):
+    (tmp_path / "ok.py").write_text(
+        "import time\n"
+        "t = time.time()  # lint: disable=GT003(epoch timestamp for the log record)\n"
+    )
+    assert lint_paths([str(tmp_path)]) == []
+
+
+@pytest.mark.lint
+def test_disable_comment_previous_line_suppresses(tmp_path):
+    (tmp_path / "ok.py").write_text(
+        "import time\n"
+        "# lint: disable=GT003(epoch timestamp for the log record)\n"
+        "t = time.time()\n"
+    )
+    assert lint_paths([str(tmp_path)]) == []
+
+
+@pytest.mark.lint
+def test_multi_code_disable_with_reason_suppresses(tmp_path):
+    """Regression: the bare-disable detector must not backtrack into a
+    reasoned multi-code directive and report its first code as
+    reason-less."""
+    (tmp_path / "ok.py").write_text(
+        "import time\n"
+        "t = time.time()  # lint: disable=GT003,GT008(epoch by design)\n"
+    )
+    assert lint_paths([str(tmp_path)]) == []
+    (tmp_path / "bad.py").write_text(
+        "import time\n"
+        "t = time.time()  # lint: disable=GT003,GT008\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    # the unsuppressed violation + one reason-less report per code
+    assert len(findings) == 3
+    assert sum("without a reason" in f.message for f in findings) == 2
+
+
+@pytest.mark.lint
+def test_disable_comment_without_reason_does_not_suppress(tmp_path):
+    (tmp_path / "bad.py").write_text(
+        "import time\n"
+        "t = time.time()  # lint: disable=GT003\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert {f.rule for f in findings} == {"GT003"}
+    # both the un-suppressed finding and the reason-less directive report
+    assert len(findings) == 2
+    assert any("without a reason" in f.message for f in findings)
+
+
+@pytest.mark.lint
+def test_lint_main_exit_codes(tmp_path):
+    _write_tree(tmp_path, FIXTURES)
+    lines: list = []
+    assert lint_main([str(tmp_path)], out=lines.append) == 1
+    assert any("finding(s)" in ln for ln in lines)
+    clean = tmp_path / "cleantree"
+    clean.mkdir()
+    (clean / "fine.py").write_text("x = 1\n")
+    assert lint_main([str(clean)], out=lines.append) == 0
+    assert lint_main([str(tmp_path / "nope.py")], out=lines.append) == 2
+
+
+@pytest.mark.lint
+def test_cli_lint_nonzero_on_fixture_tree(tmp_path, capsys):
+    from geomesa_tpu.tools.cli import main as cli_main
+
+    _write_tree(tmp_path, FIXTURES)
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["lint", str(tmp_path)])
+    assert exc.value.code == 1
+    out = capsys.readouterr().out
+    for rule in FIXTURES:
+        assert rule in out
+
+
+@pytest.mark.lint
+def test_cli_lint_clean_repo_exits_zero(capsys):
+    from geomesa_tpu.tools.cli import main as cli_main
+
+    cli_main(["lint"])  # no SystemExit -> exit code 0
+    assert "clean" in capsys.readouterr().out
+
+
+@pytest.mark.lint
+def test_rule_table_lists_all_rules(capsys):
+    from geomesa_tpu.tools.cli import main as cli_main
+
+    cli_main(["lint", "--rules"])
+    out = capsys.readouterr().out
+    for code in FIXTURES:
+        assert code in out

@@ -34,6 +34,21 @@ Properties:
                                 manifests on flush (crash durability;
                                 ``off`` trades it for speed, e.g. tmpfs
                                 or throwaway benchmark stores)
+- ``store.format.version``      partition manifest format written by
+                                flushes: 2 = chunked columnar with
+                                per-chunk statistics (the default),
+                                1 = legacy (no chunk stats; what
+                                pre-chunk stores read as)
+- ``store.chunk.rows``          rows per chunk in v2 partition files
+                                (parquet row groups align 1:1)
+- ``store.chunk.grid``          coarse density-histogram grid edge
+                                (grid x grid world cells per chunk)
+- ``store.chunk.prune``         prune non-intersecting chunks from
+                                streamed scans before read/decode
+- ``store.chunk.pushdown``      answer chunk-tolerant density/count/
+                                stats queries from the manifest's
+                                pre-aggregates (boundary chunks still
+                                row-refine; exact for count/stats)
 - ``trace.sample``              head-sampling probability for request
                                 traces (0..1; tracing.py). Sampled
                                 traces are retained in the recent-trace
@@ -58,6 +73,13 @@ from contextlib import contextmanager
 
 def _parse_bool(v) -> bool:
     return str(v).strip().lower() in ("true", "1", "t", "yes", "on")
+
+
+def _parse_format(v) -> int:
+    n = int(v)
+    if n not in (1, 2):
+        raise ValueError(f"store.format.version must be 1 or 2, not {v!r}")
+    return n
 
 
 def _parse_verify(v) -> str:
@@ -93,6 +115,15 @@ _DEFS = {
     # verification scope, and whether flushes fsync what they publish
     "store.verify": ("off", _parse_verify),
     "store.fsync": (True, _parse_bool),
+    # chunked partition format v2 (store/fs.py + store/chunkstats.py):
+    # write-format selector, chunk size (= parquet row-group size), the
+    # coarse density-histogram grid, and the two read-side switches --
+    # chunk-level scan pruning (oocscan) and aggregation pushdown
+    "store.format.version": (2, _parse_format),
+    "store.chunk.rows": (1 << 16, int),
+    "store.chunk.grid": (64, int),
+    "store.chunk.prune": (True, _parse_bool),
+    "store.chunk.pushdown": (True, _parse_bool),
     # per-request tracing (tracing.py): head-sampling probability, the
     # slow-query always-capture threshold, and the optional jax.profiler
     # device-trace dump directory for sampled launches

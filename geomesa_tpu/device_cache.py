@@ -713,12 +713,25 @@ class DeviceIndex:
     def refresh(self) -> None:
         """Re-stage from the backing store (after writes / age-off).
         Compiled filters are data-independent and persist; jit re-compiles
-        on its own if the row count changes shape."""
-        res = self.store.query(self.type_name, _staging_query())
-        self._bin_range = None
-        self._bt_base = None
-        self._visid_np = None
-        self._host_batch, self._cols = self._stage_checked(res.batch)
+        on its own if the row count changes shape.
+
+        Stores that publish manifest chunk statistics (partition format
+        v2, store/chunkstats.py) make this cheap to plan: the staging
+        scan's full-scan shape rides the store's PRE-SIZED assembly
+        (buffers sized from the manifest's chunk row counts, zero-row
+        chunks skipped — one dataset copy at peak instead of the
+        collect-then-concat two), and the row total is known before any
+        file is read, so the traced span carries it up front."""
+        from geomesa_tpu.tracing import span
+
+        rows_hint = getattr(self.store, "manifest_rows", None)
+        hint = int(rows_hint(self.type_name)) if rows_hint else -1
+        with span("cache.stage", type=self.type_name, rows_hint=hint):
+            res = self.store.query(self.type_name, _staging_query())
+            self._bin_range = None
+            self._bt_base = None
+            self._visid_np = None
+            self._host_batch, self._cols = self._stage_checked(res.batch)
 
     def __len__(self) -> int:
         return len(self._host_batch)

@@ -72,6 +72,12 @@ class PartitionMeta:
     #: {"algo": "crc32"|"crc32c", "value": int, "length": bytes} --
     #: written at flush, verified on read per the store.verify knob
     checksum: "dict | None" = None
+    #: partition format v2 chunk statistics (store/chunkstats.ChunkSet;
+    #: fs stores only): per-chunk row counts, key min/max, bbox, time
+    #: range, coarse density cells and sketch partials -- the
+    #: aggregation-pushdown and sub-partition scan-pruning index.
+    #: None = legacy v1 partition (no chunk stats recorded)
+    chunks: "object | None" = None
 
     def overlaps(self, r: KeyRange) -> bool:
         return not (r.hi < self.key_lo or r.lo > self.key_hi)

@@ -302,6 +302,48 @@ store_read_retries = REGISTRY.counter(
     "transient partition-read retries by the prefetch workers",
 )
 
+# chunked partition format v2 (store/chunkstats.py): how much of the
+# streamed-scan workload the chunk-level Z/bbox/time pruning index
+# removed BEFORE read/decode (bytes skipped are real file bytes -- the
+# pruned parquet row groups), and fsck's chunk-stat drift findings
+store_chunks_read = REGISTRY.counter(
+    "geomesa_store_chunks_read_total",
+    "v2 partition chunks read by chunk-planned scans",
+)
+store_chunks_skipped = REGISTRY.counter(
+    "geomesa_store_chunks_skipped_total",
+    "v2 partition chunks pruned before read/decode",
+)
+store_chunk_bytes_skipped = REGISTRY.counter(
+    "geomesa_store_chunk_bytes_skipped_total",
+    "encoded partition-file bytes skipped by chunk pruning",
+)
+store_chunk_stat_drift = REGISTRY.counter(
+    "geomesa_store_chunk_stat_drift_total",
+    "chunk-stat records that disagreed with decoded rows (fsck)",
+)
+
+# aggregation pushdown (store/pushdown.py): density/count/stats queries
+# answered from chunk pre-aggregates -- how often it engages (by kind),
+# how often an eligible-looking query fell back, and the interior rows
+# that were never read vs the boundary chunks that row-refined
+agg_pushdown_queries = REGISTRY.counter(
+    "geomesa_agg_pushdown_queries_total",
+    "aggregate queries answered from chunk pre-aggregates",
+)
+agg_pushdown_fallbacks = REGISTRY.counter(
+    "geomesa_agg_pushdown_fallback_total",
+    "aggregate queries that fell back to the row-scan path",
+)
+agg_pushdown_rows = REGISTRY.counter(
+    "geomesa_agg_pushdown_rows_preaggregated_total",
+    "rows answered from interior-chunk summaries without being read",
+)
+agg_pushdown_chunks_refined = REGISTRY.counter(
+    "geomesa_agg_pushdown_chunks_refined_total",
+    "boundary chunks that descended to row-level refinement",
+)
+
 # per-request tracing (tracing.py): how many traces the ring retained
 # (head-sampled or slow-captured) and how many crossed the slow-query
 # threshold (trace.slow_ms) — the rate the slow-query log grows at

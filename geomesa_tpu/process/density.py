@@ -51,6 +51,13 @@ def density(
     ``loose`` applies only to the resident path (key-plane cell
     granularity, same contract as DeviceIndex.count/query). ``auths``
     applies row security on BOTH paths; a full Query's auths hint wins.
+
+    Stores with chunk pre-aggregates (partition format v2) answer
+    unweighted bbox+time densities from the manifest's coarse per-chunk
+    histograms WITHOUT materializing rows (interior chunks prorated,
+    boundary chunks row-refined — total mass exact, placement within
+    coarse-cell tolerance); ``hints={"agg.pushdown": False}`` forces the
+    exact row-scan path.
     """
     from geomesa_tpu.query.plan import Query
 
@@ -63,6 +70,13 @@ def density(
         if grid is not None:
             return grid
         # filter or planes not resident: fall through to the store path
+    pushed = getattr(store, "density_pushdown", None)
+    if pushed is not None and weight_attr is None and not auths:
+        pd_query = query if isinstance(query, Query) else Query(filter=filt)
+        grid = pushed(type_name, pd_query, envelope, width, height)
+        if grid is not None:
+            return grid
+        # chunk stats cannot decide this query: exact row-scan path
     # a caller-supplied full Query keeps ALL its attributes/hints
     # (max-features, sampling, ...) on the store path — with the RESOLVED
     # auths merged in (the Query's own hint won in _split_query; a bare

@@ -24,6 +24,23 @@ def run_stats(
 
         filt, auths = _split_query(query, auths)
         return device_index.stats(filt, stat_spec, auths=auths)
+    # chunk pre-aggregates (partition format v2): Count/MinMax specs
+    # with bbox+time filters merge the manifest's per-chunk sketch
+    # partials (exact; boundary chunks row-refine) instead of
+    # materializing the matched rows
+    pushed = getattr(store, "stats_pushdown", None)
+    if pushed is not None and not auths:
+        from geomesa_tpu.process.density import _split_query
+        from geomesa_tpu.query.plan import Query
+
+        filt, q_auths = _split_query(query, auths)
+        if not q_auths:
+            pd_query = (
+                query if isinstance(query, Query) else Query(filter=filt)
+            )
+            seq = pushed(type_name, pd_query, stat_spec)
+            if seq is not None:
+                return seq
     seq = parse_stat(stat_spec)
     res = store.query(type_name, query)
     seq.observe_batch(res.batch)

@@ -58,6 +58,13 @@ class QueryPlan:
     geom_bounds: FilterBounds
     time_bounds: FilterBounds
     candidates: "list[tuple[str, float]]" = field(default_factory=list)
+    #: aggregation-pushdown routing hint (:func:`aggregate_bounds`):
+    #: ``(envelopes, intervals)`` when the filter is EXACTLY a bbox+time
+    #: conjunction, so chunk-tolerant density/count/stats queries may be
+    #: answered from the v2 manifest's chunk pre-aggregates (interior
+    #: chunks from summaries, boundary chunks row-refined). None = the
+    #: filter has structure the chunk stats cannot decide -- row scan.
+    agg_bounds: "tuple | None" = None
 
     def explain(self) -> str:
         """Human-readable plan dump (ref: Explainer output surfaced by the
@@ -195,6 +202,7 @@ def _plan_query(
         geom_bounds=geoms,
         time_bounds=intervals,
         candidates=candidates,
+        agg_bounds=aggregate_bounds(f, sft, geoms, intervals),
     )
     guard_plan(chain, plan)
     _tsp.set(
@@ -202,6 +210,60 @@ def _plan_query(
         ranges=len(ranges) if ranges is not None else "full-scan",
     )
     return plan
+
+
+def is_aggregate_shape(f, sft) -> bool:
+    """Structural half of :func:`aggregate_bounds` -- True when ``f`` is
+    a conjunction of envelope predicates on the default geometry and
+    closed intervals on the default dtg (or INCLUDE). Cheap (no bound
+    extraction, no planning): pushdown entry points pre-screen with this
+    before paying for a full query plan they would then discard."""
+    geom_field = sft.geom_field
+    dtg_field = sft.dtg_field
+
+    def _pure(node) -> bool:
+        if node is ast.Include:
+            return True
+        if isinstance(node, ast.BBox) and node.attr == geom_field:
+            return True
+        if isinstance(node, ast.During) and node.attr == dtg_field:
+            return True
+        if (
+            isinstance(node, ast.Between)
+            and node.attr == dtg_field
+            and isinstance(node.lo, (int, float))
+            and isinstance(node.hi, (int, float))
+        ):
+            return True
+        return False
+
+    nodes = f.children if isinstance(f, ast.And) else (f,)
+    return all(_pure(n) for n in nodes)
+
+
+def aggregate_bounds(f, sft, geoms, intervals) -> "tuple | None":
+    """The planner's aggregation-pushdown routing test: ``(envs, ivals)``
+    when ``f`` is EXACTLY a conjunction of envelope predicates on the
+    default geometry and closed intervals on the default dtg (or
+    INCLUDE) -- the shapes chunk statistics can decide. ``envs``/
+    ``ivals`` follow the classify() convention: None = unconstrained on
+    that dimension, an empty tuple = provably empty. Any other filter
+    structure (attribute predicates, NOT, OR, exact geometries, open
+    comparisons) returns None and aggregates take the row-scan path.
+
+    Soundness: an INTERIOR chunk (bbox inside one envelope, time range
+    inside one interval) then contains ONLY rows satisfying ``f`` --
+    a feature's envelope lies within its chunk's bbox, so containment
+    implies the bbox predicate for point and extent geometries alike."""
+    if not is_aggregate_shape(f, sft):
+        return None
+    envs = (
+        None
+        if geoms.unbounded
+        else tuple(env for env, _ in geoms.values)
+    )
+    ivals = None if intervals.unbounded else tuple(intervals.values)
+    return (envs, ivals)
 
 
 class _StatEstimator:

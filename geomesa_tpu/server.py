@@ -526,6 +526,17 @@ class _Handler(BaseHTTPRequestHandler):
                 n = min(n, cap)  # the plain path counts the capped result
             self._observe_resident(type_name, cql, t0, _time.perf_counter(), n)
             return self._json(200, {"count": n})
+        if self._cap(q) is None and not self._auths(q):
+            # store.count answers bbox+time counts from the v2 chunk
+            # pre-aggregates (interior chunks never read) and falls back
+            # to the row scan internally for anything else
+            n = self._sched_run(
+                q,
+                fn=lambda: self.store.count(
+                    type_name, q.get("cql", "INCLUDE")
+                ),
+            )
+            return self._json(200, {"count": int(n)})
         res = self._sched_run(q, fn=lambda: self._query(type_name, q))
         self._json(200, {"count": len(res)})
 

@@ -76,6 +76,11 @@ class _FsTypeState:
     #: generation token embedded in the partition FILE names
     #: (``part-<file_gen>-NNNNN.*``); None = legacy un-scoped names
     file_gen: "str | None" = None
+    #: manifest format version (chunkstats.FORMAT_V1/V2): v2 partitions
+    #: carry per-chunk statistics and parquet row groups aligned to the
+    #: chunk boundaries. Lazily upgraded -- any rewrite (flush/compact/
+    #: reindex/repartition) re-publishes at ``store.format.version``
+    format_version: int = 1
     # legacy manifests only: a pre-generation-era flush failed AFTER
     # unlinking its files, so the rows exist only in that writer's
     # memory. Readers of such a manifest fail loudly instead of seeing
@@ -128,19 +133,27 @@ def _write_table(table, path: str, encoding: str) -> None:
         )
 
 
-def _read_table(path: str, encoding: str):
+def _read_table(path: str, encoding: str, row_groups=None):
+    """Read a partition file; ``row_groups`` (parquet only) reads ONLY
+    those row groups -- the chunk-selective pruned read. Callers pass it
+    only for v2 files whose chunks align 1:1 with row groups
+    (:meth:`FileSystemDataStore._row_groups_for`)."""
     if encoding == "orc":
         import pyarrow.orc as orc
 
         return orc.read_table(path)
     import pyarrow.parquet as pq
 
-    return pq.read_table(path)
+    if row_groups is None:
+        return pq.read_table(path)
+    return pq.ParquetFile(path).read_row_groups(list(row_groups))
 
 
-def _encode_table(table, encoding: str) -> bytes:
+def _encode_table(table, encoding: str, row_group_rows=None) -> bytes:
     """Arrow table -> parquet/orc bytes in memory: the durable write
-    path checksums (and fsyncs) the exact bytes that land on disk."""
+    path checksums (and fsyncs) the exact bytes that land on disk.
+    ``row_group_rows`` (parquet only) sizes row groups to the v2 chunk
+    boundaries so chunk-pruned reads skip real file bytes."""
     import pyarrow as pa
 
     sink = pa.BufferOutputStream()
@@ -159,17 +172,23 @@ def _encode_table(table, encoding: str) -> bytes:
             or pa.types.is_large_string(f.type)
             or pa.types.is_binary(f.type)
         ]
+        kwargs = {}
+        if row_group_rows:
+            kwargs["row_group_size"] = int(row_group_rows)
         pq.write_table(
             table, sink,
             use_dictionary=dict_cols or False,
             write_statistics=False,
+            **kwargs,
         )
     return sink.getvalue().to_pybytes()
 
 
-def _parse_table(data: bytes, encoding: str):
+def _parse_table(data: bytes, encoding: str, row_groups=None):
     """Verified-read counterpart of :func:`_read_table`: parse a table
-    from bytes already checksummed in memory."""
+    from bytes already checksummed in memory (``row_groups`` as in
+    :func:`_read_table` -- the whole file was read for the checksum, but
+    only the surviving row groups pay the decompress/decode)."""
     import pyarrow as pa
 
     buf = pa.BufferReader(pa.py_buffer(data))
@@ -179,7 +198,26 @@ def _parse_table(data: bytes, encoding: str):
         return orc.read_table(buf)
     import pyarrow.parquet as pq
 
-    return pq.read_table(buf)
+    if row_groups is None:
+        return pq.read_table(buf)
+    return pq.ParquetFile(buf).read_row_groups(list(row_groups))
+
+
+def _row_group_nbytes(data: bytes) -> "list[int]":
+    """Per-row-group compressed byte sizes of encoded parquet bytes --
+    recorded in the v2 manifest so chunk pruning can account the file
+    bytes it skipped without opening the file."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    md = pq.ParquetFile(pa.BufferReader(pa.py_buffer(data))).metadata
+    out = []
+    for i in range(md.num_row_groups):
+        rg = md.row_group(i)
+        out.append(
+            sum(rg.column(j).total_compressed_size for j in range(rg.num_columns))
+        )
+    return out
 
 
 # resolved ONCE: a failed import is not cached by Python, and paying a
@@ -257,13 +295,129 @@ def _fsync_dir(d: str) -> None:
         os.close(fd)
 
 
-def _write_part_file(table, path: str, encoding: str, fsync: bool) -> dict:
+def _write_part_file(
+    table, path: str, encoding: str, fsync: bool, chunk_rows=None
+) -> "tuple[dict, list | None]":
     """Write one partition file durably — encode to bytes, checksum,
-    single write (+fsync) — and return its manifest checksum record."""
-    data = _encode_table(table, encoding)
+    single write (+fsync) — returning ``(checksum_record,
+    chunk_nbytes)``. With ``chunk_rows`` set (v2 parquet), row groups
+    align to the chunk boundaries and ``chunk_nbytes`` carries their
+    compressed sizes for the manifest; None otherwise."""
+    data = _encode_table(table, encoding, row_group_rows=chunk_rows)
     algo, value = checksum_bytes(data)
+    chunk_nbytes = None
+    if chunk_rows and encoding == "parquet":
+        chunk_nbytes = _row_group_nbytes(data)
     _write_file(path, data, fsync)
-    return {"algo": algo, "value": value, "length": len(data)}
+    return {"algo": algo, "value": value, "length": len(data)}, chunk_nbytes
+
+
+class _Sized:
+    """Audit shim for pushdown-served aggregates: observe_query only
+    needs ``len(result)`` (the hit count for the audit event)."""
+
+    def __init__(self, n: int):
+        self._n = int(n)
+
+    def __len__(self) -> int:
+        return self._n
+
+
+class _PresizedSink:
+    """Streaming assembly of a FULL-scan result into buffers pre-sized
+    from the manifest's row counts (the chunk-stats/manifest contract:
+    recorded rows == file rows). The generic path collects every
+    partition batch in a list and then concatenates — peak host memory
+    is ~2x the dataset at exactly the moment the resident DeviceIndex
+    stages it. This sink copies each batch into its slice as it arrives
+    and drops it, so the peak is ONE dataset copy plus the in-flight
+    prefetch chunks. Buffers grow (rare: manifest drift) and trim (a
+    batch shorter than recorded) defensively, so the result is correct
+    even when the pre-size hint was wrong."""
+
+    def __init__(self, sft, total: int):
+        self.sft = sft
+        self.cap = int(total)
+        self.filled = 0
+        self._cols: "dict | None" = None
+        self._fids = None
+
+    def _alloc(self, like: np.ndarray, fill=None) -> np.ndarray:
+        buf = np.empty((self.cap,) + like.shape[1:], dtype=like.dtype)
+        if fill is not None:
+            buf[: self.filled] = fill
+        return buf
+
+    def _grow(self, need: int) -> None:
+        self.cap = max(self.cap * 2, need)
+        for k, v in self._cols.items():
+            nb = np.empty((self.cap,) + v.shape[1:], dtype=v.dtype)
+            nb[: self.filled] = v[: self.filled]
+            self._cols[k] = nb
+        nf = np.empty(self.cap, dtype=self._fids.dtype)
+        nf[: self.filled] = self._fids[: self.filled]
+        self._fids = nf
+
+    def add(self, batch: FeatureBatch) -> None:
+        from geomesa_tpu.security import VIS_COLUMN
+
+        n = len(batch)
+        if n == 0:
+            return
+        if self._cols is None:
+            self.cap = max(self.cap, n)
+            self._cols = {
+                k: self._alloc(v) for k, v in batch.columns.items()
+            }
+            self._fids = self._alloc(batch.fids)
+        if self.filled + n > self.cap:
+            self._grow(self.filled + n)
+        a, b = self.filled, self.filled + n
+        for k, buf in self._cols.items():
+            v = batch.columns.get(k)
+            if v is None:
+                if k != VIS_COLUMN:
+                    raise KeyError(f"column {k!r} missing from a partition")
+                v = np.array([""] * n, dtype=object)
+            if not np.can_cast(v.dtype, buf.dtype, casting="same_kind"):
+                # preserve trailing dims (e.g. (n, 2) point columns):
+                # a bare np.empty(0, dtype) template would allocate 1-D
+                promoted = self._alloc(
+                    np.empty(
+                        (0,) + buf.shape[1:],
+                        np.promote_types(buf.dtype, v.dtype),
+                    )
+                )
+                promoted[:a] = buf[:a]
+                self._cols[k] = buf = promoted
+            buf[a:b] = v
+        for k in batch.columns:
+            if k not in self._cols:
+                # a later partition introduces visibility labels: prior
+                # rows are public ("") — same semantics as concat()
+                self._cols[k] = self._alloc(batch.columns[k], fill="")
+                self._cols[k][a:b] = batch.columns[k]
+        if not np.can_cast(
+            batch.fids.dtype, self._fids.dtype, casting="same_kind"
+        ):
+            nf = np.empty(
+                self.cap,
+                np.promote_types(self._fids.dtype, batch.fids.dtype),
+            )
+            nf[:a] = self._fids[:a]
+            self._fids = nf
+        self._fids[a:b] = batch.fids
+        self.filled = b
+
+    def finish(self) -> "FeatureBatch | None":
+        if self._cols is None:
+            return None
+        n = self.filled
+        return FeatureBatch(
+            self.sft,
+            self._fids[:n],
+            {k: v[:n] for k, v in self._cols.items()},
+        )
 
 
 class FileSystemDataStore:
@@ -405,6 +559,8 @@ class FileSystemDataStore:
             with open(os.path.join(self._dir(name), "schema.json")) as fh:
                 meta = json.load(fh)
         sft = SimpleFeatureType.create(name, meta["spec"])
+        from geomesa_tpu.store.chunkstats import FORMAT_V1, chunkset_from_json
+
         parts = [
             PartitionMeta(
                 pid=p["pid"],
@@ -417,6 +573,7 @@ class FileSystemDataStore:
                 time_range=tuple(p["time_range"]) if p.get("time_range") else None,
                 leaf=p.get("leaf"),
                 checksum=p.get("checksum"),
+                chunks=self._load_chunks(chunkset_from_json, p.get("chunks")),
             )
             for p in meta["partitions"]
         ]
@@ -432,8 +589,18 @@ class FileSystemDataStore:
             stats=self._load_stats(meta.get("stats")),
             generation=meta.get("generation"),
             file_gen=meta.get("file_gen"),
+            format_version=int(meta.get("format", FORMAT_V1)),
             dirty=bool(meta.get("dirty", False)),
         )
+
+    @staticmethod
+    def _load_chunks(parse, raw):
+        if not raw:
+            return None
+        try:
+            return parse(raw)
+        except Exception:
+            return None  # chunk stats are advisory; never block opening
 
     @staticmethod
     def _load_stats(raw):
@@ -475,11 +642,14 @@ class FileSystemDataStore:
     def _save_meta(self, name: str) -> None:
         import uuid
 
+        from geomesa_tpu.store.chunkstats import chunkset_to_json
+
         st = self._types[name]
         st.generation = uuid.uuid4().hex  # new manifest token
         meta = {
             "generation": st.generation,
             "file_gen": st.file_gen,
+            "format": st.format_version,
             "dirty": st.dirty,
             "spec": st.sft.spec,
             "primary": st.primary,
@@ -498,6 +668,7 @@ class FileSystemDataStore:
                     "time_range": list(p.time_range) if p.time_range else None,
                     "leaf": p.leaf,
                     "checksum": p.checksum,
+                    "chunks": chunkset_to_json(p.chunks),
                 }
                 for p in st.partitions
             ],
@@ -615,6 +786,7 @@ class FileSystemDataStore:
         st.stats = new.stats
         st.generation = new.generation
         st.file_gen = new.file_gen
+        st.format_version = new.format_version
         st.dirty = new.dirty
         st.cache = {}
         # a new generation means new files: stale per-partition
@@ -692,9 +864,19 @@ class FileSystemDataStore:
         d = self._dir(type_name)
         fsync = bool(sys_prop("store.fsync"))
         new_gen = uuid.uuid4().hex[:8]
+        # partition format v2: fixed-size chunks with manifest statistics
+        # (store/chunkstats.py); parquet row groups align to the chunk
+        # boundaries so chunk-pruned reads skip real bytes. v1 keeps the
+        # legacy single-row-group layout bit-for-bit.
+        from geomesa_tpu.store.chunkstats import FORMAT_V2, build_chunk_set
+
+        fmt = int(sys_prop("store.format.version"))
+        chunk_rows = max(int(sys_prop("store.chunk.rows")), 1)
+        chunk_grid = max(int(sys_prop("store.chunk.grid")), 1)
+        v2 = fmt == FORMAT_V2
         prev = (
             st.partitions, st.file_gen, st.stats, st.data_interval,
-            st.generation, st.dirty, st.quarantine_owner,
+            st.generation, st.dirty, st.quarantine_owner, st.format_version,
         )
         # partition files stream out on writer threads (pyarrow releases
         # the GIL; at GB scale the writes are disk-writeback-bound) while
@@ -723,13 +905,22 @@ class FileSystemDataStore:
                     # paid a full column conversion for every file)
                     table = built.batch.to_arrow()
                     for p in built.partitions:
-                        part = dataclasses.replace(p, pid=pid, leaf=leaf)
+                        part = dataclasses.replace(
+                            p,
+                            pid=pid,
+                            leaf=leaf,
+                            chunks=build_chunk_set(
+                                ks, built.batch, built.keys,
+                                p.start, p.stop, chunk_rows, chunk_grid,
+                            ) if v2 else None,
+                        )
                         writes.append((part, ex.submit(
                             _write_part_file,
                             table.slice(p.start, p.stop - p.start),
                             self._part_path(type_name, part, gen=new_gen),
                             st.encoding,
                             fsync,
+                            chunk_rows if v2 else None,
                         )))
                         pid += 1
                 full = data
@@ -738,12 +929,20 @@ class FileSystemDataStore:
                 built = self._build(ks, data)
                 table = built.batch.to_arrow()
                 for p in built.partitions:
-                    writes.append((p, ex.submit(
+                    part = dataclasses.replace(
+                        p,
+                        chunks=build_chunk_set(
+                            ks, built.batch, built.keys,
+                            p.start, p.stop, chunk_rows, chunk_grid,
+                        ) if v2 else None,
+                    )
+                    writes.append((part, ex.submit(
                         _write_part_file,
                         table.slice(p.start, p.stop - p.start),
-                        self._part_path(type_name, p, gen=new_gen),
+                        self._part_path(type_name, part, gen=new_gen),
                         st.encoding,
                         fsync,
+                        chunk_rows if v2 else None,
                     )))
                 full = built.batch
                 # the build already encoded every row's (bin, z): reuse
@@ -762,17 +961,25 @@ class FileSystemDataStore:
 
             stats = build_default_stats(st.sft, full, z3_keys=z3_keys)
             # join: a failed write must fail the flush loudly, BEFORE
-            # anything publishes; the checksums ride back with the joins
-            parts = [
-                dataclasses.replace(p, checksum=w.result())
-                for p, w in writes
-            ]
+            # anything publishes; the checksums (and v2 per-chunk
+            # row-group byte sizes) ride back with the joins
+            parts = []
+            for p, w in writes:
+                checksum, chunk_nbytes = w.result()
+                if (
+                    p.chunks is not None
+                    and chunk_nbytes is not None
+                    and len(chunk_nbytes) == len(p.chunks)
+                ):
+                    p.chunks.nbytes = np.asarray(chunk_nbytes, dtype=np.int64)
+                parts.append(dataclasses.replace(p, checksum=checksum))
             fail_point("fail.flush.after_write")
             if fsync:
                 for dd in sorted(dirs):
                     _fsync_dir(dd)
             st.partitions = parts
             st.file_gen = new_gen
+            st.format_version = fmt
             st.data_interval = interval
             st.stats = stats
             st.cache = {}
@@ -793,7 +1000,8 @@ class FileSystemDataStore:
             ex.shutdown(wait=True, cancel_futures=True)
             published_gen = st.generation if publishing else None
             (st.partitions, st.file_gen, st.stats, st.data_interval,
-             st.generation, st.dirty, st.quarantine_owner) = prev
+             st.generation, st.dirty, st.quarantine_owner,
+             st.format_version) = prev
             st.cache = {}
             if publishing:
                 # the manifest replace may have landed before the
@@ -812,6 +1020,7 @@ class FileSystemDataStore:
                     st.partitions, st.file_gen = parts, new_gen
                     st.data_interval, st.stats = interval, stats
                     st.generation = published_gen
+                    st.format_version = fmt
                     st.dirty = False
                     st.quarantine_owner = False
             else:
@@ -1057,13 +1266,23 @@ class FileSystemDataStore:
 
         types = {}
         for name, st in self._types.items():
+            chunked = [p for p in st.partitions if p.chunks is not None]
             types[name] = {
                 "generation": st.generation,
                 "file_generation": st.file_gen,
                 "encoding": st.encoding,
+                "format": int(st.format_version),
                 "partitions": len(st.partitions),
                 "rows": int(sum(p.count for p in st.partitions)),
                 "dirty": bool(st.dirty),
+                # format-mix / chunk-stats coverage: how much of the
+                # type the pruning + pushdown machinery can serve (v1
+                # partitions linger until a compact lazily upgrades)
+                "chunked_partitions": len(chunked),
+                "chunks": int(sum(len(p.chunks) for p in chunked)),
+                "chunk_rows_covered": int(
+                    sum(p.count for p in chunked)
+                ),
                 "quarantined": {
                     int(pid): err for pid, err in st.quarantined.items()
                 },
@@ -1079,6 +1298,21 @@ class FileSystemDataStore:
                 "checksum_failures": metrics.store_checksum_failures.value(),
                 "partitions_quarantined": metrics.store_quarantined.value(),
                 "read_retries": metrics.store_read_retries.value(),
+                "chunks_read": metrics.store_chunks_read.value(),
+                "chunks_skipped": metrics.store_chunks_skipped.value(),
+                "chunk_bytes_skipped":
+                    metrics.store_chunk_bytes_skipped.value(),
+                "chunk_stat_drift": metrics.store_chunk_stat_drift.value(),
+                "pushdown_queries": {
+                    k: metrics.agg_pushdown_queries.value(kind=k)
+                    for k in ("count", "density", "stats")
+                },
+                "pushdown_fallbacks": {
+                    k: metrics.agg_pushdown_fallbacks.value(kind=k)
+                    for k in ("count", "density", "stats")
+                },
+                "pushdown_rows_preaggregated":
+                    metrics.agg_pushdown_rows.value(),
             },
         }
 
@@ -1178,26 +1412,67 @@ class FileSystemDataStore:
             st.scheme = scheme
             self._rebuild_locked(type_name)
 
+    def _cache_slice(
+        self, st, p: PartitionMeta, chunk_sel
+    ) -> "FeatureBatch | None":
+        """Serve a chunk-selective read from an already-cached FULL
+        partition batch (chunk row offsets are partition-relative slices
+        of the file order), or None on a cache miss. Chunk-selective
+        results are never themselves pinned -- a partial batch in the
+        cache would silently truncate later full reads."""
+        full = st.cache.get(p.pid)
+        if full is None:
+            return None
+        cs = p.chunks
+        idx = np.concatenate(
+            [
+                np.arange(int(cs.starts[i]), int(cs.stops[i]), dtype=np.int64)
+                for i in chunk_sel
+            ]
+        ) if len(chunk_sel) else np.array([], dtype=np.int64)
+        return full.take(idx)
+
     def _read_partition(
-        self, type_name: str, p: PartitionMeta, cache: bool = True
+        self,
+        type_name: str,
+        p: PartitionMeta,
+        cache: bool = True,
+        chunk_sel=None,
     ) -> FeatureBatch:
         """``cache=False`` reads without pinning the batch in the
         per-type partition cache — the out-of-core streaming scan reads
         every partition exactly once, and pinning them would accumulate
         the whole dataset in host RAM (the thing that scan exists to
-        avoid)."""
+        avoid). ``chunk_sel`` reads only those chunks of a v2 partition
+        (pruned row groups; never cached)."""
         st = self._types[type_name]
-        if p.pid in st.cache:
+        if chunk_sel is not None:
+            hit = self._cache_slice(st, p, chunk_sel)
+            if hit is not None:
+                return hit
+        elif p.pid in st.cache:
             return st.cache[p.pid]
         with self._shared():  # never read a half-rewritten directory
-            t = self._read_part_table(type_name, p)
+            # chunk_sel only rides when set: monkeypatch/test doubles of
+            # _read_part_table keep the legacy 3-arg call shape
+            t = (
+                self._read_part_table(type_name, p, chunk_sel=chunk_sel)
+                if chunk_sel is not None
+                else self._read_part_table(type_name, p)
+            )
         # decode OUTSIDE the lock: _shared() is thread-exclusive
         # in-process (_mem_lock), and the Arrow->FeatureBatch conversion
         # is the heavy half — concurrent readers must overlap it
-        return self._decode_part_table(type_name, p, t, cache)
+        return self._decode_part_table(
+            type_name, p, t, cache and chunk_sel is None
+        )
 
     def _read_partition_unlocked(
-        self, type_name: str, p: PartitionMeta, cache: bool = False
+        self,
+        type_name: str,
+        p: PartitionMeta,
+        cache: bool = False,
+        chunk_sel=None,
     ) -> FeatureBatch:
         """Read + decode one partition file with NO locking — the caller
         must already hold the store lock (shared or exclusive) for the
@@ -1207,14 +1482,23 @@ class FileSystemDataStore:
         (thread-serializing) lock themselves, or the pipeline deadlocks
         against its own consumer."""
         st = self._types[type_name]
-        if p.pid in st.cache:
+        if chunk_sel is not None:
+            hit = self._cache_slice(st, p, chunk_sel)
+            if hit is not None:
+                return hit
+        elif p.pid in st.cache:
             return st.cache[p.pid]
+        t = (
+            self._read_part_table(type_name, p, chunk_sel=chunk_sel)
+            if chunk_sel is not None
+            else self._read_part_table(type_name, p)
+        )
         return self._decode_part_table(
-            type_name, p, self._read_part_table(type_name, p), cache
+            type_name, p, t, cache and chunk_sel is None
         )
 
     def _read_partition_prefetch(
-        self, type_name: str, p: PartitionMeta
+        self, type_name: str, p: PartitionMeta, chunk_sel=None
     ) -> FeatureBatch:
         """Worker-thread partition read for the out-of-core stream.
         Guards against a mid-rewrite directory with the file lock ALONE:
@@ -1228,7 +1512,11 @@ class FileSystemDataStore:
         from geomesa_tpu.locking import file_lock
 
         st = self._types[type_name]
-        if p.pid in st.cache:
+        if chunk_sel is not None:
+            hit = self._cache_slice(st, p, chunk_sel)
+            if hit is not None:
+                return hit
+        elif p.pid in st.cache:
             return st.cache[p.pid]
         # writer fence: touch (acquire+release) _mem_lock BEFORE taking
         # the shared flock. A same-process writer holds _mem_lock while
@@ -1243,7 +1531,11 @@ class FileSystemDataStore:
         with self._mem_lock:
             pass
         with file_lock(self._lock_path, shared=True):
-            t = self._read_part_table(type_name, p)
+            t = (
+                self._read_part_table(type_name, p, chunk_sel=chunk_sel)
+                if chunk_sel is not None
+                else self._read_part_table(type_name, p)
+            )
         return self._decode_part_table(type_name, p, t, cache=False)
 
     def scan_lock_held(self) -> bool:
@@ -1254,13 +1546,56 @@ class FileSystemDataStore:
         thread-local depth)."""
         return getattr(self._lock_tl, "depth", 0) > 0
 
-    def _read_part_table(self, type_name: str, p: PartitionMeta):
+    def _row_groups_for(self, st, p: PartitionMeta, chunk_sel):
+        """Row-group indices for a chunk-selective read, or None when
+        the file cannot serve one (v1, ORC, or chunk stats without the
+        write-time row-group record). v2 parquet writes size row groups
+        to the chunk boundaries and record their byte sizes, so
+        ``chunks align 1:1 with row groups`` holds by construction --
+        the fsck chunk cross-check verifies it stays true on disk."""
+        if chunk_sel is None:
+            return None
+        cs = p.chunks
+        if (
+            st.encoding != "parquet"
+            or cs is None
+            or cs.nbytes is None
+            or len(cs.nbytes) != len(cs)
+        ):
+            return None
+        return [int(i) for i in chunk_sel]
+
+    @staticmethod
+    def _slice_table_chunks(t, cs, chunk_sel):
+        """Row-slice fallback for chunk-selective reads of files without
+        aligned row groups (ORC): the whole table was read, only the
+        selected chunks' rows survive to the (heavy) decode."""
+        import pyarrow as pa
+
+        slices = [
+            t.slice(int(cs.starts[i]), int(cs.stops[i] - cs.starts[i]))
+            for i in chunk_sel
+        ]
+        if not slices:
+            return t.slice(0, 0)
+        return pa.concat_tables(slices)
+
+    def _read_part_table(
+        self, type_name: str, p: PartitionMeta, chunk_sel=None
+    ):
         """File -> Arrow table (timed; the prefetch pipeline's 'read'
         stage). Locking is the CALLER's concern. Honors the
         ``fail.read.*`` failpoints; under ``store.verify=always`` the
         raw bytes are checksummed against the manifest BEFORE parsing,
         and a mismatch quarantines this one partition and raises a
-        loud :class:`PartitionCorruptError` (siblings keep serving)."""
+        loud :class:`PartitionCorruptError` (siblings keep serving).
+
+        ``chunk_sel`` (v2 partitions) reads only the selected chunks:
+        aligned parquet row groups skip the pruned chunks' file bytes
+        outright (checksum verification, when on, still reads the whole
+        file -- the checksum covers all bytes -- but only surviving row
+        groups pay decompress/decode); other encodings read fully and
+        row-slice before decode."""
         from geomesa_tpu import metrics
         from geomesa_tpu.conf import sys_prop
         from geomesa_tpu.failpoints import fail_hit, fail_point
@@ -1275,12 +1610,13 @@ class FileSystemDataStore:
         fail_point("fail.read.io")  # transient: the prefetch retry path
         injected = fail_hit("fail.read.corrupt")
         verify = injected or sys_prop("store.verify") == "always"
+        row_groups = self._row_groups_for(st, p, chunk_sel)
         from geomesa_tpu.tracing import span
 
         with span("store.read", pid=p.pid, rows=int(p.count)) as sp, \
                 metrics.io_read_seconds.time():
             if not verify:
-                t = _read_table(path, st.encoding)
+                t = _read_table(path, st.encoding, row_groups=row_groups)
             else:
                 with open(path, "rb") as fh:
                     data = fh.read()
@@ -1297,11 +1633,21 @@ class FileSystemDataStore:
                         f"dataset {type_name!r} partition {p.pid} "
                         f"({path}): {err}"
                     )
-                t = _parse_table(data, st.encoding)
+                t = _parse_table(data, st.encoding, row_groups=row_groups)
+            if chunk_sel is not None and row_groups is None:
+                t = self._slice_table_chunks(t, p.chunks, chunk_sel)
         try:
-            size = os.path.getsize(path)
+            if row_groups is not None and not verify:
+                # pruned read: account the bytes actually fetched (the
+                # selected row groups' manifest-recorded sizes), not the
+                # file size -- the skipped remainder is the pruning win
+                size = int(p.chunks.nbytes[list(chunk_sel)].sum())
+            else:
+                size = os.path.getsize(path)
             metrics.io_bytes_read.inc(size)
             sp.set(bytes=int(size))
+            if chunk_sel is not None:
+                sp.set(chunks=len(chunk_sel), chunk_total=len(p.chunks))
         except OSError:
             pass
         return t
@@ -1520,6 +1866,18 @@ class FileSystemDataStore:
             self.io,
             size_of=batch_nbytes,
         )
+        # FULL scans (Include, no ranges — notably the resident
+        # DeviceIndex staging query) stream into buffers pre-sized from
+        # the manifest's chunk/partition row counts instead of the
+        # collect-then-concat path: one dataset copy instead of two at
+        # peak, and zero-row partitions never touch the buffers
+        sink = (
+            _PresizedSink(st.sft, sum(int(q.count) for q in parts))
+            if plan.filter is ast.Include
+            and plan.ranges is None
+            and len(parts) > 1
+            else None
+        )
         sources = []  # the read batch behind each chunk (alias guard)
         try:
             for p, batch in zip(parts, batches):
@@ -1540,12 +1898,17 @@ class FileSystemDataStore:
                 )
                 sub = run_query(local, inner_plan)
                 if len(sub.batch):
-                    chunks.append(sub.batch)
-                    sources.append(batch)
+                    if sink is not None:
+                        sink.add(sub.batch)  # copies; batch drops now
+                    else:
+                        chunks.append(sub.batch)
+                        sources.append(batch)
         finally:
             batches.close()
         total = sum(p.count for p in st.partitions)
-        if chunks:
+        if sink is not None and sink.filled:
+            out = sink.finish()
+        elif chunks:
             if len(chunks) == 1:
                 out = chunks[0]
                 if out is sources[0]:
@@ -1579,8 +1942,83 @@ class FileSystemDataStore:
     def explain(self, type_name: str, query) -> str:
         return self.plan(type_name, query).explain()
 
+    # -- aggregation pushdown (partition format v2) ------------------------
+
+    def manifest_rows(self, type_name: str) -> int:
+        """Total rows recorded by the manifest (== file rows by the
+        manifest contract) — the pre-size hint resident staging and the
+        pushdown paths consume without reading any file."""
+        return int(sum(p.count for p in self._types[type_name].partitions))
+
     def count(self, type_name: str, query=ast.Include) -> int:
+        """Filtered count; bbox+time-shaped filters on a v2 store are
+        answered from chunk pre-aggregates (interior chunks from the
+        manifest, boundary chunks row-refined — bit-identical to the
+        row scan, proven by the parity tests) without reading interior
+        rows. Anything the chunk stats cannot decide exactly falls back
+        to the full query path. Pushdown-served counts are audited and
+        counted exactly like scanned ones."""
+        import time as _time
+
+        from geomesa_tpu.audit import observe_query
+        from geomesa_tpu.store.pushdown import count_pushdown
+
+        t0 = _time.perf_counter()
+        self.flush(type_name)
+        with self._shared():
+            self._refresh_from_disk(type_name)
+            t1 = _time.perf_counter()
+            out = count_pushdown(self, type_name, query)
+        if out is not None:
+            n, plan = out
+            observe_query(
+                "fs", type_name, plan, t0, t1, _time.perf_counter(),
+                _Sized(n), self.audit_writer,
+            )
+            return n
         return len(self.query(type_name, query))
+
+    def density_pushdown(
+        self, type_name: str, query, envelope, width: int, height: int
+    ):
+        """Chunk-granular density grid (see store/pushdown.py), or None
+        when the query needs the row-scan path. Interior chunks prorate
+        their coarse world-grid histograms onto the raster; boundary
+        chunks read + rasterize exactly — total mass matches the row
+        scan, per-pixel placement is within coarse-cell tolerance."""
+        from geomesa_tpu.store.pushdown import density_pushdown
+
+        self.flush(type_name)
+        with self._shared():
+            self._refresh_from_disk(type_name)
+            return density_pushdown(
+                self, type_name, query, envelope, width, height
+            )
+
+    def stats_pushdown(self, type_name: str, query, stat_spec: str):
+        """Stat-DSL aggregation from chunk partials (Count/MinMax specs
+        with bbox+time filters; exact — interior chunks merge their
+        manifest sketches, boundary chunks observe their rows), or None
+        for the row-scan path."""
+        from geomesa_tpu.store.pushdown import stats_pushdown
+
+        self.flush(type_name)
+        with self._shared():
+            self._refresh_from_disk(type_name)
+            return stats_pushdown(self, type_name, query, stat_spec)
+
+    def verify_chunk_stats(self, type_name: str) -> "list[tuple]":
+        """fsck's chunk-stat cross-check: decode every v2 partition and
+        recompute per-chunk row counts, key min/max, bbox, time range,
+        density-cell mass and MinMax partials against the manifest (plus
+        parquet row-group alignment). Returns ``[(pid, chunk, error)]``
+        for every drifted record — drift means pruning/pushdown could
+        return wrong answers, so fsck fails nonzero on it."""
+        from geomesa_tpu.store.pushdown import verify_chunk_stats
+
+        with self._shared():
+            self._refresh_from_disk(type_name)
+            return verify_chunk_stats(self, type_name)
 
 
 

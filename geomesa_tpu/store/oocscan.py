@@ -39,6 +39,16 @@ the disk and the device kernel on slab i. ``io=`` tunes it
 properties); ``io=0`` is the serial baseline. Peak host memory is the
 in-flight chunks (read-ahead depth, byte-budgeted) — never the dataset.
 
+Chunk pruning (ISSUE 6): v2 partitions carry per-chunk statistics
+(store/chunkstats.py), so pruning happens one level below the manifest's
+partition prune — chunks whose Z key span misses every planned range (or
+whose bbox/time range misses the query bounds) are dropped BEFORE
+read/decode, and the surviving chunks read as selective parquet row
+groups (pruned chunks' bytes never leave the disk). ``count`` goes
+further: bbox+time filters answer interior chunks straight from the
+manifest pre-aggregates and stream only boundary chunks
+(store/pushdown.py has the classification contract).
+
 Durability interplay (ISSUE 3): the partition reads beneath a streamed
 scan ride the store's crash-consistent read path — transient I/O errors
 retry on the workers with bounded backoff (``io.retries`` x
@@ -208,17 +218,94 @@ class StreamedDeviceScan:
         plan = self.store.plan(self.type_name, query)
         return plan, self.store._pruned_parts(self.type_name, plan)
 
-    def _slab_groups(self, parts):
-        """Group partitions into slab_rows-sized chunks (fewer, larger
-        uploads) by the MANIFEST row counts — no reads needed, so the
-        chunk plan exists before the pipeline starts and grouping is
-        identical at every worker count (count == file rows by the
-        manifest contract)."""
+    def _chunk_plan(self, plan, parts):
+        """Sub-partition pruning (partition format v2): ``(partition,
+        chunk_sel, rows)`` work items where ``chunk_sel`` lists the
+        chunks whose key span overlaps a planned Z range AND whose
+        bbox/time range meets the query bounds — everything else is
+        skipped BEFORE read/decode (pruned parquet row groups never
+        leave the disk). ``chunk_sel=None`` means the whole file (v1
+        partitions, pruning disabled, or nothing pruned). Sound exactly
+        like partition pruning, one level finer: the planner's ranges
+        cover every key a filter-matching row can have.
+
+        Returns ``(items, prune_stats)``; PURE — the caller records
+        ``prune_stats`` via :meth:`_record_prune` only when it actually
+        EXECUTES the plan (a fallback that re-reads everything must not
+        report chunks as skipped)."""
+        from geomesa_tpu.conf import sys_prop
+        from geomesa_tpu.store import chunkstats as cks
+
+        prune = bool(sys_prop("store.chunk.prune"))
+        can_prune = plan.ranges is not None or (
+            not plan.geom_bounds.unbounded or not plan.time_bounds.unbounded
+        )
+        items: list = []
+        skipped_chunks = 0
+        skipped_bytes = 0
+        read_chunks = 0
+        for p in parts:
+            cs = p.chunks
+            if not prune or not can_prune or cs is None or len(cs) <= 1:
+                items.append((p, None, int(p.count)))
+                continue
+            keep = np.ones(len(cs), dtype=bool)
+            if plan.ranges is not None:
+                keep &= cks.chunks_overlapping(cs, plan.ranges)
+            envs = (
+                None
+                if plan.geom_bounds.unbounded
+                else [env for env, _ in plan.geom_bounds.values]
+            )
+            ivals = (
+                None
+                if plan.time_bounds.unbounded
+                else list(plan.time_bounds.values)
+            )
+            if envs is not None or ivals is not None:
+                keep &= cks.classify(cs, envs, ivals) != cks.DISJOINT
+            sel = np.nonzero(keep)[0]
+            read_chunks += len(sel)
+            skipped_chunks += len(cs) - len(sel)
+            if cs.nbytes is not None and len(sel) < len(cs):
+                skipped_bytes += int(cs.nbytes[~keep].sum())
+            if len(sel) == len(cs):
+                items.append((p, None, int(p.count)))
+            elif len(sel):
+                items.append((
+                    p,
+                    [int(i) for i in sel],
+                    int(cs.rows[sel].sum()),
+                ))
+            # else: every chunk pruned -- the partition drops entirely
+        return items, (read_chunks, skipped_chunks, skipped_bytes)
+
+    @staticmethod
+    def _record_prune(prune_stats) -> None:
+        from geomesa_tpu import metrics
+
+        read_chunks, skipped_chunks, skipped_bytes = prune_stats
+        if skipped_chunks:
+            metrics.store_chunks_read.inc(read_chunks)
+            metrics.store_chunks_skipped.inc(skipped_chunks)
+            if skipped_bytes:
+                metrics.store_chunk_bytes_skipped.inc(skipped_bytes)
+
+    def _slab_groups(self, items):
+        """Group ``(partition, chunk_sel, rows)`` work items into
+        slab_rows-sized chunks (fewer, larger uploads) by the MANIFEST
+        row counts — no reads needed, so the chunk plan exists before
+        the pipeline starts and grouping is identical at every worker
+        count (count == file rows by the manifest contract). Bare
+        PartitionMeta items coerce to whole-file work (chunk_sel
+        None)."""
         group: list = []
         rows = 0
-        for p in parts:
-            group.append(p)
-            rows += int(p.count)
+        for item in items:
+            if not isinstance(item, tuple):
+                item = (item, None, int(item.count))
+            group.append(item)
+            rows += int(item[2])
             if rows >= self.slab_rows:
                 yield group
                 group, rows = [], 0
@@ -240,7 +327,7 @@ class StreamedDeviceScan:
         from geomesa_tpu.ops.scan import stage_columns_host
         from geomesa_tpu.tracing import span
 
-        batches = [read(p) for p in group]
+        batches = [read(p, sel) for p, sel, _rows in group]
         batch = (
             batches[0] if len(batches) == 1 else FeatureBatch.concat(batches)
         )
@@ -249,7 +336,7 @@ class StreamedDeviceScan:
             cols = stage_columns_host(batch, names)
         return cols, (batch if want_batch else None)
 
-    def _pairs(self, parts, names, want_batch: bool = True):
+    def _pairs(self, items, names, want_batch: bool = True):
         """Yield ``(host_cols, source_batch)`` in deterministic partition
         order through the prefetch pipeline. Workers use PER-READ
         locking (same consistency window as the serial scan), so a
@@ -284,11 +371,24 @@ class StreamedDeviceScan:
             prefetch_read = getattr(
                 self.store, "_read_partition_prefetch", None
             )
+        # chunk_sel rides as a kwarg ONLY when a selection exists: the
+        # whole-file read keeps the legacy call shape (stores and test
+        # doubles predating chunk_sel stay compatible)
         if cfg.workers > 0 and prefetch_read is not None:
-            read = lambda p: prefetch_read(self.type_name, p)  # noqa: E731
+            read = lambda p, sel: (  # noqa: E731
+                prefetch_read(self.type_name, p, chunk_sel=sel)
+                if sel is not None
+                else prefetch_read(self.type_name, p)
+            )
         else:
-            read = lambda p: self.store._read_partition(  # noqa: E731
-                self.type_name, p, cache=False
+            read = lambda p, sel: (  # noqa: E731
+                self.store._read_partition(
+                    self.type_name, p, cache=False, chunk_sel=sel
+                )
+                if sel is not None
+                else self.store._read_partition(
+                    self.type_name, p, cache=False
+                )
             )
         size_of = lambda pair: (  # noqa: E731
             sum(int(v.nbytes) for v in pair[0].values())
@@ -296,7 +396,7 @@ class StreamedDeviceScan:
         )
         yield from prefetch_map(
             lambda g: self._load_group(g, read, names, want_batch),
-            self._slab_groups(parts),
+            self._slab_groups(items),
             cfg,
             size_of=size_of,
         )
@@ -336,22 +436,110 @@ class StreamedDeviceScan:
 
     # -- public surface ----------------------------------------------------
 
+    def _agg_split(self, plan, parts):
+        """Count-pushdown split over the chunk stats: ``(base, items,
+        pushed)`` where ``base`` rows come straight from interior-chunk
+        summaries (never read) and ``items`` are the boundary work items
+        that still stream through the device. Falls back to the plain
+        chunk plan (base 0, pushed False) when the filter or the
+        partitions cannot support pushdown — including any partition
+        holding visibility-labeled rows: the device count path ignores
+        labels by contract, but the NON-device fallback is store.query
+        (which hides them), and a manifest summary must never widen what
+        that fallback would return. For an agg_bounds-shaped (bbox+time)
+        filter the device mask IS the exact predicate, so summary +
+        refined counts compose bit-identically with the full streamed
+        count. Callers record the ``geomesa_agg_pushdown_*`` metrics
+        when (and only when) they actually USE the split."""
+        from geomesa_tpu.conf import sys_prop
+        from geomesa_tpu.store import chunkstats as cks
+
+        q = plan.query
+        eligible = (
+            plan.agg_bounds is not None
+            and bool(sys_prop("store.chunk.pushdown"))
+            and q.hints.get("agg.pushdown") is not False
+            and q.max_features is None  # incl. interceptor-applied caps
+            and all(
+                p.chunks is not None and not p.chunks.has_vis
+                for p in parts
+            )
+        )
+        if not eligible:
+            items, prune_stats = self._chunk_plan(plan, parts)
+            return 0, items, False, prune_stats
+        from geomesa_tpu.store.pushdown import _boundary_sel
+
+        envs, ivals = plan.agg_bounds
+        base = 0
+        items: list = []
+        for p in parts:
+            cs = p.chunks
+            klass = cks.classify(cs, envs, ivals)
+            base += int(cs.rows[klass == cks.INTERIOR].sum())
+            # boundary selection + Z-range refinement: the one shared
+            # rule (store/pushdown._boundary_sel) — the two count paths
+            # must never diverge on which chunks row-refine
+            sel = _boundary_sel(plan, cs, klass)
+            if len(sel) == len(cs):
+                items.append((p, None, int(p.count)))
+            elif len(sel):
+                items.append(
+                    (p, [int(i) for i in sel], int(cs.rows[sel].sum()))
+                )
+        return base, items, True, None
+
+    @staticmethod
+    def _record_pushdown(base: int, items) -> None:
+        from geomesa_tpu import metrics
+
+        metrics.agg_pushdown_queries.inc(kind="count")
+        metrics.agg_pushdown_rows.inc(base)
+        refined = sum(
+            len(sel) for _p, sel, _r in items if sel is not None
+        )
+        if refined:
+            metrics.agg_pushdown_chunks_refined.inc(refined)
+
     def count(self, query) -> int:
         """Streamed fused count. Filters with host-only predicates fall
-        back to the store's own (streaming, host) scan."""
+        back to the store's own (streaming, host) scan. bbox+time
+        filters over v2 partitions short-circuit through the chunk
+        pre-aggregates: interior chunks are answered from the manifest
+        and only boundary chunks stream through the device — a fully
+        pre-aggregated answer (e.g. INCLUDE) reads no file at all."""
         from geomesa_tpu.tracing import span
 
         plan, parts = self._parts(query)
         compiled = plan.compiled
-        if not compiled.device_cols or not compiled.fully_on_device:
+        device_ok = bool(
+            compiled.device_cols and compiled.fully_on_device
+        )
+        if not device_ok:
+            # no usable device predicate; a PURE summary answer (every
+            # surviving chunk interior) still needs no rows at all
+            base, items, pushed, _prune = self._agg_split(plan, parts)
+            if pushed and not items:
+                self._record_pushdown(base, items)
+                return int(base)
+            # boundary chunks would need the (absent) device mask: the
+            # store's host scan answers instead — the split (and its
+            # prune accounting) is discarded, so neither may be recorded
             return len(self.store.query(self.type_name, query).batch)
         with span(
             "oocscan.count", type=self.type_name, parts=len(parts)
-        ):
+        ) as sp:
+            base, items, pushed, prune_stats = self._agg_split(plan, parts)
+            if pushed:
+                self._record_pushdown(base, items)
+            elif prune_stats is not None:
+                self._record_prune(prune_stats)
             outs = self._stream(plan, "count").stream(
-                self._pairs(parts, compiled.device_cols, want_batch=False)
+                self._pairs(items, compiled.device_cols, want_batch=False)
             )
-            return int(sum(int(o) for o, _ in outs))
+            total = base + int(sum(int(o) for o, _ in outs))
+            sp.set(rows_preagg=int(base))
+            return total
 
     def query(self, query):
         """Streamed fused scan returning the hit FeatureBatch: device
@@ -375,7 +563,12 @@ class StreamedDeviceScan:
         from geomesa_tpu.query.runner import _post_process
 
         compiled = plan.compiled
-        pairs = self._pairs(parts, compiled.device_cols)
+        # chunk-level pruning: non-intersecting chunks never read/decode
+        # (the mask path still applies the exact filter to what remains,
+        # so pruning only ever removes provably-empty work)
+        items, prune_stats = self._chunk_plan(plan, parts)
+        self._record_prune(prune_stats)
+        pairs = self._pairs(items, compiled.device_cols)
         hits: list = []
         for mask, batch in self._stream(plan, "mask").stream(pairs):
             m = np.asarray(mask)[: len(batch)]

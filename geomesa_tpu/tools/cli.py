@@ -358,14 +358,18 @@ def cmd_fsck(args):
     """Recovery sweep + full checksum verification (the offline face of
     the store's crash-recovery machinery, ISSUE 3): reclaims files from
     interrupted flushes, repairs a lagging generation sidecar, verifies
-    every partition file against its manifest checksum, and reports the
-    quarantine state operators would otherwise discover query-by-query.
-    Exits non-zero when corruption was found."""
+    every partition file against its manifest checksum, cross-checks v2
+    chunk statistics (row counts, key min/max, bbox/time, density mass,
+    sketch partials, row-group alignment) against the decoded rows, and
+    reports the quarantine state operators would otherwise discover
+    query-by-query. Exits non-zero on corruption OR chunk-stat drift —
+    drifted stats mean pruning/pushdown could return wrong answers."""
     store = _store(args)
     names = (
         [args.feature_name] if args.feature_name else store.type_names
     )
     corrupt = 0
+    drifted = 0
     for name in names:
         rep = store.recover(name)
         line = (
@@ -383,8 +387,27 @@ def cmd_fsck(args):
             print(f"  partition {pid} CORRUPT ({path}): {err}")
         print(f"  verified {total - len(errors)}/{total} partition file(s) ok")
         corrupt += len(errors)
-    if corrupt:
-        sys.exit(f"error: {corrupt} corrupt partition file(s)")
+        if errors:
+            continue  # corrupt files cannot be decoded for stat checks
+        chunked = sum(
+            1 for p in store._types[name].partitions if p.chunks is not None
+        )
+        if not chunked:
+            continue
+        drift = store.verify_chunk_stats(name)
+        for pid, ci, err in drift:
+            where = f"chunk {ci}" if ci >= 0 else "chunks"
+            print(f"  partition {pid} {where} DRIFT: {err}")
+        print(
+            f"  chunk stats cross-checked on {chunked} partition(s): "
+            f"{len(drift)} drift finding(s)"
+        )
+        drifted += len(drift)
+    if corrupt or drifted:
+        sys.exit(
+            f"error: {corrupt} corrupt partition file(s), "
+            f"{drifted} drifted chunk-stat record(s)"
+        )
 
 
 

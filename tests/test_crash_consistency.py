@@ -46,7 +46,15 @@ def _rows(n, seed, fid0=0):
     return cols, np.arange(fid0, fid0 + n)
 
 
-def _populated(root, n=N0):
+def _populated(root, n=N0, fmt=2):
+    from geomesa_tpu.conf import prop_override
+
+    with prop_override("store.format.version", fmt):
+        ds = _populate_fmt(root, n)
+    return ds
+
+
+def _populate_fmt(root, n):
     ds = FileSystemDataStore(root, partition_size=128)
     ds.create_schema("t", SPEC)
     cols, fids = _rows(n, seed=1)
@@ -55,14 +63,20 @@ def _populated(root, n=N0):
     return ds
 
 
-def _crash_op(root, op, failpoint):
+def _crash_op(root, op, failpoint, fmt=2):
     """Subprocess body: arm the failpoint with the `kill` action and run
     the operation — the process SIGKILLs ITSELF at the exact instant
     under test, which is as close to `kill -9 at the worst moment` as a
     deterministic test gets."""
     from geomesa_tpu import failpoints
+    from geomesa_tpu.conf import set_prop
     from geomesa_tpu.store.fs import FileSystemDataStore
 
+    set_prop("store.format.version", fmt)
+    # several chunks per partition: a v2 crash must leave the chunked
+    # manifest and the row-group-aligned files consistent, not just the
+    # degenerate one-chunk case
+    set_prop("store.chunk.rows", 32)
     ds = FileSystemDataStore(root, partition_size=128)
     if op == "flush":
         cols, fids = _rows(NEW_N, seed=7, fid0=NEW_FID0)
@@ -79,18 +93,18 @@ def _crash_op(root, op, failpoint):
     os._exit(42)  # must be unreachable: every failpoint kills
 
 
-def _run_crash(tmp_path, op, failpoint):
+def _run_crash(tmp_path, op, failpoint, fmt=2):
     """Populate, crash a subprocess mid-op, reopen; returns
     (advanced, orphans_reclaimed) where advanced == the reopened store
     serves the POST-op state."""
     root = str(tmp_path / "store")
-    ds = _populated(root)
+    ds = _populated(root, fmt=fmt)
     old_fids = {int(f) for f in ds.query("t").batch.fids}
     assert len(old_fids) == N0
     del ds
 
     ctx = mp.get_context("spawn")  # fork is unsafe under JAX threads
-    p = ctx.Process(target=_crash_op, args=(root, op, failpoint))
+    p = ctx.Process(target=_crash_op, args=(root, op, failpoint, fmt))
     p.start()
     p.join(180)
     assert p.exitcode == -signal.SIGKILL, (op, failpoint, p.exitcode)
@@ -128,6 +142,7 @@ def _run_crash(tmp_path, op, failpoint):
 
 
 @pytest.mark.chaos
+@pytest.mark.parametrize("fmt", [1, 2], ids=["v1", "v2"])
 @pytest.mark.parametrize(
     "failpoint,expect_new",
     [
@@ -136,12 +151,22 @@ def _run_crash(tmp_path, op, failpoint):
         ("fail.flush.after_publish", True),  # published, old gen not GC'd
     ],
 )
-def test_flush_kill_matrix_smoke(tmp_path, failpoint, expect_new):
-    advanced, orphans = _run_crash(tmp_path, "flush", failpoint)
+def test_flush_kill_matrix_smoke(tmp_path, failpoint, expect_new, fmt):
+    """The old-xor-new contract must hold for BOTH manifest formats:
+    v2's chunked manifests and row-group-aligned files ride the same
+    write-new-then-publish protocol, so a crash can never publish a
+    manifest whose chunk stats disagree with its files."""
+    advanced, orphans = _run_crash(tmp_path, "flush", failpoint, fmt=fmt)
     assert advanced == expect_new
     # every kill leaves an unpublished new generation (pre-publish) or an
     # un-GC'd old one (post-publish): the sweep must reclaim something
     assert orphans >= 1
+    if fmt == 2:
+        # whichever generation survived, its chunk stats must match the
+        # decoded rows bit for bit (the fsck cross-check)
+        root = str(tmp_path / "store")
+        ds = FileSystemDataStore(root, partition_size=128)
+        assert ds.verify_chunk_stats("t") == []
 
 
 @pytest.mark.chaos

@@ -347,8 +347,12 @@ class TestServerEndToEnd:
                 walk(c)
 
         walk(doc["spans"])
+        # /count serves through the chunk-stats pushdown (PR 6): the
+        # levels that run are plan -> agg.pushdown -> boundary-chunk
+        # refinement (read/decode/scan); store.query only appears on
+        # the row-scan fallback
         assert {
-            "store.query", "query.plan", "query.scan",
+            "agg.pushdown", "query.plan", "query.scan",
             "store.read", "store.decode",
         } <= names
         assert doc["spans"]["attrs"]["status"] == 200
@@ -479,7 +483,8 @@ class TestServerEndToEnd:
         assert rid in capsys.readouterr().out
         cli_main(["trace", "--url", url, rid])
         out = capsys.readouterr().out
-        assert "store.query" in out and "coverage" in out
+        # the /count request serves via the aggregation pushdown (PR 6)
+        assert "agg.pushdown" in out and "coverage" in out
 
 
 class TestMetricsRegressions:

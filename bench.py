@@ -1960,6 +1960,109 @@ def bench_serving(args) -> dict:
     return out
 
 
+def bench_serve_chaos(args) -> dict:
+    """``--mode serve --chaos-smoke``: the serve-path chaos smoke
+    (ISSUE 7). Injects (1) a persistent device-launch failure — the
+    resident count must degrade to the store rung with the SAME answer,
+    the device breaker must open within the failure budget and half-open
+    recover once the fault clears — and (2) a staging OOM on the store
+    scan path — the batch-halving recovery must return the exact row
+    set. Finishes with a draining shutdown and asserts the scheduler
+    drained clean (no request lost, queue and running both zero). Fast
+    and deterministic: the CI chaos step."""
+    import urllib.request
+    from urllib.parse import quote
+
+    import numpy as np
+
+    from geomesa_tpu import failpoints, metrics, resilience
+    from geomesa_tpu.conf import prop_override
+    from geomesa_tpu.filter.ecql import parse_instant
+    from geomesa_tpu.sched import SchedConfig
+    from geomesa_tpu.server import serve_background
+    from geomesa_tpu.store.memory import MemoryDataStore
+
+    n = args.n or (1 << 14)
+    resilience.reset()
+    ds = MemoryDataStore()
+    ds.create_schema("gdelt", "name:String,dtg:Date,*geom:Point:srid=4326")
+    rng = np.random.default_rng(7)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write("gdelt", {
+        "name": rng.choice(["a", "b"], n),
+        "dtg": t0 + rng.integers(0, 10**8, n),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+    server, _ = serve_background(
+        ds, resident=True,
+        sched=SchedConfig(max_inflight=1, max_queue=64,
+                          default_deadline_ms=None),
+    )
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+
+    def get(path):
+        with urllib.request.urlopen(f"{base}{path}", timeout=120) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+
+    cql = quote("BBOX(geom, -10.0, 35.0, 30.0, 60.0)")
+    count_path = f"/count/gdelt?cql={cql}"
+    feat_path = f"/features/gdelt?cql={cql}&properties=name"
+    _, _, doc = get(count_path)  # warm: stage + compile
+    expect = doc["count"]
+    _, _, doc = get(feat_path)
+    expect_rows = len(doc["features"])
+    log(f"chaos-smoke: n={n:,}, oracle count={expect}")
+
+    # -- leg 1: persistent device-launch failure ----------------------
+    with prop_override("resilience.retries", 0), \
+            prop_override("resilience.breaker.failures", 1), \
+            prop_override("resilience.breaker.cooldown.s", 0.3):
+        with failpoints.failpoint_override("fail.device.launch", "raise"):
+            st, hd, doc = get(count_path)
+            assert st == 200 and doc["count"] == expect, (st, doc)
+            assert "device-launch-failed" in hd.get("X-Degraded", ""), hd
+            st, hd, doc = get(count_path)  # breaker open: skip the rung
+            assert doc["count"] == expect
+            assert "device-breaker-open" in hd.get("X-Degraded", ""), hd
+            assert resilience.device_breaker().state == "open"
+        time.sleep(0.35)  # cooldown: the half-open probe runs clean
+        st, hd, doc = get(count_path)
+        assert st == 200 and doc["count"] == expect
+        assert "X-Degraded" not in hd, hd
+        assert resilience.device_breaker().state == "closed"
+    log("chaos-smoke: device-launch leg ok "
+        "(degraded-correct, breaker open -> half-open -> closed)")
+
+    # -- leg 2: staging OOM on the store scan path --------------------
+    o0 = metrics.resilience_oom_recoveries.value()
+    with failpoints.failpoint_override("fail.stage.oom", "raise:1"):
+        st, hd, doc = get(feat_path)
+    assert st == 200 and len(doc["features"]) == expect_rows
+    ooms = int(metrics.resilience_oom_recoveries.value() - o0)
+    assert ooms >= 1, "staging OOM never engaged the halving recovery"
+    log(f"chaos-smoke: staging-OOM leg ok ({ooms} halvings, exact rows)")
+
+    # -- leg 3: draining shutdown -------------------------------------
+    st, _, doc = get("/readyz")
+    assert st == 200 and doc["ready"]
+    server.shutdown()  # draining: admission off, in-flight finished
+    snap = server.scheduler.snapshot()
+    assert snap["queue_depth"] == 0 and snap["running"] == 0, snap
+    server.scheduler.shutdown(timeout=2.0)
+    log("chaos-smoke: drained clean (queue 0, running 0)")
+    return {
+        "serve_chaos_n": n,
+        "serve_chaos_count": expect,
+        "serve_chaos_oom_recoveries": ooms,
+        "serve_chaos_breaker_opens":
+            resilience.device_breaker().snapshot()["opens"],
+        "serve_chaos_ok": True,
+    }
+
+
 def _serve_observability_snapshot(base: str) -> dict:
     """Scrape /metrics (the geomesa_* scalar series) and the newest
     /debug/traces entry from the serving leg's own server, for embedding
@@ -2174,6 +2277,13 @@ def main() -> None:
         "overhead stays under 3%%",
     )
     ap.add_argument(
+        "--chaos-smoke", action="store_true",
+        help="serve mode: ONLY the fault-injection smoke (fast; CI "
+        "safe) — inject a device-launch failure and a staging OOM, "
+        "assert degraded-but-correct responses, breaker open/half-open "
+        "recovery and a clean drain (bench_serve_chaos)",
+    )
+    ap.add_argument(
         "--engine",
         choices=("pallas", "xla"),
         default="pallas",
@@ -2218,9 +2328,12 @@ def main() -> None:
     elif args.mode == "join":
         out = bench_join(args)
     elif args.mode == "serve":
-        out = bench_serving(args)
-        if args.trace_overhead:
-            out.update(bench_trace_overhead(args))
+        if args.chaos_smoke:
+            out = bench_serve_chaos(args)
+        else:
+            out = bench_serving(args)
+            if args.trace_overhead:
+                out.update(bench_trace_overhead(args))
     elif args.mode == "flush":
         out = bench_flush(args)
     else:

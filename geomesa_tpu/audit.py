@@ -32,6 +32,13 @@ class AuditedEvent:
     scanning_ms: float = 0.0
     hits: int = 0
     trace_id: str = ""  # cross-links the event to /debug/traces/<id>
+    # how the request ended: "ok", "shed" (429), "deadline-expired"
+    # (504) or "error" — shed/expired requests audit too (ISSUE 7),
+    # not just the ones that executed
+    outcome: str = "ok"
+    # comma-joined degradation reasons when the answer came from a
+    # lower rung (resilience.note_degraded); "" = full-fidelity
+    degraded: str = ""
     # event timestamp persisted into the audit log (epoch by design)
     ts: float = field(default_factory=time.time)
 
@@ -156,6 +163,7 @@ def observe_query(store, type_name, plan, t0, t1, t2, result, audit_writer):
     guaranteed never to throw into the query path."""
     try:
         from geomesa_tpu.metrics import queries_run, query_seconds
+        from geomesa_tpu.resilience import current_degraded
         from geomesa_tpu.tracing import current_trace_id
 
         queries_run.inc(store=store, type=type_name)
@@ -170,6 +178,7 @@ def observe_query(store, type_name, plan, t0, t1, t2, result, audit_writer):
                     scanning_ms=(t2 - t1) * 1e3,
                     hits=len(result),
                     trace_id=current_trace_id(),
+                    degraded=",".join(current_degraded()),
                 )
             )
     except Exception:  # pragma: no cover - observability must not break reads

@@ -63,6 +63,32 @@ Properties:
                                 device launch in a ``jax.profiler``
                                 trace dumped to this directory
                                 (profiling.device_trace); "" = off
+- ``io.backoff.cap.ms``         cumulative cap on the transient-read
+                                backoff a single partition read may
+                                sleep (retries stop once spent)
+- ``resilience.enabled``        master switch for the fault-tolerance
+                                layer (resilience.py): breakers, serving
+                                retries, watchdog, degradation ladder
+- ``resilience.degrade``        allow degraded (approximate / partial,
+                                stamped ``X-Degraded``) answers instead
+                                of failing when a domain is unhealthy
+- ``resilience.retries``        serving-path retries of RETRYABLE
+                                faults beyond the first attempt
+- ``resilience.backoff.ms``     base serving-retry backoff, doubling
+                                per attempt, jittered 0.5-1.5x
+- ``resilience.backoff.cap.ms`` cumulative serving-retry backoff cap
+- ``resilience.breaker.failures``  consecutive failures that open a
+                                circuit breaker
+- ``resilience.breaker.cooldown.s``  seconds a breaker stays open
+                                before half-opening for one probe
+- ``resilience.launch.timeout.s``  device-launch watchdog budget: a
+                                scheduler execution stuck longer is
+                                failed and its worker replaced (0 =
+                                watchdog off)
+- ``resilience.brownout.queue.frac``  scheduler-queue fill fraction
+                                past which exact aggregate answers
+                                yield to chunk-pushdown approximations
+                                (0 disables brownout)
 """
 
 from __future__ import annotations
@@ -140,6 +166,22 @@ _DEFS = {
     "sched.max.fusion": (64, int),
     "sched.default.deadline.ms": (30_000.0, float),
     "sched.retry.after.s": (1.0, float),
+    # transient-read backoff cumulative cap (store/prefetch.py): with
+    # io.retries x io.backoff.ms doubling AND jitter, this bounds the
+    # total wall-clock one read may sleep before surfacing the error
+    "io.backoff.cap.ms": (1000.0, float),
+    # fault-tolerant serving (resilience.py): master switch, the
+    # degraded-answers switch, serving-retry budget/backoff, breaker
+    # thresholds, the device-launch watchdog and the brownout ladder
+    "resilience.enabled": (True, _parse_bool),
+    "resilience.degrade": (True, _parse_bool),
+    "resilience.retries": (2, int),
+    "resilience.backoff.ms": (25.0, float),
+    "resilience.backoff.cap.ms": (2000.0, float),
+    "resilience.breaker.failures": (5, int),
+    "resilience.breaker.cooldown.s": (5.0, float),
+    "resilience.launch.timeout.s": (30.0, float),
+    "resilience.brownout.queue.frac": (0.8, float),
 }
 
 _overrides: dict = {}

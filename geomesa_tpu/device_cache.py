@@ -1033,6 +1033,9 @@ class DeviceIndex:
         (None/() hides labeled rows — fail closed)."""
         import jax.numpy as jnp
 
+        from geomesa_tpu.failpoints import fail_point
+
+        fail_point("fail.device.launch")  # chaos: resident count launch
         f = self._parse(query)
         if VIS_ID in (self._cols or {}):
             # labeled data: the auth table must AND into the device mask
@@ -1142,6 +1145,9 @@ class DeviceIndex:
         (mirroring the serial count path); the mask variant leaves
         validity to the host-side AND in fused_loose_query (mirroring
         _loose_mask)."""
+        from geomesa_tpu.failpoints import fail_point
+
+        fail_point("fail.device.launch")  # chaos: fused resident launch
         if not queries:
             return None
         if VIS_ID in (self._cols or {}):
@@ -1285,6 +1291,9 @@ class DeviceIndex:
         live set (evicted, in subclasses) are always False. When a
         label-id plane is staged, the per-request ``auths`` verdict is
         ANDed in (fail closed on None/())."""
+        from geomesa_tpu.failpoints import fail_point
+
+        fail_point("fail.device.launch")  # chaos: resident scan launch
         f = self._parse(query)
         if self._resolve_loose(loose):
             lm = self._loose_mask(f)
@@ -1966,6 +1975,9 @@ class DeviceIndex:
         device-expressible (caller falls back to a host path)."""
         import jax
 
+        from geomesa_tpu.failpoints import fail_point
+
+        fail_point("fail.device.launch")  # chaos: fused-agg launch
         kind = None
         lb = None
         if self._resolve_loose(loose):

@@ -21,6 +21,24 @@ Points honored by the store layer (fs.py / prefetch.py):
 - ``fail.read.corrupt``         -- partition read reports a checksum
                                    mismatch (exercises quarantine)
 
+Serving-path points (sched / query runner / device cache — the chaos
+suite's fault-tolerant-serving legs, ISSUE 7):
+
+- ``fail.device.launch``        -- a device scan launch is about to
+                                   dispatch (resident + store paths);
+                                   ``raise`` simulates a launch failure
+                                   the degradation ladder must absorb
+- ``fail.stage.oom``            -- column staging for a device scan run;
+                                   a raise here is treated as HBM OOM by
+                                   the batch-halving recovery
+- ``fail.sched.worker``         -- a scheduler worker about to execute a
+                                   claimed group; ``raise`` simulates a
+                                   worker crash (requests must fail
+                                   typed, never hang or vanish)
+- ``fail.read.slow``            -- evaluated next to ``fail.read.io``;
+                                   arm with ``sleep:<ms>`` to inject
+                                   slow-disk latency without errors
+
 Activation: programmatic (``set_failpoint``/``failpoint_override``) or
 the ``GEOMESA_TPU_FAILPOINTS`` environment variable, a comma-separated
 ``name=action`` list — the env form is how a chaos test arms a point in
@@ -31,6 +49,8 @@ a subprocess it is about to kill. Actions:
 - ``raise``    -- raise :class:`FailpointError` every evaluation
 - ``raise:N``  -- raise for the first N evaluations, then pass
                   (transient-error injection for retry paths)
+- ``sleep:MS`` -- sleep MS milliseconds, then pass (latency injection —
+                  slow disks, slow launches — without any error)
 - ``off``      -- disarmed (same as absent)
 """
 
@@ -62,12 +82,24 @@ POINTS = (
     "fail.flush.after_publish",
     "fail.read.io",
     "fail.read.corrupt",
+    "fail.read.slow",
+    "fail.device.launch",
+    "fail.stage.oom",
+    "fail.sched.worker",
 )
 
 
 class FailpointError(OSError):
     """Raised by a ``raise`` action. An OSError so injected transient
-    read failures ride the same retry handler as real I/O errors."""
+    read failures ride the same retry handler as real I/O errors.
+    ``name`` records WHICH failpoint fired — handlers that give one
+    site's injection special semantics (e.g. ``fail.stage.oom`` as a
+    simulated OOM) must match on it, not on whichever failpoint happens
+    to be armed."""
+
+    def __init__(self, msg: str, name: "str | None" = None):
+        super().__init__(msg)
+        self.name = name
 
 
 _lock = checked_lock("failpoints")
@@ -154,10 +186,15 @@ def fail_hit(name: str) -> bool:
                     return False
                 _counts[name] = seen + 1
         return True
+    if base == "sleep":  # latency injection: pause, then pass
+        import time
+
+        time.sleep(max(float(arg or 0), 0.0) / 1e3)
+        return False
     raise ValueError(f"unknown failpoint action {action!r} for {name!r}")
 
 
 def fail_point(name: str) -> None:
     """Evaluate a failpoint at a named site; no-op unless armed."""
     if fail_hit(name):
-        raise FailpointError(f"failpoint {name} triggered")
+        raise FailpointError(f"failpoint {name} triggered", name=name)

@@ -78,6 +78,13 @@ class PartitionMeta:
     #: aggregation-pushdown and sub-partition scan-pruning index.
     #: None = legacy v1 partition (no chunk stats recorded)
     chunks: "object | None" = None
+    #: the file generation that OWNS this partition (fs stores only;
+    #: stamped at manifest load and flush-publish). Reads resolve the
+    #: partition file through this, not the type's CURRENT generation,
+    #: so a scan iterating a pre-flush snapshot keeps reading its own
+    #: generation's files (and fails loudly once they are GC'd) instead
+    #: of silently mixing generations. None = legacy un-scoped files.
+    gen: "str | None" = None
 
     def overlaps(self, r: KeyRange) -> bool:
         return not (r.hi < self.key_lo or r.lo > self.key_hi)

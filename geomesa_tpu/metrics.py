@@ -344,6 +344,45 @@ agg_pushdown_chunks_refined = REGISTRY.counter(
     "boundary chunks that descended to row-level refinement",
 )
 
+# fault-tolerant serving (resilience.py): breaker state machines per
+# failure domain (0 closed / 1 half-open / 2 open; the keyed partition
+# domain exposes open counts via /readyz instead), serving-path
+# retries, degraded answers by reason, watchdog interventions, OOM
+# batch-halving recoveries and scheduler-worker crash-replacements
+resilience_breaker_state = REGISTRY.gauge(
+    "geomesa_resilience_breaker_state",
+    "circuit-breaker state per domain (0=closed 1=half-open 2=open)",
+)
+resilience_breaker_transitions = REGISTRY.counter(
+    "geomesa_resilience_breaker_transitions_total",
+    "circuit-breaker state transitions (domain, to)",
+)
+resilience_retries = REGISTRY.counter(
+    "geomesa_resilience_retries_total",
+    "serving-path retries of retryable faults (by domain)",
+)
+resilience_degraded = REGISTRY.counter(
+    "geomesa_resilience_degraded_total",
+    "requests answered degraded, by (bounded) reason",
+)
+resilience_watchdog_timeouts = REGISTRY.counter(
+    "geomesa_resilience_watchdog_timeouts_total",
+    "stuck device launches failed by the scheduler watchdog",
+)
+resilience_oom_recoveries = REGISTRY.counter(
+    "geomesa_resilience_oom_recoveries_total",
+    "staging/HBM OOMs recovered by halving the scan batch",
+)
+sched_worker_failures = REGISTRY.counter(
+    "geomesa_sched_worker_failures_total",
+    "scheduler worker crashes survived (requests failed typed, worker "
+    "kept serving)",
+)
+sched_drains = REGISTRY.counter(
+    "geomesa_sched_drains_total",
+    "graceful drains completed (admission stopped, in-flight finished)",
+)
+
 # per-request tracing (tracing.py): how many traces the ring retained
 # (head-sampled or slow-captured) and how many crossed the slow-query
 # threshold (trace.slow_ms) — the rate the slow-query log grows at

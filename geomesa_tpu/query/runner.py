@@ -84,10 +84,73 @@ def _device_trace_ctx():
     return device_trace(log_dir)
 
 
+#: OOM-recovery recursion bound: halving a run more times than this
+#: means the device cannot hold even a sliver — give up loudly
+_MAX_OOM_SPLITS = 8
+
+
+def _scan_run(built, compiled, jitted, start: int, stop: int,
+              depth: int = 0) -> np.ndarray:
+    """One staged device launch over rows [start, stop) returning the
+    fetched mask. Staging/HBM OOM (or the ``fail.stage.oom`` injection)
+    recovers by HALVING the run and retrying each half — a transient
+    memory squeeze (concurrent staging, fragmentation) costs extra
+    launches, not the query; anything else propagates to the fault
+    taxonomy upstream."""
+    from geomesa_tpu.failpoints import FailpointError, fail_point
+    from geomesa_tpu.tracing import span
+
+    try:
+        with span(
+            "device.launch", rows=int(stop - start)
+        ), _device_trace_ctx():
+            fail_point("fail.device.launch")
+            fail_point("fail.stage.oom")
+            cols = stage_columns(
+                built.batch, compiled.device_cols, start, stop
+            )
+            return np.asarray(jitted(cols))  # lint: disable=GT004(the mask fetch IS the launch's intended sync point -- one per contiguous run, not per row)
+    except Exception as e:
+        from geomesa_tpu import resilience
+
+        # fail.stage.oom's FailpointError SIMULATES an OOM at this site;
+        # a real one surfaces as RESOURCE_EXHAUSTED / MemoryError. Match
+        # on WHICH failpoint fired — fail.device.launch raises the same
+        # type here and must take the launch-failure path, not halving
+        oom = resilience.is_oom(e) or (
+            isinstance(e, FailpointError)
+            and getattr(e, "name", None) == "fail.stage.oom"
+        )
+        if oom and resilience.enabled() and stop - start > 1 \
+                and depth < _MAX_OOM_SPLITS:
+            from geomesa_tpu import metrics
+
+            metrics.resilience_oom_recoveries.inc()
+            mid = (start + stop) // 2
+            return np.concatenate([
+                _scan_run(built, compiled, jitted, start, mid, depth + 1),
+                _scan_run(built, compiled, jitted, mid, stop, depth + 1),
+            ])
+        if (
+            resilience.degrade_allowed()
+            and resilience.classify(e) != resilience.FATAL
+        ):
+            # device rung unavailable (launch failed / stuck / OOM too
+            # small to split): evaluate the SAME predicate on the host
+            # rows — exact, just slower — so the store scan path keeps
+            # answering with a dead accelerator. The residual re-applies
+            # downstream; it is a subset of the full host predicate, so
+            # the double application is idempotent.
+            resilience.note_degraded(
+                "device-oom" if oom else "device-launch-failed"
+            )
+            rows = built.batch.take(np.arange(start, stop))
+            return np.asarray(compiled.host_mask(rows), dtype=bool)
+        raise
+
+
 def _run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
     import jax
-
-    from geomesa_tpu.tracing import span
 
     parts = built.prune(plan.ranges)
     compiled = plan.compiled
@@ -103,13 +166,7 @@ def _run_query(built: BuiltIndex, plan: QueryPlan) -> QueryResult:
             if use_device:
                 # one span per kernel launch: stage + dispatch + the
                 # mask fetch (np.asarray is the sync point)
-                with span(
-                    "device.launch", rows=int(stop - start)
-                ), _device_trace_ctx():
-                    cols = stage_columns(
-                        built.batch, compiled.device_cols, start, stop
-                    )
-                    mask = np.asarray(jitted(cols))  # lint: disable=GT004(the mask fetch IS the launch's intended sync point -- one per contiguous run, not per row)
+                mask = _scan_run(built, compiled, jitted, start, stop)
             else:
                 mask = np.ones(stop - start, dtype=bool)
             idx = np.nonzero(mask)[0]

@@ -30,6 +30,22 @@ accelerator):
   request already executing runs to completion — device launches are
   not cancellable mid-flight.
 
+- **Failure domains (resilience.py).** Every claimed group is tracked
+  in-flight; a watchdog thread fails groups stuck past
+  ``resilience.launch.timeout.s`` with :class:`LaunchStuckError`
+  (records a device-breaker failure) and REPLACES the wedged worker —
+  a hung device launch costs one abandoned thread, not a scheduler
+  lane. A worker-level crash (``fail.sched.worker``) fails its group's
+  unfinished requests typed and the worker keeps serving. Completion
+  is idempotent: between the watchdog, the crash handler and normal
+  execution every request gets EXACTLY one response.
+
+- **Adaptive Retry-After.** 429 rejections carry a Retry-After derived
+  from live queue depth and an EWMA of per-request service time
+  (depth x service / workers), jittered 0.75-1.25x so a synchronized
+  client fleet de-correlates instead of re-spiking admission; the
+  static ``sched.retry.after.s`` is only the no-data fallback.
+
 Observability: queue depth, wait time, launches, fusion factor
 (queries / launches), rejections and expirations — exported through
 :mod:`geomesa_tpu.metrics` and the server's ``/stats/sched`` endpoint.
@@ -37,10 +53,13 @@ Observability: queue depth, wait time, launches, fusion factor
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass
+
+_retry_rng = random.Random()  # Retry-After jitter (de-correlates clients)
 
 LANE_INTERACTIVE = "interactive"
 LANE_BATCH = "batch"
@@ -107,13 +126,15 @@ class _Request:
     __slots__ = (
         "fn", "fuse", "lane", "tenant", "deadline", "enqueued",
         "event", "result", "error", "state", "ctx", "t0_perf",
+        "degraded", "device",
     )
 
-    def __init__(self, fn, fuse, lane, tenant, deadline):
-        from geomesa_tpu import tracing
+    def __init__(self, fn, fuse, lane, tenant, deadline, device=False):
+        from geomesa_tpu import resilience, tracing
 
         self.fn = fn
         self.fuse = fuse
+        self.device = device
         self.lane = lane
         self.tenant = tenant
         self.deadline = deadline
@@ -127,6 +148,10 @@ class _Request:
         # land in the submitting request's trace, and the queue-wait +
         # execute spans fan out to every rider of a fused launch
         self.ctx = tracing.capture()
+        # the submitter's degradation collector rides the same way, so
+        # a degraded note from work on a scheduler thread lands in the
+        # submitting request's X-Degraded header / audit event
+        self.degraded = resilience.capture_degraded()
         self.t0_perf = time.perf_counter()
 
 
@@ -153,8 +178,17 @@ class QueryScheduler:
         self.fused_queries = 0
         self.rejected = 0
         self.expired = 0
+        self.worker_failures = 0  # crashes survived (group failed typed)
+        self.watchdog_timeouts = 0  # stuck launches failed + replaced
         self._wait_sum = 0.0
+        self._svc_ewma = None  # EWMA per-request service seconds
         self._launch_seq = 0  # device-launch ids for trace tagging
+        # in-flight groups for the launch watchdog: token ->
+        # [group, started_monotonic, abandoned]; abandoned entries were
+        # failed by the watchdog — their (wedged) worker must neither
+        # finish the requests again nor retire the running count twice
+        self._inflight: dict = {}
+        self._inflight_seq = 0
         self._workers = [
             threading.Thread(
                 target=self._worker, daemon=True, name=f"sched-worker-{i}"
@@ -163,6 +197,10 @@ class QueryScheduler:
         ]
         for w in self._workers:
             w.start()
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, daemon=True, name="sched-watchdog"
+        )
+        self._watchdog.start()
 
     # -- submission --------------------------------------------------------
 
@@ -173,14 +211,21 @@ class QueryScheduler:
         lane: str = LANE_INTERACTIVE,
         tenant: str = "",
         deadline_ms=_USE_DEFAULT,
+        device=None,
     ) -> _Request:
         """Admit one request (non-blocking). ``fn`` is the zero-arg
         serial execution; ``fuse`` an optional FusableQuery the
         micro-batcher may fold into a shared launch (``fn`` defaults to
         its serial form). ``deadline_ms`` unset applies the config
         default; an explicit None means no deadline (bulk producers).
-        Raises :class:`RejectedError` when the queue is full. Wait for
-        the result with :meth:`wait`."""
+        ``device`` marks the work a device launch — the stuck-launch
+        watchdog only arms for device groups (a long host/store scan is
+        slow, not stuck, and must not charge the device breaker); unset,
+        it is inferred from ``fuse`` (fused queries are launches by
+        construction). Raises :class:`RejectedError` when the queue is
+        full. Wait for the result with :meth:`wait`."""
+        if device is None:
+            device = fuse is not None
         if fuse is not None and not fuse.fusable:
             if fn is None:
                 fn = fuse.run_serial
@@ -198,7 +243,9 @@ class QueryScheduler:
             if deadline_ms is not None
             else None
         )
-        req = _Request(fn, fuse, lane, str(tenant or ""), deadline)
+        req = _Request(
+            fn, fuse, lane, str(tenant or ""), deadline, device=bool(device)
+        )
         from geomesa_tpu import metrics
 
         with self._cv:
@@ -207,7 +254,7 @@ class QueryScheduler:
             if self._queued >= self.config.max_queue:
                 self.rejected += 1
                 metrics.sched_rejected.inc()
-                raise RejectedError(self.config.retry_after_s)
+                raise RejectedError(self._retry_after_locked())
             self._queues[req.lane].setdefault(
                 req.tenant, deque()
             ).append(req)
@@ -253,14 +300,37 @@ class QueryScheduler:
         lane: str = LANE_INTERACTIVE,
         tenant: str = "",
         deadline_ms=_USE_DEFAULT,
+        device=None,
     ):
         """submit() + wait() in one call — the serving entry point."""
         return self.wait(
             self.submit(
                 fn=fn, fuse=fuse, lane=lane, tenant=tenant,
-                deadline_ms=deadline_ms,
+                deadline_ms=deadline_ms, device=device,
             )
         )
+
+    def _retry_after_locked(self) -> float:
+        """Retry-After for a 429, from ACTUAL queue pressure: estimated
+        drain time of the current queue (depth x EWMA service time /
+        workers), jittered 0.75-1.25x so synchronized clients that all
+        got shed together do not all come back together. Falls back to
+        the static ``sched.retry.after.s`` before any request has been
+        measured; clamped to [0.05s, 30s]."""
+        base = self.config.retry_after_s
+        svc = self._svc_ewma
+        if svc is not None and svc > 0:
+            est = self._queued * svc / max(self.config.max_inflight, 1)
+            est = max(est, base * 0.25)  # never promise a near-0 comeback
+        else:
+            est = base
+        est *= 0.75 + 0.5 * _retry_rng.random()
+        return min(max(est, 0.05), 30.0)
+
+    def queue_pressure(self) -> "tuple[int, int]":
+        """(queued, max_queue) — what the brownout ladder consults."""
+        with self._cv:
+            return (self._queued, self.config.max_queue)
 
     # -- queue internals (call under self._cv) -----------------------------
 
@@ -365,17 +435,152 @@ class QueryScheduler:
                                 req.fuse.key, cfg.max_fusion - len(group)
                             )
                         group += more
+            token = self._track_start(group)
             try:
+                from geomesa_tpu.failpoints import fail_point
+
+                fail_point("fail.sched.worker")
                 self._execute(group)
+            except Exception as e:
+                # worker-level crash (a bug outside the per-request
+                # try, or the fail.sched.worker injection): the group
+                # must neither hang nor vanish — fail every unfinished
+                # request typed, count it, and KEEP this worker serving
+                from geomesa_tpu import metrics
+
+                with self._cv:
+                    self.worker_failures += 1
+                metrics.sched_worker_failures.inc()
+                for r in group:
+                    self._finish(r, error=e)
             finally:
                 # the whole group was claimed (queued -> running) above;
                 # retire it and wake close(), which drains on this count
+                # — unless the watchdog already abandoned this worker
+                # (it retired the count and failed the requests); then
+                # a replacement is serving and this thread exits
+                if self._track_end(token, group):
+                    return
+
+    def _track_start(self, group) -> int:
+        with self._cv:
+            self._inflight_seq += 1
+            token = self._inflight_seq
+            # [group, last-progress time, done-rider count]: the
+            # watchdog restarts the stall clock whenever another rider
+            # completes, so it measures the CURRENT launch's stall, not
+            # the group's cumulative wall-clock (a serially executed
+            # fusion-declined group is slow, not stuck)
+            self._inflight[token] = [group, time.monotonic(), 0]
+        return token
+
+    def _track_end(self, token: int, group) -> bool:
+        """Retire a tracked group; True when the watchdog abandoned it
+        — it popped the entry when it failed the group, so a missing
+        entry tells the wedged thread to exit instead of
+        double-retiring."""
+        with self._cv:
+            entry = self._inflight.pop(token, None)
+            abandoned = entry is None
+            if not abandoned:
+                self._running -= len(group)
+            self._cv.notify_all()
+        return abandoned
+
+    def _launch_timeout_s(self) -> float:
+        from geomesa_tpu import resilience
+        from geomesa_tpu.conf import sys_prop
+
+        if not resilience.enabled():
+            return 0.0
+        return float(sys_prop("resilience.launch.timeout.s"))
+
+    def _watchdog_loop(self) -> None:
+        """Fail DEVICE groups whose CURRENT launch is stuck past the
+        launch-timeout budget and replace their (wedged, uncancellable)
+        workers, so a hung device launch costs one abandoned thread
+        instead of a scheduler lane. The stall clock restarts whenever
+        a rider of the group completes — a fusion-declined group run
+        serially makes progress launch by launch and is slow, not
+        stuck. Host/store groups are exempt: a legitimately long scan
+        (a large export) would be falsely failed by any launch-scale
+        timeout and would charge the DEVICE breaker for work that never
+        touched the device — a genuinely wedged host scan instead costs
+        its worker, the pre-watchdog status quo. Runs until shutdown."""
+        from geomesa_tpu import metrics, resilience
+
+        while True:
+            stuck: list = []
+            with self._cv:
+                if self._stop:
+                    return
+                timeout = self._launch_timeout_s()
+                if timeout > 0:
+                    now = time.monotonic()
+                    for token, entry in list(self._inflight.items()):
+                        group, started, done0 = entry
+                        done = sum(
+                            1 for r in group if r.state == "done"
+                        )
+                        if done != done0:  # progress: restart the clock
+                            entry[2] = done
+                            entry[1] = started = now
+                        if (
+                            now - started > timeout
+                            and any(r.device for r in group)
+                        ):
+                            # pop NOW: the wedged worker may never
+                            # return to retire the entry via _track_end,
+                            # and a leaked entry would pin the group's
+                            # closures/results for the process lifetime
+                            del self._inflight[token]
+                            self._running -= len(group)
+                            self.watchdog_timeouts += 1
+                            stuck.append(group)
+                    if stuck:
+                        self._cv.notify_all()  # close() drains on running
+                self._cv.wait(timeout=0.25)
+            for group in stuck:
+                metrics.resilience_watchdog_timeouts.inc()
+                resilience.device_breaker().record_failure()
+                for r in group:
+                    self._finish(r, error=resilience.LaunchStuckError(
+                        "device launch exceeded "
+                        f"resilience.launch.timeout.s ({timeout:g}s); "
+                        "worker abandoned and replaced"
+                    ))
+            if stuck:
+                replacements = [
+                    threading.Thread(
+                        target=self._worker, daemon=True,
+                        name="sched-worker-replacement",
+                    )
+                    for _ in stuck
+                ]
                 with self._cv:
-                    self._running -= len(group)
-                    self._cv.notify_all()
+                    # prune dead threads while adding replacements: the
+                    # list must not grow without bound over a long-lived
+                    # server's lifetime of watchdog interventions
+                    self._workers = [
+                        w for w in self._workers if w.is_alive()
+                    ] + replacements
+                for w in replacements:
+                    w.start()
+
+    def _observe_service_locked(self, dur_s: float, n: int) -> None:
+        """Fold one execution's per-request service time into the EWMA
+        the adaptive Retry-After estimate drains the queue with."""
+        if n <= 0 or dur_s < 0:
+            return
+        per = dur_s / n
+        self._svc_ewma = (
+            per
+            if self._svc_ewma is None
+            else 0.8 * self._svc_ewma + 0.2 * per
+        )
 
     def _execute(self, group: "list[_Request]") -> None:
-        from geomesa_tpu import metrics, tracing
+        from geomesa_tpu import metrics, resilience, tracing
         from geomesa_tpu.sched.fusion import execute_group
 
         now = time.monotonic()
@@ -412,7 +617,8 @@ class QueryScheduler:
                 # belong to one trace: the head rider's. Every rider
                 # still gets the flat sched.execute span below, tagged
                 # with the shared launch id.
-                with tracing.attach(live[0].ctx):
+                with tracing.attach(live[0].ctx), \
+                        resilience.attach_degraded(live[0].degraded):
                     fused = execute_group([r.fuse for r in live])
             except Exception:
                 fused = None  # any fusion failure: serial is always exact
@@ -431,6 +637,8 @@ class QueryScheduler:
             metrics.sched_queries.inc(len(live))
             metrics.sched_fused.inc(len(live))
             dur = time.perf_counter() - now_perf
+            with self._cv:
+                self._observe_service_locked(dur, len(live))
             for r, v in zip(live, fused):
                 tracing.record_span(
                     r.ctx, "sched.execute", now_perf, dur,
@@ -444,23 +652,44 @@ class QueryScheduler:
             with self._cv:
                 self._launch_seq += 1
                 launch_id = self._launch_seq
+            t_run = time.perf_counter()
             try:
                 # attach the rider's context so the work's own spans
-                # (plan / device.launch / store reads) nest in its trace
-                with tracing.attach(r.ctx), tracing.span(
-                    "sched.execute", launch=launch_id, fused=1,
-                    lane=r.lane,
-                ):
+                # (plan / device.launch / store reads) nest in its
+                # trace, and its degradation collector so degraded
+                # notes reach its response/audit stamping
+                with tracing.attach(r.ctx), \
+                        resilience.attach_degraded(r.degraded), \
+                        tracing.span(
+                            "sched.execute", launch=launch_id, fused=1,
+                            lane=r.lane,
+                        ):
                     res = r.fn()
             except Exception as e:  # the submitter re-raises it
+                with self._cv:
+                    self._observe_service_locked(
+                        time.perf_counter() - t_run, 1
+                    )
                 self._finish(r, error=e)
                 continue
+            with self._cv:
+                self._observe_service_locked(
+                    time.perf_counter() - t_run, 1
+                )
             self._finish(r, result=res)
 
     def _finish(self, req: _Request, result=None, error=None) -> None:
-        req.result = result
-        req.error = error
-        req.state = "done"
+        """Complete a request EXACTLY ONCE: between normal execution,
+        the worker crash handler, the watchdog and queue-expiry, the
+        first completion wins and every later one is a no-op — a
+        submitter can never observe two results (or a result mutating
+        under it after the event fired)."""
+        with self._cv:
+            if req.state == "done":
+                return
+            req.result = result
+            req.error = error
+            req.state = "done"
         req.event.set()
 
     def _observe_expired(self) -> None:
@@ -490,6 +719,11 @@ class QueryScheduler:
                 ),
                 "rejected": self.rejected,
                 "expired": self.expired,
+                "worker_failures": self.worker_failures,
+                "watchdog_timeouts": self.watchdog_timeouts,
+                "retry_after_estimate_s": round(
+                    self._retry_after_locked(), 4
+                ),
                 "avg_wait_ms": (
                     round(self._wait_sum / queries * 1e3, 3)
                     if queries
@@ -505,12 +739,18 @@ class QueryScheduler:
         ``make_server``'s shutdown calls this. Idempotent; requests
         still unfinished at the timeout are failed by the shutdown."""
         deadline = time.monotonic() + timeout
+        drained = False
         with self._cv:
             while (self._queued or self._running) and not self._stop:
                 rem = deadline - time.monotonic()
                 if rem <= 0:
                     break
                 self._cv.wait(timeout=min(rem, 0.25))
+            drained = not (self._queued or self._running)
+        if drained:
+            from geomesa_tpu import metrics
+
+            metrics.sched_drains.inc()
         self.shutdown(timeout=max(deadline - time.monotonic(), 0.1))
 
     def shutdown(self, timeout: float = 5.0) -> None:
@@ -528,8 +768,17 @@ class QueryScheduler:
             self._finish(
                 r, error=RuntimeError("scheduler shut down")
             )
-        for w in self._workers:
-            w.join(timeout=timeout)
+        # one SHARED deadline for all joins: a watchdog-abandoned
+        # (wedged) worker never exits, and paying the full timeout per
+        # wedged thread would stretch shutdown by N x timeout
+        join_deadline = time.monotonic() + timeout
+        with self._cv:
+            workers = list(self._workers)
+        for w in workers:
+            w.join(timeout=max(join_deadline - time.monotonic(), 0.0))
+        self._watchdog.join(
+            timeout=max(join_deadline - time.monotonic(), 0.1)
+        )
 
     def __enter__(self):
         return self

@@ -26,6 +26,12 @@ Endpoints (all GET):
 - ``/proximity/<type>?points=x,y;...&distance=&cql=`` -- features near
   any input point, with distances (ProximitySearchProcess analog)
 - ``/metrics``                      -- Prometheus exposition text
+- ``/healthz``                      -- liveness: 200 while the process
+  is up, draining included (only readiness flips on drain)
+- ``/readyz``                       -- readiness: breaker states per
+  failure domain, scheduler pressure, degraded domains; 503 while
+  draining (load balancers pull the instance), 200 otherwise — a
+  DEGRADED instance keeps serving and says so in the body
 - ``/stats/sched``                  -- device query scheduler counters
   (sched mode: queue depth, wait time, fusion factor, rejections)
 - ``/stats/store``                  -- store durability/integrity snapshot
@@ -62,31 +68,62 @@ SNAPSHOT: after writing to the backing store, hit ``/refresh/<type>``
 (or restart) to restage — the durable store stays the source of truth,
 exactly the DeviceIndex contract.
 
-Errors return JSON ``{"error": ...}`` with 4xx/5xx status.
+Fault tolerance (resilience.py, ISSUE 7): device-rung work (resident
+count/features/stats/density) runs behind the ``device`` circuit
+breaker with jittered retries of transient faults; when the breaker is
+open, a launch fails or the resident cache cannot stage, requests fall
+down the degradation ladder (resident -> store scan; exact -> chunk
+pre-aggregates under brownout) instead of failing — every degraded
+response carries an ``X-Degraded: <reason,...>`` header and the audit
+event records the same reasons. Shutdown DRAINS: admission stops
+(query endpoints 503 + Retry-After, ``/readyz`` flips 503 while
+``/healthz`` stays 200 so the orchestrator de-routes without killing),
+in-flight scheduler work finishes, audit/slow logs flush, then the
+accept loop stops.
+
+Errors return JSON ``{"error": ...}`` with 4xx/5xx status; 429/504/5xx
+responses carry ``X-Request-Id`` too, and shed / deadline-expired
+requests are stamped into the audit log (outcome field).
 """
 
 from __future__ import annotations
 
 import io
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, unquote, urlparse
 
 
 class _GeomesaHTTPServer(ThreadingHTTPServer):
-    """ThreadingHTTPServer whose ``shutdown`` also DRAINS the query
-    scheduler (``QueryScheduler.close``): stopping the accept loop but
-    leaving scheduler workers mid-device-launch lets a CLI/test process
-    exit with work half-executed -- the drain is bounded and joins the
-    worker threads."""
+    """ThreadingHTTPServer whose ``shutdown`` is a DRAINING shutdown:
+    admission stops first (the ``draining`` event flips query endpoints
+    to 503 + Retry-After and ``/readyz`` to 503; ``/healthz`` liveness
+    stays 200 so the orchestrator de-routes, not kills), in-flight
+    scheduler work finishes (``QueryScheduler.close`` — bounded, joins
+    the workers; leaving workers mid-device-launch lets a CLI/test
+    process exit with work half-executed), the audit and slow-query
+    logs flush, and only then does the accept loop stop."""
 
     scheduler = None
+    store = None  # wired by make_server (audit flush at drain)
+
+    def __init__(self, *args, **kwargs):
+        self.draining = threading.Event()
+        super().__init__(*args, **kwargs)
 
     def shutdown(self):
-        super().shutdown()
+        self.draining.set()  # stop admission BEFORE finishing in-flight
         if self.scheduler is not None:
             self.scheduler.close(timeout=5.0)
+        aw = getattr(self.store, "audit_writer", None)
+        if aw is not None:
+            try:
+                aw.flush()
+            except Exception:  # flush is best-effort on the way down
+                pass
+        super().shutdown()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -101,13 +138,42 @@ class _Handler(BaseHTTPRequestHandler):
         flavor: its internal lock serializes refresh against concurrent
         handler-thread scans. The dict read is the GIL-safe fast path;
         the construction lock only guards first-touch builds (a duplicate
-        build would stage the whole dataset into device memory twice)."""
+        build would stage the whole dataset into device memory twice).
+
+        First-touch builds run behind the ``cache`` circuit breaker
+        (resilience.py): a staging failure (device OOM, store fault)
+        degrades the request to the store path — returns None, stamped
+        — instead of 500ing, and repeated failures open the breaker so
+        requests stop paying the staging attempt until its half-open
+        probe. A breaker-gated failure never evicts an ALREADY-staged
+        healthy index (the dict hit above short-circuits)."""
         if not self.resident:
             return None
         di = self._resident_cache.get(type_name)
         if di is not None:
             return di
-        return self._build_locked(type_name)[0]
+        from geomesa_tpu import resilience
+
+        if not resilience.degrade_allowed():
+            return self._build_locked(type_name)[0]
+        br = resilience.cache_breaker()
+        if not br.allow():
+            resilience.note_degraded("cache-breaker-open")
+            return None
+        try:
+            di = self._build_locked(type_name)[0]
+        except Exception as e:
+            if resilience.classify(e) == resilience.FATAL:
+                # unknown type / bad request: surface, not degrade —
+                # and free a held half-open probe slot (no health
+                # signal either way)
+                br.release_probe()
+                raise
+            br.record_failure()
+            resilience.note_degraded("resident-unavailable")
+            return None
+        br.record_success()
+        return di
 
     @staticmethod
     def _loose(q: dict) -> "bool | None":
@@ -156,6 +222,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             from geomesa_tpu.audit import AuditedEvent
             from geomesa_tpu.metrics import queries_run, query_seconds
+            from geomesa_tpu.resilience import current_degraded
             from geomesa_tpu.tracing import current_trace_id
 
             queries_run.inc(store="resident", type=type_name)
@@ -166,6 +233,7 @@ class _Handler(BaseHTTPRequestHandler):
                     store="resident", type_name=type_name, filter=cql,
                     planning_ms=0.0, scanning_ms=(t1 - t0) * 1e3, hits=hits,
                     trace_id=current_trace_id(),
+                    degraded=",".join(current_degraded()),
                 ))
         except Exception:  # pragma: no cover - observability must not break
             pass
@@ -184,6 +252,22 @@ class _Handler(BaseHTTPRequestHandler):
             # was retained — clients correlate logs by it either way
             self.send_header("X-Request-Id", tr.trace_id)
             tr.root.set(status=code)
+        else:
+            # untraced paths (parse errors, monitoring endpoints) still
+            # echo a sanitized inbound id: a client correlating a 400/
+            # 429/5xx against its own logs needs it most on errors
+            from geomesa_tpu.tracing import _clean_id
+
+            rid = _clean_id(self.headers.get("X-Request-Id"))
+            if rid:
+                self.send_header("X-Request-Id", rid)
+        reasons = getattr(self, "_degraded", None)
+        if reasons:
+            # the degradation contract: an approximate or partial answer
+            # is never silent — the client can see (and log) the rung
+            self.send_header("X-Degraded", ",".join(reasons))
+            if tr is not None:
+                tr.root.set(degraded=",".join(reasons))
         for name, value in headers:
             self.send_header(name, value)
         self.end_headers()
@@ -192,7 +276,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _json(self, code: int, doc) -> None:
         self._send(code, json.dumps(doc).encode("utf-8"), "application/json")
 
-    def _sched_run(self, q: dict, fn=None, fuse=None):
+    def _sched_run(self, q: dict, fn=None, fuse=None, device=None):
         """Route one unit of query work through the device query
         scheduler when one is configured (admission control, deadlines,
         micro-batch fusion for compatible resident queries); direct
@@ -216,8 +300,68 @@ class _Handler(BaseHTTPRequestHandler):
             fuse=fuse,
             lane=q.get("lane", "interactive"),
             tenant=tenant or "",
+            device=device,
             **kw,
         )
+
+    def _degradable(self, q: dict, reason: str, fallback, fn=None,
+                    fuse=None):
+        """Run device-rung work with the full fault discipline: the
+        ``device`` circuit breaker gates entry (open -> straight to the
+        fallback rung, stamped — nobody queues behind a dead device),
+        transient faults retry with jittered backoff
+        (``resilience.retries``), and a non-retryable / still-failing
+        launch falls to ``fallback`` with ``reason`` noted. Flow-control
+        signals (429/504) and FATAL faults (bad requests) always
+        propagate — backpressure and errors are part of the client
+        contract, not something to degrade away. The fallback runs
+        OUTSIDE the scheduler by design: it is the emergency rung, and
+        the scheduler meters the device it no longer touches."""
+        from geomesa_tpu import resilience
+        from geomesa_tpu.sched import DeadlineExpired, RejectedError
+
+        if not resilience.enabled():
+            return self._sched_run(q, fn=fn, fuse=fuse, device=True)
+        br = resilience.device_breaker()
+        can_fall = fallback is not None and resilience.degrade_allowed()
+        if can_fall and not br.allow():
+            resilience.note_degraded("device-breaker-open")
+            return fallback()
+        try:
+            res = resilience.retry_call(
+                lambda: self._sched_run(q, fn=fn, fuse=fuse, device=True),
+                domain="device",
+            )
+        except (RejectedError, DeadlineExpired):
+            # a shed/expired half-open probe carried no health signal:
+            # free the slot so the next caller probes immediately, or a
+            # saturated queue would pin the breaker half-open (and all
+            # traffic on the degraded rung) one full cooldown per shed
+            if can_fall:
+                br.release_probe()
+            raise
+        except Exception as e:
+            if resilience.classify(e) == resilience.FATAL:
+                # a bad REQUEST says nothing about device health: free
+                # a held half-open probe slot instead of pinning the
+                # breaker (and all traffic on the degraded rung) for
+                # another cooldown
+                if can_fall:
+                    br.release_probe()
+                raise
+            stuck = isinstance(e, resilience.LaunchStuckError)
+            if not stuck:
+                # the watchdog already charged the stuck launch to the
+                # breaker — once per FAULT; re-recording here would add
+                # one count per fused rider and open the breaker after
+                # a single wedged group
+                br.record_failure()
+            if not can_fall:
+                raise
+            resilience.note_degraded("launch-stuck" if stuck else reason)
+            return fallback()
+        br.record_success()
+        return res
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib API)
         try:
@@ -233,7 +377,7 @@ class _Handler(BaseHTTPRequestHandler):
         # /stats/<type> with a real type name IS a query and stays
         # traced; the same disambiguation _dispatch routes by.
         untraced = (
-            parts and parts[0] in ("metrics", "debug")
+            parts and parts[0] in ("metrics", "debug", "healthz", "readyz")
         ) or (
             parts == ["stats", "sched"] and self.scheduler is not None
         ) or (
@@ -242,19 +386,50 @@ class _Handler(BaseHTTPRequestHandler):
         )
         if untraced:
             self._trace = None
+            self._degraded = None
             return self._dispatch_safe(url, parts, q)
+        from geomesa_tpu import resilience
         from geomesa_tpu.tracing import TRACER
 
         # error handling lives INSIDE the trace: the error response is
         # sent (status attr stamped, its time counted) before the trace
-        # finishes and retention / the slow-query log fire
+        # finishes and retention / the slow-query log fire. The
+        # degradation collector wraps the same scope: any layer that
+        # answers below the requested rung notes a reason here, and the
+        # response/audit stamping reads it back.
         with TRACER.trace(
             f"GET {url.path}",
             trace_id=self.headers.get("X-Request-Id"),
             attrs={"path": url.path, "query": url.query[:512]},
-        ) as tr:
+        ) as tr, resilience.collect_degraded() as reasons:
             self._trace = tr
+            self._degraded = reasons
             return self._dispatch_safe(url, parts, q)
+
+    def _audit_outcome(self, parts: list, q: dict, outcome: str) -> None:
+        """Stamp a shed (429) or deadline-expired (504) request into the
+        audit log — operators sizing admission need the requests that
+        did NOT run, not just the ones that did. Best-effort: auditing
+        must never break the error response it annotates."""
+        try:
+            aw = getattr(self.store, "audit_writer", None)
+            if aw is None:
+                return
+            from geomesa_tpu.audit import AuditedEvent
+            from geomesa_tpu.resilience import current_degraded
+            from geomesa_tpu.tracing import current_trace_id
+
+            aw.write(AuditedEvent(
+                store="server",
+                type_name=parts[1] if len(parts) > 1 else "",
+                filter=q.get("cql", ""),
+                hits=0,
+                trace_id=current_trace_id(),
+                outcome=outcome,
+                degraded=",".join(current_degraded()),
+            ))
+        except Exception:  # pragma: no cover - observability must not break
+            pass
 
     def _dispatch_safe(self, url, parts: list, q: dict) -> None:
         try:
@@ -270,20 +445,75 @@ class _Handler(BaseHTTPRequestHandler):
 
             if isinstance(e, RejectedError):
                 # backpressure: shed load explicitly instead of queueing
-                # unboundedly; clients should honor Retry-After
+                # unboundedly; clients should honor Retry-After (derived
+                # from live queue depth + drain rate, jittered — see
+                # QueryScheduler._retry_after_locked)
+                self._audit_outcome(parts, q, "shed")
                 return self._send(
                     429,
                     json.dumps({"error": str(e)}).encode("utf-8"),
                     "application/json",
-                    headers=(("Retry-After", f"{e.retry_after_s:g}"),),
+                    # RFC 9110 delta-seconds is integral: standard client
+                    # retry machinery (urllib3 et al.) rejects fractions.
+                    # Ceil keeps the estimate an upper bound; the jitter
+                    # survives rounding at multi-second queue depths
+                    headers=(
+                        ("Retry-After", str(math.ceil(e.retry_after_s))),
+                    ),
                 )
             if isinstance(e, DeadlineExpired):
+                self._audit_outcome(parts, q, "deadline-expired")
                 return self._json(504, {"error": str(e)})
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _draining(self) -> bool:
+        ev = getattr(self.server, "draining", None)
+        return ev is not None and ev.is_set()
+
+    def _healthz(self) -> None:
+        """Liveness: 200 for as long as the process is up — INCLUDING
+        while draining. Failing liveness makes an orchestrator KILL the
+        instance (restart, not de-route), which would lose exactly the
+        in-flight work the draining shutdown exists to finish; traffic
+        removal is ``/readyz``'s job, and it flips 503 the moment
+        draining starts."""
+        self._json(
+            200, {"status": "draining" if self._draining() else "ok"}
+        )
+
+    def _readyz(self) -> None:
+        """Readiness, driven by breaker state: the body reports every
+        failure domain's breaker, the open (unhealthy) domains and
+        scheduler queue pressure. A DEGRADED instance is still READY
+        (200) — it serves, just lower-rung, and says so; only draining
+        flips 503 (nothing new should be routed here)."""
+        from geomesa_tpu import resilience
+
+        breakers = resilience.snapshot()
+        degraded = sorted(
+            d for d, s in breakers.items()
+            if isinstance(s, dict) and s.get("state") != "closed"
+        )
+        if breakers.get("partition_open"):
+            degraded.append("partition")
+        doc = {
+            "ready": not self._draining(),
+            "draining": self._draining(),
+            "degraded_domains": degraded,
+            "breakers": breakers,
+        }
+        if self.scheduler is not None:
+            queued, max_queue = self.scheduler.queue_pressure()
+            doc["sched"] = {"queued": queued, "max_queue": max_queue}
+        self._json(200 if doc["ready"] else 503, doc)
 
     def _dispatch(self, url, parts: list, q: dict) -> None:
         if parts == ["capabilities"]:
             return self._capabilities()
+        if parts == ["healthz"]:
+            return self._healthz()
+        if parts == ["readyz"]:
+            return self._readyz()
         if parts == ["metrics"]:
             from geomesa_tpu.metrics import REGISTRY
 
@@ -304,6 +534,17 @@ class _Handler(BaseHTTPRequestHandler):
             "features", "count", "explain", "density", "stats",
             "refresh", "knn", "tube", "proximity",
         ):
+            if self._draining():
+                # admission is closed: a draining instance finishes
+                # what it has, it does not take on more
+                return self._send(
+                    503,
+                    json.dumps(
+                        {"error": "server is draining"}
+                    ).encode("utf-8"),
+                    "application/json",
+                    headers=(("Retry-After", "1"),),
+                )
             handler = getattr(self, f"_{parts[0]}")
             return handler(unquote(parts[1]), q)
         self._json(404, {"error": f"no such endpoint {url.path!r}"})
@@ -373,8 +614,15 @@ class _Handler(BaseHTTPRequestHandler):
 
             t0 = _time.perf_counter()
             cql = q.get("cql", "INCLUDE")
-            batch = self._sched_run(
-                q,
+            fell: list = []
+
+            def fallback():
+                # store rung: exact, audited by the store path itself
+                fell.append(True)
+                return self._query(type_name, q).batch
+
+            batch = self._degradable(
+                q, "device-launch-failed", fallback,
                 fuse=FusableQuery(
                     di, cql, "query",
                     loose=self._loose(q), auths=self._auths(q),
@@ -383,9 +631,10 @@ class _Handler(BaseHTTPRequestHandler):
             cap = self._cap(q)
             if cap is not None and len(batch) > cap:
                 batch = batch.take(np.arange(cap))
-            self._observe_resident(
-                type_name, cql, t0, _time.perf_counter(), len(batch)
-            )
+            if not fell:
+                self._observe_resident(
+                    type_name, cql, t0, _time.perf_counter(), len(batch)
+                )
         else:
             batch = self._sched_run(
                 q, fn=lambda: self._query(type_name, q).batch
@@ -505,17 +754,77 @@ class _Handler(BaseHTTPRequestHandler):
             extra={"proximity_distance_deg": [float(d) for d in dists]},
         )
 
+    def _agg_shaped(self, type_name: str, cql: str) -> bool:
+        """Pre-screen for the brownout rung: True when the filter is a
+        shape the chunk pre-aggregates can answer (bbox+time
+        conjunctions — `is_aggregate_shape`) AND the store actually has
+        chunk statistics for the type. Anything else would row-scan
+        inside store.count/density, and brownout runs on the HANDLER
+        thread outside scheduler admission precisely because it is
+        supposed to be near-free: an unmetered full scan there would
+        amplify the overload it exists to relieve."""
+        from geomesa_tpu.query.plan import Query, is_aggregate_shape
+
+        has_stats = getattr(self.store, "has_chunk_stats", None)
+        if has_stats is None or not has_stats(type_name):
+            return False  # v1/legacy/memory store: no pre-aggregates
+        try:
+            return bool(is_aggregate_shape(
+                Query(filter=cql).parsed(),
+                self.store.get_schema(type_name),
+            ))
+        except Exception:
+            return False
+
+    def _pushdown_eligible(self, q: dict) -> bool:
+        """May a count answer from ``store.count`` (chunk pre-aggregates
+        + internal row-scan fallback)? Caps and auths force the full
+        query path — the ONE eligibility rule for the store-rung
+        fallback, the brownout rung, and the non-resident route."""
+        return (
+            self._cap(q) is None
+            and not self._auths(q)
+            and hasattr(self.store, "count")
+        )
+
+    def _count_fallback(self, type_name: str, q: dict) -> int:
+        """Store-rung count: the chunk-pushdown path when eligible
+        (audited there), the full query path otherwise — exact either
+        way, just not device-resident."""
+        if self._pushdown_eligible(q):
+            return int(
+                self.store.count(type_name, q.get("cql", "INCLUDE"))
+            )
+        return len(self._query(type_name, q))
+
     def _count(self, type_name: str, q: dict) -> None:
         di = self._di(type_name)
         if di is not None:
             import time as _time
 
+            from geomesa_tpu import resilience
             from geomesa_tpu.sched import FusableQuery
 
             t0 = _time.perf_counter()
             cql = q.get("cql", "INCLUDE")
-            n = self._sched_run(
-                q,
+            if resilience.brownout(self.scheduler) and \
+                    self._pushdown_eligible(q) and \
+                    self._agg_shaped(type_name, cql):
+                # brownout rung: the admission queue is near its 429
+                # cliff — answer from the store's chunk pre-aggregates
+                # (exact; interior chunks never read) WITHOUT queueing
+                # another device launch behind the saturated scheduler
+                resilience.note_degraded("brownout-pushdown")
+                n = int(self.store.count(type_name, cql))
+                return self._json(200, {"count": n})
+            fell: list = []
+
+            def fallback():
+                fell.append(True)
+                return self._count_fallback(type_name, q)
+
+            n = self._degradable(
+                q, "device-launch-failed", fallback,
                 fuse=FusableQuery(
                     di, cql, "count",
                     loose=self._loose(q), auths=self._auths(q),
@@ -524,9 +833,12 @@ class _Handler(BaseHTTPRequestHandler):
             cap = self._cap(q)
             if cap is not None:
                 n = min(n, cap)  # the plain path counts the capped result
-            self._observe_resident(type_name, cql, t0, _time.perf_counter(), n)
+            if not fell:
+                self._observe_resident(
+                    type_name, cql, t0, _time.perf_counter(), n
+                )
             return self._json(200, {"count": n})
-        if self._cap(q) is None and not self._auths(q):
+        if self._pushdown_eligible(q):
             # store.count answers bbox+time counts from the v2 chunk
             # pre-aggregates (interior chunks never read) and falls back
             # to the row scan internally for anything else
@@ -560,20 +872,10 @@ class _Handler(BaseHTTPRequestHandler):
         spec = q.get("stats")
         if not spec:
             raise ValueError("stats endpoint needs stats=<Stat-DSL spec>")
-        def work():
-            di = self._di(type_name)
-            if di is not None:
-                import time as _time
 
-                t0 = _time.perf_counter()
-                cql = q.get("cql", "INCLUDE")
-                seq = di.stats(
-                    cql, spec, loose=self._loose(q), auths=self._auths(q)
-                )
-                self._observe_resident(
-                    type_name, cql, t0, _time.perf_counter(), 0
-                )
-                return seq
+        def store_work():
+            # store rung: run_stats consults the chunk-stat pushdown
+            # internally (PR 6) and row-scans what it cannot pre-answer
             from geomesa_tpu.process import run_stats
             from geomesa_tpu.query.plan import Query
 
@@ -587,7 +889,26 @@ class _Handler(BaseHTTPRequestHandler):
                 spec,
             )
 
-        seq = self._sched_run(q, fn=work)
+        di = self._di(type_name)
+        if di is not None:
+            import time as _time
+
+            def device_work():
+                t0 = _time.perf_counter()
+                cql = q.get("cql", "INCLUDE")
+                seq = di.stats(
+                    cql, spec, loose=self._loose(q), auths=self._auths(q)
+                )
+                self._observe_resident(
+                    type_name, cql, t0, _time.perf_counter(), 0
+                )
+                return seq
+
+            seq = self._degradable(
+                q, "device-launch-failed", store_work, fn=device_work
+            )
+        else:
+            seq = self._sched_run(q, fn=store_work)
         self._json(200, seq.to_json())
 
     def _explain(self, type_name: str, q: dict) -> None:
@@ -609,33 +930,62 @@ class _Handler(BaseHTTPRequestHandler):
         cql = q.get("cql", "INCLUDE")
         env = Envelope(*bbox)
 
-        def work():
-            di = self._di(type_name)
-            grid = None
-            if di is not None:
+        def store_work():
+            # store rung: process.density consults the chunk-histogram
+            # pushdown internally (PR 6 — mass-exact, cell placement
+            # within coarse-cell tolerance on aligned rasters), records
+            # its own metrics (observe_query) and honors the SAME auths
+            # the resident path would have
+            return density(
+                self.store, type_name, cql, env, width, height,
+                auths=self._auths(q),
+            )
+
+        di = self._di(type_name)
+        if di is not None:
+            from geomesa_tpu import resilience
+
+            if resilience.brownout(self.scheduler) and \
+                    self._agg_shaped(type_name, cql):
+                # brownout rung: heatmaps are the classic overload
+                # amplifier — answer from the chunk pre-aggregates
+                # (within the PR 6 parity bounds) without queueing
+                # another device launch behind the saturated scheduler
+                resilience.note_degraded("brownout-pushdown")
+                grid = store_work()
+            else:
                 import time as _time
 
-                t0 = _time.perf_counter()
-                grid = di.density(cql, env, width, height,
-                                  loose=self._loose(q), auths=self._auths(q))
-                if grid is not None:
-                    # unweighted: the grid mass IS the in-window hit count
+                def device_work():
+                    t0 = _time.perf_counter()
+                    grid = di.density(
+                        cql, env, width, height,
+                        loose=self._loose(q), auths=self._auths(q),
+                    )
+                    if grid is None:
+                        # filter/planes not device-expressible: a normal
+                        # routing outcome, not a fault — resolved OUTSIDE
+                        # _degradable so store-path errors are never
+                        # retried/recorded under the DEVICE domain
+                        return None
+                    # unweighted: the grid mass IS the in-window count
                     self._observe_resident(
                         type_name, cql, t0, _time.perf_counter(),
                         int(round(float(grid.sum()))),
                     )
-            if grid is None:
-                # no resident index, or filter/planes not device-
-                # expressible: the store path records its own metrics
-                # (observe_query) and honors the SAME auths the resident
-                # path would have
-                grid = density(
-                    self.store, type_name, cql, env, width, height,
-                    auths=self._auths(q),
-                )
-            return grid
+                    return grid
 
-        grid = self._sched_run(q, fn=work)
+                grid = self._degradable(
+                    q, "device-launch-failed", store_work, fn=device_work
+                )
+                if grid is None:
+                    # the store resolution of a not-device-expressible
+                    # filter is NORMAL routing, not an emergency rung:
+                    # it goes back through the scheduler's admission
+                    # control and deadline like any other unit of work
+                    grid = self._sched_run(q, fn=store_work)
+        else:
+            grid = self._sched_run(q, fn=store_work)
         self._json(
             200,
             {
@@ -737,6 +1087,7 @@ def make_server(
             handler._resident_cache[tn] = di
     server = _GeomesaHTTPServer((host, port), handler)
     server.scheduler = scheduler  # callers may inspect / shut down
+    server.store = store  # the draining shutdown flushes its audit log
     return server
 
 

@@ -323,6 +323,20 @@ class _Sized:
         return self._n
 
 
+class _PartFailure:
+    """Sentinel a DEGRADABLE partition read returns instead of raising:
+    the prefetch pipeline keeps flowing (an exception at item i would
+    tear the whole scan down), and the CONSUMER decides — skip the
+    partition and stamp the result degraded (``resilience.degrade``
+    on), or surface the partition-scoped error."""
+
+    __slots__ = ("p", "error")
+
+    def __init__(self, p, error):
+        self.p = p
+        self.error = error
+
+
 class _PresizedSink:
     """Streaming assembly of a FULL-scan result into buffers pre-sized
     from the manifest's row counts (the chunk-stats/manifest contract:
@@ -574,6 +588,7 @@ class FileSystemDataStore:
                 leaf=p.get("leaf"),
                 checksum=p.get("checksum"),
                 chunks=self._load_chunks(chunkset_from_json, p.get("chunks")),
+                gen=meta.get("file_gen"),
             )
             for p in meta["partitions"]
         ]
@@ -972,7 +987,9 @@ class FileSystemDataStore:
                     and len(chunk_nbytes) == len(p.chunks)
                 ):
                     p.chunks.nbytes = np.asarray(chunk_nbytes, dtype=np.int64)
-                parts.append(dataclasses.replace(p, checksum=checksum))
+                parts.append(
+                dataclasses.replace(p, checksum=checksum, gen=new_gen)
+            )
             fail_point("fail.flush.after_write")
             if fsync:
                 for dd in sorted(dirs):
@@ -1083,9 +1100,13 @@ class FileSystemDataStore:
     def _part_path(
         self, type_name: str, p: PartitionMeta, gen=_GEN_CURRENT
     ) -> str:
-        """Path of a partition file. ``gen`` defaults to the type's
-        published file generation (None = legacy un-scoped names); a
-        flush mid-rewrite passes its NEW generation explicitly."""
+        """Path of a partition file. ``gen`` defaults to the generation
+        stamped on the META (falling back to the type's published file
+        generation for unstamped metas; None = legacy un-scoped names);
+        a flush mid-rewrite passes its NEW generation explicitly. Meta-
+        faithful resolution is what keeps a scan over a pre-flush
+        partition snapshot on ITS generation's files — it must never
+        silently read a newer generation's file for the same pid."""
         from geomesa_tpu.store.partitions import part_file_name
 
         st = self._types[type_name]
@@ -1093,7 +1114,7 @@ class FileSystemDataStore:
         if p.leaf:
             d = os.path.join(d, p.leaf)
         if gen is self._GEN_CURRENT:
-            gen = st.file_gen
+            gen = p.gen if p.gen is not None else st.file_gen
         return os.path.join(d, part_file_name(p.pid, st.encoding, gen))
 
     # -- crash recovery / integrity ----------------------------------------
@@ -1419,8 +1440,12 @@ class FileSystemDataStore:
         partition batch (chunk row offsets are partition-relative slices
         of the file order), or None on a cache miss. Chunk-selective
         results are never themselves pinned -- a partial batch in the
-        cache would silently truncate later full reads."""
-        full = st.cache.get(p.pid)
+        cache would silently truncate later full reads. Cache keys are
+        (generation, pid): a pid recurs across generations with
+        different contents, so a stale-snapshot scan must neither hit a
+        newer generation's bytes nor publish its own where a
+        current-generation reader would find them."""
+        full = st.cache.get((p.gen, p.pid))
         if full is None:
             return None
         cs = p.chunks
@@ -1450,8 +1475,8 @@ class FileSystemDataStore:
             hit = self._cache_slice(st, p, chunk_sel)
             if hit is not None:
                 return hit
-        elif p.pid in st.cache:
-            return st.cache[p.pid]
+        elif (p.gen, p.pid) in st.cache:
+            return st.cache[(p.gen, p.pid)]
         with self._shared():  # never read a half-rewritten directory
             # chunk_sel only rides when set: monkeypatch/test doubles of
             # _read_part_table keep the legacy 3-arg call shape
@@ -1486,8 +1511,8 @@ class FileSystemDataStore:
             hit = self._cache_slice(st, p, chunk_sel)
             if hit is not None:
                 return hit
-        elif p.pid in st.cache:
-            return st.cache[p.pid]
+        elif (p.gen, p.pid) in st.cache:
+            return st.cache[(p.gen, p.pid)]
         t = (
             self._read_part_table(type_name, p, chunk_sel=chunk_sel)
             if chunk_sel is not None
@@ -1516,8 +1541,8 @@ class FileSystemDataStore:
             hit = self._cache_slice(st, p, chunk_sel)
             if hit is not None:
                 return hit
-        elif p.pid in st.cache:
-            return st.cache[p.pid]
+        elif (p.gen, p.pid) in st.cache:
+            return st.cache[(p.gen, p.pid)]
         # writer fence: touch (acquire+release) _mem_lock BEFORE taking
         # the shared flock. A same-process writer holds _mem_lock while
         # it polls for the exclusive flock; without the fence, N workers'
@@ -1537,6 +1562,70 @@ class FileSystemDataStore:
                 else self._read_part_table(type_name, p)
             )
         return self._decode_part_table(type_name, p, t, cache=False)
+
+    def _read_partition_degradable(
+        self, type_name: str, p: PartitionMeta, cache: bool = False,
+        locked: bool = False,
+    ):
+        """Breaker-guarded partition read for the SERVING scan paths:
+        transient errors retry on the worker (the ``io.*`` jittered,
+        cumulative-capped budget), retries-exhausted and corrupt reads
+        record a failure on THIS partition's circuit breaker and return
+        a :class:`_PartFailure` sentinel (partition-scoped — the scan's
+        pipeline and sibling partitions are untouched), and an OPEN
+        breaker short-circuits the read entirely until its half-open
+        probe. With ``resilience.degrade`` off this is exactly the
+        plain read (errors propagate and fail the query loudly).
+        ``locked`` selects the per-read-locking flavor
+        (query_partitions holds no lock across its yields)."""
+        from geomesa_tpu import resilience
+
+        plain = (
+            self._read_partition if locked else self._read_partition_unlocked
+        )
+        if not resilience.degrade_allowed():
+            return plain(type_name, p, cache=cache)
+        # breaker scope includes the store root: two stores (or a test
+        # and its tmp sibling) with the same type name must not share
+        # failure state
+        br = resilience.partition_breaker(
+            f"{self.root}:{type_name}", p.pid
+        )
+        if not br.allow():
+            return _PartFailure(
+                p,
+                resilience.PartitionUnavailableError(
+                    type_name, p.pid, "circuit breaker open"
+                ),
+            )
+        from geomesa_tpu.store.prefetch import _with_retries
+
+        read = _with_retries(lambda pp: plain(type_name, pp, cache=cache))
+        try:
+            batch = read(p)
+        except FileNotFoundError:
+            raise  # a real state (GC'd generation): refresh, not degrade
+        except (OSError, PartitionCorruptError) as e:
+            br.record_failure()
+            return _PartFailure(p, e)
+        br.record_success()
+        return batch
+
+    @staticmethod
+    def _skip_part_failure(type_name: str, failure: "_PartFailure"):
+        """Consumer half of the degradable read: note the degradation
+        (header/audit stamping + metric) and log the skipped partition.
+        Callers ``continue`` past the partition afterwards."""
+        import logging
+
+        from geomesa_tpu import resilience
+
+        resilience.note_degraded("partition-unavailable")
+        logging.getLogger(__name__).warning(
+            "dataset %r partition %d unavailable (%s) -- serving "
+            "DEGRADED result without it",
+            type_name, failure.p.pid, failure.error,
+        )
 
     def scan_lock_held(self) -> bool:
         """True when THIS thread holds the store's exclusive lock —
@@ -1667,7 +1756,7 @@ class FileSystemDataStore:
             batch = FeatureBatch.from_arrow(t, st.sft)
         sp.set(rows=len(batch))
         if cache:
-            st.cache[p.pid] = batch
+            st.cache[(p.gen, p.pid)] = batch
         return batch
 
     def _read_all(self, type_name: str) -> FeatureBatch:
@@ -1779,13 +1868,26 @@ class FileSystemDataStore:
         # to the in-line serial reads, whose _shared() short-circuits on
         # the re-entrant thread-local depth.
         batches = prefetch_map(
-            lambda p: self._read_partition(type_name, p),
+            lambda p: self._read_partition_degradable(
+                type_name, p, cache=True, locked=True
+            ),
             parts,
             0 if self.scan_lock_held() else self.io,
             size_of=batch_nbytes,
         )
         try:
             for p, batch in zip(parts, batches):
+                if isinstance(batch, _PartFailure):
+                    # bulk/export consumers must never silently lose a
+                    # partition: the fault surfaces as a TYPED,
+                    # partition-scoped error naming exactly what is
+                    # unreachable (retries already exhausted on the
+                    # worker) — not an anonymous pipeline teardown
+                    from geomesa_tpu import resilience
+
+                    raise resilience.PartitionUnavailableError(
+                        type_name, batch.p.pid, str(batch.error)
+                    ) from batch.error
                 local = BuiltIndex(
                     ks,
                     batch,
@@ -1859,7 +1961,7 @@ class FileSystemDataStore:
         # path. A deadline abort closes the pipeline (workers drained)
         # via the generator's finally.
         batches = prefetch_map(
-            lambda p: self._read_partition_unlocked(
+            lambda p: self._read_partition_degradable(
                 type_name, p, cache=True
             ),
             parts,
@@ -1885,6 +1987,22 @@ class FileSystemDataStore:
                     raise QueryTimeout(
                         f"query on {type_name!r} exceeded {timeout_ms}ms"
                     )
+                if isinstance(batch, _PartFailure):
+                    from geomesa_tpu import resilience
+
+                    if resilience.capture_degraded() is None:
+                        # no request collector to stamp: a library/CLI
+                        # caller would get a SILENT partial — fail with
+                        # the typed partition-scoped error instead (the
+                        # serving path installs collect_degraded and
+                        # rides the branch below)
+                        raise resilience.PartitionUnavailableError(
+                            type_name, batch.p.pid, str(batch.error)
+                        ) from batch.error
+                    # partition-scoped fault: serve the siblings, stamp
+                    # the result degraded (never a silent partial)
+                    self._skip_part_failure(type_name, batch)
+                    continue
                 scanned += len(batch)
                 local = BuiltIndex(
                     ks,
@@ -1949,6 +2067,18 @@ class FileSystemDataStore:
         manifest contract) — the pre-size hint resident staging and the
         pushdown paths consume without reading any file."""
         return int(sum(p.count for p in self._types[type_name].partitions))
+
+    def has_chunk_stats(self, type_name: str) -> bool:
+        """True when every partition of ``type_name`` carries v2 chunk
+        statistics, i.e. aggregate pushdown can answer bbox+time shapes
+        without row scans. The server's brownout rung consults this —
+        over a v1/legacy dataset the 'pre-aggregate' path would quietly
+        row-scan, the opposite of a brownout."""
+        st = self._types.get(type_name)
+        if st is None:
+            return False
+        # snapshot: flush replaces st.partitions wholesale, never mutates
+        return all(p.chunks is not None for p in list(st.partitions))
 
     def count(self, type_name: str, query=ast.Include) -> int:
         """Filtered count; bbox+time-shaped filters on a v2 store are

@@ -501,6 +501,45 @@ class StreamedDeviceScan:
         if refined:
             metrics.agg_pushdown_chunks_refined.inc(refined)
 
+    @staticmethod
+    def _degrade_or_raise(e: BaseException) -> None:
+        """Degradation rung for streamed-scan faults: a failed or stuck
+        device launch (incl. the ``fail.device.launch`` injection) lets
+        the caller retry the whole question through the store's HOST
+        scan — exact, just slower; the result is stamped degraded. FATAL
+        faults (bad filters, programming errors) and degrade-off
+        propagate. The host fallback composes with the store's own
+        partition-level degradation (an unreachable partition is skipped
+        and stamped there). The stamped reason distinguishes store/disk
+        faults that bubbled out of the stream from device faults — a
+        corrupt partition labeled ``device-launch-failed`` would send
+        the operator to the accelerator for a disk problem."""
+        from geomesa_tpu import resilience
+        from geomesa_tpu.store.fs import PartitionCorruptError
+
+        if (
+            not resilience.degrade_allowed()
+            or resilience.classify(e) == resilience.FATAL
+        ):
+            raise e
+        if resilience.is_oom(e) or (
+            getattr(e, "name", None) == "fail.stage.oom"
+        ):
+            reason = "device-oom"
+        elif isinstance(
+            e,
+            (PartitionCorruptError, resilience.PartitionUnavailableError),
+        ) or (
+            # OSError = read/disk fault (FailpointError rides OSError;
+            # only the device-launch injection is a DEVICE fault)
+            isinstance(e, OSError)
+            and getattr(e, "name", None) != "fail.device.launch"
+        ):
+            reason = "partition-unavailable"
+        else:
+            reason = "device-launch-failed"
+        resilience.note_degraded(reason)
+
     def count(self, query) -> int:
         """Streamed fused count. Filters with host-only predicates fall
         back to the store's own (streaming, host) scan. bbox+time
@@ -530,14 +569,28 @@ class StreamedDeviceScan:
             "oocscan.count", type=self.type_name, parts=len(parts)
         ) as sp:
             base, items, pushed, prune_stats = self._agg_split(plan, parts)
+            try:
+                outs = self._stream(plan, "count").stream(
+                    self._pairs(
+                        items, compiled.device_cols, want_batch=False
+                    )
+                )
+                total = base + int(sum(int(o) for o, _ in outs))
+            except Exception as e:
+                self._degrade_or_raise(e)
+                # the cheapest host rung that COUNTS without
+                # materializing the row set (we are degrading under
+                # memory pressure): the store's pushdown-served count
+                if hasattr(self.store, "count"):
+                    return int(self.store.count(self.type_name, query))
+                return len(self.store.query(self.type_name, query).batch)
+            # metrics only after the split/plan actually answered — a
+            # degraded fallback re-reads everything and must not report
+            # chunks as skipped or rows as pre-aggregated
             if pushed:
                 self._record_pushdown(base, items)
             elif prune_stats is not None:
                 self._record_prune(prune_stats)
-            outs = self._stream(plan, "count").stream(
-                self._pairs(items, compiled.device_cols, want_batch=False)
-            )
-            total = base + int(sum(int(o) for o, _ in outs))
             sp.set(rows_preagg=int(base))
             return total
 
@@ -556,7 +609,11 @@ class StreamedDeviceScan:
         if not compiled.device_cols:
             return self.store.query(self.type_name, query).batch
         with span("oocscan.query", type=self.type_name, parts=len(parts)):
-            return self._query_streamed(plan, parts)
+            try:
+                return self._query_streamed(plan, parts)
+            except Exception as e:
+                self._degrade_or_raise(e)
+                return self.store.query(self.type_name, query).batch
 
     def _query_streamed(self, plan, parts):
         from geomesa_tpu.features.batch import FeatureBatch

@@ -119,33 +119,46 @@ def batch_nbytes(batch) -> int:
 
 def _with_retries(fn):
     """Transient-read resilience for the pipeline workers: retry ``fn``
-    on OSError with bounded exponential backoff (``io.retries`` extra
-    attempts, ``io.backoff.ms`` base doubling per attempt). Reads are
-    idempotent, so re-running the whole work item is safe. NOT retried:
-    FileNotFoundError (a real state — e.g. another writer GC'd the
-    generation mid-scan, which a refresh must resolve, not a sleep) and
-    non-OSError domain failures (checksum quarantines stay loud)."""
+    on OSError with bounded, JITTERED exponential backoff —
+    ``io.retries`` extra attempts, ``io.backoff.ms`` base doubling per
+    attempt scaled 0.5-1.5x (a fleet of workers hitting the same
+    flapping disk de-correlates), the CUMULATIVE sleep capped by
+    ``io.backoff.cap.ms`` so a flapping disk can never stall a worker
+    for unbounded wall-clock (once the budget is spent the next error
+    surfaces immediately). Reads are idempotent, so re-running the
+    whole work item is safe. NOT retried: FileNotFoundError (a real
+    state — e.g. another writer GC'd the generation mid-scan, which a
+    refresh must resolve, not a sleep) and non-OSError domain failures
+    (checksum quarantines stay loud)."""
     from geomesa_tpu.conf import sys_prop
 
     retries = int(sys_prop("io.retries"))
     if retries <= 0:
         return fn
-    backoff_s = max(float(sys_prop("io.backoff.ms")), 0.0) / 1e3
 
     def call(item):
         import time as _time
 
         from geomesa_tpu import metrics
+        from geomesa_tpu.resilience import backoff_sleeps
 
-        for attempt in range(retries):
+        # per-item budget, resolved per call so prop_override applies
+        sleeps = backoff_sleeps(
+            retries,
+            float(sys_prop("io.backoff.ms")),
+            float(sys_prop("io.backoff.cap.ms")),
+        )
+        while True:
             try:
                 return fn(item)
             except FileNotFoundError:
                 raise
             except OSError:
+                delay = next(sleeps, None)
+                if delay is None:
+                    raise  # retries/budget exhausted: surface the error
                 metrics.store_read_retries.inc()
-                _time.sleep(backoff_s * (1 << attempt))
-        return fn(item)  # the last attempt's error propagates
+                _time.sleep(delay)
 
     return call
 

@@ -215,24 +215,42 @@ def test_checksum_corruption_quarantines_one_partition(tmp_path):
     assert outside, "test window must prune at least one partition"
     victim = outside[0]
     before = sorted(int(f) for f in ds.query("t", ecql).batch.fids)
+    all_before = sorted(int(f) for f in ds.query("t").batch.fids)
+    victim_fids = {int(f) for f in ds._read_partition("t", victim).fids}
     _corrupt(ds._part_path("t", victim))
 
     with prop_override("store.verify", "always"):
         fresh = FileSystemDataStore(root, partition_size=128)
         c0 = metrics.store_checksum_failures.value()
-        # touching the corrupt partition fails loudly, naming it
-        with pytest.raises(PartitionCorruptError, match=f"partition {victim.pid}"):
-            fresh.query("t")
-        assert metrics.store_checksum_failures.value() - c0 == 1
-        assert set(fresh._types["t"].quarantined) == {victim.pid}
-        # ... but ONLY that partition: the pruned query still serves,
-        # byte-identical to the pre-corruption answer
-        after = sorted(int(f) for f in fresh.query("t", ecql).batch.fids)
-        assert after == before
-        # repeated reads stay loud without re-counting the failure
-        with pytest.raises(PartitionCorruptError):
-            fresh.query("t")
-        assert metrics.store_checksum_failures.value() - c0 == 1
+        with prop_override("resilience.degrade", False):
+            # degradation off: touching the corrupt partition fails
+            # loudly, naming it (the pre-ISSUE-7 contract, still the
+            # strict-mode behavior)
+            with pytest.raises(
+                PartitionCorruptError, match=f"partition {victim.pid}"
+            ):
+                fresh.query("t")
+            assert metrics.store_checksum_failures.value() - c0 == 1
+            assert set(fresh._types["t"].quarantined) == {victim.pid}
+            # ... but ONLY that partition: the pruned query still serves,
+            # byte-identical to the pre-corruption answer
+            after = sorted(
+                int(f) for f in fresh.query("t", ecql).batch.fids
+            )
+            assert after == before
+            # repeated reads stay loud without re-counting the failure
+            with pytest.raises(PartitionCorruptError):
+                fresh.query("t")
+            assert metrics.store_checksum_failures.value() - c0 == 1
+        # resilience.degrade (the default): the corruption is a
+        # PARTITION-SCOPED fault — the full scan serves every healthy
+        # sibling, stamped degraded, instead of failing (ISSUE 7)
+        from geomesa_tpu import resilience
+
+        with resilience.collect_degraded() as reasons:
+            got = sorted(int(f) for f in fresh.query("t").batch.fids)
+        assert got == sorted(set(all_before) - victim_fids)
+        assert "partition-unavailable" in reasons
 
 
 def test_verify_open_quarantines_at_open(tmp_path):
@@ -325,11 +343,17 @@ def test_transient_read_errors_retry_with_backoff(tmp_path):
             res = fresh.query("t", "INCLUDE")
         assert len(res.batch) == N0
         assert metrics.store_read_retries.value() - r0 == 2
-    # exhausted retries surface the error instead of looping forever
+    # exhausted retries surface a typed, partition-scoped error instead
+    # of looping forever (outside a serving request there is nothing to
+    # stamp degraded, so the query fails loudly — ISSUE 7)
+    from geomesa_tpu import resilience
+
     fresh2 = FileSystemDataStore(root, partition_size=128)
     with prop_override("io.retries", 1), prop_override("io.backoff.ms", 1):
         with failpoints.failpoint_override("fail.read.io", "raise"):
-            with pytest.raises(OSError, match="failpoint"):
+            with pytest.raises(
+                resilience.PartitionUnavailableError, match="failpoint"
+            ):
                 fresh2.query("t", "INCLUDE")
 
 

@@ -2173,6 +2173,11 @@ print(json.dumps({
 """
 
 
+#: BENCH_r05's recorded mesh-build rate (rows/sec) — the bar the
+#: rebuilt exchange must beat; the --smoke/--check CI guard pins it
+_R05_MESH_BUILD_ROWS_PER_SEC = 0.47e6
+
+
 def bench_meshbuild(args) -> dict:
     """Mesh exchange-sort throughput (the build's distribution leg): a
     2^22-row distributed sort with a row-id payload over an 8-virtual-
@@ -2180,7 +2185,13 @@ def bench_meshbuild(args) -> dict:
     item 5 asked for ANY recorded exchange number). Runs in a SUBPROCESS
     because the bench process owns the TPU backend and the virtual-device
     flag must precede jax init. A CPU-mesh rate is not a TPU/ICI rate —
-    it proves the exchange executes at scale and tracks regressions."""
+    it proves the exchange executes at scale and tracks regressions.
+
+    A subprocess failure PROPAGATES: the rc and stderr tail land in the
+    bench JSON, and ``--check``/``--smoke`` runs raise (exit nonzero)
+    instead of recording ``None`` with the error buried in the log.
+    ``--smoke``/``--check`` additionally guard the measured rate against
+    the BENCH_r05 baseline (0.47M rows/s)."""
     import json as _json
     import subprocess
     import sys as _sys
@@ -2192,13 +2203,209 @@ def bench_meshbuild(args) -> dict:
         capture_output=True, text=True, timeout=900,
     )
     if out.returncode != 0:
-        log(f"meshbuild FAILED: {out.stderr[-500:]}")
-        return {"mesh_build_rows_per_sec": None}
+        tail = out.stderr[-800:]
+        log(f"meshbuild FAILED rc={out.returncode}: {tail[-500:]}")
+        if args.check or args.smoke:
+            raise RuntimeError(
+                f"meshbuild subprocess failed rc={out.returncode}: {tail}"
+            )
+        return {
+            "mesh_build_rows_per_sec": None,
+            "mesh_build_rc": out.returncode,
+            "mesh_build_stderr_tail": tail,
+        }
     line = out.stdout.strip().splitlines()[-1]
     got = _json.loads(line)
-    log(f"mesh build: {got['mesh_build_rows_per_sec']/1e6:.1f}M rows/s "
-        f"({got['mesh_build_ms']}ms for 2^22 rows over 8 devices)")
+    got["mesh_build_rc"] = 0
+    rate = got["mesh_build_rows_per_sec"]
+    got["mesh_build_vs_r05_x"] = round(rate / _R05_MESH_BUILD_ROWS_PER_SEC, 2)
+    log(f"mesh build: {rate/1e6:.1f}M rows/s "
+        f"({got['mesh_build_ms']}ms for 2^22 rows over 8 devices; "
+        f"{got['mesh_build_vs_r05_x']}x the r05 baseline)")
+    if args.check or args.smoke:
+        assert rate > _R05_MESH_BUILD_ROWS_PER_SEC, (
+            f"mesh build {rate/1e6:.2f}M rows/s does not beat the r05 "
+            f"baseline {_R05_MESH_BUILD_ROWS_PER_SEC/1e6:.2f}M rows/s"
+        )
     return got
+
+
+_MULTICHIP_SNIPPET = r"""
+import sys
+nd, n, serve_n, reqs = (int(a) for a in sys.argv[1:5])
+from geomesa_tpu.jaxconf import force_cpu_devices
+force_cpu_devices(max(nd, 2))  # nd=1 still simulates on the CPU platform
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp
+from geomesa_tpu.parallel import make_mesh
+from geomesa_tpu.parallel.dist import distributed_sort
+
+mesh = make_mesh(nd)
+rng = np.random.default_rng(0)
+hi = jnp.asarray(rng.integers(0, 1 << 31, n).astype(np.uint32))
+lo = jnp.asarray(
+    rng.integers(0, 1 << 32, n, dtype=np.uint64).astype(np.uint32)
+)
+rid = jnp.asarray(np.arange(n, dtype=np.uint32))
+def run():
+    (sh, sl), pay, sv = distributed_sort(mesh, (hi, lo), payload={"rid": rid})
+    jax.block_until_ready((sh, sl, pay["rid"], sv))
+run()  # compile + correctness (overflow would raise)
+times = []
+for _ in range(3):
+    t0 = time.perf_counter(); run(); times.append(time.perf_counter() - t0)
+build = n / sorted(times)[1]
+del hi, lo, rid
+
+# fused mesh serving: mesh-sharded resident index + scheduler micro-batches
+from geomesa_tpu.store import MemoryDataStore
+from geomesa_tpu.device_cache import DeviceIndex, ShardedDeviceIndex
+from geomesa_tpu.sched import FusableQuery, QueryScheduler, SchedConfig
+from geomesa_tpu.conf import prop_override
+
+store = MemoryDataStore()
+store.create_schema("pts", "dtg:Date,*geom:Point:srid=4326")
+t0ms = 1577836800000
+store.write("pts", {
+    "dtg": t0ms + rng.integers(0, 30 * 86400_000, serve_n),
+    "geom": np.stack(
+        [rng.uniform(-180, 180, serve_n), rng.uniform(-90, 90, serve_n)],
+        axis=1,
+    ),
+}, fids=np.arange(serve_n))
+di = (
+    ShardedDeviceIndex(store, "pts", mesh=mesh)
+    if nd > 1
+    else DeviceIndex(store, "pts", z_planes=True)
+)
+qs = [f"BBOX(geom, {-170 + 20 * i}, -40, {-140 + 20 * i}, 40)"
+      for i in range(16)]
+sched = QueryScheduler(SchedConfig(
+    max_inflight=1, max_queue=8192, fusion_window_ms=0.5,
+    default_deadline_ms=None,
+))
+with prop_override("query.loose.bbox", True):
+    expect = [di.count(q, loose=True) for q in qs]  # warm the kernels
+    warm = [sched.submit(fuse=FusableQuery(di, qs[i % 16], "count",
+                                           loose=True))
+            for i in range(64)]
+    for p in warm:
+        sched.wait(p)  # warm the fused launch shapes
+    t0 = time.perf_counter()
+    pend = [sched.submit(fuse=FusableQuery(di, qs[i % 16], "count",
+                                           loose=True))
+            for i in range(reqs)]
+    got = [sched.wait(p) for p in pend]
+    qps = reqs / (time.perf_counter() - t0)
+for i, g in enumerate(got):
+    assert g == expect[i % 16], (i, g, expect[i % 16])
+snap = sched.snapshot()
+sched.close(timeout=10)
+print(json.dumps({
+    "devices": nd,
+    "build_rows_per_sec": round(build, 1),
+    "build_n": n,
+    "serve_fused_qps": round(qps, 1),
+    "serve_rows": serve_n,
+    "serve_fusion_factor": snap["fusion_factor"],
+}))
+"""
+
+
+def bench_multichip(args) -> dict:
+    """The multi-chip SCALING leg (promotes MULTICHIP_r0*.json from a
+    dryrun smoke to a first-class bench): for 1/2/4/8 virtual CPU
+    devices, record the distributed-sort build rate AND the fused
+    resident-serving qps through the scheduler's micro-batcher over a
+    mesh-sharded index, each in a fresh subprocess (the device-count
+    flag must precede jax init). The curve is written to the next
+    MULTICHIP_r0*.json next to this file. ``--smoke`` runs smaller
+    shapes and (like ``--check``) raises on any leg failure and guards
+    the 8-device build rate against the r05 baseline."""
+    import json as _json
+    import os
+    import re as _re
+    import subprocess
+    import sys as _sys
+
+    n = args.n or ((1 << 20) if args.smoke else (1 << 22))
+    serve_n = (1 << 16) if args.smoke else (1 << 18)
+    reqs = 256 if args.smoke else 512
+    curve: list = []
+    for nd in (1, 2, 4, 8):
+        out = subprocess.run(
+            [_sys.executable, "-c", _MULTICHIP_SNIPPET,
+             str(nd), str(n), str(serve_n), str(reqs)],
+            capture_output=True, text=True, timeout=1800,
+        )
+        if out.returncode != 0:
+            tail = out.stderr[-800:]
+            log(f"multichip[{nd}] FAILED rc={out.returncode}: {tail[-300:]}")
+            if args.check or args.smoke:
+                raise RuntimeError(
+                    f"multichip leg ({nd} devices) failed "
+                    f"rc={out.returncode}: {tail}"
+                )
+            curve.append({
+                "devices": nd, "rc": out.returncode, "stderr_tail": tail,
+            })
+            continue
+        got = _json.loads(out.stdout.strip().splitlines()[-1])
+        got["rc"] = 0
+        log(f"multichip[{nd}]: build {got['build_rows_per_sec']/1e6:.2f}M "
+            f"rows/s, fused serve {got['serve_fused_qps']:.0f} qps "
+            f"(fusion factor {got['serve_fusion_factor']})")
+        curve.append(got)
+    res: dict = {"multichip_scaling": curve, "multichip_build_n": n}
+    eight = next(
+        (c for c in curve if c.get("devices") == 8 and c.get("rc") == 0),
+        None,
+    )
+    if eight:
+        res["mesh_build_rows_per_sec_8dev"] = eight["build_rows_per_sec"]
+        res["mesh_build_vs_r05_x"] = round(
+            eight["build_rows_per_sec"] / _R05_MESH_BUILD_ROWS_PER_SEC, 2
+        )
+        if args.check or args.smoke:
+            assert eight["build_rows_per_sec"] > \
+                _R05_MESH_BUILD_ROWS_PER_SEC, (
+                    "8-device mesh build "
+                    f"{eight['build_rows_per_sec']/1e6:.2f}M rows/s does "
+                    "not beat the r05 baseline "
+                    f"{_R05_MESH_BUILD_ROWS_PER_SEC/1e6:.2f}M rows/s"
+                )
+    # record the curve as the next first-class MULTICHIP artifact (a
+    # scaling record replaces the old dryrun-smoke format); a bench
+    # re-run overwrites its own latest scaling record instead of
+    # minting a file per invocation
+    try:
+        root = os.path.dirname(os.path.abspath(__file__))
+        existing = sorted(
+            f for f in os.listdir(root)
+            if _re.match(r"MULTICHIP_r\d+\.json$", f)
+        )
+        nxt = 1
+        if existing:
+            last = existing[-1]
+            with open(os.path.join(root, last)) as f:
+                prev = _json.load(f)
+            num = int(_re.search(r"r(\d+)", last).group(1))
+            nxt = num if "scaling" in prev else num + 1
+        path = os.path.join(root, f"MULTICHIP_r{nxt:02d}.json")
+        with open(path, "w") as f:
+            _json.dump({
+                "ok": all(c.get("rc") == 0 for c in curve),
+                "smoke": bool(args.smoke),
+                "build_n": n,
+                "serve_rows": serve_n,
+                "scaling": curve,
+            }, f, indent=2)
+            f.write("\n")
+        log(f"multichip scaling curve recorded in {os.path.basename(path)}")
+    except OSError as e:  # read-only checkout: the JSON line still has it
+        log(f"could not record the MULTICHIP artifact: {e}")
+    return res
 
 
 def _run_mode_subprocess(mode: str, n=None, check=False, timeout=3600):
@@ -2293,8 +2500,8 @@ def main() -> None:
         "--mode",
         choices=(
             "all", "filter", "zscan", "build", "polygon", "density", "sweep",
-            "xzbuild", "meshbuild", "pipeline", "oocscan", "join", "serve",
-            "flush",
+            "xzbuild", "meshbuild", "multichip", "pipeline", "oocscan",
+            "join", "serve", "flush",
         ),
         default="all",
         help="all: every benchmark, one JSON line with everything (what "
@@ -2321,6 +2528,8 @@ def main() -> None:
         out = bench_xz_build(args)
     elif args.mode == "meshbuild":
         out = bench_meshbuild(args)
+    elif args.mode == "multichip":
+        out = bench_multichip(args)
     elif args.mode == "pipeline":
         out = bench_pipeline(args)
     elif args.mode == "oocscan":
@@ -2407,6 +2616,9 @@ def main() -> None:
         out["xz_build_n"] = xzb["xz_build_n"]
         # the build's exchange leg at scale (8-virtual-device CPU mesh)
         out.update(bench_meshbuild(args))
+        # the multi-chip scaling curve: build rate + fused serve qps at
+        # 1/2/4/8 devices (records the next MULTICHIP_r0*.json)
+        out.update(bench_multichip(args))
         # spatial-join coarse pass (chained + device-compacted)
         out.update(bench_join(args))
         # concurrent serving through the device query scheduler: the

@@ -89,6 +89,27 @@ Properties:
                                 past which exact aggregate answers
                                 yield to chunk-pushdown approximations
                                 (0 disables brownout)
+- ``mesh.enabled``              serve resident indexes sharded across a
+                                device mesh (ShardedDeviceIndex) when
+                                more than one jax device is visible
+- ``mesh.devices``              devices in the serving mesh (0 = all
+                                visible devices)
+- ``mesh.replicas``             replica axis size: the mesh factors as
+                                shard x replica and the resident planes
+                                replicate across the replica axis (1 =
+                                pure sharding)
+- ``mesh.sort.engine``          distributed-sort node-local stage
+                                engine: ``auto`` (host radix on all-CPU
+                                meshes, device otherwise), ``device``
+                                (everything in one jitted launch) or
+                                ``host`` (numpy radix local sorts + XLA
+                                all_to_all exchange)
+- ``compile.cache.dir``         persistent XLA compilation-cache
+                                directory for serving ("" = the
+                                GEOMESA_TPU_COMPILE_CACHE env /
+                                ~/.cache default; ``off`` disables) —
+                                wired at make_server / CLI serve start,
+                                hit/miss surfaced in /stats
 """
 
 from __future__ import annotations
@@ -113,6 +134,15 @@ def _parse_verify(v) -> str:
     if s not in ("off", "open", "always"):
         raise ValueError(
             f"store.verify must be off, open or always, not {v!r}"
+        )
+    return s
+
+
+def _parse_sort_engine(v) -> str:
+    s = str(v).strip().lower()
+    if s not in ("auto", "device", "host"):
+        raise ValueError(
+            f"mesh.sort.engine must be auto, device or host, not {v!r}"
         )
     return s
 
@@ -182,6 +212,16 @@ _DEFS = {
     "resilience.breaker.cooldown.s": (5.0, float),
     "resilience.launch.timeout.s": (30.0, float),
     "resilience.brownout.queue.frac": (0.8, float),
+    # multi-chip sharded serving (parallel/, device_cache.py): mesh
+    # topology for the resident-index shards and the distributed-sort
+    # node-local engine selector
+    "mesh.enabled": (False, _parse_bool),
+    "mesh.devices": (0, int),
+    "mesh.replicas": (1, int),
+    "mesh.sort.engine": ("auto", _parse_sort_engine),
+    # persistent serving compile cache (jaxconf.py): directory override
+    # ("" = env/default resolution, "off" disables)
+    "compile.cache.dir": ("", str),
 }
 
 _overrides: dict = {}

@@ -13,10 +13,13 @@ layer's listener).
 
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
 from geomesa_tpu.features.sft import SimpleFeatureType
 from geomesa_tpu.filter import ast
+from geomesa_tpu.jaxconf import scoped_x64
 from geomesa_tpu.ops.scan import stage_columns
 from geomesa_tpu.query.plan import internal_query
 
@@ -498,7 +501,7 @@ class DeviceIndex:
         if not self._z_encode_failed:
             dx, dy = coords_dev if coords_dev is not None else (x, y)
             try:
-                with jax.enable_x64():
+                with scoped_x64():
                     if self._dim_encode_jit is None:
 
                         def _enc2(x, y):
@@ -564,7 +567,7 @@ class DeviceIndex:
                 coords_dev if coords_dev is not None else (x, y, off)
             )
             try:
-                with jax.enable_x64():
+                with scoped_x64():
                     if self._dim_encode_jit is None:
 
                         def _enc(x, y, off, bins_u32, base):
@@ -666,7 +669,7 @@ class DeviceIndex:
                 # the host oracle bit-for-bit, without flipping the
                 # process-wide dtype default (callers may run float32
                 # everywhere else)
-                with jax.enable_x64():
+                with scoped_x64():
                     if self._z_encode_jit is None:
 
                         def _enc_hl(*cs):
@@ -975,8 +978,41 @@ class DeviceIndex:
         return len(self._host_batch)
 
     def _make_scan_fns(self, compiled):
-        """(count_fn, mask_fn) taking the resident column subset."""
-        return compiled.jitted_scan()
+        """(count_fn, mask_fn) taking the resident column subset.
+
+        When a device validity plane exists (padded buffers: streaming
+        deltas, mesh shards) it is ANDed into the fused scan — padding
+        rows stage as zeros and CAN match a filter. The plane is read
+        at CALL time (appends/refreshes replace it), and an index whose
+        plane appears only after a later restage still dispatches the
+        valid-aware jit from then on."""
+        import jax
+        import jax.numpy as jnp
+
+        plain_count, plain_mask = compiled.jitted_scan()
+        if self._device_valid() is None and type(self) is DeviceIndex:
+            # the base cache never pads: skip the per-call dispatch
+            return plain_count, plain_mask
+        mask_jit = jax.jit(
+            lambda cols, valid: compiled.device_fn(cols) & valid
+        )
+        count_jit = jax.jit(
+            lambda cols, valid: jnp.sum(compiled.device_fn(cols) & valid)
+        )
+
+        def count_fn(cols):
+            dv = self._device_valid()
+            return count_jit(cols, dv) if dv is not None else plain_count(
+                cols
+            )
+
+        def mask_fn(cols):
+            dv = self._device_valid()
+            return mask_jit(cols, dv) if dv is not None else plain_mask(
+                cols
+            )
+
+        return count_fn, mask_fn
 
     # -- queries -----------------------------------------------------------
 
@@ -2609,18 +2645,496 @@ class StreamingDeviceIndex(DeviceIndex):
     def _staged_len(self) -> int:
         return self._n
 
-    def _make_scan_fns(self, compiled):
-        """Valid-aware jitted scans: the compiled filter's XLA mask ANDed
-        with the validity plane, fused in one dispatch. The wrappers read
-        ``self._valid`` at call time — appends and evictions replace it."""
+    # _make_scan_fns: the base implementation ANDs _device_valid() (read
+    # at call time, so appends/evictions replacing self._valid apply)
+
+
+class ShardedDeviceIndex(DeviceIndex):
+    """Mesh-resident index: one logical resident cache whose scan planes
+    shard across a ``Mesh`` by CONTIGUOUS GLOBAL Z-KEY RANGES, so every
+    query — serial count/mask/query, the scheduler's fused micro-batch
+    launches, stats/density/kNN riders — runs mesh-wide in single SPMD
+    launches with device-side partial results reduced over the mesh (no
+    per-query host round-trips per device).
+
+    Staging is the MESH BUILD: the (bin, hi, lo, rid) key lanes run the
+    all_to_all splitter-exchange sort (``parallel/dist.distributed_sort``
+    — the rid lane makes ties deterministic, so results are bit-identical
+    across shard counts), the host mirror is reordered by the resulting
+    permutation, and every staged plane is placed with a
+    ``NamedSharding`` over the ``shard`` axis — shard s holds the s-th
+    globally-sorted key range. Schemas without a spatial key shard
+    positionally. Rows pad to a shard multiple at the GLOBAL TAIL with a
+    device validity plane masking the padding (the streaming-buffer
+    discipline), so every inherited scan stays exact.
+
+    A failed mesh sort degrades to the host sort (stamped
+    ``mesh-degraded``, counted) rather than failing the refresh; a failed
+    mesh scan launch surfaces to the server's device-breaker ladder like
+    any other launch fault and the request answers from the store rung.
+
+    With ``mesh.replicas`` > 1 the mesh factors shard x replica and the
+    resident planes replicate across the replica axis (whole-index
+    replication: fan-out capacity and a warm copy surviving a shard-
+    group failure). The dim-plane Pallas engine is single-chip-only and
+    is disabled here (the masked-compare engine shards; same results).
+    """
+
+    def __init__(
+        self,
+        store,
+        type_name: str,
+        columns: "list[str] | None" = None,
+        z_planes: bool = True,
+        mesh=None,
+        replicas: "int | None" = None,
+    ):
+        from geomesa_tpu.locking import checked_rlock
+        from geomesa_tpu.parallel.mesh import serving_mesh
+
+        # refresh republishes the mirror + sharded planes together; a
+        # scan between the two assignments would read misaligned state.
+        # blocking_ok: refresh holds it across store reads + the mesh
+        # sort + device staging by design (that serialization is the
+        # lock's purpose — the streaming-index discipline)
+        self._lock = checked_rlock("device_cache.mesh", blocking_ok=True)
+        self._mesh = mesh if mesh is not None else serving_mesh(
+            replicas=replicas
+        )
+        self._axis = "shard"
+        self._n_shards = int(self._mesh.shape[self._axis])
+        self._replicas = int(dict(self._mesh.shape).get("replica", 1))
+        self._dev_valid = None
+        self._n_staged = 0
+        self._rid_plane = None
+        self._shards: list = []
+        self._build_seconds = 0.0
+        self._build_engine = None  # "mesh" | "host-fallback" | None
+        self._hits_jits: dict = {}
+        super().__init__(
+            store, type_name, columns, z_planes=z_planes, dim_planes=False
+        )
+
+    @property
+    def mesh_shards(self) -> int:
+        return self._n_shards
+
+    # -- cache lifecycle ---------------------------------------------------
+
+    def refresh(self) -> None:
+        import time as _time
+
+        from geomesa_tpu import metrics, tracing
+        from geomesa_tpu.tracing import span
+
+        rows_hint = getattr(self.store, "manifest_rows", None)
+        hint = int(rows_hint(self.type_name)) if rows_hint else -1
+        t0 = _time.perf_counter()
+        with self._lock, span(
+            "mesh.build", type=self.type_name, shards=self._n_shards,
+            rows_hint=hint,
+        ):
+            res = self.store.query(self.type_name, _staging_query())
+            batch = res.batch
+            order = self._mesh_order(batch)
+            if order is not None:
+                batch = batch.take(order)
+            self._bin_range = None
+            self._bt_base = None
+            self._visid_np = None
+            self._host_batch, cols = self._stage_checked(batch)
+            self._cols = self._shard_cols(cols)
+        self._build_seconds = _time.perf_counter() - t0
+        metrics.mesh_build_seconds.observe(self._build_seconds)
+        metrics.mesh_shards.set(self._n_shards)
+        self._record_shards(tracing.capture(), t0, self._build_seconds)
+
+    def _mesh_order(self, batch) -> "np.ndarray | None":
+        """Global Z-order permutation computed BY THE MESH: the
+        splitter-exchange distributed sort over (bin?, hi, lo, rid) key
+        lanes — rid makes duplicate keys deterministic, so the staged
+        layout is bit-identical across shard counts and equal to the
+        host lexsort. None = the schema has no spatial key (positional
+        sharding). A mesh-sort fault degrades to the host sort."""
+        n = len(batch)
+        if n <= 1:
+            return None
+        kind, planes, _bins = _z_planes_np(batch, self.sft)
+        if kind is None:
+            return None
+        lanes: list = []
+        if Z_BIN in planes:
+            # bias signed period bins into uint32 lane order
+            lanes.append(
+                (np.asarray(planes[Z_BIN]).astype(np.int64) + (1 << 31))
+                .astype(np.uint32)
+            )
+        lanes.append(np.asarray(planes[Z_HI]).astype(np.uint32))
+        lanes.append(np.asarray(planes[Z_LO]).astype(np.uint32))
+        rid = np.arange(n, dtype=np.uint32)
+        pad = (-n) % self._n_shards
+        if pad:
+            lanes = [
+                np.concatenate([l, np.full(pad, 0xFFFFFFFF, l.dtype)])
+                for l in lanes
+            ]
+            rid = np.concatenate([rid, np.zeros(pad, np.uint32)])
+        valid = np.arange(n + pad) < n
+        from geomesa_tpu.parallel.dist import distributed_sort
+
+        try:
+            sorted_lanes, _pay, v = distributed_sort(
+                self._mesh, tuple(lanes) + (rid,), axis=self._axis,
+                valid=valid, on_overflow="raise",
+            )
+            v = np.asarray(v)
+            order = np.asarray(sorted_lanes[-1])[v].astype(np.int64)
+            if len(order) != n:
+                raise RuntimeError(
+                    f"mesh sort returned {len(order)} of {n} rows"
+                )
+            self._build_engine = "mesh"
+            return order
+        except Exception as e:
+            import warnings
+
+            from geomesa_tpu import metrics, resilience
+
+            warnings.warn(
+                f"mesh build sort failed ({type(e).__name__}: {e}); "
+                "staging falls back to the host sort",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            metrics.mesh_build_fallbacks.inc()
+            resilience.note_degraded("mesh-degraded")
+            self._build_engine = "host-fallback"
+            real = [l[:n] for l in lanes] + [rid[:n]]
+            return np.lexsort(tuple(reversed(real)))
+
+    def _shard_cols(self, cols: dict) -> dict:
+        """Place every staged plane with a NamedSharding over the shard
+        axis, padding to a shard multiple at the GLOBAL TAIL (masked by
+        the device validity plane; the host mirror keeps only real
+        rows, and mask truncation at ``_staged_len`` drops the tail)."""
         import jax
         import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        mask_jit = jax.jit(lambda cols, valid: compiled.device_fn(cols) & valid)
-        count_jit = jax.jit(
-            lambda cols, valid: jnp.sum(compiled.device_fn(cols) & valid)
+        n = len(self._host_batch)
+        self._n_staged = n
+        pad = (-n) % self._n_shards
+        cap = n + pad
+        if cap == 0:
+            self._dev_valid = None
+            self._rid_plane = None
+            return {k: jnp.asarray(np.asarray(v)) for k, v in cols.items()}
+        sharding = NamedSharding(self._mesh, P(self._axis))
+        out = {}
+        # pop as we go: resharding routes through the single-device
+        # staging buffers (base _stage_batch), and keeping both copies
+        # alive for the whole loop would transiently double residency —
+        # dropping each plane after its sharded put bounds the overlap
+        # to one plane. (Staging the planes sharded from the start is
+        # the remaining follow-up; the encode runs on device 0 today.)
+        for k in list(cols):
+            vcol = cols.pop(k)
+            a = np.asarray(vcol)
+            del vcol
+            if pad:
+                a = np.concatenate(
+                    [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
+                )
+            out[k] = jax.device_put(a, sharding)
+        self._dev_valid = jax.device_put(np.arange(cap) < n, sharding)
+        self._rid_plane = jax.device_put(
+            np.arange(cap, dtype=np.uint32), sharding
         )
-        return (
-            lambda cols: count_jit(cols, self._valid),
-            lambda cols: mask_jit(cols, self._valid),
-        )
+        return out
+
+    def _record_shards(self, ctx, t0: float, dur: float) -> None:
+        """Per-shard residency manifest (ShardMeta) + gauges + one
+        retroactive ``mesh.shard`` span per shard (they ran concurrently
+        inside the one SPMD build, so they share the build's timing)."""
+        from geomesa_tpu import metrics, tracing
+        from geomesa_tpu.index.api import ShardMeta
+
+        self._shards = []
+        n = self._n_staged
+        cap = n + ((-n) % self._n_shards)
+        per = cap // self._n_shards if self._n_shards and cap else 0
+        # boundary-only key fetches: 2 elements per shard instead of
+        # gathering the whole sharded key planes back to host
+        have_z = bool(n) and Z_HI in self._cols
+        have_bins = have_z and Z_BIN in self._cols
+
+        def _key_at(i: int) -> tuple:
+            hi_w = int(np.asarray(self._cols[Z_HI][i]))
+            lo_w = int(np.asarray(self._cols[Z_LO][i]))
+            key = ((hi_w << 32) | lo_w,)
+            if have_bins:
+                key = (int(np.asarray(self._cols[Z_BIN][i])),) + key
+            return key
+
+        per_bytes = self.nbytes / max(self._n_shards, 1)
+        for s in range(self._n_shards):
+            lo_i = min(s * per, n)
+            hi_i = min((s + 1) * per, n)
+            rows = max(0, hi_i - lo_i)
+            key_lo = key_hi = None
+            if have_z and rows:
+                key_lo = _key_at(lo_i)
+                key_hi = _key_at(hi_i - 1)
+            self._shards.append(ShardMeta(s, rows, key_lo, key_hi))
+            metrics.mesh_resident_rows.set(rows, shard=str(s))
+            metrics.mesh_resident_bytes.set(per_bytes, shard=str(s))
+            tracing.record_span(
+                ctx, "mesh.shard", t0, dur, shard=s, rows=rows,
+            )
+
+    def mesh_stats(self) -> dict:
+        """The per-type ``/stats/mesh`` document."""
+        return {
+            "type": self.type_name,
+            "devices": int(self._mesh.devices.size),
+            "shards": self._n_shards,
+            "replicas": self._replicas,
+            "rows": self._n_staged,
+            "resident_bytes": self.nbytes,
+            "build_seconds": round(self._build_seconds, 4),
+            "build_engine": self._build_engine,
+            "shard_ranges": [m.to_json() for m in self._shards],
+        }
+
+    # -- scan hooks --------------------------------------------------------
+
+    def _device_valid(self):
+        return self._dev_valid
+
+    def _staged_len(self) -> int:
+        return self._n_staged
+
+    # _make_scan_fns: the base implementation ANDs _device_valid() (read
+    # at call time), masking the global-tail padding rows
+
+    # -- queries (mesh-wide launches + observability) ----------------------
+
+    def count(self, query, loose: "bool | None" = None, auths=None) -> int:
+        from geomesa_tpu import metrics
+        from geomesa_tpu.tracing import span
+
+        with self._lock, span(
+            "mesh.scan", op="count", shards=self._n_shards,
+            type=self.type_name,
+        ):
+            n = super().count(query, loose=loose, auths=auths)
+        metrics.mesh_launches.inc()
+        return n
+
+    def mask(
+        self, query, loose: "bool | None" = None, auths=None
+    ) -> np.ndarray:
+        from geomesa_tpu import metrics
+        from geomesa_tpu.tracing import span
+
+        with self._lock, span(
+            "mesh.scan", op="mask", shards=self._n_shards,
+            type=self.type_name,
+        ):
+            m = super().mask(query, loose=loose, auths=auths)
+        metrics.mesh_launches.inc()
+        return m
+
+    def query(self, query, loose: "bool | None" = None, auths=None):
+        """Hit stream via per-shard device-side COMPACTION when the
+        key-plane engine answers the filter: each shard compacts its
+        matching row ids into a sized buffer and the shard-partitioned
+        buffers gather ONCE — id bytes instead of a full boolean plane
+        for selective queries. Anything else takes the inherited
+        mask-and-take path (identical results)."""
+        from geomesa_tpu import metrics
+        from geomesa_tpu.tracing import span
+
+        with self._lock:
+            f = self._parse(query)
+            if (
+                self._resolve_loose(loose)
+                and VIS_ID not in (self._cols or {})
+                and self._staged_len() > 0
+                and self._n_shards > 1
+            ):
+                lb = self._loose_bounds(f)
+                if lb is not None and not (len(lb) == 3 and lb[0] == "dim"):
+                    with span(
+                        "mesh.scan", op="query-compact",
+                        shards=self._n_shards, type=self.type_name,
+                    ):
+                        ids = self._mesh_hits(lb)
+                    if ids is not None:
+                        metrics.mesh_launches.inc()
+                        return self._host_rows().take(ids)
+            return super().query(query, loose=loose, auths=auths)
+
+    def fused_loose_counts(self, queries, loose: "bool | None" = None):
+        from geomesa_tpu import metrics
+        from geomesa_tpu.tracing import span
+
+        with self._lock, span(
+            "mesh.scan", op="fused-count", shards=self._n_shards,
+            queries=len(queries), type=self.type_name,
+        ):
+            out = super().fused_loose_counts(queries, loose=loose)
+        if out is not None:
+            metrics.mesh_launches.inc()
+        return out
+
+    def fused_loose_query(self, queries, loose: "bool | None" = None):
+        from geomesa_tpu import metrics
+        from geomesa_tpu.tracing import span
+
+        with self._lock, span(
+            "mesh.scan", op="fused-query", shards=self._n_shards,
+            queries=len(queries), type=self.type_name,
+        ):
+            out = super().fused_loose_query(queries, loose=loose)
+        if out is not None:
+            metrics.mesh_launches.inc()
+        return out
+
+    # -- rider endpoints (scan bodies live in DeviceIndex; one lock
+    # span so a concurrent refresh cannot republish planes mid-scan) ---
+
+    def stats(
+        self, query, spec: str, loose: "bool | None" = None, auths=None
+    ):
+        with self._lock:
+            return super().stats(query, spec, loose=loose, auths=auths)
+
+    def density(self, query, envelope, width, height,
+                weight_attr=None, loose=None, auths=None):
+        with self._lock:
+            return super().density(
+                query, envelope, width, height,
+                weight_attr=weight_attr, loose=loose, auths=auths,
+            )
+
+    def knn(self, px, py, k, query=None, auths=None, max_radius_deg=45.0):
+        with self._lock:
+            return super().knn(
+                px, py, k, query=query, auths=auths,
+                max_radius_deg=max_radius_deg,
+            )
+
+    def window_union_query(self, envs, times=None, auths=None, base=None):
+        with self._lock:
+            return super().window_union_query(
+                envs, times=times, auths=auths, base=base
+            )
+
+    def window_pairs_query(self, envs, auths=None, base=None):
+        with self._lock:
+            return super().window_pairs_query(envs, auths=auths, base=base)
+
+    def bin_export(self, query, track_attr, dtg_attr=None, geom_attr=None,
+                   label_attr=None, sort=False, loose=None, auths=None):
+        with self._lock:
+            return super().bin_export(
+                query, track_attr, dtg_attr=dtg_attr, geom_attr=geom_attr,
+                label_attr=label_attr, sort=sort, loose=loose, auths=auths,
+            )
+
+    def _mesh_hits(self, lb) -> "np.ndarray | None":
+        """Two sharded launches: per-shard hit counts (cheap scalar
+        vector) size a power-of-two compaction cap, then each shard
+        compacts its matching GLOBAL row ids on device and the
+        fixed-shape buffers gather once. Returns ascending staged-row
+        indices (identical to ``nonzero(mask)``)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from geomesa_tpu.ops import zscan
+        from geomesa_tpu.parallel.dist import shard_map
+
+        bounds, ids = lb
+        kind = self._z_kind
+        binned = ids is not None
+        plane_names = [Z_HI, Z_LO] + ([Z_BIN] if binned else [])
+        try:
+            planes = [self._cols[p] for p in plane_names]
+        except KeyError:
+            return None
+        local_n = planes[0].shape[0] // self._n_shards
+        if local_n == 0:
+            return None
+        mf = zscan.kind_mask_fn(kind)
+        has_valid = self._dev_valid is not None
+        axis = self._axis
+        mesh = self._mesh
+        n_shards = self._n_shards
+        spec = P(axis)
+        n_pl = len(planes)
+
+        def local_mask(args):
+            pl = args[:n_pl]
+            if binned:
+                m = mf(pl[0], pl[1], pl[2], args[n_pl], args[n_pl + 1])
+            else:
+                m = mf(pl[0], pl[1], args[n_pl])
+            if has_valid:
+                m = m & args[-1]
+            return m
+
+        ckey = ("mhits-count", kind, binned, has_valid)
+        cfn = self._hits_jits.get(ckey)
+        if cfn is None:
+            n_in = n_pl + (2 if binned else 1) + has_valid
+
+            @partial(
+                shard_map, mesh=mesh,
+                in_specs=(spec,) * n_pl + (P(),) * (2 if binned else 1)
+                + (spec,) * has_valid,
+                out_specs=spec, check_vma=False,
+            )
+            def count_step(*args):
+                return jnp.sum(local_mask(args), dtype=jnp.int32)[None]
+
+            cfn = jax.jit(count_step)
+            self._hits_jits[ckey] = cfn
+        operands = list(planes) + [bounds] + ([ids] if binned else [])
+        if has_valid:
+            operands.append(self._dev_valid)
+        counts = np.asarray(cfn(*operands))
+        top = int(counts.max()) if len(counts) else 0
+        if top == 0:
+            return np.zeros(0, np.int64)
+        cap = min(_next_pow2(top), local_n)
+        gkey = ("mhits-gather", kind, binned, has_valid, cap)
+        gfn = self._hits_jits.get(gkey)
+        if gfn is None:
+
+            @partial(
+                shard_map, mesh=mesh,
+                in_specs=(spec,) + (spec,) * n_pl
+                + (P(),) * (2 if binned else 1) + (spec,) * has_valid,
+                out_specs=(spec, spec), check_vma=False,
+            )
+            def gather_step(rid_l, *args):
+                m = local_mask(args)
+                pos = jnp.cumsum(m.astype(jnp.int32)) - 1
+                keep = m & (pos < cap)
+                idx = jnp.where(keep, pos, cap)  # cap = trash slot
+                buf = jnp.zeros((cap + 1,), rid_l.dtype).at[idx].set(rid_l)
+                hits = jnp.sum(m, dtype=jnp.int32)
+                out_valid = jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(
+                    hits, cap
+                )
+                return buf[:cap], out_valid
+
+            gfn = jax.jit(gather_step)
+            self._hits_jits[gkey] = gfn
+        got, gvalid = gfn(self._rid_plane, *operands)
+        out = np.asarray(got)[np.asarray(gvalid)].astype(np.int64)
+        # shard buffers concatenate in shard order and each shard's ids
+        # ascend, so the stream is globally ascending == nonzero(mask)
+        return out[out < self._n_staged]

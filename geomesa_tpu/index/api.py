@@ -91,6 +91,30 @@ class PartitionMeta:
 
 
 @dataclass
+class ShardMeta:
+    """Manifest entry for one mesh shard of a resident index (the
+    multi-chip twin of :class:`PartitionMeta`): which contiguous
+    globally-sorted key range the shard serves, and how much of the
+    dataset lives on it. Built by ``ShardedDeviceIndex`` at every
+    (re)stage and surfaced through ``/stats/mesh``."""
+
+    shard: int
+    rows: int  # real rows resident on the shard (padding excluded)
+    #: inclusive sort-key range the shard serves; None when the schema
+    #: has no spatial key (positional sharding) or the shard is empty
+    key_lo: "tuple | None" = None
+    key_hi: "tuple | None" = None
+
+    def to_json(self) -> dict:
+        return {
+            "shard": self.shard,
+            "rows": self.rows,
+            "key_lo": list(self.key_lo) if self.key_lo else None,
+            "key_hi": list(self.key_hi) if self.key_hi else None,
+        }
+
+
+@dataclass
 class BuiltIndex:
     """A fully built (sorted + partitioned) index over a feature set."""
 

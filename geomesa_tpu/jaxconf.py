@@ -15,6 +15,67 @@ from __future__ import annotations
 
 _enabled = False
 _cache_dir: "str | None" = None
+_cache_events = {"requests": 0, "hits": 0}
+_cache_listener = False
+
+
+def _install_cache_listener() -> None:
+    """Count persistent-cache hit/miss through jax's monitoring events
+    (the only portable signal; the cache itself logs nothing). Feeds the
+    ``geomesa_compile_cache_*`` metrics and ``compile_cache_stats()``
+    (the ``/stats`` document)."""
+    global _cache_listener
+    if _cache_listener:
+        return
+    _cache_listener = True
+    try:
+        from jax import monitoring
+
+        def _on_event(event, *a, **k):
+            if event == "/jax/compilation_cache/cache_hits":
+                _cache_events["hits"] += 1
+                from geomesa_tpu import metrics
+
+                metrics.compile_cache_hits.inc()
+            elif event == "/jax/compilation_cache/compile_requests_use_cache":
+                _cache_events["requests"] += 1
+                from geomesa_tpu import metrics
+
+                metrics.compile_cache_requests.inc()
+
+        monitoring.register_event_listener(_on_event)
+    except Exception:  # pragma: no cover - jax without monitoring
+        pass
+
+
+def compile_cache_stats() -> dict:
+    """Persistent-compile-cache snapshot for ``/stats``: directory,
+    event-derived hit/miss counts, and on-disk entry count/bytes."""
+    import os
+
+    d: dict = {
+        "dir": _cache_dir,
+        "enabled": _cache_dir is not None,
+        "requests": _cache_events["requests"],
+        "hits": _cache_events["hits"],
+        "misses": max(
+            0, _cache_events["requests"] - _cache_events["hits"]
+        ),
+    }
+    if _cache_dir:
+        try:
+            entries = 0
+            size = 0
+            with os.scandir(_cache_dir) as it:
+                for e in it:
+                    if e.is_file():
+                        entries += 1
+                        size += e.stat().st_size
+            d["entries"] = entries
+            d["bytes"] = size
+        except OSError:  # pragma: no cover - cache dir raced away
+            pass
+    return d
 
 
 def enable_compilation_cache(path: "str | None" = None) -> "str | None":
@@ -34,9 +95,21 @@ def enable_compilation_cache(path: "str | None" = None) -> "str | None":
     when disabled)."""
     global _cache_dir
     if _cache_dir is not None:
+        _install_cache_listener()
         return _cache_dir
     import os
 
+    if path is None:
+        # the compile.cache.dir conf key (GT008-declared) is the serving
+        # deployment's knob — "" defers to the env/default resolution
+        try:
+            from geomesa_tpu.conf import sys_prop
+
+            path = str(sys_prop("compile.cache.dir")) or None
+        except Exception:  # pragma: no cover - conf import cycles
+            path = None
+    if path and path.lower() in ("off", "0", "none", "disabled"):
+        return None
     env = os.environ.get("GEOMESA_TPU_COMPILE_CACHE", "")
     if env.lower() in ("off", "0", "none", "disabled"):
         return None
@@ -56,7 +129,25 @@ def enable_compilation_cache(path: "str | None" = None) -> "str | None":
     except Exception:
         pass  # older jax: size gate not configurable
     _cache_dir = path
+    _install_cache_listener()
     return path
+
+
+def scoped_x64():
+    """Context manager enabling 64-bit jax types for the calls traced
+    inside it, across jax versions: newer jax exports ``jax.enable_x64``;
+    older installs only have ``jax.experimental.enable_x64``. Callers
+    that need bit-exact float64 quantization for a single jitted encode
+    (device_cache staging) use this instead of flipping the process-wide
+    default."""
+    import jax
+
+    cm = getattr(jax, "enable_x64", None)
+    if cm is not None:
+        return cm()
+    from jax.experimental import enable_x64  # pragma: no cover - old jax
+
+    return enable_x64()
 
 
 def require_x64() -> None:

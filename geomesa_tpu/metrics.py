@@ -383,6 +383,52 @@ sched_drains = REGISTRY.counter(
     "graceful drains completed (admission stopped, in-flight finished)",
 )
 
+# multi-chip sharded serving (parallel/dist.py + device_cache.py): mesh
+# topology and residency per shard (bounded labels: shard indexes are
+# capped by the device count), mesh-wide scan launches, exchange
+# capacity retries (an adversarial layout relaunched at the measured
+# block bound) and mesh builds that fell back to the host sort
+mesh_shards = REGISTRY.gauge(
+    "geomesa_mesh_shards",
+    "shards in the serving mesh (0 = single-device serving)",
+)
+mesh_resident_rows = REGISTRY.gauge(
+    "geomesa_mesh_resident_rows",
+    "resident rows per mesh shard (shard label; padding excluded)",
+)
+mesh_resident_bytes = REGISTRY.gauge(
+    "geomesa_mesh_resident_bytes",
+    "resident device bytes per mesh shard (shard label)",
+)
+mesh_launches = REGISTRY.counter(
+    "geomesa_mesh_launches_total",
+    "mesh-wide sharded scan launches (fused groups count once)",
+)
+mesh_build_seconds = REGISTRY.histogram(
+    "geomesa_mesh_build_seconds",
+    "mesh-resident index build time (distributed sort + shard staging)",
+)
+mesh_exchange_retries = REGISTRY.counter(
+    "geomesa_mesh_exchange_retries_total",
+    "distributed-sort exchanges relaunched at the measured capacity",
+)
+mesh_build_fallbacks = REGISTRY.counter(
+    "geomesa_mesh_build_fallbacks_total",
+    "mesh index builds that degraded to the host sort",
+)
+
+# persistent serving compile cache (jaxconf.py): task-level hit/miss as
+# observed through jax's compilation-cache monitoring events
+compile_cache_hits = REGISTRY.counter(
+    "geomesa_compile_cache_hits_total",
+    "XLA executables loaded from the persistent compilation cache",
+)
+compile_cache_requests = REGISTRY.counter(
+    "geomesa_compile_cache_requests_total",
+    "XLA compilations eligible for the persistent cache (misses = "
+    "requests - hits)",
+)
+
 # per-request tracing (tracing.py): how many traces the ring retained
 # (head-sampled or slow-captured) and how many crossed the slow-query
 # threshold (trace.slow_ms) — the rate the slow-query log grows at

@@ -5,9 +5,15 @@ from __future__ import annotations
 import numpy as np
 
 
-def make_mesh(n_devices: "int | None" = None, axes: "tuple[str, ...]" = ("shard",)):
+def make_mesh(
+    n_devices: "int | None" = None,
+    axes: "tuple[str, ...]" = ("shard",),
+    replicas: "int | None" = None,
+):
     """Build a Mesh over the first n devices. With two axis names the
-    devices are factored (shard-major)."""
+    devices are factored shard x replica: ``replicas`` pins the replica
+    axis size (n must divide by it); unset, the factoring prefers more
+    shards (replica axis 2 when n is even, else 1)."""
     import jax
     from jax.sharding import Mesh
 
@@ -15,10 +21,42 @@ def make_mesh(n_devices: "int | None" = None, axes: "tuple[str, ...]" = ("shard"
     n = len(devices) if n_devices is None else n_devices
     devices = np.array(devices[:n])
     if len(axes) == 1:
+        if replicas not in (None, 1):
+            raise ValueError(
+                f"replicas={replicas} needs a two-axis mesh (shard, replica)"
+            )
         return Mesh(devices, axes)
     if len(axes) == 2:
+        if replicas is not None:
+            if replicas < 1 or n % replicas:
+                raise ValueError(
+                    f"cannot factor {n} devices into shard x {replicas} "
+                    "replicas"
+                )
+            return Mesh(devices.reshape(n // replicas, replicas), axes)
         # factor n = shard * replica, preferring more shards
         for r in (2, 1):
             if n % r == 0 and n // r >= 1:
                 return Mesh(devices.reshape(n // r, r), axes)
     raise ValueError(f"cannot build mesh with axes {axes} over {n} devices")
+
+
+def serving_mesh(
+    n_devices: "int | None" = None, replicas: "int | None" = None
+):
+    """The resident-serving mesh, shaped by the ``mesh.*`` conf keys:
+    ``mesh.devices`` (0 = every visible device) sharded over a ``shard``
+    axis, with a ``replica`` axis when ``mesh.replicas`` > 1 (the
+    resident planes replicate across it — hot-dataset replication for
+    failure isolation and fan-out). Arguments override the conf keys."""
+    from geomesa_tpu.conf import sys_prop
+
+    if n_devices is None:
+        n_devices = int(sys_prop("mesh.devices")) or None
+    if replicas is None:
+        replicas = int(sys_prop("mesh.replicas"))
+    if replicas > 1:
+        return make_mesh(
+            n_devices, axes=("shard", "replica"), replicas=replicas
+        )
+    return make_mesh(n_devices)

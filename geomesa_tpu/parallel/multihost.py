@@ -57,12 +57,16 @@ def initialize(
             raise
 
 
-def global_mesh(axes: "tuple[str, ...]" = ("shard",)):
+def global_mesh(
+    axes: "tuple[str, ...]" = ("shard",), replicas: "int | None" = None
+):
     """Mesh over ALL devices in the process group (jax.devices() spans
-    hosts after initialize()); same axis semantics as make_mesh."""
+    hosts after initialize()); same axis/replica semantics as
+    make_mesh, so a multi-host pod can run the same sharded serving
+    topology the single-host ``mesh.*`` conf keys describe."""
     from geomesa_tpu.parallel.mesh import make_mesh
 
-    return make_mesh(None, axes)
+    return make_mesh(None, axes, replicas=replicas)
 
 
 def host_batches_to_global(mesh, cols: dict, axis: str = "shard") -> dict:

@@ -474,6 +474,7 @@ REASONS = frozenset(
         "cache-breaker-open",
         "partition-unavailable",
         "brownout-pushdown",
+        "mesh-degraded",
     }
 )
 

@@ -42,6 +42,13 @@ class FusableQuery:
         device launch (the index makes the final call)."""
         return (id(self.di), self.op, bool(self.loose), self.auths)
 
+    @property
+    def mesh_shards(self) -> int:
+        """Shards the index's launches span (0 = single-device index) —
+        rides the scheduler's launch spans so a trace shows whether a
+        fused group ran mesh-wide."""
+        return int(getattr(self.di, "mesh_shards", 0) or 0)
+
     def run_serial(self):
         """The unfused (exact-parity) execution of this one query."""
         if self.op == "count":
@@ -51,11 +58,21 @@ class FusableQuery:
 
 
 def execute_group(specs: "list[FusableQuery]"):
-    """Run a compatible group as ONE batched device launch. Returns the
-    per-query results aligned with ``specs``, or None when the index
-    declines to fuse (caller falls back to serial)."""
+    """Run a compatible group as ONE batched device launch — on a
+    mesh-sharded index that launch is SPMD across every shard (each
+    shard scans its resident Z-range for the whole stacked query set;
+    partial counts all-reduce, hit planes gather once), so the fused
+    micro-batch costs one mesh-wide kernel pass, not queries x shards.
+    Returns the per-query results aligned with ``specs``, or None when
+    the index declines to fuse (caller falls back to serial)."""
+    from geomesa_tpu.tracing import span
+
     di = specs[0].di
     queries = [s.query for s in specs]
-    if specs[0].op == "count":
-        return di.fused_loose_counts(queries, loose=specs[0].loose)
-    return di.fused_loose_query(queries, loose=specs[0].loose)
+    with span(
+        "fusion.launch", op=specs[0].op, queries=len(queries),
+        shards=specs[0].mesh_shards,
+    ):
+        if specs[0].op == "count":
+            return di.fused_loose_counts(queries, loose=specs[0].loose)
+        return di.fused_loose_query(queries, loose=specs[0].loose)

@@ -639,10 +639,12 @@ class QueryScheduler:
             dur = time.perf_counter() - now_perf
             with self._cv:
                 self._observe_service_locked(dur, len(live))
+            shards = live[0].fuse.mesh_shards
             for r, v in zip(live, fused):
                 tracing.record_span(
                     r.ctx, "sched.execute", now_perf, dur,
                     launch=launch_id, fused=len(live), lane=r.lane,
+                    shards=shards,
                 )
                 self._finish(r, result=v)
             return

@@ -36,6 +36,10 @@ Endpoints (all GET):
   (sched mode: queue depth, wait time, fusion factor, rejections)
 - ``/stats/store``                  -- store durability/integrity snapshot
   (FS stores: generations, quarantined partitions, recovery counters)
+- ``/stats/mesh``                   -- serving-mesh topology + per-type
+  shard residency (rows/bytes/Z-key range per shard, build engine)
+- ``/stats``                        -- roll-up: sched + store + mesh +
+  persistent compile-cache hit/miss in one scrape
 - ``/debug/traces``                 -- recent request traces (summaries;
   ``?limit=``)
 - ``/debug/traces/<id>``            -- one trace's full span tree;
@@ -129,6 +133,7 @@ class _GeomesaHTTPServer(ThreadingHTTPServer):
 class _Handler(BaseHTTPRequestHandler):
     store = None  # injected by make_server
     resident = False  # serve from device-pinned DeviceIndex caches
+    mesh = False  # shard resident indexes across the device mesh
     scheduler = None  # QueryScheduler (admission + micro-batch fusion)
     _resident_cache: dict = {}  # per-server-class: type -> DeviceIndex
     _resident_lock = None  # per-server-class construction lock
@@ -205,14 +210,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _build_locked(self, type_name: str):
         """First-touch resident build under the construction lock;
-        returns (index, built_now)."""
+        returns (index, built_now). Mesh mode (``mesh.enabled`` or
+        ``make_server(mesh=True)``) with more than one visible device
+        stages a :class:`~geomesa_tpu.device_cache.ShardedDeviceIndex`
+        — the type's planes shard across the serving mesh by global
+        Z-key range and every scan launches mesh-wide."""
         cache = self._resident_cache
         with self._resident_lock:
             if type_name in cache:
                 return cache[type_name], False
-            from geomesa_tpu.device_cache import StreamingDeviceIndex
-
-            di = StreamingDeviceIndex(self.store, type_name, z_planes=True)
+            di = _make_resident_index(self.store, type_name, self.mesh)
             cache[type_name] = di
             return di, True
 
@@ -383,7 +390,7 @@ class _Handler(BaseHTTPRequestHandler):
         ) or (
             parts == ["stats", "store"]
             and hasattr(self.store, "store_stats")
-        )
+        ) or parts == ["stats", "mesh"] or parts == ["stats"]
         if untraced:
             self._trace = None
             self._degraded = None
@@ -530,6 +537,10 @@ class _Handler(BaseHTTPRequestHandler):
             self.store, "store_stats"
         ):
             return self._json(200, self.store.store_stats())
+        if parts == ["stats", "mesh"]:
+            return self._json(200, self._mesh_stats())
+        if parts == ["stats"]:
+            return self._json(200, self._stats_index())
         if len(parts) == 2 and parts[0] in (
             "features", "count", "explain", "density", "stats",
             "refresh", "knn", "tube", "proximity",
@@ -548,6 +559,36 @@ class _Handler(BaseHTTPRequestHandler):
             handler = getattr(self, f"_{parts[0]}")
             return handler(unquote(parts[1]), q)
         self._json(404, {"error": f"no such endpoint {url.path!r}"})
+
+    def _mesh_stats(self) -> dict:
+        """``/stats/mesh``: serving-mesh topology + per-type shard
+        residency (rows, bytes, Z-key range and build engine per shard)
+        for every mesh-resident type staged so far."""
+        import jax
+
+        doc: dict = {
+            "enabled": bool(self.mesh),
+            "devices_visible": len(jax.devices()),
+            "types": {},
+        }
+        for name, di in list(self._resident_cache.items()):
+            stats = getattr(di, "mesh_stats", None)
+            if stats is not None:
+                doc["types"][name] = stats()
+        return doc
+
+    def _stats_index(self) -> dict:
+        """``/stats``: one roll-up document — scheduler, store, mesh and
+        the persistent compile cache (hit/miss) in a single scrape."""
+        from geomesa_tpu.jaxconf import compile_cache_stats
+
+        doc: dict = {"compile_cache": compile_cache_stats()}
+        if self.scheduler is not None:
+            doc["sched"] = self.scheduler.snapshot()
+        if hasattr(self.store, "store_stats"):
+            doc["store"] = self.store.store_stats()
+        doc["mesh"] = self._mesh_stats()
+        return doc
 
     def _debug_traces(self, parts: list, q: dict) -> None:
         """``/debug/traces`` (recent summaries) and
@@ -997,9 +1038,37 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
 
+def _mesh_serving_enabled(mesh) -> bool:
+    """Resolve the mesh-serving switch: an explicit ``make_server``
+    argument wins, else the ``mesh.enabled`` conf key; either way the
+    mesh path needs more than one visible device (a 1-device mesh is
+    just single-chip serving with extra steps)."""
+    from geomesa_tpu.conf import sys_prop
+
+    if mesh is None:
+        mesh = bool(sys_prop("mesh.enabled"))
+    if not mesh:
+        return False
+    import jax
+
+    n = int(sys_prop("mesh.devices")) or len(jax.devices())
+    return min(n, len(jax.devices())) > 1
+
+
+def _make_resident_index(store, type_name: str, mesh: bool):
+    """One resident index, mesh-sharded when mesh serving is on."""
+    if mesh:
+        from geomesa_tpu.device_cache import ShardedDeviceIndex
+
+        return ShardedDeviceIndex(store, type_name, z_planes=True)
+    from geomesa_tpu.device_cache import StreamingDeviceIndex
+
+    return StreamingDeviceIndex(store, type_name, z_planes=True)
+
+
 def make_server(
     store, host: str = "127.0.0.1", port: int = 0, resident: bool = False,
-    warm: bool = False, sched=None, io=None,
+    warm: bool = False, sched=None, io=None, mesh: "bool | None" = None,
 ):
     """Build a ThreadingHTTPServer bound to (host, port); port 0 picks an
     ephemeral port (see ``server.server_address``). ``resident=True``
@@ -1023,7 +1092,21 @@ def make_server(
     (a :class:`~geomesa_tpu.store.prefetch.PrefetchConfig` or an int
     worker count; None keeps the store's own / the ``io.*`` system
     properties). Prefetch health is visible on ``/metrics`` as the
-    ``geomesa_io_*`` series."""
+    ``geomesa_io_*`` series.
+
+    ``mesh`` (or the ``mesh.enabled`` conf key) shards each resident
+    type across the serving device mesh by global Z-key range
+    (ShardedDeviceIndex): every count/features/stats/density/kNN scan —
+    including the scheduler's fused micro-batches — runs as ONE
+    mesh-wide SPMD launch, ``/stats/mesh`` reports the topology and
+    per-shard residency, and a failed shard launch degrades down the
+    PR 7 ladder instead of failing the query. Needs > 1 visible jax
+    device; topology comes from ``mesh.devices`` / ``mesh.replicas``.
+
+    The persistent XLA compile cache is wired here from the
+    ``compile.cache.dir`` conf key (serving is compile-heavy; a
+    restarted server warms from disk) — hit/miss counts ride
+    ``/stats`` and the ``geomesa_compile_cache_*`` metrics."""
     import os as _os
 
     from geomesa_tpu.jaxconf import enable_compilation_cache
@@ -1031,6 +1114,7 @@ def make_server(
     from geomesa_tpu.tracing import TRACER
 
     enable_compilation_cache()
+    mesh_on = resident and _mesh_serving_enabled(mesh)
     preload_pyarrow()  # handler threads serve Arrow; see pyarrow_compat
     if io is not None and hasattr(store, "io"):
         store.io = io
@@ -1059,6 +1143,7 @@ def make_server(
         {
             "store": store,
             "resident": resident,
+            "mesh": mesh_on,
             "scheduler": scheduler,
             "_resident_cache": {},
             # blocking_ok: first-touch resident builds hold it across
@@ -1072,14 +1157,12 @@ def make_server(
     if resident and warm:
         import warnings
 
-        from geomesa_tpu.device_cache import StreamingDeviceIndex
-
         for tn in store.type_names:
             # a type that fails to stage (e.g. device OOM) must not keep
             # the OTHER types from serving — same isolation the lazy
             # first-touch path gives: that type just isn't resident
             try:
-                di = StreamingDeviceIndex(store, tn, z_planes=True)
+                di = _make_resident_index(store, tn, mesh_on)
                 di.warmup()
             except Exception as e:
                 warnings.warn(f"warm staging failed for {tn!r}: {e!r}")
@@ -1093,12 +1176,13 @@ def make_server(
 
 def serve_background(
     store, host: str = "127.0.0.1", port: int = 0, resident: bool = False,
-    warm: bool = False, sched=None, io=None,
+    warm: bool = False, sched=None, io=None, mesh: "bool | None" = None,
 ):
     """Start serving on a daemon thread; returns (server, thread). Stop
     with ``server.shutdown()``."""
     server = make_server(
-        store, host, port, resident=resident, warm=warm, sched=sched, io=io
+        store, host, port, resident=resident, warm=warm, sched=sched,
+        io=io, mesh=mesh,
     )
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()

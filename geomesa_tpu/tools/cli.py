@@ -23,7 +23,8 @@ Runner/IngestCommand/ExportCommand/ExplainCommand/StatsCommand):
     geomesa-tpu compact        --root DIR -f NAME
     geomesa-tpu fsck           --root DIR [-f NAME] [--no-verify]
                                (recovery sweep + checksum verify)
-    geomesa-tpu serve          --root DIR [--resident] [--warm] [--sched]
+    geomesa-tpu serve          --root DIR [--resident] [--warm] [--mesh]
+                               [--sched]
     geomesa-tpu trace          --url http://host:port [TRACE_ID]
                                [--perfetto -o out.json] (request traces
                                from /debug/traces, pretty span tree)
@@ -633,11 +634,14 @@ def cmd_serve(args):
     server = make_server(
         store, args.host, args.port, resident=args.resident,
         warm=getattr(args, "warm", False), sched=_sched_config(args),
+        mesh=True if getattr(args, "mesh", False) else None,
     )
     host, port = server.server_address[:2]
     mode = " (resident device caches)" if args.resident else ""
     if getattr(args, "sched", False):
         mode += " (query scheduler)"
+    if getattr(server.RequestHandlerClass, "mesh", False):
+        mode += " (mesh-sharded)"
     print(f"serving {store.root} on http://{host}:{port}{mode}")
     try:
         server.serve_forever()
@@ -970,6 +974,13 @@ def main(argv=None) -> None:
         help="with --resident: stage every type and pre-compile its "
         "serving kernels before accepting traffic (no request pays a "
         "first-touch staging or XLA compile)",
+    )
+    sp.add_argument(
+        "--mesh",
+        action="store_true",
+        help="with --resident: shard each type across the device mesh "
+        "by global Z-key range (needs > 1 jax device; topology from "
+        "the mesh.* conf keys, residency on /stats/mesh)",
     )
     _add_sched_flags(sp)
     _add_io_flags(sp)

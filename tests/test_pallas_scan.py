@@ -3,6 +3,8 @@ CPU -- the same kernel code the TPU runs, per SURVEY.md section 4 rebuild
 test plan)."""
 
 import numpy as np
+
+from geomesa_tpu.jaxconf import scoped_x64
 import pytest
 
 from geomesa_tpu.features.batch import FeatureBatch
@@ -72,7 +74,7 @@ def test_mosaic_mod_recursion_repro():
     old = sys.getrecursionlimit()
     sys.setrecursionlimit(20000)
     try:
-        with jax.enable_x64():
+        with scoped_x64():
 
             def kern_mod(x_ref, o_ref):
                 o_ref[...] = x_ref[...].astype(jnp.int32) % 2
@@ -104,7 +106,7 @@ def test_pip_kernel_parity_under_x64():
     batch = make_batch(rng, 4096)
     ecql = FILTERS[6]
     compiled = compile_filter(parse_ecql(ecql), SFT)
-    with jax.enable_x64():
+    with scoped_x64():
         scan = compiled.pallas_scan()
         assert scan is not None
         cols = stage_columns(batch, list(compiled.device_cols))

@@ -67,7 +67,9 @@ class LintContext:
     registries (declared conf keys, registered failpoint names) parsed
     STATICALLY from source so linting never imports the linted code."""
 
-    def __init__(self, path, rel, src, tree, conf_keys, failpoints):
+    def __init__(
+        self, path, rel, src, tree, conf_keys, failpoints, slo_registries=None
+    ):
         self.path = path
         self.rel = rel.replace(os.sep, "/")
         self.src = src
@@ -75,6 +77,12 @@ class LintContext:
         self.tree = tree
         self.conf_keys = conf_keys
         self.failpoints = failpoints
+        # the GT009 registries: declared SLO names + flight-recorder
+        # reasons (slo.py) and ledger cost fields (ledger.py)
+        sr = slo_registries or {}
+        self.slo_names = sr.get("slo_names", frozenset())
+        self.flight_reasons = sr.get("flight_reasons", frozenset())
+        self.ledger_fields = sr.get("ledger_fields", frozenset())
 
     def finding(self, rule: str, node, message: str) -> Finding:
         return Finding(
@@ -161,12 +169,18 @@ def _parse_conf_keys(root: str) -> "frozenset[str]":
 
 def _parse_failpoints(root: str) -> "frozenset[str]":
     """The GT005 registry: the ``POINTS`` tuple in failpoints.py."""
-    path = _find_source(root, "failpoints.py")
+    return _parse_str_tuple(root, "failpoints.py", "POINTS")
+
+
+def _parse_str_tuple(root: str, fname: str, target: str) -> "frozenset[str]":
+    """String elements of a module-level tuple/list assignment, parsed
+    statically (the shared mechanism behind the GT005/GT009 registries)."""
+    path = _find_source(root, fname)
     if path is None:
         return frozenset()
     try:
         with open(path) as fh:
-            value = _assigned_node(ast.parse(fh.read()), "POINTS")
+            value = _assigned_node(ast.parse(fh.read()), target)
     except (OSError, SyntaxError):
         return frozenset()
     if not isinstance(value, (ast.Tuple, ast.List)):
@@ -176,6 +190,18 @@ def _parse_failpoints(root: str) -> "frozenset[str]":
         for e in value.elts
         if isinstance(e, ast.Constant) and isinstance(e.value, str)
     )
+
+
+def _parse_slo_registries(root: str) -> dict:
+    """The GT009 registries: SLO names and flight-recorder reasons from
+    slo.py, ledger cost fields from ledger.py."""
+    return {
+        "slo_names": _parse_str_tuple(root, "slo.py", "SLO_NAMES"),
+        "flight_reasons": _parse_str_tuple(
+            root, "slo.py", "FLIGHT_REASONS"
+        ),
+        "ledger_fields": _parse_str_tuple(root, "ledger.py", "FIELDS"),
+    }
 
 
 # -- driver ------------------------------------------------------------------
@@ -198,11 +224,14 @@ def lint_file(
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
         return [Finding("GT000", path, e.lineno or 1, 1, f"syntax error: {e.msg}")]
-    conf_keys, failpoints = _registries or (
+    conf_keys, failpoints, slo_registries = _registries or (
         _parse_conf_keys(root),
         _parse_failpoints(root),
+        _parse_slo_registries(root),
     )
-    ctx = LintContext(path, rel, src, tree, conf_keys, failpoints)
+    ctx = LintContext(
+        path, rel, src, tree, conf_keys, failpoints, slo_registries
+    )
     disabled = _disabled_rules(ctx.lines)
     findings: list = []
     seen = set()  # nested withs/loops walk shared sub-trees: dedupe
@@ -248,7 +277,11 @@ def lint_paths(paths, rules=None) -> "list[Finding]":
     for p in paths:
         p = os.path.abspath(p)
         if os.path.isdir(p):
-            registries = (_parse_conf_keys(p), _parse_failpoints(p))
+            registries = (
+                _parse_conf_keys(p),
+                _parse_failpoints(p),
+                _parse_slo_registries(p),
+            )
             for f in _iter_py_files(p):
                 findings += lint_file(
                     f,

@@ -1,4 +1,4 @@
-"""The GT001-GT008 rule modules, one per rule, plus shared AST helpers.
+"""The GT001-GT009 rule modules, one per rule, plus shared AST helpers.
 
 A rule module exposes ``CODE`` (the GTnnn id), ``TITLE`` (one line for
 the README/CLI table) and ``check(ctx)`` yielding
@@ -23,6 +23,7 @@ from geomesa_tpu.analysis.rules import (
     gt006_metric_discipline,
     gt007_publish_fsync,
     gt008_conf_keys,
+    gt009_slo_registries,
 )
 
 ALL_RULES = (
@@ -34,6 +35,7 @@ ALL_RULES = (
     gt006_metric_discipline,
     gt007_publish_fsync,
     gt008_conf_keys,
+    gt009_slo_registries,
 )
 
 RULE_TABLE = [(r.CODE, r.TITLE) for r in ALL_RULES]

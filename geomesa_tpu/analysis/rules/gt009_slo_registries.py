@@ -1,0 +1,76 @@
+"""GT009: SLO names, ledger fields and flight-recorder reasons must
+come from their declared registries.
+
+The SLO/ledger layer (ISSUE 9) keys everything by short strings: a
+``charge("device_secconds", ...)`` typo would silently mint a cost
+column nobody aggregates, an unregistered flight-recorder reason would
+name bundle directories (and a metric label) outside the bounded enum,
+and an unknown SLO name would KeyError at runtime on the first scrape.
+Same static-parse discipline as GT006 metrics / GT008 conf keys: the
+registries (``FIELDS`` in ledger.py, ``SLO_NAMES`` / ``FLIGHT_REASONS``
+in slo.py) are parsed from source, never imported, and every literal
+call-site argument is validated against them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from geomesa_tpu.analysis.astutil import (
+    receiver_name,
+    str_arg,
+    terminal_name,
+)
+
+CODE = "GT009"
+TITLE = (
+    "SLO name / ledger field / flight-recorder reason not in its "
+    "declared registry"
+)
+
+
+def check(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = terminal_name(node.func)
+        arg = str_arg(node)
+        if arg is None:
+            continue
+        if name == "charge" and ctx.ledger_fields:
+            # ledger.charge / RequestCost.charge / cost.charge — all
+            # take a FIELDS name first
+            if arg not in ctx.ledger_fields:
+                yield ctx.finding(
+                    CODE,
+                    node,
+                    f"ledger field {arg!r} is not declared in "
+                    "ledger.FIELDS -- declare it (and document what it "
+                    "measures) or fix the name",
+                )
+        elif name == "trigger" and ctx.flight_reasons:
+            # FlightRecorder.trigger: only flag receivers that are
+            # clearly the flight recorder (FLIGHTREC.trigger,
+            # self.flightrec.trigger, recorder.trigger) — a generic
+            # .trigger() elsewhere is none of this rule's business
+            recv = (receiver_name(node.func) or "").lower()
+            if (
+                ("flight" in recv or recv.endswith("rec"))
+                and arg not in ctx.flight_reasons
+            ):
+                yield ctx.finding(
+                    CODE,
+                    node,
+                    f"flight-recorder reason {arg!r} is not declared in "
+                    "slo.FLIGHT_REASONS -- reasons are a bounded enum "
+                    "(bundle dir names + metric label)",
+                )
+        elif name == "slo_def" and ctx.slo_names:
+            if arg not in ctx.slo_names:
+                yield ctx.finding(
+                    CODE,
+                    node,
+                    f"SLO name {arg!r} is not declared in slo.SLO_NAMES "
+                    "-- register it (and its slo.<name>.* conf keys) or "
+                    "fix the name",
+                )

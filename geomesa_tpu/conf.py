@@ -110,6 +110,36 @@ Properties:
                                 ~/.cache default; ``off`` disables) —
                                 wired at make_server / CLI serve start,
                                 hit/miss surfaced in /stats
+- ``slo.enabled``               serving SLO engine master switch
+                                (slo.py): windowed latency tracking,
+                                burn rates, /stats/slo, the flight
+                                recorder
+- ``slo.<name>.objective``      fraction of requests that must answer
+                                under the threshold (error budget =
+                                1 - objective); one set of keys per
+                                registered SLO name (slo.SLO_NAMES:
+                                ``interactive``, ``batch``)
+- ``slo.<name>.threshold.ms``   the latency bar a GOOD request answers
+                                under (5xx responses are always bad)
+- ``slo.<name>.window.s``       the slow burn window (and the windowed
+                                histogram ring span) for this SLO
+- ``slo.burn.fast.s``           the fast burn window shared by every
+                                SLO (classic multi-window burn alerts:
+                                fast 5m / slow 1h)
+- ``slo.flightrec.burn``        fast-window burn rate at which the
+                                flight recorder snapshots a postmortem
+                                bundle (0 disables the burn trigger;
+                                breaker-open triggers stay on)
+- ``slo.flightrec.keep``        bundles retained under _flightrec/
+                                (oldest pruned past this)
+- ``slo.flightrec.interval.s``  min seconds between bundles PER REASON
+                                (a sustained burn must not disk-flood)
+- ``ledger.enabled``            per-request cost ledger master switch
+                                (ledger.py): request cost collection,
+                                compile attribution, /stats/ledger
+- ``ledger.topk``               entries per ranking in the
+                                /stats/ledger document (tenants,
+                                shapes, top requests, compile sigs)
 """
 
 from __future__ import annotations
@@ -222,6 +252,25 @@ _DEFS = {
     # persistent serving compile cache (jaxconf.py): directory override
     # ("" = env/default resolution, "off" disables)
     "compile.cache.dir": ("", str),
+    # serving SLO engine (slo.py): master switch, one
+    # objective/threshold/window triple per registered SLO name
+    # (slo.SLO_NAMES), the shared fast burn window, and the flight
+    # recorder's trigger threshold / retention / rate limit
+    "slo.enabled": (True, _parse_bool),
+    "slo.interactive.objective": (0.999, float),
+    "slo.interactive.threshold.ms": (500.0, float),
+    "slo.interactive.window.s": (3600.0, float),
+    "slo.batch.objective": (0.99, float),
+    "slo.batch.threshold.ms": (5000.0, float),
+    "slo.batch.window.s": (3600.0, float),
+    "slo.burn.fast.s": (300.0, float),
+    "slo.flightrec.burn": (8.0, float),
+    "slo.flightrec.keep": (8, int),
+    "slo.flightrec.interval.s": (60.0, float),
+    # per-request cost ledger (ledger.py): master switch and the
+    # /stats/ledger ranking size
+    "ledger.enabled": (True, _parse_bool),
+    "ledger.topk": (10, int),
 }
 
 _overrides: dict = {}

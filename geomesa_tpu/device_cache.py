@@ -729,7 +729,10 @@ class DeviceIndex:
 
         rows_hint = getattr(self.store, "manifest_rows", None)
         hint = int(rows_hint(self.type_name)) if rows_hint else -1
-        with span("cache.stage", type=self.type_name, rows_hint=hint):
+        from geomesa_tpu import ledger
+
+        with span("cache.stage", type=self.type_name, rows_hint=hint), \
+                ledger.compile_scope("cache.stage"):
             res = self.store.query(self.type_name, _staging_query())
             self._bin_range = None
             self._bt_base = None
@@ -1245,11 +1248,15 @@ class DeviceIndex:
             if r == 0
             else (self._cols[Z_NX], self._cols[Z_NY], self._cols[Z_BT])
         )
-        out = fn(
-            planes,
-            jnp.asarray(qmat),
-            self._device_valid() if want == "count" else None,
-        )
+        from geomesa_tpu import ledger
+
+        # r and qcap are pow2-bucketed: the signature space stays bounded
+        with ledger.compile_scope(f"fused.dim:r={r}:q={qcap}:{want}"):
+            out = fn(
+                planes,
+                jnp.asarray(qmat),
+                self._device_valid() if want == "count" else None,
+            )
         return out[: len(lbs)]
 
     def _fused_compare(self, lbs, qcap, want: str):
@@ -1310,14 +1317,17 @@ class DeviceIndex:
 
             fn = jax.jit(_run)
             self._fused_jits[key] = fn
-        out = fn(
-            self._cols[Z_HI],
-            self._cols[Z_LO],
-            self._cols.get(Z_BIN) if binned else None,
-            jnp.asarray(bounds),
-            jnp.asarray(idm) if idm is not None else None,
-            self._device_valid() if want == "count" else None,
-        )
+        from geomesa_tpu import ledger
+
+        with ledger.compile_scope(f"fused.cmp:{kind}:q={qcap}:{want}"):
+            out = fn(
+                self._cols[Z_HI],
+                self._cols[Z_LO],
+                self._cols.get(Z_BIN) if binned else None,
+                jnp.asarray(bounds),
+                jnp.asarray(idm) if idm is not None else None,
+                self._device_valid() if want == "count" else None,
+            )
         return out[: len(lbs)]
 
     def mask(
@@ -1665,10 +1675,16 @@ class DeviceIndex:
         if compiled is not None:
             wanted += [c for c in compiled.device_cols if c not in wanted]
         sub = {c: self._cols[c] for c in wanted}
-        d2, idx = fn(
-            sub, q, self._device_valid(),
-            self._auth_table(auths) if has_vis else None,
-        )
+        from geomesa_tpu import ledger
+
+        # compile attribution: a cold kNN kernel is THE headline compile
+        # cliff (ROADMAP item 4) — tag it so the compile ledger can say
+        # which k-bucket ate whose deadline (kk is pow2: bounded sigs)
+        with ledger.compile_scope(f"knn:k={kk}:filtered={f is not None}"):
+            d2, idx = fn(
+                sub, q, self._device_valid(),
+                self._auth_table(auths) if has_vis else None,
+            )
         d2 = np.asarray(d2)
         idx = np.asarray(idx)
         ok = np.isfinite(d2)
@@ -2086,13 +2102,21 @@ class DeviceIndex:
 
             cached = jax.jit(fused)
             self._agg_cache[key] = cached
-        return cached(
-            self._cols,
-            (lb[1] if dim_loose else lb) if kind == "loose" else None,
-            self._device_valid(),
-            extra,
-            self._auth_table(auths) if has_vis else None,
-        )
+        from geomesa_tpu import ledger
+
+        # agg_key may nest stat-spec tuples: keep only its plain-string
+        # tags so the compile signature stays a short bounded token
+        agg_tag = "+".join(
+            a for a in agg_key if isinstance(a, str)
+        ) or "stats"
+        with ledger.compile_scope(f"fused.agg:{kind}:{agg_tag}"):
+            return cached(
+                self._cols,
+                (lb[1] if dim_loose else lb) if kind == "loose" else None,
+                self._device_valid(),
+                extra,
+                self._auth_table(auths) if has_vis else None,
+            )
 
     def _stats_fused(self, f, loose, device_parts, need_mask, auths=None):
         """Stat-DSL reductions on the pushdown hook: mask + every device

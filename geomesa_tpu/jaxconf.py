@@ -23,11 +23,20 @@ def _install_cache_listener() -> None:
     """Count persistent-cache hit/miss through jax's monitoring events
     (the only portable signal; the cache itself logs nothing). Feeds the
     ``geomesa_compile_cache_*`` metrics and ``compile_cache_stats()``
-    (the ``/stats`` document)."""
+    (the ``/stats`` document). The compile LEDGER's listener (per-shape
+    compile attribution, blocked-request charging — ledger.py) installs
+    alongside: every compile-heavy entry point that enables the cache
+    gets attribution for free."""
     global _cache_listener
     if _cache_listener:
         return
     _cache_listener = True
+    try:
+        from geomesa_tpu import ledger
+
+        ledger.install()
+    except Exception:  # pragma: no cover - attribution must not break init
+        pass
     try:
         from jax import monitoring
 

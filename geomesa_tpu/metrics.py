@@ -70,13 +70,19 @@ DEFAULT_BUCKETS = (
 
 
 class Histogram(_Metric):
-    """Cumulative-bucket histogram; ``time()`` context manager included."""
+    """Cumulative-bucket histogram; ``time()`` context manager included.
+
+    ``observe(..., exemplar={"trace_id": tid})`` attaches an OpenMetrics
+    exemplar to the bucket the value lands in (last writer wins): the
+    exposition then links a bucket — say the p99 one — to an actual
+    captured trace id, so a latency violation on ``/metrics`` resolves
+    to its ``/debug/traces`` entry."""
 
     def __init__(self, name, help_="", buckets=DEFAULT_BUCKETS):
         super().__init__(name, help_, "histogram")
         self.buckets = tuple(sorted(buckets))
 
-    def observe(self, v: float, **labels) -> None:
+    def observe(self, v: float, *, exemplar=None, **labels) -> None:
         key = self.labels(**labels)
         with self._lock:
             st = self._values.setdefault(
@@ -84,9 +90,14 @@ class Histogram(_Metric):
             )
             # le-bucket: first bound >= v (cumulated at exposition time);
             # past the last bound lands in the trailing +Inf slot
-            st["counts"][bisect_left(self.buckets, v)] += 1
+            b = bisect_left(self.buckets, v)
+            st["counts"][b] += 1
             st["sum"] += v
             st["n"] += 1
+            if exemplar:
+                st.setdefault("exemplars", {})[b] = (
+                    dict(exemplar), float(v)
+                )
 
     def time(self, **labels):
         return _Timer(self, labels)
@@ -133,8 +144,15 @@ class MetricsRegistry:
                 raise TypeError(f"metric {name!r} is a {m.kind}")
             return m
 
-    def prometheus_text(self) -> str:
-        """Prometheus exposition format (text/plain; version 0.0.4).
+    def prometheus_text(self, openmetrics: bool = False) -> str:
+        """Prometheus exposition. Default: the classic text format
+        (text/plain; version 0.0.4) — NO exemplars, because the 0.0.4
+        parser rejects anything but an optional timestamp after the
+        value and one suffixed line would fail the WHOLE scrape.
+        ``openmetrics=True`` (the server sets it when the scraper's
+        Accept header negotiates application/openmetrics-text) emits
+        the OpenMetrics form: exemplar suffixes on histogram buckets
+        and the terminating ``# EOF``.
 
         Every mutable structure is SNAPSHOTTED under its metric's lock
         before formatting: writers mutate ``_values`` (and histogram
@@ -156,19 +174,35 @@ class MetricsRegistry:
             else:
                 with m._lock:
                     stats = sorted(
-                        (key, list(st["counts"]), st["sum"], st["n"])
+                        (
+                            key, list(st["counts"]), st["sum"], st["n"],
+                            dict(st.get("exemplars", ())),
+                        )
                         for key, st in m._values.items()
                     )
-                for key, counts, total, n in stats:
+                for key, counts, total, n, exemplars in stats:
                     cum = 0
-                    for b, c in zip(m.buckets + (float("inf"),), counts):
+                    for i, (b, c) in enumerate(
+                        zip(m.buckets + (float("inf"),), counts)
+                    ):
                         cum += c
                         lb = "+Inf" if b == float("inf") else _fmt_val(b)
-                        lines.append(
-                            f"{name}_bucket{_fmt_labels(key + (('le', lb),))} {cum}"
+                        line = (
+                            f"{name}_bucket"
+                            f"{_fmt_labels(key + (('le', lb),))} {cum}"
                         )
+                        ex = exemplars.get(i) if openmetrics else None
+                        if ex is not None:
+                            # OpenMetrics exemplar: "<line> # {labels} value"
+                            line += (
+                                f" # {_fmt_labels(tuple(sorted(ex[0].items())))}"
+                                f" {_fmt_val(ex[1])}"
+                            )
+                        lines.append(line)
                     lines.append(f"{name}_sum{_fmt_labels(key)} {_fmt_val(total)}")
                     lines.append(f"{name}_count{_fmt_labels(key)} {n}")
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def clear(self) -> None:
@@ -439,6 +473,60 @@ traces_captured = REGISTRY.counter(
 slow_queries = REGISTRY.counter(
     "geomesa_slow_queries_total",
     "requests slower than trace.slow_ms (always-captured + slow-logged)",
+)
+
+# serving SLO engine (slo.py): latency observations per endpoint/lane
+# (bucket exemplars carry trace ids — a p99 violation on /metrics
+# resolves to a captured trace), good/bad per SLO name, burn-rate
+# gauges per (slo, fast|slow) window, flight-recorder bundles by
+# (bounded) reason
+slo_latency = REGISTRY.histogram(
+    "geomesa_slo_latency_seconds",
+    "request latency per endpoint/lane (buckets carry trace exemplars)",
+    buckets=(
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        0.75, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    ),
+)
+slo_requests = REGISTRY.counter(
+    "geomesa_slo_requests_total", "requests measured against an SLO"
+)
+slo_bad = REGISTRY.counter(
+    "geomesa_slo_bad_total",
+    "requests over their SLO latency threshold or failed 5xx",
+)
+slo_burn = REGISTRY.gauge(
+    "geomesa_slo_burn_rate",
+    "error-budget burn rate per (slo, window); > 1 consumes budget "
+    "faster than it accrues",
+)
+flightrec_bundles = REGISTRY.counter(
+    "geomesa_flightrec_bundles_total",
+    "flight-recorder postmortem bundles written, by reason",
+)
+
+# per-request cost ledger (ledger.py): requests folded into the
+# process aggregates, attributed device/compile seconds, and the raw
+# compile events the compile ledger observed through jax.monitoring
+ledger_requests = REGISTRY.counter(
+    "geomesa_ledger_requests_total",
+    "requests folded into the cost ledger",
+)
+ledger_device_seconds = REGISTRY.counter(
+    "geomesa_ledger_device_seconds_total",
+    "fair-share device seconds attributed to ledgered requests",
+)
+ledger_compile_seconds = REGISTRY.counter(
+    "geomesa_ledger_compile_seconds_total",
+    "XLA compile seconds ledgered requests blocked on",
+)
+compile_events = REGISTRY.counter(
+    "geomesa_compile_events_total",
+    "XLA backend compiles observed by the compile ledger",
+)
+compile_event_seconds = REGISTRY.counter(
+    "geomesa_compile_event_seconds_total",
+    "total XLA backend compile seconds observed by the compile ledger",
 )
 
 # runtime lock-order checker (analysis/lockcheck.py): the acquisition

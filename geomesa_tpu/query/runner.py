@@ -101,15 +101,32 @@ def _scan_run(built, compiled, jitted, start: int, stop: int,
     from geomesa_tpu.tracing import span
 
     try:
+        import time as _time
+
+        from geomesa_tpu import ledger
+
+        t_stage = _time.perf_counter()
         with span(
             "device.launch", rows=int(stop - start)
-        ), _device_trace_ctx():
+        ), _device_trace_ctx(), \
+                ledger.compile_scope("store.scan"):
             fail_point("fail.device.launch")
             fail_point("fail.stage.oom")
             cols = stage_columns(
                 built.batch, compiled.device_cols, start, stop
             )
-            return np.asarray(jitted(cols))  # lint: disable=GT004(the mask fetch IS the launch's intended sync point -- one per contiguous run, not per row)
+            t_launch = _time.perf_counter()
+            out = np.asarray(jitted(cols))  # lint: disable=GT004(the mask fetch IS the launch's intended sync point -- one per contiguous run, not per row)
+        # store-path launches never pass through the scheduler's device
+        # accounting: charge the requesting ledger here instead — the
+        # host column staging charges as STAGE time, only the jitted
+        # dispatch+fetch as device time (the cross-tenant device-time
+        # sums must mean what they say)
+        done = _time.perf_counter()
+        ledger.charge("stage_seconds", t_launch - t_stage)
+        ledger.charge("device_launches", 1)
+        ledger.charge("device_seconds", done - t_launch)
+        return out
     except Exception as e:
         from geomesa_tpu import resilience
 

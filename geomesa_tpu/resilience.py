@@ -227,9 +227,10 @@ def retry_call(fn, domain: str = "device"):
             delay = next(sleeps, None)
             if delay is None:
                 raise  # retry budget exhausted: the caller degrades
-            from geomesa_tpu import metrics
+            from geomesa_tpu import ledger, metrics
 
             metrics.resilience_retries.inc(domain=domain)
+            ledger.charge("retries", 1)
             time.sleep(delay)
 
 
@@ -347,15 +348,28 @@ class CircuitBreaker:
                 self._probe_at = time.monotonic() - self.cooldown_s
 
     def record_failure(self) -> None:
+        opened = False
         with self._lock:
             self._consecutive += 1
             if self._state == "half-open":
                 self._transition_locked("open")  # failed probe: re-open
+                opened = True
             elif (
                 self._state == "closed"
                 and self._consecutive >= self.failures
             ):
                 self._transition_locked("open")
+                opened = True
+        if opened:
+            # postmortem snapshot OUTSIDE the breaker lock (the bundle
+            # write is file I/O); rate limiting and the enabled gates
+            # live in the recorder
+            try:
+                from geomesa_tpu import slo
+
+                slo.on_breaker_open(self.domain)
+            except Exception:  # pragma: no cover - must not break serving
+                pass
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -495,11 +509,12 @@ def note_degraded(reason: str) -> None:
     """Record that the current request was answered below its requested
     rung. Reasons are the bounded enum above; collection is a no-op
     outside a request, the metric always counts."""
-    from geomesa_tpu import metrics
+    from geomesa_tpu import ledger, metrics
 
     metrics.resilience_degraded.inc(
         reason=reason if reason in REASONS else "other"
     )
+    ledger.charge("degraded", 1)
     reasons = _collector.get()
     if reasons is not None and reason not in reasons:
         reasons.append(reason)

@@ -126,11 +126,11 @@ class _Request:
     __slots__ = (
         "fn", "fuse", "lane", "tenant", "deadline", "enqueued",
         "event", "result", "error", "state", "ctx", "t0_perf",
-        "degraded", "device",
+        "degraded", "device", "cost",
     )
 
     def __init__(self, fn, fuse, lane, tenant, deadline, device=False):
-        from geomesa_tpu import resilience, tracing
+        from geomesa_tpu import ledger, resilience, tracing
 
         self.fn = fn
         self.fuse = fuse
@@ -152,6 +152,9 @@ class _Request:
         # a degraded note from work on a scheduler thread lands in the
         # submitting request's X-Degraded header / audit event
         self.degraded = resilience.capture_degraded()
+        # ...and so does the cost ledger: device seconds burned on a
+        # worker thread are charged to the request that asked for them
+        self.cost = ledger.capture_cost()
         self.t0_perf = time.perf_counter()
 
 
@@ -580,7 +583,7 @@ class QueryScheduler:
         )
 
     def _execute(self, group: "list[_Request]") -> None:
-        from geomesa_tpu import metrics, resilience, tracing
+        from geomesa_tpu import ledger, metrics, resilience, tracing
         from geomesa_tpu.sched.fusion import execute_group
 
         now = time.monotonic()
@@ -618,7 +621,8 @@ class QueryScheduler:
                 # still gets the flat sched.execute span below, tagged
                 # with the shared launch id.
                 with tracing.attach(live[0].ctx), \
-                        resilience.attach_degraded(live[0].degraded):
+                        resilience.attach_degraded(live[0].degraded), \
+                        ledger.attach_cost(live[0].cost):
                     fused = execute_group([r.fuse for r in live])
             except Exception:
                 fused = None  # any fusion failure: serial is always exact
@@ -646,6 +650,13 @@ class QueryScheduler:
                     launch=launch_id, fused=len(live), lane=r.lane,
                     shards=shards,
                 )
+                if r.cost is not None:
+                    # fair-share cost split: summing the ledger over
+                    # the riders reproduces the launch's actual device
+                    # time instead of multiplying it by the width
+                    r.cost.charge("device_launches", 1)
+                    r.cost.charge("device_seconds", dur / len(live))
+                    r.cost.charge("fusion_width", len(live))
                 self._finish(r, result=v)
             return
         metrics.sched_launches.inc(len(live))
@@ -658,27 +669,40 @@ class QueryScheduler:
             try:
                 # attach the rider's context so the work's own spans
                 # (plan / device.launch / store reads) nest in its
-                # trace, and its degradation collector so degraded
-                # notes reach its response/audit stamping
+                # trace, its degradation collector so degraded notes
+                # reach its response/audit stamping, and its cost
+                # collector so device/compile time is charged to it
                 with tracing.attach(r.ctx), \
                         resilience.attach_degraded(r.degraded), \
+                        ledger.attach_cost(r.cost), \
                         tracing.span(
                             "sched.execute", launch=launch_id, fused=1,
                             lane=r.lane,
                         ):
                     res = r.fn()
             except Exception as e:  # the submitter re-raises it
+                dur_run = time.perf_counter() - t_run
+                self._charge_serial(r, dur_run)
                 with self._cv:
-                    self._observe_service_locked(
-                        time.perf_counter() - t_run, 1
-                    )
+                    self._observe_service_locked(dur_run, 1)
                 self._finish(r, error=e)
                 continue
+            dur_run = time.perf_counter() - t_run
+            self._charge_serial(r, dur_run)
             with self._cv:
-                self._observe_service_locked(
-                    time.perf_counter() - t_run, 1
-                )
+                self._observe_service_locked(dur_run, 1)
             self._finish(r, result=res)
+
+    @staticmethod
+    def _charge_serial(r: _Request, dur_s: float) -> None:
+        """Ledger one serially-executed request: device work charges a
+        launch; host/store work (device=False) charges nothing here —
+        its read/decode/stage time is charged at the store layer."""
+        if r.cost is None or not r.device:
+            return
+        r.cost.charge("device_launches", 1)
+        r.cost.charge("device_seconds", dur_s)
+        r.cost.charge("fusion_width", 1)
 
     def _finish(self, req: _Request, result=None, error=None) -> None:
         """Complete a request EXACTLY ONCE: between normal execution,

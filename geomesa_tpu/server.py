@@ -38,8 +38,15 @@ Endpoints (all GET):
   (FS stores: generations, quarantined partitions, recovery counters)
 - ``/stats/mesh``                   -- serving-mesh topology + per-type
   shard residency (rows/bytes/Z-key range per shard, build engine)
+- ``/stats/slo``                    -- windowed SLO engine: per-SLO
+  objective/threshold, fast+slow burn rates, burning flags, and
+  windowed p50/p99/p999 per endpoint/lane (slo.py)
+- ``/stats/ledger``                 -- per-request cost ledger roll-up:
+  per-tenant and per-shape cost aggregates, the top-K most expensive
+  requests (with trace ids), and the compile-attribution table
+  (ledger.py)
 - ``/stats``                        -- roll-up: sched + store + mesh +
-  persistent compile-cache hit/miss in one scrape
+  slo + ledger + persistent compile-cache hit/miss in one scrape
 - ``/debug/traces``                 -- recent request traces (summaries;
   ``?limit=``)
 - ``/debug/traces/<id>``            -- one trace's full span tree;
@@ -84,6 +91,16 @@ event records the same reasons. Shutdown DRAINS: admission stops
 ``/healthz`` stays 200 so the orchestrator de-routes without killing),
 in-flight scheduler work finishes, audit/slow logs flush, then the
 accept loop stops.
+
+SLOs + cost accounting (slo.py / ledger.py, ISSUE 9): every query
+request is measured against its lane's SLO (``slo.<lane>.*`` conf
+keys) in time-rotated latency windows, multi-window burn rates ride
+``/stats/slo`` and ``/readyz`` (burning = degraded detail, NOT
+unready), and a per-request cost ledger — device launches/seconds,
+compile attribution, host I/O, chunks pruned, retries, degradations —
+aggregates per tenant/shape on ``/stats/ledger``. When the fast-window
+burn crosses ``slo.flightrec.burn`` or a breaker opens, the flight
+recorder snapshots a postmortem bundle to ``<root>/_flightrec/``.
 
 Errors return JSON ``{"error": ...}`` with 4xx/5xx status; 429/504/5xx
 responses carry ``X-Request-Id`` too, and shed / deadline-expired
@@ -234,6 +251,14 @@ class _Handler(BaseHTTPRequestHandler):
 
             queries_run.inc(store="resident", type=type_name)
             query_seconds.observe(t1 - t0)
+            if self.scheduler is None:
+                # unscheduled resident serving: the scheduler would have
+                # charged the ledger for this launch — do it here instead
+                from geomesa_tpu import ledger
+
+                ledger.charge("device_launches", 1)
+                ledger.charge("device_seconds", t1 - t0)
+                ledger.charge("fusion_width", 1)
             aw = getattr(self.store, "audit_writer", None)
             if aw is not None:
                 aw.write(AuditedEvent(
@@ -253,6 +278,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        cost = getattr(self, "_cost", None)
+        if cost is not None:
+            # the ledger/SLO layer classifies good vs bad by this code
+            cost.status = code
         tr = getattr(self, "_trace", None)
         if tr is not None:
             # the trace id rides the response whether or not the trace
@@ -376,7 +405,12 @@ class _Handler(BaseHTTPRequestHandler):
             parts = [p for p in url.path.split("/") if p]
             q = {k: v[0] for k, v in parse_qs(url.query).items()}
         except Exception as e:
+            # clear ALL per-request state: on a keep-alive connection
+            # this handler instance served the previous request, and a
+            # stale cost/degraded carry-over would mis-stamp this 400
             self._trace = None
+            self._degraded = None
+            self._cost = None
             return self._json(400, {"error": str(e)})
         # observability endpoints are not themselves traced — scrapes,
         # trace reads and the stats snapshots must not churn the trace
@@ -390,28 +424,48 @@ class _Handler(BaseHTTPRequestHandler):
         ) or (
             parts == ["stats", "store"]
             and hasattr(self.store, "store_stats")
-        ) or parts == ["stats", "mesh"] or parts == ["stats"]
+        ) or parts == ["stats", "mesh"] or parts == ["stats", "slo"] \
+            or parts == ["stats", "ledger"] or parts == ["stats"]
         if untraced:
             self._trace = None
             self._degraded = None
+            self._cost = None
             return self._dispatch_safe(url, parts, q)
-        from geomesa_tpu import resilience
+        from geomesa_tpu import ledger, resilience
         from geomesa_tpu.tracing import TRACER
 
+        tenant = q.get("tenant") or (
+            str(self.client_address[0]) if self.client_address else ""
+        )
         # error handling lives INSIDE the trace: the error response is
         # sent (status attr stamped, its time counted) before the trace
         # finishes and retention / the slow-query log fire. The
         # degradation collector wraps the same scope: any layer that
         # answers below the requested rung notes a reason here, and the
-        # response/audit stamping reads it back.
+        # response/audit stamping reads it back. The cost collector
+        # rides along too — it is finalized AFTER the trace completes
+        # (the span tree is whole at that point) and folded into the
+        # process ledger + the SLO engine's latency windows.
         with TRACER.trace(
             f"GET {url.path}",
             trace_id=self.headers.get("X-Request-Id"),
             attrs={"path": url.path, "query": url.query[:512]},
-        ) as tr, resilience.collect_degraded() as reasons:
+        ) as tr, resilience.collect_degraded() as reasons, \
+                ledger.collect_cost(
+                    tenant=tenant,
+                    endpoint=_cost_endpoint(parts),
+                    lane=q.get("lane", "interactive"),
+                    shape=_query_shape(parts, q),
+                ) as cost:
             self._trace = tr
             self._degraded = reasons
-            return self._dispatch_safe(url, parts, q)
+            self._cost = cost
+            if cost is not None:
+                # stamped NOW (not at finish) so a mid-request compile
+                # ledger entry can name the trace that blocked on it
+                cost.trace_id = tr.trace_id
+            self._dispatch_safe(url, parts, q)
+        ledger.finish_request(cost, tr)
 
     def _audit_outcome(self, parts: list, q: dict, outcome: str) -> None:
         """Stamp a shed (429) or deadline-expired (504) request into the
@@ -490,11 +544,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _readyz(self) -> None:
         """Readiness, driven by breaker state: the body reports every
-        failure domain's breaker, the open (unhealthy) domains and
-        scheduler queue pressure. A DEGRADED instance is still READY
-        (200) — it serves, just lower-rung, and says so; only draining
-        flips 503 (nothing new should be routed here)."""
-        from geomesa_tpu import resilience
+        failure domain's breaker, the open (unhealthy) domains,
+        scheduler queue pressure and any BURNING SLOs. A DEGRADED or
+        burning instance is still READY (200) — it serves, just
+        lower-rung or over budget, and says so; only draining flips 503
+        (nothing new should be routed here)."""
+        from geomesa_tpu import resilience, slo
 
         breakers = resilience.snapshot()
         degraded = sorted(
@@ -503,10 +558,15 @@ class _Handler(BaseHTTPRequestHandler):
         )
         if breakers.get("partition_open"):
             degraded.append("partition")
+        # burning SLOs are degraded DETAIL, never unready: pulling a
+        # burning instance from rotation would shift its load onto the
+        # others and burn THEIR budgets faster
+        burning = slo.ENGINE.burning() if slo.enabled() else []
         doc = {
             "ready": not self._draining(),
             "draining": self._draining(),
             "degraded_domains": degraded,
+            "slo_burning": burning,
             "breakers": breakers,
         }
         if self.scheduler is not None:
@@ -524,10 +584,17 @@ class _Handler(BaseHTTPRequestHandler):
         if parts == ["metrics"]:
             from geomesa_tpu.metrics import REGISTRY
 
+            # content negotiation: exemplars (trace-id suffixes) are
+            # only valid in the OpenMetrics format — the classic 0.0.4
+            # parser would fail the WHOLE scrape on one suffixed line
+            om = "application/openmetrics-text" in (
+                self.headers.get("Accept") or ""
+            )
             return self._send(
                 200,
-                REGISTRY.prometheus_text().encode("utf-8"),
-                "text/plain; version=0.0.4",
+                REGISTRY.prometheus_text(openmetrics=om).encode("utf-8"),
+                "application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8" if om else "text/plain; version=0.0.4",
             )
         if parts[:2] == ["debug", "traces"]:
             return self._debug_traces(parts, q)
@@ -539,6 +606,14 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(200, self.store.store_stats())
         if parts == ["stats", "mesh"]:
             return self._json(200, self._mesh_stats())
+        if parts == ["stats", "slo"]:
+            from geomesa_tpu import slo
+
+            return self._json(200, slo.ENGINE.snapshot())
+        if parts == ["stats", "ledger"]:
+            from geomesa_tpu.ledger import LEDGER
+
+            return self._json(200, LEDGER.snapshot())
         if parts == ["stats"]:
             return self._json(200, self._stats_index())
         if len(parts) == 2 and parts[0] in (
@@ -578,9 +653,12 @@ class _Handler(BaseHTTPRequestHandler):
         return doc
 
     def _stats_index(self) -> dict:
-        """``/stats``: one roll-up document — scheduler, store, mesh and
-        the persistent compile cache (hit/miss) in a single scrape."""
+        """``/stats``: one roll-up document — scheduler, store, mesh,
+        SLO engine, cost ledger and the persistent compile cache
+        (hit/miss) in a single scrape."""
+        from geomesa_tpu import slo
         from geomesa_tpu.jaxconf import compile_cache_stats
+        from geomesa_tpu.ledger import LEDGER
 
         doc: dict = {"compile_cache": compile_cache_stats()}
         if self.scheduler is not None:
@@ -588,6 +666,8 @@ class _Handler(BaseHTTPRequestHandler):
         if hasattr(self.store, "store_stats"):
             doc["store"] = self.store.store_stats()
         doc["mesh"] = self._mesh_stats()
+        doc["slo"] = slo.ENGINE.snapshot()
+        doc["ledger"] = LEDGER.snapshot()
         return doc
 
     def _debug_traces(self, parts: list, q: dict) -> None:
@@ -1038,6 +1118,39 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
 
+#: the query endpoints the ledger/SLO layer labels by — anything else
+#: (typo'd paths that 404, novel routes) collapses into "other" so a
+#: URL scanner cannot mint unbounded metric series or ring keys
+_KNOWN_ENDPOINTS = frozenset({
+    "features", "count", "explain", "density", "stats", "refresh",
+    "knn", "tube", "proximity", "capabilities",
+})
+
+
+def _cost_endpoint(parts: list) -> str:
+    ep = parts[0] if parts else "-"
+    return ep if ep in _KNOWN_ENDPOINTS else "other"
+
+
+def _query_shape(parts: list, q: dict) -> str:
+    """The ledger's query-shape key: endpoint + the filter's leading
+    predicate + the loose flag — coarse on purpose (per-tenant detail
+    lives in the trace; the shape key exists to group compile/cost
+    attribution by KERNEL family, the measurement substrate the
+    shape-bucketing work needs). The ledger bounds the key space, so an
+    adversarial filter cannot mint unbounded aggregates."""
+    endpoint = _cost_endpoint(parts)
+    cql = (q.get("cql") or "INCLUDE").strip()
+    words = cql.split("(", 1)[0].split()
+    head = (words[0].upper()[:16] if words else "INCLUDE") or "INCLUDE"
+    if not head.replace("_", "").isalnum():
+        head = "EXPR"
+    shape = f"{endpoint}:{head}"
+    if q.get("loose"):
+        shape += ":loose"
+    return shape
+
+
 def _mesh_serving_enabled(mesh) -> bool:
     """Resolve the mesh-serving switch: an explicit ``make_server``
     argument wins, else the ``mesh.enabled`` conf key; either way the
@@ -1109,11 +1222,14 @@ def make_server(
     ``/stats`` and the ``geomesa_compile_cache_*`` metrics."""
     import os as _os
 
+    from geomesa_tpu import ledger as _ledger
+    from geomesa_tpu import slo as _slo
     from geomesa_tpu.jaxconf import enable_compilation_cache
     from geomesa_tpu.pyarrow_compat import preload_pyarrow
     from geomesa_tpu.tracing import TRACER
 
     enable_compilation_cache()
+    _ledger.install()  # compile-time attribution via jax.monitoring
     mesh_on = resident and _mesh_serving_enabled(mesh)
     preload_pyarrow()  # handler threads serve Arrow; see pyarrow_compat
     if io is not None and hasattr(store, "io"):
@@ -1168,6 +1284,31 @@ def make_server(
                 warnings.warn(f"warm staging failed for {tn!r}: {e!r}")
                 continue
             handler._resident_cache[tn] = di
+    # flight recorder: bundles land next to the store's data (memory
+    # stores have no root — the recorder stays disabled unless a test
+    # configured a directory of its own); sched/store/mesh snapshots
+    # register as bundle providers
+    providers: dict = {}
+    if scheduler is not None:
+        providers["sched"] = scheduler.snapshot
+    if hasattr(store, "store_stats"):
+        providers["store"] = store.store_stats
+
+    def _mesh_snapshot(h=handler):
+        doc = {"enabled": bool(h.mesh), "types": {}}
+        for name, di in list(h._resident_cache.items()):
+            stats = getattr(di, "mesh_stats", None)
+            if stats is not None:
+                doc["types"][name] = stats()
+        return doc
+
+    providers["mesh"] = _mesh_snapshot
+    _slo.FLIGHTREC.configure(
+        _os.path.join(str(root_dir), "_flightrec")
+        if root_dir
+        else _slo.FLIGHTREC.dir,
+        providers=providers,
+    )
     server = _GeomesaHTTPServer((host, port), handler)
     server.scheduler = scheduler  # callers may inspect / shut down
     server.store = store  # the draining shutdown flushes its audit log

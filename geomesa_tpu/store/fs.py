@@ -1700,8 +1700,12 @@ class FileSystemDataStore:
         injected = fail_hit("fail.read.corrupt")
         verify = injected or sys_prop("store.verify") == "always"
         row_groups = self._row_groups_for(st, p, chunk_sel)
+        import time as _time
+
+        from geomesa_tpu import ledger
         from geomesa_tpu.tracing import span
 
+        t_read = _time.perf_counter()
         with span("store.read", pid=p.pid, rows=int(p.count)) as sp, \
                 metrics.io_read_seconds.time():
             if not verify:
@@ -1725,6 +1729,7 @@ class FileSystemDataStore:
                 t = _parse_table(data, st.encoding, row_groups=row_groups)
             if chunk_sel is not None and row_groups is None:
                 t = self._slice_table_chunks(t, p.chunks, chunk_sel)
+        ledger.charge("read_seconds", _time.perf_counter() - t_read)
         try:
             if row_groups is not None and not verify:
                 # pruned read: account the bytes actually fetched (the
@@ -1734,9 +1739,14 @@ class FileSystemDataStore:
             else:
                 size = os.path.getsize(path)
             metrics.io_bytes_read.inc(size)
+            ledger.charge("read_bytes", size)
             sp.set(bytes=int(size))
             if chunk_sel is not None:
                 sp.set(chunks=len(chunk_sel), chunk_total=len(p.chunks))
+                ledger.charge("chunks_read", len(chunk_sel))
+                ledger.charge(
+                    "chunks_pruned", len(p.chunks) - len(chunk_sel)
+                )
         except OSError:
             pass
         return t
@@ -1750,10 +1760,16 @@ class FileSystemDataStore:
 
         from geomesa_tpu.tracing import span
 
+        import time as _time
+
+        from geomesa_tpu import ledger
+
         st = self._types[type_name]
+        t_dec = _time.perf_counter()
         with span("store.decode", pid=p.pid) as sp, \
                 metrics.io_decode_seconds.time():
             batch = FeatureBatch.from_arrow(t, st.sft)
+        ledger.charge("decode_seconds", _time.perf_counter() - t_dec)
         sp.set(rows=len(batch))
         if cache:
             st.cache[(p.gen, p.pid)] = batch

@@ -331,9 +331,23 @@ class StreamedDeviceScan:
         batch = (
             batches[0] if len(batches) == 1 else FeatureBatch.concat(batches)
         )
+        import time as _time
+
+        from geomesa_tpu import ledger
+
+        t_stage = _time.perf_counter()
         with span("store.stage", rows=len(batch), parts=len(group)), \
                 metrics.io_stage_seconds.time():
             cols = stage_columns_host(batch, names)
+        ledger.charge("stage_seconds", _time.perf_counter() - t_stage)
+        try:
+            ledger.charge(
+                "stage_bytes",
+                sum(int(c.nbytes) for c in cols.values()
+                    if hasattr(c, "nbytes")),
+            )
+        except Exception:  # staged planes without nbytes: skip the charge
+            pass
         return cols, (batch if want_batch else None)
 
     def _pairs(self, items, names, want_batch: bool = True):

@@ -198,11 +198,16 @@ def _prefetch_threads(fn, items, cfg: PrefetchConfig, size_of):
     # per-thread, so without this capture/attach pair the workers' read/
     # decode/stage spans would silently vanish from the request's trace
     # (tracing.py module docstring). Captured HERE — the consumer thread
-    # at generator start — and attached around each work item.
+    # at generator start — and attached around each work item. The cost
+    # ledger rides the same way: bytes read on a worker are charged to
+    # the request whose scan asked for them.
+    from geomesa_tpu import ledger
+
     trace_ctx = tracing.capture()
+    cost_ctx = ledger.capture_cost()
 
     def run(item):
-        with tracing.attach(trace_ctx):
+        with tracing.attach(trace_ctx), ledger.attach_cost(cost_ctx):
             out = fn(item)
         b = 0
         if size_of is not None and budget:

@@ -27,10 +27,23 @@ Runner/IngestCommand/ExportCommand/ExplainCommand/StatsCommand):
                                [--sched]
     geomesa-tpu trace          --url http://host:port [TRACE_ID]
                                [--perfetto -o out.json] (request traces
-                               from /debug/traces, pretty span tree)
+                               from /debug/traces, pretty span tree;
+                               with a TRACE_ID also prints the span-
+                               derived cost breakdown)
+    geomesa-tpu slo            --url http://host:port (the trace
+                               family's SLO view: burn table from
+                               /stats/slo — objectives, fast/slow burn,
+                               windowed p50/p99/p999 per endpoint/lane)
+    geomesa-tpu ledger         --url http://host:port (the trace
+                               family's cost view: per-tenant/per-shape
+                               top-K cost tables, most expensive
+                               requests, compile attribution from
+                               /stats/ledger)
     geomesa-tpu load-driver    --root DIR -f NAME [-q CQL] [--threads M]
-                               [--requests N] [--loose] (concurrent-serving
-                               load: throughput, p50/p99, fusion factor)
+                               [--requests N] [--loose] [--tenants K]
+                               (concurrent-serving load: throughput,
+                               p50/p99, fusion factor, and a per-tenant
+                               cost summary from the ledger at exit)
     geomesa-tpu lint           [PATHS...] [--rules] (invariant linter
                                GT001-GT008; exit 0 clean / 1 findings)
     geomesa-tpu env | version
@@ -692,11 +705,17 @@ def cmd_load_driver(args):
     shed = [0, 0]  # 429s, other errors
     lock = checked_lock("cli.load_driver")
 
-    def worker():
+    def worker(tid: int):
+        # --tenants K spreads the load over K synthetic tenant ids so
+        # the ledger's per-tenant fairness/cost view has something to
+        # show; 0 keeps the server default (the client address)
+        t_url = target
+        if args.tenants > 0:
+            t_url += f"&tenant=lt{tid % args.tenants}"
         for _ in range(args.requests):
             t0 = time.perf_counter()
             try:
-                with urllib.request.urlopen(target, timeout=120) as r:
+                with urllib.request.urlopen(t_url, timeout=120) as r:
                     r.read()
             except urllib.error.HTTPError as e:
                 with lock:
@@ -706,7 +725,8 @@ def cmd_load_driver(args):
                 lats.append(time.perf_counter() - t0)
 
     threads = [
-        threading.Thread(target=worker) for _ in range(args.threads)
+        threading.Thread(target=worker, args=(i,))
+        for i in range(args.threads)
     ]
     t0 = time.perf_counter()
     for t in threads:
@@ -737,6 +757,27 @@ def cmd_load_driver(args):
     except Exception:
         pass  # no scheduler on the target: latency numbers still stand
     print(json.dumps(rep, indent=2))
+    # exit summary: who spent what, from the server's cost ledger —
+    # per-tenant requests, p50/p99 and the device/compile/IO split
+    try:
+        with urllib.request.urlopen(
+            f"{url}/stats/ledger", timeout=10
+        ) as r:
+            led = json.loads(r.read())
+        if led.get("enabled"):
+            _print_cost_table(
+                "per-tenant cost + latency (from the ledger)",
+                led.get("tenants", {}),
+            )
+            comp = led.get("compile", {})
+            if comp.get("compiles"):
+                print(
+                    f"\ncompile attribution: {comp['compiles']} compiles, "
+                    f"{comp['total_s']}s blocked, "
+                    f"{comp.get('cache_hits', 0)} cache hits"
+                )
+    except Exception:
+        pass  # pre-ledger server: the load report above still stands
     if server is not None:
         # shutdown drains + joins the scheduler too (make_server wiring)
         server.shutdown()
@@ -810,6 +851,121 @@ def cmd_trace(args):
         return
     print(format_trace(doc))
     print(f"span coverage of request wall time: {coverage(doc) * 100:.1f}%")
+    from geomesa_tpu.ledger import cost_from_trace
+
+    costs = cost_from_trace(doc)
+    if costs:
+        print("cost breakdown (span-derived):")
+        for k, v in costs.items():
+            print(f"  {k:<18} {v:g}")
+
+
+def _fetch_json(url: str):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        sys.exit(
+            f"error: HTTP {e.code} "
+            f"({e.read().decode(errors='replace')[:200]})"
+        )
+
+
+def cmd_slo(args):
+    """The trace family's SLO view: burn table + windowed percentiles
+    from a running server's ``/stats/slo``."""
+    doc = _fetch_json(f"{args.url.rstrip('/')}/stats/slo")
+    if not doc.get("enabled", False):
+        print("(slo engine disabled — see the slo.enabled conf key)")
+        return
+    hdr = (
+        f"{'slo':<13}{'objective':>10}{'threshold':>11}{'window':>9}"
+        f"{'fast burn':>11}{'slow burn':>11}{'burning':>9}"
+        f"{'requests':>10}{'bad':>6}"
+    )
+    print(hdr)
+    for name, s in sorted(doc.get("slos", {}).items()):
+        b = s["burn"]
+        print(
+            f"{name:<13}{s['objective'] * 100:>9.2f}%"
+            f"{s['threshold_ms']:>9.0f}ms{s['window_s']:>8.0f}s"
+            f"{b['fast']['rate']:>11.3f}{b['slow']['rate']:>11.3f}"
+            f"{'YES' if s['burning'] else 'no':>9}"
+            f"{s['requests']:>10}{s['bad']:>6}"
+        )
+    series = doc.get("series", {})
+    if series:
+        print("\nwindowed latency (endpoint|lane):")
+        for key, s in sorted(series.items()):
+            print(
+                f"  {key:<26} p50={s['p50_ms']}ms p99={s['p99_ms']}ms "
+                f"p999={s['p999_ms']}ms  ({s['requests']} req, "
+                f"{s['bad']} bad)"
+            )
+
+
+def _print_cost_table(title: str, table: dict):
+    if not table:
+        return
+    print(f"\n{title}:")
+    print(
+        f"  {'key':<26}{'req':>7}{'err':>5}{'p50':>9}{'p99':>9}"
+        f"{'device_s':>10}{'compile_s':>10}{'read_mb':>9}{'degr':>6}"
+    )
+    for key, agg in table.items():
+        c = agg.get("cost", {})
+        print(
+            f"  {key[:26]:<26}{agg['requests']:>7}{agg['errors']:>5}"
+            f"{(agg['p50_ms'] or 0):>7.1f}ms{(agg['p99_ms'] or 0):>7.1f}ms"
+            f"{c.get('device_seconds', 0):>10.3f}"
+            f"{c.get('compile_seconds', 0):>10.3f}"
+            f"{c.get('read_bytes', 0) / 1e6:>9.2f}"
+            f"{int(c.get('degraded', 0)):>6}"
+        )
+
+
+def cmd_ledger(args):
+    """The trace family's cost view: per-tenant / per-shape top-K cost
+    tables, the most expensive requests and the compile-attribution
+    table from a running server's ``/stats/ledger``."""
+    doc = _fetch_json(f"{args.url.rstrip('/')}/stats/ledger")
+    if not doc.get("enabled", False):
+        print("(cost ledger disabled — see the ledger.enabled conf key)")
+        return
+    print(f"ledgered requests: {doc.get('requests', 0)}")
+    _print_cost_table("tenants (top-K by cost)", doc.get("tenants", {}))
+    _print_cost_table("query shapes (top-K by cost)", doc.get("shapes", {}))
+    top = doc.get("top_requests", [])
+    if top:
+        print("\nmost expensive requests:")
+        for r in top:
+            print(
+                f"  {r['trace_id']:<18}{r['shape']:<24}"
+                f"tenant={r['tenant']:<12}{r['duration_ms']:>9.1f}ms"
+                f"  cost={r['cost_s']:.3f}s"
+            )
+    comp = doc.get("compile", {})
+    sigs = comp.get("by_signature", {})
+    if sigs:
+        print(
+            f"\ncompile attribution ({comp.get('compiles', 0)} compiles, "
+            f"{comp.get('total_s', 0)}s total, "
+            f"{comp.get('cache_hits', 0)} cache hits):"
+        )
+        for sig, s in sigs.items():
+            trace = (
+                f"  last trace {s['last_trace_id']}"
+                if s.get("last_trace_id")
+                else ""
+            )
+            print(
+                f"  {sig[:40]:<40}{s['compiles']:>4}x "
+                f"{s['total_s']:>8.3f}s (max {s['max_s']:.3f}s, "
+                f"{s['cache_hits']} cache hits){trace}"
+            )
 
 
 def cmd_count(args):
@@ -1008,9 +1164,20 @@ def main(argv=None) -> None:
     sp.add_argument("--limit", type=int, default=50,
                     help="max traces to list (no trace_id)")
 
+    sp = add("slo", cmd_slo)
+    sp.add_argument("--url", required=True,
+                    help="running server base URL (e.g. http://host:port)")
+
+    sp = add("ledger", cmd_ledger)
+    sp.add_argument("--url", required=True,
+                    help="running server base URL (e.g. http://host:port)")
+
     sp = add("load-driver", cmd_load_driver)
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-q", "--cql")
+    sp.add_argument("--tenants", type=int, default=0,
+                    help="spread requests over K synthetic tenant ids "
+                    "(0 = the server's client-address default)")
     sp.add_argument("--url", help="existing server base URL; omit to "
                     "self-serve --root with a resident scheduler")
     sp.add_argument("--endpoint", default="count",

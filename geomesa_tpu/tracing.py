@@ -340,6 +340,21 @@ class Tracer:
         except Exception:  # pragma: no cover - the log must not break serving
             pass
 
+    def retain(self, t: Trace) -> None:
+        """Force-retain a finished trace in the recent-trace ring even
+        when head-sampling declined and it beat the slow threshold —
+        the SLO engine calls this for requests that breached their
+        lane's objective, so the ``/metrics`` exemplar pointing at the
+        trace id actually resolves in ``/debug/traces``. No-op for
+        unrecorded traces (there is no span tree to show)."""
+        if not t.recording:
+            return
+        with self._lock:
+            self._ring[t.trace_id] = t
+            self._ring.move_to_end(t.trace_id)
+            while len(self._ring) > self.capacity:
+                self._ring.popitem(last=False)
+
     # -- read side (the /debug/traces endpoints + the trace CLI) -----------
 
     def get(self, trace_id: str) -> "Trace | None":

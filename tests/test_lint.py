@@ -1,5 +1,5 @@
 """Invariant linter (analysis/lint.py): package self-lint, one seeded
-fixture violation per rule GT001-GT008, the disable-comment escape
+fixture violation per rule GT001-GT009, the disable-comment escape
 hatch, and the CLI exit codes."""
 
 import os
@@ -66,6 +66,12 @@ FIXTURES = {
         "def f():\n"
         "    return sys_prop('no.such.key')\n",
     ),
+    "GT009": (
+        "costs.py",
+        "from geomesa_tpu.ledger import charge\n"
+        "def f():\n"
+        "    charge('not_a_ledger_field', 1)\n",
+    ),
 }
 
 
@@ -78,7 +84,7 @@ def _write_tree(root, fixtures):
 
 @pytest.mark.lint
 def test_package_self_lint_is_clean():
-    """The GT001-GT008 rules over the geomesa_tpu tree itself: every
+    """The GT001-GT009 rules over the geomesa_tpu tree itself: every
     baseline violation is fixed or carries a reasoned disable comment.
     Rides tier-1 so a regression fails the next test run, not the next
     CI run."""

@@ -1,0 +1,629 @@
+"""Serving SLO engine + cost ledger (ISSUE 9): window rotation under a
+fake clock, burn-rate math against hand-computed fixtures, exemplar
+round-trip through the /metrics exposition, compile-ledger attribution
+on a forced cold compile, flight-recorder triggers (injected
+breaker-open; bounded retention), per-tenant ledger isolation, and the
+e2e /stats/slo -> /readyz flow (the whole suite runs under the runtime
+lock-order checker, see conftest)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+from urllib.parse import quote
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import ledger, resilience, slo
+from geomesa_tpu.conf import prop_override
+from geomesa_tpu.ledger import (
+    COMPILES,
+    CostLedger,
+    RequestCost,
+    cost_from_trace,
+)
+from geomesa_tpu.slo import SloEngine, WindowedHistogram, slo_def
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- windowed histogram: rotation + quantiles under a fake clock ------------
+
+
+class TestWindowedHistogram:
+    def test_rotation_expires_old_slots(self):
+        clk = FakeClock()
+        h = WindowedHistogram(window_s=60.0, slots=6, clock=clk)  # 10s slots
+        h.observe(0.1)
+        assert h.merged()["n"] == 1
+        clk.advance(30.0)
+        h.observe(0.2)
+        assert h.merged()["n"] == 2
+        # slot 0 (t=0..10) falls out once the window slides past it
+        clk.advance(41.0)  # t=71: window covers (11, 71]
+        assert h.merged()["n"] == 1
+        clk.advance(200.0)  # everything expired
+        assert h.merged()["n"] == 0
+
+    def test_ring_wrap_clears_stale_slot(self):
+        clk = FakeClock()
+        h = WindowedHistogram(window_s=60.0, slots=6, clock=clk)
+        h.observe(0.1, bad=True)
+        # t=60 maps to the SAME ring position as t=0 (6 slots of 10s):
+        # the stale counts must not leak into the new slot
+        clk.t = 60.0
+        h.observe(0.2)
+        m = h.merged()
+        assert m["n"] == 1 and m["bad"] == 0
+
+    def test_sub_window_merge(self):
+        clk = FakeClock()
+        h = WindowedHistogram(window_s=600.0, slots=60, clock=clk)
+        h.observe(0.1)  # t=0
+        clk.t = 590.0
+        h.observe(0.2)
+        assert h.merged()["n"] == 2  # full window sees both
+        assert h.merged(50.0)["n"] == 1  # fast window: only the recent one
+
+    def test_quantiles_bucket_upper_bounds(self):
+        clk = FakeClock()
+        h = WindowedHistogram(window_s=60.0, clock=clk)
+        for _ in range(99):
+            h.observe(0.004)  # lands in the 0.005 bucket
+        h.observe(20.0)  # lands in the 30.0 bucket
+        assert h.quantile_ms(0.5) == 5.0
+        assert h.quantile_ms(0.99) == 5.0
+        assert h.quantile_ms(0.999) == 30000.0
+
+    def test_quantile_none_without_data(self):
+        h = WindowedHistogram(window_s=60.0, clock=FakeClock())
+        assert h.quantile_ms(0.5) is None
+
+
+# -- burn-rate math vs hand-computed fixtures -------------------------------
+
+
+class TestBurnRate:
+    def test_burn_hand_computed(self):
+        clk = FakeClock(1000.0)
+        eng = SloEngine(clock=clk)
+        with prop_override("slo.interactive.objective", 0.999), \
+                prop_override("slo.interactive.threshold.ms", 100.0):
+            d = slo_def("interactive")
+            for _ in range(97):
+                eng.observe("count", "interactive", 0.001)
+            for _ in range(3):
+                eng.observe("count", "interactive", 10.0)  # > threshold
+            # bad fraction 3/100 over budget 0.001 => burn 30
+            assert eng.burn(d, 300.0) == pytest.approx(30.0)
+
+    def test_error_counts_as_bad_even_when_fast(self):
+        clk = FakeClock(1000.0)
+        eng = SloEngine(clock=clk)
+        with prop_override("slo.interactive.objective", 0.9):
+            d = slo_def("interactive")
+            eng.observe("count", "interactive", 0.001, error=True)
+            # 1/1 bad over budget 0.1 => burn 10
+            assert eng.burn(d, 300.0) == pytest.approx(10.0)
+
+    def test_no_traffic_is_zero_burn(self):
+        eng = SloEngine(clock=FakeClock())
+        d = slo_def("interactive")
+        assert eng.burn(d, 300.0) == 0.0
+
+    def test_burning_needs_both_windows(self):
+        """Fast-window spike over a healthy hour must NOT read as
+        burning (the classic multi-window rule: page on fast AND slow)."""
+        clk = FakeClock(0.0)
+        eng = SloEngine(clock=clk)
+        with prop_override("slo.interactive.objective", 0.99), \
+                prop_override("slo.interactive.threshold.ms", 100.0), \
+                prop_override("slo.interactive.window.s", 3600.0), \
+                prop_override("slo.burn.fast.s", 300.0), \
+                prop_override("slo.flightrec.burn", 0.0):
+            d = slo_def("interactive")
+            for _ in range(1000):
+                eng.observe("count", "interactive", 0.001)
+            clk.advance(3000.0)
+            for _ in range(10):
+                eng.observe("count", "interactive", 10.0)
+            fast = eng.burn(d, 300.0)
+            slow = eng.burn(d, 3600.0)
+            # fast: 10/10 bad / 0.01 = 100; slow: 10/1010 / 0.01 ~= 0.99
+            assert fast == pytest.approx(100.0)
+            assert slow == pytest.approx((10 / 1010) / 0.01)
+            assert eng.burning() == []
+            # once the good traffic ages out of the slow window AND the
+            # fast window still sees fresh bad traffic, it reports
+            clk.advance(650.0)  # t=3650: good slots fall out of 3600s
+            for _ in range(10):
+                eng.observe("count", "interactive", 10.0)
+            assert eng.burn(d, 3600.0) > 1.0
+            assert eng.burn(d, 300.0) > 1.0
+            assert "interactive" in eng.burning()
+
+    def test_snapshot_document_shape(self):
+        clk = FakeClock(50.0)
+        eng = SloEngine(clock=clk)
+        with prop_override("slo.flightrec.burn", 0.0):
+            eng.observe("count", "interactive", 0.002, trace_id="t1")
+        doc = eng.snapshot()
+        assert doc["enabled"] is True
+        s = doc["slos"]["interactive"]
+        assert s["requests"] == 1 and s["bad"] == 0
+        assert s["burn"]["fast"]["rate"] == 0.0
+        assert doc["series"]["count|interactive"]["p50_ms"] == 2.5
+
+
+# -- exemplars: observe -> prometheus exposition round trip -----------------
+
+
+class TestExemplars:
+    def test_histogram_exemplar_round_trip(self):
+        from geomesa_tpu.metrics import MetricsRegistry
+
+        r = MetricsRegistry()
+        h = r.histogram("geomesa_t_seconds", buckets=(0.1, 1.0))
+        h.observe(0.5, exemplar={"trace_id": "abc123"})
+        h.observe(0.05)  # no exemplar on this bucket
+        text = r.prometheus_text(openmetrics=True)
+        # cumulative buckets: le="1" counts both observations; the
+        # exemplar names the one that landed IN that bucket
+        assert (
+            'geomesa_t_seconds_bucket{le="1"} 2 # {trace_id="abc123"} 0.5'
+            in text
+        )
+        # the exemplar-less bucket stays plain
+        assert 'geomesa_t_seconds_bucket{le="0.1"} 1\n' in text
+        assert text.endswith("# EOF\n")
+
+    def test_classic_exposition_never_carries_exemplars(self):
+        """The 0.0.4 text format has no exemplar syntax — one suffixed
+        line would fail a classic Prometheus scrape ENTIRELY, so the
+        default exposition must strip them (OpenMetrics only)."""
+        from geomesa_tpu.metrics import MetricsRegistry
+
+        r = MetricsRegistry()
+        h = r.histogram("geomesa_t_seconds", buckets=(0.1, 1.0))
+        h.observe(0.5, exemplar={"trace_id": "abc123"})
+        text = r.prometheus_text()
+        assert "trace_id" not in text
+        assert "# EOF" not in text
+        assert 'geomesa_t_seconds_bucket{le="1"} 1\n' in text
+
+    def test_slo_observe_attaches_exemplar(self):
+        from geomesa_tpu.metrics import REGISTRY
+
+        with slo.fresh_engine() as eng, \
+                prop_override("slo.flightrec.burn", 0.0):
+            eng.observe(
+                "count", "interactive", 0.33, trace_id="feedbee1"
+            )
+        text = REGISTRY.prometheus_text(openmetrics=True)
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("geomesa_slo_latency_seconds_bucket")
+            and 'trace_id="feedbee1"' in ln
+        )
+        assert 'le="0.5"' in line  # 0.33 lands in the 0.5 bucket
+
+
+# -- compile ledger: forced cold compile attribution ------------------------
+
+
+class TestCompileLedger:
+    def test_cold_compile_charges_request_and_signature(self):
+        import jax
+        import jax.numpy as jnp
+
+        ledger.install()
+        COMPILES.reset()
+        # a fresh closure constant makes the HLO unique: this compile
+        # cannot be served by any cache, in this process or on disk
+        uniq = int(time.perf_counter() * 1e9) % 1_000_003 + 2
+        with ledger.collect_cost(
+            tenant="t", endpoint="knn", lane="interactive", shape="s"
+        ) as cost:
+            cost.trace_id = "trace-cold-1"
+            with ledger.compile_scope("test.kernel:k=8"):
+                jax.jit(lambda x: x * uniq + 1)(jnp.arange(257))
+        fields = cost.snapshot_fields()
+        assert fields.get("compiles", 0) >= 1
+        assert fields.get("compile_seconds", 0) > 0
+        snap = COMPILES.snapshot()
+        sig = snap["by_signature"]["test.kernel:k=8"]
+        assert sig["compiles"] >= 1
+        assert sig["last_trace_id"] == "trace-cold-1"
+        # the compile also lands in the trace retroactively when one is
+        # recording — covered by the e2e test below; here the request
+        # aggregate is the contract
+        assert snap["total_s"] > 0
+
+    def test_compile_outside_scope_falls_back_to_request_shape(self):
+        import jax
+        import jax.numpy as jnp
+
+        ledger.install()
+        COMPILES.reset()
+        uniq = int(time.perf_counter() * 1e9) % 999_983 + 2
+        with ledger.collect_cost(
+            tenant="t", endpoint="count", lane="batch", shape="count:BBOX"
+        ):
+            jax.jit(lambda x: x + uniq)(jnp.arange(129))
+        assert "request:count:BBOX" in COMPILES.snapshot()["by_signature"]
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+@pytest.fixture
+def flightrec(tmp_path):
+    slo.FLIGHTREC.reset()
+    slo.FLIGHTREC.configure(str(tmp_path / "fr"))
+    (tmp_path / "fr").mkdir()
+    yield slo.FLIGHTREC
+    slo.FLIGHTREC.reset()
+
+
+class TestFlightRecorder:
+    def test_injected_breaker_open_writes_bundle(self, flightrec):
+        resilience.reset()
+        try:
+            with prop_override("resilience.breaker.failures", 1), \
+                    prop_override("slo.flightrec.interval.s", 0.0):
+                resilience.device_breaker().record_failure()
+            names = flightrec.bundle_names()
+            assert len(names) == 1 and names[0].endswith("breaker-open")
+            from pathlib import Path
+
+            bundle = Path(flightrec.dir) / names[0]
+            reason = json.loads((bundle / "reason.json").read_text())
+            assert reason["reason"] == "breaker-open"
+            assert reason["detail"]["domain"] == "device"
+            breakers = json.loads((bundle / "breakers.json").read_text())
+            assert breakers["device"]["state"] == "open"
+            # the rest of the postmortem set is present
+            have = {p.name for p in bundle.iterdir()}
+            assert {
+                "traces.json", "metrics.prom", "slo.json", "ledger.json",
+            } <= have
+        finally:
+            resilience.reset()
+
+    def test_rate_limit_per_reason(self, flightrec):
+        with prop_override("slo.flightrec.interval.s", 3600.0):
+            assert flightrec.trigger("manual") is not None
+            assert flightrec.trigger("manual") is None  # limited
+            # a different reason has its own budget
+            assert flightrec.trigger("burn-rate") is not None
+
+    def test_bounded_retention(self, flightrec):
+        with prop_override("slo.flightrec.interval.s", 0.0), \
+                prop_override("slo.flightrec.keep", 3):
+            for _ in range(6):
+                assert flightrec.trigger("manual") is not None
+        assert len(flightrec.bundle_names()) == 3
+
+    def test_unknown_reason_collapses_to_manual(self, flightrec):
+        with prop_override("slo.flightrec.interval.s", 0.0):
+            path = flightrec.trigger("not-a-reason")
+        assert path is not None and path.endswith("manual")
+
+    def test_disabled_without_directory(self):
+        slo.FLIGHTREC.reset()
+        assert slo.FLIGHTREC.trigger("manual") is None
+
+
+# -- cost ledger ------------------------------------------------------------
+
+
+def _cost(tenant, shape="count:BBOX", dur_s=0.01, status=200, **charges):
+    c = RequestCost(
+        tenant=tenant, endpoint="count", lane="interactive", shape=shape
+    )
+    for field, amount in charges.items():
+        c.charge(field, amount)
+    c.dur_s = dur_s
+    c.status = status
+    return c
+
+
+class TestCostLedger:
+    def test_per_tenant_isolation(self):
+        led = CostLedger()
+        led.record(_cost("a", device_seconds=1.0, device_launches=1))
+        led.record(_cost("b", device_seconds=3.0, device_launches=2))
+        led.record(_cost("a", device_seconds=0.5, device_launches=1))
+        snap = led.snapshot(top=10)
+        ta, tb = snap["tenants"]["a"], snap["tenants"]["b"]
+        assert ta["requests"] == 2 and tb["requests"] == 1
+        assert ta["cost"]["device_seconds"] == pytest.approx(1.5)
+        assert tb["cost"]["device_seconds"] == pytest.approx(3.0)
+        assert ta["cost"]["device_launches"] == 2
+        # per-shape aggregation sees all three
+        assert snap["shapes"]["count:BBOX"]["requests"] == 3
+
+    def test_latency_quantiles_per_tenant(self):
+        led = CostLedger()
+        for _ in range(9):
+            led.record(_cost("a", dur_s=0.004))
+        led.record(_cost("a", dur_s=2.0))  # rank 9.9 of 10 => 2.5s bucket
+        agg = led.snapshot(top=5)["tenants"]["a"]
+        assert agg["p50_ms"] == 5.0
+        assert agg["p99_ms"] == 2500.0
+
+    def test_bounded_tenant_keyspace(self):
+        led = CostLedger()
+        for i in range(300):
+            led.record(_cost(f"tenant-{i}"))
+        snap = led.snapshot(top=500)
+        assert len(snap["tenants"]) <= 257
+        assert snap["tenants"]["other"]["requests"] >= 43
+
+    def test_unknown_field_raises(self):
+        with pytest.raises(KeyError):
+            RequestCost().charge("not_a_ledger_field", 1)
+
+    def test_fusion_width_folds_as_max(self):
+        c = RequestCost(tenant="a")
+        c.charge("fusion_width", 4)
+        c.charge("fusion_width", 2)
+        assert c.snapshot_fields()["fusion_width"] == 4
+
+    def test_top_requests_ranked_by_cost(self):
+        led = CostLedger()
+        cheap = _cost("a", device_seconds=0.001)
+        cheap.trace_id = "cheap"
+        dear = _cost("b", device_seconds=9.0, compile_seconds=3.0)
+        dear.trace_id = "dear"
+        led.record(cheap)
+        led.record(dear)
+        top = led.snapshot(top=1)["top_requests"]
+        assert top[0]["trace_id"] == "dear"
+        assert top[0]["cost_s"] == pytest.approx(12.0)
+
+    def test_charges_noop_outside_request(self):
+        # no collector installed: must neither raise nor leak anywhere
+        ledger.charge("device_seconds", 1.0)
+
+    def test_disabled_ledger_skips_the_fold_but_not_slo(self):
+        """ledger.enabled=False must not fold into the process ledger —
+        and must NOT silently disable the SLO engine, whose only feed
+        is finish_request (the switches are independent)."""
+        before = ledger.LEDGER.requests
+        with prop_override("ledger.enabled", False), \
+                prop_override("slo.flightrec.burn", 0.0), \
+                slo.fresh_engine() as eng:
+
+            class _Done:
+                dur_s = 0.002
+                trace_id = "x"
+                recording = False
+
+            with ledger.collect_cost(
+                tenant="x", endpoint="count", lane="interactive"
+            ) as cost:
+                assert cost is not None  # SLO still needs the meta
+                ledger.charge("device_seconds", 1.0)
+                cost.status = 200
+            ledger.finish_request(cost, _Done)
+            assert ledger.LEDGER.requests == before  # no ledger fold
+            d = slo_def("interactive")
+            with eng._lock:
+                lane = eng._lanes.get("interactive")
+                n = lane.merged(d.window_s)["n"] if lane else 0
+            assert n == 1  # ...but the SLO engine observed the request
+
+
+class TestCostFromTrace:
+    def test_span_tree_assembly(self):
+        doc = {
+            "trace_id": "t", "duration_ms": 100.0,
+            "spans": {
+                "name": "GET /count/x", "dur_ms": 100.0, "attrs": {},
+                "children": [
+                    {"name": "sched.execute", "dur_ms": 40.0,
+                     "attrs": {"fused": 4}, "children": []},
+                    {"name": "store.read", "dur_ms": 10.0,
+                     "attrs": {"bytes": 2048, "chunks": 3,
+                               "chunk_total": 10}, "children": []},
+                    {"name": "store.decode", "dur_ms": 5.0, "attrs": {},
+                     "children": []},
+                    {"name": "xla.compile", "dur_ms": 25.0,
+                     "attrs": {"signature": "knn:k=8"}, "children": []},
+                ],
+            },
+        }
+        costs = cost_from_trace(doc)
+        assert costs["device_launches"] == 1
+        assert costs["device_seconds"] == pytest.approx(0.01)  # 40ms / 4
+        assert costs["fusion_width"] == 4
+        assert costs["read_bytes"] == 2048
+        assert costs["chunks_read"] == 3
+        assert costs["chunks_pruned"] == 7
+        assert costs["decode_seconds"] == pytest.approx(0.005)
+        assert costs["compile_seconds"] == pytest.approx(0.025)
+
+
+# -- e2e: serving flow under lockcheck --------------------------------------
+
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+
+
+def _fs_store(tmp_path, n=512):
+    from geomesa_tpu.filter.ecql import parse_instant
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    ds = FileSystemDataStore(str(tmp_path / "store"))
+    ds.create_schema("gdelt", SPEC)
+    rng = np.random.default_rng(11)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write("gdelt", {
+        "name": rng.choice(["a", "b"], n),
+        "dtg": t0 + rng.integers(0, 10**8, n),
+        "geom": np.stack(
+            [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+    ds.flush("gdelt")
+    return ds
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=60) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+class TestServingE2E:
+    def test_slo_to_readyz_flow(self, tmp_path):
+        """The acceptance flow: a breaching workload lights /stats/slo,
+        /readyz reports the burning SLO as degraded detail (still 200),
+        the /metrics exemplar resolves to a captured trace, the ledger
+        attributes per-tenant cost, and the flight recorder lands a
+        burn-rate bundle under the store root."""
+        from geomesa_tpu.sched import SchedConfig
+        from geomesa_tpu.server import serve_background
+
+        ds = _fs_store(tmp_path)
+        prev_engine = slo.ENGINE
+        slo.ENGINE = SloEngine()
+        ledger.LEDGER.reset()
+        slo.FLIGHTREC.reset()
+        resilience.reset()
+        try:
+            server, _ = serve_background(
+                ds, resident=True,
+                sched=SchedConfig(max_inflight=1, default_deadline_ms=None),
+            )
+            host, port = server.server_address[:2]
+            base = f"http://{host}:{port}"
+            cql = quote("BBOX(geom, -10, -10, 10, 10)")
+            with prop_override("slo.interactive.threshold.ms", 0.0001), \
+                    prop_override("slo.flightrec.interval.s", 0.0):
+                for i in range(4):
+                    st, _, _ = _get(
+                        base,
+                        f"/count/gdelt?cql={cql}&loose=1&tenant=t{i % 2}",
+                    )
+                    assert st == 200
+                # the SLO fold runs on the server thread AFTER the
+                # response body is written: poll (inside the override
+                # scope) until the last request has been observed
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    st, _, doc = _get(base, "/stats/slo")
+                    if doc["slos"]["interactive"]["requests"] >= 4:
+                        break
+                    time.sleep(0.02)
+            # /stats/slo: every request breached the (absurd) threshold
+            assert st == 200
+            s = doc["slos"]["interactive"]
+            assert s["bad"] == s["requests"] == 4
+            assert s["burning"] is True
+            # /readyz: burning is degraded DETAIL, not unready
+            st, _, ready = _get(base, "/readyz")
+            assert st == 200 and ready["ready"] is True
+            assert "interactive" in ready["slo_burning"]
+            # /metrics: exemplars only under OpenMetrics negotiation —
+            # a classic scrape must stay suffix-free
+            with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+                assert "trace_id" not in r.read().decode()
+            req = urllib.request.Request(
+                f"{base}/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert "openmetrics-text" in r.headers.get("Content-Type")
+                text = r.read().decode()
+            tids = {
+                ln.split('trace_id="')[1].split('"')[0]
+                for ln in text.splitlines()
+                if ln.startswith("geomesa_slo_latency_seconds_bucket")
+                and "trace_id=" in ln
+            }
+            assert tids, "no exemplars on the slo latency histogram"
+            resolved = []
+            for tid in tids:
+                try:
+                    st, _, trace = _get(base, f"/debug/traces/{tid}")
+                except urllib.error.HTTPError:
+                    continue  # an older test's evicted trace
+                if st == 200 and trace["trace_id"] == tid:
+                    resolved.append(tid)
+            assert resolved, f"no exemplar resolved to a trace: {tids}"
+            # the ledger attributed per-tenant cost, and the /stats
+            # roll-up carries both new sections
+            st, _, led = _get(base, "/stats/ledger")
+            assert {"t0", "t1"} <= set(led["tenants"])
+            assert led["tenants"]["t0"]["cost"].get(
+                "device_launches", 0
+            ) >= 1
+            st, _, roll = _get(base, "/stats")
+            assert "slo" in roll and "ledger" in roll
+            # the burn crossed slo.flightrec.burn: a bundle exists and
+            # names the burn + the compile attribution inside
+            names = slo.FLIGHTREC.bundle_names()
+            assert any(n.endswith("burn-rate") for n in names)
+            server.shutdown()
+            server.scheduler.shutdown(timeout=2.0)
+        finally:
+            slo.ENGINE = prev_engine
+            slo.FLIGHTREC.reset()
+            ledger.LEDGER.reset()
+            resilience.reset()
+
+    def test_fault_free_serving_stays_quiet(self, tmp_path):
+        """No breach, no bundle: a healthy serve leg must not trip the
+        recorder, and /readyz must report nothing burning."""
+        from geomesa_tpu.server import serve_background
+
+        ds = _fs_store(tmp_path, n=128)
+        prev_engine = slo.ENGINE
+        slo.ENGINE = SloEngine()
+        slo.FLIGHTREC.reset()
+        try:
+            server, _ = serve_background(ds)
+            host, port = server.server_address[:2]
+            base = f"http://{host}:{port}"
+            cql = quote("BBOX(geom, -10, -10, 10, 10)")
+            with prop_override("slo.interactive.threshold.ms", 60000.0):
+                for _ in range(3):
+                    st, _, _ = _get(base, f"/count/gdelt?cql={cql}")
+                    assert st == 200
+            st, _, ready = _get(base, "/readyz")
+            assert ready["slo_burning"] == []
+            assert slo.FLIGHTREC.bundle_names() == []
+            st, _, doc = _get(base, "/stats/slo")
+            assert doc["slos"]["interactive"]["bad"] == 0
+            server.shutdown()
+        finally:
+            slo.ENGINE = prev_engine
+            slo.FLIGHTREC.reset()
+
+    def test_slo_disabled_is_inert(self, tmp_path):
+        from geomesa_tpu.server import serve_background
+
+        ds = _fs_store(tmp_path, n=64)
+        with prop_override("slo.enabled", False):
+            server, _ = serve_background(ds)
+            host, port = server.server_address[:2]
+            base = f"http://{host}:{port}"
+            cql = quote("BBOX(geom, -1, -1, 1, 1)")
+            st, _, _ = _get(base, f"/count/gdelt?cql={cql}")
+            assert st == 200
+            st, _, doc = _get(base, "/stats/slo")
+            assert doc == {"enabled": False, "slos": {}, "series": {}}
+            st, _, ready = _get(base, "/readyz")
+            assert ready["slo_burning"] == []
+            server.shutdown()

@@ -2324,6 +2324,383 @@ def _serve_observability_snapshot(base: str) -> dict:
     return out
 
 
+def bench_stream(args) -> dict:
+    """``--mode stream``: sustained streaming ingest CONCURRENT with a
+    serving load over the merged live layer (ISSUE 10). An appender
+    POSTs batches to ``/append`` (honoring 429 Retry-After) while a
+    query thread hammers ``/count`` and samples ``/stats/stream``;
+    records append rows/s, serve qps and the live-layer state. Guards
+    (always, ``--smoke`` is just the small-N variant):
+
+    - **read amplification**: the sampled live-run count never exceeds
+      ``wal.max.generations`` (backpressure, not unbounded growth);
+    - **immediate visibility**: once the appender finishes, the very
+      next ``/count`` equals seed + acked rows — no flush on the path;
+    - **acked-row durability**: after a draining shutdown the store
+      reopens (WAL replay) to exactly seed + acked rows.
+    """
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from geomesa_tpu import resilience
+    from geomesa_tpu.conf import prop_override, sys_prop
+    from geomesa_tpu.sched import SchedConfig
+    from geomesa_tpu.server import serve_background
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.store.stream import StreamingStore
+
+    smoke = bool(args.smoke)
+    seed_n = args.n or (1 << 12 if smoke else 1 << 15)
+    batch_rows = 256 if smoke else 2048
+    n_batches = 40 if smoke else 192
+    resilience.reset()
+    tmp = tempfile.mkdtemp(prefix="geomesa-bench-stream-")
+    root = os.path.join(tmp, "store")
+    rng = np.random.default_rng(7)
+
+    def mk(n, fid0):
+        return {
+            "val": rng.integers(0, 100, n),
+            "dtg": rng.integers(0, 10**9, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)],
+                axis=1,
+            ),
+        }, np.arange(fid0, fid0 + n)
+
+    try:
+        with prop_override("stream.memtable.rows", 4096 if smoke else 1 << 16), \
+                prop_override("stream.run.rows", batch_rows):
+            ds = FileSystemDataStore(root, partition_size=1 << 14)
+            ds.create_schema(
+                "gdelt", "val:Int,dtg:Date,*geom:Point:srid=4326"
+            )
+            cols, fids = mk(seed_n, 0)
+            ds.write("gdelt", cols, fids=fids)
+            ds.flush("gdelt")
+            server, _ = serve_background(
+                ds, resident=True, stream=True,
+                sched=SchedConfig(max_queue=256,
+                                  default_deadline_ms=None),
+            )
+            host, port = server.server_address[:2]
+            base = f"http://{host}:{port}"
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=120) as r:
+                    return json.loads(r.read())
+
+            def post(doc):
+                req = urllib.request.Request(
+                    f"{base}/append/gdelt",
+                    data=json.dumps(doc).encode(),
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    return r.status, json.loads(r.read())
+
+            assert get("/count/gdelt")["count"] == seed_n  # warm resident
+            max_gens = int(sys_prop("wal.max.generations"))
+            max_runs_seen = [0]
+            qps_n = [0]
+            stop = threading.Event()
+            errors: list = []
+
+            def server_load():
+                try:
+                    while not stop.is_set():
+                        get("/count/gdelt")
+                        qps_n[0] += 1
+                        st = get("/stats/stream")
+                        t = st["types"].get("gdelt")
+                        if t:
+                            max_runs_seen[0] = max(
+                                max_runs_seen[0], len(t["runs"])
+                            )
+                except Exception as e:  # pragma: no cover - fails the guard
+                    errors.append(e)
+
+            th = threading.Thread(target=server_load, daemon=True)
+            th.start()
+            acked = 0
+            shed = 0
+            fid0 = 10_000_000
+            t0 = time.perf_counter()
+            for i in range(n_batches):
+                cols, fids = mk(batch_rows, fid0)
+                doc = {
+                    "columns": {
+                        "val": cols["val"].tolist(),
+                        "dtg": cols["dtg"].tolist(),
+                        "geom": cols["geom"].tolist(),
+                    },
+                    "fids": fids.tolist(),
+                }
+                while True:
+                    try:
+                        status, out = post(doc)
+                    except urllib.error.HTTPError as e:
+                        if e.code == 429:  # backpressured: honor the hint
+                            shed += 1
+                            time.sleep(
+                                min(float(e.headers.get(
+                                    "Retry-After", 1)), 2.0)
+                            )
+                            continue
+                        raise
+                    assert out["acked"] == batch_rows, out
+                    acked += batch_rows
+                    fid0 += batch_rows
+                    break
+            append_s = time.perf_counter() - t0
+            stop.set()
+            th.join(timeout=10)
+            assert not errors, errors[:1]
+            # guard: bounded read amplification under sustained ingest
+            assert max_runs_seen[0] <= max_gens, (
+                f"live runs {max_runs_seen[0]} exceeded "
+                f"wal.max.generations={max_gens}"
+            )
+            # guard: every acked row queryable with NO flush on the path
+            total = get("/count/gdelt")["count"]
+            assert total == seed_n + acked, (total, seed_n, acked)
+            stream_doc = get("/stats/stream")
+            server.shutdown()
+        # guard: durability — reopen (WAL replay + watermark) and the
+        # acked rows are all there, exactly once
+        ds2 = FileSystemDataStore(root, partition_size=1 << 14)
+        layer2 = StreamingStore(ds2)
+        try:
+            reopened = layer2.count("gdelt")
+            assert reopened == seed_n + acked, (reopened, seed_n, acked)
+        finally:
+            layer2.close()
+        rate = acked / append_s if append_s > 0 else 0.0
+        log(
+            f"stream: {acked:,} rows acked in {append_s:.2f}s "
+            f"({rate:,.0f} rows/s) concurrent with {qps_n[0]} serving "
+            f"reads; max live runs {max_runs_seen[0]}/{max_gens}, "
+            f"{shed} backpressure sheds, "
+            f"{int(stream_doc['counters']['compactions'])} compactions"
+        )
+        return {
+            "stream_seed_rows": seed_n,
+            "stream_acked_rows": acked,
+            "stream_append_rows_per_sec": rate,
+            "stream_serve_reads": qps_n[0],
+            "stream_serve_qps": qps_n[0] / append_s if append_s else 0.0,
+            "stream_max_live_runs": max_runs_seen[0],
+            "stream_max_generations": max_gens,
+            "stream_backpressure_sheds": shed,
+            "stream_compactions": int(
+                stream_doc["counters"]["compactions"]
+            ),
+            "stream_reopened_rows": seed_n + acked,
+            "stream_ok": True,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+#: subprocess body for the stream chaos SIGKILL leg: append batches,
+#: fsync an ack record per batch, then die at the armed WAL instant
+_STREAM_CRASH_BODY = r"""
+import os, sys
+import numpy as np
+from geomesa_tpu import failpoints
+from geomesa_tpu.conf import set_prop
+from geomesa_tpu.store.fs import FileSystemDataStore
+from geomesa_tpu.store.stream import StreamingStore
+
+root, acked_path = sys.argv[1], sys.argv[2]
+set_prop("stream.run.rows", 64)
+set_prop("stream.memtable.rows", 1 << 20)
+set_prop("wal.max.generations", 64)
+ds = FileSystemDataStore(root, partition_size=1 << 12)
+layer = StreamingStore(ds)
+fh = open(acked_path, "a")
+rng = np.random.default_rng(11)
+for i in range(3):
+    n = 64
+    layer.append("gdelt", {
+        "val": rng.integers(0, 100, n),
+        "dtg": rng.integers(0, 10**9, n),
+        "geom": np.stack([rng.uniform(-180, 180, n),
+                          rng.uniform(-90, 90, n)], axis=1),
+    }, fids=np.arange(5_000_000 + i * 100, 5_000_000 + i * 100 + n))
+    fh.write(f"{i}\n"); fh.flush(); os.fsync(fh.fileno())
+failpoints.set_failpoint("fail.wal.append", "kill")
+n = 64
+layer.append("gdelt", {
+    "val": rng.integers(0, 100, n),
+    "dtg": rng.integers(0, 10**9, n),
+    "geom": np.stack([rng.uniform(-180, 180, n),
+                      rng.uniform(-90, 90, n)], axis=1),
+}, fids=np.arange(6_000_000, 6_000_000 + n))
+os._exit(42)  # unreachable: the failpoint kills
+"""
+
+
+def bench_stream_chaos(args) -> dict:
+    """``--mode stream --chaos-smoke``: the streaming-ingest chaos
+    smoke, mirroring the PR 7 serve chaos step. Legs:
+
+    1. transient WAL faults ride the ``wal``-domain retry budget (the
+       append still acks, rows still serve);
+    2. a persistent WAL fault opens the ``wal`` breaker — appends fail
+       fast 503 (no ack against a dead log) and recover after cooldown;
+    3. a compaction that publishes but fails before WAL truncation
+       neither loses nor re-applies rows across a reopen (watermark);
+    4. a REAL SIGKILL mid-append in a subprocess: the reopened store
+       serves exactly the acked rows.
+    """
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from geomesa_tpu import failpoints, resilience
+    from geomesa_tpu.conf import prop_override
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.store.stream import (
+        StreamingStore,
+        WalUnavailableError,
+    )
+
+    resilience.reset()
+    tmp = tempfile.mkdtemp(prefix="geomesa-bench-streamchaos-")
+    root = os.path.join(tmp, "store")
+    rng = np.random.default_rng(3)
+
+    def mk(n, fid0):
+        return {
+            "val": rng.integers(0, 100, n),
+            "dtg": rng.integers(0, 10**9, n),
+            "geom": np.stack(
+                [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)],
+                axis=1,
+            ),
+        }, np.arange(fid0, fid0 + n)
+
+    try:
+        with prop_override("stream.memtable.rows", 1 << 20):
+            ds = FileSystemDataStore(root, partition_size=1 << 12)
+            ds.create_schema(
+                "gdelt", "val:Int,dtg:Date,*geom:Point:srid=4326"
+            )
+            cols, fids = mk(1024, 0)
+            ds.write("gdelt", cols, fids=fids)
+            ds.flush("gdelt")
+            layer = StreamingStore(ds)
+            total = 1024
+
+            # -- leg 1: transient WAL faults retry and still ack ------
+            with failpoints.failpoint_override("fail.wal.append", "raise:2"):
+                cols, fids = mk(64, 1_000_000)
+                layer.append("gdelt", cols, fids=fids)
+                total += 64
+            assert layer.count("gdelt") == total
+            log("stream-chaos: transient-WAL leg ok (retried, acked, "
+                "served)")
+
+            # -- leg 2: persistent WAL fault opens the wal breaker ----
+            with prop_override("resilience.retries", 0), \
+                    prop_override("resilience.breaker.failures", 1), \
+                    prop_override("resilience.breaker.cooldown.s", 0.3):
+                with failpoints.failpoint_override(
+                    "fail.wal.append", "raise"
+                ):
+                    try:
+                        cols, fids = mk(64, 1_100_000)
+                        layer.append("gdelt", cols, fids=fids)
+                        raise AssertionError("append acked against a "
+                                             "failing WAL")
+                    except OSError:
+                        pass  # the injected fault, retries exhausted
+                    assert resilience.wal_breaker().state == "open"
+                    try:
+                        cols, fids = mk(64, 1_200_000)
+                        layer.append("gdelt", cols, fids=fids)
+                        raise AssertionError("append acked through an "
+                                             "open wal breaker")
+                    except WalUnavailableError:
+                        pass  # fail-fast: no ack against a dead log
+                assert layer.count("gdelt") == total  # nothing phantom
+                time.sleep(0.35)  # cooldown: half-open probe
+                cols, fids = mk(64, 1_300_000)
+                layer.append("gdelt", cols, fids=fids)
+                total += 64
+                assert resilience.wal_breaker().state == "closed"
+            assert layer.count("gdelt") == total
+            log("stream-chaos: wal-breaker leg ok (fail-fast 503, "
+                "half-open recovery)")
+
+            # -- leg 3: publish-then-fail compaction, watermark skip --
+            from geomesa_tpu.failpoints import FailpointError
+
+            with failpoints.failpoint_override(
+                "fail.compact.publish", "raise"
+            ):
+                try:
+                    layer.compact_now("gdelt")
+                    raise AssertionError("failpoint did not fire")
+                except FailpointError:
+                    pass
+            assert layer.count("gdelt") == total
+            layer.close()
+            ds2 = FileSystemDataStore(root, partition_size=1 << 12)
+            layer2 = StreamingStore(ds2)
+            assert layer2.count("gdelt") == total, (
+                "watermark failed: rows lost or re-applied across reopen"
+            )
+            layer2.close()
+            log("stream-chaos: compact-publish leg ok (no loss, no "
+                "double-apply across reopen)")
+
+            # -- leg 4: real SIGKILL mid-append in a subprocess -------
+            acked_path = os.path.join(tmp, "acked.txt")
+            env = dict(os.environ)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            p = subprocess.run(
+                [sys.executable, "-c", _STREAM_CRASH_BODY, root,
+                 acked_path],
+                env=env, timeout=240,
+            )
+            assert p.returncode == -signal.SIGKILL, p.returncode
+            with open(acked_path) as fh:
+                acked = [int(x) for x in fh.read().split()]
+            expected = total + len(acked) * 64
+            ds3 = FileSystemDataStore(root, partition_size=1 << 12)
+            layer3 = StreamingStore(ds3)
+            got = layer3.query("gdelt").batch
+            assert len(got) == len({str(f) for f in got.fids}), (
+                "rows double-applied after crash"
+            )
+            assert layer3.count("gdelt") == expected, (
+                layer3.count("gdelt"), expected
+            )
+            assert ds3.verify_chunk_stats("gdelt") == []
+            layer3.close()
+            log(f"stream-chaos: SIGKILL leg ok ({len(acked)} acked "
+                "batches served exactly after reopen)")
+        return {
+            "stream_chaos_rows": expected,
+            "stream_chaos_acked_batches": len(acked),
+            "stream_chaos_ok": True,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_trace_overhead(args) -> dict:
     """The --trace-overhead check: the serving leg with tracing at its
     DEFAULT sampling (trace.sample=1, slow capture on) must stay within
@@ -2837,7 +3214,7 @@ def main() -> None:
         choices=(
             "all", "filter", "zscan", "build", "polygon", "density", "sweep",
             "xzbuild", "meshbuild", "multichip", "pipeline", "oocscan",
-            "join", "serve", "flush",
+            "join", "serve", "flush", "stream",
         ),
         default="all",
         help="all: every benchmark, one JSON line with everything (what "
@@ -2883,6 +3260,11 @@ def main() -> None:
                 out.update(bench_trace_overhead(args))
     elif args.mode == "flush":
         out = bench_flush(args)
+    elif args.mode == "stream":
+        if args.chaos_smoke:
+            out = bench_stream_chaos(args)
+        else:
+            out = bench_stream(args)
     else:
         # zscan FIRST: its DeviceIndex staging is a long sequence of
         # host->device transfers that measures 20-30x slower when another

@@ -118,7 +118,7 @@ Properties:
                                 under the threshold (error budget =
                                 1 - objective); one set of keys per
                                 registered SLO name (slo.SLO_NAMES:
-                                ``interactive``, ``batch``)
+                                ``interactive``, ``batch``, ``ingest``)
 - ``slo.<name>.threshold.ms``   the latency bar a GOOD request answers
                                 under (5xx responses are always bad)
 - ``slo.<name>.window.s``       the slow burn window (and the windowed
@@ -140,6 +140,39 @@ Properties:
 - ``ledger.topk``               entries per ranking in the
                                 /stats/ledger document (tenants,
                                 shapes, top requests, compile sigs)
+- ``stream.enabled``            streaming live layer (store/stream.py):
+                                WAL-backed ``append`` with an in-memory
+                                generation that serves immediately and
+                                background compaction into the store's
+                                generation files
+- ``wal.segment.bytes``         write-ahead-log segment rotation size;
+                                a full segment is sealed (fsynced) and
+                                a new one opened
+- ``wal.max.generations``       live memtable runs allowed before
+                                appends backpressure (429-style) — the
+                                read-amplification bound: every merged
+                                query scans at most this many runs on
+                                top of the resident/on-disk data
+- ``stream.memtable.rows``      memtable rows that trigger background
+                                compaction into the partition files
+- ``stream.run.rows``           target rows per Z-sorted memtable run:
+                                appends coalesce into the tail run
+                                until it reaches this size (bounds the
+                                per-append re-sort AND run count)
+- ``stream.compact.yield.ms``   per-check pause the compactor yields
+                                to serving load while the scheduler
+                                queue is brownout-saturated (bounded:
+                                it compacts regardless once appends
+                                are backpressured)
+- ``stream.stall.s``            seconds without a successful compaction
+                                while appends are backpressured before
+                                the flight recorder snapshots an
+                                ``ingest-stall`` postmortem bundle
+- ``stream.append.max.bytes``   request-body bound for POST /append
+                                (413 past it): one append becomes one
+                                WAL record and one memtable run, so an
+                                unbounded body would be an unbounded
+                                allocation (0 disables the bound)
 """
 
 from __future__ import annotations
@@ -263,6 +296,11 @@ _DEFS = {
     "slo.batch.objective": (0.99, float),
     "slo.batch.threshold.ms": (5000.0, float),
     "slo.batch.window.s": (3600.0, float),
+    # the streaming-append lane's own budget (a 429-shed append is the
+    # backpressure contract, not an SLO breach; 5xx and slow acks are)
+    "slo.ingest.objective": (0.999, float),
+    "slo.ingest.threshold.ms": (100.0, float),
+    "slo.ingest.window.s": (3600.0, float),
     "slo.burn.fast.s": (300.0, float),
     "slo.flightrec.burn": (8.0, float),
     "slo.flightrec.keep": (8, int),
@@ -271,6 +309,18 @@ _DEFS = {
     # /stats/ledger ranking size
     "ledger.enabled": (True, _parse_bool),
     "ledger.topk": (10, int),
+    # streaming live layer (store/stream.py + store/wal.py): master
+    # switch, WAL segment rotation, the read-amplification bound
+    # (appends backpressure past it), memtable sizing and the
+    # compactor's yield/stall knobs
+    "stream.enabled": (False, _parse_bool),
+    "wal.segment.bytes": (4 << 20, int),
+    "wal.max.generations": (8, int),
+    "stream.memtable.rows": (1 << 15, int),
+    "stream.run.rows": (8192, int),
+    "stream.compact.yield.ms": (50.0, float),
+    "stream.stall.s": (30.0, float),
+    "stream.append.max.bytes": (32 << 20, int),
 }
 
 _overrides: dict = {}

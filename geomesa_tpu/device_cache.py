@@ -742,6 +742,21 @@ class DeviceIndex:
     def __len__(self) -> int:
         return len(self._host_batch)
 
+    def refresh_delta(self, batch) -> str:
+        """Incrementally fold freshly appended rows into the resident
+        planes (the streaming live layer's per-append hook). The base
+        cache has no validity plane or capacity headroom, so its only
+        correct move is the full restage; the streaming and sharded
+        flavors override this with true in-place deltas behind their
+        validity planes. Returns the mode taken (``"delta"`` /
+        ``"restage"``) and counts it on
+        ``geomesa_stream_delta_refreshes_total``."""
+        from geomesa_tpu import metrics
+
+        self.refresh()
+        metrics.stream_delta_refreshes.inc(mode="restage")
+        return "restage"
+
     @property
     def nbytes(self) -> int:
         """Resident device bytes."""
@@ -2554,6 +2569,28 @@ class StreamingDeviceIndex(DeviceIndex):
         with self._lock:
             self._install(self._parts[0].take(np.array([], dtype=np.int64)))
 
+    def refresh_delta(self, batch) -> str:
+        """Streamed-append hook: fresh fids delta-append — one donated
+        device update, no restage. A batch carrying a fid this index
+        already holds is ambiguous (a duplicate-fid append, which the
+        store path serves as TWO rows, or a re-delivery racing a full
+        restage that already staged it): the backing store's merged
+        view is authoritative for both, so restage from it rather than
+        guess — upserting here would silently diverge from the store
+        path's duplicate-row semantics."""
+        from geomesa_tpu import metrics
+
+        with self._lock:
+            if any(f in self._row_of for f in batch.fids.tolist()):
+                self.refresh()
+                mode = "restage"
+            else:
+                before = self.restages
+                self.append(batch)
+                mode = "restage" if self.restages > before else "delta"
+        metrics.stream_delta_refreshes.inc(mode=mode)
+        return mode
+
     def attach_live(self, live_store):
         """Apply per-message deltas from a live store: Put upserts only
         the changed rows, Remove evicts, Clear (or anything else) falls
@@ -2712,6 +2749,7 @@ class ShardedDeviceIndex(DeviceIndex):
         z_planes: bool = True,
         mesh=None,
         replicas: "int | None" = None,
+        reserve_rows: int = 0,
     ):
         from geomesa_tpu.locking import checked_rlock
         from geomesa_tpu.parallel.mesh import serving_mesh
@@ -2735,6 +2773,19 @@ class ShardedDeviceIndex(DeviceIndex):
         self._build_seconds = 0.0
         self._build_engine = None  # "mesh" | "host-fallback" | None
         self._hits_jits: dict = {}
+        #: extra plane capacity staged behind the validity plane so
+        #: streamed appends land as in-place deltas instead of a full
+        #: mesh restage (0 = pad to the shard multiple only — the
+        #: batch-serving default)
+        self._reserve_rows = max(int(reserve_rows), 0)
+        self._deltas = 0  # streamed delta refreshes since last restage
+        self._delta_jits: dict = {}
+        #: host-mirror parts: deltas append here and the concat is
+        #: DEFERRED to the next host-side read (_host_rows) — an eager
+        #: per-delta concat would copy the whole mirror per append,
+        #: O(total) on the ack path (the StreamingDeviceIndex _parts
+        #: discipline)
+        self._host_parts: list = []
         super().__init__(
             store, type_name, columns, z_planes=z_planes, dim_planes=False
         )
@@ -2846,8 +2897,15 @@ class ShardedDeviceIndex(DeviceIndex):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         n = len(self._host_batch)
+        self._host_parts = [self._host_batch]
         self._n_staged = n
-        pad = (-n) % self._n_shards
+        self._deltas = 0
+        self._fids_seen = None  # delta duplicate-check set: rebuild lazily
+        # reserve_rows of delta headroom, rounded to a shard multiple:
+        # streamed appends update slots [n, cap) in place behind the
+        # validity plane until the reserve is spent (then full restage)
+        want = n + self._reserve_rows
+        pad = (want - n) + ((-want) % self._n_shards)
         cap = n + pad
         if cap == 0:
             self._dev_valid = None
@@ -2885,7 +2943,15 @@ class ShardedDeviceIndex(DeviceIndex):
 
         self._shards = []
         n = self._n_staged
-        cap = n + ((-n) % self._n_shards)
+        # REAL plane capacity (reserve_rows headroom included): the
+        # per-shard slot width comes from the staged layout, not the
+        # no-reserve formula — with reserve on, real rows concentrate
+        # in the leading shards and the manifest must say so
+        cap = (
+            int(self._dev_valid.shape[0])
+            if self._dev_valid is not None
+            else n
+        )
         per = cap // self._n_shards if self._n_shards and cap else 0
         # boundary-only key fetches: 2 elements per shard instead of
         # gathering the whole sharded key planes back to host
@@ -2927,8 +2993,122 @@ class ShardedDeviceIndex(DeviceIndex):
             "resident_bytes": self.nbytes,
             "build_seconds": round(self._build_seconds, 4),
             "build_engine": self._build_engine,
+            "reserve_rows": self._reserve_rows,
+            "delta_refreshes": self._deltas,
             "shard_ranges": [m.to_json() for m in self._shards],
         }
+
+    def refresh_delta(self, batch) -> str:
+        """Streamed-append hook: fold the new rows into the RESERVED
+        tail slots behind the validity plane — one donated mesh-wide
+        update per plane set, no restage — while capacity, the packed
+        bt window and the vis vocabulary allow; anything else (reserve
+        spent, ``_BtRebase``/``_VisOverflow``, a plane the fixed
+        buffers have no slot for, a duplicate fid) falls back to the
+        full mesh restage. Delta rows are NOT globally Z-sorted — the
+        scans are masked compares over the planes with validity ANDed
+        in, so answers stay exact; the next restage re-sorts."""
+        from geomesa_tpu import metrics
+
+        with self._lock:
+            try:
+                mode = self._delta_locked(batch)
+            except (_VisOverflow, _BtRebase):
+                mode = None
+            if mode is None:
+                self.refresh()
+                mode = "restage"
+        metrics.stream_delta_refreshes.inc(mode=mode)
+        return mode
+
+    def _delta_locked(self, batch) -> "str | None":
+        import jax
+        import jax.numpy as jnp
+
+        from geomesa_tpu.features.batch import FeatureBatch
+
+        m = len(batch)
+        if m == 0:
+            return "delta"
+        if self._dev_valid is None or not self._host_parts:
+            return None  # nothing sharded yet: restage establishes it
+        cap = int(self._dev_valid.shape[0])
+        pad = max(_next_pow2(m), 256)
+        if self._n_staged + pad > cap:
+            return None  # reserve spent
+        # duplicate fids cannot update in place (no per-row eviction on
+        # the sharded planes): restage folds them through the store
+        if any(f in self._row_of_sharded() for f in batch.fids.tolist()):
+            return None
+        before = set(self._cols)
+        delta = self._stage_batch(batch)  # may raise _VisOverflow/_BtRebase
+        if set(delta) != before:
+            return None  # a plane with no buffer slot (first labels etc.)
+        delta = {
+            k: jnp.concatenate([v, jnp.zeros(pad - m, v.dtype)])
+            if pad > m
+            else v
+            for k, v in delta.items()
+        }
+        key = (pad, tuple(sorted(delta)))
+        upd_jit = self._delta_jits.get(key)
+        if upd_jit is None:
+            def _upd(cols, valid, dcols, n, rows):
+                out = {
+                    k: jax.lax.dynamic_update_slice_in_dim(
+                        buf, dcols[k].astype(buf.dtype), n, 0
+                    )
+                    for k, buf in cols.items()
+                }
+                live = jnp.arange(pad) < rows
+                return out, jax.lax.dynamic_update_slice_in_dim(
+                    valid, live, n, 0
+                )
+
+            upd_jit = self._delta_jits[key] = jax.jit(
+                _upd, donate_argnums=(0, 1)
+            )
+        self._cols, self._dev_valid = upd_jit(
+            self._cols, self._dev_valid, delta, self._n_staged, m
+        )
+        # host mirror: append the part, concat deferred to _host_rows
+        self._host_parts.append(batch)
+        self._host_batch = None
+        self._n_staged += m
+        self._deltas += 1
+        for f in batch.fids.tolist():
+            self._fids_seen.add(f)
+        return "delta"
+
+    def __len__(self) -> int:
+        return self._n_staged
+
+    def _host_rows(self):
+        """Host mirror, materialized lazily: deltas collect in
+        ``_host_parts`` and pay ONE concat at the next host-side read
+        instead of one per append."""
+        if self._host_batch is None:
+            from geomesa_tpu.features.batch import FeatureBatch
+
+            self._host_batch = (
+                self._host_parts[0]
+                if len(self._host_parts) == 1
+                else FeatureBatch.concat(self._host_parts)
+            )
+            self._host_parts = [self._host_batch]
+        return self._host_batch
+
+    def _row_of_sharded(self) -> set:
+        """Lazily built fid membership set for the delta duplicate
+        check: built once per restage (``_shard_cols`` resets it to
+        None), kept incrementally current by ``_delta_locked``. A
+        None-flag, NOT a length comparison — staged data may
+        legitimately hold duplicate fids (the store serves them as two
+        rows), and a length test would misfire on them forever,
+        forcing the full mirror concat back onto every ack."""
+        if getattr(self, "_fids_seen", None) is None:
+            self._fids_seen = set(self._host_rows().fids.tolist())
+        return self._fids_seen
 
     # -- scan hooks --------------------------------------------------------
 

@@ -39,6 +39,24 @@ suite's fault-tolerant-serving legs, ISSUE 7):
                                    arm with ``sleep:<ms>`` to inject
                                    slow-disk latency without errors
 
+Streaming-ingest points (store/wal.py + store/stream.py — the live
+layer's crash kill matrix, ISSUE 10):
+
+- ``fail.wal.append``           -- a WAL record is about to be written
+                                   (before any byte lands); ``kill``
+                                   here loses exactly the un-acked
+                                   record, never an acked one
+- ``fail.wal.rotate``           -- a full WAL segment is about to seal
+                                   and a new one open
+- ``fail.wal.replay``           -- WAL replay at store open is about to
+                                   scan a segment (recovery must be
+                                   idempotent under a crash mid-replay)
+- ``fail.compact.publish``      -- the background compactor published a
+                                   new generation but has not yet
+                                   truncated the consumed WAL segments
+                                   (replay must skip them via the
+                                   manifest watermark, not re-apply)
+
 Activation: programmatic (``set_failpoint``/``failpoint_override``) or
 the ``GEOMESA_TPU_FAILPOINTS`` environment variable, a comma-separated
 ``name=action`` list — the env form is how a chaos test arms a point in
@@ -86,6 +104,10 @@ POINTS = (
     "fail.device.launch",
     "fail.stage.oom",
     "fail.sched.worker",
+    "fail.wal.append",
+    "fail.wal.rotate",
+    "fail.wal.replay",
+    "fail.compact.publish",
 )
 
 

@@ -95,6 +95,10 @@ FIELDS = (
     "chunks_pruned",     # v2 chunks skipped before read/decode
     "retries",           # serving-path retries spent (resilience.py)
     "degraded",          # degradation rungs taken (note_degraded count)
+    "wal_bytes",         # write-ahead-log bytes this append durably wrote
+    "wal_fsyncs",        # WAL fsync calls this append waited on
+    "memtable_rows",     # rows this append landed in the live memtable
+    "compact_seconds",   # background compaction seconds (system requests)
 )
 
 #: fields folded with max() instead of sum() (a request's fusion width

@@ -529,6 +529,60 @@ compile_event_seconds = REGISTRY.counter(
     "total XLA backend compile seconds observed by the compile ledger",
 )
 
+# streaming live layer (store/stream.py + store/wal.py): WAL-backed
+# incremental ingest, the in-memory generation it serves from, and the
+# backpressured background compaction into the partition files
+stream_appends = REGISTRY.counter(
+    "geomesa_stream_appends_total", "acked streaming append calls"
+)
+stream_rows = REGISTRY.counter(
+    "geomesa_stream_rows_total", "rows acked through the streaming layer"
+)
+stream_wal_bytes = REGISTRY.counter(
+    "geomesa_stream_wal_bytes_total", "bytes appended to WAL segments"
+)
+stream_wal_fsyncs = REGISTRY.counter(
+    "geomesa_stream_wal_fsyncs_total", "WAL fsync calls (durability acks)"
+)
+stream_wal_replay_rows = REGISTRY.counter(
+    "geomesa_stream_wal_replay_rows_total",
+    "rows recovered into the memtable by WAL replay at open",
+)
+stream_wal_truncations = REGISTRY.counter(
+    "geomesa_stream_wal_truncations_total",
+    "torn WAL tails truncated at the last valid checksum during replay",
+)
+stream_memtable_rows = REGISTRY.gauge(
+    "geomesa_stream_memtable_rows",
+    "rows live in the in-memory generation (not yet compacted)",
+)
+stream_memtable_runs = REGISTRY.gauge(
+    "geomesa_stream_memtable_runs",
+    "Z-sorted memtable runs live (the per-query read amplification)",
+)
+stream_backpressure = REGISTRY.counter(
+    "geomesa_stream_backpressure_total",
+    "appends rejected 429-style at the wal.max.generations bound",
+)
+stream_compactions = REGISTRY.counter(
+    "geomesa_stream_compactions_total",
+    "memtable generations compacted into partition files",
+)
+stream_compact_seconds = REGISTRY.histogram(
+    "geomesa_stream_compact_seconds",
+    "background compaction duration (merge + flush + WAL truncate)",
+)
+stream_compact_yields = REGISTRY.counter(
+    "geomesa_stream_compact_yields_total",
+    "compactor pauses yielded to serving load (brownout signal)",
+)
+stream_delta_refreshes = REGISTRY.counter(
+    "geomesa_stream_delta_refreshes_total",
+    "resident-index refreshes from streamed appends, by mode "
+    "(delta = incremental into the validity-planed buffers, "
+    "restage = fallback full restage)",
+)
+
 # runtime lock-order checker (analysis/lockcheck.py): the acquisition
 # graph's size and its findings -- nonzero cycles or blocking events in
 # a checked process is a concurrency regression (gauges, set whenever

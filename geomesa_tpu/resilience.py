@@ -407,6 +407,13 @@ def cache_breaker() -> CircuitBreaker:
     return breaker("cache")
 
 
+def wal_breaker() -> CircuitBreaker:
+    """The breaker guarding write-ahead-log I/O (streaming ingest): an
+    open breaker fails appends fast — acks must never be promised
+    against a log that cannot take them."""
+    return breaker("wal")
+
+
 def partition_breaker(type_name: str, pid) -> CircuitBreaker:
     """The keyed breaker guarding reads of ONE partition. Bounded
     registry (HARD bound): when full, closed keyed breakers evict
@@ -449,6 +456,7 @@ def snapshot() -> dict:
     health probe sees the full domain list from the first scrape."""
     device_breaker()
     cache_breaker()
+    wal_breaker()
     with _breakers_lock:
         singles = {
             k: b for k, b in _breakers.items() if isinstance(k, str)
@@ -466,6 +474,7 @@ def reset() -> None:
         _breakers.clear()
     metrics.resilience_breaker_state.set(0, domain="device")
     metrics.resilience_breaker_state.set(0, domain="cache")
+    metrics.resilience_breaker_state.set(0, domain="wal")
 
 
 # -- degradation accounting -------------------------------------------------
@@ -489,6 +498,8 @@ REASONS = frozenset(
         "partition-unavailable",
         "brownout-pushdown",
         "mesh-degraded",
+        "ingest-degraded",
+        "wal-replay-truncated",
     }
 )
 

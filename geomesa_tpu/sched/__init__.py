@@ -11,6 +11,7 @@ ONE stacked device launch than as N independent ones. See
 from geomesa_tpu.sched.fusion import FusableQuery, execute_group
 from geomesa_tpu.sched.scheduler import (
     LANE_BATCH,
+    LANE_INGEST,
     LANE_INTERACTIVE,
     DeadlineExpired,
     QueryScheduler,
@@ -22,6 +23,7 @@ __all__ = [
     "DeadlineExpired",
     "FusableQuery",
     "LANE_BATCH",
+    "LANE_INGEST",
     "LANE_INTERACTIVE",
     "QueryScheduler",
     "RejectedError",

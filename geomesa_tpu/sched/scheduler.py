@@ -63,7 +63,13 @@ _retry_rng = random.Random()  # Retry-After jitter (de-correlates clients)
 
 LANE_INTERACTIVE = "interactive"
 LANE_BATCH = "batch"
-_LANES = (LANE_INTERACTIVE, LANE_BATCH)
+#: streaming appends: highest priority BY DESIGN — an append is a
+#: sub-millisecond host-side unit (WAL write + memtable insert; its
+#: own 429 bound is the wal.max.generations backpressure), and queueing
+#: acks behind multi-second device scans would put a flush back on the
+#: ack path. Admission/deadline/fairness apply like any lane.
+LANE_INGEST = "ingest"
+_LANES = (LANE_INGEST, LANE_INTERACTIVE, LANE_BATCH)
 
 
 class RejectedError(RuntimeError):

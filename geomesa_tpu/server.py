@@ -129,6 +129,7 @@ class _GeomesaHTTPServer(ThreadingHTTPServer):
 
     scheduler = None
     store = None  # wired by make_server (audit flush at drain)
+    stream_layer = None  # StreamingStore, when the live layer is on
 
     def __init__(self, *args, **kwargs):
         self.draining = threading.Event()
@@ -138,6 +139,13 @@ class _GeomesaHTTPServer(ThreadingHTTPServer):
         self.draining.set()  # stop admission BEFORE finishing in-flight
         if self.scheduler is not None:
             self.scheduler.close(timeout=5.0)
+        if self.stream_layer is not None:
+            # stop the compactor and seal the WAL; acked-but-uncompacted
+            # rows stay durable in the log and replay on the next open
+            try:
+                self.stream_layer.close()
+            except Exception:  # close is best-effort on the way down
+                pass
         aw = getattr(self.store, "audit_writer", None)
         if aw is not None:
             try:
@@ -152,6 +160,7 @@ class _Handler(BaseHTTPRequestHandler):
     resident = False  # serve from device-pinned DeviceIndex caches
     mesh = False  # shard resident indexes across the device mesh
     scheduler = None  # QueryScheduler (admission + micro-batch fusion)
+    stream = None  # StreamingStore live layer (None = batch-only)
     _resident_cache: dict = {}  # per-server-class: type -> DeviceIndex
     _resident_lock = None  # per-server-class construction lock
 
@@ -236,7 +245,10 @@ class _Handler(BaseHTTPRequestHandler):
         with self._resident_lock:
             if type_name in cache:
                 return cache[type_name], False
-            di = _make_resident_index(self.store, type_name, self.mesh)
+            di = _make_resident_index(
+                self.store, type_name, self.mesh,
+                streaming=self.stream is not None,
+            )
             cache[type_name] = di
             return di, True
 
@@ -425,7 +437,8 @@ class _Handler(BaseHTTPRequestHandler):
             parts == ["stats", "store"]
             and hasattr(self.store, "store_stats")
         ) or parts == ["stats", "mesh"] or parts == ["stats", "slo"] \
-            or parts == ["stats", "ledger"] or parts == ["stats"]
+            or parts == ["stats", "ledger"] or parts == ["stats", "stream"] \
+            or parts == ["stats"]
         if untraced:
             self._trace = None
             self._degraded = None
@@ -467,6 +480,113 @@ class _Handler(BaseHTTPRequestHandler):
             self._dispatch_safe(url, parts, q)
         ledger.finish_request(cost, tr)
 
+    def do_POST(self) -> None:  # noqa: N802 (stdlib API)
+        """POST ``/append/<type>``: the streaming-ingest endpoint. Body
+        ``{"columns": {...}, "fids": [...], "visibilities": [...]}``;
+        the response acks rows that are WAL-durable and queryable NOW
+        (no flush/restage on this path). Backpressure surfaces as 429 +
+        Retry-After — from the scheduler's admission bound or the live
+        layer's ``wal.max.generations`` read-amplification bound."""
+        from geomesa_tpu.conf import sys_prop
+
+        try:
+            url = urlparse(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            q = {k: v[0] for k, v in parse_qs(url.query).items()}
+            length = int(self.headers.get("Content-Length") or 0)
+            cap = int(sys_prop("stream.append.max.bytes"))
+            if cap and length > cap:
+                # bounded-everything discipline: one append becomes one
+                # WAL record and one memtable run — refuse BEFORE
+                # buffering (nothing is read, nothing is acked)
+                self._trace = None
+                self._degraded = None
+                self._cost = None
+                return self._json(413, {
+                    "error": f"append body {length} bytes exceeds "
+                             f"stream.append.max.bytes={cap}"
+                })
+            body = self.rfile.read(length) if length else b""
+        except Exception as e:
+            self._trace = None
+            self._degraded = None
+            self._cost = None
+            return self._json(400, {"error": str(e)})
+        if len(parts) != 2 or parts[0] != "append":
+            self._trace = None
+            self._degraded = None
+            self._cost = None
+            return self._json(
+                404, {"error": f"no such POST endpoint {url.path!r}"}
+            )
+        # appends default to the dedicated ingest lane (top priority:
+        # sub-ms host work must not queue behind device scans)
+        q.setdefault("lane", "ingest")
+        from geomesa_tpu import ledger, resilience
+        from geomesa_tpu.tracing import TRACER
+
+        tenant = q.get("tenant") or (
+            str(self.client_address[0]) if self.client_address else ""
+        )
+        with TRACER.trace(
+            f"POST {url.path}",
+            trace_id=self.headers.get("X-Request-Id"),
+            attrs={"path": url.path, "bytes": len(body)},
+        ) as tr, resilience.collect_degraded() as reasons, \
+                ledger.collect_cost(
+                    tenant=tenant,
+                    endpoint="append",
+                    lane=q["lane"],
+                    shape="append",
+                ) as cost:
+            self._trace = tr
+            self._degraded = reasons
+            self._cost = cost
+            if cost is not None:
+                cost.trace_id = tr.trace_id
+            self._run_safe(
+                lambda: self._append_post(parts, q, body), parts, q
+            )
+        ledger.finish_request(cost, tr)
+
+    def _append_post(self, parts: list, q: dict, body: bytes) -> None:
+        from geomesa_tpu.features.batch import FeatureBatch
+
+        type_name = unquote(parts[1])
+        if self._draining():
+            return self._send(
+                503,
+                json.dumps(
+                    {"error": "server is draining"}
+                ).encode("utf-8"),
+                "application/json",
+                headers=(("Retry-After", "1"),),
+            )
+        stream = self.stream
+        if stream is None:
+            return self._json(
+                400,
+                {"error": "server is not running with the streaming "
+                          "live layer (stream.enabled / serve --stream)"},
+            )
+        doc = json.loads(body.decode("utf-8")) if body else {}
+        cols = doc.get("columns")
+        if not isinstance(cols, dict) or not cols:
+            raise ValueError(
+                'append body needs {"columns": {...}, "fids": [...]}'
+            )
+        sft = self.store.get_schema(type_name)  # KeyError -> 404
+        batch = FeatureBatch.from_columns(sft, cols, doc.get("fids"))
+        vis = doc.get("visibilities")
+        if vis is not None:
+            batch = batch.with_visibility(vis)
+        res = self._sched_run(
+            q, fn=lambda: stream.append(type_name, batch)
+        )
+        self._json(
+            200, {"acked": int(res["rows"]), "seq": int(res["seq"])}
+        )
+
     def _audit_outcome(self, parts: list, q: dict, outcome: str) -> None:
         """Stamp a shed (429) or deadline-expired (504) request into the
         audit log — operators sizing admission need the requests that
@@ -493,8 +613,13 @@ class _Handler(BaseHTTPRequestHandler):
             pass
 
     def _dispatch_safe(self, url, parts: list, q: dict) -> None:
+        return self._run_safe(
+            lambda: self._dispatch(url, parts, q), parts, q
+        )
+
+    def _run_safe(self, fn, parts: list, q: dict) -> None:
         try:
-            return self._dispatch(url, parts, q)
+            return fn()
         except KeyError as e:
             self._json(404, {"error": f"unknown schema or attribute {e}"})
         except ValueError as e:
@@ -503,7 +628,17 @@ class _Handler(BaseHTTPRequestHandler):
             pass
         except Exception as e:
             from geomesa_tpu.sched import DeadlineExpired, RejectedError
+            from geomesa_tpu.store.stream import WalUnavailableError
 
+            if isinstance(e, WalUnavailableError):
+                # the wal breaker is open: appends fail fast until its
+                # half-open probe — 503 says "not you, come back"
+                return self._send(
+                    503,
+                    json.dumps({"error": str(e)}).encode("utf-8"),
+                    "application/json",
+                    headers=(("Retry-After", "1"),),
+                )
             if isinstance(e, RejectedError):
                 # backpressure: shed load explicitly instead of queueing
                 # unboundedly; clients should honor Retry-After (derived
@@ -614,6 +749,13 @@ class _Handler(BaseHTTPRequestHandler):
             from geomesa_tpu.ledger import LEDGER
 
             return self._json(200, LEDGER.snapshot())
+        if parts == ["stats", "stream"]:
+            return self._json(
+                200,
+                self.stream.stream_stats()
+                if self.stream is not None
+                else {"enabled": False},
+            )
         if parts == ["stats"]:
             return self._json(200, self._stats_index())
         if len(parts) == 2 and parts[0] in (
@@ -668,6 +810,8 @@ class _Handler(BaseHTTPRequestHandler):
         doc["mesh"] = self._mesh_stats()
         doc["slo"] = slo.ENGINE.snapshot()
         doc["ledger"] = LEDGER.snapshot()
+        if self.stream is not None:
+            doc["stream"] = self.stream.stream_stats()
         return doc
 
     def _debug_traces(self, parts: list, q: dict) -> None:
@@ -1123,7 +1267,7 @@ class _Handler(BaseHTTPRequestHandler):
 #: URL scanner cannot mint unbounded metric series or ring keys
 _KNOWN_ENDPOINTS = frozenset({
     "features", "count", "explain", "density", "stats", "refresh",
-    "knn", "tube", "proximity", "capabilities",
+    "knn", "tube", "proximity", "capabilities", "append",
 })
 
 
@@ -1168,20 +1312,46 @@ def _mesh_serving_enabled(mesh) -> bool:
     return min(n, len(jax.devices())) > 1
 
 
-def _make_resident_index(store, type_name: str, mesh: bool):
-    """One resident index, mesh-sharded when mesh serving is on."""
+def _make_resident_index(store, type_name: str, mesh: bool,
+                         streaming: bool = False):
+    """One resident index, mesh-sharded when mesh serving is on. With
+    the streaming live layer attached, the mesh flavor reserves
+    ``stream.memtable.rows`` of plane headroom so streamed appends land
+    as in-place deltas behind the validity plane instead of full mesh
+    restages (the single-chip StreamingDeviceIndex delta-appends
+    natively)."""
     if mesh:
         from geomesa_tpu.device_cache import ShardedDeviceIndex
 
-        return ShardedDeviceIndex(store, type_name, z_planes=True)
+        reserve = 0
+        if streaming:
+            from geomesa_tpu.conf import sys_prop
+
+            reserve = int(sys_prop("stream.memtable.rows"))
+        return ShardedDeviceIndex(
+            store, type_name, z_planes=True, reserve_rows=reserve
+        )
     from geomesa_tpu.device_cache import StreamingDeviceIndex
 
-    return StreamingDeviceIndex(store, type_name, z_planes=True)
+    capacity = None
+    if streaming:
+        # pre-size the delta buffers so the first streamed appends land
+        # as in-place deltas instead of an immediate growth restage
+        from geomesa_tpu.conf import sys_prop
+
+        rows = getattr(store, "manifest_rows", None)
+        capacity = int(sys_prop("stream.memtable.rows")) + (
+            int(rows(type_name)) if rows else 0
+        )
+    return StreamingDeviceIndex(
+        store, type_name, z_planes=True, capacity=capacity
+    )
 
 
 def make_server(
     store, host: str = "127.0.0.1", port: int = 0, resident: bool = False,
     warm: bool = False, sched=None, io=None, mesh: "bool | None" = None,
+    stream: "bool | None" = None,
 ):
     """Build a ThreadingHTTPServer bound to (host, port); port 0 picks an
     ephemeral port (see ``server.server_address``). ``resident=True``
@@ -1251,6 +1421,27 @@ def make_server(
         scheduler = QueryScheduler(
             sched if isinstance(sched, SchedConfig) else None
         )
+    # streaming live layer: wrap the store so every serving path —
+    # endpoints AND resident DeviceIndex staging — reads the merged
+    # (memtable ∪ partitions) view; POST /append goes WAL-first and
+    # serves immediately. Needs a real filesystem store (the WAL and
+    # crash-consistent compaction live under its root).
+    stream_layer = None
+    from geomesa_tpu.store.stream import StreamingStore, streaming_enabled
+
+    stream_on = streaming_enabled() if stream is None else bool(stream)
+    if stream_on:
+        if not (root_dir and hasattr(store, "_exclusive")):
+            import warnings
+
+            warnings.warn(
+                "streaming live layer needs a FileSystemDataStore "
+                "(a WAL directory under the store root); stream.enabled "
+                "ignored for this store"
+            )
+        else:
+            stream_layer = StreamingStore(store, scheduler=scheduler)
+            store = stream_layer
     from geomesa_tpu.locking import checked_lock
 
     handler = type(
@@ -1261,6 +1452,7 @@ def make_server(
             "resident": resident,
             "mesh": mesh_on,
             "scheduler": scheduler,
+            "stream": stream_layer,
             "_resident_cache": {},
             # blocking_ok: first-touch resident builds hold it across
             # store reads + device staging BY DESIGN (a duplicate build
@@ -1278,7 +1470,10 @@ def make_server(
             # the OTHER types from serving — same isolation the lazy
             # first-touch path gives: that type just isn't resident
             try:
-                di = _make_resident_index(store, tn, mesh_on)
+                di = _make_resident_index(
+                    store, tn, mesh_on,
+                    streaming=stream_layer is not None,
+                )
                 di.warmup()
             except Exception as e:
                 warnings.warn(f"warm staging failed for {tn!r}: {e!r}")
@@ -1303,6 +1498,34 @@ def make_server(
         return doc
 
     providers["mesh"] = _mesh_snapshot
+    if stream_layer is not None:
+        providers["stream"] = stream_layer.stream_stats
+
+        def _stream_delta(tname, batch, h=handler):
+            """Per-append incremental resident refresh: fold the acked
+            batch into an already-staged index's planes (delta path —
+            no restage on the ack path). The cache probe happens UNDER
+            the construction lock: an append acked between a first-
+            touch build's staging snapshot and its cache publication
+            must wait for the build and then deliver (refresh_delta is
+            re-delivery-safe — duplicate fids force a restage through
+            the merged view), or the staged index would be missing
+            acked rows with no future delta to repair it. A failure
+            evicts the index so the next query restages a correct
+            copy; the streaming layer stamps ``ingest-degraded`` and
+            the rows keep serving from the merged store path either
+            way."""
+            with h._resident_lock:
+                di = h._resident_cache.get(tname)
+            if di is None:
+                return  # first query stages the merged view lazily
+            try:
+                di.refresh_delta(batch)
+            except Exception:
+                h._resident_cache.pop(tname, None)
+                raise
+
+        stream_layer.add_delta_listener(_stream_delta)
     _slo.FLIGHTREC.configure(
         _os.path.join(str(root_dir), "_flightrec")
         if root_dir
@@ -1312,18 +1535,20 @@ def make_server(
     server = _GeomesaHTTPServer((host, port), handler)
     server.scheduler = scheduler  # callers may inspect / shut down
     server.store = store  # the draining shutdown flushes its audit log
+    server.stream_layer = stream_layer  # closed by the draining shutdown
     return server
 
 
 def serve_background(
     store, host: str = "127.0.0.1", port: int = 0, resident: bool = False,
     warm: bool = False, sched=None, io=None, mesh: "bool | None" = None,
+    stream: "bool | None" = None,
 ):
     """Start serving on a daemon thread; returns (server, thread). Stop
     with ``server.shutdown()``."""
     server = make_server(
         store, host, port, resident=resident, warm=warm, sched=sched,
-        io=io, mesh=mesh,
+        io=io, mesh=mesh, stream=stream,
     )
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()

@@ -71,12 +71,15 @@ __all__ = [
 
 #: the SLO name registry (GT009): one SLO per scheduler priority lane.
 #: Adding an SLO = a name here + its three conf keys in conf._DEFS.
-SLO_NAMES = ("interactive", "batch")
+#: ``ingest`` (the streaming-append lane) gets its own budget: sub-ms
+#: appends at volume would otherwise dilute the interactive good-ratio
+#: and mask a real latency breach from the burn-rate alerts.
+SLO_NAMES = ("interactive", "batch", "ingest")
 
 #: the flight-recorder reason registry (GT009): bundle directory names
 #: and the geomesa_flightrec_bundles_total metric label both come from
 #: here, so reasons stay a bounded, greppable enum
-FLIGHT_REASONS = ("burn-rate", "breaker-open", "manual")
+FLIGHT_REASONS = ("burn-rate", "breaker-open", "manual", "ingest-stall")
 
 #: windowed-histogram bucket bounds (seconds) — finer than the metrics
 #: default so p999 at serving latencies is meaningful
@@ -126,6 +129,11 @@ _SLO_KEYS = {
         "slo.batch.objective",
         "slo.batch.threshold.ms",
         "slo.batch.window.s",
+    ),
+    "ingest": (
+        "slo.ingest.objective",
+        "slo.ingest.threshold.ms",
+        "slo.ingest.window.s",
     ),
 }
 

@@ -96,6 +96,13 @@ class _FsTypeState:
     #: sibling partitions keep serving. Cleared when a new generation
     #: is read or published.
     quarantined: "dict[int, str]" = field(default_factory=dict)
+    #: highest WAL sequence folded into the published generation (the
+    #: streaming layer's recovery watermark, store/stream.py): replay
+    #: at open skips records at or below it — they are already in the
+    #: partition files. -1 = nothing streamed/compacted yet. Persisted
+    #: ATOMICALLY with the manifest, so a crash between publish and
+    #: WAL truncation re-applies nothing.
+    wal_watermark: int = -1
 
 
 class PartitionCorruptError(RuntimeError):
@@ -606,6 +613,7 @@ class FileSystemDataStore:
             file_gen=meta.get("file_gen"),
             format_version=int(meta.get("format", FORMAT_V1)),
             dirty=bool(meta.get("dirty", False)),
+            wal_watermark=int(meta.get("wal_watermark", -1)),
         )
 
     @staticmethod
@@ -666,6 +674,7 @@ class FileSystemDataStore:
             "file_gen": st.file_gen,
             "format": st.format_version,
             "dirty": st.dirty,
+            "wal_watermark": st.wal_watermark,
             "spec": st.sft.spec,
             "primary": st.primary,
             "encoding": st.encoding,
@@ -803,6 +812,7 @@ class FileSystemDataStore:
         st.file_gen = new.file_gen
         st.format_version = new.format_version
         st.dirty = new.dirty
+        st.wal_watermark = new.wal_watermark
         st.cache = {}
         # a new generation means new files: stale per-partition
         # quarantines must not outlive the files they indicted
@@ -1296,6 +1306,7 @@ class FileSystemDataStore:
                 "partitions": len(st.partitions),
                 "rows": int(sum(p.count for p in st.partitions)),
                 "dirty": bool(st.dirty),
+                "wal_watermark": int(st.wal_watermark),
                 # format-mix / chunk-stats coverage: how much of the
                 # type the pruning + pushdown machinery can serve (v1
                 # partitions linger until a compact lazily upgrades)

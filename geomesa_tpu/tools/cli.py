@@ -368,6 +368,65 @@ def cmd_compact(args):
     print(f"compacted {args.feature_name!r}")
 
 
+def cmd_wal(args):
+    """Inspect the streaming live layer's write-ahead logs: per-type
+    segments, sequence state, the manifest watermark and how many acked
+    rows would replay into the memtable on the next open. ``--truncate``
+    garbage-collects segments wholly below the watermark (what the
+    compactor does after every publish; safe — replayable records are
+    never touched)."""
+    import os as _os
+
+    from geomesa_tpu.store.wal import WriteAheadLog
+
+    store = _store(args)
+    names = (
+        [args.feature_name] if args.feature_name else store.type_names
+    )
+    for name in names:
+        wal_dir = _os.path.join(store.root, name, "_wal")
+        if not _os.path.isdir(wal_dir):
+            print(f"{name}: no WAL (nothing streamed)")
+            continue
+        # readonly: a live server may be appending to this log RIGHT
+        # NOW — the inspection scan must never truncate what it reads
+        # as a torn tail out from under the appender's fd
+        wal = WriteAheadLog(wal_dir, readonly=True)
+        watermark = int(store._types[name].wal_watermark)
+        rows = 0
+        records = 0
+        from geomesa_tpu.features.batch import FeatureBatch  # noqa: F401
+
+        for _seq, payload in wal.replay(after_seq=watermark):
+            records += 1
+            rows += _wal_payload_rows(payload)
+        st = wal.stats()
+        print(
+            f"{name}: {st['segments']} segment(s), {st['bytes']} bytes, "
+            f"next_seq={st['next_seq']}, watermark={watermark}; "
+            f"{records} replayable record(s) / {rows} acked row(s) "
+            "pending compaction"
+            + (f"; {st['truncations']} torn tail(s) truncated"
+               if st["truncations"] else "")
+        )
+        if getattr(args, "truncate", False):
+            removed = wal.truncate_through(watermark)
+            print(f"{name}: removed {removed} compacted segment(s)")
+        wal.close()
+
+
+def _wal_payload_rows(payload: bytes) -> int:
+    """Row count of one WAL record without a full FeatureBatch decode."""
+    try:
+        import pyarrow as pa
+
+        return int(
+            pa.ipc.open_stream(pa.BufferReader(payload)).read_all().num_rows
+        )
+    except Exception:
+        return 0
+
+
 def cmd_fsck(args):
     """Recovery sweep + full checksum verification (the offline face of
     the store's crash-recovery machinery, ISSUE 3): reclaims files from
@@ -648,6 +707,7 @@ def cmd_serve(args):
         store, args.host, args.port, resident=args.resident,
         warm=getattr(args, "warm", False), sched=_sched_config(args),
         mesh=True if getattr(args, "mesh", False) else None,
+        stream=True if getattr(args, "stream", False) else None,
     )
     host, port = server.server_address[:2]
     mode = " (resident device caches)" if args.resident else ""
@@ -655,6 +715,8 @@ def cmd_serve(args):
         mode += " (query scheduler)"
     if getattr(server.RequestHandlerClass, "mesh", False):
         mode += " (mesh-sharded)"
+    if server.stream_layer is not None:
+        mode += " (streaming live layer)"
     print(f"serving {store.root} on http://{host}:{port}{mode}")
     try:
         server.serve_forever()
@@ -1138,8 +1200,26 @@ def main(argv=None) -> None:
         "by global Z-key range (needs > 1 jax device; topology from "
         "the mesh.* conf keys, residency on /stats/mesh)",
     )
+    sp.add_argument(
+        "--stream",
+        action="store_true",
+        help="enable the streaming live layer: POST /append goes to a "
+        "crash-safe WAL and serves immediately from an in-memory "
+        "generation, compacted in the background (stream.*/wal.* conf "
+        "keys; state on /stats/stream)",
+    )
     _add_sched_flags(sp)
     _add_io_flags(sp)
+
+    sp = add("wal", cmd_wal)
+    sp.add_argument("-f", "--feature-name")
+    sp.add_argument(
+        "--truncate",
+        action="store_true",
+        help="garbage-collect WAL segments wholly below the manifest "
+        "watermark (already compacted); never touches replayable "
+        "records",
+    )
 
     sp = add("lint", cmd_lint)
     sp.add_argument("paths", nargs="*",

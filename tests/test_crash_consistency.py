@@ -431,3 +431,159 @@ def test_stats_store_snapshot_and_endpoint(tmp_path):
         assert doc2["types"]["t"]["rows"] == N0
     finally:
         server.shutdown()
+
+
+# -- streaming live layer (ISSUE 10): acked-rows-exactly kill matrix ---------
+
+STREAM_FID0, STREAM_BATCH = 20_000, 80
+
+
+def _stream_rows(i):
+    return _rows(STREAM_BATCH, seed=50 + i, fid0=STREAM_FID0 + i * 100)
+
+
+def _crash_stream(root, failpoint, acked_path):
+    """Subprocess body: stream batches through the live layer, fsyncing
+    each ACKED batch id to ``acked_path`` AFTER its append returns (the
+    client's view of what was acked), then arm ``failpoint`` with
+    ``kill`` and keep going — the process dies at the exact instant
+    under test. Auto-compaction is disabled so the kill instant, not a
+    background race, decides what was compacted."""
+    from geomesa_tpu import failpoints
+    from geomesa_tpu.conf import set_prop
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.store.stream import StreamingStore
+
+    set_prop("stream.run.rows", 64)  # every append = its own Z-sorted run
+    set_prop("wal.segment.bytes", 1 << 12)  # force segment rotations
+    set_prop("stream.memtable.rows", 1 << 20)  # no background compaction
+    set_prop("wal.max.generations", 64)  # kill decides, not backpressure
+    ds = FileSystemDataStore(root, partition_size=128)
+    layer = StreamingStore(ds)
+    fh = open(acked_path, "a")
+
+    def ack(i):
+        cols, fids = _stream_rows(i)
+        layer.append("t", cols, fids=fids)
+        fh.write(f"{i}\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+    for i in range(3):  # cleanly acked pre-crash batches
+        ack(i)
+    failpoints.set_failpoint(failpoint, "kill")
+    if failpoint == "fail.compact.publish":
+        layer.compact_now("t")  # dies between publish and WAL truncate
+    else:
+        for i in range(3, 40):  # dies at the armed WAL instant
+            ack(i)
+    os._exit(42)  # must be unreachable: the failpoint kills
+
+
+def _crash_stream_reopen(root):
+    """Second-phase subprocess: a crash DURING WAL replay at open —
+    recovery itself must be idempotent under SIGKILL."""
+    from geomesa_tpu import failpoints
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.store.stream import StreamingStore
+
+    failpoints.set_failpoint("fail.wal.replay", "kill")
+    ds = FileSystemDataStore(root, partition_size=128)
+    StreamingStore(ds)  # dies scanning the first segment
+    os._exit(42)
+
+
+def _crash_stream_no_arm(root, acked_path):
+    """Clean-exit variant (no failpoint): appends acked batches and
+    exits WITHOUT compaction or close — the WAL alone must carry them."""
+    from geomesa_tpu.conf import set_prop
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.store.stream import StreamingStore
+
+    set_prop("stream.run.rows", 64)
+    set_prop("wal.segment.bytes", 1 << 12)
+    set_prop("stream.memtable.rows", 1 << 20)
+    ds = FileSystemDataStore(root, partition_size=128)
+    layer = StreamingStore(ds)
+    fh = open(acked_path, "a")
+    for i in range(3):
+        cols, fids = _stream_rows(i)
+        layer.append("t", cols, fids=fids)
+        fh.write(f"{i}\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os._exit(43)
+
+
+def _verify_acked_exactly(root, acked_path):
+    """Reopen the store + live layer and assert the served row set is
+    EXACTLY seed ∪ acked — no acked row lost, no phantom row invented,
+    no row double-applied — and the chunk stats are drift-free."""
+    from geomesa_tpu.store.stream import StreamingStore
+
+    with open(acked_path) as fh:
+        acked = [int(line) for line in fh.read().split()]
+    expected = {int(f) for f in range(N0)}
+    for i in acked:
+        base = STREAM_FID0 + i * 100
+        expected |= set(range(base, base + STREAM_BATCH))
+    ds = FileSystemDataStore(root, partition_size=128)
+    layer = StreamingStore(ds)
+    try:
+        batch = layer.query("t").batch
+        got = [int(f) for f in batch.fids]
+        assert len(got) == len(set(got)), "rows double-applied"
+        assert set(got) == expected, (
+            f"served {len(got)} rows != seed+acked {len(expected)}"
+        )
+        assert layer.count("t") == len(expected)
+        assert ds.verify_chunk_stats("t") == []  # stats drift-free
+    finally:
+        layer.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize(
+    "failpoint",
+    ["fail.wal.append", "fail.wal.rotate", "fail.compact.publish"],
+)
+def test_stream_kill_matrix(tmp_path, failpoint):
+    """SIGKILL at every streaming-ingest instant: reopened store serves
+    exactly the acked rows. ``fail.wal.append`` kills before the record
+    lands (the un-acked batch vanishes with its torn tail, acked ones
+    survive); ``fail.wal.rotate`` kills at segment seal; and
+    ``fail.compact.publish`` kills between manifest publish and WAL
+    truncation (the manifest watermark must make replay skip the stale
+    segments, not re-apply them)."""
+    root = str(tmp_path / "store")
+    _populated(root)
+    acked_path = str(tmp_path / "acked.txt")
+
+    ctx = mp.get_context("spawn")  # fork is unsafe under JAX threads
+    p = ctx.Process(target=_crash_stream, args=(root, failpoint, acked_path))
+    p.start()
+    p.join(240)
+    assert p.exitcode == -signal.SIGKILL, (failpoint, p.exitcode)
+    _verify_acked_exactly(root, acked_path)
+
+
+@pytest.mark.chaos
+def test_stream_kill_during_replay(tmp_path):
+    """SIGKILL mid-replay: a crash during recovery itself loses nothing
+    — the next open replays the same records (idempotent; nothing was
+    compacted, so the watermark skips none of them)."""
+    root = str(tmp_path / "store")
+    _populated(root)
+    acked_path = str(tmp_path / "acked.txt")
+
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_crash_stream_no_arm, args=(root, acked_path))
+    p.start()
+    p.join(240)
+    assert p.exitcode == 43, p.exitcode  # clean exit, WAL not compacted
+
+    p2 = ctx.Process(target=_crash_stream_reopen, args=(root,))
+    p2.start()
+    p2.join(240)
+    assert p2.exitcode == -signal.SIGKILL, p2.exitcode
+    _verify_acked_exactly(root, acked_path)

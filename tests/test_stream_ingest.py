@@ -1,0 +1,700 @@
+"""Streaming live layer (ISSUE 10): WAL semantics, live-merge parity,
+backpressure, recovery, incremental resident refresh and the serving
+endpoints.
+
+The contracts under test:
+
+- WAL: an acked record survives anything; a torn tail truncates at the
+  last valid checksum; rotation seals segments; ``truncate_through``
+  GC's only wholly-compacted segments; interior damage raises loudly.
+- Live merge: (resident ⊎ memtable ⊎ mid-compaction) answers are
+  IDENTICAL to the same data batch-flushed — query/count/density/stats,
+  visibility labels included.
+- Backpressure: at ``wal.max.generations`` live runs, appends shed
+  429-style instead of growing read amplification unboundedly.
+- Recovery: reopen serves exactly the acked rows; replay is idempotent
+  and watermark-guarded.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.conf import prop_override
+from geomesa_tpu.store.fs import FileSystemDataStore
+from geomesa_tpu.store.stream import (
+    IngestBackpressureError,
+    StreamingStore,
+)
+from geomesa_tpu.store.wal import WalCorruption, WriteAheadLog
+
+SPEC = "val:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def _rows(n, seed, fid0=0):
+    rng = np.random.default_rng(seed)
+    cols = {
+        "val": rng.integers(0, 100, n),
+        "dtg": rng.integers(0, 10**9, n),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+        ),
+    }
+    return cols, np.arange(fid0, fid0 + n)
+
+
+def _store(tmp_path, n0=400, name="store"):
+    ds = FileSystemDataStore(str(tmp_path / name), partition_size=128)
+    ds.create_schema("t", SPEC)
+    if n0:
+        cols, fids = _rows(n0, seed=1)
+        ds.write("t", cols, fids=fids)
+        ds.flush("t")
+    return ds
+
+
+# -- WAL unit tests ----------------------------------------------------------
+
+
+def test_wal_append_replay_roundtrip(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    payloads = [f"record-{i}".encode() * (i + 1) for i in range(10)]
+    seqs = [wal.append(p) for p in payloads]
+    assert seqs == list(range(10))
+    wal.close()
+    wal2 = WriteAheadLog(str(tmp_path / "wal"))
+    got = list(wal2.replay())
+    assert [s for s, _ in got] == seqs
+    assert [p for _, p in got] == payloads
+    # after_seq skips the already-compacted prefix
+    assert [s for s, _ in wal2.replay(after_seq=6)] == [7, 8, 9]
+    # new appends continue the sequence
+    assert wal2.append(b"x") == 10
+    wal2.close()
+
+
+def test_wal_torn_tail_truncated(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    for i in range(5):
+        wal.append(f"rec-{i}".encode())
+    wal.close()
+    [seg] = wal.segments()
+    size = os.path.getsize(seg)
+    with open(seg, "ab") as fh:  # a crash mid-append: half a record
+        fh.write(b"\x41\x57\x4d\x47garbage-torn-tail")
+    wal2 = WriteAheadLog(str(tmp_path / "wal"))
+    got = list(wal2.replay())
+    assert [s for s, _ in got] == list(range(5))
+    assert wal2.truncations == 1
+    assert os.path.getsize(seg) == size  # cut back to the valid prefix
+    # the next append lands cleanly after the truncation point
+    assert wal2.append(b"after") == 5
+    assert [s for s, _ in wal2.replay()] == [0, 1, 2, 3, 4, 5]
+    wal2.close()
+
+
+def test_wal_corrupt_record_payload_truncates_at_damage(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    for i in range(3):
+        wal.append(b"x" * 64)
+    wal.close()
+    [seg] = wal.segments()
+    data = bytearray(open(seg, "rb").read())
+    data[-10] ^= 0xFF  # flip a payload byte of the LAST record
+    open(seg, "wb").write(bytes(data))
+    wal2 = WriteAheadLog(str(tmp_path / "wal"))
+    assert [s for s, _ in wal2.replay()] == [0, 1]  # bad crc = torn tail
+    assert wal2.truncations == 1
+    wal2.close()
+
+
+def test_wal_rotation_and_truncate_through(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal"), segment_bytes=1 << 12)
+    for i in range(40):
+        wal.append(b"p" * 512)
+    assert len(wal.segments()) > 2
+    nseg = len(wal.segments())
+    # GC everything below seq 20: only sealed, wholly-consumed segments
+    removed = wal.truncate_through(20)
+    assert removed >= 1
+    assert len(wal.segments()) == nseg - removed
+    survivors = [s for s, _ in wal.replay()]
+    # nothing above the truncation watermark may be lost
+    assert set(range(21, 40)) <= set(survivors)
+    wal.close()
+
+
+def test_wal_interior_damage_raises(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal"), segment_bytes=1 << 12)
+    for i in range(40):
+        wal.append(b"p" * 512)
+    wal.close()
+    first = wal.segments()[0]
+    data = bytearray(open(first, "rb").read())
+    data[20] ^= 0xFF
+    open(first, "wb").write(bytes(data))
+    with pytest.raises(WalCorruption):
+        WriteAheadLog(str(tmp_path / "wal"))
+
+
+# -- live-merge parity -------------------------------------------------------
+
+
+def _twin(tmp_path, batches):
+    """A batch-flushed twin store holding seed + every streamed batch."""
+    ds = _store(tmp_path, name="twin")
+    for cols, fids in batches:
+        ds.write("t", dict(cols), fids=fids)
+    if batches:
+        ds.flush("t")
+    return ds
+
+
+FILTERS = [
+    "INCLUDE",
+    "BBOX(geom, -90, -45, 90, 45)",
+    "BBOX(geom, -180, -90, 0, 90) AND val < 50",
+    "val >= 25 AND val < 75",
+]
+
+
+def test_live_merge_parity_query_count(tmp_path):
+    """Property-style parity: N appends of varying sizes through the
+    live layer answer every filter identically to the same rows
+    batch-flushed — while the memtable holds them, mid-compaction, and
+    after full compaction."""
+    with prop_override("stream.run.rows", 128), \
+            prop_override("stream.memtable.rows", 1 << 20):
+        ds = _store(tmp_path)
+        layer = StreamingStore(ds)
+        rng = np.random.default_rng(7)
+        batches = []
+        fid0 = 10_000
+        for i in range(6):
+            n = int(rng.integers(10, 200))
+            cols, fids = _rows(n, seed=100 + i, fid0=fid0)
+            fid0 += n
+            batches.append((cols, fids))
+            layer.append("t", cols, fids=fids)
+        twin = _twin(tmp_path, batches)
+        assert layer.stream_stats()["types"]["t"]["memtable_rows"] > 0
+
+        def check():
+            for f in FILTERS:
+                got = layer.query("t", f).batch
+                want = twin.query("t", f).batch
+                assert sorted(map(int, got.fids)) == \
+                    sorted(map(int, want.fids)), f
+                assert layer.count("t", f) == len(want), f
+
+        check()  # memtable live
+        layer.compact_now("t")
+        assert layer.stream_stats()["types"]["t"]["memtable_rows"] == 0
+        check()  # fully compacted
+        # appends after a compaction merge with the new generation
+        cols, fids = _rows(50, seed=999, fid0=90_000)
+        layer.append("t", cols, fids=fids)
+        batches.append((cols, fids))
+        twin2 = _twin(tmp_path / "b", batches)
+        for f in FILTERS:
+            assert layer.count("t", f) == len(twin2.query("t", f)), f
+        layer.close()
+
+
+def test_live_merge_density_and_stats_parity(tmp_path):
+    from geomesa_tpu.process import run_stats
+    from geomesa_tpu.process.density import density
+    from geomesa_tpu.geom import Envelope
+
+    with prop_override("stream.memtable.rows", 1 << 20), \
+            prop_override("store.chunk.pushdown", False):
+        ds = _store(tmp_path)
+        layer = StreamingStore(ds)
+        batches = []
+        for i in range(3):
+            cols, fids = _rows(120, seed=200 + i, fid0=10_000 + i * 1000)
+            batches.append((cols, fids))
+            layer.append("t", cols, fids=fids)
+        twin = _twin(tmp_path, batches)
+        env = Envelope(-180, -90, 180, 90)
+        g1 = density(layer, "t", "INCLUDE", env, 64, 32, use_device=False)
+        g2 = density(twin, "t", "INCLUDE", env, 64, 32, use_device=False)
+        assert np.array_equal(g1, g2)
+        s1 = run_stats(layer, "t", "INCLUDE", "Count();MinMax('val')")
+        s2 = run_stats(twin, "t", "INCLUDE", "Count();MinMax('val')")
+        assert s1.to_json() == s2.to_json()
+        layer.close()
+
+
+def test_live_merge_visibility_labels(tmp_path):
+    """Labeled streamed rows hide without auths and serve with them —
+    identical to the batch path."""
+    from geomesa_tpu.query.plan import Query
+
+    with prop_override("stream.memtable.rows", 1 << 20):
+        ds = _store(tmp_path, n0=0)
+        layer = StreamingStore(ds)
+        cols, fids = _rows(40, seed=5, fid0=100)
+        batch_cols = dict(cols)
+        batch_cols["__vis__"] = np.array(
+            ["secret"] * 20 + [""] * 20, dtype=object
+        )
+        layer.append("t", batch_cols, fids=fids)
+        public = layer.query("t", Query(filter="INCLUDE"))
+        assert len(public) == 20  # labeled rows hidden, fail closed
+        cleared = layer.query(
+            "t", Query(filter="INCLUDE", hints={"auths": ("secret",)})
+        )
+        assert len(cleared) == 40
+        # parity holds through compaction
+        layer.compact_now("t")
+        assert len(layer.query("t", Query(filter="INCLUDE"))) == 20
+        layer.close()
+
+
+def test_mid_compaction_consistency(tmp_path):
+    """A query racing repeated compactions must never double-count or
+    miss rows: sampled counts are exactly the monotone acked totals."""
+    with prop_override("stream.run.rows", 64), \
+            prop_override("stream.memtable.rows", 1 << 20):
+        ds = _store(tmp_path, n0=100)
+        layer = StreamingStore(ds)
+        seen, errors = [], []
+        stop = threading.Event()
+
+        def sampler():
+            try:
+                while not stop.is_set():
+                    seen.append(layer.count("t"))
+            except Exception as e:  # pragma: no cover - fails the test
+                errors.append(e)
+
+        th = threading.Thread(target=sampler)
+        th.start()
+        total = 100
+        valid = {total}
+        try:
+            for i in range(8):
+                cols, fids = _rows(64, seed=300 + i, fid0=50_000 + i * 100)
+                layer.append("t", cols, fids=fids)
+                total += 64
+                valid.add(total)
+                if i % 2:
+                    layer.compact_now("t")
+        finally:
+            stop.set()
+            th.join()
+        assert not errors
+        assert seen, "sampler never ran"
+        assert set(seen) <= valid, sorted(set(seen) - valid)
+        # monotone: a later sample never loses rows an earlier one had
+        assert seen == sorted(seen)
+        assert layer.count("t") == total
+        layer.close()
+
+
+def test_pushdown_gated_while_memtable_live(tmp_path):
+    with prop_override("stream.memtable.rows", 1 << 20):
+        ds = _store(tmp_path)
+        layer = StreamingStore(ds)
+        assert layer.has_chunk_stats("t")  # nothing streamed yet
+        cols, fids = _rows(10, seed=9, fid0=10_000)
+        layer.append("t", cols, fids=fids)
+        # pre-aggregates cannot see the memtable: decline, don't lie
+        assert not layer.has_chunk_stats("t")
+        from geomesa_tpu.geom import Envelope
+
+        assert layer.density_pushdown(
+            "t", "INCLUDE", Envelope(-180, -90, 180, 90), 8, 8
+        ) is None
+        layer.compact_now("t")
+        assert layer.has_chunk_stats("t")
+        layer.close()
+
+
+# -- backpressure ------------------------------------------------------------
+
+
+def test_backpressure_at_max_generations(tmp_path):
+    from geomesa_tpu import metrics
+
+    with prop_override("wal.max.generations", 2), \
+            prop_override("stream.run.rows", 8), \
+            prop_override("stream.memtable.rows", 1 << 20):
+        ds = _store(tmp_path, n0=0)
+        layer = StreamingStore(ds)
+        before = metrics.stream_backpressure.value()
+        cols, fids = _rows(8, seed=1, fid0=0)
+        layer.append("t", cols, fids=fids)
+        cols, fids = _rows(8, seed=2, fid0=100)
+        layer.append("t", cols, fids=fids)
+        with pytest.raises(IngestBackpressureError) as ei:
+            cols, fids = _rows(8, seed=3, fid0=200)
+            layer.append("t", cols, fids=fids)
+        assert ei.value.retry_after_s > 0
+        assert metrics.stream_backpressure.value() == before + 1
+        # nothing was acked for the shed append
+        assert layer.count("t") == 16
+        # compaction clears the bound and appends flow again
+        layer.compact_now("t")
+        cols, fids = _rows(8, seed=3, fid0=200)
+        layer.append("t", cols, fids=fids)
+        assert layer.count("t") == 24
+        layer.close()
+
+
+def test_failed_compaction_unseals_runs_and_rolls_back(tmp_path):
+    """A pre-publish flush failure must leave the memtable EXACTLY as
+    it was: runs un-sealed (tail coalescing keeps working — a sealed
+    leftover would pin every later append into its own run and race
+    the 429 bound), the merged batch out of pending, the watermark
+    restored, and every row still served."""
+    from geomesa_tpu.failpoints import FailpointError, failpoint_override
+
+    with prop_override("stream.memtable.rows", 1 << 20), \
+            prop_override("stream.run.rows", 1 << 20):
+        ds = _store(tmp_path)
+        layer = StreamingStore(ds)
+        cols, fids = _rows(40, seed=31, fid0=10_000)
+        layer.append("t", cols, fids=fids)
+        wm0 = ds._types["t"].wal_watermark
+        with failpoint_override("fail.flush.before_publish", "raise"):
+            with pytest.raises(FailpointError):
+                layer.compact_now("t")
+        assert layer.count("t") == 440  # rows still served
+        assert ds._types["t"].wal_watermark == wm0  # rolled back
+        assert not ds._types["t"].pending  # merged batch stripped
+        runs = layer._runs_snapshot("t")
+        assert runs and not any(r.sealed for r in runs)
+        # tail coalescing still works: the next append must NOT open a
+        # new run (run target is huge)
+        cols, fids = _rows(10, seed=32, fid0=20_000)
+        layer.append("t", cols, fids=fids)
+        assert len(layer._runs_snapshot("t")) == len(runs)
+        # and a clean retry compacts everything
+        layer.compact_now("t")
+        assert layer.count("t") == 450
+        assert layer.stream_stats()["types"]["t"]["memtable_rows"] == 0
+        layer.close()
+
+
+def test_stall_trigger_does_not_deadlock_append(tmp_path):
+    """The ingest-stall flight trigger fires with the memtable lock
+    RELEASED — its bundle providers re-take that lock (stream_stats),
+    and firing under it wedged the appender forever."""
+    from geomesa_tpu import slo
+
+    with prop_override("wal.max.generations", 1), \
+            prop_override("stream.run.rows", 4), \
+            prop_override("stream.stall.s", 0.001), \
+            prop_override("stream.memtable.rows", 1 << 20):
+        ds = _store(tmp_path, n0=0)
+        layer = StreamingStore(ds)
+        slo.FLIGHTREC.configure(
+            str(tmp_path / "_flightrec"),
+            providers={"stream": layer.stream_stats},
+        )
+        try:
+            cols, fids = _rows(4, seed=1, fid0=0)
+            layer.append("t", cols, fids=fids)
+            done = []
+
+            def shed_append():
+                cols, fids = _rows(4, seed=2, fid0=100)
+                with pytest.raises(IngestBackpressureError):
+                    layer.append("t", cols, fids=fids)
+                done.append(True)
+
+            th = threading.Thread(target=shed_append, daemon=True)
+            th.start()
+            th.join(timeout=20)
+            assert done, "backpressured append deadlocked on the " \
+                "flight-recorder providers"
+            bundles = os.listdir(str(tmp_path / "_flightrec"))
+            assert any("ingest-stall" in b for b in bundles), bundles
+        finally:
+            slo.FLIGHTREC.configure(None)
+            slo.FLIGHTREC.providers.pop("stream", None)
+            layer.close()
+
+
+# -- recovery ----------------------------------------------------------------
+
+
+def test_recovery_replays_acked_rows(tmp_path):
+    with prop_override("stream.memtable.rows", 1 << 20):
+        ds = _store(tmp_path)
+        layer = StreamingStore(ds)
+        for i in range(3):
+            cols, fids = _rows(50, seed=400 + i, fid0=10_000 + i * 100)
+            layer.append("t", cols, fids=fids)
+        layer.close()  # no compaction: the WAL alone carries the rows
+
+        ds2 = FileSystemDataStore(str(tmp_path / "store"), partition_size=128)
+        layer2 = StreamingStore(ds2)
+        assert layer2.count("t") == 400 + 150
+        st = layer2.stream_stats()["types"]["t"]
+        assert st["memtable_rows"] == 150
+        layer2.close()
+        # replay is idempotent: a third open serves the same set
+        ds3 = FileSystemDataStore(str(tmp_path / "store"), partition_size=128)
+        layer3 = StreamingStore(ds3)
+        assert layer3.count("t") == 550
+        batch = layer3.query("t").batch
+        assert len(batch) == len({int(f) for f in batch.fids})
+        layer3.close()
+
+
+def test_recovery_skips_compacted_segments_via_watermark(tmp_path):
+    """A compaction that published but crashed before WAL truncation
+    (simulated with a raising failpoint) must NOT re-apply its rows at
+    the next open — the manifest watermark skips them."""
+    from geomesa_tpu.failpoints import FailpointError, failpoint_override
+
+    with prop_override("stream.memtable.rows", 1 << 20):
+        ds = _store(tmp_path)
+        layer = StreamingStore(ds)
+        cols, fids = _rows(60, seed=11, fid0=10_000)
+        layer.append("t", cols, fids=fids)
+        with failpoint_override("fail.compact.publish", "raise"):
+            with pytest.raises(FailpointError):
+                layer.compact_now("t")
+        # published: the memtable dropped the runs, the WAL kept them
+        assert layer.count("t") == 460
+        assert layer.stream_stats()["types"]["t"]["memtable_rows"] == 0
+        assert layer._ts("t").wal.stats()["segments"] >= 1
+        layer.close()
+
+        ds2 = FileSystemDataStore(str(tmp_path / "store"), partition_size=128)
+        layer2 = StreamingStore(ds2)
+        assert layer2.count("t") == 460  # not 520: replay skipped them
+        assert layer2.stream_stats()["types"]["t"]["memtable_rows"] == 0
+        layer2.close()
+
+
+def test_recovery_truncates_torn_tail_and_stamps(tmp_path):
+    from geomesa_tpu import metrics
+
+    with prop_override("stream.memtable.rows", 1 << 20):
+        ds = _store(tmp_path, n0=0)
+        layer = StreamingStore(ds)
+        for i in range(2):
+            cols, fids = _rows(30, seed=500 + i, fid0=i * 100)
+            layer.append("t", cols, fids=fids)
+        layer.close()
+        wal_dir = str(tmp_path / "store" / "t" / "_wal")
+        seg = sorted(os.listdir(wal_dir))[-1]
+        with open(os.path.join(wal_dir, seg), "ab") as fh:
+            fh.write(b"GMWA-half-a-record")  # the crash's torn tail
+        before = metrics.stream_wal_truncations.value()
+        ds2 = FileSystemDataStore(str(tmp_path / "store"), partition_size=128)
+        layer2 = StreamingStore(ds2)
+        assert layer2.count("t") == 60  # acked rows intact
+        assert metrics.stream_wal_truncations.value() == before + 1
+        layer2.close()
+
+
+# -- incremental resident refresh -------------------------------------------
+
+
+def test_streaming_device_index_delta_refresh(tmp_path):
+    from geomesa_tpu import metrics
+    from geomesa_tpu.device_cache import StreamingDeviceIndex
+
+    ds = _store(tmp_path)
+    # capacity headroom: the delta path needs free padded slots (the
+    # server's streaming wiring sizes this from stream.memtable.rows)
+    di = StreamingDeviceIndex(ds, "t", z_planes=True, capacity=2048)
+    n0 = len(di)
+    restages0 = di.restages
+    cols, fids = _rows(64, seed=21, fid0=10_000)
+    from geomesa_tpu.features.batch import FeatureBatch
+
+    batch = FeatureBatch.from_columns(ds.get_schema("t"), cols, fids)
+    before = metrics.stream_delta_refreshes.value(mode="delta")
+    mode = di.refresh_delta(batch)
+    assert mode == "delta"
+    assert di.restages == restages0  # no restage on the ack path
+    assert len(di) == n0 + 64
+    assert di.count("INCLUDE") == n0 + 64
+    assert metrics.stream_delta_refreshes.value(mode="delta") == before + 1
+
+
+def test_base_device_index_delta_falls_back_to_restage(tmp_path):
+    from geomesa_tpu.device_cache import DeviceIndex
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.store.stream import StreamingStore as _SS
+
+    ds = _store(tmp_path)
+    layer = _SS(ds)
+    di = DeviceIndex(layer, "t")
+    cols, fids = _rows(16, seed=22, fid0=10_000)
+    layer.append("t", cols, fids=fids)  # the layer's merged view
+    batch = FeatureBatch.from_columns(ds.get_schema("t"), cols, fids)
+    assert di.refresh_delta(batch) == "restage"
+    assert di.count("INCLUDE") == 416  # restaged THROUGH the merged view
+    layer.close()
+
+
+@pytest.mark.skipif(
+    len(__import__("jax").devices()) < 2,
+    reason="sharded-mesh delta refresh needs > 1 device "
+    "(XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+)
+def test_sharded_index_delta_refresh_parity(tmp_path):
+    """Mesh path: streamed appends land in the reserved tail slots
+    behind the validity plane — no restage — and answers match the
+    single-chip oracle."""
+    from geomesa_tpu.device_cache import ShardedDeviceIndex
+    from geomesa_tpu.features.batch import FeatureBatch
+
+    ds = _store(tmp_path)
+    di = ShardedDeviceIndex(ds, "t", z_planes=True, reserve_rows=4096)
+    n0 = len(ds.query("t"))
+    cols, fids = _rows(100, seed=23, fid0=10_000)
+    batch = FeatureBatch.from_columns(ds.get_schema("t"), cols, fids)
+    mode = di.refresh_delta(batch)
+    assert mode == "delta"
+    assert di.count("INCLUDE") == n0 + 100
+    f = "BBOX(geom, -90, -45, 90, 45)"
+    ds2 = _store(tmp_path, name="twin")
+    ds2.write("t", cols, fids=fids)
+    ds2.flush("t")
+    assert di.count(f) == len(ds2.query("t", f))
+    got = di.query(f)
+    assert sorted(map(int, got.fids)) == sorted(
+        map(int, ds2.query("t", f).batch.fids)
+    )
+    # reserve exhaustion falls back to a full restage, still exact —
+    # the restage reads the backing store (in production the streaming
+    # layer's merged view, which still holds every acked row)
+    big_cols, big_fids = _rows(8192, seed=24, fid0=50_000)
+    big = FeatureBatch.from_columns(ds.get_schema("t"), big_cols, big_fids)
+    assert di.refresh_delta(big) == "restage"
+    assert di.count("INCLUDE") == n0
+
+
+# -- serving endpoints -------------------------------------------------------
+
+
+@pytest.fixture
+def stream_server(tmp_path):
+    from geomesa_tpu.server import serve_background
+
+    ds = _store(tmp_path)
+    with prop_override("stream.memtable.rows", 1 << 20):
+        server, _ = serve_background(
+            ds, resident=True, sched=True, stream=True
+        )
+        host, port = server.server_address[:2]
+        yield f"http://{host}:{port}", server
+        server.shutdown()
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _post(base, path, doc):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(doc).encode(), method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def test_server_append_visible_within_one_roundtrip(stream_server):
+    base, server = stream_server
+    assert _get(base, "/count/t")["count"] == 400  # stages resident
+    out = _post(base, "/append/t", {
+        "columns": {
+            "val": [1, 2, 3],
+            "dtg": [1000, 2000, 3000],
+            "geom": [[10.0, 10.0], [11.0, 11.0], [12.0, 12.0]],
+        },
+        "fids": [9001, 9002, 9003],
+    })
+    assert out == {"acked": 3, "seq": 0}
+    # the VERY NEXT read serves the rows — no flush/restage happened
+    assert _get(base, "/count/t")["count"] == 403
+    cql = urllib.parse.quote("BBOX(geom, 9, 9, 13, 13)")
+    feats = _get(base, f"/features/t?cql={cql}")
+    ids = {f["id"] for f in feats["features"]}
+    assert {"9001", "9002", "9003"} <= ids
+    # and the streaming state is inspectable
+    ss = _get(base, "/stats/stream")
+    assert ss["types"]["t"]["memtable_rows"] == 3
+    assert ss["types"]["t"]["appended_rows"] == 3
+    assert ss["counters"]["appends"] >= 1  # process-global counter
+    assert "stream" in _get(base, "/stats")
+
+
+def test_server_append_backpressure_is_429(stream_server):
+    base, server = stream_server
+    doc = {"columns": {
+        "val": [1] * 8,
+        "dtg": [1000] * 8,
+        "geom": [[1.0, 1.0]] * 8,
+    }}
+    with prop_override("wal.max.generations", 1), \
+            prop_override("stream.run.rows", 8):
+        doc["fids"] = list(range(9100, 9108))
+        _post(base, "/append/t", doc)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            doc["fids"] = list(range(9200, 9208))
+            _post(base, "/append/t", doc)
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+
+
+def test_server_append_errors(stream_server):
+    base, server = stream_server
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base, "/append/nosuch", {"columns": {"val": [1]}})
+    assert ei.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base, "/append/t", {"nope": 1})
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base, "/nosuch/t", {"columns": {"val": [1]}})
+    assert ei.value.code == 404
+
+
+def test_server_append_body_bound_413(stream_server):
+    base, server = stream_server
+    doc = {"columns": {
+        "val": [1] * 64, "dtg": [1] * 64, "geom": [[0.0, 0.0]] * 64,
+    }, "fids": list(range(9300, 9364))}
+    with prop_override("stream.append.max.bytes", 64):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/append/t", doc)
+        assert ei.value.code == 413
+    # nothing was acked for the refused body
+    assert _get(base, "/stats/stream")["types"] \
+        .get("t", {}).get("memtable_rows", 0) == 0
+    _post(base, "/append/t", doc)  # under the default bound: acked
+    assert _get(base, "/count/t")["count"] == 464
+
+
+def test_server_append_ledger_fields(stream_server):
+    base, server = stream_server
+    _post(base, "/append/t", {
+        "columns": {
+            "val": [7], "dtg": [123], "geom": [[5.0, 5.0]],
+        },
+        "fids": [9500],
+    })
+    led = _get(base, "/stats/ledger")
+    fields: dict = {}
+    for doc in (led.get("tenants") or {}).values():
+        for k, v in (doc.get("cost") or {}).items():
+            fields[k] = fields.get(k, 0) + v
+    assert fields.get("wal_bytes", 0) > 0
+    assert fields.get("memtable_rows", 0) >= 1

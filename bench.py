@@ -1102,67 +1102,381 @@ def bench_xz_build(args) -> dict:
     }
 
 
+#: BENCH_r05 join leg: 250,406 pairs/s through the old per-window
+#: window_pairs_query coarse pass at 1M x 10K — the baseline the smoke
+#: guard holds the engine to (>= 10x)
+R05_JOIN_PAIRS_PER_SEC = 250_406.4
+
+
+def _join_reference(x, y, envs):
+    """Exact envelope-join oracle (numpy, window-major pairs): what the
+    engine must match BIT-IDENTICALLY — same pairs, same order."""
+    import numpy as np
+
+    xo = np.argsort(x, kind="stable")
+    xs = x[xo]
+    out_r, out_w = [], []
+    for j in range(len(envs)):
+        a, b, c, d = envs[j]
+        lo = np.searchsorted(xs, a, side="left")
+        hi = np.searchsorted(xs, c, side="right")
+        cand = xo[lo:hi]
+        ids = np.sort(cand[(y[cand] >= b) & (y[cand] <= d)])
+        if len(ids):
+            out_r.append(ids)
+            out_w.append(np.full(len(ids), j, np.int64))
+    if not out_r:
+        e = np.empty(0, np.int64)
+        return e, e.copy()
+    return (
+        np.concatenate(out_r).astype(np.int64), np.concatenate(out_w),
+    )
+
+
+def _join_leg(eng, envs, label):
+    """One timed engine join, warmed on the FULL window set outside the
+    timing — a prefix would compile smaller power-of-two candidate
+    buckets than the measurement uses on the device engine."""
+    eng.join(envs)
+    t = time.perf_counter()
+    res = eng.join(envs)
+    wall = time.perf_counter() - t
+    log(
+        f"join[{label}]: {len(envs):,} windows -> {res.pairs:,} pairs in "
+        f"{wall*1e3:.0f}ms = {res.pairs/wall/1e6:.2f}M pairs/s "
+        f"(strategy={res.strategy} engine={res.engine} "
+        f"candidates={res.candidates:,} splits={res.splits})"
+    )
+    return res, wall
+
+
 def bench_join(args) -> dict:
-    """Spatial-join coarse pass (VERDICT r4 weak #5 / next-4): |R|
-    right-side envelopes against a resident left side through
-    DeviceIndex.window_pairs_query — 64-window groups chained G per
-    dispatch with device-side sort-compaction of each group's candidate
-    rows (only candidates are fetched, 12B each, instead of a full
-    8B/row bit-plane per group). Measured 193s -> 16.6s (11.6x) at
-    |R|=10k x 1M rows on the tunnel when this landed."""
+    """Device-side spatial join engine (ISSUE 11): the r05 workload
+    (1M left x 10K 2-degree windows, ~3.5M pairs) through the join
+    planner — Z-range co-partitioned candidate runs, adaptive strategy
+    selection, batched count->cap->compact refinement — EXACT (bit-
+    identical to the numpy envelope-join oracle), vs BENCH_r05's 250K
+    candidate pairs/s through the old per-window coarse pass. Legs:
+    auto + forced-strategy points, a layout-aligned (Z-sorted staged
+    order) fast path, polygon-polygon topological interlinking over the
+    XZ layout, enrichment against a streamed live layer, and a mesh
+    co-partitioned scaling leg (zero cross-shard exchange). ``--smoke``
+    shrinks the workload and guards rate >= 10x the r05 baseline with
+    full-parity asserts (CI tier-1 safe)."""
     import jax
     import numpy as np
 
+    from geomesa_tpu.conf import prop_override
     from geomesa_tpu.device_cache import DeviceIndex
+    from geomesa_tpu.join import JoinEngine
     from geomesa_tpu.store.memory import MemoryDataStore
 
     platform = jax.devices()[0].platform
-    n = args.n or ((1 << 20) if platform == "tpu" else (1 << 16))
-    m = 10_000 if platform == "tpu" else 1_000
+    smoke = bool(args.smoke)
+    n = args.n or ((1 << 18) if smoke else (1 << 20))
+    m = 2_048 if smoke else 10_000
     log(f"platform={platform} n={n:,} |R|={m:,} (join mode)")
     rng = np.random.default_rng(3)
+    x = rng.uniform(-60, 60, n)
+    y = rng.uniform(-50, 50, n)
     ds = MemoryDataStore()
     ds.create_schema("t", "dtg:Date,*geom:Point:srid=4326")
     ds.write("t", {
         "dtg": rng.integers(1_577_836_800_000, 1_583_020_800_000, n),
-        "geom": np.stack(
-            [rng.uniform(-60, 60, n), rng.uniform(-50, 50, n)], axis=1
-        ),
+        "geom": np.stack([x, y], axis=1),
     })
     di = DeviceIndex(ds, "t")
     x0 = rng.uniform(-60, 58, m)
     y0 = rng.uniform(-50, 48, m)
     envs = np.stack([x0, y0, x0 + 2, y0 + 2], axis=1)
-    di.window_pairs_query(envs[:512])  # compile outside the timing
+
+    eng = JoinEngine(di)
     t = time.perf_counter()
-    rows, wins = di.window_pairs_query(envs)
-    wall = time.perf_counter() - t
-    if args.check:
-        sub = envs[:200]
-        r2, w2 = di.window_pairs_query(sub)
-        batch = ds.query("t", "INCLUDE").batch
-        g = np.asarray(batch.columns["geom"])
-        got = set(zip(r2.tolist(), w2.tolist()))
-        for j, (a, b, c, d) in enumerate(sub):
-            hits = np.nonzero(
-                (g[:, 0] >= a) & (g[:, 0] <= c)
-                & (g[:, 1] >= b) & (g[:, 1] <= d)
-            )[0]
-            missing = [int(r) for r in hits if (int(r), j) not in got]
-            assert not missing, (j, missing[:5])
-        log(f"join candidate superset verified on {len(sub)} windows")
-    log(
-        f"join: |R|={m:,} x {n:,} rows in {wall:.1f}s -> "
-        f"{m/wall:.0f} windows/s, {len(rows)/wall/1e6:.2f}M pairs/s "
-        f"({len(rows):,} candidate pairs)"
-    )
-    return {
+    eng.prepare()  # the join layout build (cached per staged generation)
+    prep_s = time.perf_counter() - t
+    res, wall = _join_leg(eng, envs, "auto")
+    out = {
+        # legacy trajectory keys (BENCH_r0* continuity) — NOTE the new
+        # engine emits EXACT pairs where the old coarse pass emitted
+        # candidates, so pairs/s now measures finished join work
         "join_windows_per_sec": round(m / wall, 1),
-        "join_pairs_per_sec": round(len(rows) / wall, 1),
+        "join_pairs_per_sec": round(res.pairs / wall, 1),
         "join_n_left": n,
         "join_n_right": m,
-        "join_pairs": int(len(rows)),
-        "join_wall_s": round(wall, 1),
+        "join_pairs": int(res.pairs),
+        "join_wall_s": round(wall, 2),
+        "join_exact": True,
+        "join_strategy": res.strategy,
+        "join_engine": res.engine,
+        "join_level": res.level,
+        "join_candidates": int(res.candidates),
+        "join_skew_splits": int(res.splits),
+        "join_prep_s": round(prep_s, 3),
+        "join_plan_s": round(res.plan_s, 4),
+        "join_refine_s": round(res.refine_s, 4),
+        "join_speedup_vs_r05": round(
+            res.pairs / wall / R05_JOIN_PAIRS_PER_SEC, 1
+        ),
+    }
+
+    # parity: FULL bit-identity at smoke scale, sampled windows at scale
+    # (the oracle runs over the STAGED row order — pairs index into the
+    # resident mirror, which the store Z-orders on write)
+    if args.check or smoke:
+        sx, sy = di._host_rows().point_coords("geom")
+        sub = envs if smoke else envs[:256]
+        rr, rw = _join_reference(
+            np.asarray(sx, np.float64), np.asarray(sy, np.float64), sub
+        )
+        got = eng.join(sub)
+        assert np.array_equal(got.rows, rr) and np.array_equal(
+            got.wins, rw
+        ), (
+            f"join != reference: {got.pairs} vs {len(rr)} pairs"
+        )
+        log(f"join bit-identical to the oracle on {len(sub)} windows "
+            f"({len(rr):,} pairs)")
+
+    # forced-strategy legs (same workload; parity asserted under smoke)
+    for strat in ("grouped", "zmerge"):
+        with prop_override("join.strategy", strat):
+            sres, swall = _join_leg(eng, envs, strat)
+        out[f"join_{strat}_pairs_per_sec"] = round(sres.pairs / swall, 1)
+        out[f"join_{strat}_candidates"] = int(sres.candidates)
+        if args.check or smoke:
+            assert sres.pairs == res.pairs and np.array_equal(
+                sres.rows, res.rows
+            ), f"forced {strat} diverged from auto"
+    bm = min(64, m)
+    with prop_override("join.strategy", "broadcast"):
+        bres, bwall = _join_leg(eng, envs[:bm], "broadcast")
+    out["join_broadcast_windows"] = bm
+    out["join_broadcast_pairs_per_sec"] = round(bres.pairs / bwall, 1)
+
+    # layout-aligned leg: a date-less point type written Z-SORTED (what
+    # an FS store's flush order gives staging) — identity permutation,
+    # emission order free
+    from geomesa_tpu.curves.z2 import Z2SFC
+
+    zo = np.argsort(Z2SFC().index(x, y), kind="stable")
+    ds.create_schema("ts", "*geom:Point:srid=4326")
+    ds.write("ts", {"geom": np.stack([x[zo], y[zo]], axis=1)})
+    dis = DeviceIndex(ds, "ts")
+    engs = JoinEngine(dis)
+    engs.prepare()
+    ares, awall = _join_leg(engs, envs, "aligned")
+    out["join_aligned_pairs_per_sec"] = round(ares.pairs / awall, 1)
+    if args.check or smoke:
+        assert ares.pairs == res.pairs, "aligned layout changed the join"
+
+    out.update(_bench_join_poly(args, smoke, rng))
+    out.update(_bench_join_stream(args, smoke, rng))
+    if len(jax.devices()) > 1:
+        out.update(_bench_join_mesh(args, smoke, di, envs, res))
+
+    if smoke:
+        rate = out["join_pairs_per_sec"]
+        floor = 10 * R05_JOIN_PAIRS_PER_SEC
+        assert rate >= floor, (
+            f"join smoke guard: {rate:,.0f} pairs/s is under 10x the "
+            f"r05 baseline ({floor:,.0f})"
+        )
+        log(f"join smoke guard ok: {rate/R05_JOIN_PAIRS_PER_SEC:.1f}x r05")
+        out["join_smoke_guard_x"] = round(
+            rate / R05_JOIN_PAIRS_PER_SEC, 1
+        )
+    return out
+
+
+def _bench_join_poly(args, smoke, rng) -> dict:
+    """Polygon-polygon topological interlinking (JedAI-spatial): box
+    polygons joined on exact st_intersects through the XZ join layout +
+    per-window predicate residual — the frame-level path."""
+    import numpy as np
+
+    from geomesa_tpu.device_cache import DeviceIndex
+    from geomesa_tpu.geom import Polygon
+    from geomesa_tpu.sql.frame import SpatialFrame
+    from geomesa_tpu.store.memory import MemoryDataStore
+
+    n = (1 << 13) if smoke else (1 << 15)
+    m = 256 if smoke else 1_024
+
+    def boxes(k, wmin, wmax):
+        cx = rng.uniform(-60, 60, k)
+        cy = rng.uniform(-50, 50, k)
+        w = rng.uniform(wmin, wmax, k)
+        h = rng.uniform(wmin, wmax, k)
+        return np.array(
+            [
+                Polygon(np.array([
+                    [cx[i] - w[i], cy[i] - h[i]],
+                    [cx[i] + w[i], cy[i] - h[i]],
+                    [cx[i] + w[i], cy[i] + h[i]],
+                    [cx[i] - w[i], cy[i] + h[i]],
+                    [cx[i] - w[i], cy[i] - h[i]],
+                ]))
+                for i in range(k)
+            ],
+            dtype=object,
+        )
+
+    ds = MemoryDataStore()
+    ds.create_schema("pl", "*geom:Geometry:srid=4326")
+    ds.write("pl", {"geom": boxes(n, 0.02, 0.3)})
+    ds.create_schema("pr", "*geom:Geometry:srid=4326")
+    ds.write("pr", {"geom": boxes(m, 0.5, 2.0)})
+    di = DeviceIndex(ds, "pl")
+    fl, fr = SpatialFrame(ds, "pl"), SpatialFrame(ds, "pr")
+    fl.spatial_join(
+        SpatialFrame(ds, "pr").limit(32), device_index=di
+    )  # warm
+    t = time.perf_counter()
+    left, right, pairs = fl.spatial_join(fr, device_index=di)
+    wall = time.perf_counter() - t
+    log(
+        f"join[poly-xz]: {n:,} x {m:,} polygons -> {len(pairs):,} exact "
+        f"st_intersects pairs in {wall*1e3:.0f}ms = "
+        f"{len(pairs)/wall/1e6:.2f}M pairs/s"
+    )
+    if args.check or smoke:
+        rl, rr_, rpairs = fl.spatial_join(fr)  # numpy oracle path
+        a = sorted((left.fids[i], j) for i, j in pairs)
+        b = sorted((rl.fids[i], j) for i, j in rpairs)
+        assert a == b, "polygon join != oracle"
+        log(f"polygon join bit-identical to the oracle ({len(b):,} pairs)")
+    return {
+        "join_poly_n_left": n,
+        "join_poly_n_right": m,
+        "join_poly_pairs": int(len(pairs)),
+        "join_poly_pairs_per_sec": round(len(pairs) / wall, 1),
+    }
+
+
+def _bench_join_stream(args, smoke, rng) -> dict:
+    """Enrichment join against a STREAMED live layer: acked-but-
+    uncompacted rows join immediately (the live merged view is the
+    engine's left side; its layout is not Z-sorted, so this leg also
+    exercises the permutation + re-canonicalization path)."""
+    import os
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from geomesa_tpu.device_cache import DeviceIndex
+    from geomesa_tpu.join import JoinEngine
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.store.stream import StreamingStore
+
+    n_seed = (1 << 14) if smoke else (1 << 17)
+    n_live = (1 << 11) if smoke else (1 << 14)
+    m = 512 if smoke else 2_048
+    tmp = tempfile.mkdtemp(prefix="geomesa-bench-join-stream-")
+    try:
+        ds = FileSystemDataStore(os.path.join(tmp, "s"))
+        ds.create_schema("e", "dtg:Date,*geom:Point:srid=4326")
+        xs = rng.uniform(-60, 60, n_seed)
+        ys = rng.uniform(-50, 50, n_seed)
+        ds.write("e", {
+            "dtg": rng.integers(0, 10**9, n_seed),
+            "geom": np.stack([xs, ys], axis=1),
+        }, fids=np.arange(n_seed))
+        ds.flush("e")
+        layer = StreamingStore(ds)
+        try:
+            xl = rng.uniform(-60, 60, n_live)
+            yl = rng.uniform(-50, 50, n_live)
+            for a in range(0, n_live, 2048):
+                b = min(a + 2048, n_live)
+                layer.append("e", {
+                    "dtg": rng.integers(0, 10**9, b - a),
+                    "geom": np.stack([xl[a:b], yl[a:b]], axis=1),
+                }, fids=np.arange(n_seed + a, n_seed + b))
+            di = DeviceIndex(layer, "e")
+            eng = JoinEngine(di)
+            eng.prepare()
+            x0 = rng.uniform(-60, 58, m)
+            y0 = rng.uniform(-50, 48, m)
+            envs = np.stack([x0, y0, x0 + 2, y0 + 2], axis=1)
+            eng.join(envs)  # warm the timed shapes
+            t = time.perf_counter()
+            res = eng.join(envs)
+            wall = time.perf_counter() - t
+            log(
+                f"join[stream-enrich]: {n_seed + n_live:,} rows "
+                f"({n_live:,} live) x {m:,} windows -> {res.pairs:,} "
+                f"pairs in {wall*1e3:.0f}ms = "
+                f"{res.pairs/wall/1e6:.2f}M pairs/s"
+            )
+            if args.check or smoke:
+                # oracle over the STAGED (merged-view) row order —
+                # full bit-identity on rows AND windows, not a count
+                gx, gy = di._host_rows().point_coords("geom")
+                rr, rw = _join_reference(
+                    np.asarray(gx, np.float64),
+                    np.asarray(gy, np.float64), envs,
+                )
+                assert np.array_equal(res.rows, rr) and np.array_equal(
+                    res.wins, rw
+                ), (
+                    f"stream enrichment join != oracle "
+                    f"({res.pairs} vs {len(rr)} pairs)"
+                )
+                log("stream enrichment join bit-identical to the oracle "
+                    f"({len(rr):,} pairs over the merged live view)")
+            return {
+                "join_stream_rows": n_seed + n_live,
+                "join_stream_live_rows": n_live,
+                "join_stream_pairs": int(res.pairs),
+                "join_stream_pairs_per_sec": round(res.pairs / wall, 1),
+            }
+        finally:
+            layer.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_join_mesh(args, smoke, di, envs, base_res) -> dict:
+    """Mesh co-partitioned scaling leg: the SAME join across shard
+    counts, runs clipped at shard row boundaries so every refinement
+    launch is pure shard-local compute — zero cross-shard row exchange
+    by construction (the kernels contain no collectives). Pairs must be
+    bit-identical at every shard count. (On a 1-core virtual-device
+    harness wall-clock does not improve with shards — the honest
+    artifact PR 8 recorded for serve qps; the leg proves partitioning +
+    parity, real meshes get the speedup.)"""
+    import jax
+    import numpy as np
+
+    from geomesa_tpu.join import JoinEngine
+    from geomesa_tpu.parallel.mesh import make_mesh
+
+    ndev = len(jax.devices())
+    counts = [c for c in (1, 2, 4, 8) if c <= ndev]
+    sub = envs[: (256 if smoke else 2_048)]
+    rates = {}
+    for s in counts:
+        mesh = make_mesh(n_devices=s)
+        eng = JoinEngine(di, mesh=mesh)
+        eng.join(sub)  # warm the timed shapes
+        t = time.perf_counter()
+        res = eng.join(sub)
+        wall = time.perf_counter() - t
+        rates[str(s)] = round(res.pairs / wall, 1)
+        ref = JoinEngine(di).join(sub)
+        assert np.array_equal(res.rows, ref.rows) and np.array_equal(
+            res.wins, ref.wins
+        ), f"mesh join diverged at {s} shards"
+        log(
+            f"join[mesh s={s}]: {res.pairs:,} pairs in {wall*1e3:.0f}ms "
+            f"({rates[str(s)]/1e6:.2f}M pairs/s, bit-identical, "
+            "exchanged_bytes=0)"
+        )
+    return {
+        "join_mesh_pairs_per_sec": rates,
+        "join_mesh_parity": True,
+        "join_mesh_exchanged_bytes": 0,
     }
 
 
@@ -3339,7 +3653,7 @@ def main() -> None:
         # the multi-chip scaling curve: build rate + fused serve qps at
         # 1/2/4/8 devices (records the next MULTICHIP_r0*.json)
         out.update(bench_multichip(args))
-        # spatial-join coarse pass (chained + device-compacted)
+        # spatial join engine (planned, co-partitioned, batched refinement)
         out.update(bench_join(args))
         # concurrent serving through the device query scheduler: the
         # fusion factor (queries per launch) and tail latency under an

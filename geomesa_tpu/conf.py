@@ -173,6 +173,36 @@ Properties:
                                 WAL record and one memtable run, so an
                                 unbounded body would be an unbounded
                                 allocation (0 disables the bound)
+- ``join.engine``               spatial-join refinement engine
+                                (join/engine.py): ``auto`` (numpy host
+                                twin on all-CPU platforms — the
+                                mesh.sort.engine precedent — batched
+                                device launches otherwise), ``device``
+                                or ``host``
+- ``join.strategy``             pin the join planner's strategy:
+                                ``auto`` (adaptive selection from the
+                                staged histogram), ``broadcast``,
+                                ``grouped`` or ``zmerge``
+- ``join.broadcast.windows``    right-side size at or below which the
+                                planner broadcasts (whole-side scans
+                                per window; planning would cost more
+                                than it prunes)
+- ``join.split.rows``           skew-splitting escape: candidate runs
+                                longer than this split into bounded
+                                sub-runs (hot cells must not blow a
+                                launch's candidate budget or unbalance
+                                co-partitioned shards)
+- ``join.batch.candidates``     candidate budget per refinement batch
+                                (one count + one compact launch per
+                                batch; bounds device scratch and the
+                                host chunk working set)
+- ``join.hist.bits``            left-side statistics grid (2^bits per
+                                axis) the planner estimates
+                                selectivity/skew from; also the
+                                ``grouped`` strategy's cell level
+- ``join.xz.ranges``            XZ code ranges per window when the
+                                left side is a non-point (extent
+                                curve) layout
 """
 
 from __future__ import annotations
@@ -206,6 +236,25 @@ def _parse_sort_engine(v) -> str:
     if s not in ("auto", "device", "host"):
         raise ValueError(
             f"mesh.sort.engine must be auto, device or host, not {v!r}"
+        )
+    return s
+
+
+def _parse_join_engine(v) -> str:
+    s = str(v).strip().lower()
+    if s not in ("auto", "device", "host"):
+        raise ValueError(
+            f"join.engine must be auto, device or host, not {v!r}"
+        )
+    return s
+
+
+def _parse_join_strategy(v) -> str:
+    s = str(v).strip().lower()
+    if s not in ("auto", "broadcast", "grouped", "zmerge"):
+        raise ValueError(
+            "join.strategy must be auto, broadcast, grouped or zmerge, "
+            f"not {v!r}"
         )
     return s
 
@@ -321,6 +370,17 @@ _DEFS = {
     "stream.compact.yield.ms": (50.0, float),
     "stream.stall.s": (30.0, float),
     "stream.append.max.bytes": (32 << 20, int),
+    # device-side spatial join engine (join/): execution engine +
+    # planner strategy selectors, the skew-split bound, per-launch
+    # candidate budget, the statistics grid and the non-point (XZ)
+    # per-window range budget
+    "join.engine": ("auto", _parse_join_engine),
+    "join.strategy": ("auto", _parse_join_strategy),
+    "join.broadcast.windows": (64, int),
+    "join.split.rows": (1 << 16, int),
+    "join.batch.candidates": (1 << 20, int),
+    "join.hist.bits": (8, int),
+    "join.xz.ranges": (32, int),
 }
 
 _overrides: dict = {}

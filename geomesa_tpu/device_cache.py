@@ -286,6 +286,10 @@ class DeviceIndex:
 
         from geomesa_tpu.ops.scan import stage_columns_host
 
+        # staged-generation token: any staging (full restage, streaming
+        # delta, sharded delta) invalidates layouts derived from the
+        # resident rows (the join engine's cached JoinIndex keys off it)
+        self._gen = getattr(self, "_gen", 0) + 1
         host = stage_columns_host(batch, self._planes)
         pack = dict(host)
         enc_pre = None
@@ -1824,6 +1828,36 @@ class DeviceIndex:
         rows_out: list = []
         wins_out: list = []
 
+        from geomesa_tpu import metrics
+        from geomesa_tpu.tracing import span as _span
+
+        with _span("join.pairs", windows=m, groups=ngroups) as sp:
+            overflows = self._pairs_dispatch(
+                envs, m, n_staged, dt, G, C, fn, sub, has_vis,
+                compiled, base_f, auths, rows_out, wins_out,
+            )
+            sp.set(overflows=overflows)
+        if overflows:
+            # the compaction-cap overflow relaunch is the expensive rare
+            # path: counted, and stamped on the span so the ledger's
+            # trace-derived costs attribute the extra full-plane fetches
+            metrics.join_pair_overflows.inc(overflows)
+        if not rows_out:
+            e = np.array([], np.int64)
+            return e, e.copy()
+        return np.concatenate(rows_out), np.concatenate(wins_out)
+
+    def _pairs_dispatch(self, envs, m, n_staged, dt, G, C, fn, sub,
+                        has_vis, compiled, base_f, auths, rows_out,
+                        wins_out):
+        """window_pairs_query's dispatch loop (one lax.scan launch per
+        G-group chunk, device-compacted fetches, full bit-plane refetch
+        for groups past the cap). Returns the overflow-relaunch count."""
+        import jax.numpy as jnp
+
+        overflows = 0
+        wspan = 64 * G
+
         def decode(rids, los, his, g0):
             """(candidate rows, their bit words) -> aligned pair lists."""
             bits = (
@@ -1835,11 +1869,10 @@ class DeviceIndex:
             rows_out.append(rids[r[keep]].astype(np.int64))
             wins_out.append((w[keep] + g0).astype(np.int64))
 
-        span = 64 * G
-        for c0 in range(0, max(m, 1), span):
-            chunk = envs[c0 : c0 + span]
+        for c0 in range(0, max(m, 1), wspan):
+            chunk = envs[c0 : c0 + wspan]
             k = len(chunk)
-            env_pad = np.empty((span, 4), dt)
+            env_pad = np.empty((wspan, 4), dt)
             env_pad[:k, 0] = np.nextafter(
                 chunk[:, 0].astype(dt), dt.type(-np.inf)
             )
@@ -1874,16 +1907,14 @@ class DeviceIndex:
                 else:
                     # dense group: the compaction cap overflowed — refetch
                     # this group's full bit-planes (correct, just bigger)
+                    overflows += 1
                     lo_f, hi_f = self._pairs_full_group(
                         sub, env_pad[g * 64 : (g + 1) * 64], has_vis,
                         compiled, base_f, auths,
                     )
                     nz = np.nonzero(lo_f | hi_f)[0]
                     decode(nz.astype(np.uint32), lo_f[nz], hi_f[nz], g0)
-        if not rows_out:
-            e = np.array([], np.int64)
-            return e, e.copy()
-        return np.concatenate(rows_out), np.concatenate(wins_out)
+        return overflows
 
     def _pairs_full_group(self, sub, env64, has_vis, compiled, base_f,
                           auths):
@@ -2542,6 +2573,7 @@ class StreamingDeviceIndex(DeviceIndex):
         ]
         if not rows:
             return
+        self._gen = getattr(self, "_gen", 0) + 1  # live set changed
         self._valid_np[rows] = False
         self._n_dead += len(rows)
         pad = max(_next_pow2(len(rows)), 64)

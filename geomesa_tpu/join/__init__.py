@@ -1,0 +1,28 @@
+"""Device-side spatial join engine (ISSUE 11).
+
+Z-range co-partitioned planning (``planner``), adaptive strategy
+selection (broadcast-small-side / per-window grouped scans / sorted
+Z-interval merge with a skew-splitting escape), and fused batched
+refinement with fixed-shape count -> cap -> compact pair emission
+(``engine`` + ``ops/join.py``). ``DataFrame.spatial_join`` and
+``process.join`` route through here; ``bench.py --mode join`` measures
+it against the numpy host reference it must match bit-for-bit.
+"""
+
+from geomesa_tpu.join.engine import (
+    JoinEngine,
+    JoinIndex,
+    JoinResult,
+    build_join_index,
+)
+from geomesa_tpu.join.planner import JoinPlan, JoinStats, plan_join
+
+__all__ = [
+    "JoinEngine",
+    "JoinIndex",
+    "JoinResult",
+    "JoinPlan",
+    "JoinStats",
+    "build_join_index",
+    "plan_join",
+]

@@ -99,6 +99,8 @@ FIELDS = (
     "wal_fsyncs",        # WAL fsync calls this append waited on
     "memtable_rows",     # rows this append landed in the live memtable
     "compact_seconds",   # background compaction seconds (system requests)
+    "join_candidates",   # candidate pairs expanded by join refinement
+    "join_pairs",        # pairs this request's spatial joins emitted
 )
 
 #: fields folded with max() instead of sum() (a request's fusion width

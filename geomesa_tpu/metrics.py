@@ -601,3 +601,39 @@ lockcheck_blocking = REGISTRY.gauge(
     "geomesa_lockcheck_blocking_events",
     "blocking calls observed under a held (non-blocking_ok) lock",
 )
+
+# device-side spatial join engine (join/): planner strategy choices
+# (bounded label: the strategy enum), candidate/pair volumes, batched
+# refinement launches, the skew-splitting escape, and the legacy
+# window-pairs coarse pass's compaction-cap overflow relaunches
+join_queries = REGISTRY.counter(
+    "geomesa_join_queries_total",
+    "spatial joins executed, by planner strategy",
+)
+join_candidates = REGISTRY.counter(
+    "geomesa_join_candidates_total",
+    "candidate (row, window) pairs expanded by join refinement",
+)
+join_pairs = REGISTRY.counter(
+    "geomesa_join_pairs_total", "pairs emitted by the join engine"
+)
+join_launches = REGISTRY.counter(
+    "geomesa_join_launches_total",
+    "batched join refinement launches (count + compact each count one)",
+)
+join_skew_splits = REGISTRY.counter(
+    "geomesa_join_skew_splits_total",
+    "candidate runs split by the skew escape (hot-cell bound)",
+)
+join_pair_overflows = REGISTRY.counter(
+    "geomesa_join_pair_overflows_total",
+    "window-pairs groups whose compaction cap overflowed into a full "
+    "bit-plane refetch",
+)
+join_plan_seconds = REGISTRY.histogram(
+    "geomesa_join_plan_seconds", "join planning time (per join)"
+)
+join_refine_seconds = REGISTRY.histogram(
+    "geomesa_join_refine_seconds",
+    "join refinement time (expansion + launches + emission, per join)",
+)

@@ -16,6 +16,10 @@ aggregating server-side iterators).
 - ``dateoffset``: shift result timestamps (ref DateOffsetProcess)
 - ``conversion``: query results as Arrow IPC / BIN payloads (ref
                  ArrowConversionProcess / BinConversionProcess)
+- ``join``:     spatial joins / interlinking between types or against
+                 envelope windows, through the device-side join engine
+                 (geomesa_tpu/join; ref the JedAI-spatial interlinking
+                 workloads in PAPERS.md)
 
 Aggregations run as device reductions (scatter-add, segment reductions)
 over the same staged columns the scan kernels use -- the rebuild's version
@@ -32,8 +36,10 @@ from geomesa_tpu.process.proximity import proximity_search
 from geomesa_tpu.process.route import route_search
 from geomesa_tpu.process.dateoffset import date_offset, parse_duration_ms
 from geomesa_tpu.process.conversion import arrow_conversion, bin_conversion
+from geomesa_tpu.process.join import spatial_join
 
 __all__ = [
+    "spatial_join",
     "density",
     "encode_bin",
     "decode_bin",

@@ -1,0 +1,109 @@
+"""Spatial-join / interlinking process operator.
+
+Ref role: the interlinking workload class (JedAI-spatial, PAPERS.md):
+topological joins between two feature types, enrichment joins of a
+(possibly streamed) layer against reference windows, multi-dataset
+dedup. Routes through the device-side join engine (geomesa_tpu/join):
+Z-range co-partitioned planning, adaptive strategy selection, batched
+count -> cap -> compact refinement — with the exact geometry predicate
+refining the emitted envelope pairs when the right side carries real
+geometries.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+
+
+class _BatchView:
+    """Minimal SpatialFrame-shaped view over an already-collected
+    FeatureBatch (the right side of a cross-type join)."""
+
+    def __init__(self, batch):
+        self._batch = batch
+
+    def collect(self):
+        return self._batch
+
+
+def spatial_join(
+    store,
+    left_type: str,
+    right,
+    on: str = "intersects",
+    distance: "float | None" = None,
+    left_filter: "ast.Filter | str | None" = None,
+    right_filter: "ast.Filter | str | None" = None,
+    device_index=None,
+    sched=None,
+    mesh=None,
+):
+    """Join ``left_type``'s features against a right side.
+
+    ``right`` is one of:
+
+    - an ``(m, 4)`` float array of envelope windows — the ENVELOPE JOIN:
+      returns the engine's :class:`geomesa_tpu.join.JoinResult` directly
+      (exact inclusive point-in-window pairs for point schemas;
+      envelope-overlap pairs for non-point ones). The enrichment /
+      analytics fast path — no geometry residual, no batch compaction.
+    - a ``FeatureBatch`` or another type name — the PREDICATE JOIN:
+      returns ``(left_batch, right_batch, pairs)`` with the exact
+      ``on`` predicate (``intersects`` | ``contains`` | ``within`` |
+      ``dwithin`` + ``distance``) refining the engine's candidates,
+      exactly like ``SpatialFrame.spatial_join``.
+
+    ``device_index`` serves the left side from its resident mirror
+    (strongly recommended — the engine's join layout caches per staged
+    generation); without one the left side is collected per call.
+    ``mesh`` runs refinement co-partitioned across the device mesh;
+    ``sched`` rides the batches through the query scheduler.
+    """
+    from geomesa_tpu.filter.ecql import parse_ecql
+    from geomesa_tpu.sql.frame import SpatialFrame
+
+    lf = (
+        parse_ecql(left_filter)
+        if isinstance(left_filter, str)
+        else (left_filter or ast.Include)
+    )
+    if isinstance(right, np.ndarray):
+        from geomesa_tpu.join import JoinEngine
+
+        envs = np.asarray(right, np.float64).reshape(-1, 4)
+        if distance:
+            envs = envs + np.array(
+                [-distance, -distance, distance, distance]
+            )
+        if device_index is not None:
+            from geomesa_tpu.join.engine import filter_gate
+
+            eng = JoinEngine(device_index, sched=sched, mesh=mesh)
+            gate = None
+            if lf is not ast.Include:
+                gate = filter_gate(device_index, lf)
+            return eng.join(envs, gate=gate)
+        from geomesa_tpu.query.plan import Query
+
+        batch = store.query(left_type, Query(filter=lf)).batch
+        eng = JoinEngine(
+            batch=batch, sft=store.get_schema(left_type), sched=sched,
+            mesh=mesh,
+        )
+        return eng.join(envs)
+
+    frame = SpatialFrame(store, left_type)
+    if lf is not ast.Include:
+        frame = frame.where(lf)
+    if isinstance(right, str):
+        rframe = SpatialFrame(store, right)
+        if right_filter is not None:
+            rframe = rframe.where(right_filter)
+    else:
+        rframe = _BatchView(right)
+    return frame.spatial_join(
+        rframe, on=on, distance=distance, device_index=device_index,
+        sched=sched, mesh=mesh,
+    )

@@ -185,31 +185,37 @@ class SpatialFrame:
         on: str = "intersects",
         distance: "float | None" = None,
         device_index=None,
+        sched=None,
+        mesh=None,
     ):
         """Join this frame's features against ``other``'s on a spatial
         predicate (``intersects`` | ``contains`` | ``within`` |
         ``dwithin`` with ``distance``). Returns (left_batch, right_batch,
         pairs) where pairs is an (m, 2) index array into the two batches.
 
-        Default path: the right side's collected envelope is pushed down
-        into the left side's scan as a BBOX pre-filter (the reference's
-        relation pushdown), then each right row's exact predicate runs
-        vectorized over the left column — O(|R|) full-column passes.
+        Default path (also the parity ORACLE the engine is tested
+        against): the right side's collected envelope is pushed down into
+        the left side's scan as a BBOX pre-filter (the reference's
+        relation pushdown), then each right row's candidates come from a
+        sorted-coordinate interval prefilter and only they run the exact
+        vectorized predicate — numpy end to end, no per-row interpreter
+        work.
 
         With a resident ``device_index`` over this frame's type, the
-        coarse pass is instead a DEVICE join: every right row's padded
-        envelope rides a runtime window array and candidate (row, window)
-        pairs come back bit-packed (DeviceIndex.window_pairs_query, one
-        dispatch per 64 right rows, 8B/row fetched), with this frame's
-        filter fused on device; the exact predicate then refines each
-        window's few candidates — O(candidates) instead of O(|R| x |L|).
-        Falls back to the default path when the planes or the frame's
-        filter are not device-resident. On the device path ``left`` is
-        compacted to exactly the rows referenced by ``pairs`` (indices
-        remapped accordingly); on the default path it is the
-        bbox-pushed, filter-applied scan result, which may include rows
-        no pair references. Address left rows through ``pairs`` for
-        path-independent results.
+        join routes through the JOIN ENGINE (geomesa_tpu/join): Z-range
+        co-partitioned candidate planning with adaptive strategy
+        selection (broadcast / grouped / zmerge, ``join.*`` conf keys),
+        batched count->cap->compact refinement, this frame's filter and
+        the index's visibility verdict applied as a row gate, and the
+        exact predicate refining each window's few candidates —
+        O(candidates) instead of O(|R| x |L|). A ``sched`` rides the
+        refinement batches through the device query scheduler; a
+        ``mesh`` runs them co-partitioned across its shards. On the
+        engine path ``left`` is compacted to exactly the rows referenced
+        by ``pairs`` (indices remapped accordingly); on the default path
+        it is the bbox-pushed, filter-applied scan result, which may
+        include rows no pair references. Address left rows through
+        ``pairs`` for path-independent results.
         """
         from geomesa_tpu.sql import functions as F
 
@@ -227,8 +233,9 @@ class SpatialFrame:
             raise ValueError(f"unknown join predicate {on!r}")
 
         if device_index is not None and len(right):
-            got = self._device_join(
-                device_index, right, rcol, on, distance, preds
+            got = self._engine_join(
+                device_index, right, geom_r, rcol, on, distance, preds,
+                sched, mesh,
             )
             if got is not None:
                 return got
@@ -249,58 +256,45 @@ class SpatialFrame:
             )
         left = left_frame.collect()
         lcol = left.columns[left.sft.geom_field]
-        pairs = []
-        for j in range(len(right)):
-            g = _row_geom_of(rcol, j)
-            if on == "dwithin":
-                m = F.st_dwithin(lcol, g, distance)
-            else:
-                m = preds[on](lcol, g)
-            for i in np.nonzero(np.asarray(m))[0]:
-                pairs.append((int(i), j))
-        return left, right, np.array(pairs, dtype=np.int64).reshape(-1, 2)
+        pairs = _reference_pairs(lcol, rcol, on, distance, preds)
+        return left, right, pairs
 
-    def _device_join(self, di, right, rcol, on, distance, preds):
-        """Device coarse pass + per-window exact refinement, or None when
-        the resident planes / this frame's filter cannot serve it."""
-        from geomesa_tpu.sql import functions as F
+    def _engine_join(self, di, right, geom_r, rcol, on, distance, preds,
+                     sched, mesh=None):
+        """Join-engine coarse pass (planned, co-partitioned, batched)
+        + per-window exact refinement; None when the index cannot serve
+        it (no geometry schema) — the caller falls back to the oracle
+        path."""
+        from geomesa_tpu.join import JoinEngine
 
-        pad = distance or 0.0
-        envs = np.empty((len(right), 4), np.float64)
-        for j in range(len(right)):
-            e = _row_geom_of(rcol, j).envelope
-            envs[j] = (e.xmin - pad, e.ymin - pad, e.xmax + pad, e.ymax + pad)
-        base = self._filter if self._filter is not ast.Include else None
-        got = di.window_pairs_query(envs, base=base)
-        if got is None:
+        try:
+            eng = JoinEngine(di, sched=sched, mesh=mesh)
+            eng.prepare()
+        except (ValueError, AttributeError):
             return None
-        rows, wins = got
+        pad = distance or 0.0
+        envs = right.bboxes(geom_r).astype(np.float64)
+        if pad:
+            envs = envs + np.array([-pad, -pad, pad, pad])
+        base = self._filter if self._filter is not ast.Include else None
+        gate = None
+        if base is not None:
+            # the frame filter (any shape — the mask path falls back to
+            # host evaluation for non-device filters) plus validity and
+            # the fail-closed visibility verdict, as one row gate
+            from geomesa_tpu.join.engine import filter_gate
+
+            gate = filter_gate(di, base)
+        res = eng.join(envs, gate=gate)
+        rows, wins = res.rows, res.wins
         left = di._host_rows()
         lcol = left.columns[left.sft.geom_field]
-        out_l: list = []
-        out_r: list = []
-        order = np.argsort(wins, kind="stable")
-        rows, wins = rows[order], wins[order]
-        starts = np.searchsorted(wins, np.arange(len(right)))
-        ends = np.searchsorted(wins, np.arange(len(right)), side="right")
-        for j in range(len(right)):
-            cand = rows[starts[j] : ends[j]]
-            if len(cand) == 0:
-                continue
-            g = _row_geom_of(rcol, j)
-            sub = lcol[cand] if lcol.dtype == object else lcol[cand, :]
-            if on == "dwithin":
-                m = F.st_dwithin(sub, g, distance)
-            else:
-                m = preds[on](sub, g)
-            hit = cand[np.nonzero(np.asarray(m))[0]]
-            out_l.append(hit)
-            out_r.append(np.full(len(hit), j, np.int64))
+        rows, wins = _exact_residual(
+            lcol, rcol, rows, wins, len(right), on, distance, preds
+        )
         pairs = (
-            np.stack(
-                [np.concatenate(out_l), np.concatenate(out_r)], axis=1
-            )
-            if out_l
+            np.stack([rows, wins], axis=1)
+            if len(rows)
             else np.empty((0, 2), np.int64)
         )
         # Compact the returned left batch to the rows the pairs actually
@@ -314,6 +308,110 @@ class SpatialFrame:
         else:
             left = left.take(np.empty(0, np.int64))
         return left, right, pairs
+
+
+def _reference_pairs(lcol, rcol, on, distance, preds) -> np.ndarray:
+    """The numpy host-reference join (the engine's parity oracle):
+    per right row, a sorted-coordinate / envelope interval prefilter
+    narrows the left side to candidates, then the SAME vectorized exact
+    predicate the full-column scan would run decides — identical pairs
+    (elementwise predicates), without the old O(n x m) interpreter-time
+    pass over every row per window. Pairs sorted (right, left)."""
+    from geomesa_tpu.sql import functions as F
+
+    n, m = len(lcol), len(rcol)
+    if n == 0 or m == 0:
+        return np.empty((0, 2), np.int64)
+    pad = distance or 0.0
+    out_l: list = []
+    out_r: list = []
+    if lcol.dtype != object:
+        # point left side: one stable argsort of x, then each window is
+        # a searchsorted interval (a superset: the exact predicate
+        # implies the point lies inside the padded envelope's x-range)
+        xv = np.asarray(lcol[:, 0], np.float64)
+        xo = np.argsort(xv, kind="stable")
+        xs = xv[xo]
+        for j in range(m):
+            g = _row_geom_of(rcol, j)
+            e = g.envelope
+            lo = np.searchsorted(xs, e.xmin - pad, side="left")
+            hi = np.searchsorted(xs, e.xmax + pad, side="right")
+            if hi <= lo:
+                continue
+            cand = xo[lo:hi]
+            sub = lcol[cand]
+            if on == "dwithin":
+                hit = F.st_dwithin(sub, g, distance)
+            else:
+                hit = preds[on](sub, g)
+            ids = cand[np.asarray(hit)]
+            if len(ids):
+                out_l.append(np.sort(ids))
+                out_r.append(np.full(len(ids), j, np.int64))
+    else:
+        # non-point left side: per-row envelopes once (O(n) total, not
+        # O(n x m)), then each window prefilters by envelope overlap
+        envs_l = np.empty((n, 4), np.float64)
+        for i in range(n):
+            e = lcol[i].envelope
+            envs_l[i] = (e.xmin, e.ymin, e.xmax, e.ymax)
+        for j in range(m):
+            g = _row_geom_of(rcol, j)
+            e = g.envelope
+            cand = np.nonzero(
+                (envs_l[:, 2] >= e.xmin - pad)
+                & (envs_l[:, 0] <= e.xmax + pad)
+                & (envs_l[:, 3] >= e.ymin - pad)
+                & (envs_l[:, 1] <= e.ymax + pad)
+            )[0]
+            if not len(cand):
+                continue
+            sub = lcol[cand]
+            if on == "dwithin":
+                hit = F.st_dwithin(sub, g, distance)
+            else:
+                hit = preds[on](sub, g)
+            ids = cand[np.asarray(hit)]  # cand ascending -> ids ascending
+            if len(ids):
+                out_l.append(ids)
+                out_r.append(np.full(len(ids), j, np.int64))
+    if not out_l:
+        return np.empty((0, 2), np.int64)
+    return np.stack(
+        [
+            np.concatenate(out_l).astype(np.int64),
+            np.concatenate(out_r),
+        ],
+        axis=1,
+    )
+
+
+def _exact_residual(lcol, rcol, rows, wins, m, on, distance, preds):
+    """Exact-predicate refinement of engine-emitted envelope pairs,
+    grouped per window (pairs arrive window-sorted): the same vectorized
+    predicate calls the reference path makes, over each window's few
+    candidates instead of the whole column."""
+    from geomesa_tpu.sql import functions as F
+
+    if len(rows) == 0:
+        return rows, wins
+    starts = np.searchsorted(wins, np.arange(m))
+    ends = np.searchsorted(wins, np.arange(m), side="right")
+    keep = np.zeros(len(rows), bool)
+    for j in range(m):
+        s, e = starts[j], ends[j]
+        if s == e:
+            continue
+        cand = rows[s:e]
+        g = _row_geom_of(rcol, j)
+        sub = lcol[cand] if lcol.dtype == object else lcol[cand, :]
+        if on == "dwithin":
+            hit = F.st_dwithin(sub, g, distance)
+        else:
+            hit = preds[on](sub, g)
+        keep[s:e] = np.asarray(hit)
+    return rows[keep], wins[keep]
 
 
 def _geom_field_of(frame: SpatialFrame) -> str:

@@ -1,0 +1,519 @@
+"""Device-side spatial join engine (ISSUE 11): the planner/engine must
+be BIT-IDENTICAL to the numpy host reference across strategies, engines,
+shard counts and adversarial layouts; plus the frame/process routing,
+the skew-split escape, the overflow-counting satellite and the join.*
+registries.
+
+Runs on the 8-virtual-device CPU harness conftest provides.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import metrics
+from geomesa_tpu.conf import prop_override
+from geomesa_tpu.device_cache import DeviceIndex
+from geomesa_tpu.join import JoinEngine, plan_join
+from geomesa_tpu.join.engine import _join_conf
+from geomesa_tpu.parallel.mesh import make_mesh
+from geomesa_tpu.sql.frame import SpatialFrame
+from geomesa_tpu.store import MemoryDataStore
+
+T0 = 1_577_836_800_000
+
+
+def _layout(kind, n, rng):
+    """Adversarial coordinate layouts (the mesh-serving suite's set)."""
+    if kind == "uniform":
+        x = rng.uniform(-60, 60, n)
+        y = rng.uniform(-50, 50, n)
+    elif kind == "presorted":
+        x = np.sort(rng.uniform(-60, 60, n))
+        y = rng.uniform(-50, 50, n)
+    elif kind == "hotcell":  # every point in one Z-cell
+        x = 2.3522 + rng.uniform(-0.005, 0.005, n)
+        y = 48.8566 + rng.uniform(-0.005, 0.005, n)
+    else:  # clustered: GDELT-style hot cities
+        centers = np.array(
+            [[2.35, 48.85], [-74.0, 40.7], [139.7, 35.7], [28.0, -26.2]]
+        )
+        which = rng.integers(0, 4, n)
+        x = centers[which, 0] + rng.uniform(-0.01, 0.01, n)
+        y = centers[which, 1] + rng.uniform(-0.01, 0.01, n)
+    return x, y
+
+
+def _store(x, y, dtg=True, fids=None):
+    n = len(x)
+    rng = np.random.default_rng(n)
+    ds = MemoryDataStore()
+    spec = "v:Integer,*geom:Point:srid=4326"
+    cols = {
+        "v": rng.integers(0, 100, n).astype(np.int32),
+        "geom": np.stack([x, y], axis=1),
+    }
+    if dtg:
+        spec = "v:Integer,dtg:Date,*geom:Point:srid=4326"
+        cols["dtg"] = rng.integers(T0, T0 + 10**9, n)
+    ds.create_schema("t", spec)
+    ds.write("t", cols, fids=fids)
+    return ds
+
+
+def _windows(rng, m, w=2.0):
+    x0 = rng.uniform(-60, 58, m)
+    y0 = rng.uniform(-50, 48, m)
+    return np.stack([x0, y0, x0 + w, y0 + w], axis=1)
+
+
+def _reference(ds, envs, gate=None):
+    """Exact inclusive envelope-join oracle over the STAGED row order,
+    pairs sorted (window, row)."""
+    g = np.asarray(
+        ds.query("t", "INCLUDE").batch.columns["geom"], np.float64
+    )
+    out = []
+    for j in range(len(envs)):
+        a, b, c, d = envs[j]
+        hit = (
+            (g[:, 0] >= a) & (g[:, 0] <= c)
+            & (g[:, 1] >= b) & (g[:, 1] <= d)
+        )
+        if gate is not None:
+            hit &= gate
+        for i in np.nonzero(hit)[0]:
+            out.append((int(i), j))
+    return out
+
+
+def _got(res):
+    return list(zip(res.rows.tolist(), res.wins.tolist()))
+
+
+# -- property suite: strategies x engines x layouts ------------------------
+
+
+@pytest.mark.parametrize(
+    "layout", ["uniform", "presorted", "hotcell", "clustered"]
+)
+@pytest.mark.parametrize("strategy", ["auto", "broadcast", "grouped",
+                                      "zmerge"])
+def test_engine_matches_reference(layout, strategy, rng):
+    n, m = 4096, 60
+    x, y = _layout(layout, n, rng)
+    ds = _store(x, y)
+    di = DeviceIndex(ds, "t")
+    envs = _windows(rng, m)
+    ref = _reference(ds, envs)
+    with prop_override("join.strategy", strategy):
+        host = JoinEngine(di).join(envs)
+    assert _got(host) == ref, (layout, strategy, "host")
+    with prop_override("join.strategy", strategy), \
+            prop_override("join.engine", "device"):
+        dev = JoinEngine(di).join(envs)
+    assert _got(dev) == ref, (layout, strategy, "device")
+
+
+@pytest.mark.parametrize("shards", [1, 2, 3, 8])
+@pytest.mark.parametrize("layout", ["uniform", "hotcell", "clustered"])
+def test_mesh_copartitioned_parity(shards, layout, rng):
+    """Co-partitioned mesh refinement is bit-identical at every shard
+    count — including non-power-of-two — and every pair a shard emits
+    references only that shard's own row range (the zero-exchange
+    property made observable)."""
+    n, m = 4099, 40  # prime n: shard padding always live
+    x, y = _layout(layout, n, rng)
+    ds = _store(x, y)
+    di = DeviceIndex(ds, "t")
+    envs = _windows(rng, m)
+    ref = _reference(ds, envs)
+    mesh = make_mesh(n_devices=shards)
+    res = JoinEngine(di, mesh=mesh).join(envs)
+    assert _got(res) == ref
+    assert res.shards == shards
+    assert res.engine == "device"
+
+
+def test_empty_and_tiny_sides(rng):
+    x, y = _layout("uniform", 300, rng)
+    ds = _store(x, y)
+    di = DeviceIndex(ds, "t")
+    # empty right
+    res = JoinEngine(di).join(np.zeros((0, 4)))
+    assert res.pairs == 0
+    # inverted (empty) windows
+    res = JoinEngine(di).join(np.array([[10.0, 10.0, -10.0, -10.0]]))
+    assert res.pairs == 0
+    # tiny right side (broadcast territory)
+    envs = _windows(rng, 3)
+    assert _got(JoinEngine(di).join(envs)) == _reference(ds, envs)
+    # empty left
+    ds0 = _store(np.zeros(0), np.zeros(0))
+    di0 = DeviceIndex(ds0, "t")
+    assert JoinEngine(di0).join(envs).pairs == 0
+
+
+def test_duplicate_fids_and_points(rng):
+    """Duplicate coordinates AND duplicate fids stay distinct rows."""
+    x, y = _layout("uniform", 400, rng)
+    x[100:200] = x[0]
+    y[100:200] = y[0]
+    fids = np.concatenate([np.zeros(200, np.int64),
+                           np.arange(200, 400)])
+    ds = _store(x, y, fids=fids)
+    di = DeviceIndex(ds, "t")
+    envs = _windows(rng, 25)
+    assert _got(JoinEngine(di).join(envs)) == _reference(ds, envs)
+
+
+def test_skew_split_correctness(rng):
+    """A hot cell under a tiny join.split.rows must split runs (counted
+    on the metric) without changing a single pair."""
+    x, y = _layout("hotcell", 5000, rng)
+    ds = _store(x, y)
+    di = DeviceIndex(ds, "t")
+    envs = np.array([[2.0, 48.0, 3.0, 49.5], [2.34, 48.84, 2.36, 48.86]])
+    ref = _reference(ds, envs)
+    before = metrics.join_skew_splits.value()
+    with prop_override("join.split.rows", 1024), \
+            prop_override("join.strategy", "grouped"):
+        res = JoinEngine(di).join(envs)
+    assert _got(res) == ref
+    assert res.splits > 0
+    assert metrics.join_skew_splits.value() > before
+    # and the split plan stays device-parity
+    with prop_override("join.split.rows", 1024), \
+            prop_override("join.strategy", "grouped"), \
+            prop_override("join.engine", "device"):
+        dev = JoinEngine(di).join(envs)
+    assert _got(dev) == ref
+
+
+def test_gate_and_streaming_validity(rng):
+    """Row gates (base filter) and the index's implicit validity both
+    cut pairs exactly."""
+    x, y = _layout("uniform", 2000, rng)
+    ds = _store(x, y)
+    di = DeviceIndex(ds, "t")
+    envs = _windows(rng, 30)
+    batch = ds.query("t", "INCLUDE").batch
+    gate = np.asarray(batch.columns["v"]) < 50
+    ref = _reference(ds, envs, gate=gate)
+    res = JoinEngine(di).join(envs, gate=gate)
+    assert _got(res) == ref
+    with prop_override("join.engine", "device"):
+        dev = JoinEngine(di).join(envs, gate=gate)
+    assert _got(dev) == ref
+
+
+def test_adaptive_selection_shifts_strategy(rng):
+    """Tiny right sides broadcast; many small windows merge Z-intervals;
+    the planner records honest estimates."""
+    x, y = _layout("uniform", 8192, rng)
+    ds = _store(x, y)
+    di = DeviceIndex(ds, "t")
+    small = JoinEngine(di).join(_windows(rng, 4))
+    assert small.strategy == "broadcast"
+    many = JoinEngine(di).join(_windows(rng, 300, w=0.5))
+    assert many.strategy in ("grouped", "zmerge")
+    assert many.stats.est_pairs >= 0
+    assert many.candidates >= many.pairs
+
+
+def test_join_index_caches_per_generation(rng):
+    x, y = _layout("uniform", 1000, rng)
+    ds = _store(x, y)
+    di = DeviceIndex(ds, "t")
+    eng = JoinEngine(di)
+    j1 = eng.prepare()
+    assert eng.prepare() is j1  # cached
+    di.refresh()
+    j2 = eng.prepare()
+    assert j2 is not j1  # staging invalidated the layout
+
+
+def test_frame_routes_through_engine(rng):
+    """frame.spatial_join with a device_index must equal the (oracle)
+    default path — including a frame filter and polygon right sides
+    whose pip semantics differ from envelope tests."""
+    from geomesa_tpu.geom import Polygon
+
+    x, y = _layout("uniform", 3000, rng)
+    ds = _store(x, y)
+    polys = []
+    for j in range(40):
+        cx, cy = rng.uniform(-55, 55), rng.uniform(-45, 45)
+        w, h = rng.uniform(0.5, 3), rng.uniform(0.5, 3)
+        if j % 2:
+            ring = np.array([[cx, cy - h], [cx + w, cy], [cx, cy + h],
+                             [cx - w, cy], [cx, cy - h]])  # diamond
+        else:
+            ring = np.array([[cx - w, cy - h], [cx + w, cy - h],
+                             [cx + w, cy + h], [cx - w, cy + h],
+                             [cx - w, cy - h]])
+        polys.append(Polygon(ring))
+    ds.create_schema("r", "*geom:Geometry:srid=4326")
+    ds.write("r", {"geom": np.array(polys, dtype=object)})
+    di = DeviceIndex(ds, "t")
+    fl = SpatialFrame(ds, "t").where("v < 70")
+    fr = SpatialFrame(ds, "r")
+
+    def canon(left, pairs):
+        return sorted((left.fids[i], j) for i, j in pairs)
+
+    for on, dist in (("intersects", None), ("dwithin", 1.0)):
+        rl, _, rp = fl.spatial_join(fr, on=on, distance=dist)
+        el, _, ep = fl.spatial_join(
+            fr, on=on, distance=dist, device_index=di
+        )
+        assert canon(rl, rp) == canon(el, ep), on
+    # engine path compacts left to exactly the referenced rows
+    el, _, ep = fl.spatial_join(fr, device_index=di)
+    if len(ep):
+        assert len(el) == len(np.unique(ep[:, 0]))
+
+
+def test_nonpoint_left_xz_layout(rng):
+    """Polygon LEFT side: the XZ2 extent-curve layout plans per-window
+    code ranges; pairs equal the oracle path."""
+    from geomesa_tpu.geom import Polygon
+
+    ds = MemoryDataStore()
+    k = 800
+    cx = rng.uniform(-60, 60, k)
+    cy = rng.uniform(-50, 50, k)
+    w = rng.uniform(0.05, 0.4, k)
+    boxes = [
+        Polygon(np.array([
+            [cx[i] - w[i], cy[i] - w[i]], [cx[i] + w[i], cy[i] - w[i]],
+            [cx[i] + w[i], cy[i] + w[i]], [cx[i] - w[i], cy[i] + w[i]],
+            [cx[i] - w[i], cy[i] - w[i]],
+        ]))
+        for i in range(k)
+    ]
+    ds.create_schema("pl", "*geom:Geometry:srid=4326")
+    ds.write("pl", {"geom": np.array(boxes, dtype=object)})
+    di = DeviceIndex(ds, "pl")
+    jidx = JoinEngine(di).prepare()
+    assert jidx.kind == "xz2"
+    fl = SpatialFrame(ds, "pl")
+    fr_store = MemoryDataStore()
+    rp = [
+        Polygon(np.array([
+            [a, b], [a + 3, b], [a + 3, b + 3], [a, b + 3], [a, b],
+        ]))
+        for a, b in zip(rng.uniform(-55, 50, 25), rng.uniform(-45, 40, 25))
+    ]
+    fr_store.create_schema("r", "*geom:Geometry:srid=4326")
+    fr_store.write("r", {"geom": np.array(rp, dtype=object)})
+    fr = SpatialFrame(fr_store, "r")
+    rl, _, rpairs = fl.spatial_join(fr)
+    el, _, epairs = fl.spatial_join(fr, device_index=di)
+    canon = lambda l, p: sorted((l.fids[i], j) for i, j in p)  # noqa: E731
+    assert canon(rl, rpairs) == canon(el, epairs)
+
+
+def test_process_operator(rng):
+    from geomesa_tpu import process
+
+    x, y = _layout("uniform", 1500, rng)
+    ds = _store(x, y)
+    di = DeviceIndex(ds, "t")
+    envs = _windows(rng, 20)
+    # envelope join returns the engine result directly
+    res = process.spatial_join(ds, "t", envs, device_index=di)
+    assert _got(res) == _reference(ds, envs)
+    # with a left filter
+    batch = ds.query("t", "INCLUDE").batch
+    gate = np.asarray(batch.columns["v"]) < 30
+    resf = process.spatial_join(
+        ds, "t", envs, left_filter="v < 30", device_index=di
+    )
+    assert _got(resf) == _reference(ds, envs, gate=gate)
+    # store-collected left side (no resident index)
+    res2 = process.spatial_join(ds, "t", envs)
+    assert _got(res2) == _reference(ds, envs)
+    report = res.report()
+    assert report["pairs"] == res.pairs
+    assert report["strategy"] in ("broadcast", "grouped", "zmerge")
+
+
+def test_scheduler_rides_refinement(rng):
+    from geomesa_tpu.sched.scheduler import QueryScheduler, SchedConfig
+
+    x, y = _layout("uniform", 2000, rng)
+    ds = _store(x, y)
+    di = DeviceIndex(ds, "t")
+    envs = _windows(rng, 40)
+    ref = _reference(ds, envs)
+    sched = QueryScheduler(SchedConfig(max_inflight=2))
+    try:
+        before = sched.queries
+        res = JoinEngine(di, sched=sched).join(envs)
+        assert _got(res) == ref
+        assert sched.queries > before  # batches went through admission
+    finally:
+        sched.close()
+
+
+def test_streaming_live_rows_join(rng):
+    """Enrichment against a live (appended) streaming index: freshly
+    acked rows join immediately; evicted rows drop out."""
+    from geomesa_tpu.device_cache import StreamingDeviceIndex
+
+    x, y = _layout("uniform", 1200, rng)
+    ds = _store(x, y, fids=np.arange(1200))
+    di = StreamingDeviceIndex(ds, "t")
+    envs = _windows(rng, 25)
+    base = JoinEngine(di).join(envs)
+    assert _got(base) == _reference(ds, envs)
+    # append live rows: the next join sees them (generation bump)
+    from geomesa_tpu.features.batch import FeatureBatch
+
+    sft = ds.get_schema("t")
+    extra = FeatureBatch.from_columns(
+        sft,
+        {
+            "v": np.arange(50, dtype=np.int32),
+            "dtg": np.full(50, T0, np.int64),
+            "geom": np.stack(
+                [rng.uniform(-60, 60, 50), rng.uniform(-50, 50, 50)],
+                axis=1,
+            ),
+        },
+        fids=np.arange(5000, 5050),
+    )
+    di.append(extra)
+    res = JoinEngine(di).join(envs)
+    g = np.asarray(di._host_rows().columns["geom"], np.float64)
+    hv = di._host_valid()
+    expect = 0
+    for j in range(len(envs)):
+        a, b, c, d = envs[j]
+        hit = ((g[:, 0] >= a) & (g[:, 0] <= c)
+               & (g[:, 1] >= b) & (g[:, 1] <= d))
+        if hv is not None:
+            hit &= hv
+        expect += int(hit.sum())
+    assert res.pairs == expect
+    # evict the appended rows: pairs revert to the base join
+    di.evict(np.arange(5000, 5050))
+    res2 = JoinEngine(di).join(envs)
+    assert _got(res2) == _got(base)
+
+
+def test_pair_overflow_metric_and_span(rng):
+    """Satellite: the window_pairs_query compaction-cap overflow is
+    counted and stamped on the join.pairs span."""
+    from geomesa_tpu.tracing import Tracer
+
+    n = 9000  # past the 4096 compaction cap: the full-group refetch
+    x, y = _layout("uniform", n, rng)
+    ds = _store(x, y)
+    di = DeviceIndex(ds, "t")
+    before = metrics.join_pair_overflows.value()
+    tr = Tracer()
+    with prop_override("trace.sample", 1.0):
+        with tr.trace("join-overflow-test") as t:
+            # whole-world windows: every row hits -> cap overflow
+            rows, wins = di.window_pairs_query(
+                np.array([[-180.0, -90.0, 180.0, 90.0]] * 2)
+            )
+    assert len(rows) == 2 * n
+    assert metrics.join_pair_overflows.value() > before
+    root = tr.get(t.trace_id).to_dict()["spans"]
+
+    def find(node, name):
+        if node["name"] == name:
+            return node
+        for c in node.get("children", ()):
+            got = find(c, name)
+            if got is not None:
+                return got
+        return None
+
+    sp = find(root, "join.pairs")
+    assert sp is not None and sp["attrs"]["overflows"] >= 1
+
+
+def test_conf_and_registries():
+    """join.* keys declared (GT008), metrics registered (GT006), ledger
+    fields present (GT009)."""
+    from geomesa_tpu import ledger
+    from geomesa_tpu.conf import declared_keys
+
+    for k in ("join.engine", "join.strategy", "join.broadcast.windows",
+              "join.split.rows", "join.batch.candidates",
+              "join.hist.bits", "join.xz.ranges"):
+        assert k in declared_keys(), k
+    conf = _join_conf()
+    assert conf["strategy"] == "auto"
+    for f in ("join_candidates", "join_pairs"):
+        assert f in ledger.FIELDS
+    for m in (metrics.join_queries, metrics.join_pairs,
+              metrics.join_candidates, metrics.join_launches,
+              metrics.join_skew_splits, metrics.join_pair_overflows):
+        assert m.name.startswith("geomesa_join_")
+
+
+def test_forced_strategy_invalid_conf():
+    with pytest.raises(ValueError):
+        with prop_override("join.strategy", "quantum"):
+            pass
+    with pytest.raises(ValueError):
+        with prop_override("join.engine", "gpu"):
+            pass
+
+
+def test_planner_interior_runs_are_exact(rng):
+    """Interior-flagged runs (strictly inside the covering ring in cell
+    space) must contain ONLY true hits — the no-coordinate-test claim."""
+    x, y = _layout("uniform", 20000, rng)
+    ds = _store(x, y)
+    di = DeviceIndex(ds, "t")
+    eng = JoinEngine(di)
+    jidx = eng.prepare()
+    envs = _windows(rng, 10, w=8.0)  # big windows: interior cells exist
+    from geomesa_tpu.join.planner import clip_envs
+
+    with prop_override("join.strategy", "zmerge"):
+        plan = plan_join(jidx, clip_envs(envs), _join_conf())
+    ii = np.nonzero(plan.interior)[0]
+    assert len(ii), "expected interior runs for 8-degree windows"
+    xs, ys = jidx.planes["x"], jidx.planes["y"]
+    for r in ii[:50]:
+        s, e, j = plan.starts[r], plan.ends[r], plan.wins[r]
+        a, b, c, d = envs[j]
+        assert np.all((xs[s:e] >= a) & (xs[s:e] <= c)
+                      & (ys[s:e] >= b) & (ys[s:e] <= d))
+
+
+def test_frame_threads_mesh_through(rng):
+    """The predicate-join path honors ``mesh=`` (review regression: it
+    used to be silently dropped) and an explicit join.engine=host pin
+    beats an attached mesh."""
+    from geomesa_tpu.geom import Polygon
+
+    x, y = _layout("uniform", 1500, rng)
+    ds = _store(x, y)
+    rp = [
+        Polygon(np.array([
+            [a, b], [a + 2, b], [a + 2, b + 2], [a, b + 2], [a, b],
+        ]))
+        for a, b in zip(rng.uniform(-55, 50, 15), rng.uniform(-45, 40, 15))
+    ]
+    ds.create_schema("r", "*geom:Geometry:srid=4326")
+    ds.write("r", {"geom": np.array(rp, dtype=object)})
+    di = DeviceIndex(ds, "t")
+    fl, fr = SpatialFrame(ds, "t"), SpatialFrame(ds, "r")
+    rl, _, rpairs = fl.spatial_join(fr)
+    mesh = make_mesh(n_devices=4)
+    el, _, epairs = fl.spatial_join(fr, device_index=di, mesh=mesh)
+    canon = lambda l, p: sorted((l.fids[i], j) for i, j in p)  # noqa: E731
+    assert canon(rl, rpairs) == canon(el, epairs)
+    # host pin wins over the mesh (the oracle engine stays forceable)
+    envs = _windows(rng, 20)
+    with prop_override("join.engine", "host"):
+        res = JoinEngine(di, mesh=mesh).join(envs)
+    assert res.engine == "host" and res.shards == 0
+    assert _got(res) == _reference(ds, envs)

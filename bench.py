@@ -2274,6 +2274,119 @@ def bench_serving(args) -> dict:
     return out
 
 
+def bench_results(args) -> dict:
+    """``--mode results``: the Arrow-native result plane (ISSUE 12).
+    Serves the SAME ~100K-row resident result as GeoJSON, streamed
+    Arrow IPC and BIN track records, recording rows/s and bytes for
+    each, then guards the tentpole claims: (1) the Arrow path beats
+    GeoJSON rows/s by >= 5x (no per-feature Python on the hot path),
+    (2) the Arrow stream round-trips BIT-IDENTICALLY to the served
+    row set (every column, numpy array_equal on the decoded buffers),
+    and (3) the BIN response is byte-identical to the DeviceIndex
+    host-twin oracle. ``--smoke`` is the CI leg (fewer reps, same
+    guards)."""
+    import io as _io
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from geomesa_tpu.arrow_io import read_feature_stream
+    from geomesa_tpu.filter.ecql import parse_instant
+    from geomesa_tpu.server import serve_background
+    from geomesa_tpu.store.memory import MemoryDataStore
+
+    platform = jax.devices()[0].platform
+    n = args.n or 100_000
+    reps = 2 if args.smoke else max(args.iters, 2)
+    log(f"platform={platform} results plane: {n:,}-row result, "
+        f"geojson vs arrow vs bin x{reps}")
+    ds = MemoryDataStore()
+    ds.create_schema(
+        "gdelt", "track:Integer,name:String,dtg:Date,*geom:Point:srid=4326"
+    )
+    rng = np.random.default_rng(11)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write("gdelt", {
+        "track": rng.integers(0, 512, n),
+        "name": rng.choice(["alpha", "beta", "gamma", "delta"], n),
+        "dtg": t0 + rng.integers(0, 10**8, n),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+    server, _ = serve_background(ds, resident=True)
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=600) as r:
+            return r.read()
+
+    legs = {
+        "geojson": "/features/gdelt",
+        "arrow": "/features/gdelt?f=arrow",
+        "bin": "/features/gdelt?f=bin&track=track",
+    }
+    out: dict = {"results_n": n}
+    bodies: dict = {}
+    for fmt, path in legs.items():
+        bodies[fmt] = get(path)  # warmup: staging + compiles + dicts
+        t = time.perf_counter()
+        for _ in range(reps):
+            get(path)
+        dt = (time.perf_counter() - t) / reps
+        out[f"results_{fmt}_rows_per_sec"] = round(n / dt, 1)
+        out[f"results_{fmt}_bytes"] = len(bodies[fmt])
+        out[f"results_{fmt}_ms"] = round(dt * 1e3, 2)
+        log("results %-7s %12.0f rows/s  %8.1fms  %s bytes"
+            % (fmt, n / dt, dt * 1e3, f"{len(bodies[fmt]):,}"))
+    # guard 2: the Arrow stream round-trips bit-identically to the
+    # served row set (the resident index's Z-sorted order)
+    di = server.RequestHandlerClass._resident_cache["gdelt"]
+    oracle = di.query("INCLUDE")
+    decoded = list(read_feature_stream(_io.BytesIO(bodies["arrow"])))
+    from geomesa_tpu.features.batch import FeatureBatch
+
+    got = FeatureBatch.concat(decoded)
+    assert len(got) == len(oracle) == n, (len(got), len(oracle), n)
+    assert np.array_equal(
+        got.fids, np.asarray([str(f) for f in oracle.fids])
+    ), "arrow fids diverged"
+    for name in oracle.sft.attribute_names:
+        a, b = got.column(name), oracle.column(name)
+        assert a.dtype == b.dtype and np.array_equal(a, b), (
+            f"arrow column {name!r} not bit-identical"
+        )
+    # guard 3: the BIN response equals the host-twin oracle bytes
+    assert bodies["bin"] == di.bin_export("INCLUDE", "track"), (
+        "BIN response diverged from the DeviceIndex host twin"
+    )
+    # the device rider must agree bit-for-bit too (forced engine; on
+    # all-CPU the serving default is the host twin, same bytes either way)
+    from geomesa_tpu.conf import prop_override
+
+    with prop_override("results.bin.engine", "device"):
+        from geomesa_tpu.results import resident_bin
+
+        assert resident_bin(di, "INCLUDE", "track") == bodies["bin"], (
+            "device BIN rider diverged from the host twin"
+        )
+    server.shutdown()
+    # guard 1: the regression cliff this mode exists for
+    ratio = (
+        out["results_arrow_rows_per_sec"]
+        / out["results_geojson_rows_per_sec"]
+    )
+    out["results_arrow_vs_geojson"] = round(ratio, 2)
+    assert ratio >= 5.0, (
+        f"arrow path only {ratio:.1f}x geojson rows/s (need >= 5x)"
+    )
+    log(f"results: arrow beats geojson {ratio:.1f}x (guard >= 5x), "
+        "round-trip bit-identical, BIN rider == host twin")
+    return out
+
+
 def bench_serve_chaos(args) -> dict:
     """``--mode serve --chaos-smoke``: the serve-path chaos smoke
     (ISSUE 7). Injects (1) a persistent device-launch failure — the
@@ -3528,7 +3641,7 @@ def main() -> None:
         choices=(
             "all", "filter", "zscan", "build", "polygon", "density", "sweep",
             "xzbuild", "meshbuild", "multichip", "pipeline", "oocscan",
-            "join", "serve", "flush", "stream",
+            "join", "serve", "flush", "stream", "results",
         ),
         default="all",
         help="all: every benchmark, one JSON line with everything (what "
@@ -3572,6 +3685,8 @@ def main() -> None:
             out = bench_serving(args)
             if args.trace_overhead:
                 out.update(bench_trace_overhead(args))
+    elif args.mode == "results":
+        out = bench_results(args)
     elif args.mode == "flush":
         out = bench_flush(args)
     elif args.mode == "stream":

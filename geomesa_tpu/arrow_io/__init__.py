@@ -15,6 +15,7 @@ of the reference's DeltaWriter/reader protocol.
 """
 
 from geomesa_tpu.arrow_io.schema import (
+    SORT_KEY_META,
     arrow_schema_for,
     batch_to_arrow,
     arrow_to_batch,
@@ -32,6 +33,7 @@ from geomesa_tpu.arrow_io.io import (
 )
 
 __all__ = [
+    "SORT_KEY_META",
     "arrow_schema_for",
     "batch_to_arrow",
     "arrow_to_batch",

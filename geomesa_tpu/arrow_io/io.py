@@ -60,6 +60,25 @@ class ArrowStreamWriter:
         self.close()
 
 
+def ensure_labels_representable(auto_detect: bool, want_vis: bool,
+                                batch) -> None:
+    """Never silently strip security labels: when visibility was
+    AUTO-detected from an unlabeled first batch, the stream schema is
+    label-free and a later labeled batch cannot be represented — fail
+    loudly. (An EXPLICIT with_visibility=False is the caller opting out
+    of labels; that strips without complaint.) The ONE implementation
+    of the rule, shared by the buffered writers here and the result
+    plane's streamed encoder (results/stream.py)."""
+    from geomesa_tpu.security import VIS_COLUMN
+
+    if auto_detect and not want_vis and VIS_COLUMN in batch.columns:
+        raise ValueError(
+            "batch carries visibility labels but the stream schema "
+            "was auto-detected from an unlabeled first batch; pass "
+            "with_visibility=True (or False to strip deliberately)"
+        )
+
+
 def _write_stream(writer_cls, sink, batches, sft=None, **kw) -> int:
     """Shared stream-writing protocol for the plain and delta writers:
     peek the first batch for the SFT / visibility auto-detect, stream the
@@ -79,17 +98,7 @@ def _write_stream(writer_cls, sink, batches, sft=None, **kw) -> int:
     with writer_cls(sink, sft or first.sft, **kw) as w:
         w.write(first)
         for b in batches:
-            if auto_detect and not want_vis and VIS_COLUMN in b.columns:
-                # never silently strip security labels: auto-detect fixed
-                # a label-free schema from the unlabeled first batch, so a
-                # later labeled batch cannot be represented — fail loudly.
-                # (An EXPLICIT with_visibility=False is the caller opting
-                # out of labels; that strips without complaint.)
-                raise ValueError(
-                    "batch carries visibility labels but the stream schema "
-                    "was auto-detected from an unlabeled first batch; pass "
-                    "with_visibility=True (or False to strip deliberately)"
-                )
+            ensure_labels_representable(auto_detect, want_vis, b)
             w.write(b)
         return w.batches
 
@@ -221,6 +230,7 @@ class DeltaWriter:
         dict_encode: "tuple[str, ...] | None" = None,
         sort_key: "str | None" = None,
         with_visibility: bool = False,
+        presorted: "str | None" = None,
     ):
         import pyarrow as pa
 
@@ -229,6 +239,18 @@ class DeltaWriter:
         self.schema = arrow_schema_for(
             sft, dict_encode, with_visibility=with_visibility
         )
+        if presorted is not None:
+            # stamp "batches form ascending runs of this order" WITHOUT
+            # re-sorting — the result plane's Z-sorted resident exports
+            # ride the index order as-is (no host re-sort). Column-named
+            # stamps are value-mergeable; order tags ("z") only declare
+            # the run discipline (see SORT_KEY_META in schema.py)
+            from geomesa_tpu.arrow_io.schema import SORT_KEY_META
+
+            self.schema = self.schema.with_metadata(
+                {**(self.schema.metadata or {}),
+                 SORT_KEY_META: presorted.encode()}
+            )
         self._dict_ids: dict = {}  # field -> {value: index}
         self._dict_values: dict = {}  # field -> [values in id order]
         for f in self.schema:

@@ -26,6 +26,15 @@ from geomesa_tpu.geom.base import (
 SFT_NAME_KEY = b"geomesa.sft.name"
 SFT_SPEC_KEY = b"geomesa.sft.spec"
 GEOM_TYPE_KEY = b"geomesa.geom.type"
+#: stream-level "batches form ascending runs of this key" stamp — the
+#: result plane's Z-sorted resident exports set it without re-sorting.
+#: The value is either a stream COLUMN name — consumers can then k-way
+#: merge streams by it (merge_delta_streams with that key) — or an
+#: order TAG naming an ordering the stream does not materialize as a
+#: column (``"z"``: the resident index's Z-curve order; same-tag
+#: streams are sorted runs of the same global order but cannot be
+#: value-merged without the key column)
+SORT_KEY_META = b"geomesa.sort.key"
 
 _SCALAR_TYPES = {
     "String": "string",
@@ -190,6 +199,22 @@ def _decode_geom_column(arr, type_name: str) -> np.ndarray:
 # -- batch <-> RecordBatch ---------------------------------------------------
 
 
+def _encode_fids(fids: np.ndarray):
+    """Feature ids as an Arrow string array with NO per-feature Python
+    on the common dtypes: integer fids cast in C++ (Arrow compute),
+    numpy unicode wraps directly; only true object arrays pay the
+    str() loop (matches the GeoJSON path's ``str(fid)`` rendering)."""
+    import pyarrow as pa
+
+    if fids.dtype.kind in "iu":
+        import pyarrow.compute as pc
+
+        return pc.cast(pa.array(fids), pa.string())
+    if fids.dtype.kind == "U":
+        return pa.array(fids, pa.string())
+    return pa.array([str(f) for f in fids], pa.string())
+
+
 def batch_to_arrow(batch: FeatureBatch, schema=None, string_encoder=None):
     """FeatureBatch -> pyarrow RecordBatch under the typed-vector schema.
 
@@ -206,7 +231,7 @@ def batch_to_arrow(batch: FeatureBatch, schema=None, string_encoder=None):
         schema = arrow_schema_for(
             sft, with_visibility=VIS_COLUMN in batch.columns
         )
-    arrays = [pa.array([str(f) for f in batch.fids], pa.string())]
+    arrays = [_encode_fids(batch.fids)]
     if schema.get_field_index(VIS_COLUMN) >= 0:
         vis = batch.columns.get(VIS_COLUMN)
         arrays.append(

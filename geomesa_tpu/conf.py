@@ -203,6 +203,16 @@ Properties:
 - ``join.xz.ranges``            XZ code ranges per window when the
                                 left side is a non-point (extent
                                 curve) layout
+- ``results.batch.rows``        rows per streamed wire record batch on
+                                the Arrow-native result plane
+                                (results/): bounds per-chunk memory on
+                                /features streaming and bulk exports
+- ``results.bin.engine``        BIN track-record encoder engine
+                                (results/binrider.py): ``auto`` (numpy
+                                host twin on all-CPU platforms — the
+                                mesh.sort.engine precedent — fused
+                                device pack otherwise), ``device`` or
+                                ``host``
 """
 
 from __future__ import annotations
@@ -245,6 +255,15 @@ def _parse_join_engine(v) -> str:
     if s not in ("auto", "device", "host"):
         raise ValueError(
             f"join.engine must be auto, device or host, not {v!r}"
+        )
+    return s
+
+
+def _parse_results_bin_engine(v) -> str:
+    s = str(v).strip().lower()
+    if s not in ("auto", "device", "host"):
+        raise ValueError(
+            f"results.bin.engine must be auto, device or host, not {v!r}"
         )
     return s
 
@@ -381,6 +400,11 @@ _DEFS = {
     "join.batch.candidates": (1 << 20, int),
     "join.hist.bits": (8, int),
     "join.xz.ranges": (32, int),
+    # Arrow-native result plane (results/): rows per streamed wire
+    # record batch (bounds per-chunk memory on /features streaming and
+    # bulk exports) and the BIN track-record encoder engine selector
+    "results.batch.rows": (8192, int),
+    "results.bin.engine": ("auto", _parse_results_bin_engine),
 }
 
 _overrides: dict = {}

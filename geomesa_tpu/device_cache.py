@@ -269,6 +269,8 @@ class DeviceIndex:
         self._vis_disabled = False  # vocabulary overflowed: public-only
         self._auth_tables: dict = {}  # sorted-auths tuple -> device table
         self._visid_np = None  # host mirror of the VIS_ID plane
+        self._bin_jits: dict = {}  # (shape, cap) -> jitted BIN pack
+        self._bin_lanes: dict = {}  # lane-matrix cache (latest staging)
         self.refresh()
 
     def _stage_batch(self, batch) -> dict:
@@ -2362,6 +2364,145 @@ class DeviceIndex:
             sort=sort,
         )
 
+    # -- device-side BIN rider (results/ plane) ----------------------------
+
+    def _device_hit_mask(self, f, loose):
+        """Device-RESIDENT boolean hit mask (never fetched to host), or
+        None when the filter is not fully device-expressible — the host
+        twin (:meth:`bin_export`) serves those shapes. Labeled stagings
+        always return None: per-request auths evaluate host-side."""
+        import jax.numpy as jnp
+
+        if VIS_ID in (self._cols or {}):
+            return None
+        if isinstance(f, type(ast.Include)):
+            # match-everything (ast.Include is a singleton instance):
+            # the validity plane IS the mask
+            dv = self._device_valid()
+            return dv if dv is not None else jnp.ones(
+                self._staged_len(), bool
+            )
+        if self._resolve_loose(loose):
+            lb = self._loose_bounds(f)
+            if lb is not None:
+                m = self._z_mask_dev(lb)
+                dv = self._device_valid()
+                return (m & dv) if dv is not None else m
+        compiled, _, mask_fn = self._compiled_for(f)
+        if (
+            not compiled.device_cols
+            or mask_fn is None
+            or not compiled.fully_on_device
+        ):
+            return None
+        return mask_fn(self._resident_subset(compiled))
+
+    def _bin_lane_matrix(self, track_attr, dtg_attr, gname, label_attr):
+        """The BIN record lanes as ONE device-resident (L, rows) uint32
+        matrix: [track hash, dtg seconds, lat f32, lon f32] (+ label
+        i64 as lo/hi words). Built once per staging generation (vector
+        host passes, single H2D transfer — the _stage_packed transfer
+        discipline) and gathered by every pack launch after that."""
+        import jax.numpy as jnp
+
+        from geomesa_tpu.process.binexport import _label_pack, _track_hash
+
+        key = (
+            track_attr, dtg_attr, gname, label_attr,
+            getattr(self, "_gen", 0),
+        )
+        mat = self._bin_lanes.get(key)
+        if mat is not None:
+            return mat
+        host = self._host_rows()
+        col = host.column(gname)
+        lanes = [
+            _track_hash(np.asarray(host.column(track_attr))).view(np.uint32),
+            (host.column(dtg_attr) // 1000).astype(np.int32).view(np.uint32),
+            np.ascontiguousarray(col[:, 1]).astype(np.float32).view(np.uint32),
+            np.ascontiguousarray(col[:, 0]).astype(np.float32).view(np.uint32),
+        ]
+        if label_attr:
+            lab = _label_pack(np.asarray(host.column(label_attr)))
+            words = lab.view(np.uint32).reshape(-1, 2)
+            # little-endian i64: low word first == the record byte layout
+            lanes.append(np.ascontiguousarray(words[:, 0]))
+            lanes.append(np.ascontiguousarray(words[:, 1]))
+        mat = jnp.asarray(np.ascontiguousarray(np.stack(lanes)))
+        self._bin_lanes = {key: mat}  # latest staging only (bounds HBM)
+        return mat
+
+    def bin_rider(
+        self,
+        query,
+        track_attr: str,
+        dtg_attr: "str | None" = None,
+        geom_attr: "str | None" = None,
+        label_attr: "str | None" = None,
+        sort: bool = False,
+        loose: "bool | None" = None,
+        auths=None,
+    ) -> "bytes | None":
+        """BIN track records packed ON DEVICE as a fused launch pair
+        riding the ``_mesh_hits`` count→cap→compact discipline: the hit
+        mask stays device-resident, a count launch sizes a power-of-two
+        compaction cap, and one pack launch cumsum-compacts the record
+        lanes into a (L, cap) uint32 buffer — only packed record bytes
+        ever cross back to host (O(hits), not O(rows)). Bit-identical
+        to the host twin :meth:`bin_export`. Returns None when the
+        shape is not device-expressible (labeled staging, host-residual
+        filter, non-point geometry) — callers fall to the twin."""
+        import jax
+        import jax.numpy as jnp
+
+        from geomesa_tpu.process.binexport import DTYPE_16, DTYPE_24
+
+        f = self._parse(query)
+        host = self._host_rows()
+        gname = geom_attr or self.sft.geom_field
+        if host is None or host.column(gname).dtype == object:
+            return None  # non-point geometry: host twin decodes coords
+        if len(host) == 0:
+            return b""
+        m = self._device_hit_mask(f, loose)
+        if m is None:
+            return None
+        mat = self._bin_lane_matrix(
+            track_attr, dtg_attr or self.sft.dtg_field, gname, label_attr
+        )
+        n_lanes, rows = int(mat.shape[0]), int(mat.shape[1])
+        if int(m.shape[0]) < rows:
+            return None  # mirror/plane layout mismatch: twin is exact
+        n = int(jnp.sum(m[:rows], dtype=jnp.int32))  # the count launch
+        dt = DTYPE_24 if label_attr else DTYPE_16
+        if n == 0:
+            return b""
+        cap = min(_next_pow2(n), rows)
+        key = ("bin-pack", n_lanes, rows, cap)
+        fn = self._bin_jits.get(key)
+        if fn is None:
+
+            def pack(mask, lanes):
+                mk = mask[:rows]
+                pos = jnp.cumsum(mk.astype(jnp.int32)) - 1
+                keep = mk & (pos < cap)
+                idx = jnp.where(keep, pos, cap)  # cap = trash slot
+                buf = jnp.zeros((n_lanes, cap + 1), jnp.uint32)
+                return buf.at[:, idx].set(lanes)[:, :cap]
+
+            fn = jax.jit(pack)
+            self._bin_jits[key] = fn
+        out = np.asarray(fn(m, mat))  # one D2H: the packed records
+        from geomesa_tpu import metrics
+
+        metrics.results_bin_device_launches.inc()
+        rec = np.frombuffer(
+            np.ascontiguousarray(out[:, :n].T).tobytes(), dtype=dt
+        )
+        if sort:
+            rec = rec[np.argsort(rec["dtg"], kind="stable")]
+        return rec.tobytes()
+
 
 def _next_pow2(n: int) -> int:
     return 1 << max(int(n) - 1, 0).bit_length()
@@ -2685,6 +2826,15 @@ class StreamingDeviceIndex(DeviceIndex):
         # and the device mask must come from the same snapshot
         with self._lock:
             return super().bin_export(
+                query, track_attr, dtg_attr=dtg_attr, geom_attr=geom_attr,
+                label_attr=label_attr, sort=sort, loose=loose, auths=auths,
+            )
+
+    def bin_rider(self, query, track_attr, dtg_attr=None, geom_attr=None,
+                  label_attr=None, sort=False, loose=None, auths=None):
+        # lane matrix + device mask must come from the same staging
+        with self._lock:
+            return super().bin_rider(
                 query, track_attr, dtg_attr=dtg_attr, geom_attr=geom_attr,
                 label_attr=label_attr, sort=sort, loose=loose, auths=auths,
             )
@@ -3275,6 +3425,17 @@ class ShardedDeviceIndex(DeviceIndex):
                    label_attr=None, sort=False, loose=None, auths=None):
         with self._lock:
             return super().bin_export(
+                query, track_attr, dtg_attr=dtg_attr, geom_attr=geom_attr,
+                label_attr=label_attr, sort=sort, loose=loose, auths=auths,
+            )
+
+    def bin_rider(self, query, track_attr, dtg_attr=None, geom_attr=None,
+                  label_attr=None, sort=False, loose=None, auths=None):
+        # the lane matrix replicates (host-built) while the mask planes
+        # are mesh-sharded; jit propagates the shardings through the
+        # pack launch, so a sharded index still packs in one SPMD pass
+        with self._lock:
+            return super().bin_rider(
                 query, track_attr, dtg_attr=dtg_attr, geom_attr=geom_attr,
                 label_attr=label_attr, sort=sort, loose=loose, auths=auths,
             )

@@ -63,22 +63,25 @@ def write_batch(batch, path: str, fmt: str, track_attr: "str | None" = None):
 
         orc.write_table(batch.to_arrow(), path)
     elif fmt == "arrow":
-        from geomesa_tpu.arrow_io import write_delta_stream
+        # the serving result plane's chunked delta encoder (results/):
+        # bulk export and /features?f=arrow share one encoder stack,
+        # and per-chunk memory stays bounded by results.batch.rows
+        from geomesa_tpu.results import write_arrow_stream_file
 
-        with open(path, "wb") as sink:
-            write_delta_stream(sink, [batch], sft=batch.sft, chunk_size=1 << 16)
+        write_arrow_stream_file(path, [batch], sft=batch.sft)
     elif fmt == "avro":
         from geomesa_tpu.features.avro import write_avro
 
         with open(path, "wb") as fh:
             write_avro(fh, batch)
     elif fmt == "bin":
-        from geomesa_tpu.process import encode_bin
+        from geomesa_tpu.results import bin_stream_chunks
 
         if not track_attr:
             raise ValueError("bin export requires a track attribute")
         with open(path, "wb") as fh:
-            fh.write(encode_bin(batch, track_attr, sort=True))
+            for chunk in bin_stream_chunks([batch], track_attr, sort=True):
+                fh.write(chunk)
     elif fmt == "shp":
         from geomesa_tpu.convert.shp import write_shapefile
 

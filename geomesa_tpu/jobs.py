@@ -108,6 +108,7 @@ def parallel_export(
     out_dir: str,
     fmt: str = "parquet",
     workers: int = 4,
+    track_attr: "str | None" = None,
 ) -> "list[str]":
     """Export query results as one file per storage partition (ref:
     distributed export / GeoMesaOutputFormat). Stores without partitioned
@@ -115,7 +116,12 @@ def parallel_export(
     host-I/O pipeline: file WRITES run on worker threads with bounded
     read-ahead while this thread keeps scanning the next partition, and
     the whole result set is never materialized at once. Returns the
-    written paths in partition order."""
+    written paths in partition order.
+
+    The ``arrow`` and ``bin`` formats encode through the serving result
+    plane (results/ — the same chunked delta-dictionary / BIN record
+    encoders ``/features`` streams from), so bulk export and serving
+    share one encoder stack; ``bin`` needs ``track_attr``."""
     from geomesa_tpu.store.prefetch import PrefetchConfig, prefetch_map
 
     os.makedirs(out_dir, exist_ok=True)
@@ -131,7 +137,7 @@ def parallel_export(
         path = os.path.join(out_dir, f"part-{i:05d}.{fmt}")
         from geomesa_tpu.export import write_batch
 
-        write_batch(batch, path, fmt)
+        write_batch(batch, path, fmt, track_attr=track_attr)
         return path
 
     n_workers = max(int(workers), 0)

@@ -101,6 +101,8 @@ FIELDS = (
     "compact_seconds",   # background compaction seconds (system requests)
     "join_candidates",   # candidate pairs expanded by join refinement
     "join_pairs",        # pairs this request's spatial joins emitted
+    "encode_seconds",    # wire-format serialization time (http.encode)
+    "response_bytes",    # response body bytes written to the socket
 )
 
 #: fields folded with max() instead of sum() (a request's fusion width

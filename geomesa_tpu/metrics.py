@@ -637,3 +637,27 @@ join_refine_seconds = REGISTRY.histogram(
     "geomesa_join_refine_seconds",
     "join refinement time (expansion + launches + emission, per join)",
 )
+
+# Arrow-native result plane (results/): wire-format serving and export
+# throughput by (bounded) format label, encode time split from the
+# socket write, and the fused device BIN rider's launch count
+results_batches = REGISTRY.counter(
+    "geomesa_results_batches_total",
+    "wire record batches / chunks emitted by the result plane (fmt)",
+)
+results_bytes = REGISTRY.counter(
+    "geomesa_results_bytes_total",
+    "response/export body bytes encoded by the result plane (fmt)",
+)
+results_encode_seconds = REGISTRY.histogram(
+    "geomesa_results_encode_seconds",
+    "wire-format serialization time per response (socket write excluded)",
+)
+results_write_seconds = REGISTRY.histogram(
+    "geomesa_results_write_seconds",
+    "socket write time per response (serialization excluded)",
+)
+results_bin_device_launches = REGISTRY.counter(
+    "geomesa_results_bin_device_launches_total",
+    "fused device BIN pack launches (count->cap->compact pairs count one)",
+)

@@ -1,0 +1,53 @@
+"""Arrow-native result plane: device-compacted hits to the wire with
+no per-feature Python (ISSUE 12; ROADMAP item 3).
+
+Ref role: geomesa-arrow's DeltaWriter tier + BinaryOutputEncoder's BIN
+track format [UNVERIFIED - empty reference mount] — the reference keeps
+response encoding columnar all the way to the socket; this package does
+the same for the TPU serving stack, where the scan core emits hits at
+device rates and the interpreter must never own the response again.
+
+Pieces:
+
+- :mod:`~geomesa_tpu.results.negotiate` — one content-negotiation table
+  (``f=`` query param > ``Accept`` header > GeoJSON) shared by every
+  feature-emitting endpoint.
+- :mod:`~geomesa_tpu.results.stream` — streamed encoders: chunked
+  delta-dictionary Arrow IPC (first batch flushes while later batches
+  are still assembling; per-chunk memory bounded by
+  ``results.batch.rows``) and BIN record streams, consumed by the
+  server's chunked responses AND the bulk export jobs — one encoder
+  stack for both.
+- :mod:`~geomesa_tpu.results.columnar` — columnar assembly helpers:
+  extra per-feature outputs (kNN distances …) become REAL Arrow
+  columns via an extended SFT, never a per-feature ``zip`` loop.
+- :mod:`~geomesa_tpu.results.binrider` — the BIN engine selector:
+  fused device pack (``DeviceIndex.bin_rider``, count→cap→compact)
+  with the numpy host twin, switched by ``results.bin.engine``.
+"""
+
+from geomesa_tpu.results.columnar import capped_batches, with_extra_columns
+from geomesa_tpu.results.negotiate import (
+    CONTENT_TYPES,
+    FORMATS,
+    negotiate_format,
+)
+from geomesa_tpu.results.binrider import bin_engine, resident_bin
+from geomesa_tpu.results.stream import (
+    arrow_stream_chunks,
+    bin_stream_chunks,
+    write_arrow_stream_file,
+)
+
+__all__ = [
+    "CONTENT_TYPES",
+    "FORMATS",
+    "arrow_stream_chunks",
+    "bin_engine",
+    "capped_batches",
+    "bin_stream_chunks",
+    "negotiate_format",
+    "resident_bin",
+    "with_extra_columns",
+    "write_arrow_stream_file",
+]

@@ -1,0 +1,63 @@
+"""BIN engine selection: the fused device pack vs its numpy host twin.
+
+``DeviceIndex.bin_rider`` packs the 16/24-byte track records on device
+(count→cap→compact, the ``_mesh_hits`` discipline) so only packed
+record bytes cross back to host; ``DeviceIndex.bin_export`` is the
+bit-identical numpy twin. ``results.bin.engine`` picks, with ``auto``
+following the ``mesh.sort.engine`` precedent: the host twin on all-CPU
+platforms (numpy beats a jitted emulation there), the device pack
+whenever a real accelerator is visible.
+"""
+
+from __future__ import annotations
+
+
+def bin_engine() -> str:
+    """Resolve ``results.bin.engine`` (auto -> host on all-CPU)."""
+    from geomesa_tpu.conf import sys_prop
+
+    eng = sys_prop("results.bin.engine")
+    if eng != "auto":
+        return eng
+    import jax
+
+    return (
+        "host"
+        if all(d.platform == "cpu" for d in jax.devices())
+        else "device"
+    )
+
+
+def resident_bin(
+    di,
+    query,
+    track_attr: str,
+    *,
+    dtg_attr: "str | None" = None,
+    geom_attr: "str | None" = None,
+    label_attr: "str | None" = None,
+    sort: bool = False,
+    loose: "bool | None" = None,
+    auths=None,
+) -> bytes:
+    """BIN bytes for a resident index's hits under the configured
+    engine. The device rider declines shapes it cannot express
+    (labeled staging, host-residual filters, non-point geometry) —
+    ``auto``/``host`` fall to the twin; a pinned ``device`` raises so
+    an operator's explicit pin never silently changes engines."""
+    kw = dict(
+        dtg_attr=dtg_attr, geom_attr=geom_attr, label_attr=label_attr,
+        sort=sort, loose=loose, auths=auths,
+    )
+    eng = bin_engine()
+    if eng != "host":
+        data = di.bin_rider(query, track_attr, **kw)
+        if data is not None:
+            return data
+        if eng == "device":
+            raise ValueError(
+                "results.bin.engine=device but the query shape is not "
+                "device-expressible (labeled staging, host-residual "
+                "filter or non-point geometry); use auto or host"
+            )
+    return di.bin_export(query, track_attr, **kw)

@@ -109,7 +109,6 @@ requests are stamped into the audit log (outcome field).
 
 from __future__ import annotations
 
-import io
 import json
 import math
 import threading
@@ -156,6 +155,16 @@ class _GeomesaHTTPServer(ThreadingHTTPServer):
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.1: chunked transfer encoding for the streamed result
+    # plane (first record batch flushes while later batches are still
+    # assembling); every buffered response carries Content-Length so
+    # keep-alive semantics hold. The socket timeout bounds how long an
+    # IDLE keep-alive connection may pin a handler thread (the stdlib
+    # turns the timeout into close_connection) — without it every
+    # half-open client would hold a ThreadingHTTPServer thread forever
+    protocol_version = "HTTP/1.1"
+    timeout = 60
+
     store = None  # injected by make_server
     resident = False  # serve from device-pinned DeviceIndex caches
     mesh = False  # shard resident indexes across the device mesh
@@ -286,10 +295,10 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: D102
         pass
 
-    def _send(self, code: int, body: bytes, ctype: str, headers=()) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
+    def _stamp_response_headers(self, code: int, headers=()) -> None:
+        """The shared response stamping between ``send_response`` and
+        ``end_headers``: ledger status, request-id echo, degradation
+        header — identical for buffered and streamed responses."""
         cost = getattr(self, "_cost", None)
         if cost is not None:
             # the ledger/SLO layer classifies good vs bad by this code
@@ -318,11 +327,165 @@ class _Handler(BaseHTTPRequestHandler):
                 tr.root.set(degraded=",".join(reasons))
         for name, value in headers:
             self.send_header(name, value)
+
+    def _send(self, code: int, body: bytes, ctype: str, headers=()) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self._stamp_response_headers(code, headers)
         self.end_headers()
         self.wfile.write(body)
 
     def _json(self, code: int, doc) -> None:
         self._send(code, json.dumps(doc).encode("utf-8"), "application/json")
+
+    def _observe_encode(self, fmt: str, enc_s: float, write_s: float,
+                        total: int, rows, batches: int) -> None:
+        """Fold one response's serialization cost into the ledger
+        (GT009 fields), the results metrics, and two SIBLING spans —
+        ``http.encode`` (serialization only) and ``http.write`` (socket
+        only), split so a slow client can no longer pollute encode
+        attribution in the slow-query log or ``/stats/ledger``."""
+        import time as _time
+
+        from geomesa_tpu import ledger, metrics
+        from geomesa_tpu.tracing import capture, record_span
+
+        now = _time.perf_counter()
+        parent = capture()
+        record_span(
+            parent, "http.encode", now - enc_s - write_s, enc_s,
+            fmt=fmt, rows=rows, batches=batches, bytes=total,
+        )
+        record_span(parent, "http.write", now - write_s, write_s,
+                    bytes=total)
+        ledger.charge("encode_seconds", enc_s)
+        ledger.charge("response_bytes", total)
+        metrics.results_encode_seconds.observe(enc_s)
+        metrics.results_write_seconds.observe(write_s)
+        metrics.results_batches.inc(batches, fmt=fmt)
+        metrics.results_bytes.inc(total, fmt=fmt)
+
+    def _send_encoded(self, code: int, body: bytes, ctype: str, fmt: str,
+                      enc_s: float, rows=None, headers=()) -> None:
+        """Buffered response whose serialization the caller already
+        timed (``enc_s``); the socket write is measured here."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self._send(code, body, ctype, headers=headers)
+        self._observe_encode(
+            fmt, enc_s, _time.perf_counter() - t0, len(body), rows, 1
+        )
+
+    @staticmethod
+    def _timed_batches(batches, cell: list):
+        """Wrap a batch iterator, accumulating time spent PRODUCING
+        batches (store partition read/decode on the streamed store
+        rung) into ``cell[0]`` — _send_stream subtracts it so
+        encode_seconds stays pure serialization time (the store's own
+        instrumentation already charges read/decode fields; counting
+        those seconds as encode would re-pollute the very attribution
+        the encode/write split exists to clean up)."""
+        import time as _time
+
+        it = iter(batches)
+        try:
+            while True:
+                t0 = _time.perf_counter()
+                b = next(it, None)
+                cell[0] += _time.perf_counter() - t0
+                if b is None:
+                    return
+                yield b
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+
+    def _send_stream(self, code: int, ctype: str, chunks, fmt: str,
+                     rows=None, headers=(), upstream: "list | None" = None,
+                     ) -> None:
+        """Chunked streaming response: the FIRST chunk is produced
+        before the status line goes out (late planning/encode errors
+        still surface as clean HTTP errors), every later chunk flushes
+        to the socket while the next is still assembling. Serialization
+        time (pulling the generator) and socket-write time accumulate
+        separately for the encode/write span split. A mid-stream
+        failure AFTER headers cannot become an error response — the
+        chunked stream ends WITHOUT its terminating 0-chunk and the
+        connection drops, so clients detect truncation instead of
+        parsing a partial result as complete."""
+        import time as _time
+
+        it = iter(chunks)
+        t0 = _time.perf_counter()
+        first = next(it, b"")
+        enc = _time.perf_counter() - t0
+        if self.request_version < "HTTP/1.1":
+            # RFC 9112: never send chunked framing to a 1.0 peer — it
+            # would read the hex chunk sizes as body bytes. Buffer the
+            # whole stream (the pre-streaming behavior) and close.
+            t1 = _time.perf_counter()
+            body = first + b"".join(it)
+            enc += _time.perf_counter() - t1
+            if upstream is not None:
+                enc = max(enc - upstream[0], 0.0)
+            self.close_connection = True
+            return self._send_encoded(
+                code, body, ctype, fmt, enc, rows=rows, headers=headers
+            )
+        write_s = 0.0
+        total = 0
+        nchunks = 0
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self._stamp_response_headers(code, headers)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        clean = False
+        try:
+            piece = first
+            while True:
+                if piece:
+                    nchunks += 1
+                    t1 = _time.perf_counter()
+                    self.wfile.write(b"%x\r\n" % len(piece))
+                    self.wfile.write(piece)
+                    self.wfile.write(b"\r\n")
+                    write_s += _time.perf_counter() - t1
+                    total += len(piece)
+                t1 = _time.perf_counter()
+                piece = next(it, None)
+                enc += _time.perf_counter() - t1
+                if piece is None:
+                    clean = True
+                    break
+        except BrokenPipeError:
+            self.close_connection = True
+        except Exception as e:
+            # headers are gone: signal truncation, never a fake success
+            self.close_connection = True
+            tr = getattr(self, "_trace", None)
+            if tr is not None:
+                tr.root.set(stream_error=f"{type(e).__name__}: {e}")
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                # deterministic teardown on abandonment: the encoder's
+                # finally closes its writer and the partition stream
+                # joins its prefetch workers NOW, not at GC time
+                close()
+        if clean:
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except BrokenPipeError:
+                self.close_connection = True
+        if upstream is not None:
+            # generator pulls included upstream batch PRODUCTION time
+            # (partition read/decode); encode keeps serialization only
+            enc = max(enc - upstream[0], 0.0)
+        self._observe_encode(fmt, enc, write_s, total, rows, nchunks)
 
     def _sched_run(self, q: dict, fn=None, fuse=None, device=None):
         """Route one unit of query work through the device query
@@ -869,7 +1032,13 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def _features(self, type_name: str, q: dict) -> None:
+        from geomesa_tpu import results
+
+        fmt = results.negotiate_format(q, self.headers.get("Accept"))
         di = self._di(type_name)
+        if fmt == "bin":
+            return self._features_bin(type_name, q, di)
+        presorted = None
         if di is not None and not q.get("properties"):
             import time as _time
 
@@ -900,50 +1069,182 @@ class _Handler(BaseHTTPRequestHandler):
                 self._observe_resident(
                     type_name, cql, t0, _time.perf_counter(), len(batch)
                 )
+                # the host mirror is Z-sorted and the compacted row ids
+                # ascend, so resident hit batches ARE sorted runs of the
+                # index key: stamp it, never re-sort on host
+                presorted = "z"
+            batches = [batch]
+            sft = batch.sft
+        elif fmt == "arrow" and not q.get("properties"):
+            # store rung, streamed: per-partition batches ride the
+            # host-I/O prefetch pipeline straight into the encoder —
+            # the first record batch hits the wire while later
+            # partitions are still being read/decoded
+            fetch = [0.0]
+            batches = self._timed_batches(
+                self._store_batches(type_name, q), fetch
+            )
+            sft = self.store.get_schema(type_name)
+            batch = None
         else:
             batch = self._sched_run(
                 q, fn=lambda: self._query(type_name, q).batch
             )
-        fmt = q.get("f", "geojson")
-        from geomesa_tpu.tracing import span
-
+            batches = [batch]
+            sft = batch.sft
         if fmt == "arrow":
-            from geomesa_tpu.arrow_io import write_delta_stream
+            # dictionary-delta record batches: clients consume
+            # incrementally and dictionaries never retransmit (ref
+            # DeltaWriter protocol); per-chunk memory is bounded by
+            # results.batch.rows — the whole-response BytesIO is gone
+            return self._send_stream(
+                200, results.CONTENT_TYPES["arrow"],
+                results.arrow_stream_chunks(
+                    batches, sft, presorted=presorted
+                ),
+                "arrow",
+                rows=None if batch is None else len(batch),
+                upstream=None if batch is not None else fetch,
+            )
+        self._emit_geojson(batch)
 
-            sink = io.BytesIO()
-            # dictionary-delta batches: clients consume incrementally and
-            # dictionaries never retransmit (ref DeltaWriter protocol).
-            # The encode span covers serialization AND the socket write —
-            # for large results that is real, attributable request time
-            with span("http.encode", fmt="arrow", rows=len(batch)):
-                write_delta_stream(
-                    sink, [batch], sft=batch.sft, chunk_size=1 << 14
-                )
-                self._send(
-                    200, sink.getvalue(),
-                    "application/vnd.apache.arrow.stream",
-                )
-        elif fmt == "geojson":
-            from geomesa_tpu.export import feature_collection
+    def _store_batches(self, type_name: str, q: dict):
+        """Store-rung result batches as an ITERATOR for the streamed
+        encoders. FS stores without the live layer stream one filtered
+        batch per surviving partition through the prefetch pipeline
+        (bounded read-ahead; visibility applied per partition, the cap
+        trimmed across the stream). The streaming live layer and plain
+        memory stores materialize the merged view — correctness first:
+        a partition iterator would miss memtable rows."""
+        from geomesa_tpu import results
+        from geomesa_tpu.query.plan import Query
 
-            with span("http.encode", fmt="geojson", rows=len(batch)):
-                self._json(200, feature_collection(batch))
-        else:
-            self._json(400, {"error": f"unknown format {fmt!r}"})
+        qp = getattr(self.store, "query_partitions", None)
+        if (
+            qp is not None
+            and self.stream is None
+            and not q.get("properties")
+        ):
+            query = Query(
+                filter=q.get("cql", "INCLUDE"),
+                hints={"auths": self._auths(q)},
+            )
+            return results.capped_batches(
+                qp(type_name, query), self._cap(q)
+            )
+        return iter(
+            [self._sched_run(q, fn=lambda: self._query(type_name, q).batch)]
+        )
+
+    def _features_bin(self, type_name: str, q: dict, di) -> None:
+        """``f=bin``: the 16/24-byte track records. Resident indexes
+        pack on device (``results.bin.engine``; the fused
+        count→cap→compact rider) with the numpy twin as fallback rung;
+        the store rung streams per-batch records. ``track=`` names the
+        track-id attribute (required), ``label=`` widens to 24-byte
+        records, ``sortBin=1`` orders by dtg seconds."""
+        import time as _time
+
+        from geomesa_tpu import results
+
+        track = q.get("track")
+        if not track:
+            raise ValueError("f=bin needs track=<attribute>")
+        label = q.get("label") or None
+        sort = (q.get("sortBin") or "").lower() in ("1", "true", "yes")
+        ctype = results.CONTENT_TYPES["bin"]
+        rec = 24 if label else 16
+        if di is not None and self._cap(q) is None \
+                and not q.get("properties"):
+            cql = q.get("cql", "INCLUDE")
+            fell: list = []
+
+            def fallback():
+                fell.append(True)
+                return None
+
+            t0 = _time.perf_counter()
+
+            def device_work():
+                return results.resident_bin(
+                    di, cql, track, dtg_attr=q.get("dtg"),
+                    label_attr=label, sort=sort,
+                    loose=self._loose(q), auths=self._auths(q),
+                )
+
+            data = self._degradable(
+                q, "device-launch-failed", fallback, fn=device_work
+            )
+            t1 = _time.perf_counter()
+            if data is not None:
+                if not fell:
+                    self._observe_resident(
+                        type_name, cql, t0, t1, len(data) // rec
+                    )
+                return self._send_encoded(
+                    200, data, ctype, "bin", t1 - t0,
+                    rows=len(data) // rec,
+                )
+        fetch = [0.0]
+        batches = self._timed_batches(
+            self._store_batches(type_name, q), fetch
+        )
+        self._send_stream(
+            200, ctype,
+            results.bin_stream_chunks(
+                batches, track, dtg_attr=q.get("dtg"),
+                label_attr=label, sort=sort,
+            ),
+            "bin",
+            upstream=fetch,
+        )
+
+    def _emit_geojson(self, batch) -> None:
+        """GeoJSON feature collection with the encode/write split."""
+        import time as _time
+
+        from geomesa_tpu.export import feature_collection
+
+        t0 = _time.perf_counter()
+        body = json.dumps(feature_collection(batch)).encode("utf-8")
+        self._send_encoded(
+            200, body, "application/json", "geojson",
+            _time.perf_counter() - t0, rows=len(batch),
+        )
 
     def _emit_features(self, batch, q: dict, extra=None) -> None:
-        """GeoJSON feature collection (optionally with extra per-feature
-        fields merged into properties, e.g. kNN distances)."""
-        from geomesa_tpu.export import feature_collection
-        from geomesa_tpu.tracing import span
+        """Emit a process result batch in the NEGOTIATED format —
+        ``/knn``/``/tube``/``/proximity`` honor ``f=arrow``/``f=bin``
+        through the result plane. Extra per-feature outputs (kNN
+        distances …) become real typed columns via an extended SFT
+        (Arrow columns / GeoJSON properties), not a per-feature zip."""
+        from geomesa_tpu import results
 
-        with span("http.encode", fmt="geojson", rows=len(batch)):
-            doc = feature_collection(batch)
-            if extra:
-                for name, vals in extra.items():
-                    for f, v in zip(doc["features"], vals):
-                        f["properties"][name] = v
-            self._json(200, doc)
+        fmt = results.negotiate_format(q, self.headers.get("Accept"))
+        if extra:
+            batch = results.with_extra_columns(batch, extra)
+        if fmt == "arrow":
+            return self._send_stream(
+                200, results.CONTENT_TYPES["arrow"],
+                results.arrow_stream_chunks([batch], batch.sft),
+                "arrow", rows=len(batch),
+            )
+        if fmt == "bin":
+            track = q.get("track")
+            if not track:
+                raise ValueError("f=bin needs track=<attribute>")
+            sort = (q.get("sortBin") or "").lower() in (
+                "1", "true", "yes"
+            )
+            return self._send_stream(
+                200, results.CONTENT_TYPES["bin"],
+                results.bin_stream_chunks(
+                    [batch], track, dtg_attr=q.get("dtg"),
+                    label_attr=q.get("label") or None, sort=sort,
+                ),
+                "bin", rows=len(batch),
+            )
+        self._emit_geojson(batch)
 
     # -- WPS process endpoints (knn / tube select / proximity search) ------
 
@@ -968,8 +1269,11 @@ class _Handler(BaseHTTPRequestHandler):
                 **kwargs,
             ),
         )
+        import numpy as np
+
         self._emit_features(
-            batch, q, extra={"knn_distance_deg": [float(d) for d in dists]}
+            batch, q,
+            extra={"knn_distance_deg": np.asarray(dists, np.float64)},
         )
 
     def _tube(self, type_name: str, q: dict) -> None:
@@ -1014,9 +1318,11 @@ class _Handler(BaseHTTPRequestHandler):
             device_index=self._di(type_name),
             auths=self._auths(q),
         )
+        import numpy as np
+
         self._emit_features(
             batch, q,
-            extra={"proximity_distance_deg": [float(d) for d in dists]},
+            extra={"proximity_distance_deg": np.asarray(dists, np.float64)},
         )
 
     def _agg_shaped(self, type_name: str, cql: str) -> bool:

@@ -629,10 +629,10 @@ class StreamedDeviceScan:
                 self._degrade_or_raise(e)
                 return self.store.query(self.type_name, query).batch
 
-    def _query_streamed(self, plan, parts):
-        from geomesa_tpu.features.batch import FeatureBatch
-        from geomesa_tpu.query.runner import _post_process
-
+    def _hit_batches(self, plan, parts):
+        """Per-slab hit batches as slabs retire (row-local refinement
+        applied; NO cross-batch post-processing — callers own
+        visibility/projection/sort/limit semantics)."""
         compiled = plan.compiled
         # chunk-level pruning: non-intersecting chunks never read/decode
         # (the mask path still applies the exact filter to what remains,
@@ -640,7 +640,6 @@ class StreamedDeviceScan:
         items, prune_stats = self._chunk_plan(plan, parts)
         self._record_prune(prune_stats)
         pairs = self._pairs(items, compiled.device_cols)
-        hits: list = []
         for mask, batch in self._stream(plan, "mask").stream(pairs):
             m = np.asarray(mask)[: len(batch)]
             idx = np.nonzero(m)[0]
@@ -648,7 +647,13 @@ class StreamedDeviceScan:
                 keep = compiled.residual_mask(batch.take(idx))
                 idx = idx[keep]
             if len(idx):
-                hits.append(batch.take(idx))
+                yield batch.take(idx)
+
+    def _query_streamed(self, plan, parts):
+        from geomesa_tpu.features.batch import FeatureBatch
+        from geomesa_tpu.query.runner import _post_process
+
+        hits = list(self._hit_batches(plan, parts))
         if not hits:
             out = FeatureBatch.from_columns(
                 self.sft, {a.name: [] for a in self.sft.attributes}
@@ -656,3 +661,50 @@ class StreamedDeviceScan:
         else:
             out = hits[0] if len(hits) == 1 else FeatureBatch.concat(hits)
         return _post_process(out, plan)
+
+    def query_batches(self, query):
+        """Out-of-core RESULT streaming (the result-plane integration,
+        results/stream.py): yield hit batches as slabs retire, so a
+        larger-than-HBM scan feeds the chunked Arrow/BIN encoders batch
+        by batch and neither the dataset nor the result set is ever
+        materialized at once. Row-local post-processing (visibility,
+        projection) applies per batch; cross-batch sort/limit do NOT —
+        the same contract as the fs store's ``query_partitions``. The
+        store-path fallback (non-device-expressible filter, degradable
+        stream fault) fires only BEFORE the first yield; a mid-stream
+        fault after rows went out raises instead of duplicating them."""
+        import dataclasses
+
+        from geomesa_tpu.query.runner import _post_process
+        from geomesa_tpu.tracing import span
+
+        plan, parts = self._parts(query)
+        compiled = plan.compiled
+        if not compiled.device_cols:
+            b = self.store.query(self.type_name, query).batch
+            if len(b):
+                yield b
+            return
+        outer = dataclasses.replace(
+            plan,
+            query=dataclasses.replace(
+                plan.query, sort_by=None, max_features=None
+            ),
+        )
+        with span(
+            "oocscan.query_batches", type=self.type_name, parts=len(parts)
+        ):
+            yielded = False
+            try:
+                for hit in self._hit_batches(plan, parts):
+                    out = _post_process(hit, outer)
+                    if len(out):
+                        yielded = True
+                        yield out
+            except Exception as e:
+                if yielded:
+                    raise
+                self._degrade_or_raise(e)
+                b = self.store.query(self.type_name, query).batch
+                if len(b):
+                    yield b

@@ -1,0 +1,512 @@
+"""Arrow-native result plane (ISSUE 12): content negotiation, streamed
+Arrow IPC / BIN serving, device-vs-host BIN bit-identity, delta
+dictionary growth across batches, the encode/write span split, ledger
+serialization fields, and the merged live-layer round trip."""
+
+import io
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import results
+from geomesa_tpu.arrow_io import SORT_KEY_META, read_feature_stream
+from geomesa_tpu.conf import prop_override
+from geomesa_tpu.device_cache import DeviceIndex
+from geomesa_tpu.filter.ecql import parse_instant
+from geomesa_tpu.process.binexport import decode_bin
+from geomesa_tpu.server import serve_background
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "track:Integer,name:String,dtg:Date,*geom:Point:srid=4326"
+CQL = "BBOX(geom, -5, -5, 5, 5)"
+
+
+def _seed_store(n=2000, seed=17):
+    ds = MemoryDataStore()
+    ds.create_schema("t", SPEC)
+    rng = np.random.default_rng(seed)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write("t", {
+        "track": rng.integers(0, 40, n),
+        "name": rng.choice(["alpha", "beta", "gamma"], n),
+        "dtg": t0 + rng.integers(0, 10**8, n),
+        "geom": np.stack(
+            [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+    return ds
+
+
+@pytest.fixture(scope="module")
+def resident_url():
+    ds = _seed_store()
+    server, _ = serve_background(ds, resident=True)
+    host, port = server.server_address[:2]
+    yield f"http://{host}:{port}", server
+    server.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _decode_stream(body):
+    return list(read_feature_stream(io.BytesIO(body)))
+
+
+def _concat(batches):
+    from geomesa_tpu.features.batch import FeatureBatch
+
+    return FeatureBatch.concat(batches)
+
+
+# -- content negotiation -----------------------------------------------------
+
+
+def test_negotiate_format_param_wins():
+    nf = results.negotiate_format
+    assert nf({"f": "arrow"}, "application/json") == "arrow"
+    assert nf({"f": "JSON"}) == "geojson"
+    assert nf({"f": "bin"}) == "bin"
+    with pytest.raises(ValueError):
+        nf({"f": "nope"})
+
+
+def test_negotiate_format_accept_header():
+    nf = results.negotiate_format
+    assert nf({}, "application/vnd.apache.arrow.stream") == "arrow"
+    assert nf({}, "text/html, application/vnd.geomesa.bin;q=0.9") == "bin"
+    assert nf({}, "application/geo+json") == "geojson"
+    assert nf({}, "*/*") == "geojson"
+    assert nf({}, None) == "geojson"
+    # q=0 is an explicit rejection: skip it, not select it
+    assert nf(
+        {},
+        "application/json;q=0, application/vnd.apache.arrow.stream",
+    ) == "arrow"
+    assert nf({}, "application/json;q=0.5") == "geojson"
+
+
+def test_unknown_format_is_400(resident_url):
+    url, _ = resident_url
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{url}/features/t?f=nope")
+    assert e.value.code == 400
+
+
+# -- streamed arrow serving --------------------------------------------------
+
+
+def test_arrow_stream_chunked_and_bit_identical(resident_url):
+    """f=arrow streams chunked IPC whose decode is bit-identical to the
+    resident row set AND row-set-identical to the GeoJSON response."""
+    url, server = resident_url
+    cql = urllib.parse.quote(CQL)
+    _, _, gj = _get(f"{url}/features/t?cql={cql}")
+    doc = json.loads(gj)
+    status, headers, body = _get(f"{url}/features/t?cql={cql}&f=arrow")
+    assert status == 200
+    assert headers.get("Transfer-Encoding") == "chunked"
+    assert headers.get("Content-Type") == \
+        "application/vnd.apache.arrow.stream"
+    got = _concat(_decode_stream(body))
+    # row-set parity with the GeoJSON response
+    assert [str(f) for f in got.fids] == [
+        f["id"] for f in doc["features"]
+    ]
+    # bit-identical columns vs the resident oracle
+    di = server.RequestHandlerClass._resident_cache["t"]
+    oracle = di.query(CQL)
+    assert len(got) == len(oracle) > 0
+    for name in oracle.sft.attribute_names:
+        a, b = got.column(name), oracle.column(name)
+        assert a.dtype == b.dtype
+        assert np.array_equal(a, b), name
+    # the Z-sorted resident path stamps its sort key (no host re-sort)
+    import pyarrow as pa
+
+    schema = pa.ipc.open_stream(io.BytesIO(body)).schema
+    assert schema.metadata.get(SORT_KEY_META) == b"z"
+
+
+def test_arrow_respects_max_features_cap(resident_url):
+    url, _ = resident_url
+    _, _, body = _get(f"{url}/features/t?f=arrow&maxFeatures=7")
+    assert sum(len(b) for b in _decode_stream(body)) == 7
+
+
+def test_arrow_empty_result(resident_url):
+    url, _ = resident_url
+    cql = urllib.parse.quote("BBOX(geom, 100, 80, 101, 81)")
+    _, _, body = _get(f"{url}/features/t?cql={cql}&f=arrow")
+    batches = _decode_stream(body)
+    assert sum(len(b) for b in batches) == 0
+    # the stream is still self-describing (schema header + EOS)
+    import pyarrow as pa
+
+    assert pa.ipc.open_stream(io.BytesIO(body)).schema is not None
+
+
+def test_arrow_store_rung_partition_stream(tmp_path):
+    """Non-resident fs serving streams one batch per partition through
+    the prefetch pipeline; the decoded union equals the store query."""
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    ds = FileSystemDataStore(str(tmp_path / "s"), partition_size=256)
+    ds.create_schema("t", SPEC)
+    seed = _seed_store()
+    ds.write("t", seed.query("t").batch)
+    ds.flush("t")
+    server, _ = serve_background(ds)
+    host, port = server.server_address[:2]
+    try:
+        cql = urllib.parse.quote(CQL)
+        _, headers, body = _get(
+            f"http://{host}:{port}/features/t?cql={cql}&f=arrow"
+        )
+        assert headers.get("Transfer-Encoding") == "chunked"
+        got = _concat(_decode_stream(body))
+        expect = ds.query("t", CQL).batch
+        assert sorted(str(f) for f in got.fids) == sorted(
+            str(f) for f in expect.fids
+        )
+    finally:
+        server.shutdown()
+
+
+# -- BIN serving -------------------------------------------------------------
+
+
+def test_bin_endpoint_matches_host_twin(resident_url):
+    url, server = resident_url
+    di = server.RequestHandlerClass._resident_cache["t"]
+    cql = urllib.parse.quote(CQL)
+    _, headers, body = _get(
+        f"{url}/features/t?cql={cql}&f=bin&track=track"
+    )
+    assert headers.get("Content-Type") == "application/vnd.geomesa.bin"
+    assert body == di.bin_export(CQL, "track")
+    assert len(decode_bin(body)) == len(di.query(CQL))
+    # 24-byte labeled records + dtg sort
+    _, _, body24 = _get(
+        f"{url}/features/t?cql={cql}&f=bin&track=track"
+        "&label=name&sortBin=1"
+    )
+    assert body24 == di.bin_export(
+        CQL, "track", label_attr="name", sort=True
+    )
+    rec = decode_bin(body24, labels=True)
+    assert (np.diff(rec["dtg"]) >= 0).all()
+
+
+def test_bin_missing_track_is_400(resident_url):
+    url, _ = resident_url
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{url}/features/t?f=bin")
+    assert e.value.code == 400
+
+
+def test_bin_device_rider_bit_identity():
+    """The fused device pack (count->cap->compact) is byte-identical to
+    the numpy host twin across filter shapes, loose mode, labels, sort
+    and the empty edge."""
+    ds = _seed_store(seed=23)
+    di = DeviceIndex(ds, "t", z_planes=True)
+    legs = [
+        dict(query="INCLUDE"),
+        dict(query=CQL),
+        dict(query=CQL, loose=True),
+        dict(query=CQL, sort=True),
+        dict(query=CQL, label_attr="name"),
+        dict(query=CQL, label_attr="name", sort=True),
+        dict(query="BBOX(geom, 100, 80, 101, 81)"),  # empty
+    ]
+    for leg in legs:
+        q = leg.pop("query")
+        twin = di.bin_export(q, "track", **leg)
+        with prop_override("results.bin.engine", "device"):
+            dev = results.resident_bin(di, q, "track", **leg)
+        assert dev == twin, leg
+
+
+def test_bin_engine_pin_refuses_inexpressible():
+    """A pinned device engine must refuse (not silently switch) when
+    the shape needs the host twin — here: non-integer-track is fine,
+    but a host-residual filter (attribute equality on a string) is not
+    device-expressible."""
+    ds = _seed_store(seed=29)
+    di = DeviceIndex(ds, "t", z_planes=True)
+    q = "name = 'alpha'"
+    with prop_override("results.bin.engine", "device"):
+        with pytest.raises(ValueError):
+            results.resident_bin(di, q, "track")
+    # auto falls to the twin silently
+    with prop_override("results.bin.engine", "auto"):
+        assert results.resident_bin(di, q, "track") == di.bin_export(
+            q, "track"
+        )
+
+
+# -- process endpoints through the plane -------------------------------------
+
+
+def test_knn_arrow_distance_column(resident_url):
+    """/knn f=arrow: the kNN distance is a REAL typed column whose
+    values match the GeoJSON per-feature properties."""
+    url, _ = resident_url
+    _, _, gj = _get(f"{url}/knn/t?x=0&y=0&k=5")
+    doc = json.loads(gj)
+    _, _, body = _get(f"{url}/knn/t?x=0&y=0&k=5&f=arrow")
+    got = _concat(_decode_stream(body))
+    assert "knn_distance_deg" in got.sft.attribute_names
+    assert got.column("knn_distance_deg").dtype == np.float64
+    assert [str(f) for f in got.fids] == [
+        f["id"] for f in doc["features"]
+    ]
+    np.testing.assert_allclose(
+        got.column("knn_distance_deg"),
+        [f["properties"]["knn_distance_deg"] for f in doc["features"]],
+    )
+
+
+def test_proximity_bin_records(resident_url):
+    url, _ = resident_url
+    pts = urllib.parse.quote("0,0;5,5")
+    _, _, body = _get(
+        f"{url}/proximity/t?points={pts}&distance=2"
+        "&f=bin&track=track"
+    )
+    _, _, gj = _get(f"{url}/proximity/t?points={pts}&distance=2")
+    assert len(decode_bin(body)) == len(json.loads(gj)["features"])
+
+
+# -- visibility --------------------------------------------------------------
+
+
+def test_visibility_masked_rows_hidden_in_arrow_and_bin():
+    from geomesa_tpu.features.batch import FeatureBatch
+
+    ds = MemoryDataStore()
+    ds.create_schema("sec", SPEC)
+    n = 300
+    rng = np.random.default_rng(31)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    batch = FeatureBatch.from_columns(
+        ds.get_schema("sec"),
+        {
+            "track": rng.integers(0, 9, n),
+            "name": rng.choice(["a", "b"], n),
+            "dtg": t0 + rng.integers(0, 10**8, n),
+            "geom": np.stack(
+                [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)],
+                axis=1,
+            ),
+        },
+        fids=np.arange(n),
+    ).with_visibility(rng.choice(["", "A"], n))
+    ds.write("sec", batch)
+    server, _ = serve_background(ds, resident=True)
+    host, port = server.server_address[:2]
+    url = f"http://{host}:{port}"
+    try:
+        vis = np.asarray(batch.visibilities)
+        public, all_rows = int((vis == "").sum()), n
+        for auths, expect in ((None, public), ("A", all_rows)):
+            sfx = f"&auths={auths}" if auths else ""
+            _, _, body = _get(f"{url}/features/sec?f=arrow{sfx}")
+            assert sum(len(b) for b in _decode_stream(body)) == expect
+            _, _, bn = _get(
+                f"{url}/features/sec?f=bin&track=track{sfx}"
+            )
+            assert len(decode_bin(bn)) == expect
+    finally:
+        server.shutdown()
+
+
+# -- streamed live layer -----------------------------------------------------
+
+
+def test_live_layer_merged_view_arrow_parity(tmp_path):
+    """Arrow round trip over the streamed live layer's MERGED
+    memtable+disk view: appended-but-uncompacted rows serve in the
+    stream, bit-identical to the GeoJSON row set."""
+    from geomesa_tpu.store.fs import FileSystemDataStore
+
+    ds = FileSystemDataStore(str(tmp_path / "s"), partition_size=128)
+    ds.create_schema("t", SPEC)
+    seed = _seed_store(n=400, seed=37)
+    ds.write("t", seed.query("t").batch)
+    ds.flush("t")
+    with prop_override("stream.memtable.rows", 1 << 20):
+        server, _ = serve_background(ds, resident=True, stream=True)
+        host, port = server.server_address[:2]
+        url = f"http://{host}:{port}"
+        try:
+            doc = {
+                "columns": {
+                    "track": [7, 7, 7],
+                    "name": ["live", "live", "live"],
+                    "dtg": [1000, 2000, 3000],
+                    "geom": [[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]],
+                },
+                "fids": [9001, 9002, 9003],
+            }
+            req = urllib.request.Request(
+                f"{url}/append/t", data=json.dumps(doc).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert json.loads(r.read())["acked"] == 3
+            _, _, gj = _get(f"{url}/features/t")
+            geo = json.loads(gj)
+            _, _, body = _get(f"{url}/features/t?f=arrow")
+            got = _concat(_decode_stream(body))
+            assert len(got) == len(geo["features"]) == 403
+            assert [str(f) for f in got.fids] == [
+                f["id"] for f in geo["features"]
+            ]
+            assert {"9001", "9002", "9003"} <= {
+                str(f) for f in got.fids
+            }
+            # columns bit-identical to the merged-view oracle
+            di = server.RequestHandlerClass._resident_cache["t"]
+            oracle = di.query("INCLUDE")
+            for name in oracle.sft.attribute_names:
+                assert np.array_equal(
+                    got.column(name), oracle.column(name)
+                ), name
+        finally:
+            server.shutdown()
+
+
+# -- delta dictionaries ------------------------------------------------------
+
+
+def test_delta_dictionary_growth_across_batches():
+    """Dictionaries grow monotonically across streamed chunks: later
+    record batches reference earlier entries by the SAME ids and carry
+    only the new vocabulary."""
+    import pyarrow as pa
+
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.features.sft import SimpleFeatureType
+
+    sft = SimpleFeatureType.create("d", "name:String,*geom:Point")
+    mk = lambda names, f0: FeatureBatch.from_columns(  # noqa: E731
+        sft,
+        {"name": names, "geom": np.zeros((len(names), 2))},
+        np.arange(f0, f0 + len(names)),
+    )
+    b1 = mk(["aa", "bb", "aa"], 0)
+    b2 = mk(["bb", "cc", "dd"], 3)
+    chunks = list(results.arrow_stream_chunks([b1, b2], chunk_rows=8))
+    data = b"".join(chunks)
+    rdr = pa.ipc.open_stream(io.BytesIO(data))
+    dicts = []
+    for rb in rdr:
+        col = rb.column(rb.schema.get_field_index("name"))
+        dicts.append(col.dictionary.to_pylist())
+    # batch 1 established [aa, bb]; batch 2 appended ONLY [cc, dd]
+    assert dicts[0] == ["aa", "bb"]
+    assert dicts[-1] == ["aa", "bb", "cc", "dd"]
+    got = _concat(_decode_stream(data))
+    assert list(got.column("name")) == [
+        "aa", "bb", "aa", "bb", "cc", "dd"
+    ]
+
+
+def test_oocscan_query_batches_feeds_the_encoders(tmp_path):
+    """StreamedDeviceScan.query_batches: per-slab hit batches equal the
+    materialized query() row set, and the generator feeds the shared
+    arrow encoder (the larger-than-HBM export recipe)."""
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.store.oocscan import StreamedDeviceScan
+
+    ds = FileSystemDataStore(str(tmp_path / "s"), partition_size=256)
+    ds.create_schema("t", SPEC)
+    ds.write("t", _seed_store(n=3000, seed=47).query("t").batch)
+    ds.flush("t")
+    scan = StreamedDeviceScan(ds, "t")
+    got = _concat(list(scan.query_batches(CQL)))
+    expect = scan.query(CQL)
+    assert len(got) == len(expect) > 0
+    assert sorted(str(f) for f in got.fids) == sorted(
+        str(f) for f in expect.fids
+    )
+    # the export recipe: stream the scan through the shared encoder
+    path = str(tmp_path / "out.arrow")
+    n = results.write_arrow_stream_file(
+        path, scan.query_batches(CQL), ds.get_schema("t")
+    )
+    assert n > 0
+    with open(path, "rb") as fh:
+        dec = _concat(_decode_stream(fh.read()))
+    assert sorted(str(f) for f in dec.fids) == sorted(
+        str(f) for f in expect.fids
+    )
+    # a filter the device cannot express falls to the store path
+    host_only = list(scan.query_batches("name = 'alpha'"))
+    oracle = ds.query("t", "name = 'alpha'").batch
+    assert sum(len(b) for b in host_only) == len(oracle)
+
+
+def test_capped_batches_trims_across_stream():
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.features.sft import SimpleFeatureType
+
+    sft = SimpleFeatureType.create("c", "v:Int,*geom:Point")
+    mk = lambda k, f0: FeatureBatch.from_columns(  # noqa: E731
+        sft, {"v": np.arange(k), "geom": np.zeros((k, 2))},
+        np.arange(f0, f0 + k),
+    )
+    out = list(results.capped_batches([mk(3, 0), mk(3, 3), mk(3, 6)], 4))
+    assert [len(b) for b in out] == [3, 1]
+    assert list(out[1].fids) == [3]
+    out = list(results.capped_batches([mk(3, 0)], None))
+    assert [len(b) for b in out] == [3]
+
+
+def test_with_extra_columns_rejects_collision_and_mismatch():
+    ds = _seed_store(n=10)
+    b = ds.query("t").batch
+    with pytest.raises(ValueError):
+        results.with_extra_columns(b, {"name": np.zeros(10)})
+    with pytest.raises(ValueError):
+        results.with_extra_columns(b, {"d": np.zeros(3)})
+    out = results.with_extra_columns(b, {"d": np.arange(10.0)})
+    assert out.column("d").dtype == np.float64
+
+
+# -- observability -----------------------------------------------------------
+
+
+def test_encode_write_span_split_and_ledger_fields(resident_url):
+    """One /features request produces SIBLING http.encode + http.write
+    spans (a slow client can no longer pollute encode attribution) and
+    charges encode_seconds / response_bytes to its shape aggregate."""
+    url, _ = resident_url
+    rid = "results-span-probe"
+    req = urllib.request.Request(f"{url}/features/t?f=arrow")
+    req.add_header("X-Request-Id", rid)
+    with urllib.request.urlopen(req, timeout=60) as r:
+        r.read()
+        assert r.headers.get("X-Request-Id") == rid
+    _, _, body = _get(f"{url}/debug/traces/{rid}")
+    doc = json.loads(body)
+
+    def names(span, acc):
+        acc.append(span["name"])
+        for c in span.get("children", ()):
+            names(c, acc)
+        return acc
+
+    spans = names(doc["spans"], [])
+    assert "http.encode" in spans and "http.write" in spans
+    _, _, led = _get(f"{url}/stats/ledger")
+    text = json.dumps(json.loads(led))
+    assert "encode_seconds" in text and "response_bytes" in text

@@ -3128,6 +3128,389 @@ def bench_stream_chaos(args) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+#: subprocess body for one replicated serving node: bind (with a short
+#: EADDRINUSE retry so a drained predecessor can finish closing), write
+#: the bound port via tmp+rename, serve until drained, free the port,
+#: exit 0 — the exact lifecycle ``fleet restart`` orchestrates
+_REPLICA_NODE_BODY = r"""
+import os, sys, time
+from geomesa_tpu.conf import set_prop
+from geomesa_tpu.replica import ReplicaConfig
+from geomesa_tpu.server import serve_background
+from geomesa_tpu.store.fs import FileSystemDataStore
+
+root, portfile, port, role, leader = sys.argv[1:6]
+lease_s, poll_ms, failover_s, peers = sys.argv[6:10]
+set_prop("replica.lease.s", float(lease_s))
+set_prop("replica.poll.ms", float(poll_ms))
+set_prop("replica.failover.s", float(failover_s))
+# the chaos smoke's zero-acked-row-loss assertion is only sound when
+# acks wait for a follower apply: with local acks a SIGKILLed leader
+# legally takes acked-but-unshipped rows down with it
+set_prop("replica.ack", "replica")
+set_prop("stream.memtable.rows", 1 << 20)
+deadline = time.monotonic() + 15
+while True:
+    try:
+        server, thread = serve_background(
+            FileSystemDataStore(root, partition_size=1 << 12),
+            port=int(port), stream=True,
+            replica=ReplicaConfig(
+                role=role, leader_url=leader,
+                peers=tuple(p for p in peers.split(",") if p),
+            ),
+        )
+        break
+    except OSError:
+        if time.monotonic() > deadline:
+            raise
+        time.sleep(0.2)  # predecessor still releasing the port
+with open(portfile + ".tmp", "w") as fh:
+    fh.write(str(server.server_address[1]))
+    fh.flush(); os.fsync(fh.fileno())
+os.replace(portfile + ".tmp", portfile)
+thread.join()  # returns when a drain (POST /admin/shutdown) completes
+server.server_close()  # a restarted successor needs the port
+os._exit(0)
+"""
+
+
+def bench_replica_chaos(args) -> dict:
+    """``--mode replica --chaos-smoke``: the replicated-tier chaos
+    smoke guarding the ISSUE 14 acceptance criteria. Legs:
+
+    1. **Leader SIGKILL under load.** Three node subprocesses (leader +
+       2 WAL-shipping followers) behind an in-process router; reader
+       threads and an appender run through the router while the leader
+       is SIGKILLed. Asserts ZERO failed reads across the whole window,
+       promotion within the conf-declared ``replica.failover.s`` bound,
+       and post-failover counts bit-identical across survivors and
+       exactly seed ∪ acked appends (modulo the one in-flight batch the
+       kill raced — the same ambiguity a crashed single node has).
+    2. **Rolling restart under load.** The killed node rejoins as a
+       follower, then ``fleet.rolling_restart`` cycles the whole group
+       while the load keeps running: zero failed reads, append shedding
+       bounded (every non-acked attempt is a 503 shed, never an error),
+       counts re-verified bit-identical after every step, and the new
+       leader's ``/stats/ledger`` snapshot recording the ship traffic.
+    """
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from geomesa_tpu import resilience
+    from geomesa_tpu.conf import prop_override
+    from geomesa_tpu.router import route_background
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.tools import fleet
+
+    resilience.reset()
+    LEASE_S, POLL_MS, FAILOVER_S = 1.5, 30.0, 10.0
+    tmp = tempfile.mkdtemp(prefix="geomesa-bench-replicachaos-")
+    rng = np.random.default_rng(7)
+    seed_n = 2048
+
+    def _get(url, path, timeout=30):
+        with urllib.request.urlopen(url + path, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def _append(url, fids):
+        n = len(fids)
+        doc = {
+            "columns": {
+                "val": list(range(n)),
+                "dtg": [1000 + i for i in range(n)],
+                "geom": [[10.0, 10.0]] * n,
+            },
+            "fids": list(fids),
+        }
+        req = urllib.request.Request(
+            url + "/append/gdelt", data=json.dumps(doc).encode(),
+            method="POST", headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs: dict = {}  # url -> Popen
+    ports: dict = {}  # url -> port
+
+    def spawn(root, port, role, leader_url, peers=""):
+        portfile = os.path.join(
+            tmp, f"port-{os.path.basename(root)}-{time.monotonic_ns()}"
+        )
+        p = subprocess.Popen(
+            [sys.executable, "-c", _REPLICA_NODE_BODY, root, portfile,
+             str(port), role, leader_url, str(LEASE_S), str(POLL_MS),
+             str(FAILOVER_S), peers],
+            env=env,
+        )
+        deadline = time.monotonic() + 120
+        while not os.path.exists(portfile):
+            assert p.poll() is None, f"node {root} died during startup"
+            assert time.monotonic() < deadline, f"node {root} never bound"
+            time.sleep(0.05)
+        bound = int(open(portfile).read())
+        url = f"http://127.0.0.1:{bound}"
+        procs[url] = p
+        ports[url] = bound
+        return url
+
+    try:
+        roots = {}
+        r0 = os.path.join(tmp, "n0")
+        ds = FileSystemDataStore(r0, partition_size=1 << 12)
+        ds.create_schema("gdelt", "val:Int,dtg:Date,*geom:Point:srid=4326")
+        ds.write("gdelt", {
+            "val": rng.integers(0, 100, seed_n),
+            "dtg": rng.integers(0, 10**9, seed_n),
+            "geom": np.stack([rng.uniform(-180, 180, seed_n),
+                              rng.uniform(-90, 90, seed_n)], axis=1),
+        }, fids=np.arange(seed_n))
+        ds.flush("gdelt")
+        del ds
+        for i in (1, 2):
+            shutil.copytree(r0, os.path.join(tmp, f"n{i}"))
+
+        # pre-allocate the three ports so every node can be told the
+        # FULL peer list up front — the election electorate (a follower
+        # with empty peers can only elect itself: split brain)
+        import socket as _socket
+
+        fixed_ports = []
+        socks = []
+        for _ in range(3):
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            fixed_ports.append(s.getsockname()[1])
+            socks.append(s)
+        for s in socks:
+            s.close()
+        node_urls = [f"http://127.0.0.1:{p}" for p in fixed_ports]
+        peers_arg = ",".join(node_urls)
+        lurl = spawn(r0, fixed_ports[0], "leader", "", peers_arg)
+        furls = [
+            spawn(os.path.join(tmp, f"n{i}"), fixed_ports[i], "follower",
+                  lurl, peers_arg)
+            for i in (1, 2)
+        ]
+        assert [lurl] + furls == node_urls
+        urls = [lurl] + furls
+        for u, root in zip(urls, (r0, os.path.join(tmp, "n1"),
+                                  os.path.join(tmp, "n2"))):
+            roots[u] = root
+
+        with prop_override("router.health.ms", 100.0):
+            rsrv, _ = route_background(urls)
+            rbase = "http://%s:%s" % rsrv.server_address[:2]
+            fleet.verify_converged(urls, timeout_s=60)
+            log(f"replica-chaos: 3-node group converged at {seed_n} rows; "
+                f"router {rbase}")
+
+            # -- concurrent load: readers + appender through the router
+            read_failures: list = []
+            reads = [0]
+            stop = threading.Event()
+
+            def reader():
+                while not stop.is_set():
+                    try:
+                        _get(rbase, "/count/gdelt", timeout=10)
+                        reads[0] += 1
+                    except Exception as e:
+                        read_failures.append(repr(e))
+                    time.sleep(0.01)
+
+            acked: set = set()
+            inflight: set = set()
+            sheds = [0]
+            append_errors: list = []
+            fid_next = [5_000_000]
+
+            def append_one(batch=16):
+                fids = list(range(fid_next[0], fid_next[0] + batch))
+                fid_next[0] += batch
+                inflight.update(fids)
+                try:
+                    out = _append(rbase, fids)
+                    if out.get("acked") and out.get("replicated", True):
+                        acked.update(fids)
+                        inflight.difference_update(fids)
+                    # acked but NOT replicated (follower lag at the ack
+                    # timeout): durable on the leader only — stays in
+                    # the ambiguous in-flight set, exactly like a batch
+                    # the kill raced
+                except urllib.error.HTTPError as e:
+                    try:
+                        body = e.read().decode("utf-8", "replace")
+                    except Exception:
+                        body = ""
+                    e.close()
+                    if e.code == 503:
+                        sheds[0] += 1  # bounded shed, not an error
+                        if "unknown" not in body:
+                            # plain shed: the router never forwarded it.
+                            # "outcome unknown" (transport died mid-send)
+                            # stays in-flight — the dying leader may have
+                            # made it durable and shipped it
+                            inflight.difference_update(fids)
+                    else:
+                        append_errors.append(e.code)
+                except Exception as e:
+                    append_errors.append(repr(e))
+
+            readers = [threading.Thread(target=reader) for _ in range(2)]
+            for t in readers:
+                t.start()
+            for _ in range(15):
+                append_one()
+                time.sleep(0.02)
+            assert len(acked) > 0, "no appends acked before the kill"
+
+            # -- leg 1: SIGKILL the leader under the running load ------
+            killer = threading.Timer(
+                0.005, lambda: procs[lurl].send_signal(signal.SIGKILL)
+            )
+            t_kill = time.monotonic()
+            killer.start()
+            append_one()  # races the kill: ack outcome may be unknown
+            procs[lurl].wait(60)
+            new_leader = fleet.wait_leader(furls, timeout_s=FAILOVER_S + 5)
+            promote_s = time.monotonic() - t_kill
+            assert promote_s <= FAILOVER_S, (
+                f"promotion took {promote_s:.2f}s, past the declared "
+                f"replica.failover.s={FAILOVER_S}"
+            )
+            # keep the load running across the promotion, then settle
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline:
+                append_one()
+                time.sleep(0.05)
+            stop.set()
+            for t in readers:
+                t.join(10)
+            assert read_failures == [], (
+                f"{len(read_failures)} failed reads during failover "
+                f"(first: {read_failures[0]})"
+            )
+            assert append_errors == [], (
+                f"append errors (not sheds) during failover: "
+                f"{append_errors[:5]}"
+            )
+            counts = fleet.verify_converged(furls, timeout_s=60)
+            feats = _get(
+                new_leader,
+                "/features/gdelt?cql=INCLUDE&maxFeatures=1000000",
+                timeout=60,
+            )
+            got = {int(f["id"]) for f in feats["features"]}
+            expected_floor = set(range(seed_n)) | acked
+            assert expected_floor <= got, (
+                f"lost {len(expected_floor - got)} acked rows"
+            )
+            assert got <= expected_floor | inflight, (
+                f"{len(got - expected_floor - inflight)} phantom rows"
+            )
+            assert counts["gdelt"] == len(got), "double-applied rows"
+            log(f"replica-chaos: SIGKILL leg ok (promotion {promote_s:.2f}s"
+                f" <= {FAILOVER_S}s, {reads[0]} reads 0 failed, "
+                f"{len(acked)} acked rows all served, {sheds[0]} sheds)")
+
+            # -- leg 2: rolling restart under the same load ------------
+            spawn_root = roots.pop(lurl)
+            del procs[lurl]
+
+            def restart(url, role, leader_url):
+                old = procs.pop(url, None)
+                if old is not None:
+                    old.wait(30)  # the drain exits the process
+                port = ports[url]
+                root = roots.get(url, spawn_root)
+                u2 = spawn(root, port, role, leader_url, peers_arg)
+                assert u2 == url, (u2, url)
+
+            # the killed ex-leader rejoins as a follower of its successor
+            rejoin = spawn(spawn_root, ports[lurl], "follower", new_leader,
+                           peers_arg)
+            assert rejoin == lurl
+            roots[lurl] = spawn_root
+            fleet.wait_ready(lurl, timeout_s=60)
+            fleet.wait_caught_up(lurl, timeout_s=60)
+            stop.clear()
+            read_failures.clear()
+            append_errors.clear()
+            sheds[0] = 0
+            readers = [threading.Thread(target=reader) for _ in range(2)]
+            for t in readers:
+                t.start()
+            appending = threading.Event()
+            appending.set()
+
+            def append_loop():
+                while appending.is_set():
+                    append_one(batch=4)
+                    time.sleep(0.05)
+
+            at = threading.Thread(target=append_loop)
+            at.start()
+            try:
+                report = fleet.rolling_restart(
+                    urls, restart, timeout_s=90.0, log=log,
+                )
+            finally:
+                appending.clear()
+                at.join(10)
+                stop.set()
+                for t in readers:
+                    t.join(10)
+            assert read_failures == [], (
+                f"{len(read_failures)} failed reads during the rolling "
+                f"restart (first: {read_failures[0]})"
+            )
+            assert append_errors == [], (
+                f"append errors (not sheds) during the rolling restart: "
+                f"{append_errors[:5]}"
+            )
+            final_leader = fleet.wait_leader(urls, timeout_s=30)
+            ledger_doc = _get(final_leader, "/stats/ledger", timeout=30)
+            assert "wal-ship" in json.dumps(ledger_doc), (
+                "leader ledger snapshot records no replication ship cost"
+            )
+            log(f"replica-chaos: rolling-restart leg ok "
+                f"({len(report['steps'])} cycles, counts "
+                f"{report['final_counts']}, {sheds[0]} bounded sheds, "
+                f"0 failed reads)")
+            rsrv.shutdown()
+            rsrv.server_close()
+        return {
+            "replica_chaos_seed_rows": seed_n,
+            "replica_chaos_promotion_s": round(promote_s, 3),
+            "replica_chaos_failover_bound_s": FAILOVER_S,
+            "replica_chaos_acked_rows": len(acked),
+            "replica_chaos_rows_served": len(got),
+            "replica_chaos_restart_steps": len(report["steps"]),
+            "replica_chaos_restart_wall_s": report["wall_s"],
+            "replica_chaos_sheds": sheds[0],
+            "replica_chaos_ok": True,
+        }
+    finally:
+        for p in procs.values():
+            try:
+                p.kill()
+                p.wait(10)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_trace_overhead(args) -> dict:
     """The --trace-overhead check: the serving leg with tracing at its
     DEFAULT sampling (trace.sample=1, slow capture on) must stay within
@@ -3641,7 +4024,7 @@ def main() -> None:
         choices=(
             "all", "filter", "zscan", "build", "polygon", "density", "sweep",
             "xzbuild", "meshbuild", "multichip", "pipeline", "oocscan",
-            "join", "serve", "flush", "stream", "results",
+            "join", "serve", "flush", "stream", "results", "replica",
         ),
         default="all",
         help="all: every benchmark, one JSON line with everything (what "
@@ -3694,6 +4077,10 @@ def main() -> None:
             out = bench_stream_chaos(args)
         else:
             out = bench_stream(args)
+    elif args.mode == "replica":
+        # the replicated tier only has a chaos leg; --chaos-smoke is
+        # how CI invokes it, but the bare mode runs the same thing
+        out = bench_replica_chaos(args)
     else:
         # zscan FIRST: its DeviceIndex staging is a long sequence of
         # host->device transfers that measures 20-30x slower when another

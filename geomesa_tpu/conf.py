@@ -213,6 +213,43 @@ Properties:
                                 mesh.sort.engine precedent — fused
                                 device pack otherwise), ``device`` or
                                 ``host``
+- ``http.keepalive.s``          idle socket timeout for persistent
+                                HTTP/1.1 connections, both server-side
+                                (server.py handler read timeout) and on
+                                router->backend pooled connections --
+                                the PR 12 hard-coded 60s, now tunable
+- ``replica.poll.ms``           follower tail-loop pause between ship
+                                cycles (the long-poll ``waitMs`` on
+                                ``GET /wal/<type>`` covers latency;
+                                this bounds the idle re-dial rate)
+- ``replica.wait.ms``           long-poll budget a leader holds an
+                                empty ``/wal/<type>`` ship open waiting
+                                for new records before answering
+- ``replica.lease.s``           leader lease: a follower that cannot
+                                reach its leader for this long declares
+                                it dead and starts an election
+- ``replica.failover.s``        the declared promotion bound: failover
+                                (detect -> elect -> promote) must
+                                complete within it; exceeding it stamps
+                                degraded and logs loudly
+- ``replica.ack``               append acknowledgement mode: ``local``
+                                (leader WAL durability only -- the
+                                PR 10 contract) or ``replica`` (the 200
+                                also waits until at least one follower
+                                has applied the record's seq)
+- ``replica.ack.timeout.s``     max wall-clock an append holds its
+                                response open for a follower ack in
+                                ``replica.ack=replica`` mode; past it
+                                the row is acked local-only and
+                                ``replica-lag`` is stamped degraded
+- ``router.retries``            read retries across DISTINCT replicas
+                                beyond the first backend the router
+                                tries (router.py)
+- ``router.health.ms``          router health-poll cadence: each
+                                backend's ``/readyz`` and
+                                ``/stats/replica`` are probed this
+                                often to drive routing, breaker probes
+                                and leader discovery
 """
 
 from __future__ import annotations
@@ -264,6 +301,15 @@ def _parse_results_bin_engine(v) -> str:
     if s not in ("auto", "device", "host"):
         raise ValueError(
             f"results.bin.engine must be auto, device or host, not {v!r}"
+        )
+    return s
+
+
+def _parse_replica_ack(v) -> str:
+    s = str(v).strip().lower()
+    if s not in ("local", "replica"):
+        raise ValueError(
+            f"replica.ack must be local or replica, not {v!r}"
         )
     return s
 
@@ -405,6 +451,19 @@ _DEFS = {
     # bulk exports) and the BIN track-record encoder engine selector
     "results.batch.rows": (8192, int),
     "results.bin.engine": ("auto", _parse_results_bin_engine),
+    # replicated serving tier (replica.py + router.py): persistent-
+    # connection idle timeout, follower tail cadence + leader long-poll
+    # budget, the leader lease / declared failover bound, the append
+    # acknowledgement mode, and the router's retry/health knobs
+    "http.keepalive.s": (60.0, float),
+    "replica.poll.ms": (50.0, float),
+    "replica.wait.ms": (1000.0, float),
+    "replica.lease.s": (3.0, float),
+    "replica.failover.s": (10.0, float),
+    "replica.ack": ("local", _parse_replica_ack),
+    "replica.ack.timeout.s": (2.0, float),
+    "router.retries": (2, int),
+    "router.health.ms": (250.0, float),
 }
 
 _overrides: dict = {}

@@ -57,6 +57,20 @@ layer's crash kill matrix, ISSUE 10):
                                    (replay must skip them via the
                                    manifest watermark, not re-apply)
 
+Replication points (replica.py — the failover kill matrix, ISSUE 14):
+
+- ``fail.replica.apply``        -- a follower is about to apply one
+                                   shipped WAL record (after checksum
+                                   verification, before the local
+                                   append_at); ``kill`` here must lose
+                                   nothing — the leader still holds the
+                                   record and the next tail re-ships it
+- ``fail.replica.promote``      -- a follower won its election and is
+                                   about to adopt the leader role;
+                                   promotion must survive (or another
+                                   replica must take over from) a fault
+                                   injected here
+
 Activation: programmatic (``set_failpoint``/``failpoint_override``) or
 the ``GEOMESA_TPU_FAILPOINTS`` environment variable, a comma-separated
 ``name=action`` list — the env form is how a chaos test arms a point in
@@ -108,6 +122,8 @@ POINTS = (
     "fail.wal.rotate",
     "fail.wal.replay",
     "fail.compact.publish",
+    "fail.replica.apply",
+    "fail.replica.promote",
 )
 
 

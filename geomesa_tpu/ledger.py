@@ -103,6 +103,8 @@ FIELDS = (
     "join_pairs",        # pairs this request's spatial joins emitted
     "encode_seconds",    # wire-format serialization time (http.encode)
     "response_bytes",    # response body bytes written to the socket
+    "replica_ship_bytes",  # WAL record bytes shipped to followers
+    "replica_apply_rows",  # rows applied from a leader's shipped WAL
 )
 
 #: fields folded with max() instead of sum() (a request's fusion width

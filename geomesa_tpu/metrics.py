@@ -661,3 +661,56 @@ results_bin_device_launches = REGISTRY.counter(
     "geomesa_results_bin_device_launches_total",
     "fused device BIN pack launches (count->cap->compact pairs count one)",
 )
+
+# replicated serving tier (replica.py + router.py): WAL shipping volume,
+# follower apply/lag, failover accounting and the router front tier's
+# per-backend routing outcomes
+replica_ship_bytes = REGISTRY.counter(
+    "geomesa_replica_ship_bytes_total",
+    "WAL record bytes a leader shipped to followers over /wal/<type>",
+)
+replica_ship_records = REGISTRY.counter(
+    "geomesa_replica_ship_records_total",
+    "WAL records a leader shipped to followers",
+)
+replica_apply_records = REGISTRY.counter(
+    "geomesa_replica_apply_records_total",
+    "shipped WAL records a follower applied into its live layer",
+)
+replica_apply_skipped = REGISTRY.counter(
+    "geomesa_replica_apply_skipped_total",
+    "shipped records skipped as already durable here (idempotent replay)",
+)
+replica_lag_records = REGISTRY.gauge(
+    "geomesa_replica_lag_records",
+    "records the leader holds that this follower has not applied yet "
+    "(summed across types)",
+)
+replica_failovers = REGISTRY.counter(
+    "geomesa_replica_failovers_total",
+    "promotions this process performed after a leader-lease expiry",
+)
+replica_failover_seconds = REGISTRY.histogram(
+    "geomesa_replica_failover_seconds",
+    "lease-expiry-to-leader-role promotion time per failover",
+)
+replica_role = REGISTRY.gauge(
+    "geomesa_replica_role",
+    "replication role of this process (0=follower, 1=promoting, 2=leader)",
+)
+router_requests = REGISTRY.counter(
+    "geomesa_router_requests_total",
+    "requests the router front tier completed",
+)
+router_retries = REGISTRY.counter(
+    "geomesa_router_retries_total",
+    "reads re-tried on another replica after a backend failure",
+)
+router_sheds = REGISTRY.counter(
+    "geomesa_router_sheds_total",
+    "appends shed 503+Retry-After because no leader is known (promotion)",
+)
+router_backend_errors = REGISTRY.counter(
+    "geomesa_router_backend_errors_total",
+    "backend attempts that failed (connection error or 5xx)",
+)

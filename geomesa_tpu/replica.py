@@ -1,0 +1,518 @@
+"""Replicated serving tier: WAL-shipping followers + bounded failover.
+
+Ref role: the distributed tier a single-process store grows once one
+SIGKILL must not take every type offline (ROADMAP item 4; GeoMesa's
+Accumulo/HBase tablet replication and the Kafka live-layer consumer
+group it fronts [UNVERIFIED - empty reference mount]). The PR 10 WAL is
+already a replication log — checksummed, segmented, seq-ordered, with
+an idempotent ≤-watermark replay contract — so replication is shipping
+it, not inventing a new protocol:
+
+- **Shipping** — a leader serves ``GET /wal/<type>?from=<seq>`` as a
+  chunked stream of records in the ON-DISK framing
+  (:func:`~geomesa_tpu.store.wal.pack_record`); any replica can serve
+  it (the cursor is readonly), which is what lets an election loser
+  re-point at the winner before the winner has even finished promoting.
+- **Applying** — a follower tails its leader and lands each record via
+  :meth:`~geomesa_tpu.store.stream.StreamingStore.apply_replicated`:
+  the record keeps the LEADER's seq (``append_at``), so watermarks,
+  replay and promotion are watermark-exact across the group, and every
+  re-ship (crash, torn tail, overlap) is an idempotent skip.
+- **Failover** — leader death is a lease timeout (no successful ship
+  contact for ``replica.lease.s``). The follower then runs a
+  most-caught-up election over ``/stats/replica`` (total applied seq,
+  URL tie-break — deterministic, every voter computes the same winner),
+  and the winner promotes: seal the tail (stop fetching), adopt the
+  leader role, stamp ``replica-failover`` in the flight recorder. By
+  the PR 10 invariants the local WAL position IS the truth, so
+  promotion loses zero acked rows and needs zero renumbering. The
+  whole detect→elect→promote path is measured against the declared
+  ``replica.failover.s`` bound.
+- **Acks** — ``replica.ack=replica`` upgrades the append contract:
+  the leader's 200 also waits (bounded by ``replica.ack.timeout.s``)
+  until a follower has applied the record's seq; a timeout answers
+  local-only and stamps ``replica-lag`` degraded.
+
+The ``fail.replica.apply`` / ``fail.replica.promote`` failpoints
+bracket the two replication-specific instants for the kill matrix in
+tests/test_replica.py.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+
+from geomesa_tpu.locking import checked_lock
+from geomesa_tpu.store.wal import RecordParser, WalCorruption
+
+__all__ = ["ReplicaConfig", "Replicator", "ROLES"]
+
+#: bounded role enum (metric value + /stats/replica field)
+ROLES = ("follower", "promoting", "leader")
+
+_ROLE_GAUGE = {"follower": 0, "promoting": 1, "leader": 2}
+
+
+@dataclass
+class ReplicaConfig:
+    """Static replication topology for one process.
+
+    ``peers`` lists EVERY replica's base URL (this process included) —
+    the election electorate and the router's discovery set. A follower
+    with an empty ``leader_url`` discovers its leader by probing peers
+    for the one reporting ``role == leader`` (how a respawned
+    ex-leader rejoins after a failover moved the role)."""
+
+    role: str = "leader"
+    self_url: str = ""
+    leader_url: str = ""
+    peers: "tuple[str, ...]" = field(default_factory=tuple)
+    #: override the ``replica.ack`` system property for this process
+    ack: "str | None" = None
+
+    def __post_init__(self):
+        if self.role not in ("leader", "follower"):
+            raise ValueError(
+                f"replica role must be leader or follower, not "
+                f"{self.role!r}"
+            )
+
+
+def _http_json(url: str, timeout: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+class Replicator:
+    """One process's replication agent.
+
+    Leader side: tracks each follower's applied position (reported on
+    every ship fetch) for ``replica.ack=replica`` append gating.
+    Follower side: the tail loop — ship, apply, lease, elect, promote.
+    Attached to the serving stack by ``make_server(replica=...)``; the
+    HTTP server exposes its :meth:`stats` as ``/stats/replica`` and
+    consults :meth:`is_leader` on every append."""
+
+    def __init__(self, config: ReplicaConfig, stream=None):
+        self.cfg = config
+        self.stream = stream  # StreamingStore; bound via attach()
+        self._lock = checked_lock("replica.state")
+        self._role = config.role
+        self._leader_url = (
+            config.self_url if config.role == "leader"
+            else config.leader_url
+        )
+        #: leader side: follower_url -> {type: applied_seq}; notified
+        #: on every ship fetch for await_replicated
+        self._followers: "dict[str, dict]" = {}
+        self._follower_seen: "dict[str, float]" = {}
+        self._ack_cv = threading.Condition()
+        #: follower side: per-type leader position from ship headers
+        self._leader_next: "dict[str, int]" = {}
+        self._needs_reprovision: "set[str]" = set()
+        self._last_ok = time.monotonic()
+        self._lease_expired_at = 0.0
+        self.failovers = 0
+        self.last_failover_s = -1.0
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def attach(self, stream) -> None:
+        self.stream = stream
+
+    def start(self) -> None:
+        from geomesa_tpu import metrics
+
+        metrics.replica_role.set(_ROLE_GAUGE[self._role])
+        if self._role == "follower":
+            self._thread = threading.Thread(
+                target=self._tail_loop, daemon=True, name="replica-tail"
+            )
+            self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+
+    # -- role ---------------------------------------------------------------
+
+    @property
+    def role(self) -> str:
+        return self._role
+
+    def is_leader(self) -> bool:
+        return self._role == "leader"
+
+    @property
+    def leader_url(self) -> str:
+        return self._leader_url
+
+    def ack_mode(self) -> str:
+        if self.cfg.ack is not None:
+            return self.cfg.ack
+        from geomesa_tpu.conf import sys_prop
+
+        return str(sys_prop("replica.ack"))
+
+    # -- leader side: follower accounting + append gating --------------------
+
+    def note_follower(self, url: str, type_name: str, applied_seq: int) -> None:
+        """A follower's ship fetch reported it holds everything up to
+        ``applied_seq`` for ``type_name`` (its ``from`` minus one)."""
+        if not url:
+            return
+        with self._ack_cv:
+            pos = self._followers.setdefault(url, {})
+            if applied_seq > pos.get(type_name, -1):
+                pos[type_name] = applied_seq
+            self._follower_seen[url] = time.monotonic()
+            self._ack_cv.notify_all()
+
+    def await_replicated(self, type_name: str, seq: int,
+                         timeout_s: float) -> bool:
+        """Block until at least one follower has applied ``seq`` for
+        ``type_name`` (it fetched with ``from > seq``), or the timeout
+        lapses. The ``replica.ack=replica`` append gate."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+
+        def _replicated() -> bool:
+            return any(
+                pos.get(type_name, -1) >= seq
+                for pos in self._followers.values()
+            )
+
+        with self._ack_cv:
+            while not _replicated():
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._ack_cv.wait(timeout=min(left, 0.25))
+            return True
+
+    # -- follower side: tail / lease / election -----------------------------
+
+    def _lease_s(self) -> float:
+        from geomesa_tpu.conf import sys_prop
+
+        return max(float(sys_prop("replica.lease.s")), 0.1)
+
+    def _tail_loop(self) -> None:
+        import logging
+
+        from geomesa_tpu import ledger, metrics
+        from geomesa_tpu.conf import sys_prop
+
+        log = logging.getLogger(__name__)
+        while not self._stop.is_set() and self._role == "follower":
+            poll_s = max(float(sys_prop("replica.poll.ms")), 1.0) / 1e3
+            if not self._leader_url:
+                if self._discover_leader() is None:
+                    # nobody claims the role yet; keep probing, and
+                    # elect once the lease runs out with no leader
+                    if (time.monotonic() - self._last_ok
+                            > self._lease_s()):
+                        self._failover()
+                    self._stop.wait(poll_s)
+                    continue
+            progressed = False
+            contacted = False
+            cost = ledger.RequestCost(
+                tenant="_system", endpoint="other", lane="ingest",
+                shape="replica-apply",
+            )
+            for t in list(self.stream.store.type_names):
+                if self._stop.is_set() or self._role != "follower":
+                    break
+                try:
+                    with ledger.attach_cost(cost):
+                        n = self._fetch_type(t)
+                    contacted = True
+                    progressed = progressed or n > 0
+                except WalCorruption as e:
+                    # transport or leader damage: drop the connection
+                    # and re-ship from our durable position — every
+                    # record we DID apply was checksum-verified
+                    contacted = True
+                    log.warning(
+                        "replica: corrupt ship stream for %r (%s); "
+                        "re-tailing from the local WAL position", t, e,
+                    )
+                except Exception:
+                    pass  # connection-level failure: the lease decides
+            if cost.fields and ledger.enabled():
+                cost.status = 200
+                ledger.LEDGER.record(cost)
+            now = time.monotonic()
+            if contacted:
+                self._last_ok = now
+            elif now - self._last_ok > self._lease_s():
+                self._failover()
+            self._publish_lag(metrics)
+            if not progressed:
+                self._stop.wait(poll_s)
+
+    def _fetch_type(self, type_name: str) -> int:
+        """One ship fetch for one type: long-poll the leader from our
+        durable WAL position, verify + apply every shipped record.
+        Returns records applied. Raises on connection-level failure
+        (the caller's lease accounting)."""
+        from geomesa_tpu.conf import sys_prop
+
+        ts = self.stream._ts(type_name)
+        frm = int(ts.wal.next_seq)
+        wait_ms = max(float(sys_prop("replica.wait.ms")), 0.0)
+        url = (
+            f"{self._leader_url}/wal/"
+            f"{urllib.parse.quote(type_name)}?from={frm}"
+            f"&waitMs={wait_ms:g}"
+            f"&follower={urllib.parse.quote(self.cfg.self_url or '')}"
+        )
+        timeout = self._lease_s() + wait_ms / 1e3 + 5.0
+        try:
+            resp = urllib.request.urlopen(url, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            if e.code == 410:
+                # the leader compacted past our position AND we are
+                # below its watermark: tailing cannot catch us up — an
+                # operator must re-provision this replica from a
+                # snapshot. Surfaced on /stats/replica; the leader is
+                # alive (it answered), so the lease holds.
+                self._needs_reprovision.add(type_name)
+                e.close()
+                return 0
+            if e.code == 404:
+                e.close()  # type not on the leader (yet): not fatal
+                return 0
+            raise
+        applied = 0
+        with resp:
+            parser = RecordParser()
+            while True:
+                chunk = resp.read(1 << 16)
+                if not chunk:
+                    break
+                for seq, payload in parser.feed(chunk):
+                    self.stream.apply_replicated(type_name, seq, payload)
+                    applied += 1
+            if parser.pending_bytes:
+                raise WalCorruption(
+                    f"ship stream for {type_name!r} ended mid-record "
+                    f"({parser.pending_bytes} bytes dangling)"
+                )
+            nxt = resp.headers.get("X-Wal-Next-Seq")
+            if nxt is not None:
+                self._leader_next[type_name] = int(nxt)
+        self._needs_reprovision.discard(type_name)
+        return applied
+
+    def _publish_lag(self, metrics) -> None:
+        lag = 0
+        for t, leader_next in list(self._leader_next.items()):
+            try:
+                local = int(self.stream._ts(t).wal.next_seq)
+            except KeyError:
+                continue
+            lag += max(leader_next - local, 0)
+        metrics.replica_lag_records.set(lag)
+
+    def lag_records(self) -> int:
+        """Total records the leader holds that this replica has not
+        applied (0 when caught up, and always 0 on a leader)."""
+        if self._role == "leader":
+            return 0
+        lag = 0
+        for t, leader_next in list(self._leader_next.items()):
+            try:
+                local = int(self.stream._ts(t).wal.next_seq)
+            except KeyError:
+                continue
+            lag += max(leader_next - local, 0)
+        return lag
+
+    def applied_total(self) -> int:
+        """Sum of WAL positions across types — the election's
+        most-caught-up comparison (seqs are leader-assigned, so totals
+        are comparable across the group)."""
+        if self.stream is None:
+            return 0
+        return sum(
+            p["next_seq"]
+            for p in self.stream.replica_positions().values()
+        )
+
+    def _peer_stats(self, peer: str, timeout: float) -> "dict | None":
+        try:
+            return _http_json(peer + "/stats/replica", timeout)
+        except Exception:
+            return None
+
+    def _discover_leader(self) -> "str | None":
+        """Probe peers for whichever one currently holds the leader
+        role (rejoin after failover / initial empty ``leader_url``)."""
+        for peer in self.cfg.peers:
+            if peer == self.cfg.self_url:
+                continue
+            doc = self._peer_stats(peer, timeout=1.0)
+            if doc and doc.get("role") == "leader":
+                self._leader_url = peer
+                self._last_ok = time.monotonic()
+                return peer
+        return None
+
+    def _failover(self) -> None:
+        """Lease expired: elect the most-caught-up replica and either
+        promote (we won) or re-point at the winner (it serves our ship
+        fetches immediately — the cursor is readonly — and adopts the
+        role within the failover bound)."""
+        import logging
+
+        log = logging.getLogger(__name__)
+        self._lease_expired_at = self._lease_expired_at or time.monotonic()
+        dead = self._leader_url
+        best = (self.applied_total(), self.cfg.self_url or "")
+        for peer in self.cfg.peers:
+            if peer in (self.cfg.self_url, dead) or not peer:
+                continue
+            doc = self._peer_stats(peer, timeout=1.0)
+            if doc is None:
+                continue
+            if doc.get("role") in ("leader", "promoting"):
+                # somebody already took (or is taking) the role
+                log.info("replica: leader moved to %s; re-tailing", peer)
+                self._leader_url = peer
+                self._last_ok = time.monotonic()
+                self._lease_expired_at = 0.0
+                return
+            best = max(best, (int(doc.get("applied_total", -1)), peer))
+        if best[1] and best[1] != self.cfg.self_url:
+            log.info(
+                "replica: election winner is %s (applied_total=%d); "
+                "re-tailing from it", best[1], best[0],
+            )
+            self._leader_url = best[1]
+            self._last_ok = time.monotonic()
+            self._lease_expired_at = 0.0
+            return
+        self._promote(dead)
+
+    def _promote(self, dead_leader: str) -> None:
+        """Adopt the leader role: seal the tail (this thread stops
+        fetching), flip the role, stamp the flight recorder. The local
+        WAL position is the truth — watermark-exact, zero acked-row
+        loss by the PR 10 replay invariants — so there is nothing to
+        rewrite, only a role to claim."""
+        import logging
+
+        from geomesa_tpu import metrics, resilience
+        from geomesa_tpu.conf import sys_prop
+        from geomesa_tpu.failpoints import fail_point
+
+        log = logging.getLogger(__name__)
+        with self._lock:
+            if self._role == "leader":
+                return
+            self._role = "promoting"
+        metrics.replica_role.set(_ROLE_GAUGE["promoting"])
+        try:
+            fail_point("fail.replica.promote")
+        except Exception as e:
+            # a transient promotion fault rolls back to follower; the
+            # still-expired lease re-enters the election on the next
+            # tail cycle (or another replica takes the role first)
+            log.warning(
+                "replica: promotion fault (%s: %s); retrying via the "
+                "election", type(e).__name__, e,
+            )
+            with self._lock:
+                self._role = "follower"
+            metrics.replica_role.set(_ROLE_GAUGE["follower"])
+            return
+        with self._lock:
+            self._role = "leader"
+            self._leader_url = self.cfg.self_url or ""
+        metrics.replica_role.set(_ROLE_GAUGE["leader"])
+        dur = time.monotonic() - (
+            self._lease_expired_at or time.monotonic()
+        )
+        self._lease_expired_at = 0.0
+        self.failovers += 1
+        self.last_failover_s = dur
+        metrics.replica_failovers.inc()
+        metrics.replica_failover_seconds.observe(dur)
+        bound = float(sys_prop("replica.failover.s"))
+        if bound > 0 and dur > bound:
+            resilience.note_degraded("replica-degraded")
+            log.warning(
+                "replica: failover took %.3fs, past the declared "
+                "replica.failover.s bound (%.3fs)", dur, bound,
+            )
+        log.warning(
+            "replica: promoted to leader (dead leader %s, %.3fs after "
+            "lease expiry); appends accepted here now", dead_leader, dur,
+        )
+        try:
+            from geomesa_tpu import slo
+
+            slo.FLIGHTREC.trigger("replica-failover", detail={
+                "dead_leader": dead_leader,
+                "self": self.cfg.self_url,
+                "failover_seconds": round(dur, 3),
+                "bound_seconds": bound,
+                "applied_total": self.applied_total(),
+            })
+        except Exception:  # pragma: no cover - observability must not break
+            pass
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``/stats/replica`` document."""
+        types = {}
+        if self.stream is not None:
+            for t, pos in self.stream.replica_positions().items():
+                leader_next = self._leader_next.get(t)
+                d = dict(pos)
+                if self._role != "leader" and leader_next is not None:
+                    d["leader_next_seq"] = int(leader_next)
+                    d["lag"] = max(int(leader_next) - d["next_seq"], 0)
+                if t in self._needs_reprovision:
+                    d["needs_reprovision"] = True
+                types[t] = d
+        with self._ack_cv:
+            followers = {
+                url: {
+                    "applied": dict(pos),
+                    "seen_age_s": round(
+                        time.monotonic()
+                        - self._follower_seen.get(url, 0.0), 3
+                    ),
+                }
+                for url, pos in self._followers.items()
+            }
+        return {
+            "enabled": True,
+            "role": self._role,
+            "self": self.cfg.self_url,
+            "leader": self._leader_url,
+            "peers": list(self.cfg.peers),
+            "ack": self.ack_mode(),
+            "applied_total": self.applied_total(),
+            "lag_records": self.lag_records(),
+            "types": types,
+            "followers": followers,
+            "failovers": self.failovers,
+            "last_failover_seconds": round(self.last_failover_s, 3),
+            "leader_ok_age_s": round(
+                time.monotonic() - self._last_ok, 3
+            ),
+        }

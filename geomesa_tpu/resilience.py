@@ -500,6 +500,8 @@ REASONS = frozenset(
         "mesh-degraded",
         "ingest-degraded",
         "wal-replay-truncated",
+        "replica-lag",
+        "replica-degraded",
     }
 )
 

@@ -1,0 +1,485 @@
+"""Health-routed HTTP front tier for a replication group (ISSUE 14).
+
+One small stdlib reverse proxy in front of N replicas of a serving
+group (a leader + its WAL-shipping followers, see
+:mod:`geomesa_tpu.replica`):
+
+- **Reads** (every GET) fan across READY backends round-robin, behind a
+  per-backend circuit breaker (:class:`~geomesa_tpu.resilience
+  .CircuitBreaker`, the PR 7 state machine re-used verbatim): a
+  connection failure or 5xx records a breaker failure and the read is
+  retried on the NEXT replica — up to ``router.retries`` retries — so a
+  SIGKILL'd leader costs in-flight reads one retry, not an error storm.
+- **Appends** (POST ``/append/<type>``) pin to the backend whose
+  ``/readyz`` reports ``replica_role == "leader"`` — followers would
+  503 them anyway (the seq space must not fork). While no leader is
+  known (mid-promotion, every candidate still a follower) the router
+  sheds the append itself: 503 + ``Retry-After``, counted on
+  ``geomesa_router_sheds_total`` — bounded shedding, not a hang and not
+  a misroute.
+- **Health** comes from a background poll of every backend's
+  ``/readyz`` each ``router.health.ms``: ``ready``/``draining`` gate
+  read routing (a draining backend finishes in-flight work but takes
+  nothing new — exactly the rolling-restart window), ``replica_role``
+  drives append pinning. A backend whose probe cannot connect is DOWN
+  until a probe succeeds; its breaker keeps request-path attempts
+  bounded in between.
+
+The router itself exposes ``/healthz`` (liveness), ``/readyz`` (ready
+iff ANY backend is ready), ``/metrics`` (this process's registry —
+``geomesa_router_*``) and ``/stats/router`` (per-backend health, role,
+breaker state, consecutive probe failures). Everything else proxies.
+
+Deliberately stdlib-only and state-light: the group's consistency
+story lives in the replication tier (watermark-exact promotion, replay
+idempotence); the router only needs liveness + role, so losing the
+router loses NO data — restart it anywhere with the same backend list.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["Router", "make_router", "route_background"]
+
+#: request headers forwarded to the backend (everything else is
+#: hop-local: connection management stays per-hop)
+_FWD_REQ_HEADERS = (
+    "Content-Type", "Accept", "X-Request-Id", "Authorization",
+)
+#: response headers forwarded back to the client
+_FWD_RESP_HEADERS = (
+    "Content-Type", "X-Request-Id", "X-Degraded", "Retry-After",
+    "X-Wal-Next-Seq", "X-Wal-Watermark", "X-Replica-Role",
+)
+
+
+class _Backend:
+    """One replica's routing state: health from the poll loop, a
+    dedicated circuit breaker for request-path attempts. The breaker is
+    a direct instance (NOT the ``breaker()`` singleton registry — URLs
+    are unbounded; the metric label stays the bounded domain
+    ``"router"``)."""
+
+    def __init__(self, url: str):
+        from geomesa_tpu.resilience import CircuitBreaker
+
+        self.url = url.rstrip("/")
+        u = urllib.parse.urlsplit(self.url)
+        if not u.hostname or not u.port:
+            raise ValueError(
+                f"backend {url!r} needs an explicit host:port"
+            )
+        self.host = u.hostname
+        self.port = int(u.port)
+        self.breaker = CircuitBreaker(f"router:{self.url}", domain="router")
+        self.ready = False
+        self.draining = False
+        self.reachable = False
+        self.role = ""
+        self.probe_failures = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "url": self.url,
+            "ready": self.ready,
+            "draining": self.draining,
+            "reachable": self.reachable,
+            "role": self.role,
+            "probe_failures": self.probe_failures,
+            "breaker": self.breaker.snapshot(),
+        }
+
+
+class Router:
+    """Routing state + the background health poll. Shared by every
+    handler thread of the front-tier HTTP server."""
+
+    def __init__(self, backends: "list[str]"):
+        from geomesa_tpu.locking import checked_lock
+
+        if not backends:
+            raise ValueError("router needs at least one backend url")
+        self.backends = [_Backend(u) for u in backends]
+        self._lock = checked_lock("router.state")
+        self._rr = 0
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._tls = threading.local()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._probe_all()  # synchronous first pass: route from request 1
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="router-health", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # -- health --------------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        from geomesa_tpu.conf import sys_prop
+
+        while not self._stop.is_set():
+            self._stop.wait(float(sys_prop("router.health.ms")) / 1e3)
+            if self._stop.is_set():
+                return
+            self._probe_all()
+
+    def _probe_all(self) -> None:
+        for b in self.backends:
+            self._probe(b)
+
+    def _probe(self, b: _Backend) -> None:
+        from geomesa_tpu.conf import sys_prop
+
+        timeout = max(float(sys_prop("router.health.ms")) / 1e3, 0.25)
+        doc: dict = {}
+        try:
+            with urllib.request.urlopen(
+                b.url + "/readyz", timeout=timeout
+            ) as r:
+                doc = json.loads(r.read())
+            reachable = True
+        except urllib.error.HTTPError as e:
+            # 503 = reachable-but-draining; the body still carries the
+            # readiness doc (role included) — a draining leader keeps
+            # its identity until its successor takes over
+            try:
+                doc = json.loads(e.read())
+            except Exception:
+                doc = {}
+            reachable = True
+        except Exception:
+            reachable = False
+        with self._lock:
+            b.reachable = reachable
+            b.ready = bool(doc.get("ready")) if reachable else False
+            b.draining = bool(doc.get("draining")) if reachable else False
+            # an unreplicated backend (no replica_role in the doc) takes
+            # its own appends — treat it as the leader of a group of one
+            b.role = (
+                str(doc.get("replica_role", "leader")) if reachable else ""
+            )
+            b.probe_failures = 0 if reachable else b.probe_failures + 1
+
+    # -- routing decisions ---------------------------------------------------
+
+    def read_order(self) -> "list[_Backend]":
+        """Backends for a read, preference-ordered: READY ones first in
+        round-robin rotation, then reachable-but-draining ones (they
+        still answer queries mid-restart — better a drained 503 than no
+        attempt), then the rest (health info may be stale; the breaker
+        bounds the cost of trying)."""
+        with self._lock:
+            idx = self._rr
+            self._rr += 1
+            ready = [b for b in self.backends if b.ready]
+            drain = [
+                b for b in self.backends if b.reachable and not b.ready
+            ]
+            down = [b for b in self.backends if not b.reachable]
+        if ready:
+            k = idx % len(ready)
+            ready = ready[k:] + ready[:k]
+        return ready + drain + down
+
+    def leader(self) -> "_Backend | None":
+        with self._lock:
+            for b in self.backends:
+                if b.reachable and b.role == "leader":
+                    return b
+        return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            backends = [b.snapshot() for b in self.backends]
+        lead = self.leader()
+        return {
+            "backends": backends,
+            "leader": lead.url if lead is not None else None,
+        }
+
+    # -- backend I/O ---------------------------------------------------------
+
+    def _conn(self, b: _Backend) -> http.client.HTTPConnection:
+        """Per-thread pooled keep-alive connection to ``b`` — handler
+        threads are long-lived, so each holds at most one socket per
+        backend, bounded by ``http.keepalive.s`` on the server side."""
+        from geomesa_tpu.conf import sys_prop
+
+        pool = getattr(self._tls, "conns", None)
+        if pool is None:
+            pool = self._tls.conns = {}
+        key = (b.host, b.port)
+        conn = pool.get(key)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                b.host, b.port,
+                timeout=float(sys_prop("http.keepalive.s")),
+            )
+            pool[key] = conn
+        return conn
+
+    def _drop_conn(self, b: _Backend) -> None:
+        pool = getattr(self._tls, "conns", None)
+        if pool is not None:
+            conn = pool.pop((b.host, b.port), None)
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+    def forward(
+        self, b: _Backend, method: str, path: str, body: "bytes | None",
+        headers: dict,
+    ) -> "tuple[int, list, bytes]":
+        """One proxied attempt against ``b``. Raises on transport
+        failure (the caller decides whether to retry elsewhere); a
+        served HTTP error status is a RESPONSE, not an exception."""
+        conn = self._conn(b)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        except Exception:
+            self._drop_conn(b)
+            raise
+        out = [
+            (k, v) for k in _FWD_RESP_HEADERS
+            if (v := resp.getheader(k)) is not None
+        ]
+        return resp.status, out, data
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    router: Router = None  # injected by make_router
+
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _send(self, code: int, body: bytes, ctype: str, headers=()) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, doc, headers=()) -> None:
+        self._send(
+            code, json.dumps(doc).encode("utf-8"), "application/json",
+            headers=headers,
+        )
+
+    def _req_headers(self) -> dict:
+        out = {}
+        for k in _FWD_REQ_HEADERS:
+            v = self.headers.get(k)
+            if v is not None:
+                out[k] = v
+        return out
+
+    def _relay(self, status: int, headers: list, data: bytes) -> None:
+        self.send_response(status)
+        sent = set()
+        for k, v in headers:
+            self.send_header(k, v)
+            sent.add(k.lower())
+        if "content-type" not in sent:
+            self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    # -- request paths -------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+        from geomesa_tpu import metrics
+
+        rt = self.router
+        url = urllib.parse.urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        if parts == ["healthz"]:
+            return self._json(200, {"ok": True, "router": True})
+        if parts == ["readyz"]:
+            st = rt.stats()
+            ready = any(b["ready"] for b in st["backends"])
+            st["ready"] = ready
+            return self._json(200 if ready else 503, st)
+        if parts == ["stats", "router"]:
+            return self._json(200, rt.stats())
+        if parts == ["metrics"]:
+            from geomesa_tpu.metrics import REGISTRY
+
+            return self._send(
+                200,
+                REGISTRY.prometheus_text().encode("utf-8"),
+                "text/plain; version=0.0.4",
+            )
+        self._proxy_read("GET", None)
+        metrics.router_requests.inc()
+
+    def do_POST(self) -> None:  # noqa: N802 (stdlib API)
+        from geomesa_tpu import metrics
+
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        url = urllib.parse.urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        if parts[:1] == ["append"]:
+            self._proxy_append(body)
+        else:
+            # non-append POSTs (e.g. /admin/shutdown) are a per-backend
+            # operator action, not a routable request: the fleet tool
+            # talks to backends DIRECTLY so the router never drains the
+            # instance the operator did not mean
+            self._json(404, {
+                "error": "the router proxies GET reads and POST "
+                         "/append/<type> only; operate on backends "
+                         "directly for admin actions",
+            })
+        metrics.router_requests.inc()
+
+    def _proxy_read(self, method: str, body: "bytes | None") -> None:
+        from geomesa_tpu import metrics
+        from geomesa_tpu.conf import sys_prop
+
+        rt = self.router
+        attempts = int(sys_prop("router.retries")) + 1
+        headers = self._req_headers()
+        last_err = None
+        tried = 0
+        skipped_by_breaker = 0
+        for b in rt.read_order():
+            if tried >= attempts:
+                break
+            if not b.breaker.allow():
+                skipped_by_breaker += 1
+                continue
+            tried += 1
+            try:
+                status, hdrs, data = rt.forward(
+                    b, method, self.path, body, headers
+                )
+            except Exception as e:
+                b.breaker.record_failure()
+                metrics.router_backend_errors.inc()
+                last_err = f"{b.url}: {e!r}"
+                metrics.router_retries.inc()
+                continue
+            if status >= 500 or status == 503:
+                # a 503 (draining / not-leader) read is worth one more
+                # replica; record it on the breaker so a flapping
+                # backend stops soaking attempts
+                b.breaker.record_failure()
+                metrics.router_backend_errors.inc()
+                last_err = f"{b.url}: HTTP {status}"
+                metrics.router_retries.inc()
+                continue
+            b.breaker.record_success()
+            return self._relay(status, hdrs, data)
+        self._json(
+            503,
+            {
+                "error": "no backend could serve the request",
+                "attempted": tried,
+                "skipped_by_breaker": skipped_by_breaker,
+                "last_error": last_err,
+            },
+            headers=(("Retry-After", "1"),),
+        )
+
+    def _proxy_append(self, body: bytes) -> None:
+        from geomesa_tpu import metrics
+
+        rt = self.router
+        lead = rt.leader()
+        if lead is None or not lead.breaker.allow():
+            if lead is not None:
+                lead.breaker.release_probe()
+            # promotion window: every candidate still reports follower.
+            # Shed BOUNDED — the client retries after the failover bound
+            metrics.router_sheds.inc()
+            return self._json(
+                503,
+                {"error": "no append leader is known (promotion in "
+                          "progress?); retry shortly"},
+                headers=(("Retry-After", "1"),),
+            )
+        try:
+            status, hdrs, data = rt.forward(
+                lead, "POST", self.path, body, self._req_headers()
+            )
+        except Exception as e:
+            lead.breaker.record_failure()
+            metrics.router_backend_errors.inc()
+            metrics.router_sheds.inc()
+            # the append may or may not have been acked before the
+            # transport died — surface the ambiguity instead of blind
+            # re-sending (appends are not idempotent)
+            return self._json(
+                503,
+                {"error": f"append leader unreachable: {e!r}; outcome "
+                          "unknown — check before re-sending"},
+                headers=(("Retry-After", "1"),),
+            )
+        if status >= 500:
+            lead.breaker.record_failure()
+            metrics.router_backend_errors.inc()
+        else:
+            lead.breaker.record_success()
+        self._relay(status, hdrs, data)
+
+
+class _RouterHTTPServer(ThreadingHTTPServer):
+    router: "Router | None" = None
+
+    def shutdown(self):
+        if self.router is not None:
+            self.router.close()
+        super().shutdown()
+
+
+def make_router(
+    backends: "list[str]", host: str = "127.0.0.1", port: int = 0,
+) -> _RouterHTTPServer:
+    """Build the front-tier server over ``backends`` (absolute
+    ``http://host:port`` urls). Port 0 picks an ephemeral port; the
+    health poll starts immediately (one synchronous probe pass, so the
+    first request routes on real health, not defaults)."""
+    rt = Router(backends)
+    handler = type("BoundRouterHandler", (_RouterHandler,), {"router": rt})
+    server = _RouterHTTPServer((host, port), handler)
+    server.router = rt
+    rt.start()
+    return server
+
+
+def route_background(
+    backends: "list[str]", host: str = "127.0.0.1", port: int = 0,
+):
+    """Start the router on a daemon thread; returns (server, thread)."""
+    server = make_router(backends, host=host, port=port)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread

@@ -52,6 +52,17 @@ Endpoints (all GET):
 - ``/debug/traces/<id>``            -- one trace's full span tree;
   ``?format=perfetto`` emits Chrome-trace/Perfetto JSON
 - ``/refresh/<type>``               -- restage a resident type after writes
+- ``/wal/<type>?from=&waitMs=&follower=`` -- replication ship: chunked
+  stream of checksummed WAL records (on-disk framing) with seq >= from;
+  long-polls when empty, 410 Gone below the compaction watermark
+- ``/stats/replica``                -- replication role/lag/failover doc
+  (replica.py; {"enabled": false} when unreplicated)
+
+POST ``/append/<type>`` ingests into the streaming live layer (WAL-first
+ack; followers answer 503 + the leader's URL), and POST
+``/admin/shutdown`` triggers the draining shutdown remotely (the fleet
+rolling-restart drain trigger; the response acks before draining
+starts).
 
 Tracing: every non-debug request runs under a root span (tracing.py) —
 an inbound ``X-Request-Id`` header becomes the trace id (echoed on the
@@ -129,6 +140,7 @@ class _GeomesaHTTPServer(ThreadingHTTPServer):
     scheduler = None
     store = None  # wired by make_server (audit flush at drain)
     stream_layer = None  # StreamingStore, when the live layer is on
+    replica = None  # Replicator, when this server is in a group
 
     def __init__(self, *args, **kwargs):
         self.draining = threading.Event()
@@ -136,6 +148,13 @@ class _GeomesaHTTPServer(ThreadingHTTPServer):
 
     def shutdown(self):
         self.draining.set()  # stop admission BEFORE finishing in-flight
+        if self.replica is not None:
+            # stop tailing/failover first: a follower must not promote
+            # because ITS OWN drain made the leader look dead
+            try:
+                self.replica.close()
+            except Exception:  # close is best-effort on the way down
+                pass
         if self.scheduler is not None:
             self.scheduler.close(timeout=5.0)
         if self.stream_layer is not None:
@@ -161,7 +180,10 @@ class _Handler(BaseHTTPRequestHandler):
     # keep-alive semantics hold. The socket timeout bounds how long an
     # IDLE keep-alive connection may pin a handler thread (the stdlib
     # turns the timeout into close_connection) — without it every
-    # half-open client would hold a ThreadingHTTPServer thread forever
+    # half-open client would hold a ThreadingHTTPServer thread forever.
+    # make_server resolves the declared ``http.keepalive.s`` conf key
+    # over this class default (router→backend persistent connections
+    # share the same knob)
     protocol_version = "HTTP/1.1"
     timeout = 60
 
@@ -170,6 +192,7 @@ class _Handler(BaseHTTPRequestHandler):
     mesh = False  # shard resident indexes across the device mesh
     scheduler = None  # QueryScheduler (admission + micro-batch fusion)
     stream = None  # StreamingStore live layer (None = batch-only)
+    replica = None  # Replicator (None = unreplicated single process)
     _resident_cache: dict = {}  # per-server-class: type -> DeviceIndex
     _resident_lock = None  # per-server-class construction lock
 
@@ -601,6 +624,7 @@ class _Handler(BaseHTTPRequestHandler):
             and hasattr(self.store, "store_stats")
         ) or parts == ["stats", "mesh"] or parts == ["stats", "slo"] \
             or parts == ["stats", "ledger"] or parts == ["stats", "stream"] \
+            or parts == ["stats", "replica"] or parts[:1] == ["wal"] \
             or parts == ["stats"]
         if untraced:
             self._trace = None
@@ -675,6 +699,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._degraded = None
             self._cost = None
             return self._json(400, {"error": str(e)})
+        if parts == ["admin", "shutdown"]:
+            # the fleet-restart drain trigger: respond FIRST (the
+            # orchestrator needs the ack), then run the draining
+            # shutdown off-thread — shutdown() joins in-flight work
+            # and would deadlock the handler thread serving this very
+            # request
+            self._trace = None
+            self._degraded = None
+            self._cost = None
+            self._json(200, {"draining": True})
+            threading.Thread(
+                target=self.server.shutdown,
+                name="admin-shutdown",
+                daemon=True,
+            ).start()
+            return
         if len(parts) != 2 or parts[0] != "append":
             self._trace = None
             self._degraded = None
@@ -725,6 +765,23 @@ class _Handler(BaseHTTPRequestHandler):
                 "application/json",
                 headers=(("Retry-After", "1"),),
             )
+        rep = self.replica
+        if rep is not None and not rep.is_leader():
+            # appends pin to the leader: a follower taking writes would
+            # fork the WAL seq space. 503 + Retry-After (not 4xx) —
+            # during promotion the SAME url becomes writable, so the
+            # client/router should retry, not give up
+            return self._send(
+                503,
+                json.dumps({
+                    "error": "not the leader "
+                             f"(role={rep.role}); appends go to the "
+                             "leader",
+                    "leader": rep.leader_url,
+                }).encode("utf-8"),
+                "application/json",
+                headers=(("Retry-After", "1"),),
+            )
         stream = self.stream
         if stream is None:
             return self._json(
@@ -746,9 +803,25 @@ class _Handler(BaseHTTPRequestHandler):
         res = self._sched_run(
             q, fn=lambda: stream.append(type_name, batch)
         )
-        self._json(
-            200, {"acked": int(res["rows"]), "seq": int(res["seq"])}
-        )
+        replicated = None
+        if rep is not None and rep.ack_mode() == "replica" \
+                and int(res["rows"]):
+            from geomesa_tpu.conf import sys_prop
+            from geomesa_tpu.resilience import note_degraded
+
+            replicated = rep.await_replicated(
+                type_name, int(res["seq"]),
+                float(sys_prop("replica.ack.timeout.s")),
+            )
+            if not replicated:
+                # acked local-durable only: rows are WAL-safe here but
+                # a leader loss before ship could lose them — stamp the
+                # response degraded instead of failing a durable write
+                note_degraded("replica-lag")
+        doc = {"acked": int(res["rows"]), "seq": int(res["seq"])}
+        if replicated is not None:
+            doc["replicated"] = bool(replicated)
+        self._json(200, doc)
 
     def _audit_outcome(self, parts: list, q: dict, outcome: str) -> None:
         """Stamp a shed (429) or deadline-expired (504) request into the
@@ -870,6 +943,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.scheduler is not None:
             queued, max_queue = self.scheduler.queue_pressure()
             doc["sched"] = {"queued": queued, "max_queue": max_queue}
+        if self.replica is not None:
+            # the router's health poll keys append-routing off this
+            doc["replica_role"] = self.replica.role
         self._json(200 if doc["ready"] else 503, doc)
 
     def _dispatch(self, url, parts: list, q: dict) -> None:
@@ -919,8 +995,19 @@ class _Handler(BaseHTTPRequestHandler):
                 if self.stream is not None
                 else {"enabled": False},
             )
+        if parts == ["stats", "replica"]:
+            return self._json(
+                200,
+                self.replica.stats()
+                if self.replica is not None
+                else {"enabled": False},
+            )
         if parts == ["stats"]:
             return self._json(200, self._stats_index())
+        if len(parts) == 2 and parts[0] == "wal":
+            # replication shipping stays OPEN while draining: the fleet
+            # restart drains a leader exactly so followers can catch up
+            return self._wal_ship(unquote(parts[1]), q)
         if len(parts) == 2 and parts[0] in (
             "features", "count", "explain", "density", "stats",
             "refresh", "knn", "tube", "proximity",
@@ -957,6 +1044,105 @@ class _Handler(BaseHTTPRequestHandler):
                 doc["types"][name] = stats()
         return doc
 
+    def _wal_ship(self, type_name: str, q: dict) -> None:
+        """``GET /wal/<type>?from=<seq>&waitMs=&follower=`` — the
+        replication ship endpoint: a chunked stream of checksummed WAL
+        records (the on-disk framing, ``pack_record``) with
+        ``seq >= from``, read through the never-mutating
+        :meth:`~geomesa_tpu.store.wal.WriteAheadLog.read_from` cursor —
+        safe against the live appender, and servable by ANY replica
+        (an election loser tails the winner before it even promotes).
+        ``waitMs`` long-polls an empty log so followers ride one
+        request per batch instead of hot-polling; ``follower`` is the
+        caller's advertised URL, folded into the leader's applied-seq
+        accounting (``replica.ack=replica``). 410 Gone when the
+        requested position was compacted away below the watermark —
+        tailing cannot help; the follower must re-provision from a
+        snapshot."""
+        import time as _time
+
+        from geomesa_tpu import ledger, metrics
+        from geomesa_tpu.conf import sys_prop
+        from geomesa_tpu.store.wal import pack_record
+
+        stream = self.stream
+        if stream is None:
+            return self._json(
+                400,
+                {"error": "server is not running with the streaming "
+                          "live layer (stream.enabled / serve --stream)"},
+            )
+        self.store.get_schema(type_name)  # KeyError -> 404
+        ts = stream._ts(type_name)
+        frm = max(int(q.get("from", 0)), 0)
+        after = frm - 1
+        wait_ms = min(max(float(q.get("waitMs", 0.0)), 0.0), 30_000.0)
+        rep = self.replica
+        if rep is not None:
+            rep.note_follower(q.get("follower", ""), type_name, after)
+        watermark = int(self.store._types[type_name].wal_watermark)
+        if frm <= watermark:
+            first = ts.wal.first_seq()
+            if first < 0 or frm < first:
+                # compaction GC'd the asked-for records: they live only
+                # in the partition files now, which shipping cannot
+                # replay — the follower needs a snapshot re-provision
+                return self._json(410, {
+                    "error": f"WAL records below seq {first} were "
+                             "compacted away; re-provision this "
+                             "follower from a store snapshot",
+                    "first_seq": first,
+                    "watermark": watermark,
+                })
+        # long-poll BEFORE the headers: X-Wal-Next-Seq must reflect the
+        # position the stream actually serves through. next_seq is a
+        # GIL-safe int read — no segment scan while waiting.
+        deadline = _time.monotonic() + wait_ms / 1e3
+        while (
+            ts.wal.next_seq <= frm
+            and _time.monotonic() < deadline
+            and not self._draining()
+        ):
+            _time.sleep(0.01)
+        nxt = int(ts.wal.next_seq)
+        state = {"bytes": 0, "records": 0}
+
+        def chunks():
+            buf = bytearray()
+            for seq, payload in ts.wal.read_from(after):
+                if seq >= nxt:
+                    break  # a fixed upper bound keeps the stream finite
+                buf += pack_record(seq, payload)
+                state["records"] += 1
+                if len(buf) >= (512 << 10):
+                    state["bytes"] += len(buf)
+                    yield bytes(buf)
+                    buf.clear()
+            if buf:
+                state["bytes"] += len(buf)
+                yield bytes(buf)
+
+        role = rep.role if rep is not None else "leader"
+        self._send_stream(
+            200, "application/x-geomesa-wal", chunks(), "wal",
+            headers=(
+                ("X-Wal-Next-Seq", str(nxt)),
+                ("X-Wal-Watermark", str(watermark)),
+                ("X-Replica-Role", role),
+            ),
+        )
+        if state["records"]:
+            metrics.replica_ship_records.inc(state["records"])
+            metrics.replica_ship_bytes.inc(state["bytes"])
+            if ledger.enabled():
+                cost = ledger.RequestCost(
+                    tenant="_system", endpoint="wal", lane="batch",
+                    shape="wal-ship",
+                )
+                cost.status = 200
+                cost.charge("replica_ship_bytes", state["bytes"])
+                ledger.LEDGER.record(cost)
+
     def _stats_index(self) -> dict:
         """``/stats``: one roll-up document — scheduler, store, mesh,
         SLO engine, cost ledger and the persistent compile cache
@@ -975,6 +1161,8 @@ class _Handler(BaseHTTPRequestHandler):
         doc["ledger"] = LEDGER.snapshot()
         if self.stream is not None:
             doc["stream"] = self.stream.stream_stats()
+        if self.replica is not None:
+            doc["replica"] = self.replica.stats()
         return doc
 
     def _debug_traces(self, parts: list, q: dict) -> None:
@@ -1573,7 +1761,7 @@ class _Handler(BaseHTTPRequestHandler):
 #: URL scanner cannot mint unbounded metric series or ring keys
 _KNOWN_ENDPOINTS = frozenset({
     "features", "count", "explain", "density", "stats", "refresh",
-    "knn", "tube", "proximity", "capabilities", "append",
+    "knn", "tube", "proximity", "capabilities", "append", "wal",
 })
 
 
@@ -1657,7 +1845,7 @@ def _make_resident_index(store, type_name: str, mesh: bool,
 def make_server(
     store, host: str = "127.0.0.1", port: int = 0, resident: bool = False,
     warm: bool = False, sched=None, io=None, mesh: "bool | None" = None,
-    stream: "bool | None" = None,
+    stream: "bool | None" = None, replica=None,
 ):
     """Build a ThreadingHTTPServer bound to (host, port); port 0 picks an
     ephemeral port (see ``server.server_address``). ``resident=True``
@@ -1691,6 +1879,16 @@ def make_server(
     per-shard residency, and a failed shard launch degrades down the
     PR 7 ladder instead of failing the query. Needs > 1 visible jax
     device; topology comes from ``mesh.devices`` / ``mesh.replicas``.
+
+    ``replica`` joins this server to a replication group: pass a
+    :class:`~geomesa_tpu.replica.ReplicaConfig` (or a pre-built
+    :class:`~geomesa_tpu.replica.Replicator`). Leaders serve the WAL
+    ship endpoint (``GET /wal/<type>``); followers tail the leader,
+    apply records at the LEADER's seqs through the replay-idempotent
+    live layer, reject POST ``/append`` with 503 + the leader's URL,
+    and promote within ``replica.failover.s`` when the leader's lease
+    expires. Requires the streaming live layer (the WAL is the thing
+    being shipped).
 
     The persistent XLA compile cache is wired here from the
     ``compile.cache.dir`` conf key (serving is compile-heavy; a
@@ -1750,6 +1948,27 @@ def make_server(
             store = stream_layer
     from geomesa_tpu.locking import checked_lock
 
+    replicator = None
+    if replica is not None:
+        from geomesa_tpu.replica import ReplicaConfig, Replicator
+
+        if stream_layer is None:
+            raise ValueError(
+                "replication needs the streaming live layer (the WAL is "
+                "what gets shipped); pass stream=True / stream.enabled"
+            )
+        if isinstance(replica, Replicator):
+            replicator = replica
+        elif isinstance(replica, ReplicaConfig):
+            replicator = Replicator(replica)
+        else:
+            raise TypeError(
+                "replica must be a ReplicaConfig or Replicator, "
+                f"got {type(replica).__name__}"
+            )
+        replicator.attach(stream_layer)
+    from geomesa_tpu.conf import sys_prop as _sys_prop
+
     handler = type(
         "BoundHandler",
         (_Handler,),
@@ -1759,6 +1978,11 @@ def make_server(
             "mesh": mesh_on,
             "scheduler": scheduler,
             "stream": stream_layer,
+            "replica": replicator,
+            # idle keep-alive bound, declared (GT008) instead of the
+            # class-default literal; router→backend pooled connections
+            # read the same key
+            "timeout": float(_sys_prop("http.keepalive.s")),
             "_resident_cache": {},
             # blocking_ok: first-touch resident builds hold it across
             # store reads + device staging BY DESIGN (a duplicate build
@@ -1842,19 +2066,29 @@ def make_server(
     server.scheduler = scheduler  # callers may inspect / shut down
     server.store = store  # the draining shutdown flushes its audit log
     server.stream_layer = stream_layer  # closed by the draining shutdown
+    if replicator is not None:
+        # the bound ephemeral port is only known NOW — default the
+        # advertised URL from it so tests/CLI may pass port=0
+        if not replicator.cfg.self_url:
+            addr = server.server_address
+            replicator.cfg.self_url = f"http://{addr[0]}:{addr[1]}"
+        if replicator.cfg.role == "leader" and not replicator._leader_url:
+            replicator._leader_url = replicator.cfg.self_url
+        server.replica = replicator
+        replicator.start()  # follower tail thread spawns here
     return server
 
 
 def serve_background(
     store, host: str = "127.0.0.1", port: int = 0, resident: bool = False,
     warm: bool = False, sched=None, io=None, mesh: "bool | None" = None,
-    stream: "bool | None" = None,
+    stream: "bool | None" = None, replica=None,
 ):
     """Start serving on a daemon thread; returns (server, thread). Stop
     with ``server.shutdown()``."""
     server = make_server(
         store, host, port, resident=resident, warm=warm, sched=sched,
-        io=io, mesh=mesh, stream=stream,
+        io=io, mesh=mesh, stream=stream, replica=replica,
     )
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()

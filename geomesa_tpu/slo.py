@@ -79,7 +79,10 @@ SLO_NAMES = ("interactive", "batch", "ingest")
 #: the flight-recorder reason registry (GT009): bundle directory names
 #: and the geomesa_flightrec_bundles_total metric label both come from
 #: here, so reasons stay a bounded, greppable enum
-FLIGHT_REASONS = ("burn-rate", "breaker-open", "manual", "ingest-stall")
+FLIGHT_REASONS = (
+    "burn-rate", "breaker-open", "manual", "ingest-stall",
+    "replica-failover",
+)
 
 #: windowed-histogram bucket bounds (seconds) — finer than the metrics
 #: default so p999 at serving latencies is meaningful

@@ -328,6 +328,67 @@ class StreamingStore:
         )
         self._commit_run_locked(ts, built, coalesce, primary, seq)
 
+    def apply_replicated(self, type_name: str, seq: int, payload: bytes) -> int:
+        """Follower apply path: land ONE leader-shipped WAL record —
+        the record keeps the LEADER's seq (``append_at``) so the
+        manifest watermark and replay idempotence hold bit-identically
+        across the replica group, and promotion needs no renumbering.
+        A seq this replica already holds durably (its WAL or at/below
+        its manifest watermark) is skipped — the ≤-watermark idempotent
+        replay contract, which is what makes re-shipping after a torn
+        tail, a follower crash, or an overlapping tail harmless.
+        Returns rows applied (0 = idempotent skip). Never sheds: the
+        leader already acked these rows, so backpressure here would be
+        data loss — the follower's own compactor bounds the memtable
+        exactly like the leader's does."""
+        from geomesa_tpu import ledger, metrics
+        from geomesa_tpu.failpoints import fail_point
+
+        ts = self._ts(type_name)
+        st = self.store._types[type_name]
+        fail_point("fail.replica.apply")
+        with ts.lock:
+            if seq < ts.wal.next_seq or seq <= int(st.wal_watermark):
+                metrics.replica_apply_skipped.inc()
+                return 0
+            # decode (fallible) BEFORE the local durability point: an
+            # undecodable record must fail the apply cleanly, not leave
+            # a durable WAL entry that replays nothing
+            batch = self._decode(type_name, payload)
+            ts.wal.append_at(seq, payload)
+            if len(batch):
+                self._insert_locked(type_name, ts, batch, seq)
+            ts.appended_rows += len(batch)
+            mem_rows = sum(r.rows for r in ts.runs)
+            nruns = len(ts.runs)
+        metrics.replica_apply_records.inc()
+        metrics.stream_memtable_rows.set(mem_rows, type=type_name)
+        metrics.stream_memtable_runs.set(nruns, type=type_name)
+        ledger.charge("replica_apply_rows", len(batch))
+        if len(batch):
+            # resident-index delta outside the memtable lock, exactly
+            # like the leader's append path
+            self._notify_delta(type_name, batch)
+        from geomesa_tpu.conf import sys_prop
+
+        if mem_rows >= int(sys_prop("stream.memtable.rows")):
+            self._kick()
+        return len(batch)
+
+    def replica_positions(self) -> dict:
+        """Per-type WAL position + manifest watermark: the follower's
+        lag accounting, the election's most-caught-up comparison and
+        the ship endpoint's 410 detection all read from here."""
+        out = {}
+        for t in self.store.type_names:
+            ts = self._ts(t)
+            st = self.store._types[t]
+            out[t] = {
+                "next_seq": int(ts.wal.next_seq),
+                "watermark": int(st.wal_watermark),
+            }
+        return out
+
     @staticmethod
     def _encode(batch: FeatureBatch) -> bytes:
         import pyarrow as pa

@@ -34,7 +34,9 @@ import zlib
 from geomesa_tpu.failpoints import fail_point
 from geomesa_tpu.locking import checked_lock
 
-__all__ = ["WriteAheadLog", "WalCorruption"]
+__all__ = [
+    "WriteAheadLog", "WalCorruption", "pack_record", "RecordParser",
+]
 
 _MAGIC = 0x474D5741  # "GMWA"
 _HEADER = struct.Struct("<IQII")  # magic, seq, length, crc
@@ -49,6 +51,56 @@ class WalCorruption(RuntimeError):
 def _crc(seq: int, payload: bytes) -> int:
     c = zlib.crc32(struct.pack("<QI", seq, len(payload)))
     return zlib.crc32(payload, c) & 0xFFFFFFFF
+
+
+def pack_record(seq: int, payload: bytes) -> bytes:
+    """One record in the on-disk framing. The replication wire format
+    IS the segment format (magic/seq/length/crc + payload): the leader
+    ships bytes it could have read back, and the follower verifies the
+    same checksum replay would — one framing, no translation layer."""
+    return _HEADER.pack(_MAGIC, seq, len(payload), _crc(seq, payload)) + payload
+
+
+class RecordParser:
+    """Incremental parser for a shipped record stream (the follower
+    side of ``GET /wal/<type>``): ``feed()`` arbitrary byte chunks,
+    get back the complete verified records they finish. A checksum or
+    framing mismatch raises :class:`WalCorruption` — a replication
+    stream has no legitimate torn tail; damage means the transport or
+    the leader is lying and the follower must resync, not guess."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> "list[tuple[int, bytes]]":
+        self._buf += data
+        out: "list[tuple[int, bytes]]" = []
+        off = 0
+        n = len(self._buf)
+        while off + _HEADER.size <= n:
+            magic, seq, length, crc = _HEADER.unpack_from(self._buf, off)
+            if magic != _MAGIC:
+                raise WalCorruption(
+                    f"replication stream framing lost at offset {off} "
+                    f"(bad magic 0x{magic:08x})"
+                )
+            end = off + _HEADER.size + length
+            if end > n:
+                break  # incomplete record — wait for more bytes
+            payload = bytes(self._buf[off + _HEADER.size:end])
+            if _crc(seq, payload) != crc:
+                raise WalCorruption(
+                    f"replication stream record seq={seq} failed its "
+                    f"checksum"
+                )
+            out.append((seq, payload))
+            off = end
+        del self._buf[:off]
+        return out
+
+    @property
+    def pending_bytes(self) -> int:
+        return len(self._buf)
 
 
 def _fsync_dir(d: str) -> None:
@@ -148,11 +200,14 @@ class WriteAheadLog:
             self._seg_path = segs[-1]
             self._seg_size = os.path.getsize(segs[-1])
 
-    def _scan_one(self, path: str, truncate_tail: bool):
+    def _scan_one(self, path: str, truncate_tail: bool, mutate: bool = True):
         """Yield ``(seq, payload)`` for every valid record of one
         segment. With ``truncate_tail`` a trailing invalid record is cut
         at the last valid offset (counted); without it, damage raises
-        :class:`WalCorruption`."""
+        :class:`WalCorruption`. ``mutate=False`` (the :meth:`read_from`
+        cursor) tolerates a torn tail like readonly mode does — stop at
+        the damage, never truncate — so a concurrent reader can walk a
+        live appender's log."""
         from geomesa_tpu import metrics
 
         good = 0
@@ -181,7 +236,7 @@ class WriteAheadLog:
                     f"WAL segment {path!r} damaged at offset {good} "
                     f"(of {n} bytes) before its tail"
                 )
-            if self._readonly:
+            if self._readonly or not mutate:
                 return  # inspect, never mutate (a live appender owns it)
             import logging
 
@@ -207,47 +262,75 @@ class WriteAheadLog:
         durability trade, same knob as partition flushes). Transient
         I/O errors retry with the ``resilience`` backoff budget under
         the ``wal`` failure domain."""
-        from geomesa_tpu import ledger, metrics, resilience
-
         if self._readonly:
             raise RuntimeError("WAL opened readonly (inspection only)")
         with self._lock:
             seq = self._next_seq
-            rec = _HEADER.pack(
-                _MAGIC, seq, len(payload), _crc(seq, payload)
-            ) + payload
-
-            def _write():
-                # inside the retry closure: an injected (or real)
-                # transient failure rides the backoff budget exactly
-                # like a flaky disk
-                fail_point("fail.wal.append")
-                self._rotate_if_needed(len(rec))
-                start = self._seg_size
-                try:
-                    self._write_record(rec)
-                except BaseException:
-                    # a partial record must not linger ahead of the
-                    # retry's full copy — replay stops at the first
-                    # damage, which would drop the (acked) retry
-                    if self._fd >= 0:
-                        try:
-                            os.ftruncate(self._fd, start)
-                            self._seg_size = start
-                        except OSError:
-                            pass
-                    raise
-
-            resilience.retry_call(_write, domain="wal")
-            self._next_seq = seq + 1
-            self.bytes_written += len(rec)
-            metrics.stream_wal_bytes.inc(len(rec))
-            ledger.charge("wal_bytes", len(rec))
-            if self._sync_on():
-                self.fsyncs += 1
-                metrics.stream_wal_fsyncs.inc()
-                ledger.charge("wal_fsyncs", 1)
+            self._append_locked(seq, payload)
             return seq
+
+    def append_at(self, seq: int, payload: bytes) -> int:
+        """Durably append one record with a CALLER-ASSIGNED seq: the
+        replication follower's apply path. Shipped records keep the
+        leader's sequence numbers so the manifest watermark, replay
+        idempotence, and promotion ("the WAL position IS the truth")
+        stay exact across the whole replica group. ``seq`` must be at
+        or past ``next_seq`` — records apply in ship order; a seq the
+        follower already holds is the caller's idempotent skip, not an
+        append."""
+        if self._readonly:
+            raise RuntimeError("WAL opened readonly (inspection only)")
+        with self._lock:
+            if seq < self._next_seq:
+                raise ValueError(
+                    f"append_at seq {seq} below next_seq "
+                    f"{self._next_seq} (already durable here)"
+                )
+            # advance BEFORE opening so a fresh segment's name encodes
+            # the true first seq it will hold
+            self._next_seq = seq
+            self._append_locked(seq, payload)
+            return seq
+
+    def _append_locked(self, seq: int, payload: bytes) -> None:
+        """Write + ack one record (caller holds the appender lock and
+        has set ``seq == self._next_seq``); advances ``next_seq``."""
+        from geomesa_tpu import ledger, metrics, resilience
+
+        rec = _HEADER.pack(
+            _MAGIC, seq, len(payload), _crc(seq, payload)
+        ) + payload
+
+        def _write():
+            # inside the retry closure: an injected (or real)
+            # transient failure rides the backoff budget exactly
+            # like a flaky disk
+            fail_point("fail.wal.append")
+            self._rotate_if_needed(len(rec))
+            start = self._seg_size
+            try:
+                self._write_record(rec)
+            except BaseException:
+                # a partial record must not linger ahead of the
+                # retry's full copy — replay stops at the first
+                # damage, which would drop the (acked) retry
+                if self._fd >= 0:
+                    try:
+                        os.ftruncate(self._fd, start)
+                        self._seg_size = start
+                    except OSError:
+                        pass
+                raise
+
+        resilience.retry_call(_write, domain="wal")
+        self._next_seq = seq + 1
+        self.bytes_written += len(rec)
+        metrics.stream_wal_bytes.inc(len(rec))
+        ledger.charge("wal_bytes", len(rec))
+        if self._sync_on():
+            self.fsyncs += 1
+            metrics.stream_wal_fsyncs.inc()
+            ledger.charge("wal_fsyncs", 1)
 
     def _write_record(self, rec: bytes) -> None:
         if self._fd < 0:
@@ -304,6 +387,38 @@ class WriteAheadLog:
             for seq, payload in self._scan_one(path, truncate_tail=tail_ok):
                 if seq > after_seq:
                     yield seq, payload
+
+    def read_from(self, after_seq: int = -1):
+        """Readonly streaming cursor: yield ``(seq, payload)`` for every
+        durable record with ``seq > after_seq``, in order, and NEVER
+        mutate — regardless of whether this instance is the live
+        appender or a readonly inspector. A torn tail is simply where
+        the stream ends (the next cursor pass picks up the retried
+        copy); a segment unlinked mid-walk by ``truncate_through`` is
+        skipped (its records are at or below the manifest watermark, so
+        every consumer of this cursor already holds them). One cursor
+        serves both the CLI ``wal`` command and the leader-side
+        replication shipper."""
+        segs = self.segments()
+        for i, path in enumerate(segs):
+            tail_ok = i == len(segs) - 1
+            try:
+                for seq, payload in self._scan_one(
+                    path, truncate_tail=tail_ok, mutate=False
+                ):
+                    if seq > after_seq:
+                        yield seq, payload
+            except FileNotFoundError:
+                continue  # racing truncate_through
+
+    def first_seq(self) -> int:
+        """Lowest seq still on disk, or -1 when the log is empty. The
+        leader's ship endpoint uses this to detect a follower asking
+        for records already garbage-collected by compaction (it must
+        re-provision from a snapshot, not tail)."""
+        for seq, _ in self.read_from(-1):
+            return seq
+        return -1
 
     def truncate_through(self, seq: int) -> int:
         """Delete sealed segments whose every record is ``<= seq``

@@ -388,16 +388,18 @@ def cmd_wal(args):
         if not _os.path.isdir(wal_dir):
             print(f"{name}: no WAL (nothing streamed)")
             continue
-        # readonly: a live server may be appending to this log RIGHT
-        # NOW — the inspection scan must never truncate what it reads
-        # as a torn tail out from under the appender's fd
+        # readonly + read_from: the SAME never-mutating cursor the
+        # replication ship endpoint serves followers from — a live
+        # server may be appending to this log RIGHT NOW, and neither
+        # reader may truncate what it reads as a torn tail out from
+        # under the appender's fd
         wal = WriteAheadLog(wal_dir, readonly=True)
         watermark = int(store._types[name].wal_watermark)
         rows = 0
         records = 0
         from geomesa_tpu.features.batch import FeatureBatch  # noqa: F401
 
-        for _seq, payload in wal.replay(after_seq=watermark):
+        for _seq, payload in wal.read_from(watermark):
             records += 1
             rows += _wal_payload_rows(payload)
         st = wal.stats()
@@ -703,11 +705,30 @@ def cmd_serve(args):
 
     _apply_io_flags(args)
     store = _store(args)
+    replica = None
+    role = getattr(args, "replica_role", None)
+    if role:
+        from geomesa_tpu.replica import ReplicaConfig
+
+        if role == "follower" and not getattr(args, "leader", None):
+            sys.exit("error: --replica-role follower needs --leader URL")
+        replica = ReplicaConfig(
+            role=role,
+            self_url=getattr(args, "advertise", "") or "",
+            leader_url=getattr(args, "leader", "") or "",
+            peers=tuple(
+                u.strip()
+                for u in (getattr(args, "peers", "") or "").split(",")
+                if u.strip()
+            ),
+        )
+        args.stream = True  # the WAL is what gets shipped
     server = make_server(
         store, args.host, args.port, resident=args.resident,
         warm=getattr(args, "warm", False), sched=_sched_config(args),
         mesh=True if getattr(args, "mesh", False) else None,
         stream=True if getattr(args, "stream", False) else None,
+        replica=replica,
     )
     host, port = server.server_address[:2]
     mode = " (resident device caches)" if args.resident else ""
@@ -717,24 +738,219 @@ def cmd_serve(args):
         mode += " (mesh-sharded)"
     if server.stream_layer is not None:
         mode += " (streaming live layer)"
+    if server.replica is not None:
+        mode += f" (replica: {server.replica.role})"
     print(f"serving {store.root} on http://{host}:{port}{mode}")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         server.shutdown()
+    finally:
+        # serve_forever also returns after a remote POST /admin/shutdown
+        # (the fleet drain); release the port for the restarted process
+        server.server_close()
 
 
-def cmd_load_driver(args):
-    """Concurrent load driver: M threads x N requests against a serving
-    endpoint (an already-running --url, or a self-served resident store),
-    reporting throughput, latency percentiles, shed load (429s) and the
-    scheduler's fusion counters from /stats/sched."""
+def _parse_backends(spec: str) -> list:
+    """``host:port,host:port,...`` (or full urls) -> absolute urls."""
+    out = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if not part.startswith("http"):
+            part = f"http://{part}"
+        out.append(part.rstrip("/"))
+    if not out:
+        sys.exit("error: --backends needs at least one host:port")
+    return out
+
+
+def _synth_columns(attrs: list, n: int, rng) -> dict:
+    """Minimal append columns for an arbitrary schema (from
+    /capabilities attribute metadata) — the load driver's write leg."""
+    cols = {}
+    for a in attrs:
+        t = a["type"].lower()
+        if "point" in t or "geometry" in t or "line" in t or "polygon" in t:
+            cols[a["name"]] = [
+                [float(rng.uniform(-180, 180)), float(rng.uniform(-90, 90))]
+                for _ in range(n)
+            ]
+        elif "string" in t:
+            cols[a["name"]] = [f"ld-{i}" for i in range(n)]
+        elif "date" in t:
+            cols[a["name"]] = [1_000_000 + i for i in range(n)]
+        elif "float" in t or "double" in t:
+            cols[a["name"]] = [float(rng.uniform(0, 100)) for _ in range(n)]
+        elif "bool" in t:
+            cols[a["name"]] = [True] * n
+        else:  # Int / Long / anything numeric-ish
+            cols[a["name"]] = [int(rng.integers(0, 100)) for _ in range(n)]
+    return cols
+
+
+def _load_driver_backends(args):
+    """``--backends`` mode: mixed read/write load over a replicated
+    group (or its router), with per-backend qps/latency/error splits.
+    Reads round-robin the backends directly; every ``--append-every``-th
+    request is a synthetic POST /append routed to whichever backend
+    currently reports the leader role (re-discovered on a 503, i.e.
+    through a failover). Per-backend splits make a sick replica — or a
+    shedding promotion window — visible in one report."""
     import threading
     import time
     import urllib.error
     import urllib.request
     from urllib.parse import quote
 
+    import numpy as np
+
+    from geomesa_tpu.locking import checked_lock
+
+    backends = _parse_backends(args.backends)
+    cql = quote(args.cql or "INCLUDE")
+    stats = {
+        u: {"ok": 0, "rejected": 0, "errors": 0, "lats": []}
+        for u in backends
+    }
+    appends = {"attempted": 0, "acked_rows": 0, "shed": 0, "errors": 0}
+    lock = checked_lock("cli.load_driver")
+    attrs = None
+    for u in backends:
+        try:
+            with urllib.request.urlopen(
+                f"{u}/capabilities", timeout=30
+            ) as r:
+                cap = json.loads(r.read())
+            attrs = cap["types"][args.feature_name]["attributes"]
+            break
+        except Exception:
+            continue
+    if attrs is None:
+        sys.exit("error: no backend answered /capabilities")
+
+    def leader_of() -> str:
+        for u in backends:
+            try:
+                with urllib.request.urlopen(
+                    f"{u}/stats/replica", timeout=5
+                ) as r:
+                    doc = json.loads(r.read())
+                if not doc.get("enabled") or doc.get("role") == "leader":
+                    return u
+            except Exception:
+                continue
+        return backends[0]
+
+    def worker(tid: int):
+        rng = np.random.default_rng(tid)
+        lead = leader_of()
+        fid0 = 1_000_000_000 + tid * 1_000_000
+        for i in range(args.requests):
+            writing = args.append_every and i % args.append_every == 0
+            if writing:
+                n = args.append_rows
+                doc = {
+                    "columns": _synth_columns(attrs, n, rng),
+                    "fids": list(range(fid0, fid0 + n)),
+                }
+                fid0 += n
+                body = json.dumps(doc).encode()
+                with lock:
+                    appends["attempted"] += 1
+                try:
+                    req = urllib.request.Request(
+                        f"{lead}/append/{args.feature_name}",
+                        data=body, method="POST",
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        out = json.loads(r.read())
+                    with lock:
+                        appends["acked_rows"] += int(out.get("acked", 0))
+                except urllib.error.HTTPError as e:
+                    with lock:
+                        if e.code in (429, 503):
+                            appends["shed"] += 1
+                        else:
+                            appends["errors"] += 1
+                    lead = leader_of()  # maybe a failover moved it
+                except Exception:
+                    with lock:
+                        appends["errors"] += 1
+                    lead = leader_of()
+                continue
+            u = backends[(tid + i) % len(backends)]
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(
+                    f"{u}/{args.endpoint}/{args.feature_name}?cql={cql}",
+                    timeout=60,
+                ) as r:
+                    r.read()
+            except urllib.error.HTTPError as e:
+                with lock:
+                    key = "rejected" if e.code in (429, 503) else "errors"
+                    stats[u][key] += 1
+                continue
+            except Exception:
+                with lock:
+                    stats[u]["errors"] += 1
+                continue
+            with lock:
+                stats[u]["ok"] += 1
+                stats[u]["lats"].append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(args.threads)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    per_backend = {}
+    for u, st in stats.items():
+        lats = sorted(st["lats"])
+        per_backend[u] = {
+            "ok": st["ok"],
+            "rejected": st["rejected"],
+            "errors": st["errors"],
+            "qps": round(st["ok"] / wall, 1) if wall > 0 else None,
+            "p50_ms": round(lats[len(lats) // 2] * 1e3, 2) if lats else None,
+            "p99_ms": (
+                round(
+                    lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 2
+                )
+                if lats
+                else None
+            ),
+        }
+    print(json.dumps({
+        "backends": per_backend,
+        "appends": appends,
+        "wall_s": round(wall, 3),
+    }, indent=2))
+
+
+def cmd_load_driver(args):
+    """Concurrent load driver: M threads x N requests against a serving
+    endpoint (an already-running --url, or a self-served resident store),
+    reporting throughput, latency percentiles, shed load (429s) and the
+    scheduler's fusion counters from /stats/sched. ``--backends`` flips
+    to the replicated-group mode: mixed read/write load over N replicas
+    (or a router) with per-backend qps/error splits."""
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+    from urllib.parse import quote
+
+    if getattr(args, "backends", None):
+        return _load_driver_backends(args)
     url, server = args.url, None
     if url is None:
         from geomesa_tpu.server import serve_background
@@ -843,6 +1059,78 @@ def cmd_load_driver(args):
     if server is not None:
         # shutdown drains + joins the scheduler too (make_server wiring)
         server.shutdown()
+
+
+def cmd_route(args):
+    """Run the health-routed front tier over a replication group:
+    reads fan across ready replicas (per-backend circuit breakers,
+    retried on failure), appends pin to the current leader and shed
+    503 + Retry-After through a promotion (router.* conf keys; state
+    on /stats/router)."""
+    from geomesa_tpu.router import make_router
+
+    backends = _parse_backends(args.backends)
+    server = make_router(backends, args.host, args.port)
+    host, port = server.server_address[:2]
+    print(
+        f"routing {len(backends)} backend(s) on http://{host}:{port}"
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+def cmd_fleet(args):
+    """Fleet orchestration over a replicated serving group.
+
+    ``fleet status`` prints every backend's role/lag/readiness.
+    ``fleet restart`` cycles the group through a rolling restart —
+    followers first, leader last; each node drains (POST
+    /admin/shutdown), followers catch up to lag 0 before the leader
+    is killed, and /count is verified bit-identical across the fleet
+    after every step. ``--spawn`` is the shell template that brings a
+    node back ({url} {host} {port} {role} {leader} placeholders)."""
+    from urllib.parse import urlsplit
+
+    from geomesa_tpu.tools import fleet
+
+    backends = _parse_backends(args.backends)
+    if args.action == "status":
+        doc = {}
+        for u in backends:
+            try:
+                doc[u] = fleet.probe(u)
+            except Exception as e:
+                doc[u] = {"error": repr(e)}
+        print(json.dumps(doc, indent=2))
+        return
+    # action == "restart"
+    if not args.spawn:
+        sys.exit("error: fleet restart needs --spawn 'command template'")
+
+    def restart(url, role, leader_url):
+        import subprocess
+
+        u = urlsplit(url)
+        cmd = args.spawn.format(
+            url=url, host=u.hostname, port=u.port, role=role,
+            leader=leader_url,
+        )
+        # detached: the node must outlive this orchestrator process
+        subprocess.Popen(
+            cmd, shell=True, start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    try:
+        report = fleet.rolling_restart(
+            backends, restart, timeout_s=args.timeout,
+            log=lambda m: print(m, file=sys.stderr),
+        )
+    except fleet.FleetError as e:
+        sys.exit(f"error: {e}")
+    print(json.dumps(report, indent=2))
 
 
 def cmd_lint(args):
@@ -1208,6 +1496,27 @@ def main(argv=None) -> None:
         "generation, compacted in the background (stream.*/wal.* conf "
         "keys; state on /stats/stream)",
     )
+    sp.add_argument(
+        "--replica-role", choices=["leader", "follower"],
+        help="join a replication group (implies --stream): leaders "
+        "serve GET /wal/<type> to followers; followers tail the "
+        "--leader, reject appends with 503, and promote within "
+        "replica.failover.s when the leader's lease expires",
+    )
+    sp.add_argument(
+        "--leader",
+        help="with --replica-role follower: the leader's base URL",
+    )
+    sp.add_argument(
+        "--peers",
+        help="comma-separated base URLs of the OTHER group members "
+        "(failover election: the most-caught-up peer promotes)",
+    )
+    sp.add_argument(
+        "--advertise",
+        help="this server's base URL as peers should reach it "
+        "(default http://<host>:<port> from the bound socket)",
+    )
     _add_sched_flags(sp)
     _add_io_flags(sp)
 
@@ -1272,7 +1581,36 @@ def main(argv=None) -> None:
                     default=True,
                     help="self-serve in resident mode (--no-resident "
                     "load-tests the store path instead)")
+    sp.add_argument("--backends",
+                    help="comma-separated host:port list: mixed "
+                    "read/write load over a replicated group (or its "
+                    "router) with per-backend qps/error splits")
+    sp.add_argument("--append-every", type=int, default=0,
+                    help="with --backends: every Nth request per "
+                    "thread is a synthetic append to the current "
+                    "leader (0 = reads only)")
+    sp.add_argument("--append-rows", type=int, default=8,
+                    help="rows per synthetic append")
     _add_sched_flags(sp)
+
+    sp = add("route", cmd_route)
+    sp.add_argument("--backends", required=True,
+                    help="comma-separated host:port (or full URL) list "
+                    "of the replicas to front")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8079)
+
+    sp = add("fleet", cmd_fleet)
+    sp.add_argument("action", choices=["status", "restart"])
+    sp.add_argument("--backends", required=True,
+                    help="comma-separated host:port (or full URL) list "
+                    "of the group members")
+    sp.add_argument("--spawn",
+                    help="restart: shell template that re-launches a "
+                    "node; {url} {host} {port} {role} {leader} "
+                    "placeholders")
+    sp.add_argument("--timeout", type=float, default=60.0,
+                    help="per-step bound (drain, catch-up, converge)")
 
     args = p.parse_args(argv)
     try:

@@ -1,0 +1,283 @@
+"""Rolling-restart orchestration for a replicated serving group (ISSUE 14).
+
+The sequence that cycles a leader + N WAL-shipping followers through a
+restart with zero failed reads and zero acked-row loss:
+
+1. **Converge check** — every backend's ``/count/<type>`` must be
+   bit-identical (one consistent sweep across the fleet) before any
+   step begins. The same check re-runs after EVERY node's cycle; a
+   divergence aborts the restart with the per-backend counts in hand.
+2. **Followers first.** Each follower is drained (POST
+   ``/admin/shutdown`` — the PR 7 draining shutdown: admission stops,
+   in-flight work finishes, WAL seals), observed down, restarted by the
+   caller-provided ``restart`` hook, and waited back to ready with
+   replication lag zero before the next node starts.
+3. **Leader last.** Followers are first waited to ``lag == 0`` (the
+   ship endpoint stays open during the drain precisely so stragglers
+   can finish), then the leader drains — from that instant appends shed
+   503 + Retry-After (BOUNDED shedding; reads keep serving from the
+   followers). One follower's lease expires and it promotes
+   (watermark-exact, PR 10 replay invariants — no acked row can
+   differ); the orchestrator waits for the new leader, then restarts
+   the old one AS A FOLLOWER of the new leader so the sequence space
+   never forks.
+
+``restart`` is a callable ``restart(url, role, leader_url)`` — tests
+pass a closure that re-serves in-process; the CLI builds one from a
+shell template (``fleet restart --spawn``). The orchestrator only
+speaks HTTP to the backends, so it can run from anywhere that can
+reach the group.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+__all__ = [
+    "FleetError",
+    "fleet_counts",
+    "probe",
+    "rolling_restart",
+    "verify_converged",
+    "wait_caught_up",
+    "wait_down",
+    "wait_leader",
+]
+
+
+class FleetError(RuntimeError):
+    """A fleet orchestration step failed or timed out."""
+
+
+def _get(url: str, path: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def probe(url: str, timeout: float = 10.0) -> dict:
+    """One backend's replication view: ``/stats/replica`` merged with
+    ``/readyz`` (readiness can be a 503 body while draining — still a
+    doc). Raises ``URLError`` when the backend is unreachable."""
+    doc = _get(url, "/stats/replica", timeout=timeout)
+    try:
+        with urllib.request.urlopen(url + "/readyz", timeout=timeout) as r:
+            rz = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            rz = json.loads(e.read())
+        except Exception:
+            rz = {"ready": False}
+    doc["ready"] = bool(rz.get("ready"))
+    doc["draining"] = bool(rz.get("draining"))
+    return doc
+
+
+def fleet_counts(backends: "list[str]", types: "list[str] | None" = None,
+                 timeout: float = 30.0) -> dict:
+    """``{type: {backend_url: count}}`` in one sweep. Types default to
+    the first reachable backend's ``/capabilities``."""
+    if types is None:
+        for url in backends:
+            try:
+                types = sorted(_get(url, "/capabilities")["types"])
+                break
+            except Exception:
+                continue
+        else:
+            raise FleetError("no backend answered /capabilities")
+    out: dict = {}
+    for t in types:
+        out[t] = {}
+        for url in backends:
+            try:
+                out[t][url] = int(
+                    _get(url, f"/count/{t}", timeout=timeout)["count"]
+                )
+            except Exception as e:
+                out[t][url] = f"error: {e!r}"
+    return out
+
+
+def verify_converged(
+    backends: "list[str]", timeout_s: float = 30.0, poll_s: float = 0.25,
+    types: "list[str] | None" = None,
+) -> dict:
+    """Wait until one sweep sees bit-identical counts on every backend
+    for every type; returns that converged ``{type: count}``. Under
+    concurrent ingest a single sweep can legitimately straddle an
+    append, so convergence is retried until ``timeout_s``."""
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        last = fleet_counts(backends, types=types)
+        if all(
+            len(set(per.values())) == 1
+            and not any(isinstance(v, str) for v in per.values())
+            for per in last.values()
+        ):
+            return {t: next(iter(per.values())) for t, per in last.items()}
+        time.sleep(poll_s)
+    raise FleetError(
+        f"fleet counts never converged within {timeout_s}s: "
+        f"{json.dumps(last)}"
+    )
+
+
+def wait_caught_up(url: str, timeout_s: float = 30.0,
+                   poll_s: float = 0.1) -> None:
+    """Wait until ``url`` reports replication ``lag_records == 0``
+    against everything its leader has advertised (leaders are trivially
+    caught up)."""
+    deadline = time.monotonic() + timeout_s
+    last: dict = {}
+    while time.monotonic() < deadline:
+        try:
+            last = probe(url)
+        except Exception:
+            time.sleep(poll_s)
+            continue
+        if not last.get("enabled", False):
+            return  # unreplicated: nothing to lag behind
+        if last.get("role") == "leader" or last.get("lag_records") == 0:
+            return
+        time.sleep(poll_s)
+    raise FleetError(
+        f"{url} never caught up within {timeout_s}s: {json.dumps(last)}"
+    )
+
+
+def drain(url: str, timeout: float = 10.0) -> dict:
+    """Trigger the draining shutdown remotely."""
+    req = urllib.request.Request(
+        url + "/admin/shutdown", data=b"", method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def wait_down(url: str, timeout_s: float = 30.0,
+              poll_s: float = 0.1) -> None:
+    """Wait until ``url`` stops answering ``/healthz`` entirely (the
+    accept loop stopped — drain complete, process exiting)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            _get(url, "/healthz", timeout=2.0)
+        except Exception:
+            return
+        time.sleep(poll_s)
+    raise FleetError(f"{url} still serving {timeout_s}s after its drain")
+
+
+def wait_leader(backends: "list[str]", timeout_s: float = 30.0,
+                poll_s: float = 0.1) -> str:
+    """Wait until some backend reports ``role == "leader"``; returns
+    its url."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        for url in backends:
+            try:
+                doc = _get(url, "/stats/replica", timeout=2.0)
+            except Exception:
+                continue
+            if not doc.get("enabled", False) or doc.get("role") == "leader":
+                return url
+        time.sleep(poll_s)
+    raise FleetError(
+        f"no leader emerged among {backends} within {timeout_s}s"
+    )
+
+
+def wait_ready(url: str, timeout_s: float = 30.0,
+               poll_s: float = 0.1) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/readyz", timeout=2.0):
+                return
+        except Exception:
+            time.sleep(poll_s)
+    raise FleetError(f"{url} not ready {timeout_s}s after restart")
+
+
+def rolling_restart(
+    backends: "list[str]", restart, timeout_s: float = 60.0,
+    log=print,
+) -> dict:
+    """Cycle every backend through drain → down → restart → caught-up,
+    followers first, the leader last (appends shed bounded only during
+    its promotion window). ``restart(url, role, leader_url)`` brings
+    the process at ``url`` back up in the given role. Returns a report:
+    per-step timings and the converged per-type counts verified after
+    every step."""
+    t0 = time.monotonic()
+    report: dict = {"steps": [], "backends": list(backends)}
+    baseline = verify_converged(backends, timeout_s=timeout_s)
+    report["baseline_counts"] = baseline
+    log(f"fleet: baseline converged {baseline}")
+
+    stats = {}
+    for url in backends:
+        stats[url] = probe(url)
+    leaders = [u for u, d in stats.items()
+               if d.get("enabled") and d.get("role") == "leader"]
+    followers = [u for u in backends if u not in leaders]
+    if len(leaders) > 1:
+        raise FleetError(f"multiple leaders: {leaders}")
+    leader = leaders[0] if leaders else None
+
+    def _cycle(url: str, role: str, leader_url: str) -> None:
+        step = {"url": url, "role": role, "t0_s": round(
+            time.monotonic() - t0, 3)}
+        drain(url)
+        wait_down(url, timeout_s=timeout_s)
+        restart(url, role, leader_url)
+        wait_ready(url, timeout_s=timeout_s)
+        wait_caught_up(url, timeout_s=timeout_s)
+        live = [u for u in backends]
+        step["counts"] = verify_converged(live, timeout_s=timeout_s)
+        step["dur_s"] = round(time.monotonic() - t0 - step["t0_s"], 3)
+        report["steps"].append(step)
+        log(f"fleet: cycled {url} ({role}) in {step['dur_s']}s; "
+            f"counts {step['counts']}")
+
+    for url in followers:
+        if stats[url].get("enabled") and leader is not None:
+            _cycle(url, "follower", leader)
+        else:
+            _cycle(url, "leader" if leader is None else "follower",
+                   leader or url)
+
+    if leader is not None:
+        # every follower fully caught up BEFORE the leader goes away:
+        # combined with the drain (no new appends after it starts) and
+        # the ship endpoint staying open through the drain window, the
+        # promoted follower holds every acked row
+        for url in followers:
+            wait_caught_up(url, timeout_s=timeout_s)
+        drain(leader)
+        wait_down(leader, timeout_s=timeout_s)
+        new_leader = leader
+        if followers:
+            new_leader = wait_leader(followers, timeout_s=timeout_s)
+            log(f"fleet: {new_leader} promoted after {leader} drained")
+        # the old leader rejoins as a FOLLOWER of its successor — two
+        # leaders would fork the WAL sequence space
+        role = "follower" if followers else "leader"
+        restart(leader, role, new_leader)
+        wait_ready(leader, timeout_s=timeout_s)
+        wait_caught_up(leader, timeout_s=timeout_s)
+        step = {
+            "url": leader, "role": role, "new_leader": new_leader,
+            "counts": verify_converged(backends, timeout_s=timeout_s),
+        }
+        report["steps"].append(step)
+        log(f"fleet: cycled old leader {leader} -> {role}; "
+            f"counts {step['counts']}")
+
+    report["final_counts"] = verify_converged(backends, timeout_s=timeout_s)
+    report["wall_s"] = round(time.monotonic() - t0, 3)
+    return report

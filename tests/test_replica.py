@@ -1,0 +1,759 @@
+"""Replicated serving tier (ISSUE 14): WAL shipping, follower apply,
+bounded failover, the router front tier and rolling restarts.
+
+The contracts under test:
+
+- **Framing**: the replication wire format IS the on-disk WAL framing —
+  ``pack_record`` + ``RecordParser`` roundtrip byte-exactly across any
+  chunking; corruption raises, never mis-applies.
+- **Cursor**: ``read_from`` is a READONLY iterator — it never truncates
+  a live appender's torn tail (the CLI ``wal`` command and the ship
+  endpoint share it); ``append_at`` installs leader-assigned seqs.
+- **Ship + tail**: a follower converges to the leader's exact row set
+  and reports lag 0; appends to a follower bounce 503 + the leader's
+  URL; a position below the leader's compaction watermark is 410 Gone
+  (re-provision), not silent wrong answers.
+- **Failover kill matrix**: SIGKILL the leader at ``fail.wal.append``,
+  mid-tail under load, and with promotion itself faulted
+  (``fail.replica.promote``) — the surviving fleet serves exactly
+  seed ∪ acked rows: no phantoms, no double-apply, bounded promotion.
+- **Rolling restart**: the fleet orchestrator cycles a 3-replica group
+  with /count bit-identical across the fleet after every step.
+"""
+
+import json
+import multiprocessing as mp
+import os
+import shutil
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.conf import prop_override, sys_prop
+from geomesa_tpu.store.fs import FileSystemDataStore
+from geomesa_tpu.store.wal import (
+    RecordParser,
+    WalCorruption,
+    WriteAheadLog,
+    pack_record,
+)
+
+SPEC = "val:Int,dtg:Date,*geom:Point:srid=4326"
+N0 = 40
+
+
+def _rows(n, seed, fid0=0):
+    rng = np.random.default_rng(seed)
+    cols = {
+        "val": rng.integers(0, 100, n),
+        "dtg": rng.integers(0, 10**9, n),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+        ),
+    }
+    return cols, np.arange(fid0, fid0 + n)
+
+
+def _seeded_root(tmp_path, name="leader", n0=N0):
+    root = str(tmp_path / name)
+    ds = FileSystemDataStore(root, partition_size=128)
+    ds.create_schema("t", SPEC)
+    cols, fids = _rows(n0, seed=1)
+    ds.write("t", cols, fids=fids)
+    ds.flush("t")
+    del ds
+    return root
+
+
+def _get(base, path, timeout=60):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(base, path, doc, timeout=60):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(doc).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _append_doc(fids, x=10.0):
+    n = len(fids)
+    return {
+        "columns": {
+            "val": list(range(n)),
+            "dtg": [1000 + i for i in range(n)],
+            "geom": [[x, x]] * n,
+        },
+        "fids": list(fids),
+    }
+
+
+def _fids(base):
+    feats = _get(base, "/features/t?cql=INCLUDE&maxFeatures=100000")
+    return {int(f["id"]) for f in feats["features"]}
+
+
+def _wait(pred, timeout_s=20.0, poll_s=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(poll_s)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- framing + cursor unit tests ---------------------------------------------
+
+
+def test_pack_record_parser_roundtrip_any_chunking():
+    records = [(i, f"payload-{i}".encode() * (i + 1)) for i in range(20)]
+    wire = b"".join(pack_record(s, p) for s, p in records)
+    for chunk in (1, 7, 64, len(wire)):
+        parser = RecordParser()
+        got = []
+        for off in range(0, len(wire), chunk):
+            got.extend(parser.feed(wire[off:off + chunk]))
+        assert got == records
+        assert parser.pending_bytes == 0
+
+
+def test_record_parser_rejects_corruption():
+    wire = pack_record(0, b"x" * 64)
+    bad = bytearray(wire)
+    bad[-5] ^= 0xFF  # payload bit flip -> CRC mismatch
+    with pytest.raises(WalCorruption):
+        RecordParser().feed(bytes(bad))
+    bad2 = bytearray(wire)
+    bad2[0] ^= 0xFF  # magic damage
+    with pytest.raises(WalCorruption):
+        RecordParser().feed(bytes(bad2))
+
+
+def test_wal_read_from_cursor_and_append_at(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    for i in range(5):
+        wal.append(f"rec-{i}".encode())
+    assert [s for s, _ in wal.read_from(-1)] == [0, 1, 2, 3, 4]
+    assert [s for s, _ in wal.read_from(2)] == [3, 4]
+    assert wal.first_seq() == 0
+    # append_at adopts a leader-assigned seq (gaps allowed, rewinds not)
+    assert wal.append_at(9, b"from-leader") == 9
+    assert wal.next_seq == 10
+    with pytest.raises(ValueError):
+        wal.append_at(3, b"rewind")
+    assert [s for s, _ in wal.read_from(4)] == [9]
+    wal.close()
+
+
+def test_wal_read_from_never_truncates_live_tail(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    for i in range(4):
+        wal.append(f"rec-{i}".encode())
+    wal.close()
+    [seg] = wal.segments()
+    with open(seg, "ab") as fh:  # a torn in-flight append
+        fh.write(b"\x41\x57\x4d\x47torn-garbage")
+    size = os.path.getsize(seg)
+    ro = WriteAheadLog(str(tmp_path / "wal"), readonly=True)
+    assert [s for s, _ in ro.read_from(-1)] == [0, 1, 2, 3]
+    # the cursor must NOT have cut the tail out from under the appender
+    assert os.path.getsize(seg) == size
+    assert ro.truncations == 0
+    ro.close()
+
+
+def test_http_keepalive_is_a_declared_conf_key(tmp_path):
+    """Satellite: the PR 12 hard-coded ``_Handler.timeout = 60`` is now
+    the declared ``http.keepalive.s`` key, resolved at make_server."""
+    from geomesa_tpu.server import serve_background
+
+    assert float(sys_prop("http.keepalive.s")) == 60.0
+    root = _seeded_root(tmp_path, "ka")
+    ds = FileSystemDataStore(root, partition_size=128)
+    with prop_override("http.keepalive.s", 17.5):
+        server, _ = serve_background(ds)
+        try:
+            assert server.RequestHandlerClass.timeout == 17.5
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+# -- ship + tail --------------------------------------------------------------
+
+
+@pytest.fixture
+def pair(tmp_path):
+    """A leader + one follower on copied roots, fast replication knobs.
+    Yields (leader_base, follower_base, leader_server, follower_server);
+    shuts both down afterwards."""
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+
+    lroot = _seeded_root(tmp_path, "leader")
+    froot = str(tmp_path / "follower")
+    shutil.copytree(lroot, froot)
+    with prop_override("replica.lease.s", 1.5), \
+            prop_override("replica.poll.ms", 25.0), \
+            prop_override("replica.failover.s", 8.0):
+        lsrv, _ = serve_background(
+            FileSystemDataStore(lroot, partition_size=128),
+            stream=True, replica=ReplicaConfig(role="leader"),
+        )
+        lbase = "http://%s:%s" % lsrv.server_address[:2]
+        fsrv, _ = serve_background(
+            FileSystemDataStore(froot, partition_size=128),
+            stream=True,
+            replica=ReplicaConfig(role="follower", leader_url=lbase),
+        )
+        fbase = "http://%s:%s" % fsrv.server_address[:2]
+        yield lbase, fbase, lsrv, fsrv
+        for s in (lsrv, fsrv):
+            try:
+                s.shutdown()
+                s.server_close()
+            except Exception:
+                pass
+
+
+def test_follower_converges_and_reports_lag(pair):
+    lbase, fbase, _, _ = pair
+    out = _post(lbase, "/append/t", _append_doc([9001, 9002, 9003]))
+    assert out["acked"] == 3
+    _wait(
+        lambda: _get(fbase, "/count/t")["count"] == N0 + 3,
+        msg="follower catch-up",
+    )
+    assert _fids(fbase) == _fids(lbase)
+    st = _get(fbase, "/stats/replica")
+    assert st["enabled"] and st["role"] == "follower"
+    assert st["lag_records"] == 0
+    assert st["leader"] == lbase
+    assert st["types"]["t"]["next_seq"] == 1
+    lst = _get(lbase, "/stats/replica")
+    assert lst["role"] == "leader"
+    # the leader saw the follower's applied position (ship accounting)
+    assert fbase in lst["followers"]
+    # the roll-ups carry the replica doc too
+    assert _get(fbase, "/stats")["replica"]["role"] == "follower"
+    assert _get(fbase, "/readyz")["replica_role"] == "follower"
+
+
+def test_follower_rejects_appends_with_leader_url(pair):
+    lbase, fbase, _, _ = pair
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(fbase, "/append/t", _append_doc([9100]))
+    assert ei.value.code == 503
+    assert ei.value.headers["Retry-After"]
+    doc = json.loads(ei.value.read())
+    assert doc["leader"] == lbase
+
+
+def test_apply_fault_retries_without_loss_or_double_apply(pair):
+    from geomesa_tpu.failpoints import failpoint_override
+
+    lbase, fbase, _, _ = pair
+    with failpoint_override("fail.replica.apply", "raise:1"):
+        _post(lbase, "/append/t", _append_doc([9301, 9302]))
+        _wait(
+            lambda: _get(fbase, "/count/t")["count"] == N0 + 2,
+            msg="apply retried past the fault",
+        )
+    assert _fids(fbase) == _fids(lbase)
+
+
+def test_ship_from_compacted_position_is_410_gone(tmp_path):
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+
+    lroot = _seeded_root(tmp_path, "leader")
+    ds = FileSystemDataStore(lroot, partition_size=128)
+    # tiny segments (clamped to 4 KiB) so the appends below seal at
+    # least one segment for truncate_through to actually remove
+    with prop_override("wal.segment.bytes", 1):
+        lsrv, _ = serve_background(
+            ds, stream=True, replica=ReplicaConfig(role="leader"),
+        )
+        lbase = "http://%s:%s" % lsrv.server_address[:2]
+        for i in range(24):
+            _post(
+                lbase, "/append/t",
+                _append_doc(list(range(9000 + i * 8, 9008 + i * 8))),
+            )
+    try:
+        stream = lsrv.stream_layer
+        stream.compact_now("t")  # publishes the watermark AND truncates
+        ts = stream._ts("t")
+        assert ts.wal.first_seq() > 0  # the shipped history is really gone
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(lbase, "/wal/t?from=0")
+        assert ei.value.code == 410
+        doc = json.loads(ei.value.read())
+        assert "re-provision" in doc["error"]
+        # a CURRENT position still ships fine (204-equivalent empty 200)
+        nxt = int(_get(lbase, "/stats/replica")["types"]["t"]["next_seq"])
+        with urllib.request.urlopen(
+            f"{lbase}/wal/t?from={nxt}", timeout=30
+        ) as r:
+            assert r.status == 200
+            assert int(r.headers["X-Wal-Next-Seq"]) == nxt
+            assert r.read() == b""
+    finally:
+        lsrv.shutdown()
+        lsrv.server_close()
+
+
+def test_replica_ack_mode_waits_for_follower(pair):
+    lbase, fbase, _, _ = pair
+    with prop_override("replica.ack", "replica"):
+        out = _post(lbase, "/append/t", _append_doc([9401, 9402]))
+    assert out["acked"] == 2
+    assert out["replicated"] is True
+    # replicated=True means the follower already holds the rows NOW
+    assert _get(fbase, "/count/t")["count"] == N0 + 2
+
+
+# -- failover ----------------------------------------------------------------
+
+
+def test_lease_expiry_promotes_follower_exactly(pair):
+    lbase, fbase, lsrv, _ = pair
+    _post(lbase, "/append/t", _append_doc([9501, 9502]))
+    _wait(
+        lambda: _get(fbase, "/count/t")["count"] == N0 + 2,
+        msg="pre-failover catch-up",
+    )
+    expected = _fids(lbase)
+    lsrv.socket.close()  # abrupt death, no drain
+    lsrv.shutdown()
+    _wait(
+        lambda: _get(fbase, "/stats/replica")["role"] == "leader",
+        msg="promotion",
+    )
+    st = _get(fbase, "/stats/replica")
+    assert st["failovers"] == 1
+    bound = float(sys_prop("replica.failover.s"))
+    assert st["last_failover_seconds"] <= bound
+    # watermark-exact: the promoted follower serves exactly the acked set
+    assert _fids(fbase) == expected
+    # and takes appends at the next seq — the sequence space never forks
+    out = _post(fbase, "/append/t", _append_doc([9503]))
+    assert out["acked"] == 1
+    assert _get(fbase, "/count/t")["count"] == N0 + 3
+
+
+def test_promotion_fault_rolls_back_then_retries(pair):
+    from geomesa_tpu.failpoints import failpoint_override
+
+    lbase, fbase, lsrv, fsrv = pair
+    with failpoint_override("fail.replica.promote", "raise:1"):
+        lsrv.socket.close()
+        lsrv.shutdown()
+        # first promotion attempt fails AND rolls back to follower;
+        # the next election cycle succeeds once the fault budget is spent
+        _wait(
+            lambda: _get(fbase, "/stats/replica")["role"] == "leader",
+            timeout_s=30.0, msg="promotion after a faulted attempt",
+        )
+    assert _fids(fbase) == set(range(N0))
+    assert _post(fbase, "/append/t", _append_doc([9601]))["acked"] == 1
+
+
+def test_failover_stamped_in_flight_recorder(tmp_path):
+    """Promotion writes a ``replica-failover`` flight-recorder bundle
+    (the follower's make_server configured the recorder last, so its
+    ``<root>/_flightrec`` is live when the promotion fires)."""
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+
+    lroot = _seeded_root(tmp_path, "leader")
+    froot = str(tmp_path / "follower")
+    shutil.copytree(lroot, froot)
+    # interval 0: an earlier test's promotion must not rate-limit ours
+    with prop_override("replica.lease.s", 1.0), \
+            prop_override("replica.poll.ms", 25.0), \
+            prop_override("slo.flightrec.interval.s", 0.0):
+        lsrv, _ = serve_background(
+            FileSystemDataStore(lroot, partition_size=128),
+            stream=True, replica=ReplicaConfig(role="leader"),
+        )
+        lbase = "http://%s:%s" % lsrv.server_address[:2]
+        fsrv, _ = serve_background(
+            FileSystemDataStore(froot, partition_size=128),
+            stream=True,
+            replica=ReplicaConfig(role="follower", leader_url=lbase),
+        )
+        fbase = "http://%s:%s" % fsrv.server_address[:2]
+        try:
+            _wait(
+                lambda: fbase
+                in _get(lbase, "/stats/replica")["followers"],
+                msg="tail established (a ship happened)",
+            )
+            lsrv.socket.close()
+            lsrv.shutdown()
+            _wait(
+                lambda: _get(fbase, "/stats/replica")["role"] == "leader",
+                msg="promotion",
+            )
+            recdir = os.path.join(froot, "_flightrec")
+
+            def _bundles():
+                try:
+                    return sorted(
+                        e for e in os.listdir(recdir)
+                        if e.endswith("-replica-failover")
+                    )
+                except FileNotFoundError:
+                    return []
+
+            # the bundle publishes via atomic rename off the promotion
+            # thread; give the dump a beat
+            _wait(lambda: _bundles(), msg="flight-recorder bundle")
+            bundles = _bundles()
+            with open(os.path.join(recdir, bundles[-1], "reason.json")) as fh:
+                doc = json.load(fh)
+            assert doc["reason"] == "replica-failover"
+            assert doc["detail"]["self"] == fbase
+            assert doc["detail"]["dead_leader"] == lbase
+        finally:
+            for s in (lsrv, fsrv):
+                try:
+                    s.shutdown()
+                    s.server_close()
+                except Exception:
+                    pass
+
+
+# -- the kill matrix (subprocess SIGKILL legs) --------------------------------
+
+
+def _leader_proc(root, portfile, armfile):
+    """Subprocess body: a replicated leader that arms
+    ``fail.wal.append=kill`` once ``armfile`` appears — the next append
+    SIGKILLs the process mid-write, the exact instant the matrix
+    needs."""
+    from geomesa_tpu import failpoints
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+    from geomesa_tpu.store.fs import FileSystemDataStore as _FS
+
+    srv, _ = serve_background(
+        _FS(root, partition_size=128), stream=True,
+        replica=ReplicaConfig(role="leader"),
+    )
+    port = srv.server_address[1]
+    with open(portfile + ".tmp", "w") as fh:
+        fh.write(str(port))
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(portfile + ".tmp", portfile)
+    while True:
+        if armfile and os.path.exists(armfile):
+            failpoints.set_failpoint("fail.wal.append", "kill")
+        time.sleep(0.01)
+
+
+def _spawn_leader(tmp_path, lroot, arm=True):
+    ctx = mp.get_context("spawn")  # fork is unsafe under JAX threads
+    portfile = str(tmp_path / "port")
+    armfile = str(tmp_path / "arm") if arm else ""
+    p = ctx.Process(
+        target=_leader_proc, args=(lroot, portfile, armfile)
+    )
+    p.start()
+    deadline = time.monotonic() + 60
+    while not os.path.exists(portfile):
+        assert time.monotonic() < deadline, "leader subprocess never bound"
+        assert p.is_alive(), "leader subprocess died during startup"
+        time.sleep(0.05)
+    port = int(open(portfile).read())
+    return p, f"http://127.0.0.1:{port}", armfile
+
+
+@pytest.fixture
+def follower_of(tmp_path):
+    """Factory: an in-process follower of ``leader_url`` on a copy of
+    ``lroot`` made BEFORE the leader process opened it."""
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+
+    made = []
+    overrides = [
+        prop_override("replica.lease.s", 1.5),
+        prop_override("replica.poll.ms", 25.0),
+    ]
+    for o in overrides:
+        o.__enter__()
+
+    def make(froot, leader_url):
+        srv, _ = serve_background(
+            FileSystemDataStore(froot, partition_size=128),
+            stream=True,
+            replica=ReplicaConfig(role="follower", leader_url=leader_url),
+        )
+        made.append(srv)
+        return "http://%s:%s" % srv.server_address[:2], srv
+
+    yield make
+    for srv in made:
+        try:
+            srv.shutdown()
+            srv.server_close()
+        except Exception:
+            pass
+    for o in reversed(overrides):
+        o.__exit__(None, None, None)
+
+
+def test_kill_matrix_sigkill_at_wal_append(tmp_path, follower_of):
+    """SIGKILL the leader inside the WAL append (before durability):
+    the follower serves exactly seed ∪ previously-acked rows — the
+    killed append was never acked and never ships."""
+    lroot = _seeded_root(tmp_path, "leader")
+    froot = str(tmp_path / "follower")
+    shutil.copytree(lroot, froot)
+    p, lbase, armfile = _spawn_leader(tmp_path, lroot)
+    try:
+        fbase, _ = follower_of(froot, lbase)
+        acked = set(range(N0))
+        out = _post(lbase, "/append/t", _append_doc([9001, 9002, 9003]))
+        assert out["acked"] == 3
+        acked |= {9001, 9002, 9003}
+        _wait(
+            lambda: _get(fbase, "/count/t")["count"] == len(acked),
+            msg="pre-kill catch-up",
+        )
+        open(armfile, "w").close()
+        time.sleep(0.3)  # the subprocess polls the armfile every 10ms
+        with pytest.raises(Exception):  # connection dies mid-append
+            _post(lbase, "/append/t", _append_doc([9004, 9005]))
+        p.join(60)
+        assert p.exitcode == -signal.SIGKILL
+        # no phantoms (9004/9005 never acked), no loss, no double-apply
+        time.sleep(0.5)
+        assert _fids(fbase) == acked
+        assert _get(fbase, "/count/t")["count"] == len(acked)
+    finally:
+        if p.is_alive():
+            p.kill()
+        p.join(10)
+
+
+def test_kill_matrix_sigkill_mid_tail_under_load(tmp_path, follower_of):
+    """External SIGKILL while the follower is actively tailing under
+    concurrent append + query load: reads never fail over the window,
+    and the follower ends with acked ⊆ served ⊆ acked ∪ the one
+    in-flight batch (durable-but-unacked at the kill is legal — it is
+    the same ambiguity a crashed single node has)."""
+    lroot = _seeded_root(tmp_path, "leader")
+    froot = str(tmp_path / "follower")
+    shutil.copytree(lroot, froot)
+    p, lbase, _ = _spawn_leader(tmp_path, lroot, arm=False)
+    acked = set(range(N0))
+    inflight: set = set()
+    read_errors = []
+    stop_reads = threading.Event()
+
+    def reader():
+        while not stop_reads.is_set():
+            try:
+                _get(fbase, "/count/t", timeout=10)
+            except Exception as e:
+                read_errors.append(repr(e))
+            time.sleep(0.01)
+
+    try:
+        fbase, _ = follower_of(froot, lbase)
+        rt = threading.Thread(target=reader)
+        rt.start()
+        fid = 9000
+        batches = 0
+        while batches < 6:
+            fids = list(range(fid, fid + 4))
+            fid += 4
+            inflight.update(fids)
+            out = _post(lbase, "/append/t", _append_doc(fids))
+            assert out["acked"] == 4
+            inflight.difference_update(fids)
+            acked.update(fids)
+            batches += 1
+        # one more append racing the kill: ack outcome unknown
+        fids = list(range(fid, fid + 4))
+        inflight.update(fids)
+        killer = threading.Timer(0.01, lambda: os.kill(p.pid, signal.SIGKILL))
+        killer.start()
+        try:
+            out = _post(lbase, "/append/t", _append_doc(fids))
+            if out.get("acked"):
+                acked.update(fids)
+                inflight.difference_update(fids)
+        except Exception:
+            pass  # killed mid-request: stays in the in-flight set
+        p.join(60)
+        assert p.exitcode == -signal.SIGKILL
+        time.sleep(1.0)  # let the tail drain whatever shipped
+        stop_reads.set()
+        rt.join(10)
+        # reads kept serving from the follower throughout the kill
+        assert read_errors == []
+        got = _fids(fbase)
+        assert acked <= got, f"lost acked rows: {sorted(acked - got)[:10]}"
+        assert got <= acked | inflight, (
+            f"phantom rows: {sorted(got - acked - inflight)[:10]}"
+        )
+        # no double-apply: row count == distinct fids
+        assert _get(fbase, "/count/t")["count"] == len(got)
+    finally:
+        stop_reads.set()
+        if p.is_alive():
+            p.kill()
+        p.join(10)
+
+
+# -- router front tier --------------------------------------------------------
+
+
+def test_router_reads_retry_and_appends_pin_to_leader(pair):
+    from geomesa_tpu.router import route_background
+
+    lbase, fbase, lsrv, _ = pair
+    with prop_override("router.health.ms", 80.0):
+        rsrv, _ = route_background([lbase, fbase])
+        rbase = "http://%s:%s" % rsrv.server_address[:2]
+        try:
+            _wait(
+                lambda: _get(rbase, "/stats/router")["leader"] == lbase,
+                msg="router leader discovery",
+            )
+            # reads round-robin both replicas
+            for _ in range(4):
+                assert _get(rbase, "/count/t")["count"] == N0
+            # appends land on the leader through the router
+            out = _post(rbase, "/append/t", _append_doc([9701]))
+            assert out["acked"] == 1
+            _wait(
+                lambda: _get(fbase, "/count/t")["count"] == N0 + 1,
+                msg="follower catch-up",
+            )
+            # leader dies: reads keep serving (retried onto the follower)
+            lsrv.socket.close()
+            lsrv.shutdown()
+            for _ in range(10):
+                assert _get(rbase, "/count/t")["count"] == N0 + 1
+            # appends shed 503+Retry-After until promotion, then resume
+            deadline = time.monotonic() + 20
+            out = None
+            while time.monotonic() < deadline:
+                try:
+                    out = _post(rbase, "/append/t", _append_doc([9702]))
+                    break
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503
+                    assert e.headers.get("Retry-After")
+                    time.sleep(0.2)
+            assert out is not None and out["acked"] == 1
+            st = _get(rbase, "/stats/router")
+            assert st["leader"] == fbase
+        finally:
+            rsrv.shutdown()
+            rsrv.server_close()
+
+
+def test_router_rejects_admin_posts(pair):
+    from geomesa_tpu.router import route_background
+
+    lbase, fbase, _, _ = pair
+    rsrv, _ = route_background([lbase, fbase])
+    rbase = "http://%s:%s" % rsrv.server_address[:2]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(rbase, "/admin/shutdown", {})
+        assert ei.value.code == 404  # backends must be drained directly
+        assert _get(lbase, "/healthz")  # nobody drained anything
+    finally:
+        rsrv.shutdown()
+        rsrv.server_close()
+
+
+# -- rolling restart ----------------------------------------------------------
+
+
+def test_rolling_restart_three_replicas_bit_identical(tmp_path):
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+    from geomesa_tpu.tools import fleet
+
+    roots = {}
+    r0 = _seeded_root(tmp_path, "n0")
+    roots[0] = r0
+    for i in (1, 2):
+        roots[i] = str(tmp_path / f"n{i}")
+        shutil.copytree(r0, roots[i])
+    servers: dict = {}
+    with prop_override("replica.lease.s", 1.5), \
+            prop_override("replica.poll.ms", 25.0), \
+            prop_override("replica.failover.s", 8.0):
+        lsrv, _ = serve_background(
+            FileSystemDataStore(roots[0], partition_size=128),
+            stream=True, replica=ReplicaConfig(role="leader"),
+        )
+        lurl = "http://%s:%s" % lsrv.server_address[:2]
+        urls = [lurl]
+        servers[lurl] = lsrv
+        rootof = {lurl: roots[0]}
+        for i in (1, 2):
+            srv, _ = serve_background(
+                FileSystemDataStore(roots[i], partition_size=128),
+                stream=True,
+                replica=ReplicaConfig(role="follower", leader_url=lurl),
+            )
+            u = "http://%s:%s" % srv.server_address[:2]
+            urls.append(u)
+            servers[u] = srv
+            rootof[u] = roots[i]
+        try:
+            _post(lurl, "/append/t", _append_doc([9001, 9002]))
+
+            def restart(url, role, leader_url):
+                old = servers.pop(url, None)
+                if old is not None:
+                    old.server_close()  # a real exit frees the port
+                port = int(url.rsplit(":", 1)[1])
+                srv, _ = serve_background(
+                    FileSystemDataStore(rootof[url], partition_size=128),
+                    port=port, stream=True,
+                    replica=ReplicaConfig(
+                        role=role, self_url=url, leader_url=leader_url,
+                        peers=tuple(u for u in urls if u != url),
+                    ),
+                )
+                servers[url] = srv
+
+            report = fleet.rolling_restart(
+                urls, restart, timeout_s=40.0, log=lambda m: None
+            )
+            assert report["baseline_counts"] == {"t": N0 + 2}
+            assert report["final_counts"] == {"t": N0 + 2}
+            assert len(report["steps"]) == 3
+            # EVERY step re-verified bit-identical counts fleet-wide
+            assert all(s["counts"] == {"t": N0 + 2} for s in report["steps"])
+            roles = sorted(
+                fleet.probe(u)["role"] for u in urls
+            )
+            assert roles == ["follower", "follower", "leader"]
+        finally:
+            for srv in servers.values():
+                try:
+                    srv.shutdown()
+                    srv.server_close()
+                except Exception:
+                    pass

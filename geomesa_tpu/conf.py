@@ -242,6 +242,15 @@ Properties:
                                 ``replica.ack=replica`` mode; past it
                                 the row is acked local-only and
                                 ``replica-lag`` is stamped degraded
+- ``replica.retain.s``          follower-retention window on the
+                                leader's WAL garbage collection: the
+                                compactor never truncates segments past
+                                the lowest position reported by a
+                                follower seen within this window, so a
+                                briefly-lagging follower keeps tailing
+                                instead of falling off a 410 cliff
+                                (a follower silent LONGER than this
+                                stops pinning the log -- bounded disk)
 - ``router.retries``            read retries across DISTINCT replicas
                                 beyond the first backend the router
                                 tries (router.py)
@@ -250,6 +259,13 @@ Properties:
                                 ``/stats/replica`` are probed this
                                 often to drive routing, breaker probes
                                 and leader discovery
+- ``admin.token``               shared secret gating POST
+                                ``/admin/shutdown`` (sent as the
+                                ``X-Admin-Token`` header); empty
+                                (default) restricts the endpoint to
+                                loopback peers instead -- any reachable
+                                client being able to terminate the
+                                process is not an operator plane
 """
 
 from __future__ import annotations
@@ -462,8 +478,12 @@ _DEFS = {
     "replica.failover.s": (10.0, float),
     "replica.ack": ("local", _parse_replica_ack),
     "replica.ack.timeout.s": (2.0, float),
+    "replica.retain.s": (600.0, float),
     "router.retries": (2, int),
     "router.health.ms": (250.0, float),
+    # operator plane: shared secret for POST /admin/shutdown (empty =
+    # loopback peers only)
+    "admin.token": ("", str),
 }
 
 _overrides: dict = {}

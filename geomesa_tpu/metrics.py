@@ -698,6 +698,11 @@ replica_role = REGISTRY.gauge(
     "geomesa_replica_role",
     "replication role of this process (0=follower, 1=promoting, 2=leader)",
 )
+replica_demotions = REGISTRY.counter(
+    "geomesa_replica_demotions_total",
+    "leader roles this process surrendered after observing a higher "
+    "election epoch (fencing: a stale leader must not take appends)",
+)
 router_requests = REGISTRY.counter(
     "geomesa_router_requests_total",
     "requests the router front tier completed",

@@ -19,15 +19,26 @@ it, not inventing a new protocol:
   replay and promotion are watermark-exact across the group, and every
   re-ship (crash, torn tail, overlap) is an idempotent skip.
 - **Failover** — leader death is a lease timeout (no successful ship
-  contact for ``replica.lease.s``). The follower then runs a
-  most-caught-up election over ``/stats/replica`` (total applied seq,
-  URL tie-break — deterministic, every voter computes the same winner),
-  and the winner promotes: seal the tail (stop fetching), adopt the
-  leader role, stamp ``replica-failover`` in the flight recorder. By
-  the PR 10 invariants the local WAL position IS the truth, so
-  promotion loses zero acked rows and needs zero renumbering. The
-  whole detect→elect→promote path is measured against the declared
-  ``replica.failover.s`` bound.
+  contact for ``replica.lease.s``), but a timeout alone never promotes:
+  the presumed-dead leader is re-probed directly first (a stall or one
+  lost route is not a death), and with a declared peer electorate a
+  MAJORITY of it must agree the leader is unreachable before anyone
+  runs the most-caught-up election over ``/stats/replica`` (total
+  applied seq, URL tie-break — deterministic, every voter computes the
+  same winner). The winner promotes: seal the tail (stop fetching),
+  adopt the leader role at election epoch ``max(seen)+1``, stamp
+  ``replica-failover`` in the flight recorder. By the PR 10 invariants
+  the local WAL position IS the truth, so promotion loses zero acked
+  rows and needs zero renumbering. The whole detect→elect→promote path
+  is measured against the declared ``replica.failover.s`` bound.
+- **Fencing** — the election epoch rides every ship request/response
+  and ``/stats/replica`` doc. A leader that observes a HIGHER epoch
+  (a successor was elected while it was stalled or partitioned)
+  demotes itself on the spot — appends 503 from the next request, so
+  two processes can never keep extending the same seq space. A
+  follower refuses ship payloads from a node that no longer serves as
+  leader/promoting at its epoch (:class:`StaleLeaderError`) — it
+  re-discovers instead of adopting a forked tail.
 - **Acks** — ``replica.ack=replica`` upgrades the append contract:
   the leader's 200 also waits (bounded by ``replica.ack.timeout.s``)
   until a follower has applied the record's seq; a timeout answers
@@ -51,12 +62,24 @@ from dataclasses import dataclass, field
 from geomesa_tpu.locking import checked_lock
 from geomesa_tpu.store.wal import RecordParser, WalCorruption
 
-__all__ = ["ReplicaConfig", "Replicator", "ROLES"]
+__all__ = ["ReplicaConfig", "Replicator", "StaleLeaderError", "ROLES"]
 
 #: bounded role enum (metric value + /stats/replica field)
 ROLES = ("follower", "promoting", "leader")
 
 _ROLE_GAUGE = {"follower": 0, "promoting": 1, "leader": 2}
+
+#: consecutive apply-side failures for one type before the follower
+#: stops refetching into the same error and flags needs_reprovision
+_APPLY_FAULT_LIMIT = 3
+
+
+class StaleLeaderError(RuntimeError):
+    """The node this follower tails answered a ship fetch without
+    holding the leader (or promoting) role at our election epoch:
+    it was demoted or replaced, and applying its records could adopt
+    a forked WAL tail. The tail loop drops it and rediscovers — a
+    stale leader must not refresh the lease either."""
 
 
 @dataclass
@@ -116,6 +139,12 @@ class Replicator:
         #: follower side: per-type leader position from ship headers
         self._leader_next: "dict[str, int]" = {}
         self._needs_reprovision: "set[str]" = set()
+        self._apply_failures: "dict[str, int]" = {}
+        #: election epoch — the fencing token: bumped past every epoch
+        #: seen in an election by the winner, advertised on ship
+        #: requests/responses and /stats/replica; a leader observing a
+        #: higher one steps down
+        self._epoch = 1 if config.role == "leader" else 0
         self._last_ok = time.monotonic()
         self._lease_expired_at = 0.0
         self.failovers = 0
@@ -127,14 +156,29 @@ class Replicator:
 
     def attach(self, stream) -> None:
         self.stream = stream
+        if stream is not None:
+            # pin the leader-side WAL GC to live follower positions:
+            # the compactor must not truncate segments a tailing
+            # follower still needs (the 410 re-provision cliff)
+            stream.retention_floor = self.follower_floor
 
     def start(self) -> None:
         from geomesa_tpu import metrics
 
         metrics.replica_role.set(_ROLE_GAUGE[self._role])
-        if self._role == "follower":
+        # followers tail; leaders with a declared electorate watch it
+        # for a higher-epoch successor (fencing) — both live on the
+        # same agent thread, dispatched by role
+        if self._role == "follower" or self.cfg.peers:
+            self._ensure_agent()
+
+    def _ensure_agent(self) -> None:
+        with self._lock:
+            t = self._thread
+            if self._stop.is_set() or (t is not None and t.is_alive()):
+                return
             self._thread = threading.Thread(
-                target=self._tail_loop, daemon=True, name="replica-tail"
+                target=self._run_loop, daemon=True, name="replica-agent"
             )
             self._thread.start()
 
@@ -156,6 +200,65 @@ class Replicator:
     @property
     def leader_url(self) -> str:
         return self._leader_url
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def observe_epoch(self, epoch: int) -> None:
+        """A peer advertised election ``epoch`` (the ship request's
+        ``epoch`` query param). Higher than ours while we hold the
+        leader role means a quorum elected a successor while this
+        process was stalled or partitioned — fence immediately: keeping
+        the role would fork the WAL seq space."""
+        if epoch <= self._epoch:
+            return
+        if self._role == "leader":
+            self._demote(epoch)
+        else:
+            self._epoch = max(self._epoch, epoch)
+
+    def _demote(self, epoch: int, new_leader: str = "") -> None:
+        """Surrender the leader role after observing election
+        ``epoch``. Appends 503 from the next request; the agent loop
+        re-enters the tail path and rediscovers (or adopts) the
+        successor. Rows acked here but never shipped may not exist on
+        it — tailing surfaces that as ``needs_reprovision`` (local
+        position ahead of the leader), an operator signal, never a
+        silent divergence."""
+        import logging
+
+        from geomesa_tpu import metrics, resilience
+
+        log = logging.getLogger(__name__)
+        with self._lock:
+            prev_epoch = self._epoch
+            self._epoch = max(self._epoch, epoch)
+            if self._role != "leader":
+                return
+            self._role = "follower"
+            self._leader_url = new_leader
+        self._last_ok = time.monotonic()  # a fresh lease to rediscover
+        metrics.replica_role.set(_ROLE_GAUGE["follower"])
+        metrics.replica_demotions.inc()
+        resilience.note_degraded("replica-demoted")
+        log.warning(
+            "replica: demoted — observed election epoch %d > own %d; "
+            "re-tailing %s; appends refused here now",
+            epoch, prev_epoch, new_leader or "(rediscovering)",
+        )
+        self._ensure_agent()
+        try:
+            from geomesa_tpu import slo
+
+            slo.FLIGHTREC.trigger("replica-demote", detail={
+                "self": self.cfg.self_url,
+                "observed_epoch": epoch,
+                "own_epoch": prev_epoch,
+                "successor": new_leader,
+            })
+        except Exception:  # pragma: no cover - observability must not break
+            pass
 
     def ack_mode(self) -> str:
         if self.cfg.ack is not None:
@@ -199,6 +302,31 @@ class Replicator:
                 self._ack_cv.wait(timeout=min(left, 0.25))
             return True
 
+    def follower_floor(self, type_name: str) -> "int | None":
+        """Lowest applied seq among followers seen within
+        ``replica.retain.s`` — the compactor's WAL-GC retention pin
+        (installed on the stream layer by :meth:`attach`): segments a
+        live follower still has to ship must outlive compaction, or
+        the leader's own GC forces it into a 410 snapshot
+        re-provision. ``None`` (no pinning) off-leader or when no
+        follower reported recently — a dead follower must not pin the
+        log forever."""
+        if self._role != "leader":
+            return None
+        from geomesa_tpu.conf import sys_prop
+
+        horizon = time.monotonic() - max(
+            float(sys_prop("replica.retain.s")), 0.0
+        )
+        floor = None
+        with self._ack_cv:
+            for url, pos in self._followers.items():
+                if self._follower_seen.get(url, 0.0) < horizon:
+                    continue
+                applied = int(pos.get(type_name, -1))
+                floor = applied if floor is None else min(floor, applied)
+        return floor
+
     # -- follower side: tail / lease / election -----------------------------
 
     def _lease_s(self) -> float:
@@ -206,68 +334,126 @@ class Replicator:
 
         return max(float(sys_prop("replica.lease.s")), 0.1)
 
-    def _tail_loop(self) -> None:
+    def _run_loop(self) -> None:
+        """The replication agent thread, dispatched by role: followers
+        tail their leader (ship → apply → lease → elect); leaders with
+        a declared electorate watch it for a successor advertising a
+        higher election epoch (fencing — a revenant ex-leader must find
+        out it was replaced and step down, not keep taking appends)."""
         import logging
 
         from geomesa_tpu import ledger, metrics
         from geomesa_tpu.conf import sys_prop
 
         log = logging.getLogger(__name__)
-        while not self._stop.is_set() and self._role == "follower":
-            poll_s = max(float(sys_prop("replica.poll.ms")), 1.0) / 1e3
-            if not self._leader_url:
-                if self._discover_leader() is None:
-                    # nobody claims the role yet; keep probing, and
-                    # elect once the lease runs out with no leader
-                    if (time.monotonic() - self._last_ok
-                            > self._lease_s()):
-                        self._failover()
-                    self._stop.wait(poll_s)
-                    continue
-            progressed = False
-            contacted = False
-            cost = ledger.RequestCost(
-                tenant="_system", endpoint="other", lane="ingest",
-                shape="replica-apply",
-            )
-            for t in list(self.stream.store.type_names):
-                if self._stop.is_set() or self._role != "follower":
-                    break
-                try:
-                    with ledger.attach_cost(cost):
-                        n = self._fetch_type(t)
-                    contacted = True
-                    progressed = progressed or n > 0
-                except WalCorruption as e:
-                    # transport or leader damage: drop the connection
-                    # and re-ship from our durable position — every
-                    # record we DID apply was checksum-verified
-                    contacted = True
-                    log.warning(
-                        "replica: corrupt ship stream for %r (%s); "
-                        "re-tailing from the local WAL position", t, e,
-                    )
-                except Exception:
-                    pass  # connection-level failure: the lease decides
-            if cost.fields and ledger.enabled():
-                cost.status = 200
-                ledger.LEDGER.record(cost)
-            now = time.monotonic()
-            if contacted:
-                self._last_ok = now
-            elif now - self._last_ok > self._lease_s():
-                self._failover()
-            self._publish_lag(metrics)
-            if not progressed:
+        while not self._stop.is_set():
+            if self._role == "follower":
+                self._tail_cycle(log, ledger, metrics, sys_prop)
+            else:
+                self._watch_cycle()
+
+    def _tail_cycle(self, log, ledger, metrics, sys_prop) -> None:
+        poll_s = max(float(sys_prop("replica.poll.ms")), 1.0) / 1e3
+        if not self._leader_url:
+            if self._discover_leader() is None:
+                # nobody claims the role yet; keep probing, and
+                # elect once the lease runs out with no leader
+                if (time.monotonic() - self._last_ok
+                        > self._lease_s()):
+                    self._failover()
                 self._stop.wait(poll_s)
+                return
+        progressed = False
+        contacted = False
+        cost = ledger.RequestCost(
+            tenant="_system", endpoint="other", lane="ingest",
+            shape="replica-apply",
+        )
+        for t in list(self.stream.store.type_names):
+            if self._stop.is_set() or self._role != "follower":
+                break
+            try:
+                with ledger.attach_cost(cost):
+                    n = self._fetch_type(t)
+                contacted = True
+                progressed = progressed or n > 0
+            except WalCorruption as e:
+                # transport or leader damage: drop the connection
+                # and re-ship from our durable position — every
+                # record we DID apply was checksum-verified
+                contacted = True
+                log.warning(
+                    "replica: corrupt ship stream for %r (%s); "
+                    "re-tailing from the local WAL position", t, e,
+                )
+            except StaleLeaderError as e:
+                # answered, but no longer AS the leader: not contact
+                # (a stale leader must not refresh the lease) — drop
+                # it and rediscover whoever took the role
+                log.warning("replica: %s; rediscovering", e)
+                self._leader_url = ""
+                break
+            except Exception as e:
+                # connection-level failure only (apply-side failures
+                # are absorbed inside _fetch_type): the lease decides
+                log.debug(
+                    "replica: no ship contact for %r (%s: %s)",
+                    t, type(e).__name__, e,
+                )
+        if cost.fields and ledger.enabled():
+            cost.status = 200
+            ledger.LEDGER.record(cost)
+        now = time.monotonic()
+        if contacted:
+            self._last_ok = now
+        elif now - self._last_ok > self._lease_s():
+            self._failover()
+        self._publish_lag(metrics)
+        if not progressed:
+            self._stop.wait(poll_s)
+
+    def _watch_cycle(self) -> None:
+        """Leader-side fencing probe: every half-lease, look for a peer
+        advertising a HIGHER election epoch. One exists only if a
+        quorum elected a successor while this process was stalled or
+        partitioned — keeping the role would fork the seq space, so
+        step down instead of arguing."""
+        self._stop.wait(self._lease_s() / 2.0)
+        if self._stop.is_set() or self._role != "leader":
+            return
+        for peer in self.cfg.peers:
+            if not peer or peer == self.cfg.self_url:
+                continue
+            doc = self._peer_stats(peer, timeout=1.0)
+            if doc is None:
+                continue
+            epoch = int(doc.get("epoch", 0) or 0)
+            if epoch <= self._epoch:
+                continue
+            successor = (
+                peer if doc.get("role") in ("leader", "promoting")
+                else str(doc.get("leader") or "")
+            )
+            self._demote(epoch, successor)
+            return
 
     def _fetch_type(self, type_name: str) -> int:
         """One ship fetch for one type: long-poll the leader from our
         durable WAL position, verify + apply every shipped record.
         Returns records applied. Raises on connection-level failure
-        (the caller's lease accounting)."""
-        from geomesa_tpu.conf import sys_prop
+        (the caller's lease accounting) and :class:`StaleLeaderError`
+        when the answering node no longer serves as leader/promoting at
+        our election epoch. Apply-side failures are NOT transport: they
+        count as leader contact, log, and flag ``needs_reprovision``
+        after ``_APPLY_FAULT_LIMIT`` consecutive failures — one
+        undecodable record must not starve the lease into an election
+        against a healthy leader."""
+        import logging
 
+        from geomesa_tpu.conf import sys_prop
+        from geomesa_tpu.store.stream import ReplicationGapError
+
+        log = logging.getLogger(__name__)
         ts = self.stream._ts(type_name)
         frm = int(ts.wal.next_seq)
         wait_ms = max(float(sys_prop("replica.wait.ms")), 0.0)
@@ -276,6 +462,7 @@ class Replicator:
             f"{urllib.parse.quote(type_name)}?from={frm}"
             f"&waitMs={wait_ms:g}"
             f"&follower={urllib.parse.quote(self.cfg.self_url or '')}"
+            f"&epoch={self._epoch}"
         )
         timeout = self._lease_s() + wait_ms / 1e3 + 5.0
         try:
@@ -296,22 +483,74 @@ class Replicator:
             raise
         applied = 0
         with resp:
+            role = resp.headers.get("X-Replica-Role", "leader")
+            epoch = int(resp.headers.get("X-Replica-Epoch", "0") or 0)
+            if role == "follower" or epoch < self._epoch:
+                # a demoted or replaced ex-leader can hold a forked
+                # tail (rows it acked after the real leader moved on):
+                # applying it would diverge — refuse and rediscover
+                raise StaleLeaderError(
+                    f"{self._leader_url} answered the ship as "
+                    f"{role!r} at epoch {epoch} (ours {self._epoch})"
+                )
+            self._epoch = max(self._epoch, epoch)
+            nxt = resp.headers.get("X-Wal-Next-Seq")
+            if nxt is not None:
+                self._leader_next[type_name] = int(nxt)
+                if int(nxt) < frm:
+                    # we hold seqs the leader never assigned: this
+                    # replica survived a fork (e.g. it was the old
+                    # leader, with an unshipped acked tail) — tailing
+                    # cannot reconcile that; flag for the operator
+                    self._needs_reprovision.add(type_name)
+                    log.error(
+                        "replica: local WAL position %d for %r is "
+                        "AHEAD of leader %s (next_seq %s): diverged "
+                        "tail; re-provision this replica from a "
+                        "snapshot", frm, type_name, self._leader_url,
+                        nxt,
+                    )
+                    return 0
             parser = RecordParser()
             while True:
                 chunk = resp.read(1 << 16)
                 if not chunk:
                     break
                 for seq, payload in parser.feed(chunk):
-                    self.stream.apply_replicated(type_name, seq, payload)
+                    try:
+                        self.stream.apply_replicated(
+                            type_name, seq, payload
+                        )
+                    except ReplicationGapError as e:
+                        # the stream skipped records (leader-side GC
+                        # racing the ship): stop HERE, never apply past
+                        # a hole — the next fetch re-asks from our real
+                        # position and either heals or gets the honest
+                        # 410 re-provision answer
+                        self._needs_reprovision.add(type_name)
+                        log.error(
+                            "replica: %s; not applying past the gap", e,
+                        )
+                        return applied
+                    except Exception as e:
+                        n = self._apply_failures.get(type_name, 0) + 1
+                        self._apply_failures[type_name] = n
+                        if n >= _APPLY_FAULT_LIMIT:
+                            self._needs_reprovision.add(type_name)
+                        log.warning(
+                            "replica: apply failed for %r seq %d "
+                            "(%s: %s; failure %d/%d); leader contact "
+                            "held, will refetch", type_name, seq,
+                            type(e).__name__, e, n, _APPLY_FAULT_LIMIT,
+                        )
+                        return applied
                     applied += 1
             if parser.pending_bytes:
                 raise WalCorruption(
                     f"ship stream for {type_name!r} ended mid-record "
                     f"({parser.pending_bytes} bytes dangling)"
                 )
-            nxt = resp.headers.get("X-Wal-Next-Seq")
-            if nxt is not None:
-                self._leader_next[type_name] = int(nxt)
+        self._apply_failures.pop(type_name, None)
         self._needs_reprovision.discard(type_name)
         return applied
 
@@ -365,27 +604,61 @@ class Replicator:
             doc = self._peer_stats(peer, timeout=1.0)
             if doc and doc.get("role") == "leader":
                 self._leader_url = peer
+                self._epoch = max(
+                    self._epoch, int(doc.get("epoch", 0) or 0)
+                )
                 self._last_ok = time.monotonic()
                 return peer
         return None
 
     def _failover(self) -> None:
-        """Lease expired: elect the most-caught-up replica and either
-        promote (we won) or re-point at the winner (it serves our ship
+        """Lease expired: decide whether the leader is REALLY gone, and
+        only then elect. A timeout alone never promotes: (1) the
+        presumed-dead leader is re-probed directly — a stall longer
+        than the lease or one lost route is not a death; (2) with a
+        declared peer electorate, promotion additionally needs a
+        MAJORITY of it to agree the leader is unreachable (their own
+        lease on it expired too) — a partitioned minority stays
+        follower and keeps serving reads instead of forking the seq
+        space. The election then picks the most-caught-up agreeing
+        replica; we either promote (we won, at epoch max(seen)+1 — the
+        fencing token) or re-point at the winner (it serves our ship
         fetches immediately — the cursor is readonly — and adopts the
-        role within the failover bound)."""
+        role within the failover bound). With no peers declared there
+        is no electorate to poll and the re-probe alone gates
+        promotion — operators who want quorum safety list peers."""
         import logging
 
         log = logging.getLogger(__name__)
         self._lease_expired_at = self._lease_expired_at or time.monotonic()
         dead = self._leader_url
+        lease = self._lease_s()
+        if dead:
+            doc = self._peer_stats(dead, timeout=1.0)
+            if doc is not None and doc.get("role") == "leader" \
+                    and int(doc.get("epoch", 0) or 0) >= self._epoch:
+                # alive after all (ship-path blip or leader stall):
+                # renew the lease, no election
+                log.info(
+                    "replica: leader %s answered the death re-probe; "
+                    "keeping the lease", dead,
+                )
+                self._last_ok = time.monotonic()
+                self._lease_expired_at = 0.0
+                return
+        electorate = {p for p in self.cfg.peers if p}
+        if self.cfg.self_url:
+            electorate.add(self.cfg.self_url)
+        votes = 1  # our own expired lease is this replica's vote
         best = (self.applied_total(), self.cfg.self_url or "")
-        for peer in self.cfg.peers:
-            if peer in (self.cfg.self_url, dead) or not peer:
+        max_epoch = self._epoch
+        for peer in sorted(electorate):
+            if peer in (self.cfg.self_url, dead):
                 continue
             doc = self._peer_stats(peer, timeout=1.0)
             if doc is None:
                 continue
+            max_epoch = max(max_epoch, int(doc.get("epoch", 0) or 0))
             if doc.get("role") in ("leader", "promoting"):
                 # somebody already took (or is taking) the role
                 log.info("replica: leader moved to %s; re-tailing", peer)
@@ -393,7 +666,22 @@ class Replicator:
                 self._last_ok = time.monotonic()
                 self._lease_expired_at = 0.0
                 return
-            best = max(best, (int(doc.get("applied_total", -1)), peer))
+            if doc.get("leader") in (dead, "") and float(
+                    doc.get("leader_ok_age_s", 0.0)) > lease:
+                # this peer's lease on the same leader expired too:
+                # it agrees the leader is unreachable, and is an
+                # eligible election candidate
+                votes += 1
+                best = max(best, (int(doc.get("applied_total", -1)), peer))
+        needed = len(electorate) // 2 + 1
+        if len(electorate) > 1 and votes < needed:
+            log.warning(
+                "replica: lease on %s expired but only %d/%d "
+                "electorate votes agree it is unreachable (quorum "
+                "%d); staying follower", dead, votes, len(electorate),
+                needed,
+            )
+            return
         if best[1] and best[1] != self.cfg.self_url:
             log.info(
                 "replica: election winner is %s (applied_total=%d); "
@@ -403,11 +691,13 @@ class Replicator:
             self._last_ok = time.monotonic()
             self._lease_expired_at = 0.0
             return
-        self._promote(dead)
+        self._promote(dead, epoch_floor=max_epoch)
 
-    def _promote(self, dead_leader: str) -> None:
+    def _promote(self, dead_leader: str, epoch_floor: int = 0) -> None:
         """Adopt the leader role: seal the tail (this thread stops
-        fetching), flip the role, stamp the flight recorder. The local
+        fetching), flip the role at an election epoch strictly above
+        every epoch seen in the election (the fencing token a revenant
+        ex-leader demotes on), stamp the flight recorder. The local
         WAL position is the truth — watermark-exact, zero acked-row
         loss by the PR 10 replay invariants — so there is nothing to
         rewrite, only a role to claim."""
@@ -440,6 +730,7 @@ class Replicator:
         with self._lock:
             self._role = "leader"
             self._leader_url = self.cfg.self_url or ""
+            self._epoch = max(self._epoch, epoch_floor) + 1
         metrics.replica_role.set(_ROLE_GAUGE["leader"])
         dur = time.monotonic() - (
             self._lease_expired_at or time.monotonic()
@@ -469,6 +760,7 @@ class Replicator:
                 "failover_seconds": round(dur, 3),
                 "bound_seconds": bound,
                 "applied_total": self.applied_total(),
+                "epoch": self._epoch,
             })
         except Exception:  # pragma: no cover - observability must not break
             pass
@@ -502,6 +794,7 @@ class Replicator:
         return {
             "enabled": True,
             "role": self._role,
+            "epoch": self._epoch,
             "self": self.cfg.self_url,
             "leader": self._leader_url,
             "peers": list(self.cfg.peers),

@@ -58,6 +58,7 @@ _FWD_REQ_HEADERS = (
 _FWD_RESP_HEADERS = (
     "Content-Type", "X-Request-Id", "X-Degraded", "Retry-After",
     "X-Wal-Next-Seq", "X-Wal-Watermark", "X-Replica-Role",
+    "X-Replica-Epoch",
 )
 
 
@@ -248,15 +249,20 @@ class Router:
     def forward(
         self, b: _Backend, method: str, path: str, body: "bytes | None",
         headers: dict,
-    ) -> "tuple[int, list, bytes]":
+    ) -> "tuple[int, list, http.client.HTTPResponse]":
         """One proxied attempt against ``b``. Raises on transport
         failure (the caller decides whether to retry elsewhere); a
-        served HTTP error status is a RESPONSE, not an exception."""
+        served HTTP error status is a RESPONSE, not an exception.
+
+        Returns the LIVE response — the body is NOT buffered here, so
+        a multi-GiB Arrow export or a 30s ``/wal`` long-poll streams
+        through instead of pinning router memory. The caller must
+        fully consume it (relay) or :meth:`discard` it before this
+        backend's pooled connection can serve another request."""
         conn = self._conn(b)
         try:
             conn.request(method, path, body=body, headers=headers)
             resp = conn.getresponse()
-            data = resp.read()
         except Exception:
             self._drop_conn(b)
             raise
@@ -264,7 +270,17 @@ class Router:
             (k, v) for k in _FWD_RESP_HEADERS
             if (v := resp.getheader(k)) is not None
         ]
-        return resp.status, out, data
+        return resp.status, out, resp
+
+    def discard(self, b: _Backend, resp) -> None:
+        """Drain a response body the caller will not relay (the retry
+        path): reading to EOF keeps the keep-alive socket reusable; if
+        draining itself fails, drop the pooled connection instead."""
+        try:
+            while resp.read(64 << 10):
+                pass
+        except Exception:
+            self._drop_conn(b)
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -299,7 +315,17 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 out[k] = v
         return out
 
-    def _relay(self, status: int, headers: list, data: bytes) -> None:
+    def _relay(self, b: _Backend, status: int, headers: list, resp) -> None:
+        """Relay a live backend response chunk-by-chunk — constant
+        router memory regardless of body size. A backend
+        Content-Length passes straight through; otherwise the body is
+        re-framed as chunked transfer-encoding (``http.client``
+        already decoded the backend's own hop-local framing). A
+        mid-body failure cannot become an error status (the headers
+        are gone), so the relay stops where it is: the truncation is
+        visible to the client (short body / missing chunk
+        terminator), the half-read backend socket is dropped rather
+        than pooled, and this client connection closes."""
         self.send_response(status)
         sent = set()
         for k, v in headers:
@@ -307,9 +333,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
             sent.add(k.lower())
         if "content-type" not in sent:
             self.send_header("Content-Type", "application/octet-stream")
-        self.send_header("Content-Length", str(len(data)))
+        clen = resp.getheader("Content-Length")
+        chunked = clen is None
+        if chunked:
+            self.send_header("Transfer-Encoding", "chunked")
+        else:
+            self.send_header("Content-Length", clen)
         self.end_headers()
-        self.wfile.write(data)
+        try:
+            while True:
+                chunk = resp.read(64 << 10)
+                if not chunk:
+                    break
+                if chunked:
+                    self.wfile.write(
+                        b"%x\r\n%s\r\n" % (len(chunk), chunk)
+                    )
+                else:
+                    self.wfile.write(chunk)
+            if chunked:
+                self.wfile.write(b"0\r\n\r\n")
+        except Exception:
+            self.router._drop_conn(b)
+            self.close_connection = True
 
     # -- request paths -------------------------------------------------------
 
@@ -378,7 +424,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 continue
             tried += 1
             try:
-                status, hdrs, data = rt.forward(
+                status, hdrs, resp = rt.forward(
                     b, method, self.path, body, headers
                 )
             except Exception as e:
@@ -391,13 +437,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 # a 503 (draining / not-leader) read is worth one more
                 # replica; record it on the breaker so a flapping
                 # backend stops soaking attempts
+                rt.discard(b, resp)
                 b.breaker.record_failure()
                 metrics.router_backend_errors.inc()
                 last_err = f"{b.url}: HTTP {status}"
                 metrics.router_retries.inc()
                 continue
             b.breaker.record_success()
-            return self._relay(status, hdrs, data)
+            return self._relay(b, status, hdrs, resp)
         self._json(
             503,
             {
@@ -427,7 +474,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 headers=(("Retry-After", "1"),),
             )
         try:
-            status, hdrs, data = rt.forward(
+            status, hdrs, resp = rt.forward(
                 lead, "POST", self.path, body, self._req_headers()
             )
         except Exception as e:
@@ -448,7 +495,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             metrics.router_backend_errors.inc()
         else:
             lead.breaker.record_success()
-        self._relay(status, hdrs, data)
+        self._relay(lead, status, hdrs, resp)
 
 
 class _RouterHTTPServer(ThreadingHTTPServer):

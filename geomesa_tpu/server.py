@@ -667,6 +667,24 @@ class _Handler(BaseHTTPRequestHandler):
             self._dispatch_safe(url, parts, q)
         ledger.finish_request(cost, tr)
 
+    def _admin_authorized(self) -> bool:
+        """Gate for operator-plane endpoints (``/admin/*``). With
+        ``admin.token`` set, the caller must present the exact shared
+        secret in ``X-Admin-Token`` (compared constant-time). With no
+        token configured the plane stays usable for local tooling but
+        only from loopback peers — a reachable serving port must not
+        expose an unauthenticated kill switch."""
+        import hmac
+
+        from geomesa_tpu.conf import sys_prop
+
+        token = str(sys_prop("admin.token"))
+        if token:
+            offered = self.headers.get("X-Admin-Token") or ""
+            return hmac.compare_digest(offered, token)
+        peer = str(self.client_address[0]) if self.client_address else ""
+        return peer in ("127.0.0.1", "::1", "::ffff:127.0.0.1")
+
     def do_POST(self) -> None:  # noqa: N802 (stdlib API)
         """POST ``/append/<type>``: the streaming-ingest endpoint. Body
         ``{"columns": {...}, "fids": [...], "visibilities": [...]}``;
@@ -708,6 +726,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._trace = None
             self._degraded = None
             self._cost = None
+            if not self._admin_authorized():
+                return self._json(403, {
+                    "error": "admin endpoint refused: present the "
+                             "X-Admin-Token header (admin.token), or "
+                             "call from loopback when no token is "
+                             "configured"
+                })
             self._json(200, {"draining": True})
             threading.Thread(
                 target=self.server.shutdown,
@@ -819,6 +844,10 @@ class _Handler(BaseHTTPRequestHandler):
                 # response degraded instead of failing a durable write
                 note_degraded("replica-lag")
         doc = {"acked": int(res["rows"]), "seq": int(res["seq"])}
+        if rep is not None:
+            # fencing token: a client (or router) holding a higher
+            # epoch from elsewhere can spot a stale leader in the ack
+            doc["epoch"] = int(rep.epoch)
         if replicated is not None:
             doc["replicated"] = bool(replicated)
         self._json(200, doc)
@@ -1080,6 +1109,10 @@ class _Handler(BaseHTTPRequestHandler):
         rep = self.replica
         if rep is not None:
             rep.note_follower(q.get("follower", ""), type_name, after)
+            try:
+                rep.observe_epoch(int(q.get("epoch", 0)))
+            except (TypeError, ValueError):
+                pass
         watermark = int(self.store._types[type_name].wal_watermark)
         if frm <= watermark:
             first = ts.wal.first_seq()
@@ -1109,9 +1142,17 @@ class _Handler(BaseHTTPRequestHandler):
 
         def chunks():
             buf = bytearray()
+            prev = after
             for seq, payload in ts.wal.read_from(after):
                 if seq >= nxt:
                     break  # a fixed upper bound keeps the stream finite
+                if seq > prev + 1 and prev >= frm:
+                    # a segment vanished mid-walk (compaction racing the
+                    # cursor): never ship across the hole — ending the
+                    # stream early makes the follower re-ask from its
+                    # true position and hit the 410/gap machinery
+                    break
+                prev = seq
                 buf += pack_record(seq, payload)
                 state["records"] += 1
                 if len(buf) >= (512 << 10):
@@ -1129,6 +1170,8 @@ class _Handler(BaseHTTPRequestHandler):
                 ("X-Wal-Next-Seq", str(nxt)),
                 ("X-Wal-Watermark", str(watermark)),
                 ("X-Replica-Role", role),
+                ("X-Replica-Epoch",
+                 str(rep.epoch if rep is not None else 0)),
             ),
         )
         if state["records"]:

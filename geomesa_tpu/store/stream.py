@@ -53,6 +53,7 @@ from geomesa_tpu.store.wal import WriteAheadLog
 
 __all__ = [
     "IngestBackpressureError",
+    "ReplicationGapError",
     "WalUnavailableError",
     "StreamingStore",
     "streaming_enabled",
@@ -88,6 +89,14 @@ class WalUnavailableError(RuntimeError):
     """The ``wal`` failure-domain breaker is open: appends fail fast
     instead of queueing against a log that cannot take them (an ack
     must never be promised by a dead WAL)."""
+
+
+class ReplicationGapError(RuntimeError):
+    """A shipped record would leave a seq hole in this replica's WAL:
+    applying it would silently skip acked rows (the follower would
+    report lag 0 while missing data forever). The apply path refuses;
+    the replicator marks the type ``needs_reprovision`` instead of
+    diverging."""
 
 
 @dataclass
@@ -143,6 +152,12 @@ class StreamingStore:
         from geomesa_tpu.locking import checked_lock
 
         self._listeners: list = []
+        #: replication retention hook: ``callable(type_name) -> int |
+        #: None`` giving the lowest WAL seq a follower still needs
+        #: (Replicator.attach installs it); the compactor never
+        #: truncates segments past it, so a lagging-but-live follower
+        #: keeps tailing instead of hitting the 410 re-provision cliff
+        self.retention_floor = None
         # blocking_ok: first-touch _TypeStream construction opens the
         # WAL (segment scan + torn-tail truncation) under it BY DESIGN
         # — two appenders racing the open would double-append one
@@ -348,9 +363,21 @@ class StreamingStore:
         st = self.store._types[type_name]
         fail_point("fail.replica.apply")
         with ts.lock:
-            if seq < ts.wal.next_seq or seq <= int(st.wal_watermark):
+            nxt = int(ts.wal.next_seq)
+            wm = int(st.wal_watermark)
+            if seq < nxt or seq <= wm:
                 metrics.replica_apply_skipped.inc()
                 return 0
+            if seq > max(nxt, wm + 1):
+                # a hole: the records in [next_seq, seq) were never
+                # applied here and are not covered by the manifest
+                # watermark — applying past them would lose acked rows
+                # while reporting lag 0 (the 410/truncation race a
+                # gapped ship stream surfaces as)
+                raise ReplicationGapError(
+                    f"shipped seq {seq} for {type_name!r} would gap "
+                    f"this replica (next_seq={nxt}, watermark={wm})"
+                )
             # decode (fallible) BEFORE the local durability point: an
             # undecodable record must fail the apply cleanly, not leave
             # a durable WAL entry that replays nothing
@@ -789,7 +816,7 @@ class StreamingStore:
         metrics.stream_memtable_rows.set(mem_rows, type=type_name)
         metrics.stream_memtable_runs.set(nruns, type=type_name)
         fail_point("fail.compact.publish")
-        ts.wal.truncate_through(watermark)
+        ts.wal.truncate_through(self._retention_seq(type_name, watermark))
         dur = time.perf_counter() - t0
         ts.compactions += 1
         ts.last_publish = time.monotonic()
@@ -808,6 +835,24 @@ class StreamingStore:
             cost.dur_s = dur
             cost.charge("compact_seconds", dur)
             ledger.LEDGER.record(cost)
+
+    def _retention_seq(self, type_name: str, watermark: int) -> int:
+        """WAL truncation bound: the manifest watermark, capped by the
+        replication retention floor when one is installed — segments a
+        recently-seen follower still has to ship must outlive their
+        compaction, or the leader's own GC forces that follower into a
+        410 snapshot re-provision (the check-then-act race the review
+        flagged). Best-effort: a broken hook never blocks compaction."""
+        fn = self.retention_floor
+        if fn is None:
+            return watermark
+        try:
+            floor = fn(type_name)
+        except Exception:
+            return watermark
+        if floor is None:
+            return watermark
+        return min(int(watermark), int(floor))
 
     # -- recovery ----------------------------------------------------------
 

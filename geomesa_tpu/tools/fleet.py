@@ -150,9 +150,19 @@ def wait_caught_up(url: str, timeout_s: float = 30.0,
 
 
 def drain(url: str, timeout: float = 10.0) -> dict:
-    """Trigger the draining shutdown remotely."""
+    """Trigger the draining shutdown remotely. The endpoint is gated
+    (``admin.token`` shared secret, or loopback-only when unset); the
+    orchestrator presents the token from its own conf — operate the
+    fleet with the same ``admin.token`` on every node."""
+    from geomesa_tpu.conf import sys_prop
+
+    headers = {}
+    token = str(sys_prop("admin.token"))
+    if token:
+        headers["X-Admin-Token"] = token
     req = urllib.request.Request(
-        url + "/admin/shutdown", data=b"", method="POST"
+        url + "/admin/shutdown", data=b"", method="POST",
+        headers=headers,
     )
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read())

@@ -684,6 +684,421 @@ def test_router_rejects_admin_posts(pair):
         rsrv.server_close()
 
 
+# -- quorum, fencing, gap + GC safety, admin gate, streaming relay -----------
+
+
+def _reserve_ports(n):
+    """Bind-then-release N loopback ports so a replica group can know
+    every member's URL before any member starts."""
+    import socket
+
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_minority_partition_never_self_promotes(tmp_path):
+    """A follower whose electorate majority is unreachable must NOT
+    promote when its leader stops answering: one vote of three is a
+    minority — it stays follower (reads keep serving) instead of
+    forking the seq space from the wrong side of a partition."""
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+
+    lroot = _seeded_root(tmp_path, "leader")
+    froot = str(tmp_path / "follower")
+    shutil.copytree(lroot, froot)
+    phantom = "http://127.0.0.1:9"  # reserved port: never answers
+    with prop_override("replica.lease.s", 1.0), \
+            prop_override("replica.poll.ms", 25.0):
+        lsrv, _ = serve_background(
+            FileSystemDataStore(lroot, partition_size=128),
+            stream=True, replica=ReplicaConfig(role="leader"),
+        )
+        lbase = "http://%s:%s" % lsrv.server_address[:2]
+        fsrv, _ = serve_background(
+            FileSystemDataStore(froot, partition_size=128),
+            stream=True,
+            replica=ReplicaConfig(
+                role="follower", leader_url=lbase,
+                peers=(lbase, phantom),
+            ),
+        )
+        fbase = "http://%s:%s" % fsrv.server_address[:2]
+        try:
+            _wait(
+                lambda: fbase
+                in _get(lbase, "/stats/replica")["followers"],
+                msg="tail established",
+            )
+            lsrv.socket.close()
+            lsrv.shutdown()
+            # hold through SEVERAL expired leases: still a follower
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                st = _get(fbase, "/stats/replica")
+                assert st["role"] == "follower", "minority self-promoted"
+                assert _get(fbase, "/count/t")["count"] == N0
+                time.sleep(0.25)
+            assert _get(fbase, "/stats/replica")["failovers"] == 0
+        finally:
+            for s in (lsrv, fsrv):
+                try:
+                    s.shutdown()
+                    s.server_close()
+                except Exception:
+                    pass
+
+
+def test_quorum_failover_elects_one_leader_with_higher_epoch(tmp_path):
+    """3-replica group with the full electorate declared: after the
+    leader dies, the two survivors form a majority, exactly ONE
+    promotes — at an election epoch above the dead leader's — and the
+    other re-points and tails the winner."""
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+
+    r0 = _seeded_root(tmp_path, "n0")
+    roots = {0: r0}
+    for i in (1, 2):
+        roots[i] = str(tmp_path / f"n{i}")
+        shutil.copytree(r0, roots[i])
+    ports = _reserve_ports(3)
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    servers = []
+    with prop_override("replica.lease.s", 1.5), \
+            prop_override("replica.poll.ms", 25.0), \
+            prop_override("replica.failover.s", 8.0):
+        for i in range(3):
+            srv, _ = serve_background(
+                FileSystemDataStore(roots[i], partition_size=128),
+                port=ports[i], stream=True,
+                replica=ReplicaConfig(
+                    role="leader" if i == 0 else "follower",
+                    self_url=urls[i],
+                    leader_url="" if i == 0 else urls[0],
+                    peers=tuple(u for j, u in enumerate(urls) if j != i),
+                ),
+            )
+            servers.append(srv)
+        try:
+            _post(urls[0], "/append/t", _append_doc([9001, 9002]))
+            for u in urls[1:]:
+                _wait(
+                    lambda u=u: _get(u, "/count/t")["count"] == N0 + 2,
+                    msg="pre-failover catch-up",
+                )
+            servers[0].socket.close()
+            servers[0].shutdown()
+            survivors = urls[1:]
+            _wait(
+                lambda: any(
+                    _get(u, "/stats/replica")["role"] == "leader"
+                    for u in survivors
+                ),
+                timeout_s=25.0, msg="quorum promotion",
+            )
+            docs = {u: _get(u, "/stats/replica") for u in survivors}
+            leaders = [u for u, d in docs.items() if d["role"] == "leader"]
+            assert len(leaders) == 1, docs
+            winner = leaders[0]
+            loser = next(u for u in survivors if u != winner)
+            # the fencing token moved past the dead leader's epoch 1
+            assert docs[winner]["epoch"] >= 2
+            _wait(
+                lambda: _get(loser, "/stats/replica")["leader"] == winner,
+                msg="loser re-points at the winner",
+            )
+            assert _post(winner, "/append/t",
+                         _append_doc([9003]))["acked"] == 1
+            _wait(
+                lambda: _get(loser, "/count/t")["count"] == N0 + 3,
+                msg="loser tails the winner",
+            )
+        finally:
+            for srv in servers:
+                try:
+                    srv.shutdown()
+                    srv.server_close()
+                except Exception:
+                    pass
+
+
+def test_ship_request_with_higher_epoch_fences_stale_leader(pair):
+    """The fencing token rides every ship request: a leader seeing a
+    follower tail at a HIGHER election epoch learns a quorum elected a
+    successor while it was stalled — it demotes in that same request
+    and refuses appends, so two processes never extend one seq space."""
+    lbase, fbase, _, _ = pair
+    st = _get(lbase, "/stats/replica")
+    assert st["role"] == "leader" and st["epoch"] == 1
+    nxt = int(st["types"]["t"]["next_seq"])
+    with urllib.request.urlopen(
+        f"{lbase}/wal/t?from={nxt}&epoch=7", timeout=30
+    ) as r:
+        assert r.status == 200
+        # the SAME response already answers as a demoted node — a
+        # tailing follower refuses it instead of adopting a forked tail
+        assert r.headers["X-Replica-Role"] == "follower"
+        assert r.headers["X-Replica-Epoch"] == "7"
+        r.read()
+    st = _get(lbase, "/stats/replica")
+    assert st["role"] == "follower"
+    assert st["epoch"] == 7
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(lbase, "/append/t", _append_doc([9801]))
+    assert ei.value.code == 503
+
+
+def test_revenant_leader_demotes_via_peer_watch(tmp_path):
+    """Fencing with no client in the loop: a leader that declares
+    peers probes them every half-lease, and on finding one advertising
+    a higher election epoch demotes itself and re-tails the successor
+    (the revenant ex-leader scenario after a restart-as-leader)."""
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+
+    aroot = _seeded_root(tmp_path, "a")
+    broot = str(tmp_path / "b")
+    shutil.copytree(aroot, broot)
+    with prop_override("replica.lease.s", 1.0), \
+            prop_override("replica.poll.ms", 25.0):
+        asrv, _ = serve_background(
+            FileSystemDataStore(aroot, partition_size=128),
+            stream=True, replica=ReplicaConfig(role="leader"),
+        )
+        abase = "http://%s:%s" % asrv.server_address[:2]
+        asrv.replica._epoch = 4  # "a" won an election the revenant missed
+        bsrv, _ = serve_background(
+            FileSystemDataStore(broot, partition_size=128),
+            stream=True,
+            replica=ReplicaConfig(role="leader", peers=(abase,)),
+        )
+        bbase = "http://%s:%s" % bsrv.server_address[:2]
+        try:
+            _wait(
+                lambda: _get(bbase, "/stats/replica")["role"] == "follower",
+                msg="revenant demotion",
+            )
+            st = _get(bbase, "/stats/replica")
+            assert st["epoch"] == 4
+            assert st["leader"] == abase
+            _post(abase, "/append/t", _append_doc([9901]))
+            _wait(
+                lambda: _get(bbase, "/count/t")["count"] == N0 + 1,
+                msg="ex-leader tails the successor",
+            )
+        finally:
+            for s in (asrv, bsrv):
+                try:
+                    s.shutdown()
+                    s.server_close()
+                except Exception:
+                    pass
+
+
+def test_apply_replicated_rejects_gapped_seq(tmp_path):
+    """A shipped record whose seq would GAP the local WAL (leader-side
+    GC raced the ship) raises instead of applying — permanently missing
+    acked rows behind a lag-0 report is the one outcome the apply path
+    must never produce. Already-held seqs stay an idempotent skip."""
+    from geomesa_tpu.store.stream import ReplicationGapError, StreamingStore
+
+    ds = FileSystemDataStore(
+        _seeded_root(tmp_path, "n"), partition_size=128
+    )
+    layer = StreamingStore(ds)
+    try:
+        cols, fids = _rows(4, seed=5, fid0=9000)
+        layer.append("t", cols, fids=fids)  # local seq 0
+        payload = next(iter(layer._ts("t").wal.read_from(-1)))[1]
+        assert layer.apply_replicated("t", 1, payload) > 0  # contiguous
+        assert layer.apply_replicated("t", 0, payload) == 0  # idempotent
+        with pytest.raises(ReplicationGapError):
+            layer.apply_replicated("t", 5, payload)
+        assert int(layer._ts("t").wal.next_seq) == 2  # nothing landed
+    finally:
+        layer.close()
+
+
+def test_wal_gc_pinned_to_live_follower_position(tmp_path):
+    """The leader's compactor must not truncate WAL segments a live
+    follower still has to ship (that forces the 410 re-provision
+    cliff); a follower silent past ``replica.retain.s`` stops pinning
+    — a dead follower must not pin the log forever."""
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+
+    lroot = _seeded_root(tmp_path, "leader")
+    ds = FileSystemDataStore(lroot, partition_size=128)
+    with prop_override("wal.segment.bytes", 1):
+        lsrv, _ = serve_background(
+            ds, stream=True, replica=ReplicaConfig(role="leader"),
+        )
+        lbase = "http://%s:%s" % lsrv.server_address[:2]
+        for i in range(24):
+            _post(
+                lbase, "/append/t",
+                _append_doc(list(range(9000 + i * 8, 9008 + i * 8))),
+            )
+    try:
+        stream = lsrv.stream_layer
+        ts = stream._ts("t")
+        lsrv.replica.note_follower("http://follower:1", "t", 3)
+        stream.compact_now("t")
+        first = ts.wal.first_seq()
+        assert 0 <= first <= 4, first  # segments past seq 3 survive GC
+        with urllib.request.urlopen(
+            f"{lbase}/wal/t?from=4", timeout=30
+        ) as r:
+            assert r.status == 200  # the pinned position still ships
+        # the follower goes silent past the retention window: unpinned
+        with prop_override("replica.retain.s", 0.0):
+            time.sleep(0.05)
+            stream.compact_now("t")
+        assert ts.wal.first_seq() > 3
+    finally:
+        lsrv.shutdown()
+        lsrv.server_close()
+
+
+def test_ship_never_streams_across_a_missing_segment(tmp_path):
+    """A WAL segment unlinked under the walking ship cursor must END
+    the stream at the hole, never skip it: the shipped prefix stays
+    contiguous, and the follower re-asks from its true position (where
+    the gap machinery answers honestly)."""
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+
+    lroot = _seeded_root(tmp_path, "leader")
+    ds = FileSystemDataStore(lroot, partition_size=128)
+    with prop_override("wal.segment.bytes", 1):
+        lsrv, _ = serve_background(
+            ds, stream=True, replica=ReplicaConfig(role="leader"),
+        )
+        lbase = "http://%s:%s" % lsrv.server_address[:2]
+        for i in range(24):
+            _post(
+                lbase, "/append/t",
+                _append_doc(list(range(9000 + i * 8, 9008 + i * 8))),
+            )
+    try:
+        segs = lsrv.stream_layer._ts("t").wal.segments()
+        assert len(segs) >= 3, segs
+        os.remove(segs[1])  # GC racing the cursor, mid-walk
+        with urllib.request.urlopen(
+            lbase + "/wal/t?from=0", timeout=30
+        ) as r:
+            data = r.read()
+        seqs = [s for s, _ in RecordParser().feed(data)]
+        assert seqs, "nothing shipped at all"
+        assert seqs == list(range(seqs[0], seqs[0] + len(seqs))), seqs
+        assert seqs[-1] < 23  # ended BEFORE the hole, no post-gap tail
+    finally:
+        lsrv.shutdown()
+        lsrv.server_close()
+
+
+def test_persistent_apply_fault_holds_lease_and_flags_reprovision(pair):
+    """An apply-side failure is NOT leader death: the follower keeps
+    renewing its lease (no spurious election against a healthy
+    leader) and, after repeated failures, flags the type
+    ``needs_reprovision`` for the operator instead of retrying
+    silently forever."""
+    from geomesa_tpu.failpoints import failpoint_override
+
+    lbase, fbase, _, _ = pair
+    with failpoint_override("fail.replica.apply", "raise:1000"):
+        _post(lbase, "/append/t", _append_doc([9951]))
+        _wait(
+            lambda: _get(fbase, "/stats/replica")["types"]["t"].get(
+                "needs_reprovision"),
+            msg="needs_reprovision flagged",
+        )
+        time.sleep(3.0)  # several lease periods under the fault
+        st = _get(fbase, "/stats/replica")
+        assert st["role"] == "follower"
+        assert st["failovers"] == 0
+    # fault lifted: the very next fetch heals — contact never lapsed
+    _wait(
+        lambda: _get(fbase, "/count/t")["count"] == N0 + 1,
+        msg="catch-up after the fault burns out",
+    )
+    assert not _get(
+        fbase, "/stats/replica"
+    )["types"]["t"].get("needs_reprovision")
+
+
+def test_admin_shutdown_gated_by_token(tmp_path):
+    """With ``admin.token`` configured, ``/admin/shutdown`` refuses
+    callers without the exact ``X-Admin-Token`` header — a reachable
+    serving port must not double as an unauthenticated kill switch.
+    ``fleet.drain`` presents the token from its own conf."""
+    from geomesa_tpu.server import serve_background
+    from geomesa_tpu.tools import fleet
+
+    root = _seeded_root(tmp_path, "one")
+    server, _ = serve_background(
+        FileSystemDataStore(root, partition_size=128)
+    )
+    base = "http://%s:%s" % server.server_address[:2]
+    try:
+        with prop_override("admin.token", "s3cret"):
+            for hdrs in ({}, {"X-Admin-Token": "wrong"}):
+                req = urllib.request.Request(
+                    base + "/admin/shutdown", data=b"", method="POST",
+                    headers=hdrs,
+                )
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=10)
+                assert ei.value.code == 403
+            assert _get(base, "/healthz")  # nothing drained
+            assert fleet.drain(base)["draining"] is True
+    finally:
+        try:
+            server.shutdown()
+            server.server_close()
+        except Exception:
+            pass
+
+
+def test_router_relays_streams_chunkwise(pair):
+    """The proxied ship stream arrives byte-identical through the
+    router — which now relays chunk-by-chunk instead of buffering
+    whole bodies — replication headers (epoch included) intact, and
+    Content-Length JSON responses ride the same path."""
+    from geomesa_tpu.router import route_background
+
+    lbase, fbase, _, _ = pair
+    _post(lbase, "/append/t", _append_doc([9851, 9852, 9853]))
+    rsrv, _ = route_background([lbase])
+    rbase = "http://%s:%s" % rsrv.server_address[:2]
+    try:
+        with urllib.request.urlopen(
+            lbase + "/wal/t?from=0", timeout=30
+        ) as r:
+            direct = r.read()
+            want_next = r.headers["X-Wal-Next-Seq"]
+        assert direct  # the appends above really shipped bytes
+        with urllib.request.urlopen(
+            rbase + "/wal/t?from=0", timeout=30
+        ) as r:
+            via = r.read()
+            assert r.headers["X-Wal-Next-Seq"] == want_next
+            assert r.headers["X-Replica-Epoch"] == "1"
+        assert via == direct
+        assert _get(rbase, "/count/t") == _get(lbase, "/count/t")
+    finally:
+        rsrv.shutdown()
+        rsrv.server_close()
+
+
 # -- rolling restart ----------------------------------------------------------
 
 

@@ -3511,6 +3511,441 @@ def bench_replica_chaos(args) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+#: subprocess body for one soak-fleet node: the replica node lifecycle
+#: with the soak knobs dialed for fault density — tiny WAL segments and
+#: a small memtable (compaction races every snapshot stream), a short
+#: follower-retention window (a node held down past it earns the 410
+#: snapshot-reprovision cliff on purpose), and the reprovision bound
+_SOAK_NODE_BODY = r"""
+import os, sys, time
+from geomesa_tpu.conf import set_prop
+from geomesa_tpu.replica import ReplicaConfig
+from geomesa_tpu.server import serve_background
+from geomesa_tpu.store.fs import FileSystemDataStore
+
+root, portfile, port, role, leader = sys.argv[1:6]
+lease_s, poll_ms, failover_s, peers, retain_s = sys.argv[6:11]
+set_prop("replica.lease.s", float(lease_s))
+set_prop("replica.poll.ms", float(poll_ms))
+set_prop("replica.failover.s", float(failover_s))
+set_prop("replica.retain.s", float(retain_s))
+set_prop("replica.reprovision.s", 30.0)
+set_prop("replica.ack", "replica")
+# fault density: rotate segments constantly, compact constantly (every
+# snapshot stream races a compaction), keep the pin TTL comfortably
+# above one stream so only a DEAD stream's pin could ever age out
+set_prop("wal.segment.bytes", 4096)
+set_prop("stream.memtable.rows", 256)
+set_prop("snapshot.pin.ttl.s", 60.0)
+deadline = time.monotonic() + 15
+while True:
+    try:
+        server, thread = serve_background(
+            FileSystemDataStore(root, partition_size=1 << 12),
+            port=int(port), stream=True,
+            replica=ReplicaConfig(
+                role=role, leader_url=leader,
+                peers=tuple(p for p in peers.split(",") if p),
+            ),
+        )
+        break
+    except OSError:
+        if time.monotonic() > deadline:
+            raise
+        time.sleep(0.2)
+with open(portfile + ".tmp", "w") as fh:
+    fh.write(str(server.server_address[1]))
+    fh.flush(); os.fsync(fh.fileno())
+os.replace(portfile + ".tmp", portfile)
+thread.join()
+server.server_close()
+os._exit(0)
+"""
+
+
+def bench_soak(args) -> dict:
+    """``--mode soak``: the randomized self-healing soak (ISSUE 15).
+    A 3-node replica group behind the router takes a SEEDED random
+    fault schedule while readers and an appender run through the
+    router the whole time:
+
+    - ``kill-follower`` / ``kill-leader`` — SIGKILL + rejoin (the
+      leader kill exercises election + the ex-leader's follower rejoin)
+    - ``corrupt-wal`` — a killed follower's newest WAL segment gets a
+      torn garbage tail before restart (recovery truncates, tailing
+      heals the lost suffix)
+    - ``diverge`` — a killed follower's WAL grows records the leader
+      never assigned (a forked tail); on restart the tail loop detects
+      local-ahead-of-leader and self-heals via snapshot reprovision
+    - ``gap-410`` — a follower held down past ``replica.retain.s``
+      while the leader keeps compacting returns to a WAL that was
+      GC'd past its position: the honest 410 answer, healed by
+      snapshot reprovision
+
+    Every node runs with ``fail.snapshot.stream=raise:2`` armed, so
+    the first snapshot streams truncate mid-ship and the per-file
+    resume path (``?id=&from_file=``) is exercised under compaction.
+    Asserts ZERO failed reads, zero append errors (sheds are bounded
+    503s, never errors), at least one completed snapshot reprovision
+    per self-heal round, lag back to 0 after every round, exactly one
+    leader at the end (no fork), bit-identical converged counts, and
+    acked ⊆ served ⊆ acked ∪ in-flight (zero acked-row loss, zero
+    phantom rows). ``--smoke`` runs one round of each fault kind (CI);
+    the full mode runs a longer schedule. ``--seed`` fixes the
+    schedule for reproduction."""
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from geomesa_tpu import resilience
+    from geomesa_tpu.conf import prop_override
+    from geomesa_tpu.router import route_background
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.store.wal import WriteAheadLog
+    from geomesa_tpu.tools import fleet
+
+    resilience.reset()
+    LEASE_S, POLL_MS, FAILOVER_S, RETAIN_S = 1.5, 25.0, 12.0, 1.0
+    seed = getattr(args, "seed", None) or 20260805
+    rng = np.random.default_rng(seed)
+    tmp = tempfile.mkdtemp(prefix="geomesa-bench-soak-")
+    seed_n = 1024
+
+    def _get(url, path, timeout=30):
+        with urllib.request.urlopen(url + path, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def _append(url, fids):
+        n = len(fids)
+        doc = {
+            "columns": {
+                "val": list(range(n)),
+                "dtg": [1000 + i for i in range(n)],
+                "geom": [[10.0, 10.0]] * n,
+            },
+            "fids": list(fids),
+        }
+        req = urllib.request.Request(
+            url + "/append/gdelt", data=json.dumps(doc).encode(),
+            method="POST", headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # transient snapshot-stream truncation on every node: the resume
+    # path runs under real compaction instead of only when a kill
+    # happens to land mid-stream
+    env["GEOMESA_TPU_FAILPOINTS"] = "fail.snapshot.stream=raise:2"
+    procs: dict = {}
+    ports: dict = {}
+    roots: dict = {}
+
+    def spawn(root, port, role, leader_url, peers=""):
+        portfile = os.path.join(
+            tmp, f"port-{os.path.basename(root)}-{time.monotonic_ns()}"
+        )
+        p = subprocess.Popen(
+            [sys.executable, "-c", _SOAK_NODE_BODY, root, portfile,
+             str(port), role, leader_url, str(LEASE_S), str(POLL_MS),
+             str(FAILOVER_S), peers, str(RETAIN_S)],
+            env=env,
+        )
+        deadline = time.monotonic() + 120
+        while not os.path.exists(portfile):
+            assert p.poll() is None, f"node {root} died during startup"
+            assert time.monotonic() < deadline, f"node {root} never bound"
+            time.sleep(0.05)
+        url = f"http://127.0.0.1:{int(open(portfile).read())}"
+        procs[url] = p
+        ports[url] = int(url.rsplit(":", 1)[1])
+        roots[url] = root
+        return url
+
+    def _stats(url, timeout=5):
+        return _get(url, "/stats/replica", timeout=timeout)
+
+    def _wait(pred, timeout_s, msg):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                if pred():
+                    return
+            except Exception:
+                pass
+            time.sleep(0.1)
+        raise AssertionError(f"soak: timed out waiting for {msg}")
+
+    try:
+        r0 = os.path.join(tmp, "n0")
+        ds = FileSystemDataStore(r0, partition_size=1 << 12)
+        ds.create_schema("gdelt", "val:Int,dtg:Date,*geom:Point:srid=4326")
+        ds.write("gdelt", {
+            "val": rng.integers(0, 100, seed_n),
+            "dtg": rng.integers(0, 10**9, seed_n),
+            "geom": np.stack([rng.uniform(-180, 180, seed_n),
+                              rng.uniform(-90, 90, seed_n)], axis=1),
+        }, fids=np.arange(seed_n))
+        ds.flush("gdelt")
+        del ds
+        for i in (1, 2):
+            shutil.copytree(r0, os.path.join(tmp, f"n{i}"))
+
+        import socket as _socket
+
+        fixed_ports, socks = [], []
+        for _ in range(3):
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            fixed_ports.append(s.getsockname()[1])
+            socks.append(s)
+        for s in socks:
+            s.close()
+        node_urls = [f"http://127.0.0.1:{p}" for p in fixed_ports]
+        peers_arg = ",".join(node_urls)
+        lurl = spawn(r0, fixed_ports[0], "leader", "", peers_arg)
+        for i in (1, 2):
+            spawn(os.path.join(tmp, f"n{i}"), fixed_ports[i],
+                  "follower", lurl, peers_arg)
+        urls = list(node_urls)
+
+        with prop_override("router.health.ms", 100.0):
+            rsrv, _ = route_background(urls)
+            rbase = "http://%s:%s" % rsrv.server_address[:2]
+            fleet.verify_converged(urls, timeout_s=60)
+
+            read_failures: list = []
+            reads = [0]
+            stop = threading.Event()
+
+            def reader():
+                # idempotent GETs get ONE immediate retry: a SIGKILL
+                # can truncate a body the router already started
+                # relaying (headers sent -- nothing upstream can retry
+                # that), which a fresh request heals instantly. Only a
+                # read that fails TWICE in a row counts: that is a real
+                # unroutable window, not the kill instant itself.
+                while not stop.is_set():
+                    for attempt in (0, 1):
+                        try:
+                            _get(rbase, "/count/gdelt", timeout=10)
+                            reads[0] += 1
+                            break
+                        except Exception as e:
+                            if attempt:
+                                read_failures.append(repr(e))
+                    time.sleep(0.01)
+
+            acked: set = set()
+            inflight: set = set()
+            sheds = [0]
+            append_errors: list = []
+            fid_next = [5_000_000]
+
+            def append_one(batch=8):
+                fids = list(range(fid_next[0], fid_next[0] + batch))
+                fid_next[0] += batch
+                inflight.update(fids)
+                try:
+                    out = _append(rbase, fids)
+                    if out.get("acked") and out.get("replicated", True):
+                        acked.update(fids)
+                        inflight.difference_update(fids)
+                except urllib.error.HTTPError as e:
+                    try:
+                        body = e.read().decode("utf-8", "replace")
+                    except Exception:
+                        body = ""
+                    e.close()
+                    if e.code == 503:
+                        sheds[0] += 1
+                        if "unknown" not in body:
+                            inflight.difference_update(fids)
+                    else:
+                        append_errors.append(e.code)
+                except Exception as e:
+                    append_errors.append(repr(e))
+
+            appending = threading.Event()
+            appending.set()
+
+            def append_loop():
+                while not stop.is_set():
+                    if appending.is_set():
+                        append_one()
+                    time.sleep(0.04)
+
+            threads = [threading.Thread(target=reader) for _ in range(2)]
+            threads.append(threading.Thread(target=append_loop))
+            for t in threads:
+                t.start()
+
+            kinds = ["kill-follower", "kill-leader", "corrupt-wal",
+                     "diverge", "gap-410"]
+            if getattr(args, "smoke", False):
+                schedule = [str(k) for k in rng.permutation(kinds)]
+            else:
+                schedule = [str(k) for k in rng.permutation(kinds)]
+                schedule += [str(k) for k in rng.choice(kinds, size=5)]
+            reprovisions = 0
+            log(f"soak: schedule (seed {seed}): {schedule}")
+
+            def current_roles():
+                lead, followers = None, []
+                for u in urls:
+                    try:
+                        doc = _stats(u)
+                    except Exception:
+                        continue
+                    if doc.get("role") == "leader":
+                        lead = u
+                    else:
+                        followers.append(u)
+                return lead, followers
+
+            def wal_dir(url):
+                return os.path.join(roots[url], "gdelt", "_wal")
+
+            def wait_healed(url, need_reprovision):
+                if need_reprovision:
+                    _wait(
+                        lambda: _stats(url).get("reprovision", {})
+                        .get("completed", 0) >= 1,
+                        45, f"{url} to complete a snapshot reprovision",
+                    )
+                _wait(
+                    lambda: not _stats(url).get("reprovision", {})
+                    .get("pending"), 45, f"{url} reprovision queue empty",
+                )
+                fleet.wait_ready(url, timeout_s=45)
+                fleet.wait_caught_up(url, timeout_s=45)
+
+            for round_no, kind in enumerate(schedule):
+                lead, followers = current_roles()
+                assert lead is not None, "soak: no leader before round"
+                target = (
+                    lead if kind == "kill-leader"
+                    else followers[int(rng.integers(len(followers)))]
+                )
+                log(f"soak: round {round_no} {kind} -> {target}")
+                if kind in ("diverge",):
+                    appending.clear()  # the fork must stay ahead
+                    time.sleep(0.3)
+                procs[target].send_signal(signal.SIGKILL)
+                procs[target].wait(30)
+                del procs[target]
+                need_reprovision = False
+                if kind == "corrupt-wal":
+                    d = wal_dir(target)
+                    segs = sorted(
+                        f for f in os.listdir(d) if f.startswith("wal-")
+                    ) if os.path.isdir(d) else []
+                    if segs:
+                        with open(os.path.join(d, segs[-1]), "ab") as fh:
+                            fh.write(bytes(rng.integers(
+                                0, 256, 64, dtype=np.uint8)))
+                elif kind == "diverge":
+                    wal = WriteAheadLog(wal_dir(target))
+                    payloads = [p for _, p in wal.read_from(-1)]
+                    if payloads:
+                        for _ in range(400):
+                            wal.append_at(wal.next_seq, payloads[-1])
+                        need_reprovision = True
+                    wal.close()
+                elif kind == "gap-410":
+                    # held down past replica.retain.s while the leader
+                    # keeps compacting: its WAL position falls off the
+                    # leader's retained log
+                    time.sleep(RETAIN_S + 2.5)
+                    need_reprovision = True
+                if kind == "kill-leader":
+                    new_lead = fleet.wait_leader(
+                        [u for u in urls if u != target],
+                        timeout_s=FAILOVER_S + 10,
+                    )
+                    spawn(roots[target], ports[target], "follower",
+                          new_lead, peers_arg)
+                else:
+                    lead2, _ = current_roles()
+                    spawn(roots[target], ports[target], "follower",
+                          lead2 or lead, peers_arg)
+                appending.set()
+                wait_healed(target, need_reprovision)
+                if need_reprovision:
+                    reprovisions += 1
+                counts = fleet.verify_converged(urls, timeout_s=60)
+                log(f"soak: round {round_no} healed; converged "
+                    f"{counts['gdelt']} rows")
+
+            stop.set()
+            for t in threads:
+                t.join(10)
+            assert read_failures == [], (
+                f"{len(read_failures)} failed reads during the soak "
+                f"(first: {read_failures[0]})"
+            )
+            assert append_errors == [], (
+                f"append errors (not sheds): {append_errors[:5]}"
+            )
+            lead, followers = current_roles()
+            assert lead is not None and len(followers) == 2, (
+                f"forked or shrunken fleet: leader={lead}, "
+                f"followers={followers}"
+            )
+            for u in followers:
+                fleet.wait_caught_up(u, timeout_s=45)
+            counts = fleet.verify_converged(urls, timeout_s=60)
+            feats = _get(
+                lead, "/features/gdelt?cql=INCLUDE&maxFeatures=1000000",
+                timeout=60,
+            )
+            got = {int(f["id"]) for f in feats["features"]}
+            expected_floor = set(range(seed_n)) | acked
+            assert expected_floor <= got, (
+                f"lost {len(expected_floor - got)} acked rows"
+            )
+            assert got <= expected_floor | inflight, (
+                f"{len(got - expected_floor - inflight)} phantom rows"
+            )
+            assert counts["gdelt"] == len(got), "count/feature drift"
+            assert reprovisions >= 2, (
+                f"schedule ran but only {reprovisions} self-heal "
+                "reprovision(s) completed"
+            )
+            log(f"soak: ok — {len(schedule)} rounds, {reprovisions} "
+                f"snapshot reprovisions, {reads[0]} reads 0 failed, "
+                f"{len(acked)} acked rows all served, {sheds[0]} "
+                f"bounded sheds, {counts['gdelt']} converged rows")
+            rsrv.shutdown()
+            rsrv.server_close()
+        return {
+            "soak_seed": seed,
+            "soak_rounds": len(schedule),
+            "soak_reprovisions": reprovisions,
+            "soak_acked_rows": len(acked),
+            "soak_rows_served": len(got),
+            "soak_reads": reads[0],
+            "soak_sheds": sheds[0],
+            "soak_ok": True,
+        }
+    finally:
+        for p in procs.values():
+            try:
+                p.kill()
+                p.wait(10)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_trace_overhead(args) -> dict:
     """The --trace-overhead check: the serving leg with tracing at its
     DEFAULT sampling (trace.sample=1, slow capture on) must stay within
@@ -3985,7 +4420,9 @@ def main() -> None:
         "--smoke", action="store_true",
         help="oocscan mode: ONLY the small-N store-integrated leg with "
         "the sustained-MB/s regression guard (fast; tier-1/CI safe). "
-        "Without it the full leg runs the slow multi-GB device pump too.",
+        "Without it the full leg runs the slow multi-GB device pump "
+        "too. soak mode: one round of each fault kind instead of the "
+        "full randomized schedule.",
     )
     ap.add_argument(
         "--io-workers", type=int, default=0,
@@ -4014,6 +4451,11 @@ def main() -> None:
         "bundle must name the breaker + the attributed compiles",
     )
     ap.add_argument(
+        "--seed", type=int, default=None,
+        help="soak mode: fault-schedule RNG seed (printed in the log; "
+        "re-run with the same seed to reproduce a failing schedule)",
+    )
+    ap.add_argument(
         "--engine",
         choices=("pallas", "xla"),
         default="pallas",
@@ -4025,6 +4467,7 @@ def main() -> None:
             "all", "filter", "zscan", "build", "polygon", "density", "sweep",
             "xzbuild", "meshbuild", "multichip", "pipeline", "oocscan",
             "join", "serve", "flush", "stream", "results", "replica",
+            "soak",
         ),
         default="all",
         help="all: every benchmark, one JSON line with everything (what "
@@ -4081,6 +4524,8 @@ def main() -> None:
         # the replicated tier only has a chaos leg; --chaos-smoke is
         # how CI invokes it, but the bare mode runs the same thing
         out = bench_replica_chaos(args)
+    elif args.mode == "soak":
+        out = bench_soak(args)
     else:
         # zscan FIRST: its DeviceIndex staging is a long sequence of
         # host->device transfers that measures 20-30x slower when another

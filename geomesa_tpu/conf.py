@@ -251,6 +251,26 @@ Properties:
                                 instead of falling off a 410 cliff
                                 (a follower silent LONGER than this
                                 stops pinning the log -- bounded disk)
+- ``replica.reprovision.s``     bound on one snapshot reprovision
+                                attempt (fetch -> verify -> install ->
+                                swap): a follower past its leader's
+                                compaction horizon must be tailing
+                                again within it or the attempt aborts,
+                                logs loudly and retries next cycle
+- ``snapshot.pin.ttl.s``        GC-pin time-to-live: a snapshot pin
+                                whose file has not been touched for
+                                this long (stream dead, e.g. SIGKILLed
+                                mid-ship) stops protecting its
+                                generation and is reclaimed by the
+                                next recovery/GC sweep; live streams
+                                refresh their pin as they ship
+- ``snapshot.chunk.bytes``      buffer size for snapshot stream file
+                                reads/writes (``GET /snapshot/<type>``
+                                and the install download)
+- ``backup.wal.trailing``       ``backup`` copies the WAL segments
+                                trailing the snapshot watermark (the
+                                acked-but-uncompacted rows) so restore
+                                replays them; 0 = snapshot only
 - ``router.retries``            read retries across DISTINCT replicas
                                 beyond the first backend the router
                                 tries (router.py)
@@ -479,6 +499,13 @@ _DEFS = {
     "replica.ack": ("local", _parse_replica_ack),
     "replica.ack.timeout.s": (2.0, float),
     "replica.retain.s": (600.0, float),
+    "replica.reprovision.s": (60.0, float),
+    # snapshot plane (store/snapshot.py, ISSUE 15): consistent-snapshot
+    # GC pin TTL (orphaned pins from a killed stream age out under it),
+    # the ship/stream chunk size, and backup's trailing-WAL toggle
+    "snapshot.pin.ttl.s": (300.0, float),
+    "snapshot.chunk.bytes": (512 << 10, int),
+    "backup.wal.trailing": (1, int),
     "router.retries": (2, int),
     "router.health.ms": (250.0, float),
     # operator plane: shared secret for POST /admin/shutdown (empty =

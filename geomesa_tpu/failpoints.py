@@ -71,6 +71,20 @@ Replication points (replica.py — the failover kill matrix, ISSUE 14):
                                    replica must take over from) a fault
                                    injected here
 
+Snapshot-plane points (store/snapshot.py — the self-healing replica
+matrix, ISSUE 15):
+
+- ``fail.snapshot.stream``      -- a pinned snapshot stream is about to
+                                   ship its next file record; ``raise``
+                                   truncates the stream mid-transfer
+                                   (the client must resume or restart,
+                                   and the orphaned pin must age out
+                                   under ``snapshot.pin.ttl.s``)
+- ``fail.snapshot.install``     -- a downloaded snapshot is about to
+                                   swap into the live tree; a fault
+                                   here must leave the previous
+                                   generation published and intact
+
 Activation: programmatic (``set_failpoint``/``failpoint_override``) or
 the ``GEOMESA_TPU_FAILPOINTS`` environment variable, a comma-separated
 ``name=action`` list — the env form is how a chaos test arms a point in
@@ -124,6 +138,8 @@ POINTS = (
     "fail.compact.publish",
     "fail.replica.apply",
     "fail.replica.promote",
+    "fail.snapshot.stream",
+    "fail.snapshot.install",
 )
 
 

@@ -105,6 +105,7 @@ FIELDS = (
     "response_bytes",    # response body bytes written to the socket
     "replica_ship_bytes",  # WAL record bytes shipped to followers
     "replica_apply_rows",  # rows applied from a leader's shipped WAL
+    "snapshot_ship_bytes",  # snapshot stream bytes shipped to a fetcher
 )
 
 #: fields folded with max() instead of sum() (a request's fusion width

@@ -703,6 +703,44 @@ replica_demotions = REGISTRY.counter(
     "leader roles this process surrendered after observing a higher "
     "election epoch (fencing: a stale leader must not take appends)",
 )
+replica_reprovisions = REGISTRY.counter(
+    "geomesa_replica_reprovisions_total",
+    "snapshot reprovisions this follower completed (410-gone, gap, "
+    "diverged tail or repeated apply failure turned into a rebuild)",
+)
+replica_reprovision_seconds = REGISTRY.histogram(
+    "geomesa_replica_reprovision_seconds",
+    "trigger-to-tailing-again time per snapshot reprovision",
+)
+
+# snapshot plane (store/snapshot.py + the /snapshot/<type> ship
+# endpoint): capture/pin accounting and shipped/installed volume
+snapshot_captures = REGISTRY.counter(
+    "geomesa_snapshot_captures_total",
+    "consistent snapshots captured (pin written under the publish lock)",
+)
+snapshot_ship_bytes = REGISTRY.counter(
+    "geomesa_snapshot_ship_bytes_total",
+    "bytes shipped over GET /snapshot/<type> streams",
+)
+snapshot_ship_files = REGISTRY.counter(
+    "geomesa_snapshot_ship_files_total",
+    "file records shipped over GET /snapshot/<type> streams",
+)
+snapshot_installs = REGISTRY.counter(
+    "geomesa_snapshot_installs_total",
+    "downloaded snapshots swapped into a live tree (write-new-then-"
+    "publish install)",
+)
+snapshot_install_bytes = REGISTRY.counter(
+    "geomesa_snapshot_install_bytes_total",
+    "bytes of verified snapshot files installed into a live tree",
+)
+snapshot_pins_reclaimed = REGISTRY.counter(
+    "geomesa_snapshot_pins_reclaimed_total",
+    "orphaned snapshot pins aged out past snapshot.pin.ttl.s by the "
+    "GC/recovery sweep",
+)
 router_requests = REGISTRY.counter(
     "geomesa_router_requests_total",
     "requests the router front tier completed",

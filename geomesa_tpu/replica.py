@@ -140,6 +140,12 @@ class Replicator:
         self._leader_next: "dict[str, int]" = {}
         self._needs_reprovision: "set[str]" = set()
         self._apply_failures: "dict[str, int]" = {}
+        #: the in-flight snapshot reprovision's state doc (None when
+        #: healthy) — /readyz reports not-ready while set — plus the
+        #: last finished attempt, for /stats/replica
+        self._reprovision_state: "dict | None" = None
+        self._last_reprovision: "dict | None" = None
+        self.reprovisions = 0
         #: election epoch — the fencing token: bumped past every epoch
         #: seen in an election by the winner, advertised on ship
         #: requests/responses and /stats/replica; a leader observing a
@@ -204,6 +210,14 @@ class Replicator:
     @property
     def epoch(self) -> int:
         return self._epoch
+
+    @property
+    def reprovisioning(self) -> "dict | None":
+        """The active snapshot-reprovision state doc, or None when no
+        install is in flight. ``/readyz`` answers not-ready while this
+        is set: a replica mid-swap serves neither reads nor a
+        trustworthy lag number, and the router must route around it."""
+        return self._reprovision_state
 
     def observe_epoch(self, epoch: int) -> None:
         """A peer advertised election ``epoch`` (the ship request's
@@ -365,6 +379,12 @@ class Replicator:
                 return
         progressed = False
         contacted = False
+        if not list(self.stream.store.type_names):
+            # bootstrap-from-zero (fleet add-node): an empty store has
+            # nothing to tail — ask the leader what exists and flag
+            # every type for snapshot reprovision, which installs
+            # schema + partitions + watermark in one swap
+            contacted = self._bootstrap_types(log) or contacted
         cost = ledger.RequestCost(
             tenant="_system", endpoint="other", lane="ingest",
             shape="replica-apply",
@@ -403,6 +423,10 @@ class Replicator:
         if cost.fields and ledger.enabled():
             cost.status = 200
             ledger.LEDGER.record(cost)
+        if (self._needs_reprovision and self._role == "follower"
+                and self._leader_url and not self._stop.is_set()):
+            contacted = self._reprovision(log, metrics, sys_prop) \
+                or contacted
         now = time.monotonic()
         if contacted:
             self._last_ok = now
@@ -455,7 +479,13 @@ class Replicator:
 
         log = logging.getLogger(__name__)
         ts = self.stream._ts(type_name)
-        frm = int(ts.wal.next_seq)
+        st = self.stream.store._types[type_name]
+        # the durable position is the WAL tail OR the manifest
+        # watermark, whichever is ahead: a freshly-installed snapshot
+        # has an EMPTY local WAL but a watermark-exact manifest, and
+        # tailing must resume from watermark+1 (apply_replicated
+        # legalizes exactly that jump), not re-ask from seq 0
+        frm = max(int(ts.wal.next_seq), int(st.wal_watermark) + 1)
         wait_ms = max(float(sys_prop("replica.wait.ms")), 0.0)
         url = (
             f"{self._leader_url}/wal/"
@@ -553,6 +583,209 @@ class Replicator:
         self._apply_failures.pop(type_name, None)
         self._needs_reprovision.discard(type_name)
         return applied
+
+    # -- follower side: snapshot reprovision (self-healing) ------------------
+
+    def _bootstrap_types(self, log) -> bool:
+        """Ask the leader (via its ``/stats/replica`` doc) which types
+        exist and flag every one for snapshot reprovision — how a node
+        added to the fleet with an EMPTY store provisions itself.
+        Returns True when the leader answered (lease contact)."""
+        doc = self._peer_stats(self._leader_url, timeout=2.0)
+        if doc is None:
+            return False
+        if doc.get("role") not in ("leader", "promoting"):
+            self._leader_url = ""
+            return False
+        self._epoch = max(self._epoch, int(doc.get("epoch", 0) or 0))
+        for t in doc.get("types", {}):
+            self._needs_reprovision.add(str(t))
+        return True
+
+    def _reprovision(self, log, metrics, sys_prop) -> bool:
+        """The self-healing state machine every ``needs_reprovision``
+        condition converges on (410 compacted-past, ship gap, diverged
+        tail, ``_APPLY_FAULT_LIMIT`` apply failures, bootstrap-from-
+        zero): fetch a pinned snapshot from the leader, stage + verify
+        it file by file, install via the store's write-new-then-publish
+        swap, resume tailing from the snapshot watermark. One pass is
+        bounded by ``replica.reprovision.s``; a failed or timed-out
+        type keeps its flag and retries next cycle. While any install
+        is in flight :attr:`reprovisioning` is set (``/readyz``
+        not-ready) and ``reprovision-installing`` is stamped degraded.
+        Returns True when the leader answered at all — reprovision
+        contact holds the lease exactly like a ship fetch does."""
+        from geomesa_tpu import resilience, slo
+
+        types = sorted(self._needs_reprovision)
+        started = time.monotonic()
+        self._reprovision_state = {
+            "types": types,
+            "leader": self._leader_url,
+            "epoch": self._epoch,
+            "started_unix": time.time(),  # lint: disable=GT003(epoch timestamp surfaced to operators on /stats/replica; the deadline below uses monotonic)
+        }
+        resilience.note_degraded("reprovision-installing")
+        try:
+            slo.FLIGHTREC.trigger("replica-reprovision", detail={
+                "self": self.cfg.self_url,
+                "leader": self._leader_url,
+                "types": types,
+                "epoch": self._epoch,
+            })
+        except Exception:  # pragma: no cover - observability must not break
+            pass
+        deadline = started + max(
+            float(sys_prop("replica.reprovision.s")), 1.0
+        )
+        contacted = False
+        healed: "list[str]" = []
+        error = ""
+        try:
+            for t in types:
+                if self._stop.is_set() or self._role != "follower":
+                    break
+                if time.monotonic() >= deadline:
+                    error = error or "replica.reprovision.s deadline"
+                    break
+                try:
+                    got, installed = self._reprovision_type(
+                        t, deadline, log
+                    )
+                except StaleLeaderError as e:
+                    log.warning("replica: %s; rediscovering", e)
+                    self._leader_url = ""
+                    error = str(e)
+                    break
+                except Exception as e:
+                    error = f"{type(e).__name__}: {e}"
+                    log.warning(
+                        "replica: snapshot reprovision of %r from %s "
+                        "failed (%s); flag held, retrying next cycle",
+                        t, self._leader_url, error,
+                    )
+                    continue
+                contacted = contacted or got
+                if installed:
+                    self._needs_reprovision.discard(t)
+                    self._apply_failures.pop(t, None)
+                    healed.append(t)
+                    self.reprovisions += 1
+                    metrics.replica_reprovisions.inc()
+        finally:
+            dur = time.monotonic() - started
+            metrics.replica_reprovision_seconds.observe(dur)
+            self._last_reprovision = {
+                "types": types,
+                "healed": healed,
+                "seconds": round(dur, 3),
+                "error": error,
+                "unix": time.time(),  # lint: disable=GT003(epoch timestamp surfaced to operators; the duration is monotonic-derived)
+            }
+            self._reprovision_state = None
+        if healed:
+            log.warning(
+                "replica: reprovisioned %s from snapshot(s) off %s in "
+                "%.3fs; tailing resumes from the snapshot watermark",
+                ",".join(healed), self._leader_url or "(gone)", dur,
+            )
+        return contacted
+
+    def _reprovision_type(self, type_name: str, deadline: float,
+                          log) -> "tuple[bool, bool]":
+        """Fetch + stage + install one type's snapshot. Resumes per
+        file over stream truncation (``?id=<sid>&from_file=K``) until
+        ``deadline``; a 410 on resume (the pin's TTL reclaimed it)
+        restarts with a fresh capture. Refuses a seed served by a
+        non-leader or at a LOWER election epoch — the same fencing rule
+        as the ship path: installing a stale ex-leader's snapshot
+        would fork the group. Returns ``(leader_contacted,
+        installed)``."""
+        import os
+
+        from geomesa_tpu.store import snapshot
+
+        store = self.stream.store
+        contacted = False
+        sid = ""
+        from_file = 0
+        doc: "dict | None" = None
+        stage = ""
+        while True:
+            if self._stop.is_set() or self._role != "follower":
+                return contacted, False
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(
+                    f"reprovision of {type_name!r} ran past the "
+                    f"replica.reprovision.s bound"
+                )
+            q = f"?id={sid}&from_file={from_file}" if sid else ""
+            url = (
+                f"{self._leader_url}/snapshot/"
+                f"{urllib.parse.quote(type_name)}{q}"
+            )
+            try:
+                resp = urllib.request.urlopen(
+                    url, timeout=max(min(left, 30.0), 1.0)
+                )
+            except urllib.error.HTTPError as e:
+                code = e.code
+                e.close()
+                if code == 410 and sid:
+                    # the pin aged out (snapshot.pin.ttl.s) between
+                    # resume attempts: restart with a fresh capture
+                    contacted = True
+                    sid, from_file, doc = "", 0, None
+                    continue
+                raise
+            with resp:
+                contacted = True
+                # the leader answered: refresh the lease HERE, not just
+                # via the caller's contact flag — an install failure
+                # after a successful download must not expire the lease
+                # into an election against a live leader
+                self._last_ok = time.monotonic()
+                role = resp.headers.get("X-Replica-Role", "leader")
+                epoch = int(
+                    resp.headers.get("X-Replica-Epoch", "0") or 0
+                )
+                if role == "follower" or epoch < self._epoch:
+                    raise StaleLeaderError(
+                        f"{self._leader_url} served a snapshot as "
+                        f"{role!r} at epoch {epoch} (ours {self._epoch})"
+                    )
+                self._epoch = max(self._epoch, epoch)
+                if not sid:
+                    sid = resp.headers.get("X-Snapshot-Id", "")
+                    if not sid:
+                        raise WalCorruption(
+                            "snapshot response carried no X-Snapshot-Id"
+                        )
+                    stage = snapshot.stage_path(store, type_name, sid)
+                    os.makedirs(stage, exist_ok=True)
+                got_doc, done, complete = snapshot.read_stream(
+                    resp, stage
+                )
+                doc = got_doc or doc
+                from_file += int(done)
+            if complete and doc is not None:
+                break
+            log.info(
+                "replica: snapshot stream for %r truncated at file "
+                "%d; resuming (id=%s)", type_name, from_file, sid,
+            )
+        res = self.stream.install_snapshot(type_name, doc, stage)
+        # the pre-install leader position describes a history we just
+        # replaced — drop it so lag doesn't spike off the stale number
+        self._leader_next.pop(type_name, None)
+        log.info(
+            "replica: installed snapshot %s for %r (generation %s, "
+            "watermark %s, %d bytes)", sid, type_name,
+            res.get("generation"), res.get("watermark"),
+            int(res.get("bytes", 0)),
+        )
+        return contacted, True
 
     def _publish_lag(self, metrics) -> None:
         lag = 0
@@ -803,6 +1036,12 @@ class Replicator:
             "lag_records": self.lag_records(),
             "types": types,
             "followers": followers,
+            "reprovision": {
+                "active": self._reprovision_state,
+                "pending": sorted(self._needs_reprovision),
+                "completed": self.reprovisions,
+                "last": self._last_reprovision,
+            },
             "failovers": self.failovers,
             "last_failover_seconds": round(self.last_failover_s, 3),
             "leader_ok_age_s": round(

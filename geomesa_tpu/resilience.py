@@ -502,6 +502,7 @@ REASONS = frozenset(
         "wal-replay-truncated",
         "replica-lag",
         "replica-degraded",
+        "reprovision-installing",
     }
 )
 

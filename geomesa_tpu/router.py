@@ -111,6 +111,10 @@ class Router:
         self.backends = [_Backend(u) for u in backends]
         self._lock = checked_lock("router.state")
         self._rr = 0
+        #: newest election epoch learned from a follower's append
+        #: bounce body — staler bounces (a revenant ex-leader's view)
+        #: must not un-learn a newer leader
+        self._bounce_epoch = 0
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
         self._tls = threading.local()
@@ -205,6 +209,34 @@ class Router:
                 if b.reachable and b.role == "leader":
                     return b
         return None
+
+    def note_bounce(self, b: _Backend, doc: dict) -> None:
+        """A follower bounced an append with its view of the group
+        (the 503 body's ``leader`` URL + election ``epoch``): adopt
+        that leader immediately instead of shedding appends until the
+        next health-probe pass. The epoch gates staleness — a bounce
+        carrying an older epoch than one already consumed is a
+        revenant's view and is ignored; the probe loop reconciles any
+        remaining disagreement on its next pass."""
+        url = str(doc.get("leader") or "")
+        try:
+            epoch = int(doc.get("epoch", 0) or 0)
+        except (TypeError, ValueError):
+            return
+        if not url:
+            return
+        with self._lock:
+            if epoch < self._bounce_epoch:
+                return
+            self._bounce_epoch = max(self._bounce_epoch, epoch)
+            b.role = "follower"
+            for peer in self.backends:
+                if peer.url.rstrip("/") == url.rstrip("/"):
+                    peer.role = "leader"
+                elif peer is not b and peer.role == "leader":
+                    # only one leader per epoch: whoever the bounce
+                    # named displaces any stale pin
+                    peer.role = "follower"
 
     def stats(self) -> dict:
         with self._lock:
@@ -490,6 +522,31 @@ class _RouterHandler(BaseHTTPRequestHandler):
                           "unknown — check before re-sending"},
                 headers=(("Retry-After", "1"),),
             )
+        if status == 503:
+            # a follower's bounce body names the leader it tails plus
+            # the election epoch (server.py's append path): consume it
+            # so the NEXT append routes right without waiting a probe
+            # interval, then relay the buffered body unchanged — the
+            # client's own re-discovery still works
+            try:
+                raw = resp.read()
+            except Exception:
+                rt._drop_conn(lead)
+                raw = b""
+            lead.breaker.record_failure()
+            metrics.router_backend_errors.inc()
+            try:
+                rt.note_bounce(lead, json.loads(raw))
+            except Exception:
+                pass
+            ctype = "application/json"
+            fwd = []
+            for k, v in hdrs:
+                if k.lower() == "content-type":
+                    ctype = v
+                elif k.lower() != "content-length":
+                    fwd.append((k, v))
+            return self._send(status, raw, ctype, headers=fwd)
         if status >= 500:
             lead.breaker.record_failure()
             metrics.router_backend_errors.inc()

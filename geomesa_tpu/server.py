@@ -625,7 +625,7 @@ class _Handler(BaseHTTPRequestHandler):
         ) or parts == ["stats", "mesh"] or parts == ["stats", "slo"] \
             or parts == ["stats", "ledger"] or parts == ["stats", "stream"] \
             or parts == ["stats", "replica"] or parts[:1] == ["wal"] \
-            or parts == ["stats"]
+            or parts[:1] == ["snapshot"] or parts == ["stats"]
         if untraced:
             self._trace = None
             self._degraded = None
@@ -796,6 +796,10 @@ class _Handler(BaseHTTPRequestHandler):
             # fork the WAL seq space. 503 + Retry-After (not 4xx) —
             # during promotion the SAME url becomes writable, so the
             # client/router should retry, not give up
+            # the bounce carries the epoch alongside the leader url so
+            # the router/load-driver re-discover in one hop, without a
+            # /stats/replica round trip — and can ignore a bounce from
+            # a staler epoch than one they already followed
             return self._send(
                 503,
                 json.dumps({
@@ -803,6 +807,7 @@ class _Handler(BaseHTTPRequestHandler):
                              f"(role={rep.role}); appends go to the "
                              "leader",
                     "leader": rep.leader_url,
+                    "epoch": int(rep.epoch),
                 }).encode("utf-8"),
                 "application/json",
                 headers=(("Retry-After", "1"),),
@@ -975,6 +980,14 @@ class _Handler(BaseHTTPRequestHandler):
         if self.replica is not None:
             # the router's health poll keys append-routing off this
             doc["replica_role"] = self.replica.role
+            inst = self.replica.reprovisioning
+            if inst:
+                # mid-reprovision this node's store is being swapped
+                # out from under its query surface: not-ready, so the
+                # router routes reads to healthy replicas until the
+                # install finishes and lag returns to 0
+                doc["ready"] = False
+                doc["reprovisioning"] = inst
         self._json(200 if doc["ready"] else 503, doc)
 
     def _dispatch(self, url, parts: list, q: dict) -> None:
@@ -1037,6 +1050,11 @@ class _Handler(BaseHTTPRequestHandler):
             # replication shipping stays OPEN while draining: the fleet
             # restart drains a leader exactly so followers can catch up
             return self._wal_ship(unquote(parts[1]), q)
+        if len(parts) == 2 and parts[0] == "snapshot":
+            # snapshot bootstrap stays OPEN while draining too: a
+            # reprovisioning follower mid-download must be able to
+            # finish against a draining leader
+            return self._snapshot_ship(unquote(parts[1]), q)
         if len(parts) == 2 and parts[0] in (
             "features", "count", "explain", "density", "stats",
             "refresh", "knn", "tube", "proximity",
@@ -1184,6 +1202,103 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 cost.status = 200
                 cost.charge("replica_ship_bytes", state["bytes"])
+                ledger.LEDGER.record(cost)
+
+    def _snapshot_ship(self, type_name: str, q: dict) -> None:
+        """``GET /snapshot/<type>[?id=&from_file=]`` — the snapshot
+        bootstrap endpoint: captures a consistent, GC-pinned snapshot
+        of the type's published generation under the publish lock and
+        ships it as a chunked stream of length-prefixed, checksummed
+        file records (store/snapshot.py framing; the manifest ships
+        last, the same order the installer publishes in). ``id`` +
+        ``from_file`` resume an earlier stream off its still-pinned
+        snapshot, skipping files already landed; 410 Gone when that pin
+        was released or aged out (``snapshot.pin.ttl.s``) — the client
+        restarts with a fresh capture. The pin is released when the
+        stream completes; a truncated stream leaves it for the resume
+        or the TTL sweep. Role/epoch ride the response headers so a
+        reprovisioning follower can refuse a snapshot seeded by a
+        stale leader."""
+        from geomesa_tpu import ledger, metrics
+        from geomesa_tpu.store import snapshot as snapshot_mod
+
+        stream = self.stream
+        if stream is None:
+            return self._json(
+                400,
+                {"error": "server is not running with the streaming "
+                          "live layer (stream.enabled / serve --stream)"},
+            )
+        self.store.get_schema(type_name)  # KeyError -> 404
+        store = stream.store
+        sid = str(q.get("id", "") or "")
+        try:
+            from_file = max(int(q.get("from_file", 0) or 0), 0)
+        except (TypeError, ValueError):
+            from_file = 0
+        if sid:
+            doc = snapshot_mod.load_pin(store, type_name, sid)
+            if doc is None:
+                return self._json(410, {
+                    "error": f"snapshot {sid!r} was released or its "
+                             "pin aged out; restart with a fresh "
+                             "GET /snapshot",
+                })
+            # the resumed stream holds the pin live again
+            store._active_pins.add((type_name, sid))
+        else:
+            doc = snapshot_mod.capture(store, type_name)
+            sid = doc["snapshot_id"]
+            from_file = 0
+        rep = self.replica
+        role = rep.role if rep is not None else "leader"
+        state = {"bytes": 0, "done": False}
+
+        def chunks():
+            try:
+                for b in snapshot_mod.iter_stream(
+                    store, type_name, doc, from_file=from_file
+                ):
+                    state["bytes"] += len(b)
+                    yield b
+                state["done"] = True
+            finally:
+                if state["done"]:
+                    # complete hand-off: unpin, GC may reclaim on the
+                    # next sweep
+                    snapshot_mod.release(store, type_name, sid)
+                else:
+                    # truncated (client gone, disk error, failpoint):
+                    # the on-disk pin stays for a resume, but this
+                    # process stops holding it live — an abandoned
+                    # stream's pin ages out under snapshot.pin.ttl.s
+                    store._active_pins.discard((type_name, sid))
+
+        self._send_stream(
+            200, snapshot_mod.SNAPSHOT_CONTENT_TYPE, chunks(),
+            "snapshot",
+            headers=(
+                ("X-Snapshot-Id", sid),
+                ("X-Wal-Watermark", str(int(doc.get("wal_watermark", -1)))),
+                ("X-Snapshot-Files", str(len(doc.get("files", ())))),
+                ("X-Replica-Role", role),
+                ("X-Replica-Epoch",
+                 str(rep.epoch if rep is not None else 0)),
+            ),
+        )
+        if state["bytes"]:
+            metrics.snapshot_ship_bytes.inc(state["bytes"])
+            if state["done"]:
+                metrics.snapshot_ship_files.inc(
+                    max(len(doc.get("files", ())) - from_file, 0)
+                )
+            if ledger.enabled():
+                cost = ledger.RequestCost(
+                    tenant="_system", endpoint="snapshot", lane="batch",
+                    shape="snapshot-ship",
+                )
+                cost.status = 200 if state["done"] else 499
+                cost.charge("snapshot_ship_bytes", state["bytes"])
                 ledger.LEDGER.record(cost)
 
     def _stats_index(self) -> dict:

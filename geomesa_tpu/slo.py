@@ -81,7 +81,7 @@ SLO_NAMES = ("interactive", "batch", "ingest")
 #: here, so reasons stay a bounded, greppable enum
 FLIGHT_REASONS = (
     "burn-rate", "breaker-open", "manual", "ingest-stall",
-    "replica-failover", "replica-demote",
+    "replica-failover", "replica-demote", "replica-reprovision",
 )
 
 #: windowed-histogram bucket bounds (seconds) — finer than the metrics

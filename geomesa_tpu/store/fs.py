@@ -492,6 +492,10 @@ class FileSystemDataStore:
         #: into the next explicit recover() so fsck reports the crash
         #: cleanup its own store open already performed
         self._open_recovery: dict = {}
+        #: (type_name, snapshot_id) pins THIS process's snapshot streams
+        #: hold: exempt from the on-disk pin TTL so a slow-but-live
+        #: local stream is never torn by its own store's sweep
+        self._active_pins: "set[tuple[str, str]]" = set()
         if audit:  # the <catalog>_queries table analog
             from geomesa_tpu.audit import FileAuditWriter
 
@@ -1228,16 +1232,30 @@ class FileSystemDataStore:
         """Remove part/tmp files not referenced by the current manifest
         (the previous generation right after a publish; interrupted-flush
         leftovers during a recovery sweep). Caller holds the exclusive
-        lock. Returns (files, bytes) removed."""
+        lock. Returns (files, bytes) removed.
+
+        Snapshot pins (store/snapshot.py) extend the keep-set: a pinned
+        generation's files survive even after a newer manifest
+        supersedes them, so an in-flight ``GET /snapshot`` stream never
+        has a file reclaimed from under it; the pin helper also ages
+        out orphaned pins (``snapshot.pin.ttl.s``) so a SIGKILLed
+        stream delays GC boundedly instead of wedging it. Underscore
+        directories (``_wal``, ``_pins``, ``_snapstage``) are never
+        descended into — the WAL/pin/stage planes manage their own
+        files."""
         import logging
+
+        from geomesa_tpu.store import snapshot
 
         st = self._types[type_name]
         expected = {
             os.path.abspath(self._part_path(type_name, p))
             for p in st.partitions
         }
+        expected |= snapshot.pinned_paths(self, type_name)
         files = nbytes = 0
-        for dirpath, _, names in os.walk(self._dir(type_name)):
+        for dirpath, dirnames, names in os.walk(self._dir(type_name)):
+            dirnames[:] = [d for d in dirnames if not d.startswith("_")]
             for f in names:
                 if not (f.startswith("part-") or f.endswith(".tmp")):
                     continue

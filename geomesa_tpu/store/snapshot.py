@@ -1,0 +1,542 @@
+"""Consistent store snapshots with GC pins — the bulk-provision plane.
+
+Ref role: GeoMesa production deployments bulk-provision replicas and
+take point-in-time backups through the backing store's snapshot/clone
+machinery (Accumulo table cloning; the FS store's immutable partition
+layout). This module is that plane for the TPU store: a snapshot is the
+published manifest of one generation plus that generation's partition
+files plus the WAL watermark recorded in the manifest — everything a
+fresh node needs to serve the type and resume tailing the leader's WAL
+from ``watermark + 1``.
+
+Consistency comes for free from the store's write-new-then-publish
+discipline (ISSUE 3): a published generation's files are immutable, so
+a snapshot captured under the publish lock names a frozen, checksummed
+file set. The only hazard is garbage collection — the very next compact
+publishes a NEW generation and sweeps the old one's files out from
+under a stream in progress. A **pin** closes that hole: capture writes
+a pin file (the snapshot doc itself) under ``<type>/_pins/`` before
+releasing the lock, and ``_gc_stale_parts`` unions every live pin's
+file set into its keep-set. Pins are leases, not locks: a stream
+touches its pin after every shipped file, and a pin untouched for
+``snapshot.pin.ttl.s`` (its stream died — SIGKILL mid-ship) is
+reclaimed by the next sweep, so a crashed snapshot can delay GC but
+never wedge it.
+
+Wire framing (``GET /snapshot/<type>``) follows the WAL ship
+discipline: length-prefixed records with a crc-protected header, over
+chunked transfer encoding, so truncation is always detectable (the
+stream ends without its END record). Per-file integrity rides the PR 3
+manifest checksum entries — the receiver verifies every file as it
+lands, incrementally, before anything installs. Resume is per-file:
+``?id=<snapshot_id>&from_file=K`` re-opens the same pin and skips the
+K files already landed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import struct
+import time
+import uuid
+import zlib
+
+__all__ = [
+    "KIND_BEGIN",
+    "KIND_END",
+    "KIND_FILE",
+    "SNAPSHOT_CONTENT_TYPE",
+    "SnapshotError",
+    "SnapshotFormatError",
+    "capture",
+    "install_files",
+    "iter_stream",
+    "load_pin",
+    "pinned_paths",
+    "read_stream",
+    "release",
+    "stage_path",
+    "touch_pin",
+]
+
+SNAPSHOT_CONTENT_TYPE = "application/x-geomesa-snapshot"
+
+#: record header: magic, kind, payload length, crc32 of the record's
+#: JSON metadata (file BYTES are covered by the manifest checksums the
+#: metadata carries — framing integrity here, content integrity there)
+_MAGIC = 0x50534D47  # "GMSP" little-endian
+_HEADER = struct.Struct("<IIQI")
+_LEN = struct.Struct("<I")
+
+KIND_BEGIN = 1  # payload: the snapshot doc (json)
+KIND_FILE = 2  # payload: u32 meta_len + meta json + raw file bytes
+KIND_END = 3  # payload: totals (json) — its presence proves completeness
+
+
+class SnapshotError(RuntimeError):
+    """A snapshot operation failed (capture, stream, or install)."""
+
+
+class SnapshotFormatError(SnapshotError):
+    """A snapshot stream violated its framing (bad magic/crc/length)."""
+
+
+def _safe_rel(rel: str) -> str:
+    """Reject path traversal in a received file record: rel paths come
+    off the wire and are joined under the install dir."""
+    if not rel or os.path.isabs(rel):
+        raise SnapshotFormatError(f"unsafe snapshot path {rel!r}")
+    parts = rel.replace("\\", "/").split("/")
+    if any(p in ("", ".", "..") for p in parts):
+        raise SnapshotFormatError(f"unsafe snapshot path {rel!r}")
+    return os.path.join(*parts)
+
+
+def _pins_dir(store, type_name: str) -> str:
+    return os.path.join(store._dir(type_name), "_pins")
+
+
+def stage_path(store, type_name: str, snapshot_id: str) -> str:
+    """Download staging dir for one incoming snapshot. Lives under the
+    type dir (same filesystem: the install swap is an atomic rename)
+    but underscore-prefixed, so the GC walk never descends into it;
+    stale stages age out with the pins under ``snapshot.pin.ttl.s``."""
+    return os.path.join(
+        store._dir(type_name), "_snapstage", str(snapshot_id)
+    )
+
+
+# -- capture / pins ----------------------------------------------------------
+
+
+def capture(store, type_name: str) -> dict:
+    """Capture a consistent snapshot of ``type_name`` under the publish
+    lock and PIN it: returns the snapshot doc (also persisted as the
+    pin file), whose ``files`` list names the manifest plus every
+    partition file of the published generation, each with its manifest
+    checksum. Until :func:`release` (or the pin's TTL expiry), GC and
+    recovery sweeps keep those files on disk even across compactions
+    that supersede the generation."""
+    from geomesa_tpu import metrics
+    from geomesa_tpu.conf import sys_prop
+    from geomesa_tpu.store.fs import _write_file, checksum_bytes
+
+    with store._exclusive():
+        # re-sync first: another process may have published a newer
+        # generation; pinning a stale in-memory view would name files
+        # a sweep already reclaimed
+        store._refresh_from_disk(type_name)
+        st = store._types[type_name]
+        d = store._dir(type_name)
+        with open(os.path.join(d, "schema.json"), "rb") as fh:
+            mbytes = fh.read()
+        manifest = json.loads(mbytes)
+        files = []
+        for p in st.partitions:
+            path = store._part_path(type_name, p)
+            files.append({
+                "rel": os.path.relpath(path, d).replace(os.sep, "/"),
+                "nbytes": int(os.path.getsize(path)),
+                "checksum": p.checksum,
+            })
+        # the manifest ships LAST: the installer lands data files
+        # first and publishes the manifest over them (the store's own
+        # write-new-then-publish order)
+        algo, value = checksum_bytes(mbytes)
+        files.append({
+            "rel": "schema.json",
+            "nbytes": len(mbytes),
+            "checksum": {
+                "algo": algo, "value": value, "length": len(mbytes),
+            },
+        })
+        sid = uuid.uuid4().hex[:12]
+        doc = {
+            "snapshot_id": sid,
+            "type": type_name,
+            "generation": manifest.get("generation"),
+            "file_gen": manifest.get("file_gen"),
+            "wal_watermark": int(manifest.get("wal_watermark", -1)),
+            "created_unix": time.time(),  # lint: disable=GT003(epoch timestamp persisted into the snapshot doc)
+            "files": files,
+            "total_bytes": int(sum(f["nbytes"] for f in files)),
+        }
+        pdir = _pins_dir(store, type_name)
+        os.makedirs(pdir, exist_ok=True)
+        tmp = os.path.join(pdir, sid + ".pin.tmp")
+        _write_file(
+            tmp, json.dumps(doc).encode("utf-8"),
+            bool(sys_prop("store.fsync")),
+        )
+        os.replace(tmp, os.path.join(pdir, sid + ".json"))
+        store._active_pins.add((type_name, sid))
+    metrics.snapshot_captures.inc()
+    return doc
+
+
+def load_pin(store, type_name: str, snapshot_id: str) -> "dict | None":
+    """The pin doc for an existing snapshot, or None if released or
+    reclaimed (the resuming client must restart with a fresh capture)."""
+    path = os.path.join(_pins_dir(store, type_name), snapshot_id + ".json")
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def touch_pin(store, type_name: str, snapshot_id: str) -> None:
+    """Refresh a pin's lease (mtime): live streams call this per
+    shipped file so only DEAD streams' pins age past the TTL."""
+    path = os.path.join(_pins_dir(store, type_name), snapshot_id + ".json")
+    try:
+        os.utime(path)
+    except OSError:
+        pass  # reclaimed under us: the stream fails on its next record
+
+
+def release(store, type_name: str, snapshot_id: str) -> None:
+    """Drop a pin: the snapshot's superseded generations become
+    reclaimable by the next sweep."""
+    store._active_pins.discard((type_name, snapshot_id))
+    path = os.path.join(_pins_dir(store, type_name), snapshot_id + ".json")
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def pinned_paths(store, type_name: str) -> "set[str]":
+    """Abspaths of every file a live pin protects — the GC keep-set
+    (``_gc_stale_parts`` unions this into its manifest ``expected``).
+    Doubles as the pin sweeper: pins whose file has not been touched
+    for ``snapshot.pin.ttl.s`` (their stream is dead) are reclaimed
+    here, as are stale download staging dirs, so orphans from a
+    SIGKILLed stream bound GC delay instead of wedging it. In-process
+    active pins are exempt from the TTL (a slow-but-live local stream
+    must not be torn)."""
+    import logging
+
+    from geomesa_tpu.conf import sys_prop
+
+    d = store._dir(type_name)
+    pdir = _pins_dir(store, type_name)
+    ttl = float(sys_prop("snapshot.pin.ttl.s"))
+    now = time.time()  # lint: disable=GT003(ages are measured against file mtimes, which are wall-clock)
+    out: "set[str]" = set()
+    try:
+        names = sorted(os.listdir(pdir))
+    except OSError:
+        names = []
+    for f in names:
+        if not f.endswith(".json"):
+            continue
+        sid = f[: -len(".json")]
+        path = os.path.join(pdir, f)
+        if (type_name, sid) not in store._active_pins:
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                continue
+            if age > ttl:
+                from geomesa_tpu import metrics
+
+                try:
+                    os.unlink(path)
+                except OSError:
+                    continue
+                metrics.snapshot_pins_reclaimed.inc()
+                logging.getLogger(__name__).warning(
+                    "dataset %r: reclaimed orphaned snapshot pin %s "
+                    "(untouched %.1fs > snapshot.pin.ttl.s=%.1fs)",
+                    type_name, sid, age, ttl,
+                )
+                continue
+        doc = load_pin(store, type_name, sid)
+        if not doc:
+            continue  # unreadable pin: pins nothing, TTL reclaims it
+        for rec in doc.get("files", ()):
+            try:
+                rel = _safe_rel(str(rec.get("rel", "")))
+            except SnapshotFormatError:
+                continue
+            out.add(os.path.abspath(os.path.join(d, rel)))
+    # stale download stages (a reprovision that died mid-fetch)
+    sdir = os.path.join(d, "_snapstage")
+    try:
+        stages = sorted(os.listdir(sdir))
+    except OSError:
+        stages = []
+    for s in stages:
+        path = os.path.join(sdir, s)
+        try:
+            if now - os.path.getmtime(path) > ttl:
+                shutil.rmtree(path, ignore_errors=True)
+        except OSError:
+            continue
+    return out
+
+
+# -- wire framing ------------------------------------------------------------
+
+
+def _json_record(kind: int, doc: dict) -> bytes:
+    body = json.dumps(doc).encode("utf-8")
+    return _HEADER.pack(
+        _MAGIC, kind, len(body), zlib.crc32(body) & 0xFFFFFFFF
+    ) + body
+
+
+def iter_stream(store, type_name: str, doc: dict, from_file: int = 0):
+    """Yield the snapshot stream's bytes: BEGIN record (the doc), one
+    length-prefixed FILE record per entry in ``doc["files"]`` (skipping
+    the first ``from_file`` on a resume), END record. The pin is
+    touched after every file so a live stream never ages past the TTL;
+    a raise mid-walk (disk error, ``fail.snapshot.stream``) ends the
+    generator without the END record — detectable truncation, exactly
+    the /wal gap-stop discipline."""
+    from geomesa_tpu.conf import sys_prop
+    from geomesa_tpu.failpoints import fail_point
+
+    chunk = max(int(sys_prop("snapshot.chunk.bytes")), 1)
+    d = store._dir(type_name)
+    sid = str(doc.get("snapshot_id", ""))
+    yield _json_record(KIND_BEGIN, doc)
+    sent_files = sent_bytes = 0
+    for i, rec in enumerate(doc.get("files", ())):
+        if i < int(from_file):
+            continue
+        fail_point("fail.snapshot.stream")
+        meta = dict(rec)
+        meta["index"] = i
+        mb = json.dumps(meta).encode("utf-8")
+        nbytes = int(rec["nbytes"])
+        yield _HEADER.pack(
+            _MAGIC, KIND_FILE, _LEN.size + len(mb) + nbytes,
+            zlib.crc32(mb) & 0xFFFFFFFF,
+        ) + _LEN.pack(len(mb)) + mb
+        remaining = nbytes
+        with open(os.path.join(d, _safe_rel(rec["rel"])), "rb") as fh:
+            while remaining:
+                b = fh.read(min(chunk, remaining))
+                if not b:
+                    raise SnapshotError(
+                        f"pinned file {rec['rel']!r} shorter on disk "
+                        f"than its snapshot record ({nbytes} bytes)"
+                    )
+                remaining -= len(b)
+                yield b
+        sent_files += 1
+        sent_bytes += nbytes
+        touch_pin(store, type_name, sid)
+    yield _json_record(
+        KIND_END, {"files": sent_files, "bytes": sent_bytes}
+    )
+
+
+class _Verifier:
+    """Incremental per-file verification against a manifest checksum
+    record (``verify_bytes`` semantics without buffering the file):
+    rolling crc32/crc32c plus the always-checked length; unknown algos
+    degrade to length-only."""
+
+    def __init__(self, checksum: "dict | None"):
+        self._c = checksum or {}
+        self._len = 0
+        self._crc = 0
+        algo = self._c.get("algo")
+        if algo == "crc32c":
+            from geomesa_tpu.store.fs import _crc32c
+
+            self._fn = _crc32c  # None when the module is absent
+        elif algo == "crc32":
+            self._fn = lambda b, v: zlib.crc32(b, v) & 0xFFFFFFFF
+        else:
+            self._fn = None
+
+    def update(self, b: bytes) -> None:
+        self._len += len(b)
+        if self._fn is not None:
+            self._crc = int(self._fn(b, self._crc))
+
+    def error(self) -> "str | None":
+        want_len = self._c.get("length")
+        if want_len is not None and self._len != int(want_len):
+            return f"length {self._len} != manifest {int(want_len)}"
+        if self._fn is None:
+            return None
+        want = int(self._c.get("value", -1))
+        if self._crc != want:
+            return (
+                f"{self._c.get('algo')} {self._crc:#010x} != "
+                f"manifest {want:#010x}"
+            )
+        return None
+
+
+def _read_exact(fp, n: int) -> "bytes | None":
+    """Read exactly n bytes, or None on a clean/short end (the resume
+    signal; framing errors raise instead)."""
+    buf = b""
+    while len(buf) < n:
+        try:
+            b = fp.read(n - len(buf))
+        except Exception:
+            return None  # transport died mid-read: truncation
+        if not b:
+            return None
+        buf += b
+    return buf
+
+
+def read_stream(fp, dest_dir: str) -> "tuple[dict | None, int, bool]":
+    """Consume a snapshot stream from file-like ``fp``, landing each
+    verified file under ``dest_dir`` at its ``rel`` path. Returns
+    ``(doc, files_done, complete)`` — ``complete`` only when the END
+    record arrived, ``files_done`` counting fully-landed-and-verified
+    files (the resume offset for the next attempt). A checksum or
+    framing violation raises; a mere truncation returns what landed."""
+    from geomesa_tpu.conf import sys_prop
+    from geomesa_tpu.store.fs import _fsync_dir
+
+    fsync = bool(sys_prop("store.fsync"))
+    chunk = max(int(sys_prop("snapshot.chunk.bytes")), 1)
+    doc: "dict | None" = None
+    done = 0
+    complete = False
+    while True:
+        head = _read_exact(fp, _HEADER.size)
+        if head is None:
+            break
+        magic, kind, length, crc = _HEADER.unpack(head)
+        if magic != _MAGIC:
+            raise SnapshotFormatError(
+                f"bad snapshot record magic {magic:#010x}"
+            )
+        if kind in (KIND_BEGIN, KIND_END):
+            body = _read_exact(fp, int(length))
+            if body is None:
+                break
+            if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                raise SnapshotFormatError("snapshot record crc mismatch")
+            if kind == KIND_BEGIN:
+                doc = json.loads(body)
+            else:
+                complete = True
+                break
+            continue
+        if kind != KIND_FILE:
+            raise SnapshotFormatError(f"unknown snapshot record kind {kind}")
+        lb = _read_exact(fp, _LEN.size)
+        if lb is None:
+            break
+        (mlen,) = _LEN.unpack(lb)
+        mb = _read_exact(fp, int(mlen))
+        if mb is None:
+            break
+        if zlib.crc32(mb) & 0xFFFFFFFF != crc:
+            raise SnapshotFormatError("snapshot file-record crc mismatch")
+        meta = json.loads(mb)
+        nbytes = int(length) - _LEN.size - int(mlen)
+        if nbytes != int(meta.get("nbytes", -1)):
+            raise SnapshotFormatError(
+                f"file record length disagrees with meta for "
+                f"{meta.get('rel')!r}"
+            )
+        rel = _safe_rel(str(meta.get("rel", "")))
+        path = os.path.join(dest_dir, rel)
+        os.makedirs(os.path.dirname(path) or dest_dir, exist_ok=True)
+        verifier = _Verifier(meta.get("checksum"))
+        got = 0
+        truncated = False
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+        try:
+            while got < nbytes:
+                b = _read_exact(fp, min(chunk, nbytes - got))
+                if b is None:
+                    truncated = True
+                    break
+                verifier.update(b)
+                view = memoryview(b)
+                while view:
+                    view = view[os.write(fd, view):]
+                got += len(b)
+            if not truncated and fsync:
+                os.fsync(fd)
+        finally:
+            os.close(fd)
+        if truncated:
+            # partial file: unlink so a resume re-lands it whole
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            break
+        err = verifier.error()
+        if err:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            raise SnapshotError(
+                f"snapshot file {rel!r} failed verification: {err}"
+            )
+        done += 1
+        # refresh the stage lease so the TTL sweep never reclaims a
+        # stage a live download is still filling
+        try:
+            os.utime(dest_dir)
+        except OSError:
+            pass
+    if complete and fsync:
+        _fsync_dir(dest_dir)
+    return doc, done, complete
+
+
+# -- install -----------------------------------------------------------------
+
+
+def install_files(type_dir: str, doc: dict, src_dir: str) -> int:
+    """Swap a fully-landed snapshot into ``type_dir`` with the store's
+    own publish order: data files first (atomic renames — ``src_dir``
+    lives on the same filesystem), directories fsynced, the manifest
+    (+ its ``.gen`` sidecar) published LAST. A crash at any instant
+    leaves the previous manifest published with its files intact (the
+    new generation's files are just unpinned orphans the sweep
+    reclaims). Returns data bytes installed. Caller holds the store's
+    exclusive lock when a live store is attached to ``type_dir``."""
+    from geomesa_tpu.conf import sys_prop
+    from geomesa_tpu.store.fs import FileSystemDataStore, _fsync_dir
+
+    fsync = bool(sys_prop("store.fsync"))
+    moved = 0
+    dirs = {type_dir}
+    for rec in doc.get("files", ()):
+        rel = _safe_rel(str(rec.get("rel", "")))
+        if rel == "schema.json":
+            continue
+        src = os.path.join(src_dir, rel)
+        dst = os.path.join(type_dir, rel)
+        if not os.path.exists(src):
+            raise SnapshotError(
+                f"snapshot install missing staged file {rel!r}"
+            )
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(src, dst)  # lint: disable=GT007(read_stream fsynced each staged file as it landed; the target dirs fsync below before the manifest publishes)
+        dirs.add(os.path.dirname(dst))
+        moved += int(rec.get("nbytes", 0))
+    if fsync:
+        for d in sorted(dirs):
+            _fsync_dir(d)
+    src_manifest = os.path.join(src_dir, "schema.json")
+    if not os.path.exists(src_manifest):
+        raise SnapshotError("snapshot install missing staged manifest")
+    with open(src_manifest) as fh:
+        body = fh.read()
+    FileSystemDataStore._publish_manifest(
+        os.path.join(type_dir, "schema.json"), body,
+        str(doc.get("generation") or json.loads(body).get("generation")),
+    )
+    return moved

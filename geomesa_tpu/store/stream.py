@@ -416,6 +416,78 @@ class StreamingStore:
             }
         return out
 
+    def install_snapshot(self, type_name: str, doc: dict, src_dir: str) -> dict:
+        """Swap a fully-downloaded, checksum-verified snapshot into the
+        live tree (the reprovision/bootstrap install): data files land
+        next to the current generation, the snapshot manifest publishes
+        over it atomically, and the live layer resets to the snapshot's
+        history — memtable dropped, local WAL wiped — so tailing
+        resumes from ``doc["wal_watermark"] + 1`` (``apply_replicated``
+        explicitly legalizes that jump). Everything happens under the
+        store's exclusive lock: a compactor racing the install blocks,
+        then re-reads the installed manifest and finds no runs to
+        merge. A crash or ``fail.snapshot.install`` before the manifest
+        publish leaves the previous generation intact (the staged files
+        are unpinned orphans the sweep reclaims)."""
+        import shutil
+
+        from geomesa_tpu import metrics
+        from geomesa_tpu.failpoints import fail_point
+        from geomesa_tpu.store import snapshot as snapshot_mod
+        from geomesa_tpu.store.wal import WriteAheadLog
+
+        store = self.store
+        with store._exclusive():
+            fail_point("fail.snapshot.install")
+            d = store._dir(type_name)
+            os.makedirs(d, exist_ok=True)
+            moved = snapshot_mod.install_files(d, doc, src_dir)
+            # adopt the installed manifest in-memory (a brand-new type
+            # loads from scratch — the add-node bootstrap path); the
+            # refresh's own recovery sweep reclaims the superseded
+            # generation, minus anything snapshot-pinned
+            store._refresh_from_disk(type_name)
+            wal_dir = self._wal_dir(type_name)
+            with self._streams_lock:
+                ts = self._streams.get(type_name)
+            if ts is not None:
+                with ts.lock:
+                    # the memtable and local WAL describe a history
+                    # this replica just abandoned (diverged tail,
+                    # compacted-past gap): the snapshot's rows are all
+                    # in partition files at or below its watermark
+                    ts.runs.clear()
+                    ts.wal.close()
+                    self._wipe_wal_dir(wal_dir)
+                    ts.wal = WriteAheadLog(wal_dir)
+            else:
+                self._wipe_wal_dir(wal_dir)
+        shutil.rmtree(src_dir, ignore_errors=True)
+        metrics.snapshot_installs.inc()
+        metrics.snapshot_install_bytes.inc(moved)
+        metrics.stream_memtable_rows.set(0, type=type_name)
+        metrics.stream_memtable_runs.set(0, type=type_name)
+        return {
+            "type": type_name,
+            "generation": doc.get("generation"),
+            "watermark": int(doc.get("wal_watermark", -1)),
+            "bytes": int(moved),
+        }
+
+    @staticmethod
+    def _wipe_wal_dir(wal_dir: str) -> None:
+        """Remove every WAL segment (snapshot install: the local log's
+        history is abandoned wholesale; an empty log accepts the
+        leader's next seq via ``append_at``)."""
+        if not os.path.isdir(wal_dir):
+            return
+        for f in os.listdir(wal_dir):
+            if f.startswith("wal-"):
+                try:
+                    os.unlink(os.path.join(wal_dir, f))
+                except OSError:
+                    pass
+
     @staticmethod
     def _encode(batch: FeatureBatch) -> bytes:
         import pyarrow as pa

@@ -485,6 +485,156 @@ def cmd_fsck(args):
         )
 
 
+def cmd_backup(args):
+    """Point-in-time backup on the snapshot machinery (ISSUE 15): each
+    type is captured as a consistent pinned snapshot (manifest + that
+    generation's partition files + WAL watermark, frozen under the
+    publish lock), every file checksum-verified against its manifest
+    entry as it is copied out, the manifest published LAST into the
+    backup tree (write-new-then-publish, even for a backup), and —
+    unless ``--no-wal`` / ``backup.wal.trailing=0`` — the trailing WAL
+    segments ride along so acked-but-uncompacted rows restore too. The
+    output directory is store-shaped: ``restore`` (or plain
+    ``FileSystemDataStore(out)``) opens it directly."""
+    import shutil
+
+    from geomesa_tpu.conf import sys_prop
+    from geomesa_tpu.store import snapshot
+    from geomesa_tpu.store.fs import FileSystemDataStore, verify_bytes
+
+    store = _store(args)
+    names = (
+        [args.feature_name] if args.feature_name else store.type_names
+    )
+    if not names:
+        sys.exit("error: store holds no schemas to back up")
+    want_wal = (
+        not args.no_wal and bool(int(sys_prop("backup.wal.trailing")))
+    )
+    for name in names:
+        doc = snapshot.capture(store, name)
+        src_d = store._dir(name)
+        dst_d = os.path.join(args.out, name)
+        copied = nbytes = 0
+        try:
+            for rec in doc["files"]:
+                rel = rec["rel"]
+                if rel == "schema.json":
+                    continue  # the manifest publishes last
+                with open(os.path.join(src_d, rel), "rb") as fh:
+                    data = fh.read()
+                err = verify_bytes(data, rec.get("checksum") or {})
+                if err:
+                    sys.exit(
+                        f"error: {name}/{rel} failed checksum "
+                        f"verification during backup: {err}"
+                    )
+                dst = os.path.join(dst_d, rel)
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                with open(dst, "wb") as fh:
+                    fh.write(data)
+                copied += 1
+                nbytes += len(data)
+            with open(os.path.join(src_d, "schema.json")) as fh:
+                body = fh.read()
+            os.makedirs(dst_d, exist_ok=True)
+            FileSystemDataStore._publish_manifest(
+                os.path.join(dst_d, "schema.json"), body,
+                str(doc.get("generation")),
+            )
+            segs = 0
+            if want_wal:
+                wal_src = os.path.join(src_d, "_wal")
+                if os.path.isdir(wal_src):
+                    wal_dst = os.path.join(dst_d, "_wal")
+                    os.makedirs(wal_dst, exist_ok=True)
+                    for f in sorted(os.listdir(wal_src)):
+                        if f.startswith("wal-"):
+                            shutil.copy2(
+                                os.path.join(wal_src, f),
+                                os.path.join(wal_dst, f),
+                            )
+                            segs += 1
+        finally:
+            snapshot.release(store, name, doc["snapshot_id"])
+        print(
+            f"{name}: backed up generation {doc.get('generation')} "
+            f"(watermark {doc.get('wal_watermark')}): {copied} "
+            f"partition file(s), {nbytes} bytes, {segs} trailing WAL "
+            f"segment(s) -> {dst_d}"
+        )
+
+
+def cmd_restore(args):
+    """Restore a ``backup`` tree into a fresh ``--root`` and PROVE it:
+    files are copied manifest-last, the streaming layer is opened over
+    the restored root (replaying any trailing WAL segments past the
+    snapshot watermark — the acked-but-uncompacted rows) and drained
+    with a compacting close, then the full ``fsck`` machinery runs —
+    recovery sweep, per-file checksum verification, chunk-stat
+    cross-check — exiting non-zero on ANY finding. A restore that
+    doesn't verify is a wish, not a backup."""
+    import shutil
+
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.store.stream import StreamingStore
+
+    src_root = args.backup
+    names = sorted(
+        d for d in os.listdir(src_root)
+        if os.path.isfile(os.path.join(src_root, d, "schema.json"))
+    )
+    if not names:
+        sys.exit(f"error: {src_root} holds no backed-up schemas")
+    root = args.root or os.environ.get("GEOMESA_TPU_ROOT")
+    if not root:
+        sys.exit("error: --root (or $GEOMESA_TPU_ROOT) is required")
+    for name in names:
+        if os.path.exists(os.path.join(root, name)):
+            sys.exit(
+                f"error: {os.path.join(root, name)} already exists; "
+                "restore targets a fresh root"
+            )
+    for name in names:
+        src_d = os.path.join(src_root, name)
+        dst_d = os.path.join(root, name)
+        for dirpath, _dirnames, filenames in os.walk(src_d):
+            for f in filenames:
+                rel = os.path.relpath(os.path.join(dirpath, f), src_d)
+                if rel in ("schema.json", "schema.json.gen"):
+                    continue  # published last, below
+                dst = os.path.join(dst_d, rel)
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                shutil.copy2(os.path.join(src_d, rel), dst)
+        with open(os.path.join(src_d, "schema.json")) as fh:
+            body = fh.read()
+        FileSystemDataStore._publish_manifest(
+            os.path.join(dst_d, "schema.json"), body,
+            str(json.loads(body).get("generation")),
+        )
+    # open the live layer over the restored root: WAL replay recovers
+    # every acked row past the watermark; the compacting close folds
+    # them into partition files so fsck verifies the WHOLE restore
+    store = FileSystemDataStore(root)
+    layer = StreamingStore(store)
+    replayed = {
+        t: int(pos["next_seq"]) - 1 - int(pos["watermark"])
+        for t, pos in layer.replica_positions().items()
+    }
+    layer.close(compact=True)
+    for name in names:
+        extra = max(replayed.get(name, 0), 0)
+        print(
+            f"{name}: restored"
+            + (f"; {extra} trailing WAL record(s) replayed"
+               if extra else "")
+        )
+    args.feature_name = None
+    args.no_verify = False
+    cmd_fsck(args)
+    counts = {t: store.count(t) for t in store.type_names}
+    print(f"restore verified: row counts {json.dumps(counts)}")
+
 
 def _stat_json(stat) -> dict:
     """to_json, with bulky payloads (HLL registers) swapped for estimates."""
@@ -1089,8 +1239,11 @@ def cmd_fleet(args):
     followers first, leader last; each node drains (POST
     /admin/shutdown), followers catch up to lag 0 before the leader
     is killed, and /count is verified bit-identical across the fleet
-    after every step. ``--spawn`` is the shell template that brings a
-    node back ({url} {host} {port} {role} {leader} placeholders)."""
+    after every step. ``fleet add-node --url`` grows the group by one
+    follower bootstrapped FROM ZERO (empty store) via leader
+    snapshots, verified converged before it reports success.
+    ``--spawn`` is the shell template that brings a node up ({url}
+    {host} {port} {role} {leader} placeholders)."""
     from urllib.parse import urlsplit
 
     from geomesa_tpu.tools import fleet
@@ -1105,9 +1258,10 @@ def cmd_fleet(args):
                 doc[u] = {"error": repr(e)}
         print(json.dumps(doc, indent=2))
         return
-    # action == "restart"
+    # action == "restart" or "add-node"
     if not args.spawn:
-        sys.exit("error: fleet restart needs --spawn 'command template'")
+        sys.exit(f"error: fleet {args.action} needs --spawn "
+                 "'command template'")
 
     def restart(url, role, leader_url):
         import subprocess
@@ -1124,10 +1278,19 @@ def cmd_fleet(args):
         )
 
     try:
-        report = fleet.rolling_restart(
-            backends, restart, timeout_s=args.timeout,
-            log=lambda m: print(m, file=sys.stderr),
-        )
+        if args.action == "add-node":
+            if not args.url:
+                sys.exit("error: fleet add-node needs --url")
+            new_url = _parse_backends(args.url)[0]
+            report = fleet.add_node(
+                backends, new_url, restart, timeout_s=args.timeout,
+                log=lambda m: print(m, file=sys.stderr),
+            )
+        else:
+            report = fleet.rolling_restart(
+                backends, restart, timeout_s=args.timeout,
+                log=lambda m: print(m, file=sys.stderr),
+            )
     except fleet.FleetError as e:
         sys.exit(f"error: {e}")
     print(json.dumps(report, indent=2))
@@ -1427,6 +1590,19 @@ def main(argv=None) -> None:
     sp = add("compact", cmd_compact)
     sp.add_argument("-f", "--feature-name", required=True)
 
+    sp = add("backup", cmd_backup)
+    sp.add_argument("--feature-name", help="one schema (default: all)")
+    sp.add_argument("--out", required=True,
+                    help="backup directory (store-shaped; restore or "
+                    "FileSystemDataStore opens it directly)")
+    sp.add_argument("--no-wal", action="store_true",
+                    help="skip the trailing WAL segments (snapshot "
+                    "watermark only)")
+
+    sp = add("restore", cmd_restore)
+    sp.add_argument("--backup", required=True,
+                    help="backup directory produced by `backup`")
+
     sp = add("fsck", cmd_fsck)
     sp.add_argument("-f", "--feature-name",
                     help="one schema; omit for every schema in the root")
@@ -1601,14 +1777,17 @@ def main(argv=None) -> None:
     sp.add_argument("--port", type=int, default=8079)
 
     sp = add("fleet", cmd_fleet)
-    sp.add_argument("action", choices=["status", "restart"])
+    sp.add_argument("action", choices=["status", "restart", "add-node"])
     sp.add_argument("--backends", required=True,
                     help="comma-separated host:port (or full URL) list "
                     "of the group members")
     sp.add_argument("--spawn",
-                    help="restart: shell template that re-launches a "
-                    "node; {url} {host} {port} {role} {leader} "
-                    "placeholders")
+                    help="restart/add-node: shell template that "
+                    "launches a node; {url} {host} {port} {role} "
+                    "{leader} placeholders")
+    sp.add_argument("--url",
+                    help="add-node: the new follower's base URL (it "
+                    "bootstraps from zero via a leader snapshot)")
     sp.add_argument("--timeout", type=float, default=60.0,
                     help="per-step bound (drain, catch-up, converge)")
 

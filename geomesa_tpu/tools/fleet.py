@@ -38,6 +38,7 @@ import urllib.request
 
 __all__ = [
     "FleetError",
+    "add_node",
     "fleet_counts",
     "probe",
     "rolling_restart",
@@ -211,6 +212,40 @@ def wait_ready(url: str, timeout_s: float = 30.0,
         except Exception:
             time.sleep(poll_s)
     raise FleetError(f"{url} not ready {timeout_s}s after restart")
+
+
+def add_node(
+    backends: "list[str]", new_url: str, start,
+    timeout_s: float = 120.0, log=print,
+) -> dict:
+    """Grow the fleet by one follower, bootstrapped FROM ZERO: the new
+    process starts with an empty store, its replication agent asks the
+    leader what types exist, and each one arrives as a pinned snapshot
+    (ISSUE 15's reprovision machinery — schema + partitions + WAL
+    watermark in one install), after which it tails the leader's WAL
+    like any other follower. ``start(url, role, leader_url)`` launches
+    the process at ``url`` (same convention as ``rolling_restart``'s
+    ``restart`` hook). Returns a report with the converged per-type
+    counts across the GROWN fleet — bit-identical counts on the new
+    node are the proof the bootstrap lost nothing."""
+    t0 = time.monotonic()
+    leader = wait_leader(backends, timeout_s=timeout_s)
+    log(f"fleet: adding {new_url} as a follower of {leader}")
+    start(new_url, "follower", leader)
+    wait_ready(new_url, timeout_s=timeout_s)
+    wait_caught_up(new_url, timeout_s=timeout_s)
+    counts = verify_converged(
+        list(backends) + [new_url], timeout_s=timeout_s
+    )
+    report = {
+        "added": new_url,
+        "leader": leader,
+        "counts": counts,
+        "wall_s": round(time.monotonic() - t0, 3),
+    }
+    log(f"fleet: {new_url} bootstrapped and converged in "
+        f"{report['wall_s']}s; counts {counts}")
+    return report
 
 
 def rolling_restart(

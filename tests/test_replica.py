@@ -586,18 +586,24 @@ def test_kill_matrix_sigkill_mid_tail_under_load(tmp_path, follower_of):
             inflight.difference_update(fids)
             acked.update(fids)
             batches += 1
-        # one more append racing the kill: ack outcome unknown
+        # the leader acks LOCAL durability (default replica.ack) — an
+        # acked batch the tail has not fetched yet legally dies with
+        # the leader. Wait until the 6 safe batches actually shipped,
+        # so the kill window holds only the one racing batch below.
+        _wait(
+            lambda: _get(fbase, "/count/t")["count"] == len(acked),
+            msg="safe batches shipped before the kill",
+        )
+        # one more append racing the kill: ack AND ship outcome unknown
+        # (local ack ≠ replicated), so it stays in-flight either way
         fids = list(range(fid, fid + 4))
         inflight.update(fids)
         killer = threading.Timer(0.01, lambda: os.kill(p.pid, signal.SIGKILL))
         killer.start()
         try:
-            out = _post(lbase, "/append/t", _append_doc(fids))
-            if out.get("acked"):
-                acked.update(fids)
-                inflight.difference_update(fids)
+            _post(lbase, "/append/t", _append_doc(fids))
         except Exception:
-            pass  # killed mid-request: stays in the in-flight set
+            pass  # killed mid-request
         p.join(60)
         assert p.exitcode == -signal.SIGKILL
         time.sleep(1.0)  # let the tail drain whatever shipped

@@ -5,6 +5,7 @@ serialization fields, and the merged live-layer round trip."""
 
 import io
 import json
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -496,7 +497,18 @@ def test_encode_write_span_split_and_ledger_fields(resident_url):
     with urllib.request.urlopen(req, timeout=60) as r:
         r.read()
         assert r.headers.get("X-Request-Id") == rid
-    _, _, body = _get(f"{url}/debug/traces/{rid}")
+    # trace retention is decided AFTER the response's last byte hits
+    # the socket, so a fresh connection can look up the id before the
+    # handler thread files the trace — poll briefly
+    deadline = time.monotonic() + 5.0
+    while True:
+        try:
+            _, _, body = _get(f"{url}/debug/traces/{rid}")
+            break
+        except urllib.error.HTTPError as e:
+            if e.code != 404 or time.monotonic() > deadline:
+                raise
+            time.sleep(0.02)
     doc = json.loads(body)
 
     def names(span, acc):

@@ -1,0 +1,559 @@
+"""Snapshot shipping + self-healing replicas (ISSUE 15).
+
+The contracts under test:
+
+- **Capture + pin**: a snapshot is the published manifest + that
+  generation's partition files + the WAL watermark, captured under the
+  publish lock; its pin keeps those files on disk across compactions
+  that supersede the generation, and release makes them reclaimable.
+- **Wire framing**: the snapshot stream roundtrips byte-exactly, every
+  file checksum-verified as it lands; truncation is detectable (no END
+  record) and resume is per-file.
+- **Orphan reclaim**: a SIGKILLed stream's pin ages out under
+  ``snapshot.pin.ttl.s`` and is reclaimed WITHOUT tearing a live
+  stream's (in-process active) pin; stale download stages sweep too.
+- **Self-healing e2e**: a follower that hits 410-Gone (compacted past)
+  or a diverged tail reprovisions itself from a leader snapshot and
+  converges to bit-identical rows — under concurrent appends.
+- **Bounce epoch**: a follower's 503 append bounce carries the
+  election epoch; the router adopts the newer leader and ignores
+  staler bounces.
+- **Backup/restore**: the CLI backup is a consistent snapshot + the
+  trailing WAL segments; restore replays them and passes fsck.
+"""
+
+import io
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.conf import prop_override
+from geomesa_tpu.store import snapshot
+from geomesa_tpu.store.fs import FileSystemDataStore
+from geomesa_tpu.store.stream import StreamingStore
+from geomesa_tpu.store.wal import WriteAheadLog
+
+SPEC = "val:Int,dtg:Date,*geom:Point:srid=4326"
+N0 = 40
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rows(n, seed, fid0=0):
+    rng = np.random.default_rng(seed)
+    cols = {
+        "val": rng.integers(0, 100, n),
+        "dtg": rng.integers(0, 10**9, n),
+        "geom": np.stack(
+            [rng.uniform(-180, 180, n), rng.uniform(-90, 90, n)], axis=1
+        ),
+    }
+    return cols, np.arange(fid0, fid0 + n)
+
+
+def _seeded_root(tmp_path, name="leader", n0=N0):
+    root = str(tmp_path / name)
+    ds = FileSystemDataStore(root, partition_size=128)
+    ds.create_schema("t", SPEC)
+    cols, fids = _rows(n0, seed=1)
+    ds.write("t", cols, fids=fids)
+    ds.flush("t")
+    del ds
+    return root
+
+
+def _get(base, path, timeout=60):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(base, path, doc, timeout=60):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(doc).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _append_doc(fids, x=10.0):
+    n = len(fids)
+    return {
+        "columns": {
+            "val": list(range(n)),
+            "dtg": [1000 + i for i in range(n)],
+            "geom": [[x, x]] * n,
+        },
+        "fids": list(fids),
+    }
+
+
+def _fids(base):
+    feats = _get(base, "/features/t?cql=INCLUDE&maxFeatures=100000")
+    return {int(f["id"]) for f in feats["features"]}
+
+
+def _wait(pred, timeout_s=30.0, poll_s=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(poll_s)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- capture / pin / framing unit tests ---------------------------------------
+
+
+def test_capture_stream_install_roundtrip(tmp_path):
+    """Capture -> wire -> read_stream -> install lands a bit-identical,
+    openable store directory (the reprovision/backup primitive)."""
+    root = _seeded_root(tmp_path, "src")
+    ds = FileSystemDataStore(root, partition_size=128)
+    doc = snapshot.capture(ds, "t")
+    try:
+        assert doc["type"] == "t" and doc["snapshot_id"]
+        assert doc["files"][-1]["rel"] == "schema.json"  # manifest LAST
+        assert doc["wal_watermark"] >= -1
+        wire = b"".join(snapshot.iter_stream(ds, "t", doc))
+        stage = str(tmp_path / "stage")
+        got_doc, done, complete = snapshot.read_stream(
+            io.BytesIO(wire), stage
+        )
+        assert complete and done == len(doc["files"])
+        assert got_doc["snapshot_id"] == doc["snapshot_id"]
+        dst = str(tmp_path / "dst" / "t")
+        os.makedirs(dst, exist_ok=True)
+        snapshot.install_files(dst, got_doc, stage)
+        ds2 = FileSystemDataStore(str(tmp_path / "dst"), partition_size=128)
+        assert ds2.count("t") == N0
+    finally:
+        snapshot.release(ds, "t", doc["snapshot_id"])
+
+
+def test_truncated_stream_resumes_per_file(tmp_path):
+    """A stream cut mid-file reports (done < total, complete=False) and
+    unlinks the partial file; resuming from ``done`` completes it."""
+    root = _seeded_root(tmp_path, "src")
+    ds = FileSystemDataStore(root, partition_size=128)
+    doc = snapshot.capture(ds, "t")
+    try:
+        wire = b"".join(snapshot.iter_stream(ds, "t", doc))
+        stage = str(tmp_path / "stage")
+        # cut inside the LAST file's bytes: everything before it landed
+        cut = len(wire) - (doc["files"][-1]["nbytes"] // 2 + 20)
+        got_doc, done, complete = snapshot.read_stream(
+            io.BytesIO(wire[:cut]), stage
+        )
+        assert not complete and 0 < done < len(doc["files"])
+        # the partial file must not linger (a resume re-lands it whole)
+        landed = {
+            os.path.relpath(os.path.join(dp, f), stage).replace(os.sep, "/")
+            for dp, _, fs in os.walk(stage) for f in fs
+        }
+        assert landed == {r["rel"] for r in doc["files"][:done]}
+        wire2 = b"".join(
+            snapshot.iter_stream(ds, "t", doc, from_file=done)
+        )
+        _, done2, complete2 = snapshot.read_stream(io.BytesIO(wire2), stage)
+        assert complete2 and done + done2 == len(doc["files"])
+    finally:
+        snapshot.release(ds, "t", doc["snapshot_id"])
+
+
+def test_corrupted_stream_raises_not_misinstalls(tmp_path):
+    root = _seeded_root(tmp_path, "src")
+    ds = FileSystemDataStore(root, partition_size=128)
+    doc = snapshot.capture(ds, "t")
+    try:
+        wire = bytearray(b"".join(snapshot.iter_stream(ds, "t", doc)))
+        # flip a bit deep in the first file's content: the per-file
+        # manifest checksum must catch it before anything installs
+        wire[len(wire) // 2] ^= 0xFF
+        with pytest.raises(snapshot.SnapshotError):
+            snapshot.read_stream(
+                io.BytesIO(bytes(wire)), str(tmp_path / "stage")
+            )
+    finally:
+        snapshot.release(ds, "t", doc["snapshot_id"])
+
+
+def test_pin_blocks_gc_across_compaction_release_sweeps(tmp_path):
+    """The satellite GC contract: a pinned generation's files survive
+    the compaction that supersedes them; release + recover reclaims."""
+    root = _seeded_root(tmp_path, "s")
+    ds = FileSystemDataStore(root, partition_size=128)
+    layer = StreamingStore(ds)
+    doc = snapshot.capture(ds, "t")
+    pinned = [
+        os.path.join(ds._dir("t"), r["rel"]) for r in doc["files"]
+        if r["rel"] != "schema.json"
+    ]
+    assert pinned and all(os.path.exists(p) for p in pinned)
+    # rewrite every partition (same rows appended again -> same
+    # partitions republished at a new generation) and compact: the old
+    # generation is superseded but the pin must keep its files
+    cols, fids = _rows(N0, seed=1, fid0=10_000)
+    layer.append("t", cols, fids=fids)
+    layer.compact_now("t")
+    ds.recover("t")  # an explicit sweep, pin still held
+    assert all(os.path.exists(p) for p in pinned), \
+        "GC reclaimed files under a live pin"
+    snapshot.release(ds, "t", doc["snapshot_id"])
+    ds.recover("t")
+    assert any(not os.path.exists(p) for p in pinned), \
+        "release did not make the superseded generation reclaimable"
+    assert layer.count("t") == 2 * N0  # the sweep touched only orphans
+    layer.close()
+
+
+_KILLED_STREAMER = """\
+import sys
+from geomesa_tpu.store import snapshot
+from geomesa_tpu.store.fs import FileSystemDataStore
+
+store = FileSystemDataStore(sys.argv[1], partition_size=128)
+doc = snapshot.capture(store, "t")
+print(doc["snapshot_id"], flush=True)
+for _ in snapshot.iter_stream(store, "t", doc):
+    pass  # fail.snapshot.stream=kill SIGKILLs before the first file
+print("UNREACHABLE", flush=True)
+"""
+
+
+def test_orphaned_pin_reclaimed_after_sigkill_mid_stream(tmp_path):
+    """Regression (satellite): SIGKILL a process mid-snapshot-stream;
+    its orphaned pin is reclaimed once untouched past
+    ``snapshot.pin.ttl.s`` — without tearing a live (in-process
+    active) stream's pin — and stale download stages sweep with it."""
+    root = _seeded_root(tmp_path, "s")
+    env = dict(os.environ)
+    env["GEOMESA_TPU_FAILPOINTS"] = "fail.snapshot.stream=kill"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILLED_STREAMER, root],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == -9, (proc.returncode, proc.stderr)
+    orphan_sid = proc.stdout.split()[0]
+    assert "UNREACHABLE" not in proc.stdout
+
+    ds = FileSystemDataStore(root, partition_size=128)
+    pdir = os.path.join(ds._dir("t"), "_pins")
+    orphan_pin = os.path.join(pdir, orphan_sid + ".json")
+    assert os.path.exists(orphan_pin)  # the crash left its pin behind
+    # a live local stream: its pin is in-process ACTIVE, so even an
+    # ancient mtime must not get it reclaimed
+    live = snapshot.capture(ds, "t")
+    live_pin = os.path.join(pdir, live["snapshot_id"] + ".json")
+    old = time.time() - 3600
+    for p in (orphan_pin, live_pin):
+        os.utime(p, (old, old))
+    # a download stage a dead reprovision left behind
+    stale_stage = snapshot.stage_path(ds, "t", "deadbeef")
+    os.makedirs(stale_stage, exist_ok=True)
+    os.utime(stale_stage, (old, old))
+    with prop_override("snapshot.pin.ttl.s", 0.5):
+        keep = snapshot.pinned_paths(ds, "t")
+    assert not os.path.exists(orphan_pin), "orphaned pin not reclaimed"
+    assert os.path.exists(live_pin), "TTL tore a live stream's pin"
+    assert not os.path.exists(stale_stage), "stale stage not swept"
+    # the keep-set is exactly the live pin's files, all still on disk
+    want = {
+        os.path.abspath(os.path.join(ds._dir("t"), r["rel"]))
+        for r in live["files"]
+    }
+    assert keep == want and all(os.path.exists(p) for p in want)
+    snapshot.release(ds, "t", live["snapshot_id"])
+
+
+def test_recovery_walk_skips_underscore_dirs(tmp_path):
+    """``part-``-named junk under ``_snapstage``/``_wal`` must never be
+    swept (or counted) by the GC walk — those dirs are pruned."""
+    root = _seeded_root(tmp_path, "s")
+    ds = FileSystemDataStore(root, partition_size=128)
+    d = ds._dir("t")
+    staged = os.path.join(d, "_snapstage", "x", "part-999-00000.npz")
+    os.makedirs(os.path.dirname(staged), exist_ok=True)
+    with open(staged, "wb") as fh:
+        fh.write(b"staged-not-yours")
+    ds.recover("t")
+    assert os.path.exists(staged)
+    assert ds.count("t") == N0
+
+
+# -- self-healing e2e ---------------------------------------------------------
+
+
+@pytest.fixture
+def pair(tmp_path):
+    """Leader + follower on copied roots with fast replication AND
+    reprovision knobs; yields (lbase, fbase, lsrv, fsrv_box) where
+    ``fsrv_box`` is a one-item list so tests can restart the follower
+    and teardown still reaps the current instance."""
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+
+    lroot = _seeded_root(tmp_path, "leader")
+    froot = str(tmp_path / "follower")
+    shutil.copytree(lroot, froot)
+    with prop_override("replica.lease.s", 1.5), \
+            prop_override("replica.poll.ms", 25.0), \
+            prop_override("replica.failover.s", 30.0), \
+            prop_override("replica.retain.s", 0.6), \
+            prop_override("replica.reprovision.s", 30.0):
+        lsrv, _ = serve_background(
+            FileSystemDataStore(lroot, partition_size=128),
+            stream=True, replica=ReplicaConfig(role="leader"),
+        )
+        lbase = "http://%s:%s" % lsrv.server_address[:2]
+        fsrv, _ = serve_background(
+            FileSystemDataStore(froot, partition_size=128),
+            stream=True,
+            replica=ReplicaConfig(role="follower", leader_url=lbase),
+        )
+        fsrv_box = [fsrv]
+        yield lbase, froot, lsrv, fsrv_box
+        for s in (lsrv, fsrv_box[0]):
+            try:
+                s.shutdown()
+                s.server_close()
+            except Exception:
+                pass
+
+
+def _fbase(fsrv):
+    return "http://%s:%s" % fsrv.server_address[:2]
+
+
+def _restart_follower(froot, lbase):
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+
+    srv, _ = serve_background(
+        FileSystemDataStore(froot, partition_size=128),
+        stream=True,
+        replica=ReplicaConfig(role="follower", leader_url=lbase),
+    )
+    return srv
+
+
+def _wait_reprovisioned(fbase, lbase, timeout_s=60.0):
+    def healed():
+        st = _get(fbase, "/stats/replica")
+        return (
+            st["reprovision"]["completed"] >= 1
+            and not st["reprovision"]["pending"]
+            and st["reprovision"]["active"] is None
+            and _get(fbase, "/count/t")["count"]
+            == _get(lbase, "/count/t")["count"]
+        )
+
+    _wait(healed, timeout_s=timeout_s, msg="auto-reprovision")
+
+
+def test_410_gone_auto_reprovision_under_concurrent_appends(pair):
+    """E2e (satellite): compact the leader past a dead follower's
+    position; on restart the follower's 410 turns into an automatic
+    snapshot reprovision that converges bit-identically while appends
+    keep landing."""
+    lbase, froot, lsrv, fsrv_box = pair
+    _wait(lambda: _get(_fbase(fsrv_box[0]), "/count/t")["count"] == N0,
+          msg="initial catch-up")
+    fsrv_box[0].shutdown()
+    fsrv_box[0].server_close()
+    with prop_override("wal.segment.bytes", 1):  # clamps to 4 KiB
+        for i in range(24):
+            _post(lbase, "/append/t",
+                  _append_doc(list(range(9000 + i * 8, 9008 + i * 8))))
+    time.sleep(0.8)  # age the dead follower past replica.retain.s
+    stream = lsrv.stream_layer
+    stream.compact_now("t")
+    assert stream._ts("t").wal.first_seq() > 0  # history really gone
+
+    stop = threading.Event()
+    errors = []
+
+    def appender():
+        i = 0
+        while not stop.is_set():
+            try:
+                _post(lbase, "/append/t", _append_doc([20_000 + i]))
+            except Exception as e:  # leader must never shed here
+                errors.append(e)
+                return
+            i += 1
+            time.sleep(0.02)
+
+    th = threading.Thread(target=appender, daemon=True)
+    th.start()
+    try:
+        fsrv_box[0] = _restart_follower(froot, lbase)
+        fbase = _fbase(fsrv_box[0])
+        _wait_reprovisioned(fbase, lbase)
+    finally:
+        stop.set()
+        th.join(timeout=10)
+    assert not errors
+    fbase = _fbase(fsrv_box[0])
+    _wait(lambda: _fids(fbase) == _fids(lbase), msg="bit-identical rows")
+    st = _get(fbase, "/stats/replica")
+    assert st["reprovision"]["completed"] >= 1
+    assert not st["reprovision"]["last"]["error"]
+    assert st["lag_records"] == 0
+
+
+def test_diverged_tail_auto_reprovision(pair):
+    """E2e (satellite): a follower whose WAL runs AHEAD of the leader
+    (forked tail) must rebuild from a snapshot, not serve phantoms."""
+    lbase, froot, lsrv, fsrv_box = pair
+    _wait(lambda: _get(_fbase(fsrv_box[0]), "/count/t")["count"] == N0,
+          msg="initial catch-up")
+    _post(lbase, "/append/t", _append_doc([9001, 9002, 9003]))
+    _wait(lambda: _get(_fbase(fsrv_box[0]), "/count/t")["count"] == N0 + 3,
+          msg="pre-divergence catch-up")
+    fsrv_box[0].shutdown()
+    fsrv_box[0].server_close()
+    # forge a diverged tail: replay the follower's own last record at
+    # 50 consecutive seqs its leader never assigned
+    wal = WriteAheadLog(os.path.join(froot, "t", "_wal"))
+    payloads = [p for _, p in wal.read_from(-1)]
+    assert payloads
+    for _ in range(50):
+        wal.append_at(wal.next_seq, payloads[-1])
+    wal.close()
+    fsrv_box[0] = _restart_follower(froot, lbase)
+    fbase = _fbase(fsrv_box[0])
+    _wait_reprovisioned(fbase, lbase)
+    _wait(lambda: _fids(fbase) == _fids(lbase), msg="fork healed")
+    # phantom rows from the forked tail must be gone, not merged
+    assert _get(fbase, "/count/t")["count"] == N0 + 3
+
+
+def test_bootstrap_from_zero_via_fleet_add_node(pair):
+    """``fleet add-node``: a follower with an EMPTY store joins, pulls
+    every type as a snapshot, and serves bit-identical counts."""
+    import socket
+
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+    from geomesa_tpu.tools import fleet
+
+    lbase, froot, lsrv, fsrv_box = pair
+    newroot = os.path.join(os.path.dirname(froot), "fresh")
+    os.makedirs(newroot)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    new_url = f"http://127.0.0.1:{port}"
+    started = []
+
+    def start(url, role, leader_url):
+        assert role == "follower" and leader_url == lbase
+        srv, _ = serve_background(
+            FileSystemDataStore(newroot, partition_size=128),
+            port=port, stream=True,
+            replica=ReplicaConfig(role="follower", leader_url=leader_url),
+        )
+        started.append(srv)
+
+    try:
+        report = fleet.add_node(
+            [lbase], new_url, start, timeout_s=90.0, log=lambda *_: None,
+        )
+        assert report["added"] == new_url
+        assert report["counts"]["t"] == _get(lbase, "/count/t")["count"]
+        st = _get(new_url, "/stats/replica")
+        assert st["reprovision"]["completed"] >= 1
+        assert _fids(new_url) == _fids(lbase)
+    finally:
+        for srv in started:
+            try:
+                srv.shutdown()
+                srv.server_close()
+            except Exception:
+                pass
+
+
+def test_append_bounce_carries_epoch_and_router_adopts_it(pair):
+    """Satellites: the follower's 503 bounce body names the leader AND
+    the election epoch; the router consumes it (one-hop re-discovery)
+    and ignores staler bounces."""
+    from geomesa_tpu.router import Router
+
+    lbase, froot, lsrv, fsrv_box = pair
+    fbase = _fbase(fsrv_box[0])
+    _wait(lambda: _get(fbase, "/count/t")["count"] == N0,
+          msg="initial catch-up")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(fbase, "/append/t", _append_doc([1]))
+    assert ei.value.code == 503
+    doc = json.loads(ei.value.read())
+    assert doc["leader"] == lbase
+    assert isinstance(doc["epoch"], int) and doc["epoch"] >= 0
+
+    rt = Router([fbase, lbase])  # never started: pure state checks
+    fb, lb = rt.backends
+    rt.note_bounce(fb, {"leader": doc["leader"], "epoch": doc["epoch"] + 1})
+    assert fb.role == "follower" and lb.role == "leader"
+    # a revenant ex-leader's staler bounce must not un-learn that
+    rt.note_bounce(lb, {"leader": fbase, "epoch": doc["epoch"]})
+    assert lb.role == "leader" and fb.role == "follower"
+
+
+# -- backup / restore ---------------------------------------------------------
+
+
+def test_backup_restore_fsck_roundtrip(tmp_path):
+    """CLI backup -> restore: compacted rows ride the snapshot, acked-
+    but-uncompacted rows ride the trailing WAL segments; restore
+    replays them, passes fsck, and serves identical counts."""
+    from geomesa_tpu.tools.cli import main as cli_main
+
+    root = _seeded_root(tmp_path, "live")
+    ds = FileSystemDataStore(root, partition_size=128)
+    layer = StreamingStore(ds)
+    cols, fids = _rows(10, seed=3, fid0=50_000)
+    layer.append("t", cols, fids=fids)
+    layer.close()  # compact=False: the 10 rows exist ONLY in the WAL
+    del layer, ds
+
+    out = str(tmp_path / "bk")
+    cli_main(["--root", root, "backup", "--out", out])
+    assert os.path.exists(os.path.join(out, "t", "schema.json"))
+    assert any(
+        f.startswith("wal-") for f in os.listdir(os.path.join(out, "t", "_wal"))
+    )
+    newroot = str(tmp_path / "restored")
+    cli_main(["--root", newroot, "restore", "--backup", out])
+    ds2 = FileSystemDataStore(newroot, partition_size=128)
+    assert ds2.count("t") == N0 + 10
+    # a second restore into the same root must refuse, not clobber
+    with pytest.raises(SystemExit):
+        cli_main(["--root", newroot, "restore", "--backup", out])
+
+
+def test_backup_no_wal_skips_trailing_segments(tmp_path):
+    from geomesa_tpu.tools.cli import main as cli_main
+
+    root = _seeded_root(tmp_path, "live")
+    layer = StreamingStore(FileSystemDataStore(root, partition_size=128))
+    cols, fids = _rows(5, seed=4, fid0=60_000)
+    layer.append("t", cols, fids=fids)
+    layer.close()
+    out = str(tmp_path / "bk")
+    cli_main(["--root", root, "backup", "--out", out, "--no-wal"])
+    assert not os.path.isdir(os.path.join(out, "t", "_wal"))
+    newroot = str(tmp_path / "restored")
+    cli_main(["--root", newroot, "restore", "--backup", out])
+    # snapshot-only restore: the compacted N0, not the WAL-only 5
+    ds2 = FileSystemDataStore(newroot, partition_size=128)
+    assert ds2.count("t") == N0

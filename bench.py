@@ -3537,6 +3537,7 @@ set_prop("replica.ack", "replica")
 set_prop("wal.segment.bytes", 4096)
 set_prop("stream.memtable.rows", 256)
 set_prop("snapshot.pin.ttl.s", 60.0)
+set_prop("sub.heartbeat.s", 0.5)
 deadline = time.monotonic() + 15
 while True:
     try:
@@ -3745,6 +3746,7 @@ def bench_soak(args) -> dict:
                     time.sleep(0.01)
 
             acked: set = set()
+            acked_seqs: set = set()
             inflight: set = set()
             sheds = [0]
             append_errors: list = []
@@ -3759,6 +3761,8 @@ def bench_soak(args) -> dict:
                     if out.get("acked") and out.get("replicated", True):
                         acked.update(fids)
                         inflight.difference_update(fids)
+                        if out.get("seq") is not None:
+                            acked_seqs.add(int(out["seq"]))
                 except urllib.error.HTTPError as e:
                     try:
                         body = e.read().decode("utf-8", "replace")
@@ -3810,6 +3814,64 @@ def bench_soak(args) -> dict:
                     else:
                         followers.append(u)
                 return lead, followers
+
+            # the pubsub leg: ONE standing subscriber rides the whole
+            # fault schedule, reconnecting from its acked cursor after
+            # every kill — at the end every quorum-acked append seq
+            # must have been delivered exactly once (zero missed, zero
+            # duplicate across however many promotions happened)
+            sub_delivered: set = set()
+            sub_dup = [0]
+            sub_cursor = [-1]
+            sub_stop = threading.Event()
+            sub_state: dict = {"id": None}
+
+            def subscriber():
+                while not sub_stop.is_set():
+                    try:
+                        lead, _f = current_roles()
+                        if lead is None:
+                            time.sleep(0.2)
+                            continue
+                        if sub_state["id"] is None:
+                            req = urllib.request.Request(
+                                lead + "/subscribe/gdelt?tenant=soaksub",
+                                data=json.dumps(
+                                    {"bbox": [-180.0, -90.0, 180.0, 90.0]}
+                                ).encode(),
+                                method="POST",
+                                headers={"Content-Type": "application/json"},
+                            )
+                            with urllib.request.urlopen(req, timeout=10) as r:
+                                sub_state["id"] = json.loads(r.read())["id"]
+                        u = (lead + "/subscribe/gdelt?id=" + sub_state["id"]
+                             + "&from=" + str(sub_cursor[0]))
+                        with urllib.request.urlopen(u, timeout=10) as resp:
+                            buf = b""
+                            while not sub_stop.is_set():
+                                chunk = resp.read1(65536)
+                                if not chunk:
+                                    break
+                                buf += chunk
+                                while b"\n\n" in buf:
+                                    frame, buf = buf.split(b"\n\n", 1)
+                                    if b"event: match" not in frame:
+                                        continue
+                                    for ln in frame.split(b"\n"):
+                                        if ln.startswith(b"id: "):
+                                            sq = int(ln[4:])
+                                            if sq <= sub_cursor[0]:
+                                                sub_dup[0] += 1
+                                            else:
+                                                sub_cursor[0] = sq
+                                            sub_delivered.add(sq)
+                    except Exception:
+                        time.sleep(0.2)
+
+            sub_thread = threading.Thread(target=subscriber, daemon=True)
+            sub_thread.start()
+            _wait(lambda: sub_state["id"] is not None, 30,
+                  "the standing subscription to register")
 
             def wal_dir(url):
                 return os.path.join(roots[url], "gdelt", "_wal")
@@ -3888,6 +3950,22 @@ def bench_soak(args) -> dict:
             stop.set()
             for t in threads:
                 t.join(10)
+            # the push tier must drain: every quorum-acked seq reaches
+            # the standing subscriber (the commit gate holds alerts for
+            # unreplicated tails, so acked == eventually-delivered)
+            _wait(lambda: acked_seqs <= sub_delivered, 60,
+                  "the standing subscriber to drain every acked seq")
+            sub_stop.set()
+            sub_thread.join(15)
+            missed_alerts = sorted(acked_seqs - sub_delivered)
+            assert missed_alerts == [], (
+                f"pubsub: {len(missed_alerts)} acked seqs never reached "
+                f"the standing subscriber (first: {missed_alerts[:5]})"
+            )
+            assert sub_dup[0] == 0, (
+                f"pubsub: {sub_dup[0]} duplicate deliveries at or below "
+                "the subscriber's acked cursor"
+            )
             assert read_failures == [], (
                 f"{len(read_failures)} failed reads during the soak "
                 f"(first: {read_failures[0]})"
@@ -3923,7 +4001,8 @@ def bench_soak(args) -> dict:
             log(f"soak: ok — {len(schedule)} rounds, {reprovisions} "
                 f"snapshot reprovisions, {reads[0]} reads 0 failed, "
                 f"{len(acked)} acked rows all served, {sheds[0]} "
-                f"bounded sheds, {counts['gdelt']} converged rows")
+                f"bounded sheds, {counts['gdelt']} converged rows, "
+                f"{len(acked_seqs)} acked seqs all pushed exactly once")
             rsrv.shutdown()
             rsrv.server_close()
         return {
@@ -3934,6 +4013,9 @@ def bench_soak(args) -> dict:
             "soak_rows_served": len(got),
             "soak_reads": reads[0],
             "soak_sheds": sheds[0],
+            "soak_pubsub_acked_seqs": len(acked_seqs),
+            "soak_pubsub_delivered": len(sub_delivered),
+            "soak_pubsub_dups": sub_dup[0],
             "soak_ok": True,
         }
     finally:
@@ -3944,6 +4026,277 @@ def bench_soak(args) -> dict:
             except Exception:
                 pass
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+_PUBSUB_NODE_BODY = r"""
+import os, sys, time
+from geomesa_tpu.conf import set_prop
+from geomesa_tpu.server import serve_background
+from geomesa_tpu.store.fs import FileSystemDataStore
+
+root, portfile, port = sys.argv[1:4]
+set_prop("stream.memtable.rows", 1 << 20)
+set_prop("sub.heartbeat.s", 0.5)
+deadline = time.monotonic() + 15
+while True:
+    try:
+        server, thread = serve_background(
+            FileSystemDataStore(root, partition_size=1 << 12),
+            port=int(port), stream=True,
+        )
+        break
+    except OSError:
+        if time.monotonic() > deadline:
+            raise
+        time.sleep(0.2)
+with open(portfile + ".tmp", "w") as fh:
+    fh.write(str(server.server_address[1]))
+    fh.flush(); os.fsync(fh.fileno())
+os.replace(portfile + ".tmp", portfile)
+thread.join()
+server.server_close()
+os._exit(0)
+"""
+
+
+def bench_pubsub(args) -> dict:
+    """``--mode pubsub``: the continuous-query push tier (ISSUE 16).
+
+    Two legs:
+
+    - **matrix** — subscriptions x append-batches in-process: every
+      acked batch must cost exactly ONE fused join launch no matter how
+      many subscriptions are armed (asserted per cell), and the
+      end-to-end matched-append latency p50/p99 is recorded per cell.
+    - **crash** — a single-node server takes appends under a live SSE
+      subscriber, is SIGKILLed mid-stream, and restarts on the same
+      root; the subscriber reconnects from its acked cursor and must
+      see every acked seq EXACTLY once — zero missed, zero duplicate.
+
+    ``--smoke`` shrinks both legs to CI size."""
+    import os
+    import shutil
+    import signal  # noqa: F401 (SIGKILL spelled via Popen.kill below)
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from geomesa_tpu.pubsub import PubSubHub
+    from geomesa_tpu.store.fs import FileSystemDataStore
+    from geomesa_tpu.store.stream import StreamingStore
+
+    smoke = bool(args.smoke)
+    spec = "val:Int,dtg:Date,*geom:Point:srid=4326"
+    rng = np.random.default_rng(20260806)
+
+    # -- leg 1: subscriptions x append-rate matrix, in-process ----------
+    sub_counts = (4, 32) if smoke else (8, 64, 512)
+    batches = 8 if smoke else 32
+    rows = 256 if smoke else 1024
+    matrix = []
+    for n_subs in sub_counts:
+        tmp = tempfile.mkdtemp(prefix="geomesa-bench-pubsub-")
+        hub = None
+        try:
+            ds = FileSystemDataStore(tmp, partition_size=1 << 12)
+            ds.create_schema("gdelt", spec)
+            layer = StreamingStore(ds)
+            hub = PubSubHub(layer)
+            for k in range(n_subs):
+                x = float(rng.uniform(-170.0, 150.0))
+                y = float(rng.uniform(-80.0, 60.0))
+                hub.subscribe(
+                    "gdelt", {"bbox": [x, y, x + 20.0, y + 20.0]},
+                    tenant=f"bench{k % 8}", auths=None,
+                )
+            launches0 = hub.matcher.launches
+            matched0 = hub.matched_records
+            times = []
+            fid = 0
+            for _ in range(batches):
+                cols = {
+                    "val": rng.integers(0, 100, rows),
+                    "dtg": rng.integers(0, 10**9, rows),
+                    "geom": np.stack(
+                        [rng.uniform(-180, 180, rows),
+                         rng.uniform(-90, 90, rows)], axis=1),
+                }
+                t0 = time.perf_counter()
+                layer.append("gdelt", cols, fids=np.arange(fid, fid + rows))
+                times.append(time.perf_counter() - t0)
+                fid += rows
+            launches = hub.matcher.launches - launches0
+            assert launches == batches, (
+                f"matching must be ONE fused launch per acked batch: "
+                f"{n_subs} subs x {batches} batches took {launches} launches"
+            )
+            ts = sorted(times)
+            cell = {
+                "subs": n_subs,
+                "batches": batches,
+                "rows_per_batch": rows,
+                "fused_launches": launches,
+                "matched_records": hub.matched_records - matched0,
+                "append_match_p50_ms": round(ts[len(ts) // 2] * 1e3, 3),
+                "append_match_p99_ms": round(
+                    ts[min(len(ts) - 1, int(len(ts) * 0.99))] * 1e3, 3),
+            }
+            matrix.append(cell)
+            log("pubsub: %4d subs  %d batches -> %d launches, "
+                "p50 %.2fms p99 %.2fms, %d matched rows"
+                % (n_subs, batches, launches, cell["append_match_p50_ms"],
+                   cell["append_match_p99_ms"], cell["matched_records"]))
+        finally:
+            if hub is not None:
+                hub.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    # -- leg 2: SIGKILL + reconnect, exactly-once over the cursor -------
+    n1 = 6 if smoke else 20     # batches before the kill
+    n2 = 6 if smoke else 20     # batches after the restart
+    crash_rows = 8
+    tmp = tempfile.mkdtemp(prefix="geomesa-bench-pubsub-crash-")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs: list = []
+    try:
+        root = os.path.join(tmp, "node")
+        ds = FileSystemDataStore(root, partition_size=1 << 12)
+        ds.create_schema("gdelt", spec)
+        del ds
+
+        def spawn():
+            portfile = os.path.join(tmp, f"port-{time.monotonic_ns()}")
+            p = subprocess.Popen(
+                [sys.executable, "-c", _PUBSUB_NODE_BODY, root, portfile,
+                 "0"], env=env,
+            )
+            deadline = time.monotonic() + 120
+            while not os.path.exists(portfile):
+                assert p.poll() is None, "pubsub node died during startup"
+                assert time.monotonic() < deadline, "pubsub node never bound"
+                time.sleep(0.05)
+            procs.append(p)
+            return p, f"http://127.0.0.1:{int(open(portfile).read())}"
+
+        p, url = spawn()
+        url_box = [url]
+        req = urllib.request.Request(
+            url + "/subscribe/gdelt?tenant=bench",
+            data=json.dumps({"bbox": [-180.0, -90.0, 180.0, 90.0]}).encode(),
+            method="POST", headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            sid = json.loads(r.read())["id"]
+
+        delivered: list = []
+        dups = [0]
+        cursor = [-1]
+        stop_read = threading.Event()
+
+        def read_stream():
+            # reconnect-from-cursor loop: survives the SIGKILL window by
+            # retrying until the restarted node binds (url_box updated)
+            while not stop_read.is_set():
+                try:
+                    u = (url_box[0] + "/subscribe/gdelt?id=" + sid
+                         + "&from=" + str(cursor[0]))
+                    with urllib.request.urlopen(u, timeout=10) as resp:
+                        buf = b""
+                        while not stop_read.is_set():
+                            chunk = resp.read1(65536)
+                            if not chunk:
+                                break
+                            buf += chunk
+                            while b"\n\n" in buf:
+                                frame, buf = buf.split(b"\n\n", 1)
+                                if b"event: match" not in frame:
+                                    continue
+                                for line in frame.split(b"\n"):
+                                    if line.startswith(b"id: "):
+                                        seq = int(line[4:])
+                                        if seq <= cursor[0]:
+                                            dups[0] += 1
+                                        else:
+                                            cursor[0] = seq
+                                        delivered.append(seq)
+                except Exception:
+                    time.sleep(0.1)
+
+        reader = threading.Thread(target=read_stream, daemon=True)
+        reader.start()
+
+        acked: set = set()
+        fid_next = [0]
+
+        def append_one():
+            fids = list(range(fid_next[0], fid_next[0] + crash_rows))
+            fid_next[0] += crash_rows
+            doc = {
+                "columns": {
+                    "val": list(range(crash_rows)),
+                    "dtg": [1000 + i for i in range(crash_rows)],
+                    "geom": [[10.0, 10.0]] * crash_rows,
+                },
+                "fids": fids,
+            }
+            rq = urllib.request.Request(
+                url_box[0] + "/append/gdelt", data=json.dumps(doc).encode(),
+                method="POST", headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(rq, timeout=30) as r:
+                acked.add(int(json.loads(r.read())["seq"]))
+
+        def _wait(pred, timeout_s, msg):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if pred():
+                    return
+                time.sleep(0.05)
+            raise AssertionError(f"pubsub crash leg: timed out on {msg}")
+
+        for _ in range(n1):
+            append_one()
+        # kill MID-delivery: at least half the acked seqs seen, then die
+        _wait(lambda: len(delivered) >= n1 // 2, 30,
+              f"{n1 // 2} of {n1} pre-kill deliveries")
+        p.kill()   # SIGKILL: no shutdown hooks, the WAL is the truth
+        p.wait(30)
+        p, url = spawn()
+        url_box[0] = url
+        for _ in range(n2):
+            append_one()
+        _wait(lambda: acked <= set(delivered), 60,
+              "every acked seq to reach the resumed subscriber")
+        stop_read.set()
+        reader.join(15)
+        missed = sorted(acked - set(delivered))
+        assert missed == [], f"missed acked seqs across the kill: {missed}"
+        assert dups[0] == 0, f"{dups[0]} duplicate deliveries across the kill"
+        assert len(delivered) == len(set(delivered)), "raw duplicate frames"
+        log("pubsub: crash leg ok — %d acked seqs, %d delivered, "
+            "0 missed, 0 duplicates across SIGKILL + cursor resume"
+            % (len(acked), len(delivered)))
+    finally:
+        for pr in procs:
+            try:
+                pr.kill()
+                pr.wait(10)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "pubsub_matrix": matrix,
+        "pubsub_crash_acked": len(acked),
+        "pubsub_crash_delivered": len(delivered),
+        "pubsub_crash_missed": 0,
+        "pubsub_crash_dups": 0,
+        "pubsub_ok": True,
+    }
 
 
 def bench_trace_overhead(args) -> dict:
@@ -4467,7 +4820,7 @@ def main() -> None:
             "all", "filter", "zscan", "build", "polygon", "density", "sweep",
             "xzbuild", "meshbuild", "multichip", "pipeline", "oocscan",
             "join", "serve", "flush", "stream", "results", "replica",
-            "soak",
+            "soak", "pubsub",
         ),
         default="all",
         help="all: every benchmark, one JSON line with everything (what "
@@ -4526,6 +4879,8 @@ def main() -> None:
         out = bench_replica_chaos(args)
     elif args.mode == "soak":
         out = bench_soak(args)
+    elif args.mode == "pubsub":
+        out = bench_pubsub(args)
     else:
         # zscan FIRST: its DeviceIndex staging is a long sequence of
         # host->device transfers that measures 20-30x slower when another

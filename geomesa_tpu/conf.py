@@ -511,6 +511,15 @@ _DEFS = {
     # operator plane: shared secret for POST /admin/shutdown (empty =
     # loopback peers only)
     "admin.token": ("", str),
+    # continuous-query push tier (pubsub/): SSE heartbeat cadence on
+    # idle push streams, the per-connection live event-queue bound
+    # (overflow tears the stream down — the client resumes from its
+    # cursor), how long a disconnected subscriber's cursor keeps
+    # pinning WAL GC, and the per-type registry bound
+    "sub.heartbeat.s": (15.0, float),
+    "sub.queue.events": (1024, int),
+    "sub.retain.s": (600.0, float),
+    "sub.max.per.type": (4096, int),
 }
 
 _overrides: dict = {}

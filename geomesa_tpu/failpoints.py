@@ -84,6 +84,16 @@ matrix, ISSUE 15):
                                    swap into the live tree; a fault
                                    here must leave the previous
                                    generation published and intact
+- ``fail.sub.match``            -- the fused batch×subscriptions match
+                                   is about to run for an acked append;
+                                   a fault here must never un-ack the
+                                   rows (matching is post-ack — the
+                                   cursor replay path re-derives the
+                                   missed alerts)
+- ``fail.sub.deliver``          -- a matched alert event is about to be
+                                   written to a push stream; a fault
+                                   tears down that one connection and
+                                   the client resumes from its cursor
 
 Activation: programmatic (``set_failpoint``/``failpoint_override``) or
 the ``GEOMESA_TPU_FAILPOINTS`` environment variable, a comma-separated
@@ -140,6 +150,8 @@ POINTS = (
     "fail.replica.promote",
     "fail.snapshot.stream",
     "fail.snapshot.install",
+    "fail.sub.match",
+    "fail.sub.deliver",
 )
 
 

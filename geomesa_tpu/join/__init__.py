@@ -13,6 +13,7 @@ from geomesa_tpu.join.engine import (
     JoinEngine,
     JoinIndex,
     JoinResult,
+    build_envelope_layout,
     build_join_index,
 )
 from geomesa_tpu.join.planner import JoinPlan, JoinStats, plan_join
@@ -23,6 +24,7 @@ __all__ = [
     "JoinResult",
     "JoinPlan",
     "JoinStats",
+    "build_envelope_layout",
     "build_join_index",
     "plan_join",
 ]

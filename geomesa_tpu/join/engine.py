@@ -282,6 +282,64 @@ def build_join_index(batch, sft, hist_bits: int, gen: int = 0) -> JoinIndex:
     )
 
 
+def build_envelope_layout(
+    envs, hist_bits: "int | None" = None, precision: int = 12,
+    gen: int = 0,
+) -> JoinIndex:
+    """XZ-encode raw ``(n, 4)`` [xmin, ymin, xmax, ymax] envelopes into
+    a join layout with no FeatureBatch behind them — the continuous-
+    query registry's subscription side: its geofences are encoded ONCE
+    per registry generation here, then every acked append batch joins
+    against the layout as one fused launch (`JoinEngine(jidx=...)`).
+    Envelope-overlap pairs are exact for box predicates; dwithin/
+    attribute residuals refine the emitted pairs."""
+    from geomesa_tpu.curves.normalize import NormalizedLat, NormalizedLon
+    from geomesa_tpu.curves.xz2 import XZ2SFC
+
+    if hist_bits is None:
+        hist_bits = _join_conf()["hist_bits"]
+    bb = np.asarray(envs, np.float64).reshape(-1, 4)
+    n = len(bb)
+    sfc = XZ2SFC(precision)
+    keys = (
+        np.asarray(
+            sfc.index(bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3]), np.uint64
+        )
+        if n
+        else np.empty(0, np.uint64)
+    )
+    planes = {
+        "x0": np.asarray(bb[:, 0], np.float64),
+        "y0": np.asarray(bb[:, 1], np.float64),
+        "x1": np.asarray(bb[:, 2], np.float64),
+        "y1": np.asarray(bb[:, 3], np.float64),
+    }
+    lon, lat = NormalizedLon(jp._BITS), NormalizedLat(jp._BITS)
+    perm = None
+    if n > 1 and not bool(np.all(keys[1:] >= keys[:-1])):
+        perm = jp._argsort_u64(keys)
+        keys = keys[perm]
+        planes = {k: v[perm] for k, v in planes.items()}
+    hist_prefix = None
+    if n:
+        hx = (planes["x0"] + planes["x1"]) * 0.5
+        hy = (planes["y0"] + planes["y1"]) * 0.5
+        s = jp._BITS - hist_bits
+        cx = np.asarray(lon.normalize(hx), np.int64) >> s
+        cy = np.asarray(lat.normalize(hy), np.int64) >> s
+        side = 1 << hist_bits
+        H = np.bincount(
+            (cy << hist_bits) | cx, minlength=side * side
+        ).reshape(side, side)
+        S = np.zeros((side + 1, side + 1), np.int64)
+        S[1:, 1:] = H.cumsum(0).cumsum(1)
+        hist_prefix = S
+    return JoinIndex(
+        "xz2", sfc, keys, perm, planes, lon, lat, hist_prefix, hist_bits,
+        gen=gen,
+    )
+
+
 class JoinEngine:
     """One joinable left side. Construct over a resident index (the
     layout caches on it per staged generation) or a raw FeatureBatch.
@@ -292,9 +350,12 @@ class JoinEngine:
     """
 
     def __init__(self, di=None, batch=None, sft=None, sched=None,
-                 mesh=None):
-        if di is None and batch is None:
-            raise ValueError("JoinEngine needs a DeviceIndex or a batch")
+                 mesh=None, jidx=None):
+        if di is None and batch is None and jidx is None:
+            raise ValueError(
+                "JoinEngine needs a DeviceIndex, a batch or a prebuilt "
+                "JoinIndex"
+            )
         self.di = di
         self._batch = batch
         self._sft = sft if sft is not None else (
@@ -302,7 +363,9 @@ class JoinEngine:
         )
         self.sched = sched
         self.mesh = mesh
-        self._own_jidx = None
+        #: a prebuilt layout (``build_envelope_layout``) — the push
+        #: tier's encode-once subscription side
+        self._own_jidx = jidx
 
     # -- layout ------------------------------------------------------------
 

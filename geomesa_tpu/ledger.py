@@ -106,6 +106,8 @@ FIELDS = (
     "replica_ship_bytes",  # WAL record bytes shipped to followers
     "replica_apply_rows",  # rows applied from a leader's shipped WAL
     "snapshot_ship_bytes",  # snapshot stream bytes shipped to a fetcher
+    "sub_matches",       # matched alert rows charged to the subscriber
+    "sub_deliver_bytes",  # push-stream bytes delivered to a subscriber
 )
 
 #: fields folded with max() instead of sum() (a request's fusion width

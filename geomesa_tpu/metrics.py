@@ -757,3 +757,50 @@ router_backend_errors = REGISTRY.counter(
     "geomesa_router_backend_errors_total",
     "backend attempts that failed (connection error or 5xx)",
 )
+
+# continuous-query push tier (pubsub/): registry size, fused match
+# launches/latency on the ingest path, delivery/replay volume and the
+# teardown/heartbeat accounting on long-lived push streams
+pubsub_subscriptions = REGISTRY.gauge(
+    "geomesa_pubsub_subscriptions",
+    "standing subscriptions currently armed in the registry",
+)
+pubsub_match_batches = REGISTRY.counter(
+    "geomesa_pubsub_match_batches_total",
+    "acked append batches matched against the subscription layout "
+    "(one fused join launch each, regardless of subscription count)",
+)
+pubsub_match_pairs = REGISTRY.counter(
+    "geomesa_pubsub_match_pairs_total",
+    "subscription×feature pairs that survived exact residual + "
+    "visibility refinement",
+)
+pubsub_match_seconds = REGISTRY.histogram(
+    "geomesa_pubsub_match_seconds",
+    "fused batch×subscriptions match time per acked append batch",
+)
+pubsub_events_delivered = REGISTRY.counter(
+    "geomesa_pubsub_events_delivered_total",
+    "alert events written to connected push streams",
+)
+pubsub_deliver_bytes = REGISTRY.counter(
+    "geomesa_pubsub_deliver_bytes_total",
+    "push-stream body bytes written to subscribers",
+)
+pubsub_replay_records = REGISTRY.counter(
+    "geomesa_pubsub_replay_records_total",
+    "WAL records re-matched below a resuming subscriber's cursor",
+)
+pubsub_heartbeats = REGISTRY.counter(
+    "geomesa_pubsub_heartbeats_total",
+    "SSE :keepalive comments written to idle push streams",
+)
+pubsub_stream_overflows = REGISTRY.counter(
+    "geomesa_pubsub_stream_overflows_total",
+    "push streams torn down because their live event queue overflowed "
+    "(the client resumes exactly-once from its cursor)",
+)
+pubsub_rearms = REGISTRY.counter(
+    "geomesa_pubsub_rearms_total",
+    "matcher re-arms from the replicated registry (promotion/recovery)",
+)

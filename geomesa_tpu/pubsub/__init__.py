@@ -1,0 +1,43 @@
+"""Continuous queries: the geofence/alert push tier (ISSUE 16).
+
+Standing subscriptions ("alert me when anything enters this bbox /
+corridor / proximity") evaluated against streaming append traffic —
+the FeatureListener scenario from the reference architecture rebuilt
+on this repo's primitives:
+
+- ``registry``: the subscription registry — bbox / attribute-filter /
+  dwithin predicates per type, persisted in its own WAL under the
+  store root and replicated through the existing WAL shipping
+  machinery (the ``_pubsub`` pseudo-type on ``GET /wal/<type>``), so a
+  promoted follower re-arms every subscription with no operator step.
+- ``matcher``: subscription envelopes are XZ-encoded ONCE per registry
+  generation into a PR 11 join layout
+  (:func:`geomesa_tpu.join.build_envelope_layout`); every acked append
+  batch then matches against ALL subscriptions as ONE fused
+  batch×subscriptions spatial join on the ingest lane — never a
+  per-subscription loop — with exact attribute/dwithin residuals and
+  fail-closed visibility refining the emitted pairs.
+- ``delivery``: long-lived chunked/SSE push streams in the negotiated
+  result formats (geojson/arrow/bin). Every delivery cursor rides the
+  data WAL seq: a reconnecting subscriber resumes exactly-once from
+  its acked watermark — records below it replay from the WAL through
+  the same fused matcher, live matches arrive above it, and the two
+  paths dedupe on the seq watermark.
+"""
+
+from geomesa_tpu.pubsub.delivery import CursorGoneError, PubSubHub
+from geomesa_tpu.pubsub.matcher import SubscriptionMatcher
+from geomesa_tpu.pubsub.registry import (
+    REGISTRY_SHIP_NAME,
+    Subscription,
+    SubscriptionRegistry,
+)
+
+__all__ = [
+    "CursorGoneError",
+    "PubSubHub",
+    "REGISTRY_SHIP_NAME",
+    "Subscription",
+    "SubscriptionMatcher",
+    "SubscriptionRegistry",
+]

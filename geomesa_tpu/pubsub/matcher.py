@@ -1,0 +1,162 @@
+"""Fused batch×subscriptions matching on the ingest path.
+
+The subscription side is encoded ONCE per registry generation: every
+subscription's coarse predicate envelope is XZ-encoded into a PR 11
+join layout (:func:`geomesa_tpu.join.build_envelope_layout`). Each
+acked append batch then runs as ONE fused spatial join against that
+layout — one launch regardless of how many subscriptions stand (the
+anti-pattern this tier exists to avoid is the per-subscription filter
+loop) — and the coarse pairs are refined by the exact predicates:
+
+- bbox: the coarse envelope IS the (intersected) bbox, and envelope
+  overlap is the exact BBOX semantics, so no residual is needed;
+- dwithin: exact center-to-envelope distance residual;
+- ECQL: :func:`geomesa_tpu.filter.compile.evaluate_host` — the host
+  twin of the device path's ``join.engine.filter_gate`` (a gate needs
+  a staged DeviceIndex; append batches are raw host columns);
+- visibility: :func:`geomesa_tpu.security.filter_by_visibility` with
+  the subscription's frozen auths — fail closed, same as reads.
+
+Matching runs on the ingest lane when a scheduler is attached (it
+shares the append path's budget); the engine itself gets ``sched=None``
+so the join does not nest a second scheduled slice inside the lane.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from geomesa_tpu import metrics
+from geomesa_tpu.failpoints import fail_point
+from geomesa_tpu.filter.compile import evaluate_host
+from geomesa_tpu.filter.ecql import parse_ecql
+from geomesa_tpu.join import JoinEngine, build_envelope_layout
+from geomesa_tpu.sched import LANE_INGEST
+from geomesa_tpu.security import filter_by_visibility
+
+
+class SubscriptionMatcher:
+    """Encode-once layout cache + fused match over it.
+
+    Not internally locked: the hub serializes calls per type (records
+    are processed in seq order under its reorder buffer), and the
+    layout cache is a per-generation swap — a stale read just rebuilds.
+    """
+
+    def __init__(self, registry, sched=None) -> None:
+        self.registry = registry
+        self.sched = sched
+        self._layouts: dict = {}  # type -> (gen, jidx|None, subs, empty_mask)
+        self._filters: dict = {}  # cql text -> parsed ast (subs-bounded)
+        self.launches = 0  # fused join launches — asserted 1/batch in tests
+
+    def invalidate(self) -> None:
+        """Drop every cached layout (promotion re-arm)."""
+        self._layouts.clear()
+        self._filters.clear()
+
+    # -- layout ------------------------------------------------------------
+
+    def _layout(self, type_name: str, precision: int):
+        gen = self.registry.gen
+        cached = self._layouts.get(type_name)
+        if cached is not None and cached[0] == gen:
+            return cached[1], cached[2], cached[3]
+        subs = self.registry.for_type(type_name)
+        if not subs:
+            entry = (gen, None, (), None)
+        else:
+            envs = np.stack([s.envelope() for s in subs])
+            # provably-empty predicates (disjoint bbox∩dwithin∩cql) stay
+            # in the layout as degenerate boxes so row ids keep aligning
+            # with ``subs``; the empty mask drops their pairs post-join
+            empty = ~np.isfinite(envs).all(axis=1)
+            if empty.any():
+                envs = envs.copy()
+                envs[empty] = (0.0, 0.0, 0.0, 0.0)
+            jidx = build_envelope_layout(envs, precision=precision, gen=gen)
+            entry = (gen, jidx, subs, empty if empty.any() else None)
+        self._layouts[type_name] = entry
+        metrics.pubsub_subscriptions.set(float(self.registry.count()))
+        return entry[1], entry[2], entry[3]
+
+    def _filter(self, cql: str):
+        f = self._filters.get(cql)
+        if f is None:
+            f = parse_ecql(cql)
+            if len(self._filters) > 4 * max(1, self.registry.count()):
+                self._filters.clear()  # bound by live subscription count
+            self._filters[cql] = f
+        return f
+
+    # -- match -------------------------------------------------------------
+
+    def match(self, type_name: str, batch, sft) -> list:
+        """Match one acked batch against every standing subscription of
+        its type in a single fused join. Returns ``[(sub, rows), ...]``
+        with ``rows`` the matched batch row indices (ascending), only
+        for subscriptions with at least one surviving match."""
+        fail_point("fail.sub.match")
+        jidx, subs, empty = self._layout(type_name, sft.xz_precision)
+        if jidx is None or not len(batch):
+            return []
+        t0 = time.perf_counter()
+        geom = sft.geom_field
+        if geom is not None and sft.descriptor(geom).is_point:
+            x, y = batch.point_coords(geom)
+            fenvs = np.stack([x, y, x, y], axis=1)
+        elif geom is not None:
+            fenvs = np.asarray(batch.bboxes(geom), dtype=np.float64)
+        else:
+            return []
+        eng = JoinEngine(jidx=jidx, sched=None)
+        if self.sched is not None:
+            res = self.sched.run(
+                fn=lambda: eng.join(fenvs), lane=LANE_INGEST, tenant="_system"
+            )
+        else:
+            res = eng.join(fenvs)
+        self.launches += 1
+        out = []
+        if len(res.rows):
+            order = np.argsort(res.rows, kind="stable")
+            srows = np.asarray(res.rows)[order]
+            swins = np.asarray(res.wins)[order]
+            starts = np.concatenate(
+                ([0], np.flatnonzero(np.diff(srows)) + 1)
+            )
+            bounds = np.append(starts[1:], len(srows))
+            for lo, hi in zip(starts, bounds):
+                si = int(srows[lo])
+                if empty is not None and empty[si]:
+                    continue
+                sub = subs[si]
+                rows = np.sort(swins[lo:hi].astype(np.int64))
+                rows = self._refine(sub, batch, rows, fenvs)
+                if len(rows):
+                    out.append((sub, rows))
+        metrics.pubsub_match_batches.inc()
+        metrics.pubsub_match_pairs.inc(float(sum(len(r) for _s, r in out)))
+        metrics.pubsub_match_seconds.observe(time.perf_counter() - t0)
+        return out
+
+    def _refine(self, sub, batch, rows: np.ndarray, fenvs: np.ndarray):
+        """Exact residuals over the coarse pairs of one subscription."""
+        keep = np.ones(len(rows), dtype=bool)
+        # visibility: fail closed — a feature without clearance never
+        # reaches a subscriber, exactly like the read path
+        vmask = filter_by_visibility(batch, sub.auths)
+        if vmask is not None:
+            keep &= np.asarray(vmask, dtype=bool)[rows]
+        if sub.dwithin is not None and keep.any():
+            cx, cy, dist = sub.dwithin
+            fe = fenvs[rows]
+            dx = np.maximum(np.maximum(fe[:, 0] - cx, cx - fe[:, 2]), 0.0)
+            dy = np.maximum(np.maximum(fe[:, 1] - cy, cy - fe[:, 3]), 0.0)
+            keep &= np.hypot(dx, dy) <= dist
+        if sub.cql and keep.any():
+            mask = evaluate_host(self._filter(sub.cql), batch)
+            keep &= np.asarray(mask, dtype=bool)[rows]
+        return rows[keep]

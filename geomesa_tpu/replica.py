@@ -157,6 +157,10 @@ class Replicator:
         self.last_failover_s = -1.0
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
+        #: PubSubHub, wired by make_server: followers tail the leader's
+        #: subscription-registry WAL (/wal/_pubsub) alongside the data
+        #: types, and a promotion re-arms continuous-query matching
+        self.pubsub = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -294,6 +298,31 @@ class Replicator:
                 pos[type_name] = applied_seq
             self._follower_seen[url] = time.monotonic()
             self._ack_cv.notify_all()
+        hub = self.pubsub
+        if hub is not None:
+            # kick OUTSIDE the ack condition (lock order: the flush
+            # takes pubsub locks, then commit_floor retakes _ack_cv)
+            try:
+                hub.commit_advanced(type_name)
+            except Exception:  # pragma: no cover - ship must not die
+                log.warning("pubsub commit flush failed", exc_info=True)
+
+    def commit_floor(self, type_name: str) -> "int | None":
+        """Highest seq some follower has applied for ``type_name`` —
+        the push tier's delivery gate under ``replica.ack=replica``: a
+        live alert must never name a seq a failover could void and
+        reassign, so the hub holds matched events above this floor.
+        ``None`` (gate inactive, deliver immediately) when this node is
+        not the leader or acks are leader-local."""
+        if self._role != "leader" or self.ack_mode() != "replica":
+            return None
+        best = -1
+        with self._ack_cv:
+            for pos in self._followers.values():
+                s = pos.get(type_name, -1)
+                if s > best:
+                    best = s
+        return best
 
     def await_replicated(self, type_name: str, seq: int,
                          timeout_s: float) -> bool:
@@ -423,6 +452,19 @@ class Replicator:
         if cost.fields and ledger.enabled():
             cost.status = 200
             ledger.LEDGER.record(cost)
+        if (self.pubsub is not None and self._role == "follower"
+                and self._leader_url and not self._stop.is_set()):
+            # subscription-registry tail: best-effort and NOT lease
+            # contact (push-tier absence on the leader must not mask a
+            # dead data ship, and vice versa)
+            try:
+                n = self._fetch_pubsub()
+                progressed = progressed or n > 0
+            except Exception as e:
+                log.debug(
+                    "replica: pubsub registry ship failed (%s: %s)",
+                    type(e).__name__, e,
+                )
         if (self._needs_reprovision and self._role == "follower"
                 and self._leader_url and not self._stop.is_set()):
             contacted = self._reprovision(log, metrics, sys_prop) \
@@ -460,6 +502,45 @@ class Replicator:
             )
             self._demote(epoch, successor)
             return
+
+    def _fetch_pubsub(self) -> int:
+        """Tail the leader's subscription-registry WAL. The registry
+        log is never truncated, so any gap self-heals by re-asking from
+        our own ``next_seq`` next cycle; a leader without the push tier
+        404s and we just idle. Returns ops applied."""
+        import logging
+
+        from geomesa_tpu.pubsub import REGISTRY_SHIP_NAME
+
+        log = logging.getLogger(__name__)
+        reg = self.pubsub.registry
+        frm = int(reg.next_seq)
+        url = (
+            f"{self._leader_url}/wal/{REGISTRY_SHIP_NAME}?from={frm}"
+            f"&waitMs=0&epoch={self._epoch}"
+        )
+        try:
+            resp = urllib.request.urlopen(url, timeout=5.0)
+        except urllib.error.HTTPError as e:
+            e.close()  # 404/400: leader runs no push tier — not fatal
+            return 0
+        applied = 0
+        with resp:
+            parser = RecordParser()
+            while True:
+                chunk = resp.read(1 << 16)
+                if not chunk:
+                    break
+                for seq, payload in parser.feed(chunk):
+                    try:
+                        if reg.apply_replicated(seq, payload):
+                            applied += 1
+                    except ValueError as e:
+                        # gap: stop here, re-ask from next_seq next
+                        # cycle — the leader still holds every op
+                        log.debug("replica: pubsub %s", e)
+                        return applied
+        return applied
 
     def _fetch_type(self, type_name: str) -> int:
         """One ship fetch for one type: long-poll the leader from our
@@ -997,6 +1078,14 @@ class Replicator:
             })
         except Exception:  # pragma: no cover - observability must not break
             pass
+        if self.pubsub is not None:
+            # re-arm continuous-query matching from the replicated
+            # registry: the new leader's ingest path starts matching
+            # (and pinning retention for) every standing subscription
+            try:
+                self.pubsub.note_promoted()
+            except Exception:  # pragma: no cover - must not fail promotion
+                pass
 
     # -- introspection -------------------------------------------------------
 

@@ -30,6 +30,7 @@ from geomesa_tpu.results.columnar import capped_batches, with_extra_columns
 from geomesa_tpu.results.negotiate import (
     CONTENT_TYPES,
     FORMATS,
+    PUSH_CONTENT_TYPES,
     negotiate_format,
 )
 from geomesa_tpu.results.binrider import bin_engine, resident_bin
@@ -42,6 +43,7 @@ from geomesa_tpu.results.stream import (
 __all__ = [
     "CONTENT_TYPES",
     "FORMATS",
+    "PUSH_CONTENT_TYPES",
     "arrow_stream_chunks",
     "bin_engine",
     "capped_batches",

@@ -19,6 +19,17 @@ CONTENT_TYPES = {
     "bin": "application/vnd.geomesa.bin",
 }
 
+#: Content-Type per format on the PUSH plane (``GET /subscribe/<type>``,
+#: long-lived continuous-query streams): geojson rides Server-Sent
+#: Events (one ``match`` event per batch, ``id:`` = WAL-seq cursor,
+#: ``:keepalive`` heartbeats); arrow and bin keep their pull-plane
+#: framing — the negotiation table is shared, only the envelope differs
+PUSH_CONTENT_TYPES = {
+    "geojson": "text/event-stream",
+    "arrow": CONTENT_TYPES["arrow"],
+    "bin": CONTENT_TYPES["bin"],
+}
+
 #: ``f=`` spellings accepted per format (case-insensitive)
 _PARAM_ALIASES = {
     "geojson": "geojson",

@@ -424,7 +424,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
         body = self.rfile.read(length) if length else b""
         url = urllib.parse.urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
-        if parts[:1] == ["append"]:
+        if parts[:1] == ["append"] or parts[:1] == ["subscribe"]:
+            # both are leader-pinned writes: appends fork the data WAL,
+            # subscription CRUD forks the registry WAL
             self._proxy_append(body)
         else:
             # non-append POSTs (e.g. /admin/shutdown) are a per-backend
@@ -435,6 +437,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 "error": "the router proxies GET reads and POST "
                          "/append/<type> only; operate on backends "
                          "directly for admin actions",
+            })
+        metrics.router_requests.inc()
+
+    def do_DELETE(self) -> None:  # noqa: N802 (stdlib API)
+        from geomesa_tpu import metrics
+
+        url = urllib.parse.urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        if parts[:1] == ["subscribe"]:
+            # subscription cancel is leader-pinned like registration
+            self._proxy_append(b"", method="DELETE")
+        else:
+            self._json(404, {
+                "error": "the router proxies DELETE /subscribe/<type> "
+                         "only",
             })
         metrics.router_requests.inc()
 
@@ -488,7 +505,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             headers=(("Retry-After", "1"),),
         )
 
-    def _proxy_append(self, body: bytes) -> None:
+    def _proxy_append(self, body: bytes, method: str = "POST") -> None:
         from geomesa_tpu import metrics
 
         rt = self.router
@@ -507,7 +524,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             )
         try:
             status, hdrs, resp = rt.forward(
-                lead, "POST", self.path, body, self._req_headers()
+                lead, method, self.path, body, self._req_headers()
             )
         except Exception as e:
             lead.breaker.record_failure()

@@ -141,6 +141,7 @@ class _GeomesaHTTPServer(ThreadingHTTPServer):
     store = None  # wired by make_server (audit flush at drain)
     stream_layer = None  # StreamingStore, when the live layer is on
     replica = None  # Replicator, when this server is in a group
+    pubsub = None  # PubSubHub, when the push tier is on
 
     def __init__(self, *args, **kwargs):
         self.draining = threading.Event()
@@ -157,6 +158,13 @@ class _GeomesaHTTPServer(ThreadingHTTPServer):
                 pass
         if self.scheduler is not None:
             self.scheduler.close(timeout=5.0)
+        if self.pubsub is not None:
+            # detach the matcher from the stream and wake every push
+            # connection BEFORE the live layer seals its WAL
+            try:
+                self.pubsub.close()
+            except Exception:  # close is best-effort on the way down
+                pass
         if self.stream_layer is not None:
             # stop the compactor and seal the WAL; acked-but-uncompacted
             # rows stay durable in the log and replay on the next open
@@ -193,6 +201,7 @@ class _Handler(BaseHTTPRequestHandler):
     scheduler = None  # QueryScheduler (admission + micro-batch fusion)
     stream = None  # StreamingStore live layer (None = batch-only)
     replica = None  # Replicator (None = unreplicated single process)
+    pubsub = None  # PubSubHub continuous-query tier (needs stream)
     _resident_cache: dict = {}  # per-server-class: type -> DeviceIndex
     _resident_lock = None  # per-server-class construction lock
 
@@ -625,7 +634,8 @@ class _Handler(BaseHTTPRequestHandler):
         ) or parts == ["stats", "mesh"] or parts == ["stats", "slo"] \
             or parts == ["stats", "ledger"] or parts == ["stats", "stream"] \
             or parts == ["stats", "replica"] or parts[:1] == ["wal"] \
-            or parts[:1] == ["snapshot"] or parts == ["stats"]
+            or parts[:1] == ["snapshot"] or parts == ["stats"] \
+            or parts == ["stats", "pubsub"] or parts[:1] == ["subscribe"]
         if untraced:
             self._trace = None
             self._degraded = None
@@ -740,6 +750,16 @@ class _Handler(BaseHTTPRequestHandler):
                 daemon=True,
             ).start()
             return
+        if len(parts) == 2 and parts[0] == "subscribe":
+            # subscription CRUD is control-plane traffic: untraced (like
+            # the ship endpoints), leader-pinned (the registry WAL must
+            # not fork), replicated to followers via /wal/_pubsub
+            self._trace = None
+            self._degraded = None
+            self._cost = None
+            return self._run_safe(
+                lambda: self._subscribe_post(parts, q, body), parts, q
+            )
         if len(parts) != 2 or parts[0] != "append":
             self._trace = None
             self._degraded = None
@@ -856,6 +876,235 @@ class _Handler(BaseHTTPRequestHandler):
         if replicated is not None:
             doc["replicated"] = bool(replicated)
         self._json(200, doc)
+
+    # -- continuous queries (the pubsub push tier) -------------------------
+
+    def _pubsub_hub(self):
+        if self.pubsub is None:
+            raise ValueError(
+                "server is not running the continuous-query push tier "
+                "(needs the streaming live layer: stream.enabled / "
+                "serve --stream)"
+            )
+        return self.pubsub
+
+    def _subscribe_post(self, parts: list, q: dict, body: bytes) -> None:
+        """POST ``/subscribe/<type>``: register a standing continuous
+        query. Body: any of ``{"bbox": [...], "cql": "...", "dwithin":
+        {"x","y","distance"}, "auths": [...]}``. The response carries
+        the subscription id and its initial cursor (the data-WAL seq it
+        is armed from). Leader-pinned: the registry WAL replicates to
+        followers, so the same 503 + leader bounce as appends."""
+        hub = self._pubsub_hub()
+        if self._draining():
+            return self._send(
+                503,
+                json.dumps({"error": "server is draining"}).encode("utf-8"),
+                "application/json",
+                headers=(("Retry-After", "1"),),
+            )
+        rep = self.replica
+        if rep is not None and not rep.is_leader():
+            return self._send(
+                503,
+                json.dumps({
+                    "error": "not the leader "
+                             f"(role={rep.role}); subscriptions go to "
+                             "the leader",
+                    "leader": rep.leader_url,
+                    "epoch": int(rep.epoch),
+                }).encode("utf-8"),
+                "application/json",
+                headers=(("Retry-After", "1"),),
+            )
+        type_name = unquote(parts[1])
+        doc = json.loads(body.decode("utf-8")) if body else {}
+        tenant = q.get("tenant") or (
+            str(self.client_address[0]) if self.client_address else ""
+        )
+        auths = doc.get("auths")
+        if auths is None:
+            auths = self._auths(q)
+        out = hub.subscribe(type_name, doc, tenant=tenant, auths=auths)
+        if rep is not None:
+            out["epoch"] = int(rep.epoch)
+        self._json(200, out)
+
+    def do_DELETE(self) -> None:  # noqa: N802 (stdlib API)
+        """DELETE ``/subscribe/<type>?id=<sub>``: cancel a standing
+        subscription (leader-pinned, replicated like registration)."""
+        try:
+            url = urlparse(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        except Exception as e:
+            self._trace = None
+            self._degraded = None
+            self._cost = None
+            return self._json(400, {"error": str(e)})
+        self._trace = None
+        self._degraded = None
+        self._cost = None
+        if len(parts) != 2 or parts[0] != "subscribe":
+            return self._json(
+                404, {"error": f"no such DELETE endpoint {url.path!r}"}
+            )
+        return self._run_safe(
+            lambda: self._subscribe_delete(parts, q), parts, q
+        )
+
+    def _subscribe_delete(self, parts: list, q: dict) -> None:
+        hub = self._pubsub_hub()
+        rep = self.replica
+        if rep is not None and not rep.is_leader():
+            return self._send(
+                503,
+                json.dumps({
+                    "error": f"not the leader (role={rep.role})",
+                    "leader": rep.leader_url,
+                    "epoch": int(rep.epoch),
+                }).encode("utf-8"),
+                "application/json",
+                headers=(("Retry-After", "1"),),
+            )
+        sub_id = q.get("id")
+        if not sub_id:
+            raise ValueError("DELETE /subscribe/<type> needs ?id=<sub>")
+        if not hub.cancel(sub_id):
+            raise KeyError(sub_id)
+        self._json(200, {"cancelled": sub_id})
+
+    def _subscribe_stream(self, type_name: str, q: dict) -> None:
+        """GET ``/subscribe/<type>?id=&from=&f=``: the long-lived push
+        stream. ``from`` (or the SSE ``Last-Event-ID`` header) is the
+        subscriber's acked seq watermark — delivery resumes exactly-once
+        above it; omitted, it defaults to the subscription's creation
+        cursor. Formats ride the results plane: geojson = SSE ``match``
+        events with ``:keepalive`` heartbeats, arrow = IPC stream with a
+        ``match_seq`` column, bin = track records (resume via explicit
+        ``from=``)."""
+        from geomesa_tpu.conf import sys_prop
+        from geomesa_tpu.pubsub import CursorGoneError
+        from geomesa_tpu.pubsub.delivery import (
+            arrow_push_chunks,
+            bin_push_chunks,
+            sse_chunks,
+        )
+        from geomesa_tpu.results import PUSH_CONTENT_TYPES, negotiate_format
+
+        hub = self._pubsub_hub()
+        if self._draining():
+            return self._send(
+                503,
+                json.dumps({"error": "server is draining"}).encode("utf-8"),
+                "application/json",
+                headers=(("Retry-After", "1"),),
+            )
+        sub_id = q.get("id")
+        if not sub_id:
+            raise ValueError("GET /subscribe/<type> needs ?id=<sub>")
+        sub = hub.registry.get(sub_id)
+        if sub is None or sub.type_name != type_name:
+            raise KeyError(sub_id)
+        fmt = negotiate_format(q, self.headers.get("Accept"))
+        frm = q.get("from")
+        if frm is None:
+            frm = self.headers.get("Last-Event-ID")
+        from_seq = int(frm) if frm is not None else int(sub.created_seq)
+        sft = self.store.get_schema(type_name)
+        try:
+            events = hub.events(
+                type_name, sub_id, from_seq,
+                float(sys_prop("sub.heartbeat.s")),
+            )
+        except CursorGoneError as e:
+            return self._json(410, {"error": str(e)})
+        # a push connection is idle ON PURPOSE between matches: exempt
+        # it from the keep-alive reap (heartbeats bound detection of a
+        # dead peer instead) and never reuse the socket afterwards
+        self.connection.settimeout(None)
+        self.close_connection = True
+        if fmt == "arrow":
+            chunks = arrow_push_chunks(events, sft)
+        elif fmt == "bin":
+            track = q.get("track") or sft.attribute_names[0]
+            chunks = bin_push_chunks(events, track)
+        else:
+            chunks = sse_chunks(events, type_name, sub_id)
+        ctype = PUSH_CONTENT_TYPES[fmt]
+        self._send_stream(
+            200, ctype, self._deliver_guard(chunks, sub), fmt,
+            headers=(("Cache-Control", "no-cache"),),
+        )
+
+    def _deliver_guard(self, chunks, sub):
+        """Per-chunk delivery wrapper: the ``fail.sub.deliver`` fault
+        hook plus byte accounting charged to the subscriber tenant."""
+        from geomesa_tpu import ledger, metrics
+        from geomesa_tpu.failpoints import fail_point
+
+        sent = 0
+        try:
+            for piece in chunks:
+                fail_point("fail.sub.deliver")
+                sent += len(piece)
+                yield piece
+        finally:
+            if sent:
+                metrics.pubsub_deliver_bytes.inc(float(sent))
+                if ledger.enabled():
+                    cost = ledger.RequestCost(
+                        tenant=sub.tenant,
+                        endpoint="subscribe",
+                        lane="interactive",
+                        shape="push-stream",
+                    )
+                    cost.status = 200
+                    cost.charge("sub_deliver_bytes", float(sent))
+                    ledger.LEDGER.record(cost)
+
+    def _registry_ship(self, q: dict) -> None:
+        """``GET /wal/_pubsub?from=``: ship the subscription-registry
+        WAL to followers. Same framing as the data ship, but the
+        registry log is never truncated (bounded by subscription churn)
+        so there is no watermark and no 410 — a follower can always
+        catch up from any position."""
+        from geomesa_tpu.store.wal import pack_record
+
+        hub = self._pubsub_hub()
+        wal = hub.registry.wal
+        frm = max(int(q.get("from", 0)), 0)
+        rep = self.replica
+        if rep is not None:
+            try:
+                rep.observe_epoch(int(q.get("epoch", 0)))
+            except (TypeError, ValueError):
+                pass
+        nxt = int(wal.next_seq)
+
+        def chunks():
+            buf = bytearray()
+            for seq, payload in wal.read_from(frm - 1):
+                if seq >= nxt:
+                    break
+                buf += pack_record(seq, payload)
+                if len(buf) >= (512 << 10):
+                    yield bytes(buf)
+                    buf.clear()
+            if buf:
+                yield bytes(buf)
+
+        role = rep.role if rep is not None else "leader"
+        self._send_stream(
+            200, "application/x-geomesa-wal", chunks(), "wal",
+            headers=(
+                ("X-Wal-Next-Seq", str(nxt)),
+                ("X-Wal-Watermark", "-1"),
+                ("X-Replica-Role", role),
+                ("X-Replica-Epoch",
+                 str(rep.epoch if rep is not None else 0)),
+            ),
+        )
 
     def _audit_outcome(self, parts: list, q: dict, outcome: str) -> None:
         """Stamp a shed (429) or deadline-expired (504) request into the
@@ -1044,6 +1293,17 @@ class _Handler(BaseHTTPRequestHandler):
                 if self.replica is not None
                 else {"enabled": False},
             )
+        if parts == ["stats", "pubsub"]:
+            return self._json(
+                200,
+                self.pubsub.stats()
+                if self.pubsub is not None
+                else {"enabled": False},
+            )
+        if len(parts) == 2 and parts[0] == "subscribe":
+            # the long-lived push stream (SSE/arrow/bin); served by ANY
+            # replica — matching runs off the local WAL feed
+            return self._subscribe_stream(unquote(parts[1]), q)
         if parts == ["stats"]:
             return self._json(200, self._stats_index())
         if len(parts) == 2 and parts[0] == "wal":
@@ -1119,6 +1379,12 @@ class _Handler(BaseHTTPRequestHandler):
                 {"error": "server is not running with the streaming "
                           "live layer (stream.enabled / serve --stream)"},
             )
+        from geomesa_tpu.pubsub import REGISTRY_SHIP_NAME
+
+        if type_name == REGISTRY_SHIP_NAME:
+            # the subscription registry ships through the same endpoint
+            # as a reserved pseudo-type (no schema, never truncated)
+            return self._registry_ship(q)
         self.store.get_schema(type_name)  # KeyError -> 404
         ts = stream._ts(type_name)
         frm = max(int(q.get("from", 0)), 0)
@@ -1321,6 +1587,8 @@ class _Handler(BaseHTTPRequestHandler):
             doc["stream"] = self.stream.stream_stats()
         if self.replica is not None:
             doc["replica"] = self.replica.stats()
+        if self.pubsub is not None:
+            doc["pubsub"] = self.pubsub.stats()
         return doc
 
     def _debug_traces(self, parts: list, q: dict) -> None:
@@ -1920,6 +2188,7 @@ class _Handler(BaseHTTPRequestHandler):
 _KNOWN_ENDPOINTS = frozenset({
     "features", "count", "explain", "density", "stats", "refresh",
     "knn", "tube", "proximity", "capabilities", "append", "wal",
+    "subscribe",
 })
 
 
@@ -2125,6 +2394,23 @@ def make_server(
                 f"got {type(replica).__name__}"
             )
         replicator.attach(stream_layer)
+    # continuous-query push tier: rides the live layer (the data WAL
+    # seq is the delivery cursor; no WAL, no cursor). The hub wires its
+    # own seq listener and retention floor into the stream here.
+    pubsub_hub = None
+    if stream_layer is not None:
+        from geomesa_tpu.pubsub import PubSubHub
+
+        pubsub_hub = PubSubHub(stream_layer, sched=scheduler)
+        if replicator is not None:
+            # followers tail /wal/_pubsub alongside the data types and
+            # a promotion re-arms matching from the replicated registry
+            replicator.pubsub = pubsub_hub
+            # under replica.ack=replica the leader's hub must not push
+            # an alert until the record is replication-durable: a
+            # failover could void the unreplicated tail and reassign
+            # its seqs, silently breaking the cursor resume
+            pubsub_hub.commit_gate = replicator.commit_floor
     from geomesa_tpu.conf import sys_prop as _sys_prop
 
     handler = type(
@@ -2137,6 +2423,7 @@ def make_server(
             "scheduler": scheduler,
             "stream": stream_layer,
             "replica": replicator,
+            "pubsub": pubsub_hub,
             # idle keep-alive bound, declared (GT008) instead of the
             # class-default literal; router→backend pooled connections
             # read the same key
@@ -2186,6 +2473,8 @@ def make_server(
         return doc
 
     providers["mesh"] = _mesh_snapshot
+    if pubsub_hub is not None:
+        providers["pubsub"] = pubsub_hub.stats
     if stream_layer is not None:
         providers["stream"] = stream_layer.stream_stats
 
@@ -2224,6 +2513,7 @@ def make_server(
     server.scheduler = scheduler  # callers may inspect / shut down
     server.store = store  # the draining shutdown flushes its audit log
     server.stream_layer = stream_layer  # closed by the draining shutdown
+    server.pubsub = pubsub_hub  # closed (before the stream) at drain
     if replicator is not None:
         # the bound ephemeral port is only known NOW — default the
         # advertised URL from it so tests/CLI may pass port=0

@@ -82,6 +82,7 @@ SLO_NAMES = ("interactive", "batch", "ingest")
 FLIGHT_REASONS = (
     "burn-rate", "breaker-open", "manual", "ingest-stall",
     "replica-failover", "replica-demote", "replica-reprovision",
+    "pubsub-rearm",
 )
 
 #: windowed-histogram bucket bounds (seconds) — finer than the metrics

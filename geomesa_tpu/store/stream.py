@@ -152,12 +152,21 @@ class StreamingStore:
         from geomesa_tpu.locking import checked_lock
 
         self._listeners: list = []
+        #: seq listeners: cb(type_name, batch, seq) after each durably
+        #: landed record — leader appends AND follower applies — the
+        #: continuous-query matcher's cursor-exact live feed
+        self._seq_listeners: list = []
         #: replication retention hook: ``callable(type_name) -> int |
         #: None`` giving the lowest WAL seq a follower still needs
         #: (Replicator.attach installs it); the compactor never
         #: truncates segments past it, so a lagging-but-live follower
         #: keeps tailing instead of hitting the 410 re-provision cliff
         self.retention_floor = None
+        #: additional retention floors (``add_retention_floor``): the
+        #: push tier pins segments live subscriber cursors still need
+        #: to replay — the effective truncation bound is the min over
+        #: every installed floor
+        self._retention_floors: list = []
         # blocking_ok: first-touch _TypeStream construction opens the
         # WAL (segment scan + torn-tail truncation) under it BY DESIGN
         # — two appenders racing the open would double-append one
@@ -286,6 +295,7 @@ class StreamingStore:
             # incremental resident refresh OUTSIDE the memtable lock
             # (device staging must not serialize WAL appends)
             self._notify_delta(type_name, batch)
+            self._notify_seq(type_name, batch, seq)
         if mem_rows >= int(sys_prop("stream.memtable.rows")):
             self._kick()
         return {"seq": int(seq), "rows": len(batch)}
@@ -396,6 +406,7 @@ class StreamingStore:
             # resident-index delta outside the memtable lock, exactly
             # like the leader's append path
             self._notify_delta(type_name, batch)
+            self._notify_seq(type_name, batch, seq)
         from geomesa_tpu.conf import sys_prop
 
         if mem_rows >= int(sys_prop("stream.memtable.rows")):
@@ -561,6 +572,46 @@ class StreamingStore:
     def remove_delta_listener(self, cb) -> None:
         if cb in self._listeners:
             self._listeners.remove(cb)
+
+    def add_seq_listener(self, cb) -> None:
+        """``cb(type_name, batch, seq)`` after every durably landed WAL
+        record — acked leader appends and follower ``apply_replicated``
+        both fire it, so a listener sees the identical seq-stamped
+        record stream on every replica. The continuous-query matcher
+        rides this: the seq is the delivery cursor. Listener faults
+        degrade like delta-listener faults — the rows are already
+        durable and queryable regardless."""
+        self._seq_listeners.append(cb)
+
+    def remove_seq_listener(self, cb) -> None:
+        if cb in self._seq_listeners:
+            self._seq_listeners.remove(cb)
+
+    def _notify_seq(self, type_name: str, batch, seq: int) -> None:
+        from geomesa_tpu import resilience
+
+        for cb in list(self._seq_listeners):
+            try:
+                cb(type_name, batch, int(seq))
+            except Exception as e:
+                import logging
+
+                resilience.note_degraded("ingest-degraded")
+                logging.getLogger(__name__).warning(
+                    "dataset %r: seq listener failed at seq %d (%s) -- "
+                    "subscribers recover via cursor replay",
+                    type_name, seq, e,
+                )
+
+    def add_retention_floor(self, fn) -> None:
+        """Install an additional WAL retention floor (``fn(type_name)
+        -> int | None``). Composes with ``retention_floor`` — the
+        compactor truncates up to the min over all installed floors."""
+        self._retention_floors.append(fn)
+
+    def remove_retention_floor(self, fn) -> None:
+        if fn in self._retention_floors:
+            self._retention_floors.remove(fn)
 
     def _notify_delta(self, type_name: str, batch) -> None:
         from geomesa_tpu import resilience
@@ -914,17 +965,21 @@ class StreamingStore:
         recently-seen follower still has to ship must outlive their
         compaction, or the leader's own GC forces that follower into a
         410 snapshot re-provision (the check-then-act race the review
-        flagged). Best-effort: a broken hook never blocks compaction."""
-        fn = self.retention_floor
-        if fn is None:
-            return watermark
-        try:
-            floor = fn(type_name)
-        except Exception:
-            return watermark
-        if floor is None:
-            return watermark
-        return min(int(watermark), int(floor))
+        flagged). Subscriber-cursor floors (``add_retention_floor``)
+        compose the same way: the bound is the min over every installed
+        floor. Best-effort: a broken hook never blocks compaction."""
+        bound = int(watermark)
+        hooks = list(self._retention_floors)
+        if self.retention_floor is not None:
+            hooks.append(self.retention_floor)
+        for fn in hooks:
+            try:
+                floor = fn(type_name)
+            except Exception:
+                continue
+            if floor is not None:
+                bound = min(bound, int(floor))
+        return bound
 
     # -- recovery ----------------------------------------------------------
 

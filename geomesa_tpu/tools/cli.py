@@ -1052,6 +1052,61 @@ def _load_driver_backends(args):
                 stats[u]["ok"] += 1
                 stats[u]["lats"].append(time.perf_counter() - t0)
 
+    # --subscribe K: standing push streams held open through the load
+    # (the mixed appends+subscriptions+reads leg). Each subscription
+    # registers under its own sub<k> tenant so the ledger's
+    # matched-alert cost (sub_matches / sub_deliver_bytes) lands on
+    # the subscriber, not the appending writer.
+    subs: list = []
+    sub_counts: list = []
+    sub_stop = threading.Event()
+    sub_threads: list = []
+    n_subs = int(getattr(args, "subscribe", 0) or 0)
+    if n_subs > 0:
+        lead = leader_of()
+        for k in range(n_subs):
+            req = urllib.request.Request(
+                f"{lead}/subscribe/{args.feature_name}?tenant=sub{k}",
+                data=json.dumps(
+                    {"bbox": [-180.0, -90.0, 180.0, 90.0]}
+                ).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                subs.append(json.loads(r.read()))
+        sub_counts = [0] * n_subs
+
+        def sub_reader(i: int, sub: dict, base: str = ""):
+            # any replica serves the stream; use the leader we know
+            target = (
+                f"{base}/subscribe/{args.feature_name}"
+                f"?id={sub['id']}&from={sub['cursor']}"
+            )
+            try:
+                with urllib.request.urlopen(target, timeout=300) as resp:
+                    buf = b""
+                    while not sub_stop.is_set():
+                        chunk = resp.read1(1 << 16)
+                        if not chunk:
+                            break
+                        buf += chunk
+                        while b"\n\n" in buf:
+                            ev, buf = buf.split(b"\n\n", 1)
+                            if b"event: match" in ev:
+                                with lock:
+                                    sub_counts[i] += 1
+            except Exception:
+                pass  # a torn stream still reports its partial count
+
+        sub_threads = [
+            threading.Thread(
+                target=sub_reader, args=(i, s, lead), daemon=True
+            )
+            for i, s in enumerate(subs)
+        ]
+        for t in sub_threads:
+            t.start()
     threads = [
         threading.Thread(target=worker, args=(i,))
         for i in range(args.threads)
@@ -1062,6 +1117,31 @@ def _load_driver_backends(args):
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
+    sub_report = None
+    if n_subs > 0:
+        # give in-flight matches a beat to deliver, then cancel: the
+        # server ends each stream ("cancelled") and the readers drain
+        time.sleep(0.5)
+        sub_stop.set()
+        lead = leader_of()
+        for s in subs:
+            try:
+                req = urllib.request.Request(
+                    f"{lead}/subscribe/{args.feature_name}?id={s['id']}",
+                    method="DELETE",
+                )
+                urllib.request.urlopen(req, timeout=10).close()
+            except Exception:
+                pass
+        for t in sub_threads:
+            t.join(timeout=5)
+        with lock:
+            counts = list(sub_counts)
+        sub_report = {
+            "subscriptions": n_subs,
+            "events_per_sub": counts,
+            "total_events": sum(counts),
+        }
     per_backend = {}
     for u, st in stats.items():
         lats = sorted(st["lats"])
@@ -1079,11 +1159,14 @@ def _load_driver_backends(args):
                 else None
             ),
         }
-    print(json.dumps({
+    report = {
         "backends": per_backend,
         "appends": appends,
         "wall_s": round(wall, 3),
-    }, indent=2))
+    }
+    if sub_report is not None:
+        report["pubsub"] = sub_report
+    print(json.dumps(report, indent=2))
 
 
 def cmd_load_driver(args):
@@ -1440,6 +1523,74 @@ def _print_cost_table(title: str, table: dict):
         )
 
 
+def cmd_subs(args):
+    """Operate on the continuous-query push tier of a running server:
+    list standing subscriptions with their delivery-cursor lag, inspect
+    one, or cancel one (``--cancel``)."""
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    if args.cancel:
+        if not args.id:
+            sys.exit("error: --cancel needs --id <subscription>")
+        doc = _fetch_json(f"{base}/stats/pubsub")
+        sub = next(
+            (s for s in doc.get("subscriptions", ())
+             if s["id"] == args.id),
+            None,
+        )
+        if sub is None:
+            sys.exit(f"error: no subscription {args.id!r}")
+        req = urllib.request.Request(
+            f"{base}/subscribe/{sub['type']}?id={args.id}",
+            method="DELETE",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            print(json.dumps(json.loads(r.read()), indent=2))
+        return
+    doc = _fetch_json(f"{base}/stats/pubsub")
+    if not doc.get("enabled", False):
+        print("(push tier disabled — the server runs without the "
+              "streaming live layer)")
+        return
+    if args.id:
+        sub = next(
+            (s for s in doc.get("subscriptions", ())
+             if s["id"] == args.id),
+            None,
+        )
+        if sub is None:
+            sys.exit(f"error: no subscription {args.id!r}")
+        print(json.dumps(sub, indent=2))
+        return
+    subs = doc.get("subscriptions", [])
+    print(
+        f"subscriptions: {len(subs)}  connections: "
+        f"{doc.get('connections', 0)}  matched batches: "
+        f"{doc.get('matched_records', 0)}  fused launches: "
+        f"{doc.get('fused_launches', 0)}"
+    )
+    if not subs:
+        return
+    print(f"\n  {'id':<14}{'type':<16}{'tenant':<14}"
+          f"{'conns':>6}{'cursor':>10}{'lag':>8}  predicate")
+    for s in subs:
+        pred = []
+        if s.get("bbox"):
+            b = s["bbox"]
+            pred.append(f"bbox[{b[0]:g},{b[1]:g},{b[2]:g},{b[3]:g}]")
+        if s.get("dwithin"):
+            d = s["dwithin"]
+            pred.append(f"dwithin({d['x']:g},{d['y']:g},{d['distance']:g})")
+        if s.get("cql"):
+            pred.append(s["cql"][:40])
+        print(
+            f"  {s['id']:<14}{s['type']:<16}{s['tenant']:<14}"
+            f"{s['connected']:>6}{s['cursor']:>10}{s['lag']:>8}  "
+            + (" AND ".join(pred) or "-")
+        )
+
+
 def cmd_ledger(args):
     """The trace family's cost view: per-tenant / per-shape top-K cost
     tables, the most expensive requests and the compile-attribution
@@ -1737,6 +1888,14 @@ def main(argv=None) -> None:
     sp.add_argument("--url", required=True,
                     help="running server base URL (e.g. http://host:port)")
 
+    sp = add("subs", cmd_subs)
+    sp.add_argument("--url", required=True,
+                    help="running server base URL (e.g. http://host:port)")
+    sp.add_argument("--id", help="inspect (or with --cancel, cancel) "
+                    "one subscription")
+    sp.add_argument("--cancel", action="store_true",
+                    help="cancel the subscription named by --id")
+
     sp = add("load-driver", cmd_load_driver)
     sp.add_argument("-f", "--feature-name", required=True)
     sp.add_argument("-q", "--cql")
@@ -1767,6 +1926,12 @@ def main(argv=None) -> None:
                     "leader (0 = reads only)")
     sp.add_argument("--append-rows", type=int, default=8,
                     help="rows per synthetic append")
+    sp.add_argument("--subscribe", type=int, default=0,
+                    help="with --backends: hold K standing "
+                    "subscriptions (SSE push streams) open through the "
+                    "load — the mixed appends+subscriptions+reads leg; "
+                    "per-subscriber match counts ride the report and "
+                    "matched-alert cost lands on the sub<k> tenants")
     _add_sched_flags(sp)
 
     sp = add("route", cmd_route)

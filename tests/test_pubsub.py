@@ -1,0 +1,798 @@
+"""Continuous queries (ISSUE 16): the geofence/alert push tier.
+
+The contracts under test:
+
+- **Registry**: bbox/CQL/dwithin predicates validate at subscribe time;
+  the registry persists through its own WAL (recovering on reopen) and
+  replicates through the ordinary ship plumbing (``apply_replicated``
+  is idempotent, gaps raise).
+- **Matcher**: every acked append batch costs exactly ONE fused join
+  launch no matter how many subscriptions are armed (launch counts are
+  counted, never trusted); residuals are exact — coarse envelope hits
+  are refined by visibility (fail closed), exact dwithin distance, and
+  full CQL evaluation.
+- **Delivery**: the WAL seq is the cursor. A resuming subscriber gets
+  replay below its watermark and live above it, exactly once; a slow
+  consumer tears down bounded (``end: overflow``) and resumes from the
+  cursor; a cursor below the compacted tail is an honest 410; a match
+  fault never un-acks the append (replay re-derives the alert).
+- **Commit gate**: under ``replica.ack=replica`` the leader holds
+  alerts until the seq is follower-applied, so a failover can never
+  void-and-reassign a seq a subscriber already acked.
+- **Failover**: the registry rides the WAL ship; a promoted follower
+  re-arms matching and a reconnecting subscriber sees zero missed and
+  zero duplicate alerts across the promotion.
+- **HTTP plane**: SSE framing (``id:`` = seq, ``:keepalive``
+  heartbeats that survive the idle-socket reaper), ``Last-Event-ID``
+  resume, negotiated arrow/bin push formats, router forwarding.
+"""
+
+import json
+import math
+import shutil
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.conf import prop_override
+from geomesa_tpu.features import FeatureBatch, SimpleFeatureType
+from geomesa_tpu.store.fs import FileSystemDataStore
+
+SPEC = "val:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def _mk_store(tmp_path, name="store"):
+    root = str(tmp_path / name)
+    ds = FileSystemDataStore(root, partition_size=128)
+    ds.create_schema("t", SPEC)
+    return root, ds
+
+
+def _cols(pts, vals=None):
+    pts = np.asarray(pts, dtype=float)
+    n = len(pts)
+    return {
+        "val": np.asarray(vals if vals is not None else range(n)),
+        "dtg": np.arange(n) + 1000,
+        "geom": pts,
+    }
+
+
+def _wait(pred, timeout_s=20.0, poll_s=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(poll_s)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(base, path, doc, timeout=30):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(doc).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _delete(base, path, timeout=30):
+    req = urllib.request.Request(base + path, method="DELETE")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _append_doc(fids, x=10.0, vals=None):
+    n = len(fids)
+    return {
+        "columns": {
+            "val": list(vals) if vals is not None else list(range(n)),
+            "dtg": [1000 + i for i in range(n)],
+            "geom": [[x, x]] * n,
+        },
+        "fids": list(fids),
+    }
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_subscription_parse_validates(tmp_path):
+    from geomesa_tpu.pubsub.registry import Subscription
+
+    _, ds = _mk_store(tmp_path)
+    sft = ds.get_schema("t")
+
+    def parse(doc):
+        return Subscription.parse(
+            "t", doc, sft, tenant="tn", auths=(), created_seq=-1
+        )
+
+    sub = parse({"bbox": [0, 0, 10, 10], "cql": "val > 5"})
+    assert sub.type_name == "t" and sub.tenant == "tn"
+    assert len(sub.sub_id) == 12
+    with pytest.raises(ValueError):
+        parse({"bbox": [10, 0, 0, 10]})  # unordered
+    with pytest.raises(ValueError):
+        parse({"bbox": [0, 0, 10]})  # not 4 numbers
+    with pytest.raises(ValueError):
+        parse({})  # at least one predicate required
+    with pytest.raises(ValueError):
+        parse({"cql": "val >"})  # unparseable ECQL
+    with pytest.raises(ValueError):
+        parse({"dwithin": {"x": 0, "y": 0}})  # missing distance
+    with pytest.raises(ValueError):
+        parse({"dwithin": {"x": 0, "y": 0, "distance": -1}})
+
+
+def test_subscription_envelope_intersects_predicates(tmp_path):
+    from geomesa_tpu.pubsub.registry import Subscription
+
+    _, ds = _mk_store(tmp_path)
+    sft = ds.get_schema("t")
+    sub = Subscription.parse(
+        "t",
+        {"bbox": [0, 0, 10, 10], "dwithin": {"x": 2, "y": 2, "distance": 1}},
+        sft, tenant="x", auths=(), created_seq=-1,
+    )
+    assert tuple(sub.envelope()) == (1.0, 1.0, 3.0, 3.0)  # bbox ∩ dwithin
+    # provably-disjoint predicates make an empty (NaN) envelope: the
+    # matcher keeps the row slot but masks it out of every result
+    empty = Subscription.parse(
+        "t",
+        {"bbox": [0, 0, 1, 1], "dwithin": {"x": 50, "y": 50, "distance": 1}},
+        sft, tenant="x", auths=(), created_seq=-1,
+    )
+    assert all(math.isnan(v) for v in empty.envelope())
+
+
+def test_registry_persists_and_recovers(tmp_path):
+    from geomesa_tpu.pubsub.registry import Subscription, SubscriptionRegistry
+
+    root, ds = _mk_store(tmp_path)
+    sft = ds.get_schema("t")
+    reg = SubscriptionRegistry(root)
+    a = Subscription.parse("t", {"bbox": [0, 0, 5, 5]}, sft,
+                           tenant="a", auths=(), created_seq=3)
+    b = Subscription.parse("t", {"cql": "val > 1"}, sft,
+                           tenant="b", auths=("secret",), created_seq=4)
+    reg.subscribe(a)
+    reg.subscribe(b)
+    assert reg.count("t") == 2
+    assert reg.unsubscribe(a.sub_id)
+    gen = reg.gen
+    reg.close()
+
+    reg2 = SubscriptionRegistry(root)
+    assert reg2.count("t") == 1
+    got = reg2.get(b.sub_id)
+    assert got is not None
+    assert got.tenant == "b" and got.auths == ("secret",)
+    assert got.created_seq == 4
+    assert reg2.gen >= gen  # layout caches keyed on gen stay invalid
+    reg2.close()
+
+
+def test_registry_apply_replicated_idempotent_and_gapless(tmp_path):
+    from geomesa_tpu.pubsub.registry import Subscription, SubscriptionRegistry
+
+    root, ds = _mk_store(tmp_path)
+    sft = ds.get_schema("t")
+    leader = SubscriptionRegistry(root)
+    s = Subscription.parse("t", {"bbox": [0, 0, 5, 5]}, sft,
+                           tenant="a", auths=(), created_seq=-1)
+    leader.subscribe(s)
+    leader.unsubscribe(s.sub_id)
+    records = list(leader.wal.read_from(-1))
+    leader.close()
+
+    froot = str(tmp_path / "follower")
+    fds = FileSystemDataStore(froot, partition_size=128)
+    fds.create_schema("t", SPEC)
+    f = SubscriptionRegistry(froot)
+    assert f.apply_replicated(*records[0]) is True
+    assert f.apply_replicated(*records[0]) is False  # idempotent re-ship
+    with pytest.raises(ValueError):
+        f.apply_replicated(records[1][0] + 5, records[1][1])  # gap
+    assert f.apply_replicated(*records[1]) is True
+    assert f.count("t") == 0  # subscribe then unsubscribe, converged
+    f.close()
+
+
+def test_registry_cap_per_type(tmp_path):
+    from geomesa_tpu.pubsub.registry import Subscription, SubscriptionRegistry
+
+    root, ds = _mk_store(tmp_path)
+    sft = ds.get_schema("t")
+    reg = SubscriptionRegistry(root)
+    with prop_override("sub.max.per.type", 2):
+        for _ in range(2):
+            reg.subscribe(Subscription.parse(
+                "t", {"bbox": [0, 0, 5, 5]}, sft,
+                tenant="a", auths=(), created_seq=-1))
+        with pytest.raises(ValueError):
+            reg.subscribe(Subscription.parse(
+                "t", {"bbox": [0, 0, 5, 5]}, sft,
+                tenant="a", auths=(), created_seq=-1))
+    reg.close()
+
+
+# -- matcher + in-process delivery -------------------------------------------
+
+
+@pytest.fixture
+def hub_env(tmp_path):
+    from geomesa_tpu.pubsub import PubSubHub
+    from geomesa_tpu.store.stream import StreamingStore
+
+    root, ds = _mk_store(tmp_path)
+    layer = StreamingStore(ds)
+    hub = PubSubHub(layer)
+    yield layer, hub
+    hub.close()
+    layer.close()
+
+
+def _take_matches(hub, sub_id, from_seq, want, heartbeat_s=0.05,
+                  timeout_s=15.0):
+    """Drive the events generator until `want` match events arrived."""
+    out = []
+    gen = hub.events("t", sub_id, from_seq, heartbeat_s)
+    deadline = time.monotonic() + timeout_s
+    try:
+        for ev in gen:
+            if ev[0] == "match":
+                out.append(ev)
+                if len(out) >= want:
+                    break
+            assert time.monotonic() < deadline, (
+                f"only {len(out)}/{want} matches before timeout"
+            )
+    finally:
+        gen.close()
+    return out
+
+
+def test_one_fused_launch_per_batch_regardless_of_subs(hub_env):
+    layer, hub = hub_env
+    rng = np.random.default_rng(7)
+    for k in range(16):
+        x, y = float(rng.uniform(-170, 150)), float(rng.uniform(-80, 60))
+        hub.subscribe("t", {"bbox": [x, y, x + 15, y + 15]},
+                      tenant=f"t{k}", auths=None)
+    base = hub.matcher.launches
+    for b in range(5):
+        layer.append("t", _cols(rng.uniform(-90, 90, size=(32, 2))),
+                     fids=np.arange(b * 32, b * 32 + 32))
+    assert hub.matcher.launches - base == 5
+    assert hub.matched_records == 5
+
+
+def test_residuals_bbox_cql_dwithin_exact(hub_env):
+    layer, hub = hub_env
+    s_box = hub.subscribe("t", {"bbox": [0, 0, 10, 10]},
+                          tenant="a", auths=None)
+    s_cql = hub.subscribe("t", {"bbox": [0, 0, 10, 10], "cql": "val > 50"},
+                          tenant="b", auths=None)
+    s_dw = hub.subscribe("t", {"dwithin": {"x": 0, "y": 0, "distance": 1.0}},
+                         tenant="c", auths=None)
+    # fid 0: in bbox, val low.  fid 1: in bbox, val high.  fid 2: far.
+    # fid 3: inside the dwithin BOX corner but outside the exact radius.
+    # fid 4: inside the radius.
+    layer.append(
+        "t",
+        _cols([[5, 5], [6, 6], [120, 40], [0.9, 0.9], [0.5, 0.0]],
+              vals=[10, 90, 90, 0, 0]),
+        fids=np.arange(5),
+    )
+    got_box = _take_matches(hub, s_box["id"], -1, 1)
+    assert sorted(got_box[0][2].fids.tolist()) == [0, 1, 3, 4]
+    got_cql = _take_matches(hub, s_cql["id"], -1, 1)
+    assert got_cql[0][2].fids.tolist() == [1]  # 0 killed by the residual
+    got_dw = _take_matches(hub, s_dw["id"], -1, 1)
+    # 3 survives the coarse envelope but hypot(.9,.9)≈1.27 > 1.0 exact
+    assert got_dw[0][2].fids.tolist() == [4]
+
+
+def test_visibility_residual_fails_closed(hub_env):
+    layer, hub = hub_env
+    s_none = hub.subscribe("t", {"bbox": [0, 0, 10, 10]},
+                           tenant="a", auths=None)
+    s_auth = hub.subscribe("t", {"bbox": [0, 0, 10, 10]},
+                           tenant="b", auths=("secret",))
+    sft = layer.store.get_schema("t")
+    batch = FeatureBatch.from_columns(
+        sft, _cols([[5, 5], [6, 6]]), fids=np.arange(2)
+    ).with_visibility(["", "secret"])
+    layer.append("t", batch)
+    got = _take_matches(hub, s_none["id"], -1, 1)
+    assert got[0][2].fids.tolist() == [0]  # labeled row hidden, no auths
+    got = _take_matches(hub, s_auth["id"], -1, 1)
+    assert sorted(got[0][2].fids.tolist()) == [0, 1]
+
+
+def test_exactly_once_resume_across_disconnect(hub_env):
+    layer, hub = hub_env
+    sub = hub.subscribe("t", {"bbox": [0, 0, 20, 20]},
+                        tenant="a", auths=None)
+    layer.append("t", _cols([[5, 5]]), fids=[0])
+    first = _take_matches(hub, sub["id"], sub["cursor"], 1)
+    assert first[0][1] == 0  # seq rides the event
+    cursor = first[0][1]
+    # away: two more batches land while nothing is connected
+    layer.append("t", _cols([[6, 6]]), fids=[1])
+    layer.append("t", _cols([[7, 7]]), fids=[2])
+    resumed = _take_matches(hub, sub["id"], cursor, 2)
+    assert [ev[1] for ev in resumed] == [1, 2]  # no seq 0 replay, no gap
+    assert [ev[2].fids.tolist() for ev in resumed] == [[1], [2]]
+
+
+def test_slow_consumer_overflow_teardown(hub_env):
+    layer, hub = hub_env
+    sub = hub.subscribe("t", {"bbox": [0, 0, 20, 20]},
+                        tenant="a", auths=None)
+    with prop_override("sub.queue.events", 3):
+        gen = hub.events("t", sub["id"], sub["cursor"], 0.05)
+        assert next(gen)[0] == "heartbeat"  # connected, queue armed
+        for i in range(6):  # 2x the queue bound, nothing consuming
+            layer.append("t", _cols([[5, 5]]), fids=[i])
+        ended = None
+        for ev in gen:
+            if ev[0] == "end":
+                ended = ev
+                break
+        assert ended == ("end", "overflow")
+        gen.close()
+    # the cursor survives the teardown: a reconnect replays everything
+    replay = _take_matches(hub, sub["id"], sub["cursor"], 6)
+    assert [ev[1] for ev in replay] == list(range(6))
+
+
+def test_match_fault_never_unacks_append(hub_env):
+    from geomesa_tpu.failpoints import failpoint_override
+
+    layer, hub = hub_env
+    sub = hub.subscribe("t", {"bbox": [0, 0, 20, 20]},
+                        tenant="a", auths=None)
+    with failpoint_override("fail.sub.match", "raise:1"):
+        out = layer.append("t", _cols([[5, 5]]), fids=[0])
+    assert out["rows"] == 1  # the append acked despite the match fault
+    assert hub.match_faults == 1
+    # the cursor replay re-derives the alert the live path dropped
+    replay = _take_matches(hub, sub["id"], sub["cursor"], 1)
+    assert replay[0][1] == 0 and replay[0][2].fids.tolist() == [0]
+
+
+def test_retention_floor_pins_then_ages_out(hub_env):
+    layer, hub = hub_env
+    sub = hub.subscribe("t", {"bbox": [0, 0, 20, 20]},
+                        tenant="a", auths=None)
+    layer.append("t", _cols([[5, 5]]), fids=[0])
+    # never-connected: pinned at the creation seq while within retain.s
+    assert hub.retention_floor("t") == sub["cursor"]
+    got = _take_matches(hub, sub["id"], sub["cursor"], 1)
+    # disconnected at watermark 0: still pinned there…
+    assert got[0][1] == 0
+    assert hub.retention_floor("t") == 0
+    with prop_override("sub.retain.s", 0.05):
+        time.sleep(0.12)
+        assert hub.retention_floor("t") is None  # …until it ages out
+
+
+def test_cursor_gone_detected(hub_env, monkeypatch):
+    from geomesa_tpu.pubsub import CursorGoneError
+
+    layer, hub = hub_env
+    sub = hub.subscribe("t", {"bbox": [0, 0, 20, 20]},
+                        tenant="a", auths=None)
+    for i in range(3):
+        layer.append("t", _cols([[5, 5]]), fids=[i])
+    wal = layer._ts("t").wal
+    monkeypatch.setattr(wal, "first_seq", lambda: 2)  # compacted past 0,1
+    with pytest.raises(CursorGoneError):
+        next(hub.events("t", sub["id"], 0, 0.05))
+    # at-or-above the retained tail is fine
+    gen = hub.events("t", sub["id"], 1, 0.05)
+    assert next(gen)[0] == "match"
+    gen.close()
+
+
+def test_commit_gate_holds_alerts_until_floor_advances(hub_env):
+    layer, hub = hub_env
+    sub = hub.subscribe("t", {"bbox": [0, 0, 20, 20]},
+                        tenant="a", auths=None)
+    floor = [-1]
+    hub.commit_gate = lambda type_name: floor[0]
+    gen = hub.events("t", sub["id"], sub["cursor"], 0.05)
+    assert next(gen)[0] == "heartbeat"
+    layer.append("t", _cols([[5, 5]]), fids=[0])
+    # matched but NOT replication-durable: held, not delivered
+    assert next(gen)[0] == "heartbeat"
+    assert hub.stats()["commit_pending"] == 1
+    # a subscriber connecting NOW must not replay the pending seq either
+    gen2 = hub.events("t", sub["id"], -1, 0.05)
+    assert next(gen2)[0] == "heartbeat"
+    floor[0] = 0
+    hub.commit_advanced("t")
+    # both connections get the flushed alert exactly once
+    assert next(gen)[0:2] == ("match", 0)
+    assert next(gen2)[0:2] == ("match", 0)
+    assert next(gen)[0] == "heartbeat"
+    assert hub.stats()["commit_pending"] == 0
+    gen.close()
+    gen2.close()
+
+
+# -- HTTP plane ---------------------------------------------------------------
+
+
+class _SSEReader:
+    """Background SSE consumer: collects (seq, fids) match events,
+    keepalive counts, and end reasons; reconnects are the caller's job
+    (one reader = one connection, like a real client socket)."""
+
+    def __init__(self, base, sub_id, from_seq=None, type_name="t"):
+        import threading
+
+        url = f"{base}/subscribe/{type_name}?id={sub_id}"
+        if from_seq is not None:
+            url += f"&from={from_seq}"
+        self.matches: list = []
+        self.keepalives = 0
+        self.ends: list = []
+        self.error = None
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, args=(url,),
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self, url):
+        try:
+            self._resp = urllib.request.urlopen(url, timeout=30)
+            buf = b""
+            while not self._stop:
+                chunk = self._resp.read1(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n\n" in buf:
+                    frame, buf = buf.split(b"\n\n", 1)
+                    self._frame(frame)
+        except Exception as e:  # noqa: BLE001 - surfaced via .error
+            self.error = e
+
+    def _frame(self, frame):
+        if frame.startswith(b":keepalive"):
+            self.keepalives += 1
+            return
+        if b"event: end" in frame:
+            for ln in frame.split(b"\n"):
+                if ln.startswith(b"data: "):
+                    self.ends.append(json.loads(ln[6:]))
+            return
+        if b"event: match" in frame:
+            seq, fids = None, []
+            for ln in frame.split(b"\n"):
+                if ln.startswith(b"id: "):
+                    seq = int(ln[4:])
+                elif ln.startswith(b"data: "):
+                    doc = json.loads(ln[6:])
+                    fids = [int(f["id"]) for f in doc["features"]]
+                    assert doc["seq"] == seq  # body and cursor agree
+            self.matches.append((seq, fids))
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._resp.close()
+        except Exception:
+            pass
+        self._thread.join(10)
+
+
+@pytest.fixture
+def http_server(tmp_path):
+    from geomesa_tpu.server import serve_background
+
+    root, _ = _mk_store(tmp_path)
+    with prop_override("sub.heartbeat.s", 0.2), \
+            prop_override("http.keepalive.s", 0.5):
+        srv, _ = serve_background(
+            FileSystemDataStore(root, partition_size=128), stream=True,
+        )
+        base = "http://%s:%s" % srv.server_address[:2]
+        yield base, srv
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_http_subscribe_stream_and_cancel(http_server):
+    base, srv = http_server
+    sub = _post(base, "/subscribe/t?tenant=alice",
+                {"bbox": [0, 0, 20, 20], "cql": "val > 5"})
+    assert sub["type"] == "t" and sub["cursor"] == -1
+    rd = _SSEReader(base, sub["id"])
+    try:
+        out = _post(base, "/append/t", _append_doc([7, 8], x=10.0,
+                                                   vals=[3, 9]))
+        assert out["acked"] == 2
+        _wait(lambda: rd.matches, msg="live SSE match")
+        assert rd.matches == [(out["seq"], [8])]  # val=3 residual-killed
+        st = _get(base, "/stats/pubsub")
+        assert st["enabled"] and st["connections"] == 1
+        (doc,) = st["subscriptions"]
+        assert doc["tenant"] == "alice" and doc["connected"] == 1
+        assert doc["cursor"] == out["seq"] and doc["lag"] == 0
+        assert _get(base, "/stats")["pubsub"]["enabled"]
+        assert _delete(base, f"/subscribe/t?id={sub['id']}")["cancelled"]
+        _wait(lambda: rd.ends, msg="end frame after cancel")
+        assert rd.ends[0]["reason"] == "cancelled"
+    finally:
+        rd.stop()
+
+
+def test_http_heartbeats_outlive_idle_socket_reaper(http_server):
+    """Satellite regression: ``http.keepalive.s`` (0.5s here) reaps
+    idle keep-alive sockets, but a quiet subscription stream must NOT
+    be torn down — the handler exempts itself and emits ``:keepalive``
+    comments every ``sub.heartbeat.s`` instead."""
+    base, _ = http_server
+    sub = _post(base, "/subscribe/t", {"bbox": [0, 0, 20, 20]})
+    rd = _SSEReader(base, sub["id"])
+    try:
+        time.sleep(1.6)  # > 3x the idle reap timeout, zero traffic
+        assert rd.error is None
+        assert rd.keepalives >= 3  # the stream stayed warm, audibly
+        out = _post(base, "/append/t", _append_doc([1]))
+        _wait(lambda: rd.matches, msg="match after the quiet window")
+        assert rd.matches == [(out["seq"], [1])]
+    finally:
+        rd.stop()
+
+
+def test_http_resume_from_cursor_and_last_event_id(http_server):
+    base, _ = http_server
+    sub = _post(base, "/subscribe/t", {"bbox": [0, 0, 20, 20]})
+    seqs = [_post(base, "/append/t", _append_doc([i]))["seq"]
+            for i in range(3)]
+    rd = _SSEReader(base, sub["id"], from_seq=seqs[0])
+    try:
+        _wait(lambda: len(rd.matches) == 2, msg="replay above the cursor")
+        assert [s for s, _ in rd.matches] == seqs[1:]
+    finally:
+        rd.stop()
+    # Last-Event-ID carries the cursor when the query param is absent
+    req = urllib.request.Request(
+        f"{base}/subscribe/t?id={sub['id']}",
+        headers={"Last-Event-ID": str(seqs[1])},
+    )
+    resp = urllib.request.urlopen(req, timeout=30)
+    try:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        buf = b""
+        while b"event: match" not in buf:
+            buf += resp.read1(4096)
+        assert f"id: {seqs[2]}".encode() in buf
+    finally:
+        resp.close()
+
+
+def test_http_cursor_gone_is_410(http_server, monkeypatch):
+    base, srv = http_server
+    sub = _post(base, "/subscribe/t", {"bbox": [0, 0, 20, 20]})
+    for i in range(3):
+        _post(base, "/append/t", _append_doc([i]))
+    wal = srv.pubsub.stream._ts("t").wal
+    monkeypatch.setattr(wal, "first_seq", lambda: 2)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"{base}/subscribe/t?id={sub['id']}&from=0", timeout=30)
+    assert ei.value.code == 410
+    ei.value.close()
+
+
+def test_http_push_formats_negotiated(http_server):
+    base, _ = http_server
+    sub = _post(base, "/subscribe/t", {"bbox": [0, 0, 20, 20]})
+    _post(base, "/append/t", _append_doc([1, 2]))
+    for fmt, ctype in (
+        ("arrow", "application/vnd.apache.arrow.stream"),
+        ("bin", "application/vnd.geomesa.bin"),
+    ):
+        resp = urllib.request.urlopen(
+            f"{base}/subscribe/t?id={sub['id']}&from=-1&f={fmt}",
+            timeout=30,
+        )
+        try:
+            assert resp.headers["Content-Type"] == ctype
+            assert len(resp.read1(65536)) > 0  # replayed batch framed
+        finally:
+            resp.close()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"{base}/subscribe/t?id={sub['id']}&f=nope", timeout=30)
+    assert ei.value.code == 400
+    ei.value.close()
+
+
+def test_http_subscribe_errors(http_server):
+    base, _ = http_server
+    for path, doc, code in (
+        ("/subscribe/missing", {"bbox": [0, 0, 1, 1]}, 404),
+        ("/subscribe/t", {}, 400),
+        ("/subscribe/t", {"bbox": [9, 9, 0, 0]}, 400),
+    ):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, path, doc)
+        assert ei.value.code == code
+        ei.value.close()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{base}/subscribe/t?id=nope", timeout=30)
+    assert ei.value.code == 404
+    ei.value.close()
+
+
+def test_subs_cli_lists_and_cancels(http_server, capsys):
+    from geomesa_tpu.tools.cli import main
+
+    base, _ = http_server
+    sub = _post(base, "/subscribe/t?tenant=ops",
+                {"bbox": [0, 0, 20, 20], "cql": "val > 5"})
+    main(["subs", "--url", base])
+    out = capsys.readouterr().out
+    assert sub["id"] in out and "ops" in out and "val > 5" in out
+    main(["subs", "--url", base, "--id", sub["id"], "--cancel"])
+    capsys.readouterr()
+    assert _get(base, "/stats/pubsub")["subscriptions"] == []
+
+
+# -- replication + failover ---------------------------------------------------
+
+
+@pytest.fixture
+def pair(tmp_path):
+    """Leader + follower on copied roots with fast replication knobs,
+    mirroring tests/test_replica.py's pair."""
+    from geomesa_tpu.replica import ReplicaConfig
+    from geomesa_tpu.server import serve_background
+
+    lroot, ds = _mk_store(tmp_path, "leader")
+    ds.write("t", _cols([[10, 10]] * 4), fids=np.arange(4))
+    ds.flush("t")
+    del ds
+    froot = str(tmp_path / "follower")
+    shutil.copytree(lroot, froot)
+    with prop_override("replica.lease.s", 1.5), \
+            prop_override("replica.poll.ms", 25.0), \
+            prop_override("replica.failover.s", 8.0), \
+            prop_override("sub.heartbeat.s", 0.2):
+        lsrv, _ = serve_background(
+            FileSystemDataStore(lroot, partition_size=128),
+            stream=True, replica=ReplicaConfig(role="leader"),
+        )
+        lbase = "http://%s:%s" % lsrv.server_address[:2]
+        fsrv, _ = serve_background(
+            FileSystemDataStore(froot, partition_size=128),
+            stream=True,
+            replica=ReplicaConfig(role="follower", leader_url=lbase),
+        )
+        fbase = "http://%s:%s" % fsrv.server_address[:2]
+        yield lbase, fbase, lsrv, fsrv
+        for s in (lsrv, fsrv):
+            try:
+                s.shutdown()
+                s.server_close()
+            except Exception:
+                pass
+
+
+def test_registry_replicates_and_follower_bounces_writes(pair):
+    lbase, fbase, _, _ = pair
+    sub = _post(lbase, "/subscribe/t", {"bbox": [0, 0, 20, 20]})
+    _wait(
+        lambda: [d["id"] for d in
+                 _get(fbase, "/stats/pubsub")["subscriptions"]] == [sub["id"]],
+        msg="registry record shipped to the follower",
+    )
+    # subscription writes are leader-pinned exactly like appends
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(fbase, "/subscribe/t", {"bbox": [0, 0, 1, 1]})
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read())["leader"] == lbase
+    ei.value.close()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _delete(fbase, f"/subscribe/t?id={sub['id']}")
+    assert ei.value.code == 503
+    ei.value.close()
+    # cancel on the leader converges the follower's registry too
+    assert _delete(lbase, f"/subscribe/t?id={sub['id']}")["cancelled"]
+    _wait(
+        lambda: _get(fbase, "/stats/pubsub")["subscriptions"] == [],
+        msg="unsubscribe shipped to the follower",
+    )
+
+
+def test_commit_gate_armed_under_replica_ack(pair):
+    lbase, fbase, _, _ = pair
+    with prop_override("replica.ack", "replica"):
+        sub = _post(lbase, "/subscribe/t", {"bbox": [0, 0, 20, 20]})
+        assert _get(lbase, "/stats/pubsub")["commit_gated"]
+        rd = _SSEReader(lbase, sub["id"])
+        try:
+            out = _post(lbase, "/append/t", _append_doc([50]))
+            assert out["replicated"] is True
+            # delivered only AFTER the follower applied the record
+            _wait(lambda: rd.matches, msg="gated alert after follower ack")
+            assert rd.matches == [(out["seq"], [50])]
+            assert _get(lbase, "/stats/pubsub")["commit_pending"] == 0
+        finally:
+            rd.stop()
+
+
+def test_failover_rearm_zero_missed_zero_duplicate(pair):
+    lbase, fbase, lsrv, _ = pair
+    sub = _post(lbase, "/subscribe/t", {"bbox": [0, 0, 20, 20]})
+    delivered: list = []
+    seqs = [_post(lbase, "/append/t", _append_doc([100 + i]))["seq"]
+            for i in range(3)]
+    rd = _SSEReader(lbase, sub["id"], from_seq=sub["cursor"])
+    try:
+        _wait(lambda: len(rd.matches) == 3, msg="pre-failover delivery")
+        delivered += rd.matches
+    finally:
+        rd.stop()
+    cursor = delivered[-1][0]
+    # the follower must hold everything acked before the leader dies
+    _wait(lambda: _get(fbase, "/count/t")["count"] == 7,
+          msg="follower caught up pre-kill")
+    _wait(
+        lambda: [d["id"] for d in
+                 _get(fbase, "/stats/pubsub")["subscriptions"]] == [sub["id"]],
+        msg="registry shipped pre-kill",
+    )
+    lsrv.socket.close()  # abrupt leader death, no drain
+    lsrv.shutdown()
+    _wait(lambda: _get(fbase, "/stats/replica")["role"] == "leader",
+          timeout_s=30, msg="promotion")
+    st = _get(fbase, "/stats/pubsub")
+    assert st["rearms"] == 1  # note_promoted re-armed the matcher
+    assert [d["id"] for d in st["subscriptions"]] == [sub["id"]]
+    # resume on the NEW leader from the acked cursor, then append more
+    rd = _SSEReader(fbase, sub["id"], from_seq=cursor)
+    try:
+        seqs += [_post(fbase, "/append/t", _append_doc([200 + i]))["seq"]
+                 for i in range(2)]
+        _wait(lambda: len(rd.matches) == 2, msg="post-failover delivery")
+        delivered += rd.matches
+    finally:
+        rd.stop()
+    got = [s for s, _ in delivered]
+    assert got == seqs  # zero missed, zero duplicate, in order
+    assert len(set(got)) == len(got)
+
+
+def test_router_forwards_subscription_writes_to_leader(pair):
+    from geomesa_tpu.router import route_background
+
+    lbase, fbase, _, _ = pair
+    with prop_override("router.health.ms", 100.0):
+        rsrv, _ = route_background([lbase, fbase])
+        rbase = "http://%s:%s" % rsrv.server_address[:2]
+        try:
+            sub = _post(rbase, "/subscribe/t", {"bbox": [0, 0, 20, 20]})
+            assert [d["id"] for d in
+                    _get(lbase, "/stats/pubsub")["subscriptions"]] \
+                == [sub["id"]]
+            assert _delete(rbase, f"/subscribe/t?id={sub['id']}")["cancelled"]
+            assert _get(lbase, "/stats/pubsub")["subscriptions"] == []
+        finally:
+            rsrv.shutdown()
+            rsrv.server_close()

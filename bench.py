@@ -4711,6 +4711,190 @@ def bench_multichip(args) -> dict:
     return res
 
 
+def _coldstart_store(n: int):
+    """GDELT-shaped MemoryDataStore both coldstart children rebuild
+    identically (seeded): same data, same shapes, same jit keys."""
+    import numpy as np
+
+    from geomesa_tpu.store.memory import MemoryDataStore
+
+    ds = MemoryDataStore()
+    ds.create_schema("gdelt", "name:String,dtg:Date,*geom:Point:srid=4326")
+    rng = np.random.default_rng(17)
+    t0 = np.datetime64("2020-01-01T00:00:00", "ms").astype(np.int64)
+    ds.write("gdelt", {
+        "name": rng.choice(["a", "b", "c"], n),
+        "dtg": t0 + rng.integers(0, 10**8, n),
+        "geom": np.stack(
+            [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)], axis=1
+        ),
+    }, fids=np.arange(n))
+    return ds
+
+
+def _bench_coldstart_child(args) -> dict:
+    """One coldstart measurement leg, run in a FRESH process: stage a
+    resident index, optionally AOT-warm it (--coldstart-child warm),
+    then time the FIRST serving call of every base kernel-family leg
+    (the warmup_plan enumeration IS the serving surface) plus a short
+    steady-state p50 per leg. The compile ledger is reset between
+    warmup and serving, so ``serving_compiles`` is exactly the number
+    of XLA compiles the serving path paid — the warmed child must
+    report 0 (the fleet warm-handoff guarantee, scored the same way
+    a restarted node is scored against /stats/ledger)."""
+    import time as _time
+    from statistics import median
+
+    from geomesa_tpu import ledger, warmup
+    from geomesa_tpu.device_cache import DeviceIndex
+
+    n = args.n or ((1 << 14) if args.smoke else (1 << 18))
+    ds = _coldstart_store(n)
+    t0 = _time.perf_counter()
+    di = DeviceIndex(ds, "gdelt", z_planes=True)
+    di.count("INCLUDE")  # force staging before the clock starts
+    stage_s = _time.perf_counter() - t0
+    wdoc = None
+    if args.coldstart_child == "warm":
+        wdoc = warmup.run({"gdelt": di})
+    legs = di.warmup_plan()  # the base kernel-family serving surface
+    ledger.COMPILES.reset()
+    first_ms: dict = {}
+    for name, fn in legs:
+        t = _time.perf_counter()
+        fn()
+        first_ms[name] = round((_time.perf_counter() - t) * 1e3, 3)
+    reps = 3 if args.smoke else 7
+    steady: dict = {}
+    for name, fn in legs:
+        ts = []
+        for _ in range(reps):
+            t = _time.perf_counter()
+            fn()
+            ts.append(_time.perf_counter() - t)
+        steady[name] = round(median(ts) * 1e3, 3)
+    comp = ledger.COMPILES.snapshot()
+    return {
+        "leg": args.coldstart_child,
+        "n": n,
+        "stage_s": round(stage_s, 3),
+        "first_ms": first_ms,
+        "steady_p50_ms": steady,
+        "serving_compiles": comp["compiles"],
+        "serving_compile_s": comp["total_s"],
+        "warmup": wdoc,
+    }
+
+
+def bench_coldstart(args) -> dict:
+    """The compile-cliff scenario bench (--mode coldstart): two fresh
+    subprocesses share one initially-EMPTY persistent compile cache.
+    The ``cold`` child serves with no warmup — its first-query p100
+    per kernel family is the cliff (and its compiles populate the
+    cache, exactly what a prior deploy's process does). The ``warm``
+    child then models the rolling-restart handoff: AOT warmup (warming
+    from the now-primed cache) before serving. Guards: warmed
+    first-query latency must stay under ``slo.coldstart.threshold.ms``
+    AND within 2x the leg's warm steady-state p50 (with a small
+    absolute floor for host dispatch jitter), and the warmed child's
+    serving path must attribute ZERO compiles in the ledger."""
+    if getattr(args, "coldstart_child", None):
+        return _bench_coldstart_child(args)
+    import os
+    import subprocess
+    import tempfile
+
+    from geomesa_tpu.conf import sys_prop
+
+    cache = tempfile.mkdtemp(prefix="geomesa-coldstart-xla-")
+
+    def child(leg: str) -> dict:
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            "--mode", "coldstart", "--coldstart-child", leg,
+        ]
+        if args.n:
+            cmd += ["--n", str(args.n)]
+        if args.smoke:
+            cmd += ["--smoke"]
+        env = dict(os.environ, GEOMESA_TPU_COMPILE_CACHE=cache)
+        out = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=3600, env=env
+        )
+        sys.stderr.write(out.stderr[-3000:])
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"coldstart {leg} child failed: {out.stderr[-500:]}"
+            )
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    log("coldstart: cold child (no warmup, empty persistent cache)")
+    cold = child("cold")
+    log("coldstart: warm child (AOT warmup from the primed cache)")
+    warm = child("warm")
+
+    thresh_ms = float(sys_prop("slo.coldstart.threshold.ms"))
+    # absolute floor under the 2x-steady guard: at CPU-smoke scale a
+    # steady p50 is single-digit ms and host scheduling jitter alone
+    # can double a first call — sub-100ms "regressions" are noise, not
+    # compile cliffs (a compile is 3-5 orders of magnitude, not 2x)
+    floor_ms = 100.0
+    violations: list = []
+    for fam, wf in warm["first_ms"].items():
+        sp50 = float(warm["steady_p50_ms"].get(fam, 0.0))
+        if wf > thresh_ms:
+            violations.append(
+                f"{fam}: warmed first query {wf}ms exceeds "
+                f"slo.coldstart.threshold.ms={thresh_ms}"
+            )
+        if wf > max(2.0 * sp50, floor_ms):
+            violations.append(
+                f"{fam}: warmed first query {wf}ms > 2x steady p50 "
+                f"{sp50}ms"
+            )
+    if int(warm.get("serving_compiles", 0)) != 0:
+        violations.append(
+            "warmed serving path paid "
+            f"{warm['serving_compiles']} compiles (ledger attribution "
+            "must be 0 — the warmup plan missed a serving signature)"
+        )
+    cliff = {
+        fam: round(
+            float(cold["first_ms"][fam])
+            / max(float(warm["steady_p50_ms"].get(fam, 0.0)), 0.1),
+            1,
+        )
+        for fam in cold["first_ms"]
+    }
+    worst = max(cliff, key=cliff.get) if cliff else None
+    log(
+        "coldstart: worst cliff "
+        f"{worst}: {cold['first_ms'].get(worst)}ms cold first vs "
+        f"{warm['steady_p50_ms'].get(worst)}ms warm steady "
+        f"({cliff.get(worst)}x); warmed first-query p100 "
+        f"{max(warm['first_ms'].values())}ms, serving compiles "
+        f"cold={cold['serving_compiles']} warm={warm['serving_compiles']}"
+    )
+    out = {
+        "coldstart_n": cold["n"],
+        "coldstart_cold_first_ms": cold["first_ms"],
+        "coldstart_cold_serving_compiles": cold["serving_compiles"],
+        "coldstart_warm_first_ms": warm["first_ms"],
+        "coldstart_warm_first_p100_ms": max(warm["first_ms"].values()),
+        "coldstart_warm_steady_p50_ms": warm["steady_p50_ms"],
+        "coldstart_warm_serving_compiles": warm["serving_compiles"],
+        "coldstart_warmup": warm.get("warmup"),
+        "coldstart_cliff_x": cliff,
+        "coldstart_threshold_ms": thresh_ms,
+        "coldstart_violations": violations,
+    }
+    if violations:
+        raise AssertionError(
+            "coldstart SLO violated:\n  " + "\n  ".join(violations)
+        )
+    return out
+
+
 def _run_mode_subprocess(mode: str, n=None, check=False, timeout=3600):
     """Run one bench mode in a FRESH process and return its JSON dict.
 
@@ -4809,6 +4993,12 @@ def main() -> None:
         "re-run with the same seed to reproduce a failing schedule)",
     )
     ap.add_argument(
+        "--coldstart-child",
+        choices=("cold", "warm"),
+        help=argparse.SUPPRESS,  # internal: one coldstart measurement
+        # leg in a fresh process (bench_coldstart spawns these)
+    )
+    ap.add_argument(
         "--engine",
         choices=("pallas", "xla"),
         default="pallas",
@@ -4820,7 +5010,7 @@ def main() -> None:
             "all", "filter", "zscan", "build", "polygon", "density", "sweep",
             "xzbuild", "meshbuild", "multichip", "pipeline", "oocscan",
             "join", "serve", "flush", "stream", "results", "replica",
-            "soak", "pubsub",
+            "soak", "pubsub", "coldstart",
         ),
         default="all",
         help="all: every benchmark, one JSON line with everything (what "
@@ -4881,6 +5071,8 @@ def main() -> None:
         out = bench_soak(args)
     elif args.mode == "pubsub":
         out = bench_pubsub(args)
+    elif args.mode == "coldstart":
+        out = bench_coldstart(args)
     else:
         # zscan FIRST: its DeviceIndex staging is a long sequence of
         # host->device transfers that measures 20-30x slower when another

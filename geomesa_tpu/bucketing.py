@@ -1,0 +1,78 @@
+"""Canonical compile-shape bucketing: the conf-declared capacity ladder.
+
+Every dynamic request dimension that becomes an XLA trace shape —
+z-range count, fused micro-batch width, kNN ``k``, window capacity,
+join candidate buckets, density canvas capacity, streaming delta pads —
+rounds UP onto one process-wide geometric ladder before it reaches a
+``jax.jit`` cache key, with validity masking (never-match padding, tail
+slicing) keeping results bit-identical to unbucketed execution
+(tests/test_bucket_parity.py proves this across the matrix). A small
+closed ladder is what makes the compile cliff killable at all: the
+warmup plan (:mod:`geomesa_tpu.warmup`) can ENUMERATE bucket x
+kernel-family signatures and pre-compile the lot at server start, and
+ROADMAP item 5's result cache gets canonical shapes as cache keys.
+
+Two GT008-declared knobs shape the ladder:
+
+- ``compile.bucket.growth`` — geometric ratio between rungs. The
+  default 2.0 reproduces the historical next-power-of-two behavior
+  exactly (every pre-existing jit key is unchanged). Values in (1, 2)
+  trade more rungs (more distinct compiles) for less padding waste;
+  values <= 1 DISABLE bucketing (the cap is the exact size — the
+  parity suite's unbucketed oracle).
+- ``compile.bucket.min`` — the smallest rung (floor of the ladder).
+
+The ladder is pure host arithmetic (no jax import): a rung is
+``max(ceil(prev * growth), prev + 1)`` starting at the floor, so any
+growth > 1 yields a strictly increasing integer ladder with
+O(log n / log growth) rungs below any capacity.
+"""
+
+from __future__ import annotations
+
+__all__ = ["bucket_cap", "ladder", "ladder_params"]
+
+
+def ladder_params() -> "tuple[float, int]":
+    """(growth, min_rung) from the ``compile.bucket.*`` conf keys."""
+    from geomesa_tpu.conf import sys_prop
+
+    growth = float(sys_prop("compile.bucket.growth"))
+    mn = max(int(sys_prop("compile.bucket.min")), 1)
+    return growth, mn
+
+
+def bucket_cap(n: int, floor: int = 1) -> int:
+    """Smallest ladder rung >= max(n, floor, 1).
+
+    With the default ladder (growth 2.0, min 1) this is exactly the
+    next power of two — the shape every dispatch site used before the
+    ladder was declared — so default deployments mint the same jit keys
+    they always did. With ``compile.bucket.growth <= 1`` bucketing is
+    off and the exact size comes back (one compile per distinct shape:
+    the parity oracle, never the serving configuration).
+    """
+    n = max(int(n), int(floor), 1)
+    growth, v = ladder_params()
+    if growth <= 1.0:
+        return n
+    while v < n:
+        v = max(int(-(-v * growth // 1)), v + 1)  # ceil, strictly up
+    return v
+
+
+def ladder(limit: int, floor: int = 1) -> "list[int]":
+    """Every ladder rung in [floor, bucket_cap(limit)] — the closed
+    bucket set the warmup plan enumerates for a dimension bounded by
+    ``limit`` (e.g. kNN k up to ``compile.warmup.knn.kmax``, fusion
+    width up to ``sched.max.fusion``)."""
+    limit = max(int(limit), 1)
+    growth, v = ladder_params()
+    v = max(v, max(int(floor), 1))
+    if growth <= 1.0:
+        return [limit]
+    out = [v]
+    while v < limit:
+        v = max(int(-(-v * growth // 1)), v + 1)
+        out.append(v)
+    return out

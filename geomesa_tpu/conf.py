@@ -110,6 +110,31 @@ Properties:
                                 ~/.cache default; ``off`` disables) —
                                 wired at make_server / CLI serve start,
                                 hit/miss surfaced in /stats
+- ``compile.bucket.growth``     geometric ratio of the canonical
+                                compile-shape ladder (bucketing.py)
+                                every dynamic trace shape rounds up
+                                onto; 2.0 (default) = next power of
+                                two, <= 1 disables bucketing (the
+                                parity-test oracle)
+- ``compile.bucket.min``        smallest ladder rung
+- ``compile.warmup.enabled``    AOT warmup master switch (warmup.py):
+                                pre-compile the closed bucket x
+                                kernel-family signature set at server
+                                start (``serve --resident --warm``)
+- ``compile.warmup.gate``       /readyz behavior while warmup runs:
+                                ``ready`` (default) holds 503 until
+                                warm — a fleet rolling restart then
+                                never routes to a cold process;
+                                ``stamp`` serves immediately but
+                                stamps ``warming``; ``off`` hides
+                                warmup from readiness
+- ``compile.warmup.threads``    bounded background compile pool size
+- ``compile.warmup.knn.kmax``   largest kNN k the warmup k-ladder
+                                pre-compiles
+- ``slo.coldstart.threshold.ms``  the cold-start SLO: bench.py
+                                ``--mode coldstart`` fails if a WARMED
+                                first query per kernel family answers
+                                over this bar
 - ``slo.enabled``               serving SLO engine master switch
                                 (slo.py): windowed latency tracking,
                                 burn rates, /stats/slo, the flight
@@ -360,6 +385,15 @@ def _parse_join_strategy(v) -> str:
     return s
 
 
+def _parse_warmup_gate(v) -> str:
+    s = str(v).strip().lower()
+    if s not in ("ready", "stamp", "off"):
+        raise ValueError(
+            f"compile.warmup.gate must be ready, stamp or off, not {v!r}"
+        )
+    return s
+
+
 from geomesa_tpu.curves.zranges import DEFAULT_MAX_RANGES
 
 _DEFS = {
@@ -435,6 +469,24 @@ _DEFS = {
     # persistent serving compile cache (jaxconf.py): directory override
     # ("" = env/default resolution, "off" disables)
     "compile.cache.dir": ("", str),
+    # canonical compile-shape bucketing (bucketing.py): the geometric
+    # capacity ladder every dynamic trace shape rounds up onto (growth
+    # 2.0 = the historical next-power-of-two; <= 1 disables bucketing
+    # -- the parity-test oracle, never a serving configuration)
+    "compile.bucket.growth": (2.0, float),
+    "compile.bucket.min": (1, int),
+    # AOT warmup (warmup.py): pre-compile the closed bucket x kernel-
+    # family signature set at server start -- master switch, the
+    # /readyz behavior while compiling ("ready" holds 503, "stamp"
+    # serves but stamps warming, "off" hides warmup from readiness),
+    # the bounded background compile pool and the kNN k-ladder bound
+    "compile.warmup.enabled": (True, _parse_bool),
+    "compile.warmup.gate": ("ready", _parse_warmup_gate),
+    "compile.warmup.threads": (2, int),
+    "compile.warmup.knn.kmax": (64, int),
+    # cold-start SLO (bench.py --mode coldstart): the bar a WARMED
+    # first query per kernel family must answer under
+    "slo.coldstart.threshold.ms": (2000.0, float),
     # serving SLO engine (slo.py): master switch, one
     # objective/threshold/window triple per registered SLO name
     # (slo.SLO_NAMES), the shared fast burn window, and the flight

@@ -1251,6 +1251,7 @@ class DeviceIndex:
             qmat[i, : len(qa)] = qa
         key = ("fdim", r, qcap, want)
         fn = self._fused_jits.get(key)
+        _note_jit_cache(fn is not None)
         if fn is None:
             bm = zscan.batched_dim_mask_rt(r)
 
@@ -1322,6 +1323,7 @@ class DeviceIndex:
             idm = None
         key = ("fcmp", kind, bounds.shape, want)
         fn = self._fused_jits.get(key)
+        _note_jit_cache(fn is not None)
         if fn is None:
             bm = zscan.batched_kind_mask(kind)
 
@@ -1389,36 +1391,32 @@ class DeviceIndex:
             np.nonzero(self.mask(query, loose=loose, auths=auths))[0]
         )
 
-    def warmup(self, k: int = 10, density_px: int = 256) -> dict:
-        """Pre-compile the hot serving kernels (loose + exact scans at
-        city/country window scales, kNN, window-union, density, stats)
-        so the first real request never pays an XLA compile — the
-        explicit warmup entry for ``serve --resident`` (ref: the
-        reference's serving path has no compile step to hide; ours does,
-        ~14s for the fused top_k alone on a cold process). Combined with
-        the persistent compilation cache (jaxconf.enable_compilation_
-        cache) a restarted server warms from disk instead of
-        recompiling. Returns {leg: seconds} (None = leg unavailable for
-        this schema / staging, e.g. non-point geometry for kNN)."""
-        import time as _time
-        import warnings
-
+    def warmup_plan(
+        self,
+        k: int = 10,
+        density_px: int = 256,
+        knn_kmax: "int | None" = None,
+        fusion_max: "int | None" = None,
+    ) -> "list[tuple[str, object]]":
+        """The AOT warmup plan: ``(signature, thunk)`` legs covering the
+        bucket x kernel-family set this index can serve — the closed
+        enumeration :mod:`geomesa_tpu.warmup` pre-compiles at server
+        start. Base legs exercise the scan/agg families at two window
+        scales (the common zrange R-buckets) plus mask, window-union,
+        window-pairs, density and stats; when ``knn_kmax`` is given the
+        kNN ``k`` compile ladder (:func:`geomesa_tpu.bucketing.ladder`)
+        gets one leg per rung up to it, and ``fusion_max`` adds one
+        fused micro-batch leg per width rung (count + query variants).
+        Signatures are bounded leg names prefixed by their
+        ``ledger.SCOPE_FAMILIES`` family where one applies; a thunk of
+        ``None`` in the returned list never occurs — unavailable legs
+        (non-point schema, empty staging) are simply not planned."""
         from geomesa_tpu.filter import ast as _ast
 
-        out: dict = {}
-
-        def leg(name, fn):
-            t0 = _time.perf_counter()
-            try:
-                fn()
-                out[name] = round(_time.perf_counter() - t0, 3)
-            except Exception as e:  # warmup must never break serving
-                warnings.warn(f"warmup leg {name!r} failed: {e!r}")
-                out[name] = None
-
+        legs: list = []
         geom = self.sft.geom_field
         if geom is None or self._staged_len() == 0:
-            return out
+            return legs
         # a data-adjacent center makes the warm queries realistic, but
         # any coordinates compile the same kernels: points use their
         # coordinate planes, non-point schemas their envelope planes,
@@ -1451,22 +1449,35 @@ class DeviceIndex:
         # loose kernels plus the exact compiled scan
         for name, half in (("city", 0.05), ("country", 5.0)):
             q = bbox(half)
-            leg(f"count_loose_{name}", lambda q=q: self.count(q, loose=True))
-            leg(f"count_exact_{name}", lambda q=q: self.count(q, loose=False))
-        leg("mask", lambda: self.mask(bbox(1.0)))
+            legs.append((f"count_loose_{name}",
+                         lambda q=q: self.count(q, loose=True)))
+            legs.append((f"count_exact_{name}",
+                         lambda q=q: self.count(q, loose=False)))
+        legs.append(("mask", lambda: self.mask(bbox(1.0))))
         if is_point:  # kNN/density scan the point coordinate planes
-            leg("knn", lambda: self.knn(cx, cy, k))
-        else:
-            out["knn"] = None
+            legs.append(("knn", lambda: self.knn(cx, cy, k)))
+            if knn_kmax is not None:
+                # one leg per k-bucket rung: k requests in (prev, rung]
+                # all dispatch the rung's executable (satellite: k=7 and
+                # k=8 share one compile), so warming the rungs closes
+                # the kNN compile space up to kmax
+                from geomesa_tpu.bucketing import ladder as _ladder
+
+                for kk in _ladder(min(int(knn_kmax),
+                                      max(self._staged_len(), 1))):
+                    legs.append((f"knn:k={kk}",
+                                 lambda kk=kk: self.knn(cx, cy, kk)))
         env1 = np.array(
             [[cx - 0.5, cy - 0.5, cx + 0.5, cy + 0.5]], np.float64
         )
-        leg("window_union", lambda: self.window_union_query(env1))
-        leg("window_pairs", lambda: self.window_pairs_query(env1))
+        legs.append(("window_union",
+                     lambda: self.window_union_query(env1)))
+        legs.append(("window_pairs",
+                     lambda: self.window_pairs_query(env1)))
         from geomesa_tpu.geom import Envelope as _Env
 
         if is_point:
-            leg(
+            legs.append((
                 "density",
                 lambda: self.density(
                     _ast.Include,
@@ -1474,10 +1485,60 @@ class DeviceIndex:
                     density_px,
                     density_px,
                 ),
-            )
-        else:
+            ))
+        legs.append(("stats", lambda: self.stats(_ast.Include, "Count()")))
+        if fusion_max is not None:
+            # the fused micro-batch Q-capacity ladder (fused.dim /
+            # fused.cmp families): one leg per width rung up to the
+            # scheduler's max fusion, count + row-demux variants
+            from geomesa_tpu.bucketing import ladder as _ladder
+
+            q = bbox(0.05)
+            for w in _ladder(max(int(fusion_max), 1)):
+                legs.append((
+                    f"fused_counts:q={w}",
+                    lambda q=q, w=w: self.fused_loose_counts([q] * w),
+                ))
+                legs.append((
+                    f"fused_query:q={w}",
+                    lambda q=q, w=w: self.fused_loose_query([q] * w),
+                ))
+        return legs
+
+    def warmup(self, k: int = 10, density_px: int = 256) -> dict:
+        """Pre-compile the hot serving kernels (loose + exact scans at
+        city/country window scales, kNN, window-union, density, stats)
+        so the first real request never pays an XLA compile — the
+        explicit warmup entry for ``serve --resident`` (ref: the
+        reference's serving path has no compile step to hide; ours does,
+        ~14s for the fused top_k alone on a cold process). Combined with
+        the persistent compilation cache (jaxconf.enable_compilation_
+        cache) a restarted server warms from disk instead of
+        recompiling. Returns {leg: seconds} (None = leg unavailable for
+        this schema / staging, e.g. non-point geometry for kNN).
+
+        This synchronous entry runs the base :meth:`warmup_plan` legs
+        inline; the server's background AOT pass
+        (:mod:`geomesa_tpu.warmup`) runs the FULL plan (kNN k-ladder,
+        fused width ladder) in a bounded pool under the ``_system``
+        ledger tenant instead."""
+        import time as _time
+        import warnings
+
+        out: dict = {}
+        legs = self.warmup_plan(k=k, density_px=density_px)
+        for name, fn in legs:
+            t0 = _time.perf_counter()
+            try:
+                fn()
+                out[name] = round(_time.perf_counter() - t0, 3)
+            except Exception as e:  # warmup must never break serving
+                warnings.warn(f"warmup leg {name!r} failed: {e!r}")
+                out[name] = None
+        if "knn" not in out:
+            out["knn"] = None  # non-point schema: leg unavailable
+        if "density" not in out:
             out["density"] = None
-        leg("stats", lambda: self.stats(_ast.Include, "Count()"))
         return out
 
     def window_union_query(self, envs, times=None, auths=None, base=None):
@@ -1556,6 +1617,7 @@ class DeviceIndex:
         if not hasattr(self, "_union_jits"):
             self._union_jits = {}
         fn = self._union_jits.get(jit_key)
+        _note_jit_cache(fn is not None)
         if fn is None:
             def umask(cols, env, tb, valid, auth_tab):
                 x = cols[gx][:, None]
@@ -1667,6 +1729,7 @@ class DeviceIndex:
         if not hasattr(self, "_knn_jits"):
             self._knn_jits = {}
         fn = self._knn_jits.get(key)
+        _note_jit_cache(fn is not None)
         if fn is None:
 
             def fused(cols, q, valid, auth_tab):
@@ -1772,6 +1835,7 @@ class DeviceIndex:
         if not hasattr(self, "_union_jits"):
             self._union_jits = {}
         fn = self._union_jits.get(jit_key)
+        _note_jit_cache(fn is not None)
         if fn is None:
 
             def packed(cols, envs3, valid, auth_tab):
@@ -2104,6 +2168,7 @@ class DeviceIndex:
         key = (repr(f), kind, agg_key, has_vis,
                lb[2] if dim_loose else None)
         cached = self._agg_cache.get(key)
+        _note_jit_cache(cached is not None)
         if cached is None:
             z_kind = self._z_kind
             n_ranges = lb[2] if dim_loose else 0
@@ -2286,13 +2351,25 @@ class DeviceIndex:
                 self._density_kernels = {}
             kkey = (width, height, weight_attr is not None)
             kern = self._density_kernels.get(kkey)
+            _note_jit_cache(kern is not None)
             if kern is None:
                 kern = build_density_pallas(
                     width, height, weight_attr is not None
                 )
                 self._density_kernels[kkey] = kern
 
-        def agg_build(cols, m, env_arr):
+        # scatter engine (grids past the Pallas tile bound): the canvas
+        # CAPACITY buckets onto the compile ladder and width/height ride
+        # as runtime scalars, so one compiled scatter serves every grid
+        # size in the bucket — pixel ids are computed from the runtime
+        # dims, cells past height*width stay zero and the host slice
+        # drops them, so the grid is bit-identical to the exact-shape
+        # dispatch. (The Pallas kernel keeps exact shapes: its VMEM
+        # accumulator and one-hot width are compile-time tile geometry,
+        # and map-tile grids are a small closed set already.)
+        cap = 0 if kern is not None else _next_pow2(height * width)
+
+        def agg_build(cols, m, env_arr, wh):
             if kern is not None:
                 return {"grid": kern(
                     env_arr, cols[gx], cols[gy], m,
@@ -2300,7 +2377,7 @@ class DeviceIndex:
                     if weight_attr else None,
                 )}
             px, py, inside = _pixel_ids(
-                cols[gx], cols[gy], env_arr, width, height, jnp
+                cols[gx], cols[gy], env_arr, wh[0], wh[1], jnp
             )
             w = (
                 cols[weight_attr].astype(jnp.float32)
@@ -2308,24 +2385,31 @@ class DeviceIndex:
                 else jnp.float32(1.0)
             )
             contrib = jnp.where(m & inside, w, jnp.float32(0.0))
-            grid = jnp.zeros(height * width, jnp.float32)
-            return {
-                "grid": grid.at[py * width + px]
-                .add(contrib)
-                .reshape(height, width)
-            }
+            grid = jnp.zeros(cap, jnp.float32)
+            return {"grid": grid.at[py * wh[0] + px].add(contrib)}
 
         # the viewport is a RUNTIME argument: one compiled kernel per
-        # (filter, width, height) serves every bbox a panning map client
+        # (filter, canvas bucket) serves every bbox a panning map client
         # sends, instead of a recompile + retained cache entry per bbox
         env_arr = jnp.asarray(
             [envelope.xmin, envelope.ymin, envelope.xmax, envelope.ymax]
         )
-        outs = self._fused_agg(
-            f, loose, ("density", width, height, weight_attr),
-            agg_build, extra=(env_arr,), auths=auths,
+        wh = jnp.asarray([width, height], jnp.int32)
+        agg_key = (
+            ("density", width, height, weight_attr)
+            if kern is not None
+            else ("density", cap, weight_attr)
         )
-        return None if outs is None else np.asarray(outs["grid"])
+        outs = self._fused_agg(
+            f, loose, agg_key, agg_build, extra=(env_arr, wh),
+            auths=auths,
+        )
+        if outs is None:
+            return None
+        grid = np.asarray(outs["grid"])
+        if kern is None:
+            grid = grid[: height * width].reshape(height, width)
+        return grid
 
     def bin_export(
         self,
@@ -2505,7 +2589,25 @@ class DeviceIndex:
 
 
 def _next_pow2(n: int) -> int:
-    return 1 << max(int(n) - 1, 0).bit_length()
+    """Round a dynamic dimension up onto the canonical compile-shape
+    ladder (bucketing.py). The name survives from the pow2-only era —
+    the default ladder (compile.bucket.growth=2) IS next-power-of-two,
+    but the rung set is conf-declared now so warmup can enumerate it
+    and deployments can trade padding waste against compile count."""
+    from geomesa_tpu.bucketing import bucket_cap
+
+    return bucket_cap(n)
+
+
+def _note_jit_cache(hit: bool) -> None:
+    """Count an in-process jit-cache probe on the tier-labeled compile
+    cache metric: ``tier=inproc`` hits are dispatches that reused an
+    already-built executable from this process's own jit dicts, vs the
+    ``tier=disk`` hits jaxconf's persistent-cache listener counts."""
+    if hit:
+        from geomesa_tpu import metrics
+
+        metrics.compile_cache_hits.inc(tier="inproc")
 
 
 class StreamingDeviceIndex(DeviceIndex):

@@ -45,7 +45,10 @@ def _install_cache_listener() -> None:
                 _cache_events["hits"] += 1
                 from geomesa_tpu import metrics
 
-                metrics.compile_cache_hits.inc()
+                # tier="disk": a persistent-cache load dodged a backend
+                # compile (tier="inproc" — in-process jit-cache reuse —
+                # is counted at the device_cache dispatch probes)
+                metrics.compile_cache_hits.inc(tier="disk")
             elif event == "/jax/compilation_cache/compile_requests_use_cache":
                 _cache_events["requests"] += 1
                 from geomesa_tpu import metrics

@@ -59,6 +59,7 @@ from geomesa_tpu.locking import checked_lock
 
 __all__ = [
     "FIELDS",
+    "SCOPE_FAMILIES",
     "RequestCost",
     "CostLedger",
     "CompileLedger",
@@ -265,6 +266,26 @@ def attach_cost(cost):
 
 
 # -- compile-time attribution -----------------------------------------------
+
+#: the statically-registered compile-scope families: every
+#: :func:`compile_scope` call site stamps a signature of the form
+#: ``family`` or ``family:<bucketed dims>``, and every family is
+#: declared here — this is the closed set the AOT warmup plan
+#: (:mod:`geomesa_tpu.warmup`) enumerates bucket x family signatures
+#: from, and what keeps ``/stats/ledger``'s ``by_signature`` keys a
+#: bounded, documented namespace. Adding a compile_scope site means
+#: adding its family here (and, if it should be pre-compiled, a warmup
+#: leg that exercises it).
+SCOPE_FAMILIES = (
+    ("cache.stage", "resident column staging pipeline"),
+    ("store.scan", "streamed store-scan kernels"),
+    ("fused.dim", "fused micro-batch count/query (r x q capacities)"),
+    ("fused.cmp", "fused single-query compare kernels"),
+    ("fused.agg", "fused aggregation kernels (stats/density)"),
+    ("knn", "k-nearest-neighbor top-k (k on the bucket ladder)"),
+    ("join.refine", "spatial-join refinement count/compact buckets"),
+    ("join.mesh", "sharded spatial-join mesh kernels"),
+)
 
 _scope: contextvars.ContextVar = contextvars.ContextVar(
     "geomesa_compile_scope", default=None

@@ -452,15 +452,30 @@ mesh_build_fallbacks = REGISTRY.counter(
 )
 
 # persistent serving compile cache (jaxconf.py): task-level hit/miss as
-# observed through jax's compilation-cache monitoring events
+# observed through jax's compilation-cache monitoring events, split by
+# tier — tier="disk" counts executables loaded from the persistent
+# on-disk cache instead of compiled, tier="inproc" counts dispatches
+# that reused an executable already built in this process's jit caches
+# (device_cache._note_jit_cache)
 compile_cache_hits = REGISTRY.counter(
     "geomesa_compile_cache_hits_total",
-    "XLA executables loaded from the persistent compilation cache",
+    "XLA executable reuse by tier (disk = persistent cache load, "
+    "inproc = in-process jit-cache hit)",
 )
 compile_cache_requests = REGISTRY.counter(
     "geomesa_compile_cache_requests_total",
     "XLA compilations eligible for the persistent cache (misses = "
     "requests - hits)",
+)
+
+# AOT warmup (warmup.py): progress of the start-time pre-compile pass
+# over the bucket x kernel-family signature set, by state label —
+# state="total" planned signatures, state="compiled" legs that paid a
+# backend compile, state="from_cache" legs satisfied entirely from the
+# persistent/in-process caches, state="failed" legs that raised
+warmup_signatures = REGISTRY.gauge(
+    "geomesa_warmup_signatures",
+    "AOT warmup signatures by state (total/compiled/from_cache/failed)",
 )
 
 # per-request tracing (tracing.py): how many traces the ring retained

@@ -29,14 +29,20 @@ import numpy as np
 
 # jit caches keyed by static kernel shape buckets (candidate bucket C,
 # run bucket R, compaction cap, dtype, gating): bounded — every bucket
-# edge is a power of two
+# edge sits on the conf-declared compile-shape ladder (next power of
+# two on the default ladder)
 _COUNT_JITS: dict = {}
 _COMPACT_JITS: dict = {}
 _MESH_JITS: dict = {}
 
 
 def next_pow2(n: int) -> int:
-    return 1 << max(int(n) - 1, 0).bit_length()
+    """Round a candidate/run capacity onto the canonical compile-shape
+    ladder (:mod:`geomesa_tpu.bucketing`). The name survives from the
+    pow2-only era — the default ladder IS next-power-of-two."""
+    from geomesa_tpu.bucketing import bucket_cap
+
+    return bucket_cap(n)
 
 
 def mesh_key(mesh) -> tuple:

@@ -163,10 +163,14 @@ _NEVER_RANGE = np.array(
 
 
 def pad_ranges(bounds: np.ndarray, min_r: int = 1) -> np.ndarray:
-    """Pad the range axis (last-but-one) to a power of two with
-    never-matching entries so jit sees a bounded set of R shapes."""
+    """Pad the range axis (last-but-one) up to the compile-shape ladder
+    (:mod:`geomesa_tpu.bucketing`; next power of two on the default
+    ladder) with never-matching entries so jit sees a bounded set of R
+    shapes."""
+    from geomesa_tpu.bucketing import bucket_cap
+
     r = bounds.shape[-2]
-    cap = max(min_r, 1 << max(r - 1, 0).bit_length())
+    cap = max(min_r, bucket_cap(r))
     if cap == r:
         return bounds
     pad_shape = bounds.shape[:-2] + (cap - r, 4)
@@ -261,8 +265,10 @@ def xz3_query_bounds(
         ids.append(b)
     if not per_bin:
         return np.zeros((0, 1, 4), np.uint32), np.array([], np.int32)
+    from geomesa_tpu.bucketing import bucket_cap
+
     longest = max(len(p) for p in per_bin)
-    r_max = max(1, 1 << max(longest - 1, 0).bit_length())  # pow2 like pad_ranges
+    r_max = bucket_cap(longest)  # same ladder as pad_ranges
     stacked = np.stack([pad_ranges(p, min_r=r_max) for p in per_bin])
     return stacked, np.array(ids, np.int32)
 
@@ -963,10 +969,13 @@ def build_z3_pallas_scan(
 
 
 def pad_bins(bounds: np.ndarray, bin_ids: np.ndarray, min_b: int = 1):
-    """Pad the bin axis to the next power of two (>= min_b) so jit sees a
-    bounded set of B shapes; pad ids are -1 (match nothing)."""
+    """Pad the bin axis up to the compile-shape ladder (>= min_b; next
+    power of two on the default ladder) so jit sees a bounded set of B
+    shapes; pad ids are -1 (match nothing)."""
+    from geomesa_tpu.bucketing import bucket_cap
+
     b = len(bin_ids)
-    cap = max(min_b, 1 << max(b - 1, 0).bit_length())
+    cap = max(min_b, bucket_cap(b))
     if cap == b:
         return bounds, bin_ids
     pb = np.zeros((cap,) + bounds.shape[1:], bounds.dtype)

@@ -111,7 +111,13 @@ class SchedConfig:
         registry) -- what ``QueryScheduler()`` with no explicit config
         uses, so a deployment can tune admission/fusion via environment
         (``GEOMESA_TPU_SCHED_MAX_QUEUE=...``) without code changes. A
-        non-positive ``sched.default.deadline.ms`` means no deadline."""
+        non-positive ``sched.default.deadline.ms`` means no deadline.
+
+        ``max_fusion`` snaps UP onto the compile-shape ladder
+        (:mod:`geomesa_tpu.bucketing`): the fusion width becomes a jit
+        batch capacity downstream, so an off-ladder cap (say 48) would
+        mint compile shapes the warmup plan does not enumerate."""
+        from geomesa_tpu.bucketing import bucket_cap
         from geomesa_tpu.conf import sys_prop
 
         deadline = float(sys_prop("sched.default.deadline.ms"))
@@ -119,7 +125,7 @@ class SchedConfig:
             max_queue=int(sys_prop("sched.max.queue")),
             max_inflight=int(sys_prop("sched.max.inflight")),
             fusion_window_ms=float(sys_prop("sched.fusion.window.ms")),
-            max_fusion=int(sys_prop("sched.max.fusion")),
+            max_fusion=bucket_cap(int(sys_prop("sched.max.fusion"))),
             default_deadline_ms=deadline if deadline > 0 else None,
             retry_after_s=float(sys_prop("sched.retry.after.s")),
         )

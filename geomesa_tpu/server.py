@@ -1201,8 +1201,10 @@ class _Handler(BaseHTTPRequestHandler):
         failure domain's breaker, the open (unhealthy) domains,
         scheduler queue pressure and any BURNING SLOs. A DEGRADED or
         burning instance is still READY (200) — it serves, just
-        lower-rung or over budget, and says so; only draining flips 503
-        (nothing new should be routed here)."""
+        lower-rung or over budget, and says so; only draining,
+        mid-reprovision, and (with ``compile.warmup.gate=ready``) a
+        still-running AOT warmup pass flip 503 (nothing new should be
+        routed here)."""
         from geomesa_tpu import resilience, slo
 
         breakers = resilience.snapshot()
@@ -1237,6 +1239,20 @@ class _Handler(BaseHTTPRequestHandler):
                 # install finishes and lag returns to 0
                 doc["ready"] = False
                 doc["reprovisioning"] = inst
+        if getattr(self, "_warmup_started", False):
+            from geomesa_tpu import warmup
+            from geomesa_tpu.conf import sys_prop
+
+            gate = str(sys_prop("compile.warmup.gate"))
+            if gate != "off" and warmup.warming():
+                # the AOT pre-compile pass over the bucket x
+                # kernel-family set is still running: gate="ready"
+                # holds readiness so a rolling restart (fleet
+                # wait_ready) never routes traffic at a cold process;
+                # gate="stamp" serves immediately but says so
+                doc["warming"] = True
+                if gate == "ready":
+                    doc["ready"] = False
         self._json(200 if doc["ready"] else 503, doc)
 
     def _dispatch(self, url, parts: list, q: dict) -> None:
@@ -1569,13 +1585,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stats_index(self) -> dict:
         """``/stats``: one roll-up document — scheduler, store, mesh,
-        SLO engine, cost ledger and the persistent compile cache
-        (hit/miss) in a single scrape."""
-        from geomesa_tpu import slo
+        SLO engine, cost ledger, the persistent compile cache
+        (hit/miss) and AOT warmup progress in a single scrape."""
+        from geomesa_tpu import slo, warmup
         from geomesa_tpu.jaxconf import compile_cache_stats
         from geomesa_tpu.ledger import LEDGER
 
-        doc: dict = {"compile_cache": compile_cache_stats()}
+        doc: dict = {
+            "compile_cache": compile_cache_stats(),
+            "warmup": warmup.progress(),
+        }
         if self.scheduler is not None:
             doc["sched"] = self.scheduler.snapshot()
         if hasattr(self.store, "store_stats"):
@@ -2449,11 +2468,30 @@ def make_server(
                     store, tn, mesh_on,
                     streaming=stream_layer is not None,
                 )
-                di.warmup()
             except Exception as e:
                 warnings.warn(f"warm staging failed for {tn!r}: {e!r}")
                 continue
             handler._resident_cache[tn] = di
+        # staging is synchronous (the resident cache is populated when
+        # make_server returns); the AOT pre-compile over the bucket x
+        # kernel-family set moves to a bounded background pool charged
+        # to the _system ledger tenant, with /readyz gating or stamping
+        # `warming` per compile.warmup.gate — a fleet rolling restart
+        # (wait_ready) therefore never routes traffic at a cold process
+        if handler._resident_cache:
+            if bool(_sys_prop("compile.warmup.enabled")):
+                from geomesa_tpu import warmup as _warmup
+
+                handler._warmup_started = True
+                _warmup.start(dict(handler._resident_cache))
+            else:
+                # warmup.enabled=false keeps the pre-ladder contract:
+                # base kernels compile inline before traffic is accepted
+                for tn, di in handler._resident_cache.items():
+                    try:
+                        di.warmup()
+                    except Exception as e:  # pragma: no cover - defensive
+                        warnings.warn(f"warmup failed for {tn!r}: {e!r}")
     # flight recorder: bundles land next to the store's data (memory
     # stores have no root — the recorder stays disabled unless a test
     # configured a directory of its own); sched/store/mesh snapshots

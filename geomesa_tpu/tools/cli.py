@@ -39,6 +39,12 @@ Runner/IngestCommand/ExportCommand/ExplainCommand/StatsCommand):
                                top-K cost tables, most expensive
                                requests, compile attribution from
                                /stats/ledger)
+    geomesa-tpu warmup         [--url http://host:port | --root DIR
+                               [-f NAME]] (AOT warmup: report a running
+                               server's pre-compile progress, or prime
+                               the persistent compile cache locally
+                               over the full bucket x kernel-family
+                               plan)
     geomesa-tpu load-driver    --root DIR -f NAME [-q CQL] [--threads M]
                                [--requests N] [--loose] [--tenants K]
                                (concurrent-serving load: throughput,
@@ -1632,6 +1638,51 @@ def cmd_ledger(args):
             )
 
 
+def cmd_warmup(args):
+    """AOT warmup. With ``--url``: report a running server's warmup
+    progress (the ``/stats`` warmup + compile-cache documents). Without:
+    stage ``--root``'s types into resident indexes and pre-compile the
+    full bucket x kernel-family plan (kNN k-ladder, fused widths) so
+    the persistent compile cache is primed before any serve starts — a
+    deploy step that makes the NEXT cold process warm from disk."""
+    if getattr(args, "url", None):
+        doc = _fetch_json(f"{args.url.rstrip('/')}/stats")
+        w = doc.get("warmup", {})
+        print(f"state: {w.get('state', 'unknown')}")
+        print(
+            f"signatures: {w.get('done', 0)}/"
+            f"{w.get('signatures_total', 0)} "
+            f"(compiled {w.get('compiled', 0)}, "
+            f"from cache {w.get('from_cache', 0)}, "
+            f"failed {w.get('failed', 0)})"
+        )
+        if w.get("seconds"):
+            print(f"wall: {w['seconds']}s")
+        cc = doc.get("compile_cache", {})
+        print(
+            f"persistent cache: enabled={bool(cc.get('enabled'))} "
+            f"entries={cc.get('entries', 0)} hits={cc.get('hits', 0)} "
+            f"misses={cc.get('misses', 0)}"
+        )
+        return
+    from geomesa_tpu import warmup
+    from geomesa_tpu.device_cache import DeviceIndex
+    from geomesa_tpu.jaxconf import enable_compilation_cache
+
+    enable_compilation_cache()
+    store = _store(args)
+    types = (
+        [args.feature_name] if getattr(args, "feature_name", None)
+        else list(store.type_names)
+    )
+    if not types:
+        sys.exit("error: no schemas in the store root")
+    indexes = {
+        tn: DeviceIndex(store, tn, z_planes=True) for tn in types
+    }
+    print(json.dumps(warmup.run(indexes)))
+
+
 def cmd_count(args):
     store = _store(args)
     print(store.count(args.feature_name, args.cql or "INCLUDE"))
@@ -1804,8 +1855,10 @@ def main(argv=None) -> None:
     sp.add_argument(
         "--warm",
         action="store_true",
-        help="with --resident: stage every type and pre-compile its "
-        "serving kernels before accepting traffic (no request pays a "
+        help="with --resident: stage every type synchronously, then "
+        "AOT pre-compile the bucket x kernel-family set in a bounded "
+        "background pool (compile.warmup.* conf keys; /readyz gates "
+        "or stamps `warming` until done, so no request pays a "
         "first-touch staging or XLA compile)",
     )
     sp.add_argument(
@@ -1887,6 +1940,15 @@ def main(argv=None) -> None:
     sp = add("ledger", cmd_ledger)
     sp.add_argument("--url", required=True,
                     help="running server base URL (e.g. http://host:port)")
+
+    sp = add("warmup", cmd_warmup)
+    sp.add_argument("--url",
+                    help="running server base URL: report its AOT "
+                    "warmup progress; omit to pre-compile --root's "
+                    "full bucket x kernel-family plan locally (primes "
+                    "the persistent compile cache for the next serve)")
+    sp.add_argument("-f", "--feature-name",
+                    help="local mode: warm one schema (default: all)")
 
     sp = add("subs", cmd_subs)
     sp.add_argument("--url", required=True,

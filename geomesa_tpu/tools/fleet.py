@@ -27,6 +27,15 @@ pass a closure that re-serves in-process; the CLI builds one from a
 shell template (``fleet restart --spawn``). The orchestrator only
 speaks HTTP to the backends, so it can run from anywhere that can
 reach the group.
+
+**Warm handoff (ISSUE 17).** Every cycle waits on ``wait_ready`` —
+``/readyz`` returning 200 — before routing the next step's traffic.
+Nodes serving with ``--warm`` run the AOT warmup pass (pre-compiling
+the bucket x kernel-family set, warm from the persistent compile
+cache) at start, and with ``compile.warmup.gate=ready`` (the default)
+``/readyz`` stays 503 until that pass finishes: a rolling bounce
+therefore never serves a cold first query — the restarted node's
+serving-path compile attribution in ``/stats/ledger`` stays zero.
 """
 
 from __future__ import annotations

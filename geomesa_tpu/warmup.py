@@ -1,0 +1,211 @@
+"""AOT warmup: pre-compile the closed bucket x kernel-family set.
+
+The worst p100 in the system is the XLA compile cliff — kNN answers in
+~194ms warm but ~14s cold (BENCH_r03/r04) — and PR 8/9 landed only the
+measurement half (persistent compile cache + per-shape attribution in
+``/stats/ledger``). The bucketing layer (:mod:`geomesa_tpu.bucketing`)
+makes the compile-shape space a CLOSED, conf-declared set; this module
+walks that set at server start so no serving request ever pays a
+compile:
+
+- **Plan.** Each resident :class:`~geomesa_tpu.device_cache.DeviceIndex`
+  enumerates its ``warmup_plan`` — (signature, thunk) legs covering the
+  scan/agg kernel families plus the kNN ``k`` ladder (up to
+  ``compile.warmup.knn.kmax``) and the fused micro-batch width ladder
+  (up to the scheduler's ``sched.max.fusion``). The families mirror the
+  ledger's statically-registered ``SCOPE_FAMILIES``.
+- **Execute.** Legs run in a bounded pool (``compile.warmup.threads``).
+  Warm executables load from the PR 8 persistent cache in well under a
+  second each; true misses compile in the pool without blocking the
+  accept loop. Every leg runs under the ledger's ``_system`` tenant
+  (``compile_scope`` + a dedicated :func:`ledger.collect_cost`
+  collector on the worker thread), so a background compile finishing
+  while a request is in flight can never misattribute its seconds to
+  the first unlucky tenant — the bugfix half of ISSUE 17.
+- **Gate.** ``/readyz`` consults :func:`warming` per
+  ``compile.warmup.gate``: ``ready`` holds readiness 503 until the set
+  is warm (fleet ``wait_ready`` then gives rolling restarts a
+  warm-handoff guarantee for free), ``stamp`` serves immediately but
+  stamps ``warming`` into the readiness doc, ``off`` hides warmup from
+  readiness entirely. Progress (``signatures_total`` / ``compiled`` /
+  ``from_cache`` / ``failed``) is exported on ``/stats``, the
+  ``geomesa_warmup_signatures`` gauge, and the ``geomesa-tpu warmup``
+  CLI.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from geomesa_tpu.locking import checked_lock
+
+__all__ = ["plan", "progress", "reset", "run", "start", "warming"]
+
+_lock = checked_lock("warmup.state")
+#: process-wide progress document (one warmup pass per process — the
+#: same scope as the COMPILES ledger it feeds)
+_state: dict = {
+    "state": "idle",  # idle | warming | warm
+    "signatures_total": 0,
+    "done": 0,
+    "compiled": 0,
+    "from_cache": 0,
+    "failed": 0,
+    "seconds": 0.0,
+}
+
+
+def progress() -> dict:
+    """Snapshot of the warmup progress document (the ``/stats`` form)."""
+    with _lock:
+        return dict(_state)
+
+
+def warming() -> bool:
+    """True while a warmup pass is running (readiness gating input)."""
+    with _lock:
+        return _state["state"] == "warming"
+
+
+def reset() -> None:
+    """Reset the progress document (tests; a fresh process starts idle)."""
+    with _lock:
+        _state.update(
+            state="idle", signatures_total=0, done=0, compiled=0,
+            from_cache=0, failed=0, seconds=0.0,
+        )
+
+
+def _gauge() -> None:
+    from geomesa_tpu import metrics
+
+    with _lock:
+        st = dict(_state)
+    metrics.warmup_signatures.set(st["signatures_total"], state="total")
+    metrics.warmup_signatures.set(st["compiled"], state="compiled")
+    metrics.warmup_signatures.set(st["from_cache"], state="from_cache")
+    metrics.warmup_signatures.set(st["failed"], state="failed")
+
+
+def plan(indexes: dict, knn_kmax: "int | None" = None,
+         fusion_max: "int | None" = None) -> "list[tuple[str, object]]":
+    """The full warmup plan over ``{type_name: DeviceIndex}``: every
+    index's ``warmup_plan`` legs with type-qualified signatures, kNN
+    k-ladder and fused-width ladder included. Ladder bounds default
+    from conf (``compile.warmup.knn.kmax``; ``sched.max.fusion``
+    snapped to the bucket ladder, exactly what the scheduler serves
+    with)."""
+    from geomesa_tpu.bucketing import bucket_cap
+    from geomesa_tpu.conf import sys_prop
+
+    if knn_kmax is None:
+        knn_kmax = int(sys_prop("compile.warmup.knn.kmax"))
+    if fusion_max is None:
+        fusion_max = bucket_cap(int(sys_prop("sched.max.fusion")))
+    legs: list = []
+    for tn, di in sorted(indexes.items()):
+        for sig, fn in di.warmup_plan(
+            knn_kmax=knn_kmax, fusion_max=fusion_max
+        ):
+            legs.append((f"{tn}:{sig}", fn))
+    return legs
+
+
+def _run_leg(sig: str, fn) -> None:
+    """One warmup leg, charged to the ``_system`` tenant: the collector
+    installs on THIS pool thread's context, so the synchronous
+    ``jax.monitoring`` compile events a leg triggers attribute here —
+    never to whatever request happens to be in flight."""
+    from geomesa_tpu import ledger
+
+    t0 = time.perf_counter()
+    with ledger.collect_cost(
+        tenant="_system", endpoint="warmup", lane="batch", shape=sig
+    ) as cost:
+        try:
+            fn()
+            cost.status = 200
+        except Exception:  # warmup must never break serving
+            cost.status = 500
+    cost.dur_s = time.perf_counter() - t0
+    if ledger.enabled():
+        ledger.LEDGER.record(cost)
+    fields = cost.snapshot_fields()
+    with _lock:
+        _state["done"] += 1
+        if cost.status >= 500:
+            _state["failed"] += 1
+        elif fields.get("compiles", 0):
+            _state["compiled"] += 1
+        else:
+            # no backend compile observed: the leg was satisfied from
+            # the persistent disk cache and/or in-process jit reuse
+            _state["from_cache"] += 1
+
+
+def run(indexes: dict, threads: "int | None" = None,
+        knn_kmax: "int | None" = None,
+        fusion_max: "int | None" = None) -> dict:
+    """Execute the full warmup plan in a bounded thread pool; returns
+    the final progress document. Synchronous — the server runs this on
+    a background thread via :func:`start`; the CLI and bench call it
+    directly."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from geomesa_tpu import ledger
+    from geomesa_tpu.conf import sys_prop
+
+    if threads is None:
+        threads = int(sys_prop("compile.warmup.threads"))
+    t0 = time.perf_counter()
+    # planning runs on the CALLER's thread and can itself compile (an
+    # index whose staging is still lazy stages under plan()): charge
+    # that to _system as well, never a request collector the caller
+    # happens to have installed
+    with ledger.collect_cost(
+        tenant="_system", endpoint="warmup", lane="batch", shape="plan"
+    ) as pcost:
+        legs = plan(indexes, knn_kmax=knn_kmax, fusion_max=fusion_max)
+    pcost.dur_s = time.perf_counter() - t0
+    if ledger.enabled() and pcost.snapshot_fields():
+        ledger.LEDGER.record(pcost)
+    with _lock:
+        _state.update(
+            state="warming", signatures_total=len(legs), done=0,
+            compiled=0, from_cache=0, failed=0, seconds=0.0,
+        )
+    _gauge()
+    try:
+        with ThreadPoolExecutor(
+            max_workers=max(int(threads), 1),
+            thread_name_prefix="geomesa-warmup",
+        ) as pool:
+            for f in [pool.submit(_run_leg, sig, fn) for sig, fn in legs]:
+                f.result()
+    finally:
+        with _lock:
+            _state["state"] = "warm"
+            _state["seconds"] = round(time.perf_counter() - t0, 3)
+        _gauge()
+    return progress()
+
+
+def start(indexes: dict, threads: "int | None" = None,
+          knn_kmax: "int | None" = None,
+          fusion_max: "int | None" = None) -> threading.Thread:
+    """Kick :func:`run` on a daemon thread. The ``warming`` state is
+    stamped SYNCHRONOUSLY before this returns, so a ``/readyz`` probe
+    racing the thread start still sees the gate closed — a rolling
+    restart can never observe a ready-but-cold window."""
+    with _lock:
+        _state["state"] = "warming"
+    t = threading.Thread(
+        target=run, args=(indexes,),
+        kwargs=dict(
+            threads=threads, knn_kmax=knn_kmax, fusion_max=fusion_max
+        ),
+        name="geomesa-warmup", daemon=True,
+    )
+    t.start()
+    return t

@@ -1,0 +1,161 @@
+"""Bucketing parity (ISSUE 17 acceptance): every family that rounds a
+request dimension onto the compile-shape ladder must return results
+BIT-IDENTICAL to unbucketed execution. ``compile.bucket.growth <= 1``
+is the unbucketed oracle (exact shapes, one compile per size); the
+default pow2 ladder and an off-default growth=3 ladder must match it
+exactly — counts, fids, distances, density grids, stat sketches and
+join pairs — at sizes straddling bucket boundaries (k=7/8/9, prime
+widths, a canvas just past the Pallas tile bound) on single chip and
+on the 8-virtual-device mesh.
+
+Runs on the 8-virtual-device CPU harness conftest provides.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.conf import prop_override
+from geomesa_tpu.device_cache import DeviceIndex
+from geomesa_tpu.filter.ecql import parse_ecql, parse_instant
+from geomesa_tpu.geom import Envelope
+from geomesa_tpu.join import JoinEngine
+from geomesa_tpu.parallel.mesh import make_mesh
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "name:String,val:Int,dtg:Date,*geom:Point:srid=4326"
+N = 1201  # prime: every pad/bucket tail is live
+
+#: window scales chosen to hit different z-range R-buckets (the
+#: city/country split of the warmup plan) plus a residual-filter query
+ECQLS = [
+    "BBOX(geom, -0.4, -0.3, 0.4, 0.3)",
+    "BBOX(geom, -12, -9, 11, 8) AND "
+    "dtg DURING 2020-01-05T00:00:00Z/2020-02-01T00:00:00Z",
+    "val >= 50 AND BBOX(geom, -18, -18, 18, 18)",
+]
+
+
+@pytest.fixture(scope="module")
+def ds():
+    ds = MemoryDataStore()
+    ds.create_schema("t", SPEC)
+    rng = np.random.default_rng(31)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    t1 = parse_instant("2020-03-01T00:00:00")
+    ds.write(
+        "t",
+        {
+            "name": rng.choice(["a", "b", "c"], N),
+            "val": rng.integers(0, 100, N),
+            "dtg": rng.integers(t0, t1, N),
+            "geom": np.stack(
+                [rng.uniform(-20, 20, N), rng.uniform(-20, 20, N)], axis=1
+            ),
+        },
+        fids=np.arange(N),
+    )
+    return ds
+
+
+def _windows(m, w=2.0):
+    rng = np.random.default_rng(m)
+    x0 = rng.uniform(-18, 16, m)
+    y0 = rng.uniform(-18, 16, m)
+    return np.stack([x0, y0, x0 + w, y0 + w], axis=1)
+
+
+def _battery(ds, growth):
+    """Every bucketed family's results under one ladder setting. A
+    FRESH DeviceIndex per growth: the per-filter loose-bounds cache
+    pins padded shapes, so reusing an index would let one growth's
+    caps leak into another's dispatch."""
+    with prop_override("compile.bucket.growth", growth):
+        di = DeviceIndex(ds, "t", z_planes=True)
+        out = {}
+        for i, ecql in enumerate(ECQLS):
+            out[f"count_loose:{i}"] = di.count(ecql, loose=True)
+            out[f"count_exact:{i}"] = di.count(ecql, loose=False)
+            out[f"fids:{i}"] = np.sort(di.query(ecql).fids)
+        # kNN straddling the k=7/8 rung edge (satellite: one compile)
+        for k in (1, 2, 3, 7, 8, 9, 13):
+            b, d = di.knn(0.3, 0.2, k)
+            out[f"knn_fids:{k}"] = list(b.fids)
+            out[f"knn_d:{k}"] = d
+        # fused micro-batch widths across the 4 -> 8 rung edge; a
+        # mixed-window group may decline to fuse under exact shapes
+        # (mixed R buckets) — the API contract is "equals the serial
+        # loose counts", so normalize through the documented fallback
+        q0 = parse_ecql(ECQLS[0])
+        qs = [
+            parse_ecql(f"BBOX(geom, {x - 0.4}, -0.3, {x + 0.4}, 0.3)")
+            for x in (-9.0, -3.0, 3.0, 9.0)
+        ]
+        for w in (1, 3, 7, 8):
+            out[f"fused_same:{w}"] = di.fused_loose_counts([q0] * w)
+            grp = (qs * 2)[:w]
+            got = di.fused_loose_counts(grp)
+            out[f"fused_mixed:{w}"] = (
+                got if got is not None
+                else [di.count(q, loose=True) for q in grp]
+            )
+        for m in (1, 3, 5):
+            out[f"union:{m}"] = np.sort(
+                di.window_union_query(_windows(m)).fids
+            )
+        # density: (64, 64) rides the Pallas exact-shape engine,
+        # (600, 3) is past the tile bound -> capacity-bucketed scatter
+        env = Envelope(-20, -20, 20, 20)
+        out["density_pallas"] = di.density(ECQLS[0], env, 64, 64)
+        out["density_scatter"] = di.density(ECQLS[1], env, 600, 3)
+        out["density_weighted"] = di.density(
+            "INCLUDE", env, 600, 3, weight_attr="val"
+        )
+        seq = di.stats(ECQLS[1], 'Count();MinMax("val")')
+        out["stats"] = [s.to_json() for s in seq.stats]
+        # join refinement: candidate-capacity buckets (join.refine C=)
+        for m in (5, 40):
+            res = JoinEngine(di).join(_windows(m, w=1.0))
+            out[f"join:{m}"] = list(
+                zip(res.rows.tolist(), res.wins.tolist())
+            )
+        # 8-virtual-device mesh: co-partitioned refinement buckets
+        res = JoinEngine(di, mesh=make_mesh(n_devices=8)).join(
+            _windows(12, w=1.0)
+        )
+        out["join_mesh"] = list(zip(res.rows.tolist(), res.wins.tolist()))
+        return out
+
+
+def _assert_same(a, b, ctx):
+    assert set(a) == set(b)
+    for key in a:
+        va, vb = a[key], b[key]
+        if isinstance(va, np.ndarray):
+            np.testing.assert_array_equal(va, vb, err_msg=f"{ctx}:{key}")
+        else:
+            assert va == vb, (ctx, key, va, vb)
+
+
+def test_bucketed_results_bit_identical(ds):
+    oracle = _battery(ds, 0)  # growth <= 1: exact shapes, no bucketing
+    _assert_same(_battery(ds, 2.0), oracle, "pow2-vs-exact")
+    _assert_same(_battery(ds, 3.0), oracle, "growth3-vs-exact")
+    # sanity on the oracle itself: it saw real hits, not empty == empty
+    assert any(oracle[f"count_loose:{i}"] > 0 for i in range(len(ECQLS)))
+    assert len(oracle["join:40"]) > 0
+    assert float(oracle["density_scatter"].sum()) > 0
+
+
+def test_knn_k7_k8_share_one_executable(ds):
+    """The satellite in one assertion: k=7 and k=8 land on the same
+    rung, so the second call finds the jit entry the first minted —
+    one compiled executable, observable as an inproc-tier cache hit."""
+    from geomesa_tpu import metrics
+
+    di = DeviceIndex(ds, "t")
+    di.knn(0.3, 0.2, 7)
+    before = metrics.compile_cache_hits.value(tier="inproc")
+    b, d = di.knn(0.3, 0.2, 8)
+    assert len(di._knn_jits) == 1
+    assert len(b.fids) == 8 and np.all(np.diff(d) >= 0)
+    assert metrics.compile_cache_hits.value(tier="inproc") == before + 1

@@ -1,0 +1,94 @@
+"""The conf-declared compile-shape ladder (ISSUE 17 tentpole): pure
+host arithmetic, so these are exact unit properties — the default
+ladder must reproduce the historical next-power-of-two keys bit for
+bit, any growth > 1 must yield a strictly increasing closed rung set,
+and growth <= 1 must disable bucketing (the parity suite's oracle)."""
+
+import pytest
+
+from geomesa_tpu.bucketing import bucket_cap, ladder, ladder_params
+from geomesa_tpu.conf import prop_override
+
+
+def _pow2(n):
+    n = max(int(n), 1)
+    v = 1
+    while v < n:
+        v <<= 1
+    return v
+
+
+def test_default_ladder_is_next_pow2():
+    """growth=2.0 / min=1 (the defaults) mints EXACTLY the pow2 keys
+    every dispatch site used before the ladder existed — a default
+    deployment's jit caches and persistent-cache entries are unchanged
+    by this PR."""
+    assert ladder_params() == (2.0, 1)
+    for n in list(range(1, 70)) + [127, 128, 129, 1000, 4096, 10**6]:
+        assert bucket_cap(n) == _pow2(n), n
+
+
+def test_cap_basic_properties():
+    caps = [bucket_cap(n) for n in range(1, 200)]
+    for n, c in enumerate(caps, start=1):
+        assert c >= n  # never rounds down
+        assert bucket_cap(c) == c  # idempotent: rungs are fixpoints
+    assert caps == sorted(caps)  # monotone in n
+
+
+def test_floor_and_degenerate_inputs():
+    assert bucket_cap(0) == 1
+    assert bucket_cap(-5) == 1
+    assert bucket_cap(3, floor=16) == 16
+    assert bucket_cap(100, floor=16) == 128
+
+
+@pytest.mark.parametrize("growth", [1.5, 2.0, 3.0, 1.1])
+def test_ladder_closed_under_cap(growth):
+    """Every capacity up to a bound lands on a rung the warmup plan
+    enumerates for that bound — the property that makes AOT warmup a
+    CLOSED set instead of a heuristic."""
+    with prop_override("compile.bucket.growth", growth):
+        rungs = ladder(200)
+        assert rungs == sorted(set(rungs))  # strictly increasing
+        for n in range(1, 201):
+            assert bucket_cap(n) in rungs, (growth, n)
+        assert rungs[-1] == bucket_cap(200)
+
+
+def test_growth_15_ladder_values():
+    with prop_override("compile.bucket.growth", 1.5):
+        assert [bucket_cap(n) for n in (1, 2, 3, 7, 8, 9, 17, 100)] == [
+            1, 2, 3, 8, 8, 12, 18, 140,
+        ]
+
+
+def test_growth_leq_one_disables_bucketing():
+    for g in (0, 1.0, -2):
+        with prop_override("compile.bucket.growth", g):
+            for n in (1, 3, 7, 17, 100):
+                assert bucket_cap(n) == n
+            assert ladder(37) == [37]
+
+
+def test_min_rung_floor():
+    with prop_override("compile.bucket.min", 8):
+        assert bucket_cap(1) == 8
+        assert bucket_cap(3) == 8
+        assert bucket_cap(9) == 16
+        assert ladder(20)[0] == 8
+
+
+def test_dispatch_sites_ride_the_ladder():
+    """The pre-existing pow2 helpers route through the ladder: an
+    off-default growth must change what they return (the rewiring is
+    live, not just the new module)."""
+    from geomesa_tpu.device_cache import _next_pow2
+    from geomesa_tpu.ops.join import next_pow2
+
+    assert _next_pow2(9) == 16 and next_pow2(9) == 16
+    with prop_override("compile.bucket.growth", 3.0):
+        assert _next_pow2(9) == 9  # ladder 1,3,9
+        assert next_pow2(10) == 27
+    with prop_override("compile.bucket.growth", 0):
+        assert _next_pow2(9) == 9 and next_pow2(10) == 10

@@ -762,8 +762,11 @@ def test_failover_rearm_zero_missed_zero_duplicate(pair):
     lsrv.shutdown()
     _wait(lambda: _get(fbase, "/stats/replica")["role"] == "leader",
           timeout_s=30, msg="promotion")
+    # the role flips observable a few steps before note_promoted runs
+    # (the failover flight bundle writes in between): wait, don't race
+    _wait(lambda: _get(fbase, "/stats/pubsub")["rearms"] == 1,
+          msg="matcher re-armed")
     st = _get(fbase, "/stats/pubsub")
-    assert st["rearms"] == 1  # note_promoted re-armed the matcher
     assert [d["id"] for d in st["subscriptions"]] == [sub["id"]]
     # resume on the NEW leader from the acked cursor, then append more
     rd = _SSEReader(fbase, sub["id"], from_seq=cursor)

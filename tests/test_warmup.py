@@ -1,0 +1,222 @@
+"""AOT warmup (ISSUE 17): the closed bucket x kernel-family pre-compile
+pass. Proves the three load-bearing properties end to end on the CPU
+harness: (1) warmup charges the ``_system`` ledger tenant — never the
+request collector that happens to be installed on the caller's thread
+(the misattribution bugfix); (2) ``/readyz`` gates on warmup per
+``compile.warmup.gate`` with the ``warming`` stamp race-free from the
+moment ``start()`` returns; (3) after a warmup pass the base serving
+legs pay ZERO backend compiles — the acceptance criterion behind the
+fleet warm-handoff guarantee."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import ledger, metrics, warmup
+from geomesa_tpu.conf import prop_override
+from geomesa_tpu.device_cache import DeviceIndex
+from geomesa_tpu.filter.ecql import parse_instant
+from geomesa_tpu.server import serve_background
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "name:String,val:Int,dtg:Date,*geom:Point:srid=4326"
+
+
+def _store(n=800, tn="t"):
+    ds = MemoryDataStore()
+    ds.create_schema(tn, SPEC)
+    rng = np.random.default_rng(7)
+    t0 = parse_instant("2020-01-01T00:00:00")
+    ds.write(
+        tn,
+        {
+            "name": rng.choice(["a", "b"], n),
+            "val": rng.integers(0, 100, n),
+            "dtg": t0 + rng.integers(0, 10**8, n),
+            "geom": np.stack(
+                [rng.uniform(-20, 20, n), rng.uniform(-20, 20, n)], axis=1
+            ),
+        },
+        fids=np.arange(n),
+    )
+    return ds
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_run_charges_system_tenant_never_the_caller():
+    """The bugfix regression: a request collector installed on the
+    CALLER's thread must see none of the warmup compiles — every leg
+    runs under its own ``_system`` collector on the pool thread, so
+    ``/stats/ledger`` pins background compile seconds where they
+    belong instead of on the first unlucky tenant."""
+    di = DeviceIndex(_store(), "t", z_planes=True)
+    ledger.LEDGER.reset()
+    warmup.reset()
+    with ledger.collect_cost(
+        tenant="alice", endpoint="query", lane="online"
+    ) as cost:
+        out = warmup.run({"t": di}, threads=2, knn_kmax=8, fusion_max=4)
+    assert cost.snapshot_fields().get("compiles", 0) == 0
+    assert cost.snapshot_fields().get("compile_s", 0) == 0
+    snap = ledger.LEDGER.snapshot()
+    assert "_system" in snap["tenants"]
+    assert snap["tenants"]["_system"]["cost"].get("compiles", 0) > 0
+    assert "alice" not in snap["tenants"]  # alice's cost never recorded
+    # progress accounting closes: every leg lands in exactly one bucket
+    assert out["state"] == "warm" and out["failed"] == 0
+    assert out["done"] == out["signatures_total"] == len(
+        warmup.plan({"t": di}, knn_kmax=8, fusion_max=4)
+    )
+    assert out["compiled"] + out["from_cache"] == out["done"]
+    assert out["compiled"] > 0  # a fresh index really compiled
+    # ...and the progress gauge mirrors the document
+    assert metrics.warmup_signatures.value(state="total") == out["done"]
+    assert metrics.warmup_signatures.value(state="failed") == 0
+
+
+def test_warm_serving_path_pays_zero_compiles():
+    """The acceptance criterion in-process: after warmup, replaying the
+    base serving legs (plus same-bucket variants at other parameters)
+    attributes ZERO backend compiles in the compile ledger."""
+    di = DeviceIndex(_store(tn="g"), "g", z_planes=True)
+    warmup.reset()
+    out = warmup.run({"g": di}, threads=2, knn_kmax=16, fusion_max=8)
+    assert out["failed"] == 0
+    ledger.COMPILES.reset()
+    for _sig, fn in di.warmup_plan():
+        fn()
+    # same-bucket variants: different k / point / width, same rung
+    di.knn(1.5, -2.0, 5)  # kk rung 8, warmed
+    di.knn(0.0, 0.0, 13)  # kk rung 16, warmed via the k-ladder
+    from geomesa_tpu.filter import ast as _ast
+
+    q = _ast.BBox("geom", -0.05, -0.05, 0.05, 0.05)
+    di.fused_loose_counts([q] * 5)  # qcap rung 8, warmed
+    snap = ledger.COMPILES.snapshot()
+    assert snap["compiles"] == 0, snap["by_signature"]
+
+
+def test_failed_leg_is_counted_not_raised():
+    class _Boom:
+        def warmup_plan(self, knn_kmax=None, fusion_max=None):
+            return [("boom", self._die), ("ok", lambda: 1)]
+
+        def _die(self):
+            raise RuntimeError("kernel exploded")
+
+    warmup.reset()
+    out = warmup.run({"t": _Boom()}, threads=1)
+    assert out == {
+        "state": "warm", "signatures_total": 2, "done": 2,
+        "compiled": 0, "from_cache": 1, "failed": 1,
+        "seconds": out["seconds"],
+    }
+
+
+class _Blocked:
+    """Fake index whose single warmup leg parks until released — makes
+    the warming window deterministic for the readiness-gate tests."""
+
+    def __init__(self):
+        self.release = threading.Event()
+
+    def warmup_plan(self, knn_kmax=None, fusion_max=None):
+        return [("block", self.release.wait)]
+
+
+@pytest.fixture()
+def gated_server():
+    ds = _store(n=50)
+    server, _ = serve_background(ds)
+    host, port = server.server_address[:2]
+    url = f"http://{host}:{port}"
+    # simulate make_server's warm path: mark warmup started on this
+    # server's handler class and run a blocked pass
+    server.RequestHandlerClass._warmup_started = True
+    warmup.reset()
+    fake = _Blocked()
+    thread = warmup.start({"t": fake})
+    try:
+        yield url, fake, thread
+    finally:
+        fake.release.set()
+        thread.join(timeout=10)
+        server.shutdown()
+        warmup.reset()
+
+
+def test_readyz_gates_until_warm(gated_server):
+    url, fake, thread = gated_server
+    # start() stamps `warming` before returning: no ready-but-cold race
+    assert warmup.warming()
+    status, doc = _get(f"{url}/readyz")  # default gate: ready
+    assert status == 503 and doc["warming"] and not doc["ready"]
+    with prop_override("compile.warmup.gate", "stamp"):
+        status, doc = _get(f"{url}/readyz")
+        assert status == 200 and doc["warming"] and doc["ready"]
+    with prop_override("compile.warmup.gate", "off"):
+        status, doc = _get(f"{url}/readyz")
+        assert status == 200 and "warming" not in doc
+    # warmup progress is surfaced on /stats while warming
+    status, doc = _get(f"{url}/stats")
+    assert status == 200 and doc["warmup"]["state"] == "warming"
+    assert "compile_cache" in doc
+    fake.release.set()
+    thread.join(timeout=10)
+    status, doc = _get(f"{url}/readyz")
+    assert status == 200 and doc["ready"] and "warming" not in doc
+    status, doc = _get(f"{url}/stats")
+    assert doc["warmup"]["state"] == "warm"
+    assert doc["warmup"]["done"] == 1
+
+
+def test_warmup_cli_reports_remote_progress(gated_server, capsys):
+    """`geomesa-tpu warmup --url` is the operator's progress probe."""
+    from geomesa_tpu.tools.cli import main
+
+    url, fake, thread = gated_server
+    main(["warmup", "--url", url])
+    out = capsys.readouterr().out
+    assert "warming" in out and "0/1" in out
+    fake.release.set()
+    thread.join(timeout=10)
+    main(["warmup", "--url", url])
+    assert "warm" in capsys.readouterr().out
+
+
+def test_server_warm_runs_background_warmup():
+    """make_server(warm=True) + warmup enabled: the resident cache is
+    populated synchronously (the PR 4 contract) and the FULL bucket
+    ladder warms in the background under the ``_system`` tenant."""
+    ds = _store(n=60, tn="gdelt")
+    warmup.reset()
+    server, _ = serve_background(ds, resident=True, warm=True)
+    try:
+        host, port = server.server_address[:2]
+        url = f"http://{host}:{port}"
+        assert "gdelt" in server.RequestHandlerClass._resident_cache
+        # poll /readyz until the gate opens (bounded: legs are tiny)
+        for _ in range(600):
+            status, doc = _get(f"{url}/readyz")
+            if status == 200:
+                break
+            threading.Event().wait(0.1)
+        assert status == 200 and "warming" not in doc
+        status, doc = _get(f"{url}/stats")
+        assert doc["warmup"]["state"] == "warm"
+        assert doc["warmup"]["signatures_total"] > 0
+        assert doc["warmup"]["failed"] == 0
+    finally:
+        server.shutdown()
+        warmup.reset()

@@ -1,0 +1,209 @@
+"""Serving-path recompile tripwire: any backend compile outside the
+allowed ``compile_scope`` namespace while serving is live is a hard
+session-end failure.
+
+PR 17 killed the compile cliff by routing every serving-path jit
+through canonical shape buckets and pre-compiling them at warmup; the
+invariant that keeps it killed is *no novel compiles while serving*.
+This checker enforces it mechanically: it rides the existing
+:class:`~geomesa_tpu.ledger.CompileLedger` jax.monitoring hook (the
+backend-compile event fires synchronously on the thread that blocked on
+it), and while at least one server is live — :func:`make_server` /
+``_GeomesaHTTPServer.shutdown`` bracket the window — every compile must
+carry an allowed ``compile_scope`` family (:data:`ALLOWED_FAMILIES`:
+the :data:`~geomesa_tpu.ledger.SCOPE_FAMILIES` namespace plus the
+``warmup`` / ``_system`` staging scopes). A scope-less compile is a
+violation unless it is test-harness normality: on the main thread with
+no request collector attached. A scope-less compile on a worker thread,
+or one charged to a live (non-``_system``) request, is exactly the
+shape-cliff regression the bucketing ladder exists to prevent.
+
+Armed by ``GEOMESA_TPU_COMPILECHECK=1``; unset, the ledger's compile
+observer list stays empty and the server lifecycle hooks are a single
+env check — zero production overhead. The conftest arms it for the
+whole tier-1 suite and fails the session on any violation; seeded tests
+use a private :class:`CompileCheck` (or monkeypatch :data:`CHECKER`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = [
+    "ENV_VAR",
+    "ALLOWED_FAMILIES",
+    "CHECKER",
+    "CompileCheck",
+    "enabled",
+    "install",
+]
+
+ENV_VAR = "GEOMESA_TPU_COMPILECHECK"
+
+
+def enabled() -> bool:
+    """True when the environment arms the checker (read dynamically;
+    the server lifecycle hooks check it per call)."""
+    return os.environ.get(ENV_VAR, "").strip().lower() in (
+        "1", "true", "t", "yes", "on",
+    )
+
+
+def _allowed_families() -> frozenset:
+    from geomesa_tpu import ledger
+
+    return frozenset(
+        fam for fam, _ in ledger.SCOPE_FAMILIES
+    ) | {"warmup", "_system"}
+
+
+#: the allowed compile_scope namespace while serving is live: the
+#: documented SCOPE_FAMILIES plus the warmup/_system staging scopes
+ALLOWED_FAMILIES = _allowed_families()
+
+
+def _family(signature: str) -> str:
+    """The bounded family component of a scope signature
+    (``fused.dim:r=64:q=8`` -> ``fused.dim``)."""
+    return str(signature).split(":", 1)[0]
+
+
+class CompileCheck:
+    """Serving-window refcount plus the violation store. The
+    module-level :data:`CHECKER` is the process-wide one; tests build
+    private instances for seeded scenarios."""
+
+    def __init__(self, name: str = "global"):
+        self.name = name
+        # the checker's own mutex must be invisible to lockcheck
+        self._mu = threading.Lock()  # lint: disable=GT001(the checker's internal mutex cannot be a checked lock)
+        self._serving = 0
+        self._violations: list = []
+        self._keys: set = set()
+        self.compiles = 0
+        self.serving_compiles = 0
+
+    # -- serving window (bracketed by the server lifecycle) -----------------
+
+    def serving_up(self) -> None:
+        with self._mu:
+            self._serving += 1
+
+    def serving_down(self) -> None:
+        with self._mu:
+            self._serving = max(self._serving - 1, 0)
+
+    @property
+    def serving(self) -> bool:
+        with self._mu:
+            return self._serving > 0
+
+    # -- recording (fed by the ledger compile-observer seam) ----------------
+
+    def on_compile(self, scope, cost, dur_s: float) -> None:
+        """One backend compile finished on the calling thread. ``scope``
+        is the RAW active ``compile_scope`` (None when absent); ``cost``
+        the active request collector."""
+        with self._mu:
+            self.compiles += 1
+            if self._serving <= 0:
+                return
+            self.serving_compiles += 1
+        tenant = getattr(cost, "tenant", "") if cost is not None else ""
+        if scope is not None:
+            fam = _family(scope)
+            if fam in ALLOWED_FAMILIES:
+                return
+            self._record(
+                (fam,),
+                scope=str(scope),
+                family=fam,
+                thread=threading.current_thread().name,
+                tenant=tenant,
+                seconds=round(float(dur_s), 4),
+                detail="compile under a scope family outside the "
+                "documented SCOPE_FAMILIES namespace while serving",
+            )
+            return
+        on_main = threading.current_thread() is threading.main_thread()
+        if cost is None and on_main:
+            return  # test-harness / interactive compiles are normal
+        if cost is not None and tenant == "_system":
+            return  # warmup / background staging legs compile on purpose
+        self._record(
+            (threading.current_thread().name, tenant),
+            scope=None,
+            thread=threading.current_thread().name,
+            tenant=tenant,
+            seconds=round(float(dur_s), 4),
+            detail="scope-less backend compile while serving: a live "
+            "request (or a worker thread) hit a jit cache miss outside "
+            "every compile_scope -- a per-shape compile cliff regrowing",
+        )
+
+    def _record(self, key: tuple, **detail) -> None:
+        with self._mu:
+            if key in self._keys:
+                return
+            self._keys.add(key)
+            self._violations.append(dict(detail))
+
+    # -- read side ----------------------------------------------------------
+
+    def report(self) -> dict:
+        """The violations document plus activity counters; pushes the
+        ``geomesa_compilecheck_*`` gauges for the global checker."""
+        with self._mu:
+            doc = {
+                "checker": self.name,
+                "compiles": int(self.compiles),
+                "serving_compiles": int(self.serving_compiles),
+                "serving": self._serving > 0,
+                "violations": [dict(v) for v in self._violations],
+            }
+        self._publish(doc)
+        return doc
+
+    def _publish(self, doc: dict) -> None:
+        if self is not CHECKER:
+            return  # private (seeded-test) checkers stay off the metrics
+        try:
+            from geomesa_tpu import metrics
+
+            metrics.compilecheck_compiles.set(doc["serving_compiles"])
+            metrics.compilecheck_violations.set(len(doc["violations"]))
+        except Exception:  # pragma: no cover - observability must not break
+            pass
+
+    def clear(self) -> None:
+        with self._mu:
+            self._violations.clear()
+            self._keys.clear()
+            self.compiles = 0
+            self.serving_compiles = 0
+
+
+CHECKER = CompileCheck()
+
+
+def _on_compile(scope, cost, dur_s):
+    # dispatches to the CURRENT module attribute so tests can swap
+    # CHECKER for a private instance without re-arming the seam
+    CHECKER.on_compile(scope, cost, dur_s)
+
+
+_installed = False
+
+
+def install() -> None:
+    """Arm the ledger compile-observer seam and the jax.monitoring
+    listener (idempotent; conftest calls this when the env is set)."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    from geomesa_tpu import ledger
+
+    ledger.add_compile_observer(_on_compile)
+    ledger.install()

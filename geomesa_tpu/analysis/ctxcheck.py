@@ -1,0 +1,318 @@
+"""Runtime context-propagation checker: lockcheck's twin for the
+request-context set that must cross every pool boundary.
+
+Contextvars are per-thread, so work handed to a worker only keeps its
+request's tracing span, ledger :class:`~geomesa_tpu.ledger.RequestCost`
+collector, degradation collector and ``compile_scope`` if the submit
+site explicitly captured-and-attached them — the discipline
+:mod:`geomesa_tpu.spawn` packages and lint rule GT010 enforces
+statically. This module checks the part statics cannot see: that the
+contexts actually attached at RUN time match what was live at SUBMIT
+time, and that the accounting events a worker task emits (device
+seconds, compile seconds, degradation stamps) land in a collector the
+task was legitimately handed. The PR 17 warmup bug — a background
+compile charging whichever request happened to be in flight — becomes a
+session-end report line instead of a p99 mystery.
+
+Armed by ``GEOMESA_TPU_CTXCHECK=1`` (read dynamically, like lockcheck);
+unset, the blessed spawn wrappers take their plain path and the ledger /
+resilience observer seams stay ``None`` — zero production overhead.
+Armed, :func:`install` hooks the seams and every blessed task is
+bracketed by :meth:`CtxCheck.task`:
+
+- **ctx-leak** — a task returned with a DIFFERENT ambient context set
+  than the worker thread had before it ran: the task attached a
+  context and failed to reset it, poisoning every later task on that
+  pool thread.
+- **mismatched-cost** — a context-routed ledger charge hit a
+  :class:`RequestCost` that was never attached on the charging thread
+  (someone smuggled a collector across a pool without the blessed
+  capture/attach, i.e. exactly how misattribution starts).
+- **orphan-degraded** — a degradation stamp landed in a collector the
+  stamping thread was never handed.
+- **orphan-compile** — a backend compile finished on a non-main thread
+  with no ``compile_scope`` and no request collector: nobody will ever
+  be charged for those compile seconds (the PR 17 class).
+
+The conftest arms the env for the whole tier-1 suite, installs the
+seams, prints :meth:`CtxCheck.report` at session end and fails the run
+on any finding. Seeding tests use a private :class:`CtxCheck` (or
+monkeypatch :data:`CHECKER`) so deliberate violations never pollute the
+global report.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+
+__all__ = [
+    "ENV_VAR",
+    "CHECKER",
+    "CtxCheck",
+    "enabled",
+    "install",
+]
+
+ENV_VAR = "GEOMESA_TPU_CTXCHECK"
+
+
+def enabled() -> bool:
+    """True when the environment arms the checker (read per spawn, so a
+    test can arm a private checker without re-importing the package —
+    but the observer seams only feed events after :func:`install`)."""
+    return os.environ.get(ENV_VAR, "").strip().lower() in (
+        "1", "true", "t", "yes", "on",
+    )
+
+
+def _ambient() -> tuple:
+    """Identity snapshot of the calling thread's full context set (the
+    ctx-leak comparison wants IDENTITY, not equality — two empty reason
+    lists are different collectors)."""
+    from geomesa_tpu import ledger, resilience, tracing
+
+    return (
+        id(tracing.capture()),
+        id(ledger.capture_cost()),
+        id(resilience.capture_degraded()),
+        ledger.capture_scope(),
+    )
+
+
+class CtxCheck:
+    """One findings store plus per-thread attach bookkeeping. The
+    module-level :data:`CHECKER` is the process-wide one the observer
+    seams feed; tests build private instances for seeded scenarios."""
+
+    def __init__(self, name: str = "global"):
+        self.name = name
+        # the checker's own mutex must be invisible to itself
+        self._mu = threading.Lock()  # lint: disable=GT001(the checker's internal mutex cannot be a checked lock)
+        self._tls = threading.local()
+        self._findings: list = []
+        self._keys: set = set()
+        self.tasks = 0
+        self.attaches = 0
+        self.charges = 0
+        self.compiles = 0
+
+    # -- per-thread state ---------------------------------------------------
+
+    def _allowed(self) -> dict:
+        """id -> [attach_depth, obj] for every collector currently
+        attached on THIS thread (the obj ref pins the id against
+        reuse). Fed by the ledger/resilience attach seams."""
+        a = getattr(self._tls, "allowed", None)
+        if a is None:
+            a = self._tls.allowed = {}
+        return a
+
+    def _task_rec(self) -> "dict | None":
+        return getattr(self._tls, "task", None)
+
+    # -- recording (fed by spawn._blessed and the observer seams) -----------
+
+    @contextmanager
+    def task(self, kind: str, label: str, ctx):
+        """Bracket one blessed worker task (:mod:`geomesa_tpu.spawn`
+        wraps the worker body in this OUTSIDE the context attach, so the
+        pre/post snapshots see the worker's ambient state)."""
+        prev = self._task_rec()
+        rec = {
+            "kind": kind,
+            "label": label,
+            "thread": threading.current_thread().name,
+            "declared": bool(ctx is not None and ctx.any()),
+        }
+        self._tls.task = rec
+        pre = _ambient()
+        with self._mu:
+            self.tasks += 1
+        try:
+            yield
+        finally:
+            post = _ambient()
+            if post != pre:
+                self._record(
+                    "ctx-leak",
+                    (kind, label),
+                    task=f"{kind}:{label}",
+                    thread=rec["thread"],
+                    detail="worker ambient context set changed across the "
+                    "task (an attach was not reset; later tasks on this "
+                    "pool thread inherit a dead request's context)",
+                )
+            self._tls.task = prev
+
+    def on_attach(self, obj, entering: bool) -> None:
+        """A cost or degradation collector was attached on (entering)
+        or detached from (exiting) the calling thread."""
+        if obj is None:
+            return
+        allowed = self._allowed()
+        key = id(obj)
+        if entering:
+            with self._mu:
+                self.attaches += 1
+            ent = allowed.get(key)
+            if ent is None:
+                allowed[key] = [1, obj]
+            else:
+                ent[0] += 1
+        else:
+            ent = allowed.get(key)
+            if ent is not None:
+                ent[0] -= 1
+                if ent[0] <= 0:
+                    del allowed[key]
+
+    def on_charge(self, cost, field: str) -> None:
+        """A context-routed ledger charge is about to fold into
+        ``cost`` (None = dropped on the floor, which is legal)."""
+        with self._mu:
+            self.charges += 1
+        if cost is None:
+            return
+        if id(cost) not in self._allowed():
+            rec = self._task_rec()
+            self._record(
+                "mismatched-cost",
+                (getattr(cost, "tenant", ""), field,
+                 threading.current_thread().name),
+                task=(f"{rec['kind']}:{rec['label']}" if rec else None),
+                thread=threading.current_thread().name,
+                field=field,
+                tenant=getattr(cost, "tenant", ""),
+                detail="charge hit a RequestCost never attached on this "
+                "thread -- a collector crossed a pool boundary outside "
+                "the blessed capture/attach",
+            )
+
+    def on_degraded(self, reasons, reason: str) -> None:
+        """A degradation stamp is about to append to ``reasons``."""
+        if reasons is None:
+            return
+        if id(reasons) not in self._allowed():
+            rec = self._task_rec()
+            self._record(
+                "orphan-degraded",
+                (reason, threading.current_thread().name),
+                task=(f"{rec['kind']}:{rec['label']}" if rec else None),
+                thread=threading.current_thread().name,
+                reason=reason,
+                detail="degradation stamp landed in a collector this "
+                "thread was never handed",
+            )
+
+    def on_compile(self, scope, cost, dur_s: float) -> None:
+        """A backend compile finished on the calling thread (raw scope:
+        None when no ``compile_scope`` was active)."""
+        with self._mu:
+            self.compiles += 1
+        if scope is not None or cost is not None:
+            return
+        if threading.current_thread() is threading.main_thread():
+            return  # interactive / test-harness compiles are normal
+        rec = self._task_rec()
+        self._record(
+            "orphan-compile",
+            (threading.current_thread().name,),
+            task=(f"{rec['kind']}:{rec['label']}" if rec else None),
+            thread=threading.current_thread().name,
+            seconds=round(float(dur_s), 4),
+            detail="backend compile on a worker thread with no "
+            "compile_scope and no request collector: these compile "
+            "seconds are unattributable (the PR 17 warmup bug class)",
+        )
+
+    def _record(self, kind: str, key: tuple, **detail) -> None:
+        with self._mu:
+            k = (kind,) + key
+            if k in self._keys:
+                return
+            self._keys.add(k)
+            self._findings.append({"kind": kind, **detail})
+
+    # -- read side ----------------------------------------------------------
+
+    def report(self) -> dict:
+        """The findings document plus activity counters; pushes the
+        ``geomesa_ctxcheck_*`` gauges for the global checker."""
+        with self._mu:
+            doc = {
+                "checker": self.name,
+                "tasks": int(self.tasks),
+                "attaches": int(self.attaches),
+                "charges": int(self.charges),
+                "compiles": int(self.compiles),
+                "findings": [dict(f) for f in self._findings],
+            }
+        self._publish(doc)
+        return doc
+
+    def _publish(self, doc: dict) -> None:
+        if self is not CHECKER:
+            return  # private (seeded-test) checkers stay off the metrics
+        try:
+            from geomesa_tpu import metrics
+
+            metrics.ctxcheck_tasks.set(doc["tasks"])
+            metrics.ctxcheck_findings.set(len(doc["findings"]))
+        except Exception:  # pragma: no cover - observability must not break
+            pass
+
+    def clear(self) -> None:
+        with self._mu:
+            self._findings.clear()
+            self._keys.clear()
+            self.tasks = 0
+            self.attaches = 0
+            self.charges = 0
+            self.compiles = 0
+
+
+CHECKER = CtxCheck()
+
+
+# The seams call these forwarders, which dispatch to the CURRENT module
+# attribute -- so a test can swap CHECKER for a private instance without
+# re-arming the seams.
+
+
+def _on_attach(obj, entering):
+    CHECKER.on_attach(obj, entering)
+
+
+def _on_charge(cost, field):
+    CHECKER.on_charge(cost, field)
+
+
+def _on_degraded(reasons, reason):
+    CHECKER.on_degraded(reasons, reason)
+
+
+def _on_compile(scope, cost, dur_s):
+    CHECKER.on_compile(scope, cost, dur_s)
+
+
+_installed = False
+
+
+def install() -> None:
+    """Arm the ledger/resilience observer seams and the jax.monitoring
+    compile listener (idempotent). The conftest calls this once at
+    session start when the env is set."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    from geomesa_tpu import ledger, resilience
+
+    ledger.set_charge_observer(_on_charge)
+    ledger.set_attach_observer(_on_attach)
+    ledger.add_compile_observer(_on_compile)
+    resilience.set_attach_observer(_on_attach)
+    resilience.set_degraded_observer(_on_degraded)
+    ledger.install()  # compile events flow from the first jit, not the first server

@@ -11,7 +11,11 @@ exactly the silent regression the linter exists to prevent).
 Entry points: :func:`lint_paths` (files/directories), :func:`lint_package`
 (the installed ``geomesa_tpu`` tree -- what the self-lint test and the
 ``geomesa-tpu lint`` default run), and :func:`main` (CLI body; exit 0
-clean / 1 findings / 2 unreadable input).
+clean / 1 findings / 2 unreadable input). ``main`` also grows the CI
+surface: ``fmt="json"``/``"sarif"`` emit machine-readable findings
+(SARIF 2.1.0 for code-scanning upload) and ``changed=True`` scopes the
+run to files touched per ``git diff`` -- exit codes are identical in
+every mode so pipelines never special-case the format.
 
 The linter is purely static: it parses source text and never imports
 the code under analysis, so it runs without jax and can lint fixture
@@ -32,6 +36,9 @@ __all__ = [
     "lint_paths",
     "lint_package",
     "format_findings",
+    "findings_to_json",
+    "findings_to_sarif",
+    "changed_paths",
     "main",
 ]
 
@@ -308,16 +315,173 @@ def format_findings(findings) -> str:
     return "\n".join(f.format() for f in findings)
 
 
-def main(paths=None, out=print) -> int:
+# -- machine-readable emitters (CI surface) ----------------------------------
+
+
+def _rule_titles() -> "dict[str, str]":
+    from geomesa_tpu.analysis.rules import ALL_RULES
+
+    return {r.CODE: r.TITLE for r in ALL_RULES}
+
+
+def findings_to_json(findings) -> str:
+    """Findings as a JSON array (stable keys: rule/path/line/col/
+    message/title) -- the greppable CI artifact."""
+    import json
+
+    titles = _rule_titles()
+    return json.dumps(
+        [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "message": f.message,
+                "title": titles.get(f.rule, ""),
+            }
+            for f in findings
+        ],
+        indent=2,
+    )
+
+
+def findings_to_sarif(findings) -> str:
+    """Findings as a minimal SARIF 2.1.0 log -- one run, one rule entry
+    per GT code, one result per finding -- the shape GitHub code
+    scanning (and every SARIF viewer) ingests. Paths are emitted
+    relative to the working directory when possible so the artifact is
+    portable across checkouts."""
+    import json
+
+    titles = _rule_titles()
+    cwd = os.getcwd()
+
+    def _uri(path: str) -> str:
+        try:
+            rel = os.path.relpath(path, cwd)
+        except ValueError:  # different drive (windows): keep absolute
+            rel = path
+        if rel.startswith(".."):
+            rel = path
+        return rel.replace(os.sep, "/")
+
+    used = sorted({f.rule for f in findings})
+    doc = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "geomesa-tpu-lint",
+                        "informationUri": (
+                            "https://github.com/geomesa/geomesa-tpu"
+                        ),
+                        "rules": [
+                            {
+                                "id": code,
+                                "shortDescription": {
+                                    "text": titles.get(code, code)
+                                },
+                            }
+                            for code in used
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {
+                                        "uri": _uri(f.path)
+                                    },
+                                    "region": {
+                                        "startLine": f.line,
+                                        "startColumn": f.col,
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)
+
+
+def changed_paths(base: "str | None" = None) -> "list[str]":
+    """Python files touched per git: ``git diff --name-only`` against
+    ``base`` (default: the working tree + index vs HEAD, plus
+    untracked ``*.py``) -- the ``lint --changed`` scope. Paths outside
+    the repo's ``geomesa_tpu`` tree are kept (fixture trees lint too);
+    deleted files are dropped. Raises ``RuntimeError`` when git is
+    unavailable or the cwd is not a repository."""
+    import subprocess
+
+    def _git(*args: str) -> "list[str]":
+        proc = subprocess.run(
+            ("git",) + args,
+            capture_output=True,
+            text=True,
+            check=False,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)} failed: {proc.stderr.strip()}"
+            )
+        return [ln for ln in proc.stdout.splitlines() if ln.strip()]
+
+    names: "list[str]" = []
+    if base:
+        names += _git("diff", "--name-only", base, "--")
+    else:
+        names += _git("diff", "--name-only", "HEAD", "--")
+        names += _git(
+            "ls-files", "--others", "--exclude-standard", "--", "*.py"
+        )
+    out, seen = [], set()
+    for n in names:
+        if not n.endswith(".py") or n in seen:
+            continue
+        seen.add(n)
+        if os.path.isfile(n):  # deleted files have nothing to lint
+            out.append(n)
+    return sorted(out)
+
+
+def main(paths=None, out=print, fmt="text", changed=False) -> int:
     """CLI body (``geomesa-tpu lint``): 0 clean, 1 findings, 2 on an
-    unreadable input path."""
+    unreadable input path or an unusable ``--changed`` scope. ``fmt``
+    picks the emitter (``text``/``json``/``sarif``); json and sarif
+    ALWAYS emit a document, even when clean, so CI can upload the
+    artifact unconditionally."""
     try:
-        findings = lint_paths(paths) if paths else lint_package()
+        if changed:
+            scope = changed_paths()
+            findings = lint_paths(scope) if scope else []
+        else:
+            findings = lint_paths(paths) if paths else lint_package()
     except FileNotFoundError as e:
         out(f"error: no such file or directory: {e}")
         return 2
-    if findings:
+    except RuntimeError as e:
+        out(f"error: {e}")
+        return 2
+    if fmt == "json":
+        out(findings_to_json(findings))
+    elif fmt == "sarif":
+        out(findings_to_sarif(findings))
+    elif findings:
         out(format_findings(findings))
         out(f"{len(findings)} finding(s)")
-        return 1
-    return 0
+    return 1 if findings else 0

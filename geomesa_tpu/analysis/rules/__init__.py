@@ -1,4 +1,4 @@
-"""The GT001-GT009 rule modules, one per rule, plus shared AST helpers.
+"""The GT001-GT012 rule modules, one per rule, plus shared AST helpers.
 
 A rule module exposes ``CODE`` (the GTnnn id), ``TITLE`` (one line for
 the README/CLI table) and ``check(ctx)`` yielding
@@ -24,6 +24,9 @@ from geomesa_tpu.analysis.rules import (
     gt007_publish_fsync,
     gt008_conf_keys,
     gt009_slo_registries,
+    gt010_blessed_spawn,
+    gt011_taxonomy_bypass,
+    gt012_unbucketed_dims,
 )
 
 ALL_RULES = (
@@ -36,6 +39,9 @@ ALL_RULES = (
     gt007_publish_fsync,
     gt008_conf_keys,
     gt009_slo_registries,
+    gt010_blessed_spawn,
+    gt011_taxonomy_bypass,
+    gt012_unbucketed_dims,
 )
 
 RULE_TABLE = [(r.CODE, r.TITLE) for r in ALL_RULES]

@@ -5,9 +5,10 @@
 the dispatch pipeline; inside a loop that is one round trip PER
 ITERATION -- the anti-pattern the fused/batched launches of PRs 1-2
 exist to avoid. Scoped to the files where a loop is plausibly iterating
-device work: ``ops/``, ``query/runner.py``, ``sched/fusion.py``.
-Intended sync points (the mask fetch that ends a launch) carry a
-reasoned disable comment.
+device work: ``ops/``, ``join/``, ``results/``, ``query/runner.py``,
+``sched/fusion.py``, ``pubsub/matcher.py``, ``warmup.py``. Intended
+sync points (the mask fetch that ends a launch) carry a reasoned
+disable comment.
 """
 
 from __future__ import annotations
@@ -19,8 +20,13 @@ from geomesa_tpu.analysis.astutil import receiver_name, walk_no_defs
 CODE = "GT004"
 TITLE = "host sync (np.asarray/device_get/block_until_ready/.item) in a device hot-path loop"
 
-_HOT_PREFIXES = ("ops/",)
-_HOT_FILES = {"query/runner.py", "sched/fusion.py"}
+_HOT_PREFIXES = ("ops/", "join/", "results/")
+_HOT_FILES = {
+    "query/runner.py",
+    "sched/fusion.py",
+    "pubsub/matcher.py",
+    "warmup.py",
+}
 
 _NP_SYNCS = {"asarray", "array"}
 _ANY_SYNCS = {"block_until_ready", "item"}

@@ -1,0 +1,68 @@
+"""GT010: raw thread/pool construction outside the blessed spawn helper.
+
+Contextvars are per-thread: a raw ``threading.Thread`` /
+``ThreadPoolExecutor`` silently drops the submitting request's full
+context set (tracing span, ledger cost collector, degradation
+collector, ``compile_scope``) — the PR 17 warmup-misattribution bug
+class. Every spawn site must go through :mod:`geomesa_tpu.spawn`
+(``spawn_thread`` / ``ContextPool``), which captures-and-attaches the
+set (or explicitly declares a context-less service thread with
+``context=False``) and is the instrumentation point for the runtime
+context checker (``GEOMESA_TPU_CTXCHECK=1``). The factory's own backing
+constructors carry reasoned disables, exactly like GT001's
+``locking.py`` exemption.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from geomesa_tpu.analysis.astutil import receiver_name
+
+CODE = "GT010"
+TITLE = (
+    "raw threading.Thread/ThreadPoolExecutor -- use spawn.spawn_thread()/"
+    "ContextPool so request contexts cross the pool boundary"
+)
+
+#: constructor names that create a thread of execution the request
+#: contexts will not follow
+_SPAWNERS = {
+    "Thread",
+    "Timer",
+    "ThreadPoolExecutor",
+    "ProcessPoolExecutor",
+    "start_new_thread",
+}
+
+#: modules whose import makes a bare Name call a spawn site
+_SPAWN_MODULES = ("threading", "concurrent.futures", "_thread")
+
+
+def check(ctx):
+    imported = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module in _SPAWN_MODULES:
+            for alias in node.names:
+                if alias.name in _SPAWNERS:
+                    imported.add(alias.asname or alias.name)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        raw = None
+        if isinstance(func, ast.Attribute) and func.attr in _SPAWNERS:
+            recv = receiver_name(func) or ""
+            if recv in ("threading", "futures", "_thread"):
+                raw = f"{recv}.{func.attr}"
+        elif isinstance(func, ast.Name) and func.id in imported:
+            raw = func.id
+        if raw is not None:
+            yield ctx.finding(
+                CODE,
+                node,
+                f"raw {raw}() drops the request context set (trace, cost, "
+                "degraded, compile_scope) at the pool boundary -- use "
+                "spawn.spawn_thread()/spawn.ContextPool (context=False for "
+                "service threads that attach per-item contexts themselves)",
+            )

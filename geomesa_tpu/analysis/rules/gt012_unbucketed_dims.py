@@ -1,0 +1,69 @@
+"""GT012: hand-rolled capacity rounding on the compile-shape paths.
+
+PR 17 killed the compile cliff by making every dynamic size that
+reaches a jit cache key or pad/capacity computation pass through ONE
+ladder (:func:`geomesa_tpu.bucketing.bucket_cap`): a closed, conf-tuned
+shape set that warmup can pre-compile. A hand-rolled next-power-of-two
+(``1 << (n - 1).bit_length()``, ``math.log2``/``ceil`` arithmetic) on
+those paths silently regrows a per-shape compile cliff the ladder no
+longer covers — and warmup cannot pre-compile shapes it cannot
+enumerate. Scoped to the modules that build jit cache keys and padded
+capacities: ``ops/``, ``device_cache.py``, ``join/``. A genuinely
+non-shape use of ``bit_length`` there (bit math on key encodings)
+carries a reasoned disable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from geomesa_tpu.analysis.astutil import receiver_name
+
+CODE = "GT012"
+TITLE = (
+    "hand-rolled capacity rounding (bit_length/log2) on a compile-shape "
+    "path -- route dynamic sizes through bucketing.bucket_cap()"
+)
+
+_SHAPE_PREFIXES = ("ops/", "join/")
+_SHAPE_FILES = {"device_cache.py"}
+
+
+def _applies(rel: str) -> bool:
+    rel = rel.removeprefix("geomesa_tpu/")
+    return rel in _SHAPE_FILES or any(
+        rel.startswith(p) for p in _SHAPE_PREFIXES
+    )
+
+
+def _rolled(call: ast.Call) -> "str | None":
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr == "bit_length":
+        return ".bit_length()"
+    if func.attr == "log2" and (receiver_name(func) or "") in (
+        "math",
+        "np",
+        "numpy",
+    ):
+        return f"{receiver_name(func)}.log2()"
+    return None
+
+
+def check(ctx):
+    if not _applies(ctx.rel):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        what = _rolled(node)
+        if what:
+            yield ctx.finding(
+                CODE,
+                node,
+                f"{what} rounds a dynamic size by hand on a compile-shape "
+                "path -- bucketing.bucket_cap() keeps the shape set closed "
+                "(and warmup pre-compilable); a non-shape bit-math use "
+                "gets a reasoned disable",
+            )

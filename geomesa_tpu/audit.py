@@ -20,6 +20,7 @@ import time
 from dataclasses import asdict, dataclass, field
 
 from geomesa_tpu.locking import checked_lock
+from geomesa_tpu.spawn import spawn_thread
 
 
 @dataclass
@@ -59,7 +60,9 @@ class AuditWriter:
 
     def __init__(self):
         self._q: "queue.Queue" = queue.Queue()
-        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread = spawn_thread(
+            self._drain, name="audit-drain", context=False
+        )
         self._started = False
         self._closed = False
         self._lock = checked_lock("audit.writer")

@@ -596,6 +596,8 @@ _NON_PROP_ENV = frozenset(
         "GEOMESA_TPU_ROOT",  # tools/cli.py default store root
         "GEOMESA_TPU_FAILPOINTS",  # failpoints.py activation list
         "GEOMESA_TPU_LOCKCHECK",  # analysis/lockcheck.py switch
+        "GEOMESA_TPU_CTXCHECK",  # analysis/ctxcheck.py switch
+        "GEOMESA_TPU_COMPILECHECK",  # analysis/compilecheck.py switch
         "GEOMESA_TPU_NO_NATIVE",  # native.py opt-out
         "GEOMESA_TPU_COMPILE_CACHE",  # jaxconf.py cache dir override
     }

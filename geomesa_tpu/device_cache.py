@@ -13,7 +13,7 @@ layer's listener).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import partial, wraps
 
 import numpy as np
 
@@ -192,6 +192,24 @@ def _z_planes_np(batch, sft: SimpleFeatureType):
     if bins is not None:
         planes[Z_BIN] = bins.astype(np.int32)
     return kind, planes, bins
+
+
+def _scan_scoped(fn):
+    """Ambient ``cache.scan`` compile attribution for the resident scan
+    entry points: a per-filter kernel a count/mask dispatch compiles is
+    claimed by this family unless a narrower scope (fused.*, knn,
+    join.*) already holds -- the serving-path recompile tripwire
+    (analysis/compilecheck.py) requires every live compile to carry a
+    blessed family."""
+
+    @wraps(fn)
+    def wrapped(*args, **kwargs):
+        from geomesa_tpu import ledger
+
+        with ledger.compile_scope("cache.scan"):
+            return fn(*args, **kwargs)
+
+    return wrapped
 
 
 class DeviceIndex:
@@ -1082,6 +1100,7 @@ class DeviceIndex:
             )
         return parse_ecql(query) if isinstance(query, str) else query
 
+    @_scan_scoped
     def count(
         self, query, loose: "bool | None" = None, auths=None
     ) -> int:
@@ -1272,14 +1291,16 @@ class DeviceIndex:
         )
         from geomesa_tpu import ledger
 
-        # r and qcap are pow2-bucketed: the signature space stays bounded
+        # r and qcap are pow2-bucketed: the signature space stays bounded.
+        # The result slice stays inside the scope: it is an eager device
+        # op whose (qcap, len) signature compiles its own tiny kernel.
         with ledger.compile_scope(f"fused.dim:r={r}:q={qcap}:{want}"):
             out = fn(
                 planes,
                 jnp.asarray(qmat),
                 self._device_valid() if want == "count" else None,
             )
-        return out[: len(lbs)]
+            return out[: len(lbs)]
 
     def _fused_compare(self, lbs, qcap, want: str):
         """Stacked masked-compare / range-list launch: per-query bounds
@@ -1342,6 +1363,7 @@ class DeviceIndex:
             self._fused_jits[key] = fn
         from geomesa_tpu import ledger
 
+        # slice inside the scope: the eager trim compiles its own kernel
         with ledger.compile_scope(f"fused.cmp:{kind}:q={qcap}:{want}"):
             out = fn(
                 self._cols[Z_HI],
@@ -1351,8 +1373,9 @@ class DeviceIndex:
                 jnp.asarray(idm) if idm is not None else None,
                 self._device_valid() if want == "count" else None,
             )
-        return out[: len(lbs)]
+            return out[: len(lbs)]
 
+    @_scan_scoped
     def mask(
         self, query, loose: "bool | None" = None, auths=None
     ) -> np.ndarray:
@@ -1661,15 +1684,21 @@ class DeviceIndex:
                 sub[c] = self._cols[c]
         if has_vis:
             sub[VIS_ID] = self._cols[VIS_ID]
-        mask = np.asarray(
-            fn(
-                sub,
-                jnp.asarray(env_pad),
-                targs if use_time else None,
-                self._device_valid(),
-                self._auth_table(auths) if has_vis else None,
-            )
-        )[: self._staged_len()]
+        from geomesa_tpu import ledger
+
+        # window cap and base filter are the only compile dims (windows
+        # themselves are runtime arrays): the union scan is a resident
+        # per-filter kernel, so it compiles under the cache.scan family
+        with ledger.compile_scope("cache.scan"):
+            mask = np.asarray(
+                fn(
+                    sub,
+                    jnp.asarray(env_pad),
+                    targs if use_time else None,
+                    self._device_valid(),
+                    self._auth_table(auths) if has_vis else None,
+                )
+            )[: self._staged_len()]
         return self._host_rows().take(np.nonzero(mask)[0])
 
     def knn(
@@ -2388,22 +2417,28 @@ class DeviceIndex:
             grid = jnp.zeros(cap, jnp.float32)
             return {"grid": grid.at[py * wh[0] + px].add(contrib)}
 
+        from geomesa_tpu import ledger
+
         # the viewport is a RUNTIME argument: one compiled kernel per
         # (filter, canvas bucket) serves every bbox a panning map client
-        # sends, instead of a recompile + retained cache entry per bbox
-        env_arr = jnp.asarray(
-            [envelope.xmin, envelope.ymin, envelope.xmax, envelope.ymax]
-        )
-        wh = jnp.asarray([width, height], jnp.int32)
-        agg_key = (
-            ("density", width, height, weight_attr)
-            if kern is not None
-            else ("density", cap, weight_attr)
-        )
-        outs = self._fused_agg(
-            f, loose, agg_key, agg_build, extra=(env_arr, wh),
-            auths=auths,
-        )
+        # sends, instead of a recompile + retained cache entry per bbox.
+        # The eager viewport converts compile tiny kernels of their own,
+        # so they sit inside the family scope too (the launch below
+        # overrides with its narrower _fused_agg signature).
+        with ledger.compile_scope("fused.agg:density"):
+            env_arr = jnp.asarray(
+                [envelope.xmin, envelope.ymin, envelope.xmax, envelope.ymax]
+            )
+            wh = jnp.asarray([width, height], jnp.int32)
+            agg_key = (
+                ("density", width, height, weight_attr)
+                if kern is not None
+                else ("density", cap, weight_attr)
+            )
+            outs = self._fused_agg(
+                f, loose, agg_key, agg_build, extra=(env_arr, wh),
+                auths=auths,
+            )
         if outs is None:
             return None
         grid = np.asarray(outs["grid"])
@@ -2669,6 +2704,18 @@ class StreamingDeviceIndex(DeviceIndex):
 
     def _install(self, batch, min_cap: int = 0) -> None:
         """Full (re)stage of ``batch`` into fresh capacity-padded buffers."""
+        from geomesa_tpu import ledger
+        from geomesa_tpu.tracing import span
+
+        # same attribution as the base-class refresh(): every full
+        # restage (init, growth, compaction) is a cache.stage compile,
+        # and the serving-path tripwire (analysis/compilecheck.py)
+        # holds this path to it
+        with span("cache.stage", type=self.type_name, rows=len(batch)), \
+                ledger.compile_scope("cache.stage"):
+            self._install_locked(batch, min_cap)
+
+    def _install_locked(self, batch, min_cap: int = 0) -> None:
         import jax.numpy as jnp
 
         self._bin_range = None
@@ -2715,7 +2762,11 @@ class StreamingDeviceIndex(DeviceIndex):
     def append(self, batch) -> None:
         """Stage only the new rows; one donated device update per call.
         Fids must be new — use upsert() when overwrites are possible."""
-        with self._lock:
+        from geomesa_tpu import ledger
+
+        # incremental staging compiles (delta pack, pad concat, the
+        # donated slot-write) carry the same family as a full restage
+        with self._lock, ledger.compile_scope("cache.stage"):
             self._append_locked(batch)
 
     def _append_locked(self, batch) -> None:
@@ -2802,7 +2853,9 @@ class StreamingDeviceIndex(DeviceIndex):
 
     def evict(self, fids) -> None:
         """Drop rows by fid: flips validity bits on device, no restage."""
-        with self._lock:
+        from geomesa_tpu import ledger
+
+        with self._lock, ledger.compile_scope("cache.stage"):
             self._evict_locked(fids)
 
     def _evict_locked(self, fids) -> None:
@@ -2834,7 +2887,9 @@ class StreamingDeviceIndex(DeviceIndex):
 
     def upsert(self, batch) -> None:
         """Evict any existing rows for the batch's fids, then append."""
-        with self._lock:
+        from geomesa_tpu import ledger
+
+        with self._lock, ledger.compile_scope("cache.stage"):
             existing = [f for f in batch.fids.tolist() if f in self._row_of]
             if existing:
                 self._evict_locked(np.asarray(existing, dtype=object))
@@ -3086,13 +3141,17 @@ class ShardedDeviceIndex(DeviceIndex):
         from geomesa_tpu import metrics, tracing
         from geomesa_tpu.tracing import span
 
+        from geomesa_tpu import ledger
+
         rows_hint = getattr(self.store, "manifest_rows", None)
         hint = int(rows_hint(self.type_name)) if rows_hint else -1
         t0 = _time.perf_counter()
+        # the whole build is a stage: the mesh-sort's splitter-exchange
+        # launches compile here too, not just the final plane staging
         with self._lock, span(
             "mesh.build", type=self.type_name, shards=self._n_shards,
             rows_hint=hint,
-        ):
+        ), ledger.compile_scope("cache.stage"):
             res = self.store.query(self.type_name, _staging_query())
             batch = res.batch
             order = self._mesh_order(batch)
@@ -3221,7 +3280,18 @@ class ShardedDeviceIndex(DeviceIndex):
     def _record_shards(self, ctx, t0: float, dur: float) -> None:
         """Per-shard residency manifest (ShardMeta) + gauges + one
         retroactive ``mesh.shard`` span per shard (they ran concurrently
-        inside the one SPMD build, so they share the build's timing)."""
+        inside the one SPMD build, so they share the build's timing).
+
+        The boundary-key gathers below are eager device reads that
+        compile per sharding layout — build bookkeeping, so they carry
+        the stage family (caller runs right after the scoped build)."""
+        from geomesa_tpu import ledger, metrics, tracing
+        from geomesa_tpu.index.api import ShardMeta
+
+        with ledger.compile_scope("cache.stage"):
+            self._record_shards_scoped(ctx, t0, dur)
+
+    def _record_shards_scoped(self, ctx, t0: float, dur: float) -> None:
         from geomesa_tpu import metrics, tracing
         from geomesa_tpu.index.api import ShardMeta
 

@@ -65,8 +65,11 @@ __all__ = [
     "CompileLedger",
     "LEDGER",
     "COMPILES",
+    "add_compile_observer",
     "attach_cost",
+    "attach_scope",
     "capture_cost",
+    "capture_scope",
     "charge",
     "collect_cost",
     "compile_scope",
@@ -215,6 +218,38 @@ _cost: contextvars.ContextVar = contextvars.ContextVar(
     "geomesa_ledger_cost", default=None
 )
 
+# -- runtime-checker observer seams ------------------------------------------
+#
+# The analysis-layer runtime checkers (ctxcheck / compilecheck) arm
+# these at install time; unarmed they are None / empty and every hook
+# below is a single falsy check — the production path stays unchanged.
+#: called as fn(active_cost_or_None, field) on every context-routed charge
+_charge_observer = None
+#: called as fn(cost_or_collector, entering: bool) when a collector is
+#: explicitly attached/installed on (entering) or detached from (exiting)
+#: a thread — how ctxcheck learns which collectors a worker task may
+#: legitimately charge
+_attach_observer = None
+#: called as fn(raw_scope_or_None, active_cost_or_None, dur_s) on every
+#: backend compile event, BEFORE the fallback-signature resolution
+_compile_observers: list = []
+
+
+def add_compile_observer(fn) -> None:
+    """Register a backend-compile event observer (runtime checkers)."""
+    if fn not in _compile_observers:
+        _compile_observers.append(fn)
+
+
+def set_charge_observer(fn) -> None:
+    global _charge_observer
+    _charge_observer = fn
+
+
+def set_attach_observer(fn) -> None:
+    global _attach_observer
+    _attach_observer = fn
+
 
 @contextmanager
 def collect_cost(**meta):
@@ -226,9 +261,13 @@ def collect_cost(**meta):
     and a dropped-on-the-floor charge costs a dict add."""
     cost = RequestCost(**meta)
     token = _cost.set(cost)
+    if _attach_observer is not None:
+        _attach_observer(cost, True)
     try:
         yield cost
     finally:
+        if _attach_observer is not None:
+            _attach_observer(cost, False)
         _cost.reset(token)
 
 
@@ -241,6 +280,8 @@ def charge(field: str, amount: float) -> None:
     with the ledger disabled). ``field`` must be a :data:`FIELDS` name
     — GT009 validates call-site literals statically."""
     cost = _cost.get()
+    if _charge_observer is not None:
+        _charge_observer(cost, field)
     if cost is not None:
         cost.charge(field, amount)
 
@@ -259,9 +300,13 @@ def attach_cost(cost):
         yield
         return
     token = _cost.set(cost)
+    if _attach_observer is not None:
+        _attach_observer(cost, True)
     try:
         yield
     finally:
+        if _attach_observer is not None:
+            _attach_observer(cost, False)
         _cost.reset(token)
 
 
@@ -278,6 +323,7 @@ def attach_cost(cost):
 #: leg that exercises it).
 SCOPE_FAMILIES = (
     ("cache.stage", "resident column staging pipeline"),
+    ("cache.scan", "resident per-filter scan kernels"),
     ("store.scan", "streamed store-scan kernels"),
     ("fused.dim", "fused micro-batch count/query (r x q capacities)"),
     ("fused.cmp", "fused single-query compare kernels"),
@@ -299,6 +345,28 @@ def compile_scope(signature: str):
     width bucketed to a power of two). The device-cache kernel builders
     wrap their jit sites with this so the compile ledger attributes
     compile time to query shapes, not just to whole requests."""
+    token = _scope.set(str(signature))
+    try:
+        yield
+    finally:
+        _scope.reset(token)
+
+
+def capture_scope() -> "str | None":
+    """The active compile-scope signature, for EXPLICIT propagation
+    onto worker threads (the blessed spawn helper carries it with the
+    trace/cost/degraded set — a builder that hands device work to a
+    pool keeps its compiles attributed)."""
+    return _scope.get()
+
+
+@contextmanager
+def attach_scope(signature):
+    """Attach a captured compile scope around work on another thread
+    (:mod:`geomesa_tpu.spawn`); None attaches nothing."""
+    if signature is None:
+        yield
+        return
     token = _scope.set(str(signature))
     try:
         yield
@@ -333,6 +401,16 @@ class CompileLedger:
     def on_backend_compile(self, dur_s: float) -> None:
         sig = self._signature()
         cost = _cost.get()
+        if _compile_observers:
+            # the runtime checkers see the RAW scope (None when no
+            # compile_scope is active — the fallback signature would
+            # mask exactly the unattributed compiles they exist to flag)
+            raw = _scope.get()
+            for obs in _compile_observers:
+                try:
+                    obs(raw, cost, dur_s)
+                except Exception:  # pragma: no cover - checkers must not break jit
+                    pass
         trace_id = cost.trace_id if cost is not None else ""
         with self._lock:
             ent = self._by_sig.get(sig)

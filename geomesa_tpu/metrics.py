@@ -617,6 +617,26 @@ lockcheck_blocking = REGISTRY.gauge(
     "blocking calls observed under a held (non-blocking_ok) lock",
 )
 
+# runtime context-propagation checker (analysis/ctxcheck.py) and
+# serving-path recompile tripwire (analysis/compilecheck.py): same
+# contract as the lockcheck gauges -- set on report(), zero findings is
+# the healthy shape
+ctxcheck_tasks = REGISTRY.gauge(
+    "geomesa_ctxcheck_tasks", "blessed worker tasks observed this process"
+)
+ctxcheck_findings = REGISTRY.gauge(
+    "geomesa_ctxcheck_findings",
+    "context-propagation findings (leaks, mismatched/orphaned accounting)",
+)
+compilecheck_compiles = REGISTRY.gauge(
+    "geomesa_compilecheck_serving_compiles",
+    "backend compiles observed while serving was live",
+)
+compilecheck_violations = REGISTRY.gauge(
+    "geomesa_compilecheck_violations",
+    "serving-path compiles outside the allowed compile_scope namespace",
+)
+
 # device-side spatial join engine (join/): planner strategy choices
 # (bounded label: the strategy enum), candidate/pair volumes, batched
 # refinement launches, the skew-splitting escape, and the legacy

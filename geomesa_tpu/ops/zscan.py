@@ -400,7 +400,9 @@ def z3_dim_plane_qarr(
             ranges.append((lo, hi))
     if len(ranges) > max_ranges:
         return None
-    r = max(1, 1 << max(len(ranges) - 1, 0).bit_length())
+    from geomesa_tpu.bucketing import bucket_cap
+
+    r = bucket_cap(len(ranges))  # same ladder as pad_ranges
     out = np.empty(4 + 2 * r, np.uint32)
     if ranges:
         out[0:4] = [qnx[0], qnx[1], qny[0], qny[1]]

@@ -133,6 +133,8 @@ def _density_device(x, y, w, env, width, height):
     import jax
     import jax.numpy as jnp
 
+    from geomesa_tpu import ledger
+
     @jax.jit
     def kernel(xd, yd, wd):
         px, py, inside = _pixel_ids(xd, yd, env, width, height, jnp)
@@ -141,4 +143,8 @@ def _density_device(x, y, w, env, width, height):
         grid = jnp.zeros(height * width, dtype=jnp.float32)
         return grid.at[flat].add(contrib).reshape(height, width)
 
-    return kernel(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w))
+    # store-path density is still a serving aggregation: its compiles
+    # (batch-length-shaped, the host fallback's known cost) carry the
+    # same fused.agg family the resident raster path uses
+    with ledger.compile_scope("fused.agg:density.store"):
+        return kernel(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w))

@@ -181,7 +181,7 @@ class PubSubHub:
                     # lint: disable=GT002(the match lock's purpose is
                     # seq-ordered event dispatch; declared blocking_ok)
                     self._match_record(type_name, b, s)
-                except Exception:
+                except Exception:  # lint: disable=GT011(reasoned swallow: a match fault must never un-ack the append; counted + logged, cursor replay re-derives the alerts)
                     # a match fault must never un-ack the append: the
                     # cursor replay path re-derives the missed alerts
                     self.match_faults += 1

@@ -60,6 +60,7 @@ import urllib.request
 from dataclasses import dataclass, field
 
 from geomesa_tpu.locking import checked_lock
+from geomesa_tpu.spawn import spawn_thread
 from geomesa_tpu.store.wal import RecordParser, WalCorruption
 
 __all__ = ["ReplicaConfig", "Replicator", "StaleLeaderError", "ROLES"]
@@ -187,8 +188,8 @@ class Replicator:
             t = self._thread
             if self._stop.is_set() or (t is not None and t.is_alive()):
                 return
-            self._thread = threading.Thread(
-                target=self._run_loop, daemon=True, name="replica-agent"
+            self._thread = spawn_thread(
+                self._run_loop, name="replica-agent", context=False
             )
             self._thread.start()
 
@@ -275,7 +276,7 @@ class Replicator:
                 "own_epoch": prev_epoch,
                 "successor": new_leader,
             })
-        except Exception:  # pragma: no cover - observability must not break
+        except Exception:  # pragma: no cover - observability must not break  # lint: disable=GT011(flight-recorder trigger is best-effort observability; a demotion must complete regardless)
             pass
 
     def ack_mode(self) -> str:
@@ -304,7 +305,7 @@ class Replicator:
             # takes pubsub locks, then commit_floor retakes _ack_cv)
             try:
                 hub.commit_advanced(type_name)
-            except Exception:  # pragma: no cover - ship must not die
+            except Exception:  # pragma: no cover - ship must not die  # lint: disable=GT011(best-effort push kick, logged: a pubsub flush fault must not fail the follower ack path)
                 log.warning("pubsub commit flush failed", exc_info=True)
 
     def commit_floor(self, type_name: str) -> "int | None":
@@ -714,7 +715,7 @@ class Replicator:
                 "types": types,
                 "epoch": self._epoch,
             })
-        except Exception:  # pragma: no cover - observability must not break
+        except Exception:  # pragma: no cover - observability must not break  # lint: disable=GT011(flight-recorder trigger is best-effort observability; reprovision proceeds regardless)
             pass
         deadline = started + max(
             float(sys_prop("replica.reprovision.s")), 1.0
@@ -906,7 +907,7 @@ class Replicator:
     def _peer_stats(self, peer: str, timeout: float) -> "dict | None":
         try:
             return _http_json(peer + "/stats/replica", timeout)
-        except Exception:
+        except Exception:  # lint: disable=GT011(peer health probe: an unreachable peer IS the signal; None routes it to the discovery loop)
             return None
 
     def _discover_leader(self) -> "str | None":
@@ -1076,7 +1077,7 @@ class Replicator:
                 "applied_total": self.applied_total(),
                 "epoch": self._epoch,
             })
-        except Exception:  # pragma: no cover - observability must not break
+        except Exception:  # pragma: no cover - observability must not break  # lint: disable=GT011(flight-recorder trigger is best-effort observability; failover completes regardless)
             pass
         if self.pubsub is not None:
             # re-arm continuous-query matching from the replicated
@@ -1084,7 +1085,7 @@ class Replicator:
             # (and pinning retention for) every standing subscription
             try:
                 self.pubsub.note_promoted()
-            except Exception:  # pragma: no cover - must not fail promotion
+            except Exception:  # pragma: no cover - must not fail promotion  # lint: disable=GT011(best-effort push re-arm: a pubsub fault must not fail the promotion; cursor replay recovers matching)
                 pass
 
     # -- introspection -------------------------------------------------------

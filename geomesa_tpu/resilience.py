@@ -484,6 +484,21 @@ _collector: contextvars.ContextVar = contextvars.ContextVar(
     "geomesa_degraded", default=None
 )
 
+# observer seams for the runtime context checker (ctxcheck): armed only
+# by its install(); None costs one comparison per attach/stamp
+_attach_observer = None
+_degraded_observer = None
+
+
+def set_attach_observer(fn) -> None:
+    global _attach_observer
+    _attach_observer = fn
+
+
+def set_degraded_observer(fn) -> None:
+    global _degraded_observer
+    _degraded_observer = fn
+
 #: bounded reason enum (metric label discipline): every note_degraded
 #: reason must come from here — an unlisted reason still collects but
 #: is counted under "other" so label cardinality stays fixed
@@ -513,9 +528,13 @@ def collect_degraded():
     ordered, deduplicated) reason list the request accumulated."""
     reasons: list = []
     token = _collector.set(reasons)
+    if _attach_observer is not None:
+        _attach_observer(reasons, True)
     try:
         yield reasons
     finally:
+        if _attach_observer is not None:
+            _attach_observer(reasons, False)
         _collector.reset(token)
 
 
@@ -530,6 +549,8 @@ def note_degraded(reason: str) -> None:
     )
     ledger.charge("degraded", 1)
     reasons = _collector.get()
+    if _degraded_observer is not None:
+        _degraded_observer(reasons, reason)
     if reasons is not None and reason not in reasons:
         reasons.append(reason)
 
@@ -554,9 +575,13 @@ def attach_degraded(reasons):
         yield
         return
     token = _collector.set(reasons)
+    if _attach_observer is not None:
+        _attach_observer(reasons, True)
     try:
         yield
     finally:
+        if _attach_observer is not None:
+            _attach_observer(reasons, False)
         _collector.reset(token)
 
 

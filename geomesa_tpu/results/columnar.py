@@ -44,7 +44,7 @@ def with_extra_columns(batch: FeatureBatch, extra: dict) -> FeatureBatch:
     spec = batch.sft.spec
     cols = dict(batch.columns)
     for name, vals in extra.items():
-        arr = np.asarray(vals)
+        arr = np.asarray(vals)  # lint: disable=GT004(host-list coercion of extra columns: no device array is in play)
         if len(arr) != len(batch):
             raise ValueError(
                 f"extra column {name!r} has {len(arr)} rows, "

@@ -59,6 +59,8 @@ import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass
 
+from geomesa_tpu.spawn import spawn_thread
+
 _retry_rng = random.Random()  # Retry-After jitter (de-correlates clients)
 
 LANE_INTERACTIVE = "interactive"
@@ -204,16 +206,19 @@ class QueryScheduler:
         # finish the requests again nor retire the running count twice
         self._inflight: dict = {}
         self._inflight_seq = 0
+        # service threads: the worker loop attaches each rider's captured
+        # context per launch itself (see _execute) — inheriting the
+        # CONSTRUCTING thread's context would pin it forever
         self._workers = [
-            threading.Thread(
-                target=self._worker, daemon=True, name=f"sched-worker-{i}"
+            spawn_thread(
+                self._worker, name=f"sched-worker-{i}", context=False
             )
             for i in range(max(1, self.config.max_inflight))
         ]
         for w in self._workers:
             w.start()
-        self._watchdog = threading.Thread(
-            target=self._watchdog_loop, daemon=True, name="sched-watchdog"
+        self._watchdog = spawn_thread(
+            self._watchdog_loop, name="sched-watchdog", context=False
         )
         self._watchdog.start()
 
@@ -566,9 +571,9 @@ class QueryScheduler:
                     ))
             if stuck:
                 replacements = [
-                    threading.Thread(
-                        target=self._worker, daemon=True,
-                        name="sched-worker-replacement",
+                    spawn_thread(
+                        self._worker, name="sched-worker-replacement",
+                        context=False,
                     )
                     for _ in stuck
                 ]
@@ -636,7 +641,7 @@ class QueryScheduler:
                         resilience.attach_degraded(live[0].degraded), \
                         ledger.attach_cost(live[0].cost):
                     fused = execute_group([r.fuse for r in live])
-            except Exception:
+            except Exception:  # lint: disable=GT011(fusion is an optimization: any failure falls back to the serial path, which classifies per-request)
                 fused = None  # any fusion failure: serial is always exact
         with self._cv:
             if fused is not None:

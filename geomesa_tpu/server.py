@@ -126,6 +126,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, unquote, urlparse
 
+from geomesa_tpu.spawn import spawn_thread
+
 
 class _GeomesaHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer whose ``shutdown`` is a DRAINING shutdown:
@@ -145,6 +147,9 @@ class _GeomesaHTTPServer(ThreadingHTTPServer):
 
     def __init__(self, *args, **kwargs):
         self.draining = threading.Event()
+        # compilecheck serving-window bracket (set by make_server once
+        # the server is fully wired; flag keeps double-shutdown balanced)
+        self._ccheck_live = False
         super().__init__(*args, **kwargs)
 
     def shutdown(self):
@@ -154,7 +159,7 @@ class _GeomesaHTTPServer(ThreadingHTTPServer):
             # because ITS OWN drain made the leader look dead
             try:
                 self.replica.close()
-            except Exception:  # close is best-effort on the way down
+            except Exception:  # lint: disable=GT011(shutdown teardown: a failing close must not stop the drain)  # close is best-effort on the way down
                 pass
         if self.scheduler is not None:
             self.scheduler.close(timeout=5.0)
@@ -163,22 +168,29 @@ class _GeomesaHTTPServer(ThreadingHTTPServer):
             # connection BEFORE the live layer seals its WAL
             try:
                 self.pubsub.close()
-            except Exception:  # close is best-effort on the way down
+            except Exception:  # lint: disable=GT011(shutdown teardown: a failing close must not stop the drain)  # close is best-effort on the way down
                 pass
         if self.stream_layer is not None:
             # stop the compactor and seal the WAL; acked-but-uncompacted
             # rows stay durable in the log and replay on the next open
             try:
                 self.stream_layer.close()
-            except Exception:  # close is best-effort on the way down
+            except Exception:  # lint: disable=GT011(shutdown teardown: a failing close must not stop the drain)  # close is best-effort on the way down
                 pass
         aw = getattr(self.store, "audit_writer", None)
         if aw is not None:
             try:
                 aw.flush()
-            except Exception:  # flush is best-effort on the way down
+            except Exception:  # lint: disable=GT011(shutdown teardown: a failing audit flush must not stop the drain)  # flush is best-effort on the way down
                 pass
         super().shutdown()
+        if self._ccheck_live:
+            # after the accept loop stops: compiles during the drain are
+            # still serving-path compiles and stay checked
+            self._ccheck_live = False
+            from geomesa_tpu.analysis import compilecheck
+
+            compilecheck.CHECKER.serving_down()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -320,7 +332,7 @@ class _Handler(BaseHTTPRequestHandler):
                     trace_id=current_trace_id(),
                     degraded=",".join(current_degraded()),
                 ))
-        except Exception:  # pragma: no cover - observability must not break
+        except Exception:  # pragma: no cover - observability must not break  # lint: disable=GT011(audit emission is observability; a failed write must not fail the query it records)
             pass
 
     # quiet default request logging; hook point for real deployments
@@ -744,10 +756,8 @@ class _Handler(BaseHTTPRequestHandler):
                              "configured"
                 })
             self._json(200, {"draining": True})
-            threading.Thread(
-                target=self.server.shutdown,
-                name="admin-shutdown",
-                daemon=True,
+            spawn_thread(
+                self.server.shutdown, name="admin-shutdown", context=False
             ).start()
             return
         if len(parts) == 2 and parts[0] == "subscribe":
@@ -1128,7 +1138,7 @@ class _Handler(BaseHTTPRequestHandler):
                 outcome=outcome,
                 degraded=",".join(current_degraded()),
             ))
-        except Exception:  # pragma: no cover - observability must not break
+        except Exception:  # pragma: no cover - observability must not break  # lint: disable=GT011(audit emission is observability; a failed write must not fail the query it records)
             pass
 
     def _dispatch_safe(self, url, parts: list, q: dict) -> None:
@@ -1977,7 +1987,7 @@ class _Handler(BaseHTTPRequestHandler):
                 Query(filter=cql).parsed(),
                 self.store.get_schema(type_name),
             ))
-        except Exception:
+        except Exception:  # lint: disable=GT011(eligibility probe: an unparseable filter just means no pushdown; the full path classifies it)
             return False
 
     def _pushdown_eligible(self, q: dict) -> bool:
@@ -2562,6 +2572,13 @@ def make_server(
             replicator._leader_url = replicator.cfg.self_url
         server.replica = replicator
         replicator.start()  # follower tail thread spawns here
+    from geomesa_tpu.analysis import compilecheck
+
+    if compilecheck.enabled():
+        # serving is live from here: every backend compile must carry an
+        # allowed compile_scope (analysis/compilecheck.py)
+        server._ccheck_live = True
+        compilecheck.CHECKER.serving_up()
     return server
 
 
@@ -2576,6 +2593,8 @@ def serve_background(
         store, host, port, resident=resident, warm=warm, sched=sched,
         io=io, mesh=mesh, stream=stream, replica=replica,
     )
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread = spawn_thread(
+        server.serve_forever, name="geomesa-serve", context=False
+    )
     thread.start()
     return server, thread

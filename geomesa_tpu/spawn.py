@@ -1,0 +1,197 @@
+"""Blessed worker-spawn helpers: the one place serving code creates
+threads and pools.
+
+Contextvars are per-thread, so every raw ``threading.Thread`` /
+``ThreadPoolExecutor`` in the serving tier silently drops the request
+contexts the observability and accounting layers live on — the
+submitting request's tracing span (:mod:`geomesa_tpu.tracing`), its
+ledger :class:`~geomesa_tpu.ledger.RequestCost` collector, its
+degradation collector (:mod:`geomesa_tpu.resilience`) and the active
+``compile_scope``. PR 17's warmup-misattribution bug was exactly this
+class: a background compile finishing on an unblessed thread charged
+whichever request happened to be in flight. The fix discipline, applied
+by hand in ``store/prefetch.py`` and the scheduler since PR 6, is
+capture-on-the-submitting-thread + attach-around-the-worker-body; this
+module packages that discipline so it cannot be forgotten:
+
+- :meth:`RequestContext.capture` snapshots the FULL context set on the
+  calling thread; ``with ctx.attach():`` installs it around the worker
+  body (each piece attaches with its own token, so nested attaches and
+  worker-local overrides — e.g. warmup's ``_system`` collector —
+  compose normally).
+- :func:`spawn_thread` is the ``threading.Thread`` drop-in. By default
+  it captures the spawner's context; ``context=False`` declares a
+  SERVICE thread (scheduler workers, compactors, health pollers — loops
+  that outlive any request and attach per-work-item contexts
+  themselves, or need none).
+- :class:`ContextPool` is the ``ThreadPoolExecutor`` drop-in whose
+  ``submit``/``map`` capture at SUBMIT time — the pool outlives any one
+  request, so capture-at-construction would pin the first request's
+  context forever.
+
+Lint rule GT010 enforces that every spawn site in the package goes
+through here, and the runtime context checker
+(:mod:`geomesa_tpu.analysis.ctxcheck`, armed by
+``GEOMESA_TPU_CTXCHECK=1``) instruments exactly these wrappers: it
+records which contexts were live at submit and reports worker tasks
+whose device/compile/degradation accounting ran against an orphaned or
+mismatched context. With the env unset the wrappers add one ``None``
+check per task — no instrumentation, no overhead.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+__all__ = ["RequestContext", "ContextPool", "spawn_thread"]
+
+
+class RequestContext:
+    """One captured set of per-request ambient contexts: tracing span,
+    ledger cost collector, degradation collector, compile scope."""
+
+    __slots__ = ("trace", "cost", "degraded", "scope")
+
+    def __init__(self, trace=None, cost=None, degraded=None, scope=None):
+        self.trace = trace
+        self.cost = cost
+        self.degraded = degraded
+        self.scope = scope
+
+    @staticmethod
+    def capture() -> "RequestContext":
+        """Snapshot the calling thread's full context set (each piece
+        may be None — attaching a None is a no-op for that piece)."""
+        from geomesa_tpu import ledger, resilience, tracing
+
+        return RequestContext(
+            trace=tracing.capture(),
+            cost=ledger.capture_cost(),
+            degraded=resilience.capture_degraded(),
+            scope=ledger.capture_scope(),
+        )
+
+    def any(self) -> bool:
+        return (
+            self.trace is not None
+            or self.cost is not None
+            or self.degraded is not None
+            or self.scope is not None
+        )
+
+    @contextmanager
+    def attach(self):
+        """Install the captured set around a worker's work item."""
+        from geomesa_tpu import ledger, resilience, tracing
+
+        with tracing.attach(self.trace), \
+                ledger.attach_cost(self.cost), \
+                resilience.attach_degraded(self.degraded), \
+                ledger.attach_scope(self.scope):
+            yield
+
+
+def _blessed(target, ctx: "RequestContext | None", kind: str, label: str):
+    """Wrap ``target`` so the captured context attaches around the call
+    and the runtime context checker (when armed) brackets the task."""
+    from geomesa_tpu.analysis import ctxcheck
+
+    if not ctxcheck.enabled():
+        if ctx is None:
+            return target
+
+        def run_plain(*args, **kwargs):
+            with ctx.attach():
+                return target(*args, **kwargs)
+
+        return run_plain
+
+    def run_checked(*args, **kwargs):
+        # the checker snapshots the worker's ambient state OUTSIDE the
+        # attach, so a task that installs context and fails to reset it
+        # (poisoning the next task on this pool thread) is a finding
+        with ctxcheck.CHECKER.task(kind, label, ctx):
+            if ctx is None:
+                return target(*args, **kwargs)
+            with ctx.attach():
+                return target(*args, **kwargs)
+
+    return run_checked
+
+
+def spawn_thread(
+    target,
+    *,
+    name: str,
+    args=(),
+    kwargs=None,
+    daemon: bool = True,
+    context: bool = True,
+) -> threading.Thread:
+    """The blessed ``threading.Thread`` factory (returned UNSTARTED —
+    a drop-in for construct-then-start sites). ``context=True`` captures
+    the spawner's full request-context set now and attaches it around
+    ``target``; ``context=False`` declares a service thread (a loop
+    that outlives requests and attaches per-item contexts itself).
+    Every thread gets a name: the ctxcheck/lockcheck reports and the
+    stuck-thread dumps are unreadable without one."""
+    ctx = RequestContext.capture() if context else None
+    return threading.Thread(  # lint: disable=GT010(this IS the blessed spawn factory)
+        target=_blessed(
+            target, ctx, "thread" if context else "service", name
+        ),
+        args=tuple(args),
+        kwargs=dict(kwargs) if kwargs else {},
+        name=name,
+        daemon=daemon,
+    )
+
+
+class ContextPool:
+    """The blessed ``ThreadPoolExecutor`` drop-in: ``submit``/``map``
+    capture the submitting thread's context set per call and attach it
+    around the worker-side run. ``context=False`` builds a plain pool
+    for work that must NOT inherit the caller's contexts (warmup legs
+    install their own ``_system`` collector — inheriting a live
+    request's collector is precisely the PR 17 misattribution bug).
+    Supports the executor context-manager protocol; ``shutdown`` passes
+    through."""
+
+    __slots__ = ("_ex", "_context", "_label")
+
+    def __init__(
+        self,
+        max_workers: int,
+        thread_name_prefix: str = "",
+        context: bool = True,
+    ):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._ex = ThreadPoolExecutor(  # lint: disable=GT010(this IS the blessed pool factory)
+            max_workers=max_workers,
+            thread_name_prefix=thread_name_prefix or "geomesa-pool",
+        )
+        self._context = context
+        self._label = thread_name_prefix or "geomesa-pool"
+
+    def submit(self, fn, /, *args, **kwargs):
+        ctx = RequestContext.capture() if self._context else None
+        return self._ex.submit(
+            _blessed(fn, ctx, "pool", self._label), *args, **kwargs
+        )
+
+    def map(self, fn, *iterables):
+        """Context-carrying ``Executor.map`` (capture once — map's
+        items all belong to the calling thread's current request)."""
+        ctx = RequestContext.capture() if self._context else None
+        return self._ex.map(_blessed(fn, ctx, "pool", self._label), *iterables)
+
+    def shutdown(self, wait: bool = True, cancel_futures: bool = False):
+        self._ex.shutdown(wait=wait, cancel_futures=cancel_futures)
+
+    def __enter__(self) -> "ContextPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(wait=True)

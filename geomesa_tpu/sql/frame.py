@@ -142,12 +142,11 @@ class SpatialFrame:
             return []
         if parallelism is None or parallelism <= 1 or len(parts) == 1:
             return [fn(p) for p in parts]
-        from concurrent.futures import ThreadPoolExecutor
-
         from geomesa_tpu.pyarrow_compat import preload_pyarrow
+        from geomesa_tpu.spawn import ContextPool
 
         preload_pyarrow()
-        with ThreadPoolExecutor(max_workers=parallelism) as pool:
+        with ContextPool(parallelism, thread_name_prefix="sql-part") as pool:
             return list(pool.map(fn, parts))
 
     # -- grouped aggregation ----------------------------------------------

@@ -626,7 +626,7 @@ class FileSystemDataStore:
             return None
         try:
             return parse(raw)
-        except Exception:
+        except Exception:  # lint: disable=GT011(sidecar stats are advisory: corrupt chunk metadata degrades to a full scan, never a failed open)
             return None  # chunk stats are advisory; never block opening
 
     @staticmethod
@@ -637,7 +637,7 @@ class FileSystemDataStore:
 
         try:
             return seq_from_json(raw)
-        except Exception:
+        except Exception:  # lint: disable=GT011(sidecar stats are advisory: corrupt sketches degrade estimates, never a failed open)
             return None  # stats are advisory; never block opening
 
     @staticmethod
@@ -880,7 +880,6 @@ class FileSystemDataStore:
         each step for the chaos suite."""
         import dataclasses
         import uuid
-        from concurrent.futures import ThreadPoolExecutor
 
         from geomesa_tpu.conf import sys_prop
         from geomesa_tpu.failpoints import fail_point
@@ -914,7 +913,11 @@ class FileSystemDataStore:
         writes: "list[tuple]" = []  # (PartitionMeta, Future[checksum])
         dirs = {d}  # every directory holding a new file gets fsynced
         publishing = False
-        ex = ThreadPoolExecutor(max_workers=2)
+        from geomesa_tpu.spawn import ContextPool
+
+        # blessed pool: the writer threads charge write I/O to the
+        # flushing request's collector (carried by submit-time capture)
+        ex = ContextPool(2, thread_name_prefix="fs-flush")
         try:
             if st.scheme is not None and len(data):
                 # group rows by directory leaf; each leaf is sorted +

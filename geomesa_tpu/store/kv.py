@@ -124,7 +124,7 @@ def _stats_from_bytes(raw: bytes):
 
     try:
         return seq_from_json(_json.loads(raw.decode("utf-8")))
-    except Exception:
+    except Exception:  # lint: disable=GT011(persisted sketches are advisory: a corrupt blob degrades estimates, never a failed reopen)
         return None
 
 

@@ -140,22 +140,30 @@ class SlabStream:
             mat[:, n:] = 0
             for i, k in enumerate(four):
                 mat[i, :n] = np.ascontiguousarray(host[k]).view(np.uint32)
-            rest = {}
-            for k in rest_names:
-                buf = np.empty((cap,) + host[k].shape[1:], host[k].dtype)
-                buf[:n] = host[k]
-                buf[n:] = 0
-                rest[k] = jnp.asarray(buf)
-            valid = np.zeros(cap, bool)
-            valid[:n] = True
-            # dtype/name pairs are a STATIC argument: one executable per
-            # (schema, bucket) pair, regardless of chunk count
-            out = self._jit(
-                jnp.asarray(mat),
-                tuple((str(host[k].dtype), k) for k in four),
-                rest,
-                jnp.asarray(valid),
-            )
+            from geomesa_tpu import ledger
+
+            # the slab launch (and its staging converts) compile under
+            # the streamed-scan family — scoped per slab, NOT across the
+            # yield below (the consumer's own compiles are its own)
+            with ledger.compile_scope("store.scan"):
+                rest = {}
+                for k in rest_names:
+                    buf = np.empty(
+                        (cap,) + host[k].shape[1:], host[k].dtype
+                    )
+                    buf[:n] = host[k]
+                    buf[n:] = 0
+                    rest[k] = jnp.asarray(buf)
+                valid = np.zeros(cap, bool)
+                valid[:n] = True
+                # dtype/name pairs are a STATIC argument: one executable
+                # per (schema, bucket) pair, regardless of chunk count
+                out = self._jit(
+                    jnp.asarray(mat),
+                    tuple((str(host[k].dtype), k) for k in four),
+                    rest,
+                    jnp.asarray(valid),
+                )
             self.slabs += 1
             self.rows += n
             self.bytes_streamed += mat.nbytes + cap + sum(
@@ -346,7 +354,7 @@ class StreamedDeviceScan:
                 sum(int(c.nbytes) for c in cols.values()
                     if hasattr(c, "nbytes")),
             )
-        except Exception:  # staged planes without nbytes: skip the charge
+        except Exception:  # lint: disable=GT011(metering fallback: a plane without nbytes skips the byte charge, the scan itself is unaffected)  # staged planes without nbytes: skip the charge
             pass
         return cols, (batch if want_batch else None)
 

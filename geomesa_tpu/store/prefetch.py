@@ -113,7 +113,7 @@ def batch_nbytes(batch) -> int:
             sum(int(v.nbytes) for v in batch.columns.values())
             + int(batch.fids.nbytes)
         )
-    except Exception:
+    except Exception:  # lint: disable=GT011(queue-budget sizing heuristic: an unsizable batch counts as 0 and the budget stays conservative elsewhere)
         return 0
 
 
@@ -185,35 +185,28 @@ def prefetch_map(fn, items, config=None, size_of=None):
 
 
 def _prefetch_threads(fn, items, cfg: PrefetchConfig, size_of):
-    from concurrent.futures import ThreadPoolExecutor
-
-    from geomesa_tpu import metrics, tracing
+    from geomesa_tpu import metrics
+    from geomesa_tpu.spawn import ContextPool
 
     it = iter(items)
     depth = cfg.effective_depth
     budget = cfg.byte_budget
     lock = checked_lock("prefetch.queued")
     queued = {"bytes": 0}  # completed-but-unconsumed result bytes
-    # span context crosses the pool EXPLICITLY: contextvars are
-    # per-thread, so without this capture/attach pair the workers' read/
-    # decode/stage spans would silently vanish from the request's trace
-    # (tracing.py module docstring). Captured HERE — the consumer thread
-    # at generator start — and attached around each work item. The cost
-    # ledger rides the same way: bytes read on a worker are charged to
-    # the request whose scan asked for them.
-    from geomesa_tpu import ledger
-
-    trace_ctx = tracing.capture()
-    cost_ctx = ledger.capture_cost()
 
     def run(item):
-        with tracing.attach(trace_ctx), ledger.attach_cost(cost_ctx):
-            out = fn(item)
+        # request context (trace spans, cost collector, degradation,
+        # compile scope) crosses the pool via the blessed ContextPool:
+        # contextvars are per-thread, so without the submit-time
+        # capture/attach the workers' read/decode/stage spans would
+        # silently vanish from the request's trace and bytes read on a
+        # worker would charge nobody (tracing.py module docstring)
+        out = fn(item)
         b = 0
         if size_of is not None and budget:
             try:
                 b = int(size_of(out))
-            except Exception:
+            except Exception:  # lint: disable=GT011(queue-budget sizing heuristic: an unsizable item is uncounted, the pipeline result is untouched)
                 b = 0
             with lock:
                 queued["bytes"] += b
@@ -222,9 +215,7 @@ def _prefetch_threads(fn, items, cfg: PrefetchConfig, size_of):
         return out, b
 
     pending: deque = deque()
-    ex = ThreadPoolExecutor(
-        max_workers=cfg.workers, thread_name_prefix=WORKER_PREFIX
-    )
+    ex = ContextPool(cfg.workers, thread_name_prefix=WORKER_PREFIX)
     # gauges are updated by DELTA (inc/dec), never set: several
     # pipelines commonly run at once (concurrent queries on a threaded
     # server) and each must contribute only its own share

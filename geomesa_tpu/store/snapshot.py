@@ -383,7 +383,7 @@ def _read_exact(fp, n: int) -> "bytes | None":
     while len(buf) < n:
         try:
             b = fp.read(n - len(buf))
-        except Exception:
+        except Exception:  # lint: disable=GT011(short-read protocol: a dead transport IS the truncation signal the resume loop keys on)
             return None  # transport died mid-read: truncation
         if not b:
             return None

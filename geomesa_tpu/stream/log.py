@@ -17,6 +17,7 @@ from typing import Callable
 import numpy as np
 
 from geomesa_tpu.locking import checked_lock
+from geomesa_tpu.spawn import spawn_thread
 
 
 @dataclass(frozen=True)
@@ -228,7 +229,10 @@ class CacheLoader:
 
         preload_pyarrow()  # consumers deserialize batches off-thread
         for i in range(len(self.plog.partitions)):
-            t = threading.Thread(target=self._run, args=(i,), daemon=True)
+            t = spawn_thread(
+                self._run, name=f"stream-consumer-{i}", args=(i,),
+                context=False,
+            )
             t.start()
             self._threads.append(t)
 

@@ -963,6 +963,7 @@ def _load_driver_backends(args):
     import numpy as np
 
     from geomesa_tpu.locking import checked_lock
+    from geomesa_tpu.spawn import spawn_thread
 
     backends = _parse_backends(args.backends)
     cql = quote(args.cql or "INCLUDE")
@@ -1106,15 +1107,17 @@ def _load_driver_backends(args):
                 pass  # a torn stream still reports its partial count
 
         sub_threads = [
-            threading.Thread(
-                target=sub_reader, args=(i, s, lead), daemon=True
+            spawn_thread(
+                sub_reader, name=f"load-sub-{i}", args=(i, s, lead),
+                context=False,
             )
             for i, s in enumerate(subs)
         ]
         for t in sub_threads:
             t.start()
     threads = [
-        threading.Thread(target=worker, args=(i,))
+        spawn_thread(worker, name=f"load-worker-{i}", args=(i,),
+                     context=False)
         for i in range(args.threads)
     ]
     t0 = time.perf_counter()
@@ -1188,6 +1191,8 @@ def cmd_load_driver(args):
     import urllib.request
     from urllib.parse import quote
 
+    from geomesa_tpu.spawn import spawn_thread
+
     if getattr(args, "backends", None):
         return _load_driver_backends(args)
     url, server = args.url, None
@@ -1242,7 +1247,8 @@ def cmd_load_driver(args):
                 lats.append(time.perf_counter() - t0)
 
     threads = [
-        threading.Thread(target=worker, args=(i,))
+        spawn_thread(worker, name=f"loadmt-worker-{i}", args=(i,),
+                     context=False)
         for i in range(args.threads)
     ]
     t0 = time.perf_counter()
@@ -1386,10 +1392,14 @@ def cmd_fleet(args):
 
 
 def cmd_lint(args):
-    """Project invariant linter (analysis/lint.py): the GT001-GT008
+    """Project invariant linter (analysis/lint.py): the GT001-GT012
     rules over the package tree (or explicit paths). Exit 0 clean, 1 on
     findings, 2 on an unreadable input -- CI gates on it, and the
-    package-self-lint test keeps tier-1 honest between CI runs."""
+    package-self-lint test keeps tier-1 honest between CI runs.
+    ``--format json|sarif`` emits the machine-readable artifact (SARIF
+    uploads straight to code scanning); ``--changed`` lints only the
+    python files git says are touched. Exit codes are identical in
+    every mode."""
     from geomesa_tpu.analysis.lint import main as lint_main
     from geomesa_tpu.analysis.rules import RULE_TABLE
 
@@ -1397,8 +1407,10 @@ def cmd_lint(args):
         for code, title in RULE_TABLE:
             print(f"{code}  {title}")
         return
-    rc = lint_main(args.paths or None)
-    if rc == 0 and not args.quiet:
+    rc = lint_main(
+        args.paths or None, fmt=args.format, changed=args.changed
+    )
+    if rc == 0 and args.format == "text" and not args.quiet:
         print("clean")
     if rc:
         sys.exit(rc)
@@ -1915,7 +1927,16 @@ def main(argv=None) -> None:
                     help="files/directories to lint (default: the "
                     "installed geomesa_tpu package)")
     sp.add_argument("--rules", action="store_true",
-                    help="print the GT001-GT008 rule table and exit")
+                    help="print the GT001-GT012 rule table and exit")
+    sp.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text",
+                    help="findings emitter: human text (default), a "
+                    "JSON array, or a SARIF 2.1.0 log for code-scanning "
+                    "upload (json/sarif emit even when clean)")
+    sp.add_argument("--changed", action="store_true",
+                    help="lint only python files git reports as "
+                    "changed (working tree + index vs HEAD, plus "
+                    "untracked)")
     sp.add_argument("-q", "--quiet", action="store_true",
                     help="suppress the 'clean' line on success")
 
@@ -2032,3 +2053,7 @@ def main(argv=None) -> None:
         except Exception:
             pass
         os._exit(0)
+
+
+if __name__ == "__main__":  # python -m geomesa_tpu.tools.cli
+    main()

@@ -126,7 +126,7 @@ def _run_leg(sig: str, fn) -> None:
         try:
             fn()
             cost.status = 200
-        except Exception:  # warmup must never break serving
+        except Exception:  # lint: disable=GT011(warmup must never break serving; the 500 status on the _system cost row IS the routing)  # warmup must never break serving
             cost.status = 500
     cost.dur_s = time.perf_counter() - t0
     if ledger.enabled():
@@ -151,10 +151,9 @@ def run(indexes: dict, threads: "int | None" = None,
     the final progress document. Synchronous — the server runs this on
     a background thread via :func:`start`; the CLI and bench call it
     directly."""
-    from concurrent.futures import ThreadPoolExecutor
-
     from geomesa_tpu import ledger
     from geomesa_tpu.conf import sys_prop
+    from geomesa_tpu.spawn import ContextPool
 
     if threads is None:
         threads = int(sys_prop("compile.warmup.threads"))
@@ -177,9 +176,13 @@ def run(indexes: dict, threads: "int | None" = None,
         )
     _gauge()
     try:
-        with ThreadPoolExecutor(
-            max_workers=max(int(threads), 1),
+        # context=False: legs install their OWN _system collector — a
+        # caller's live request context must never leak onto warmup
+        # compiles (the ISSUE 17 misattribution bug)
+        with ContextPool(
+            max(int(threads), 1),
             thread_name_prefix="geomesa-warmup",
+            context=False,
         ) as pool:
             for f in [pool.submit(_run_leg, sig, fn) for sig, fn in legs]:
                 f.result()
@@ -200,12 +203,14 @@ def start(indexes: dict, threads: "int | None" = None,
     restart can never observe a ready-but-cold window."""
     with _lock:
         _state["state"] = "warming"
-    t = threading.Thread(
-        target=run, args=(indexes,),
+    from geomesa_tpu.spawn import spawn_thread
+
+    t = spawn_thread(
+        run, name="geomesa-warmup", args=(indexes,),
         kwargs=dict(
             threads=threads, knn_kmax=knn_kmax, fusion_max=fusion_max
         ),
-        name="geomesa-warmup", daemon=True,
+        context=False,  # warmup charges _system, never the caller's request
     )
     t.start()
     return t

@@ -1,0 +1,219 @@
+"""Serving-path recompile tripwire (analysis/compilecheck.py): the
+allowed compile_scope namespace, seeded violations on private checker
+instances, the server-lifecycle serving window, and the zero-violations
+invariant over a real served workload."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import ledger
+from geomesa_tpu.analysis import compilecheck
+from geomesa_tpu.store import MemoryDataStore
+
+
+def _cost(tenant="t"):
+    return ledger.RequestCost(
+        tenant=tenant, endpoint="e", lane="interactive", shape="s"
+    )
+
+
+@pytest.fixture
+def chk(monkeypatch):
+    """A private checker swapped in for the module-level one (the
+    observer seam and the server lifecycle hooks both dispatch through
+    the module attribute)."""
+    c = compilecheck.CompileCheck("private")
+    monkeypatch.setattr(compilecheck, "CHECKER", c)
+    return c
+
+
+def test_enabled_for_the_suite():
+    assert compilecheck.enabled()
+
+
+def test_global_checker_zero_violations_invariant():
+    """The mid-run half of the conftest enforcement: no suite that ran
+    before this test compiled outside the blessed namespace while a
+    server was live."""
+    rep = compilecheck.CHECKER.report()
+    assert rep["violations"] == [], rep["violations"]
+
+
+def test_allowed_families_is_the_documented_namespace():
+    fams = {fam for fam, _ in ledger.SCOPE_FAMILIES}
+    assert compilecheck.ALLOWED_FAMILIES == fams | {"warmup", "_system"}
+    # the PR 17 bucketing families the serving path actually uses
+    assert {"fused.dim", "cache.stage", "knn"} <= fams
+
+
+# -- the decision table, seeded ---------------------------------------------
+
+
+def test_not_serving_records_nothing(chk):
+    chk.on_compile(None, _cost(), 0.1)
+    chk.on_compile("rogue.family:x", None, 0.1)
+    rep = chk.report()
+    assert rep["violations"] == [] and rep["serving_compiles"] == 0
+    assert rep["compiles"] == 2
+
+
+def test_serving_allowed_scopes_are_clean(chk):
+    chk.serving_up()
+    for sig in ("fused.dim:r=64:q=8", "cache.stage:pts", "knn:k=16",
+                "warmup:pts", "join.refine:m=4"):
+        chk.on_compile(sig, None, 0.1)
+    rep = chk.report()
+    assert rep["violations"] == []
+    assert rep["serving_compiles"] == 5
+
+
+def test_serving_unknown_family_is_a_violation(chk):
+    chk.serving_up()
+    chk.on_compile("rogue.family:whatever", _cost("t1"), 0.2)
+    vs = chk.report()["violations"]
+    assert len(vs) == 1 and vs[0]["family"] == "rogue.family"
+    assert vs[0]["tenant"] == "t1"
+
+
+def test_serving_scopeless_live_request_is_a_violation(chk):
+    """The compile-cliff regression shape: a live (non-_system) request
+    blocked on a compile no compile_scope claimed."""
+    chk.serving_up()
+    chk.on_compile(None, _cost("tenant-a"), 0.4)
+    vs = chk.report()["violations"]
+    assert len(vs) == 1 and vs[0]["scope"] is None
+    assert vs[0]["tenant"] == "tenant-a"
+    assert "cliff" in vs[0]["detail"]
+
+
+def test_serving_scopeless_worker_thread_is_a_violation(chk):
+    chk.serving_up()
+    t = threading.Thread(  # lint: disable=GT010(seeding the violation the blessed helper exists to prevent)
+        target=lambda: chk.on_compile(None, None, 0.3), name="rogue-w"
+    )
+    t.start()
+    t.join()
+    vs = chk.report()["violations"]
+    assert len(vs) == 1 and vs[0]["thread"] == "rogue-w"
+
+
+def test_serving_exemptions_main_thread_and_system(chk):
+    chk.serving_up()
+    chk.on_compile(None, None, 0.1)  # main thread, no collector
+    chk.on_compile(None, _cost("_system"), 0.1)  # warmup/staging leg
+    assert chk.report()["violations"] == []
+
+
+def test_violations_dedupe_by_site(chk):
+    chk.serving_up()
+    for _ in range(4):
+        chk.on_compile("rogue.family:x", None, 0.1)
+    assert len(chk.report()["violations"]) == 1
+
+
+def test_serving_window_refcounts(chk):
+    assert not chk.serving
+    chk.serving_up()
+    chk.serving_up()
+    chk.serving_down()
+    assert chk.serving  # two servers up, one down: still live
+    chk.serving_down()
+    assert not chk.serving
+    chk.serving_down()  # extra downs clamp at zero
+    chk.serving_up()
+    assert chk.serving
+
+
+# -- the server lifecycle brackets the window --------------------------------
+
+
+def _serve(store, **kw):
+    from geomesa_tpu.server import serve_background
+
+    return serve_background(store, **kw)
+
+
+def test_server_lifecycle_brackets_serving_window(chk):
+    server, _ = _serve(MemoryDataStore())
+    try:
+        assert chk.serving
+    finally:
+        server.shutdown()
+    assert not chk.serving
+    # idempotent shutdown must not double-decrement someone else's window
+    chk.serving_up()
+    server.shutdown()
+    assert chk.serving
+
+
+def test_real_compile_while_serving_trips_and_scoped_does_not(chk):
+    """End-to-end through jax.monitoring: while a real server is live, a
+    genuinely novel jit under an allowed scope is clean, the same
+    without any scope (charged to a live request) is THE violation."""
+    import jax
+    import jax.numpy as jnp
+
+    ledger.install()
+    server, _ = _serve(MemoryDataStore())
+    try:
+        uniq = int(time.perf_counter() * 1e9) % 1_000_003 + 2
+        with ledger.compile_scope("fused.dim:test"):
+            jax.jit(lambda x: x * uniq + 3)(jnp.arange(277))
+        assert chk.report()["violations"] == []
+        with ledger.collect_cost(
+            tenant="live-tenant", endpoint="knn", lane="interactive",
+            shape="s",
+        ):
+            jax.jit(lambda x: x * uniq + 5)(jnp.arange(281))
+        vs = chk.report()["violations"]
+        assert len(vs) == 1 and vs[0]["tenant"] == "live-tenant"
+        assert chk.report()["serving_compiles"] >= 2
+    finally:
+        server.shutdown()
+
+
+def test_served_workload_is_compile_clean(chk):
+    """The acceptance invariant in miniature: a real HTTP workload
+    (schema create, writes, count + features queries) over a resident
+    server produces ZERO unattributed serving-path compiles -- every
+    serving jit goes through the blessed scopes. The suite-wide version
+    is the conftest enforcement over all of tier-1."""
+    rng = np.random.default_rng(7)
+    n = 513
+    store = MemoryDataStore()
+    store.create_schema(
+        "pts", "name:String,dtg:Date,*geom:Point:srid=4326"
+    )
+    store.write(
+        "pts",
+        {
+            "name": rng.choice(["a", "b", "c"], n),
+            "dtg": rng.integers(0, 86_400, n).astype(np.int64),
+            "geom": np.stack(
+                [rng.uniform(-10, 10, n), rng.uniform(-10, 10, n)],
+                axis=1,
+            ),
+        },
+        fids=np.arange(n),
+    )
+    server, _ = _serve(store, resident=True)
+    try:
+        host, port = server.server_address[:2]
+        base = f"http://{host}:{port}"
+        for path in (
+            "/count/pts?cql=BBOX(geom,-5,-5,5,5)",
+            "/features/pts?cql=BBOX(geom,-5,-5,5,5)",
+            "/count/pts?cql=BBOX(geom,-2,-2,2,2)",
+        ):
+            with urllib.request.urlopen(base + path, timeout=120) as r:
+                assert r.status == 200
+                json.loads(r.read())
+    finally:
+        server.shutdown()
+    rep = chk.report()
+    assert rep["violations"] == [], rep["violations"]

@@ -1,0 +1,213 @@
+"""Runtime context checker (analysis/ctxcheck.py): the zero-findings
+invariant the conftest enforces over the whole suite, seeded detections
+for every finding kind on private checker instances, and the runtime
+half of the PR 17 regression (a raw thread compiling with no
+attribution)."""
+
+import threading
+
+import pytest
+
+from geomesa_tpu import ledger, resilience
+from geomesa_tpu.analysis import ctxcheck
+from geomesa_tpu.spawn import ContextPool, spawn_thread
+
+
+def _cost(tenant="t"):
+    return ledger.RequestCost(
+        tenant=tenant, endpoint="e", lane="interactive", shape="s"
+    )
+
+
+@pytest.fixture
+def chk(monkeypatch):
+    """A private checker swapped in for the module-level one: the
+    observer seams dispatch through the module attribute, so seeded
+    violations land here and never pollute the session-end report."""
+    c = ctxcheck.CtxCheck("private")
+    monkeypatch.setattr(ctxcheck, "CHECKER", c)
+    return c
+
+
+def test_enabled_for_the_suite():
+    """The conftest arms both env vars before any package import; the
+    whole tier-1 run doubles as the sanitizer soak."""
+    assert ctxcheck.enabled()
+
+
+def test_global_checker_zero_findings_invariant():
+    """The mid-run half of the conftest enforcement: every blessed task
+    spawned by any suite that ran before this test kept its context
+    accounting straight."""
+    rep = ctxcheck.CHECKER.report()
+    assert rep["findings"] == [], rep["findings"]
+
+
+# -- clean blessed flows stay clean -----------------------------------------
+
+
+def test_blessed_thread_with_context_is_clean(chk):
+    seen = {}
+
+    def work():
+        ledger.charge("read_bytes", 3)
+        seen["cost"] = ledger.capture_cost()
+
+    with ledger.collect_cost(
+        tenant="t", endpoint="e", lane="interactive", shape="s"
+    ) as cost:
+        t = spawn_thread(work, name="ctx-clean")
+        t.start()
+        t.join()
+    assert seen["cost"] is cost  # the request's collector crossed over
+    rep = chk.report()
+    assert rep["findings"] == []
+    assert rep["tasks"] == 1
+    assert rep["charges"] >= 1
+    assert rep["attaches"] >= 1
+
+
+def test_blessed_pool_map_is_clean(chk):
+    with resilience.collect_degraded() as reasons:
+        with ContextPool(2, thread_name_prefix="ctx-pool") as pool:
+            list(pool.map(lambda i: i * 2, range(6)))
+    assert reasons == []
+    rep = chk.report()
+    assert rep["findings"] == []
+    assert rep["tasks"] == 6
+
+
+def test_context_false_service_thread_is_clean(chk):
+    t = spawn_thread(lambda: None, name="ctx-svc", context=False)
+    t.start()
+    t.join()
+    rep = chk.report()
+    assert rep["findings"] == []
+    assert rep["tasks"] == 1
+
+
+# -- seeded detections, one per finding kind --------------------------------
+
+
+def test_seeded_ctx_leak_detected(chk):
+    """A task that attaches a collector and never resets it poisons its
+    pool thread; the pre/post ambient snapshot catches it."""
+    cost = _cost()
+    token = None
+    with chk.task("thread", "leaky", None):
+        token = ledger._cost.set(cost)  # attach without reset: the bug
+    try:
+        kinds = [f["kind"] for f in chk.report()["findings"]]
+        assert kinds == ["ctx-leak"]
+    finally:
+        ledger._cost.reset(token)
+
+
+def test_seeded_mismatched_cost_detected(chk):
+    """A charge into a collector this thread was never handed (the
+    smuggled-collector shape) is a finding; a properly attached one is
+    not."""
+    good, bad = _cost("good"), _cost("bad")
+    chk.on_attach(good, True)
+    chk.on_charge(good, "device_seconds")
+    chk.on_charge(bad, "device_seconds")
+    chk.on_attach(good, False)
+    fs = chk.report()["findings"]
+    assert [f["kind"] for f in fs] == ["mismatched-cost"]
+    assert fs[0]["tenant"] == "bad"
+
+
+def test_seeded_orphan_degraded_detected(chk):
+    handed, smuggled = [], []
+    chk.on_attach(handed, True)
+    chk.on_degraded(handed, "store_read_retry")
+    chk.on_degraded(smuggled, "knn_refine_trimmed")
+    chk.on_attach(handed, False)
+    fs = chk.report()["findings"]
+    assert [f["kind"] for f in fs] == ["orphan-degraded"]
+    assert fs[0]["reason"] == "knn_refine_trimmed"
+
+
+def test_seeded_orphan_compile_detected(chk):
+    """Scope-less, collector-less compiles are fine on the main thread
+    (test harness reality) and a finding on a worker."""
+    chk.on_compile(None, None, 0.2)  # main thread: exempt
+    chk.on_compile("fused.dim:r=64", None, 0.2)  # scoped: attributed
+    chk.on_compile(None, _cost(), 0.2)  # collector: attributed
+    t = threading.Thread(  # lint: disable=GT010(seeding the violation the blessed helper exists to prevent)
+        target=lambda: chk.on_compile(None, None, 0.3), name="rogue"
+    )
+    t.start()
+    t.join()
+    fs = chk.report()["findings"]
+    assert [f["kind"] for f in fs] == ["orphan-compile"]
+    assert fs[0]["thread"] == "rogue"
+
+
+def test_findings_dedupe_by_site(chk):
+    bad = _cost("bad")
+    for _ in range(5):
+        chk.on_charge(bad, "device_seconds")
+    assert len(chk.report()["findings"]) == 1
+
+
+def test_clear_resets_counters_and_findings(chk):
+    chk.on_charge(_cost("bad"), "read_bytes")
+    assert chk.report()["findings"]
+    chk.clear()
+    rep = chk.report()
+    assert rep["findings"] == [] and rep["charges"] == 0
+
+
+# -- the PR 17 regression, runtime half -------------------------------------
+
+
+def test_pr17_regression_raw_thread_compile_is_orphaned(chk):
+    """A RAW thread (no blessed wrapper, no compile_scope, no request
+    collector) that triggers a backend compile: exactly the warmup bug
+    PR 17 fixed. The compile-observer seam fires on the compiling
+    thread and the checker reports the unattributable seconds."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    ledger.install()
+    uniq = int(time.perf_counter() * 1e9) % 1_000_033 + 2
+
+    def rogue():
+        jax.jit(lambda x: x * uniq + 7)(jnp.arange(263))
+
+    t = threading.Thread(target=rogue, name="pr17-rogue")  # lint: disable=GT010(seeding the violation the blessed helper exists to prevent)
+    t.start()
+    t.join()
+    fs = chk.report()["findings"]
+    assert [f["kind"] for f in fs] == ["orphan-compile"], fs
+    assert fs[0]["thread"] == "pr17-rogue"
+    assert fs[0]["seconds"] > 0
+
+
+def test_pr17_fixed_shape_blessed_thread_compile_is_attributed(chk):
+    """The same compile routed the blessed way -- spawn_thread carrying
+    the request context, compile_scope active -- produces zero
+    findings and the seconds land on the request collector."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    ledger.install()
+    uniq = int(time.perf_counter() * 1e9) % 999_959 + 2
+
+    def warm():
+        with ledger.compile_scope("warmup:test"):
+            jax.jit(lambda x: x * uniq + 9)(jnp.arange(271))
+
+    with ledger.collect_cost(
+        tenant="_system", endpoint="warmup", lane="batch", shape="w"
+    ) as cost:
+        t = spawn_thread(warm, name="pr17-blessed")
+        t.start()
+        t.join()
+    assert chk.report()["findings"] == []
+    assert cost.snapshot_fields().get("compiles", 0) >= 1

@@ -1,12 +1,16 @@
 """Invariant linter (analysis/lint.py): package self-lint, one seeded
-fixture violation per rule GT001-GT009, the disable-comment escape
-hatch, and the CLI exit codes."""
+fixture violation per rule GT001-GT012, the disable-comment escape
+hatch, the machine-readable emitters (json/sarif/--changed), and the
+CLI exit codes."""
 
+import json
 import os
 
 import pytest
 
 from geomesa_tpu.analysis.lint import (
+    findings_to_json,
+    findings_to_sarif,
     format_findings,
     lint_package,
     lint_paths,
@@ -72,6 +76,26 @@ FIXTURES = {
         "def f():\n"
         "    charge('not_a_ledger_field', 1)\n",
     ),
+    "GT010": (
+        "spawny.py",
+        "import threading\n"
+        "def f():\n"
+        "    t = threading.Thread(target=print)\n"
+        "    t.start()\n",
+    ),
+    "GT011": (
+        "store/swallow.py",
+        "def f(fetch):\n"
+        "    try:\n"
+        "        return fetch()\n"
+        "    except Exception:\n"
+        "        return None\n",
+    ),
+    "GT012": (
+        "ops/padder.py",
+        "def pad_cap(n):\n"
+        "    return max(1, 1 << max(n - 1, 0).bit_length())\n",
+    ),
 }
 
 
@@ -84,7 +108,7 @@ def _write_tree(root, fixtures):
 
 @pytest.mark.lint
 def test_package_self_lint_is_clean():
-    """The GT001-GT009 rules over the geomesa_tpu tree itself: every
+    """The GT001-GT012 rules over the geomesa_tpu tree itself: every
     baseline violation is fixed or carries a reasoned disable comment.
     Rides tier-1 so a regression fails the next test run, not the next
     CI run."""
@@ -139,7 +163,9 @@ def test_multi_code_disable_with_reason_suppresses(tmp_path):
     assert lint_paths([str(tmp_path)]) == []
     (tmp_path / "bad.py").write_text(
         "import time\n"
-        "t = time.time()  # lint: disable=GT003,GT008\n"
+        # the token is split so linting THIS file's source (--changed
+        # picks test files up) does not see a bare disable directive
+        "t = time.time()  # lint: disa" "ble=GT003,GT008\n"
     )
     findings = lint_paths([str(tmp_path)])
     # the unsuppressed violation + one reason-less report per code
@@ -151,7 +177,7 @@ def test_multi_code_disable_with_reason_suppresses(tmp_path):
 def test_disable_comment_without_reason_does_not_suppress(tmp_path):
     (tmp_path / "bad.py").write_text(
         "import time\n"
-        "t = time.time()  # lint: disable=GT003\n"
+        "t = time.time()  # lint: disa" "ble=GT003\n"
     )
     findings = lint_paths([str(tmp_path)])
     assert {f.rule for f in findings} == {"GT003"}
@@ -202,3 +228,251 @@ def test_rule_table_lists_all_rules(capsys):
     out = capsys.readouterr().out
     for code in FIXTURES:
         assert code in out
+
+
+# -- the PR 20 rules: edge semantics ----------------------------------------
+
+
+@pytest.mark.lint
+def test_gt010_flags_every_raw_spawn_flavor(tmp_path):
+    (tmp_path / "flavors.py").write_text(
+        "import threading\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "from threading import Thread, Timer\n"
+        "a = threading.Thread(target=print)\n"
+        "b = ThreadPoolExecutor(max_workers=2)\n"
+        "c = Thread(target=print)\n"
+        "d = Timer(1.0, print)\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert [f.rule for f in findings] == ["GT010"] * 4
+    assert [f.line for f in findings] == [4, 5, 6, 7]
+
+
+@pytest.mark.lint
+def test_gt010_ignores_blessed_spawn_and_annotations(tmp_path):
+    (tmp_path / "ok.py").write_text(
+        "import threading\n"
+        "from geomesa_tpu.spawn import ContextPool, spawn_thread\n"
+        "def start(fn) -> threading.Thread:\n"  # reference, not a call
+        "    t = spawn_thread(fn, name='worker', context=False)\n"
+        "    t.start()\n"
+        "    return t\n"
+        "pool = ContextPool(4, thread_name_prefix='w')\n"
+    )
+    assert lint_paths([str(tmp_path)]) == []
+
+
+@pytest.mark.lint
+def test_gt011_passes_when_the_fault_is_routed(tmp_path):
+    (tmp_path / "store").mkdir()
+    (tmp_path / "store" / "routed.py").write_text(
+        "from geomesa_tpu.resilience import classify, note_degraded\n"
+        "def a(fn):\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except Exception:\n"
+        "        raise\n"
+        "def b(fn):\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except Exception as e:\n"
+        "        classify(e)\n"
+        "        return None\n"
+        "def c(fn):\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except Exception:\n"
+        "        note_degraded('store_fault')\n"
+        "        return None\n"
+        "def d(fn, log):\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except Exception as e:\n"  # bound-name use counts as routing
+        "        log.warning('fetch failed: %s', e)\n"
+        "        return None\n"
+    )
+    assert lint_paths([str(tmp_path)]) == []
+
+
+@pytest.mark.lint
+def test_gt011_only_fires_on_the_serving_surface(tmp_path):
+    src = (
+        "def f(fn):\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except Exception:\n"
+        "        return None\n"
+    )
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "tools" / "helper.py").write_text(src)
+    assert lint_paths([str(tmp_path)]) == []
+    (tmp_path / "join").mkdir()
+    (tmp_path / "join" / "hot.py").write_text(src)
+    findings = lint_paths([str(tmp_path)])
+    assert [f.rule for f in findings] == ["GT011"]
+    assert findings[0].path.endswith(os.path.join("join", "hot.py"))
+
+
+@pytest.mark.lint
+def test_gt012_flags_log2_and_spares_bucketing_users(tmp_path):
+    (tmp_path / "ops").mkdir()
+    (tmp_path / "ops" / "logpad.py").write_text(
+        "import math\n"
+        "def cap(n):\n"
+        "    return 2 ** math.ceil(math.log2(max(n, 1)))\n"
+    )
+    (tmp_path / "ops" / "bucketed.py").write_text(
+        "from geomesa_tpu.bucketing import bucket_cap\n"
+        "def cap(n):\n"
+        "    return bucket_cap(n)\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert [f.rule for f in findings] == ["GT012"]
+    assert findings[0].path.endswith("logpad.py")
+
+
+@pytest.mark.lint
+def test_pr17_regression_fixture_raw_thread_plus_jit(tmp_path):
+    """The static half of the ISSUE regression: a raw thread that jits
+    with no compile_scope attribution must be caught by GT010 at the
+    spawn site (the runtime halves live in test_ctxcheck /
+    test_compilecheck)."""
+    (tmp_path / "ops").mkdir()
+    (tmp_path / "ops" / "rogue.py").write_text(
+        "import threading\n"
+        "import jax\n"
+        "def warm(fn, x):\n"
+        "    t = threading.Thread(target=lambda: jax.jit(fn)(x))\n"
+        "    t.start()\n"
+        "    return t\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert "GT010" in {f.rule for f in findings}
+
+
+# -- machine-readable emitters ----------------------------------------------
+
+
+@pytest.mark.lint
+def test_json_emitter_round_trips_findings(tmp_path):
+    _write_tree(tmp_path, FIXTURES)
+    findings = lint_paths([str(tmp_path)])
+    doc = json.loads(findings_to_json(findings))
+    assert len(doc) == len(findings)
+    assert {d["rule"] for d in doc} >= set(FIXTURES)
+    for d in doc:
+        assert set(d) == {"rule", "path", "line", "col", "message", "title"}
+        assert d["line"] >= 1 and d["title"]
+
+
+@pytest.mark.lint
+def test_sarif_emitter_is_valid_2_1_0(tmp_path):
+    _write_tree(tmp_path, FIXTURES)
+    findings = lint_paths([str(tmp_path)])
+    doc = json.loads(findings_to_sarif(findings))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "geomesa-tpu-lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rule_ids == {f.rule for f in findings}
+    assert len(run["results"]) == len(findings)
+    for res in run["results"]:
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["region"]["startLine"] >= 1
+        assert "\\" not in loc["artifactLocation"]["uri"]
+
+
+@pytest.mark.lint
+def test_sarif_emitter_clean_run_still_emits_a_log():
+    doc = json.loads(findings_to_sarif([]))
+    assert doc["runs"][0]["results"] == []
+    assert json.loads(findings_to_json([])) == []
+
+
+@pytest.mark.lint
+def test_main_format_modes_share_exit_codes(tmp_path):
+    _write_tree(tmp_path, FIXTURES)
+    for fmt in ("text", "json", "sarif"):
+        lines: list = []
+        assert lint_main([str(tmp_path)], out=lines.append, fmt=fmt) == 1
+        assert lines
+    clean = tmp_path / "cleantree"
+    clean.mkdir()
+    (clean / "fine.py").write_text("x = 1\n")
+    for fmt in ("json", "sarif"):
+        lines = []
+        assert lint_main([str(clean)], out=lines.append, fmt=fmt) == 0
+        json.loads(lines[0])  # clean runs still emit a parseable doc
+
+
+@pytest.mark.lint
+def test_cli_lint_format_sarif(tmp_path, capsys):
+    from geomesa_tpu.tools.cli import main as cli_main
+
+    _write_tree(tmp_path, FIXTURES)
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["lint", str(tmp_path), "--format", "sarif"])
+    assert exc.value.code == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    assert {r["ruleId"] for r in doc["runs"][0]["results"]} >= set(FIXTURES)
+
+
+@pytest.mark.lint
+def test_changed_scope_lints_only_touched_files(tmp_path, monkeypatch):
+    """--changed in a scratch repo: only the dirty file is linted."""
+    import subprocess
+
+    def git(*args):
+        subprocess.run(
+            ("git",) + args, cwd=tmp_path, check=True,
+            capture_output=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    git("init", "-q")
+    (tmp_path / "committed.py").write_text(
+        "import time\nt = time.time()\n"  # GT003, but committed clean
+    )
+    git("add", "-A")
+    git("commit", "-q", "-m", "seed")
+    (tmp_path / "fresh.py").write_text(
+        "import threading\nt = threading.Thread(target=print)\n"
+    )
+    monkeypatch.chdir(tmp_path)
+    lines: list = []
+    rc = lint_main(out=lines.append, changed=True)
+    assert rc == 1
+    body = "\n".join(lines)
+    assert "fresh.py" in body and "GT010" in body
+    # the committed-but-untouched violation stays out of scope
+    assert "committed.py" not in body
+
+
+@pytest.mark.lint
+def test_changed_scope_clean_when_nothing_changed(tmp_path, monkeypatch):
+    import subprocess
+
+    env = {**os.environ,
+           "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+    subprocess.run(("git", "init", "-q"), cwd=tmp_path, check=True,
+                   capture_output=True, env=env)
+    (tmp_path / "seed.py").write_text("x = 1\n")
+    subprocess.run(("git", "add", "-A"), cwd=tmp_path, check=True,
+                   capture_output=True, env=env)
+    subprocess.run(("git", "commit", "-q", "-m", "seed"), cwd=tmp_path,
+                   check=True, capture_output=True, env=env)
+    monkeypatch.chdir(tmp_path)
+    assert lint_main(out=[].append, changed=True) == 0
+
+
+@pytest.mark.lint
+def test_changed_scope_outside_a_repo_is_exit_2(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    lines: list = []
+    assert lint_main(out=lines.append, changed=True) == 2
+    assert any("error:" in ln for ln in lines)
